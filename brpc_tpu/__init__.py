@@ -1,0 +1,22 @@
+"""brpc_tpu — a TPU-native RPC framework.
+
+A brand-new framework with the capabilities of Apache bRPC (reference:
+monographdb/brpc), re-designed TPU-first: the data plane moves payloads as
+device arrays over a ``tpu://`` transport, combo-channel fan-outs lower to XLA
+collectives over a ``jax.sharding.Mesh``, and the M:N fiber runtime parks on
+device futures instead of only futexes.
+
+Layering mirrors the reference's strict onion (see SURVEY.md §1):
+
+  butil      — TpuBuf zero-copy chained buffer, EndPoint, resource pools
+  bvar       — thread-local-combining metrics (Adder/Window/LatencyRecorder)
+  fiber      — M:N work-stealing scheduler, butex, timers, execution queues
+  transport  — Socket with versioned refs + wait-free writes; mem/tcp/tpu
+  protocol   — pluggable wire protocols (tpu_std, http, streaming)
+  rpc        — Channel/Controller/Server, combo channels, LB, naming, CB
+  builtin    — observability HTTP services (/status /vars /flags /rpcz)
+  parallel   — collective lowering of fan-out/streaming onto device meshes
+  ops        — Pallas kernels for the hot device-side paths
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,20 @@
+"""Base library: buffers, endpoints, pools, read-mostly containers.
+
+TPU-native re-design of the reference's ``src/butil`` (see SURVEY.md §2.1).
+"""
+
+from brpc_tpu.butil.iobuf import Block, BlockRef, IOBuf, IOPortal, DeviceBlock
+from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+from brpc_tpu.butil.resource_pool import ResourcePool, VersionedId
+from brpc_tpu.butil.doubly_buffered import DoublyBufferedData
+from brpc_tpu.butil.timekeeping import cpuwide_time_ns, monotime_us, gettimeofday_us
+from brpc_tpu.butil.fast_rand import fast_rand, fast_rand_less_than
+
+__all__ = [
+    "Block", "BlockRef", "IOBuf", "IOPortal", "DeviceBlock",
+    "EndPoint", "str2endpoint",
+    "ResourcePool", "VersionedId",
+    "DoublyBufferedData",
+    "cpuwide_time_ns", "monotime_us", "gettimeofday_us",
+    "fast_rand", "fast_rand_less_than",
+]

@@ -1,0 +1,36 @@
+"""DoublyBufferedData: read-mostly data with lock-free reads.
+
+The reference (butil/containers/doubly_buffered_data.h:86) keeps fg/bg
+copies and per-thread wrapper locks so readers never contend; it backs every
+load-balancer server list. Under the GIL a single reference read is already
+atomic, so the idiomatic equivalent is RCU-by-immutable-snapshot: readers
+grab the current snapshot with one attribute load; writers build the next
+snapshot under a lock and publish it with one store. Readers always see a
+complete, internally-consistent value and writers never block readers —
+the same contract, one copy cheaper.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Generic, TypeVar
+
+T = TypeVar("T")
+
+
+class DoublyBufferedData(Generic[T]):
+    def __init__(self, initial: T) -> None:
+        self._snapshot = initial
+        self._write_lock = threading.Lock()
+
+    def read(self) -> T:
+        """Lock-free; treat the result as immutable."""
+        return self._snapshot
+
+    def modify(self, fn: Callable[[T], T]) -> T:
+        """Serialize writers; fn maps old snapshot -> new snapshot (must not
+        mutate the old one in place — readers may still hold it)."""
+        with self._write_lock:
+            new = fn(self._snapshot)
+            self._snapshot = new
+            return new

@@ -1,0 +1,84 @@
+"""ResourcePool: dense versioned-id <-> object map.
+
+The reference's ResourcePool (butil/resource_pool.h) hands out dense 32-bit
+slot ids for hot objects (Socket, TaskMeta, correlation ids) so they can be
+addressed by value, with a version counter packed alongside to make stale
+ids fail addressing instead of touching a recycled object (the ABA defense
+behind Socket's versioned refs, brpc/socket.cpp:776-800).
+
+This implementation keeps that contract: ``insert`` returns a 64-bit
+VersionedId = (version << 32) | slot; ``address`` returns the object only
+while the id is live; ``remove`` bumps the version so every outstanding id
+goes stale atomically. Slots are recycled through a freelist.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+VersionedId = int
+
+_SLOT_BITS = 32
+_SLOT_MASK = (1 << _SLOT_BITS) - 1
+
+INVALID_ID: VersionedId = (1 << 64) - 1
+
+
+def id_slot(vid: VersionedId) -> int:
+    return vid & _SLOT_MASK
+
+
+def id_version(vid: VersionedId) -> int:
+    return vid >> _SLOT_BITS
+
+
+class ResourcePool(Generic[T]):
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._objs: List[Optional[T]] = []
+        self._versions: List[int] = []
+        self._free: List[int] = []
+
+    def insert(self, obj: T) -> VersionedId:
+        with self._lock:
+            if self._free:
+                slot = self._free.pop()
+                self._objs[slot] = obj
+            else:
+                slot = len(self._objs)
+                self._objs.append(obj)
+                self._versions.append(0)
+            return (self._versions[slot] << _SLOT_BITS) | slot
+
+    def address(self, vid: VersionedId) -> Optional[T]:
+        """Lock-free read: list reads are atomic under the GIL and slots
+        only ever grow, mirroring the reference's wait-free address path."""
+        slot = vid & _SLOT_MASK
+        objs = self._objs
+        if slot >= len(objs):
+            return None
+        if self._versions[slot] != (vid >> _SLOT_BITS):
+            return None
+        return objs[slot]
+
+    def remove(self, vid: VersionedId) -> Optional[T]:
+        """Invalidate the id (version bump) and free the slot. Returns the
+        object if the id was still live."""
+        slot = vid & _SLOT_MASK
+        with self._lock:
+            if slot >= len(self._objs):
+                return None
+            if self._versions[slot] != (vid >> _SLOT_BITS):
+                return None
+            obj = self._objs[slot]
+            self._objs[slot] = None
+            self._versions[slot] += 1
+            self._free.append(slot)
+            return obj
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._objs) - len(self._free)

@@ -1,0 +1,14 @@
+"""Test env: force JAX onto a virtual 8-device CPU platform BEFORE any jax
+import, so sharding/collective tests run without TPU hardware (the same
+trick the reference uses by testing everything over 127.0.0.1 loopback,
+SURVEY.md §4)."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,112 @@
+import threading
+
+import pytest
+
+from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+from brpc_tpu.butil.resource_pool import INVALID_ID, ResourcePool, id_slot, id_version
+from brpc_tpu.butil.doubly_buffered import DoublyBufferedData
+from brpc_tpu.butil.fast_rand import fast_rand, fast_rand_less_than
+
+
+class TestEndPoint:
+    def test_parse_tcp(self):
+        ep = str2endpoint("tcp://10.0.0.1:8000")
+        assert (ep.scheme, ep.host, ep.port) == ("tcp", "10.0.0.1", 8000)
+
+    def test_parse_bare_hostport(self):
+        ep = str2endpoint("127.0.0.1:9000")
+        assert (ep.scheme, ep.host, ep.port) == ("tcp", "127.0.0.1", 9000)
+
+    def test_parse_mem(self):
+        ep = str2endpoint("mem://server-a")
+        assert (ep.scheme, ep.host, ep.port) == ("mem", "server-a", 0)
+
+    def test_parse_tpu_with_device(self):
+        ep = str2endpoint("tpu://worker0:8476#device=3")
+        assert ep.scheme == "tpu"
+        assert ep.device == 3
+
+    def test_roundtrip(self):
+        for s in ["tcp://a:1", "mem://x", "tpu://h:2#coord=0,1,2&device=5"]:
+            assert str(str2endpoint(s)) == s
+
+    def test_with_extras(self):
+        ep = str2endpoint("tpu://h:1").with_extras(device=2)
+        assert ep.device == 2
+
+
+class TestResourcePool:
+    def test_insert_address_remove(self):
+        pool = ResourcePool()
+        vid = pool.insert("obj")
+        assert pool.address(vid) == "obj"
+        assert pool.remove(vid) == "obj"
+        assert pool.address(vid) is None
+        assert pool.remove(vid) is None
+
+    def test_stale_id_after_slot_reuse(self):
+        pool = ResourcePool()
+        vid1 = pool.insert("a")
+        pool.remove(vid1)
+        vid2 = pool.insert("b")
+        assert id_slot(vid1) == id_slot(vid2)
+        assert id_version(vid2) == id_version(vid1) + 1
+        assert pool.address(vid1) is None  # stale id must not see "b"
+        assert pool.address(vid2) == "b"
+
+    def test_concurrent_insert_remove(self):
+        pool = ResourcePool()
+        errors = []
+
+        def worker(tag):
+            try:
+                for i in range(500):
+                    vid = pool.insert((tag, i))
+                    assert pool.address(vid) == (tag, i)
+                    assert pool.remove(vid) == (tag, i)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        ts = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not errors
+        assert len(pool) == 0
+
+
+class TestDoublyBuffered:
+    def test_read_modify(self):
+        dbd = DoublyBufferedData({"a": 1})
+        assert dbd.read() == {"a": 1}
+        dbd.modify(lambda d: {**d, "b": 2})
+        assert dbd.read() == {"a": 1, "b": 2}
+
+    def test_readers_see_consistent_snapshot_under_writes(self):
+        dbd = DoublyBufferedData(tuple(range(10)))
+        stop = threading.Event()
+        bad = []
+
+        def reader():
+            while not stop.is_set():
+                snap = dbd.read()
+                if len(snap) != 10 or snap[0] + 9 != snap[-1]:
+                    bad.append(snap)
+
+        def writer():
+            for base in range(1000):
+                dbd.modify(lambda _: tuple(range(base, base + 10)))
+            stop.set()
+
+        rs = [threading.Thread(target=reader) for _ in range(4)]
+        w = threading.Thread(target=writer)
+        [t.start() for t in rs]
+        w.start()
+        w.join()
+        [t.join() for t in rs]
+        assert not bad
+
+
+def test_fast_rand_distribution():
+    seen = {fast_rand_less_than(4) for _ in range(200)}
+    assert seen == {0, 1, 2, 3}
+    assert fast_rand() != fast_rand()

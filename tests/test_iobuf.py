@@ -1,0 +1,163 @@
+import numpy as np
+import pytest
+
+from brpc_tpu.butil.iobuf import (
+    DEFAULT_BLOCK_SIZE, Block, DeviceBlock, IOBuf, IOPortal, _tls_cache,
+)
+
+
+def test_append_and_to_bytes():
+    buf = IOBuf()
+    buf.append(b"hello ")
+    buf.append(b"world")
+    assert buf.size == 11
+    assert buf.to_bytes() == b"hello world"
+
+
+def test_append_coalesces_into_tail_block():
+    buf = IOBuf()
+    buf.append(b"a" * 10)
+    buf.append(b"b" * 10)
+    assert buf.backing_block_count == 1
+    assert buf.to_bytes() == b"a" * 10 + b"b" * 10
+
+
+def test_append_spans_blocks():
+    buf = IOBuf()
+    data = bytes(range(256)) * 40  # 10240 > 8192
+    buf.append(data)
+    assert buf.backing_block_count == 2
+    assert buf.to_bytes() == data
+
+
+def test_cut_is_metadata_only():
+    buf = IOBuf()
+    buf.append(b"x" * 100)
+    head = buf.cut(30)
+    assert head.to_bytes() == b"x" * 30
+    assert buf.size == 70
+    # both views share the same underlying block
+    assert head.refs()[0].block is buf.refs()[0].block
+
+
+def test_cut_across_blocks():
+    buf = IOBuf()
+    data = b"ab" * 5000  # 10000 bytes, 2 blocks
+    buf.append(data)
+    head = buf.cut(9000)
+    assert head.to_bytes() == data[:9000]
+    assert buf.to_bytes() == data[9000:]
+    assert buf.cut(10**9).to_bytes() == data[9000:]
+    assert buf.empty()
+
+
+def test_append_buf_zero_copy():
+    a = IOBuf()
+    a.append(b"12345")
+    b = IOBuf()
+    b.append(b"abc")
+    b.append_buf(a)
+    assert b.to_bytes() == b"abc12345"
+    assert b.refs()[-1].block is a.refs()[0].block
+    # writes after a zero-copy share must not corrupt the sharer
+    a.append(b"!!")
+    assert b.to_bytes() == b"abc12345"
+
+
+def test_pop_front_and_peek():
+    buf = IOBuf()
+    buf.append(b"0123456789")
+    assert buf.peek_bytes(4) == b"0123"
+    assert buf.pop_front(3) == 3
+    assert buf.to_bytes() == b"3456789"
+    assert buf.pop_front(100) == 7
+    assert buf.empty()
+
+
+def test_user_data_block_with_deleter():
+    deleted = []
+    payload = bytes(1000)
+    buf = IOBuf()
+    buf.append_user_data(payload, deleter=lambda d: deleted.append(len(d)), meta="lkey")
+    assert buf.size == 1000
+    assert buf.refs()[0].block.user_meta == "lkey"
+    del buf
+    import gc
+    gc.collect()
+    assert deleted == [1000]
+
+
+def test_device_block_zero_copy_cut():
+    arr = np.arange(64, dtype=np.uint8)
+    buf = IOBuf()
+    buf.append(b"hdr:")
+    buf.append_device_array(arr)
+    assert buf.size == 68
+    assert buf.has_device_blocks()
+    head = buf.cut(4)
+    assert head.to_bytes() == b"hdr:"
+    mid = buf.cut(10)
+    # slicing a device block must not copy the backing array
+    assert mid.refs()[0].block.array is arr
+    assert mid.to_bytes() == arr[:10].tobytes()
+    assert buf.to_bytes() == arr[10:].tobytes()
+
+
+def test_device_block_jax_array():
+    import jax.numpy as jnp
+    arr = jnp.arange(32, dtype=jnp.uint8)
+    buf = IOBuf()
+    buf.append_device_array(arr)
+    assert buf.to_bytes() == np.arange(32, dtype=np.uint8).tobytes()
+    assert len(buf.device_arrays()) == 1
+
+
+def test_cut_into_writer_short_writes():
+    buf = IOBuf()
+    buf.append(b"z" * 300)
+    written = []
+
+    def write(mv):
+        take = min(7, len(mv))
+        written.append(bytes(mv[:take]))
+        return take
+
+    # a short write means "would block": cut_into_writer stops so the caller
+    # (the KeepWrite fiber) can re-poll — drain by looping like KeepWrite does
+    total = 0
+    while not buf.empty():
+        n = buf.cut_into_writer(write)
+        assert n > 0
+        total += n
+    assert total == 300
+    assert b"".join(written) == b"z" * 300
+
+
+def test_ioportal_append_from_reader():
+    src = bytearray(b"streamed-data" * 100)
+
+    def recv_into(mv):
+        take = min(len(mv), len(src), 37)
+        mv[:take] = src[:take]
+        del src[:take]
+        return take
+
+    portal = IOPortal()
+    while True:
+        if portal.append_from_reader(recv_into) == 0:
+            break
+    assert portal.to_bytes() == b"streamed-data" * 100
+
+
+def test_block_recycling_returns_buffer_to_tls_cache():
+    import gc
+    _tls_cache.free.clear()
+    buf = IOBuf()
+    buf.append(b"q" * DEFAULT_BLOCK_SIZE)
+    del buf
+    gc.collect()
+    assert len(_tls_cache.free) == 1
+    # a fresh block reuses the cached bytearray
+    reused = _tls_cache.free[0]
+    blk = Block()
+    assert blk.data is reused
