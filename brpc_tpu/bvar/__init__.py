@@ -1,0 +1,19 @@
+"""Metrics: thread-local-combining counters, windows, percentiles.
+
+TPU-native re-design of the reference's ``src/bvar`` (SURVEY.md §2.3).
+Write paths touch only per-thread agents (no contention); reads combine.
+"""
+
+from brpc_tpu.bvar.variable import Variable, expose, dump_exposed, describe_exposed, unexpose_all
+from brpc_tpu.bvar.reducer import Adder, Maxer, Miner, IntRecorder, PassiveStatus, Status
+from brpc_tpu.bvar.percentile import Percentile
+from brpc_tpu.bvar.window import Window, PerSecond, Sampler, global_sampler
+from brpc_tpu.bvar.latency_recorder import LatencyRecorder
+from brpc_tpu.bvar.prometheus import dump_prometheus
+
+__all__ = [
+    "Variable", "expose", "dump_exposed", "describe_exposed", "unexpose_all",
+    "Adder", "Maxer", "Miner", "IntRecorder", "PassiveStatus", "Status",
+    "Percentile", "Window", "PerSecond", "Sampler", "global_sampler",
+    "LatencyRecorder", "dump_prometheus",
+]

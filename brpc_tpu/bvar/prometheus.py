@@ -1,0 +1,34 @@
+"""Prometheus text-format dump of exposed variables
+(builtin/prometheus_metrics_service.cpp equivalent)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from brpc_tpu.bvar.variable import dump_exposed
+
+
+def _sanitize(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() or ch == "_") else "_")
+    s = "".join(out)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return s
+
+
+def dump_prometheus(prefix: str = "") -> str:
+    lines: List[str] = []
+    for name, value in dump_exposed(prefix):
+        mname = _sanitize(name)
+        if isinstance(value, dict):
+            for k, v in value.items():
+                if isinstance(v, (int, float)):
+                    lines.append(f"{mname}_{_sanitize(str(k))} {v}")
+        elif isinstance(value, bool):
+            lines.append(f"{mname} {int(value)}")
+        elif isinstance(value, (int, float)):
+            lines.append(f"{mname} {value}")
+        # non-numeric vars are skipped, like the reference's dumper
+    return "\n".join(lines) + ("\n" if lines else "")

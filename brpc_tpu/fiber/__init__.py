@@ -1,0 +1,28 @@
+"""M:N fiber runtime (bthread equivalent, SURVEY.md §2.2)."""
+
+from brpc_tpu.fiber.scheduler import (
+    Fiber, TaskControl, TaskGroup, SchedAwaitable, current_fiber,
+    current_group, global_control, set_concurrency, spawn, spawn_urgent,
+    yield_now,
+)
+from brpc_tpu.fiber.butex import Butex, WAIT_OK, WAIT_TIMEOUT, WAIT_VALUE_CHANGED
+from brpc_tpu.fiber.sync import (
+    CountdownEvent, FiberCondition, FiberEvent, FiberMutex,
+)
+from brpc_tpu.fiber.timer import (
+    PeriodicTask, TimerThread, global_timer, sleep, sleep_us,
+)
+from brpc_tpu.fiber.execution_queue import ExecutionQueue
+from brpc_tpu.fiber.device_poller import DeviceEventPoller, device_ready, global_poller
+from brpc_tpu.fiber.keys import FiberLocal
+
+__all__ = [
+    "Fiber", "TaskControl", "TaskGroup", "SchedAwaitable", "current_fiber",
+    "current_group", "global_control", "set_concurrency", "spawn",
+    "spawn_urgent", "yield_now",
+    "Butex", "WAIT_OK", "WAIT_TIMEOUT", "WAIT_VALUE_CHANGED",
+    "CountdownEvent", "FiberCondition", "FiberEvent", "FiberMutex",
+    "PeriodicTask", "TimerThread", "global_timer", "sleep", "sleep_us",
+    "ExecutionQueue", "DeviceEventPoller", "device_ready", "global_poller",
+    "FiberLocal",
+]

@@ -1,0 +1,132 @@
+"""DeviceEventPoller: park fibers on device/async futures.
+
+The north-star twist on the fork's RingListener/EloqModule design
+(bthread/ring_listener.h:115, eloq_module.h:60): instead of an io_uring
+CQE pump per worker group, one poller thread drains *device event*
+completions — jax.Array readiness (`.is_ready()` over PjRt's future) and
+concurrent.futures.Future — and reschedules the parked fiber into its
+(possibly bound) group, so RPC handlers can launch XLA computations
+without burning a worker thread on `block_until_ready`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Tuple
+
+from brpc_tpu.fiber.scheduler import Fiber, SchedAwaitable
+
+
+def _is_ready(obj: Any) -> bool:
+    ready_fn = getattr(obj, "is_ready", None)
+    if ready_fn is not None:
+        return bool(ready_fn())
+    done_fn = getattr(obj, "done", None)  # concurrent.futures.Future
+    if done_fn is not None:
+        return bool(done_fn())
+    return True
+
+
+class DeviceEventPoller:
+    """Single pump thread; adaptive spin-then-sleep polling."""
+
+    def __init__(self, name: str = "device_poller"):
+        self._cond = threading.Condition()
+        self._pending: List[Tuple[Any, Callable[[], None]]] = []
+        self._thread: Optional[threading.Thread] = None
+        self._name = name
+        self._stop = False
+
+    def watch(self, obj: Any, on_ready: Callable[[], None]) -> None:
+        """Call on_ready() once obj becomes ready. If a Future supports
+        callbacks, use them directly (no polling)."""
+        add_cb = getattr(obj, "add_done_callback", None)
+        if add_cb is not None:
+            add_cb(lambda _f: on_ready())
+            return
+        if _is_ready(obj):
+            on_ready()
+            return
+        with self._cond:
+            self._pending.append((obj, on_ready))
+            self._ensure_thread()
+            self._cond.notify()
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop = False
+            self._thread = threading.Thread(target=self._run, name=self._name,
+                                            daemon=True)
+            self._thread.start()
+
+    def _run(self):
+        import time
+        idle_spins = 0
+        while not self._stop:
+            with self._cond:
+                if not self._pending:
+                    self._cond.wait(0.5)
+                    continue
+                pending = self._pending
+                self._pending = []
+            still = []
+            fired = 0
+            for obj, cb in pending:
+                if _is_ready(obj):
+                    fired += 1
+                    try:
+                        cb()
+                    except Exception:
+                        import logging
+                        logging.getLogger("brpc_tpu.fiber").exception(
+                            "device poller callback failed")
+                else:
+                    still.append((obj, cb))
+            if still:
+                with self._cond:
+                    self._pending.extend(still)
+            if fired:
+                idle_spins = 0
+            else:
+                # adaptive backoff: spin a few rounds (device events complete
+                # in µs), then sleep a little to spare the host
+                idle_spins += 1
+                if idle_spins > 64:
+                    time.sleep(0.0002)
+
+    def stop(self):
+        self._stop = True
+        with self._cond:
+            self._cond.notify()
+
+
+_global_poller: Optional[DeviceEventPoller] = None
+_lock = threading.Lock()
+
+
+def global_poller() -> DeviceEventPoller:
+    global _global_poller
+    if _global_poller is None:
+        with _lock:
+            if _global_poller is None:
+                _global_poller = DeviceEventPoller()
+    return _global_poller
+
+
+def device_ready(obj: Any) -> SchedAwaitable:
+    """Awaitable: park the fiber until a jax.Array / Future is ready, then
+    resume with the object itself (its result for Futures)."""
+
+    class _Ready(SchedAwaitable):
+        def _register(self, fiber: Fiber):
+            def on_ready():
+                result = obj
+                res_fn = getattr(obj, "result", None)
+                if res_fn is not None and hasattr(obj, "done"):
+                    try:
+                        result = res_fn()
+                    except Exception:
+                        result = obj
+                fiber.control.schedule(fiber, result)
+            global_poller().watch(obj, on_ready)
+    return _Ready()

@@ -1,0 +1,76 @@
+"""ExecutionQueue: MPSC serialized executor (bthread/execution_queue.h).
+
+Producers push lock-free-ish (GIL-atomic deque append + one flag CAS); a
+single drainer fiber consumes batches through the user's executor callback.
+Exactly one drainer runs at a time — the property StreamingRPC's ordered
+write path and LB feedback depend on.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Iterable, Optional
+
+from brpc_tpu.fiber.scheduler import TaskControl, global_control
+
+STOP_TASK = object()
+
+
+class ExecutionQueue:
+    def __init__(self, execute: Callable[[Iterable[Any]], Any],
+                 control: Optional[TaskControl] = None, name: str = "execq"):
+        """``execute(tasks)`` receives an iterable batch, called from a
+        fiber; it may be sync or async."""
+        self._execute = execute
+        self._control = control
+        self._q: deque = deque()
+        self._flag_lock = threading.Lock()
+        self._draining = False
+        self._stopped = False
+        self._name = name
+        self._idle = threading.Event()
+        self._idle.set()
+
+    def execute(self, task: Any) -> bool:
+        """Push a task; returns False if the queue is stopped."""
+        if self._stopped:
+            return False
+        self._q.append(task)
+        self._maybe_start_drainer()
+        return True
+
+    def _maybe_start_drainer(self):
+        with self._flag_lock:
+            if self._draining or not self._q:
+                return
+            self._draining = True
+            self._idle.clear()
+        ctrl = self._control or global_control()
+        ctrl.spawn(self._drain, name=self._name)
+
+    async def _drain(self):
+        import inspect
+        while True:
+            batch = []
+            while True:
+                try:
+                    batch.append(self._q.popleft())
+                except IndexError:
+                    break
+            if batch:
+                r = self._execute(batch)
+                if inspect.iscoroutine(r):
+                    await r
+            with self._flag_lock:
+                if not self._q:
+                    self._draining = False
+                    self._idle.set()
+                    return
+
+    def stop(self):
+        self._stopped = True
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait (from a plain thread) until the queue is fully drained."""
+        return self._idle.wait(timeout)

@@ -1,0 +1,415 @@
+"""M:N fiber scheduler: work-stealing worker threads stepping coroutines.
+
+TPU-native re-design of the reference's bthread runtime (SURVEY.md §2.2):
+
+  TaskControl (task_control.h:42)  -> TaskControl: owns N workers + parking
+  TaskGroup   (task_group.h:70)    -> TaskGroup: per-worker run queues
+  WorkStealingQueue                -> collections.deque (owner pops right /
+                                      thieves pop left; GIL-atomic)
+  ParkingLot  (parking_lot.h:31)   -> condition variable + signal counter
+  fcontext asm switch              -> coroutine send/StopIteration stepping
+  _bound_rq (fork's group-bound    -> Fiber.bound_group pinning, the hook
+   bthreads, task_group.h:230)        TPU device affinity hangs off
+
+A *fiber* wraps a Python coroutine. Workers pop a fiber and ``step`` it:
+one ``coro.send`` advances it until it either finishes (StopIteration) or
+awaits a scheduler token (a ``SchedAwaitable``), which re-registers the
+fiber with whatever will wake it (butex, timer, device poller, io).
+Plain callables are wrapped in a trivial coroutine; they may block their
+worker thread (the reference's usercode_in_pthread escape hatch).
+
+Unlike bthread's start_urgent, a running Python frame can't be preempted,
+so ``spawn_urgent`` pushes to the *head* of the local queue instead
+(runs at the next suspension point).
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import threading
+from collections import deque
+from typing import Any, Callable, Deque, List, Optional
+
+from brpc_tpu.butil.fast_rand import fast_rand_less_than
+from brpc_tpu.bvar.reducer import Adder, PassiveStatus
+
+FIBER_STATE_READY = 0
+FIBER_STATE_RUNNING = 1
+FIBER_STATE_SUSPENDED = 2
+FIBER_STATE_DONE = 3
+
+
+class SchedAwaitable:
+    """Base of everything a fiber may ``await``. ``_register(fiber)`` must
+    arrange a future ``TaskControl.schedule(fiber, value)`` exactly once."""
+
+    def _register(self, fiber: "Fiber") -> None:
+        raise NotImplementedError
+
+    def __await__(self):
+        result = yield self
+        return result
+
+
+class _YieldNow(SchedAwaitable):
+    def _register(self, fiber: "Fiber") -> None:
+        fiber.control.schedule(fiber, None, to_tail=True)
+
+
+def yield_now() -> SchedAwaitable:
+    """Cooperatively reschedule (bthread_yield)."""
+    return _YieldNow()
+
+
+class Fiber:
+    """One unit of M:N execution (bthread's TaskMeta)."""
+
+    __slots__ = (
+        "coro", "control", "state", "result", "exception", "bound_group",
+        "locals", "_done_event", "_joiner_butex", "_resume_value", "name",
+        "_key_destructors",
+    )
+
+    def __init__(self, coro, control: "TaskControl", name: str = ""):
+        self.coro = coro
+        self.control = control
+        self.state = FIBER_STATE_READY
+        self.result: Any = None
+        self.exception: Optional[BaseException] = None
+        self.bound_group: Optional[int] = None
+        self.locals: dict = {}
+        self.name = name
+        self._done_event = threading.Event()
+        self._joiner_butex = None  # lazily created Butex for fiber joiners
+        self._resume_value: Any = None
+        self._key_destructors: List[Callable] = []
+
+    # ---------------------------------------------------------------- join
+    def done(self) -> bool:
+        return self.state == FIBER_STATE_DONE
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Block the calling *thread* until the fiber finishes. Safe from
+        non-fiber threads; inside a fiber prefer ``await fiber.join_async()``."""
+        return self._done_event.wait(timeout)
+
+    def join_async(self) -> SchedAwaitable:
+        """Awaitable join for use inside another fiber."""
+        from brpc_tpu.fiber.butex import Butex
+        if self._joiner_butex is None:
+            with _joiner_init_lock:
+                if self._joiner_butex is None:
+                    self._joiner_butex = Butex(0)
+        butex = self._joiner_butex
+
+        class _Join(SchedAwaitable):
+            def _register(_self, fiber):
+                if self.done():
+                    fiber.control.schedule(fiber, None)
+                else:
+                    butex.add_waiter(fiber, expected=0)
+        return _Join()
+
+    def value(self) -> Any:
+        if self.exception is not None:
+            raise self.exception
+        return self.result
+
+    def _finish(self, result, exc) -> None:
+        self.result = result
+        self.exception = exc
+        for d in self._key_destructors:
+            try:
+                d(self)
+            except Exception:
+                pass
+        self.state = FIBER_STATE_DONE
+        if self._joiner_butex is not None:
+            self._joiner_butex.set_and_wake_all(1)
+        self._done_event.set()
+        self.control.nfibers.add(-1)
+        if exc is not None and not isinstance(exc, SystemExit):
+            self.control.on_fiber_error(self, exc)
+
+
+_joiner_init_lock = threading.Lock()
+
+
+class _WorkerTLS(threading.local):
+    def __init__(self):
+        self.group: Optional["TaskGroup"] = None
+        self.current: Optional[Fiber] = None
+
+
+_tls = _WorkerTLS()
+
+
+def current_fiber() -> Optional[Fiber]:
+    return _tls.current
+
+
+def current_group() -> Optional["TaskGroup"]:
+    return _tls.group
+
+
+class ParkingLot:
+    """Futex-style idle-worker parking (bthread/parking_lot.h:31)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._signals = 0
+
+    def signal_count(self) -> int:
+        return self._signals
+
+    def signal(self, n: int = 1) -> None:
+        with self._cond:
+            self._signals += 1
+            self._cond.notify(n)
+
+    def wait(self, expected: int, timeout: float = 1.0) -> None:
+        with self._cond:
+            if self._signals == expected:
+                self._cond.wait(timeout)
+
+
+class TaskGroup:
+    """Per-worker scheduler state (bthread/task_group.h:70)."""
+
+    def __init__(self, control: "TaskControl", index: int):
+        self.control = control
+        self.index = index
+        self.rq: Deque[Fiber] = deque()         # local queue: owner pops right
+        self.remote_rq: Deque[Fiber] = deque()  # pushed by non-workers
+        self.bound_rq: Deque[Fiber] = deque()   # group-pinned fibers (fork's _bound_rq)
+        self.nsteals = 0
+        self.nswitches = 0
+
+    # owner-side pop order: bound first (pinned work can't run elsewhere),
+    # then local LIFO for cache locality, then remote FIFO
+    def pop_local(self) -> Optional[Fiber]:
+        try:
+            return self.bound_rq.popleft()
+        except IndexError:
+            pass
+        try:
+            return self.rq.pop()
+        except IndexError:
+            pass
+        try:
+            return self.remote_rq.popleft()
+        except IndexError:
+            return None
+
+    def steal_from(self) -> Optional[Fiber]:
+        """Thieves take the oldest local/remote task; bound tasks are never
+        stolen."""
+        try:
+            return self.rq.popleft()
+        except IndexError:
+            pass
+        try:
+            return self.remote_rq.popleft()
+        except IndexError:
+            return None
+
+
+class TaskControl:
+    """Owns the worker pthreads (bthread/task_control.h:42)."""
+
+    def __init__(self, concurrency: Optional[int] = None, name: str = "fiber"):
+        if concurrency is None:
+            concurrency = min(8, os.cpu_count() or 4)
+        self.name = name
+        self.concurrency = concurrency
+        self.groups: List[TaskGroup] = [TaskGroup(self, i) for i in range(concurrency)]
+        self.parking_lot = ParkingLot()
+        self._threads: List[threading.Thread] = []
+        self._stop = False
+        self.nfibers = Adder(0)
+        self.nfibers_created = Adder(0)
+        self._error_handlers: List[Callable] = []
+        self._started = False
+        self._start_lock = threading.Lock()
+
+    # -------------------------------------------------------------- start
+    def start(self) -> None:
+        with self._start_lock:
+            if self._started:
+                return
+            self._started = True
+            for g in self.groups:
+                t = threading.Thread(target=self._worker, args=(g,),
+                                     name=f"{self.name}_w{g.index}", daemon=True)
+                self._threads.append(t)
+                t.start()
+
+    def stop_and_join(self, timeout: float = 5.0) -> None:
+        self._stop = True
+        for _ in self._threads:
+            self.parking_lot.signal(len(self._threads))
+        for t in self._threads:
+            t.join(timeout)
+        self._threads.clear()
+        self._started = False
+        self._stop = False
+
+    # -------------------------------------------------------------- spawn
+    def spawn(self, fn: Callable | Any, *args, name: str = "", urgent: bool = False,
+              bound_group: Optional[int] = None, **kwargs) -> Fiber:
+        """Start a fiber from a coroutine function, coroutine object, or
+        plain callable (bthread_start_background / start_urgent)."""
+        if inspect.iscoroutine(fn):
+            coro = fn
+        elif inspect.iscoroutinefunction(fn):
+            coro = fn(*args, **kwargs)
+        else:
+            async def _runner():
+                return fn(*args, **kwargs)
+            coro = _runner()
+        fiber = Fiber(coro, self, name=name)
+        if bound_group is not None:
+            fiber.bound_group = bound_group % self.concurrency
+        self.nfibers.add(1)
+        self.nfibers_created.add(1)
+        if not self._started:
+            self.start()
+        # note: the local queue is LIFO for the owner (Chase-Lev bottom), so a
+        # plain push already runs next — bthread_start_urgent's "run NOW with
+        # caller requeued" can't preempt a Python frame, and `urgent` adds
+        # nothing beyond the LIFO push; it is accepted for API parity only
+        self.schedule(fiber, None)
+        return fiber
+
+    def schedule(self, fiber: Fiber, resume_value: Any, to_tail: bool = False) -> None:
+        """Make a ready fiber runnable (ready_to_run / ready_to_run_remote)."""
+        fiber._resume_value = resume_value
+        fiber.state = FIBER_STATE_READY
+        if fiber.bound_group is not None:
+            self.groups[fiber.bound_group].bound_rq.append(fiber)
+            self.parking_lot.signal(1)
+            return
+        g = _tls.group
+        if g is not None and g.control is self:
+            if to_tail:
+                g.rq.appendleft(fiber)    # back of the owner's LIFO
+            else:
+                g.rq.append(fiber)        # Chase-Lev bottom: owner runs it next
+        else:
+            # remote push: spread by random target group
+            target = self.groups[fast_rand_less_than(self.concurrency)]
+            target.remote_rq.append(fiber)
+        self.parking_lot.signal(1)
+
+    # ------------------------------------------------------------- worker
+    def _worker(self, group: TaskGroup) -> None:
+        _tls.group = group
+        while not self._stop:
+            fiber = group.pop_local()
+            if fiber is None:
+                fiber = self._steal(group)
+            if fiber is not None:
+                self._step(group, fiber)
+                continue
+            expected = self.parking_lot.signal_count()
+            # re-check after reading the signal count (no lost wakeups)
+            fiber = group.pop_local() or self._steal(group)
+            if fiber is not None:
+                self._step(group, fiber)
+                continue
+            self.parking_lot.wait(expected, timeout=0.5)
+        _tls.group = None
+
+    def _steal(self, group: TaskGroup) -> Optional[Fiber]:
+        n = self.concurrency
+        offset = fast_rand_less_than(n)
+        for i in range(n):
+            g = self.groups[(offset + i) % n]
+            if g is group:
+                continue
+            f = g.steal_from()
+            if f is not None:
+                group.nsteals += 1
+                return f
+        return None
+
+    def _step(self, group: TaskGroup, fiber: Fiber) -> None:
+        """Advance the fiber one leg: run until it finishes or awaits."""
+        prev = _tls.current
+        _tls.current = fiber
+        fiber.state = FIBER_STATE_RUNNING
+        group.nswitches += 1
+        try:
+            token = fiber.coro.send(fiber._resume_value)
+        except StopIteration as e:
+            _tls.current = prev
+            fiber._finish(e.value, None)
+            return
+        except BaseException as e:
+            _tls.current = prev
+            fiber._finish(None, e)
+            return
+        _tls.current = prev
+        fiber.state = FIBER_STATE_SUSPENDED
+        fiber._resume_value = None
+        if token is None:
+            # bare `yield` inside legacy generators: treat as yield_now
+            self.schedule(fiber, None, to_tail=True)
+        else:
+            token._register(fiber)
+
+    # -------------------------------------------------------------- misc
+    def on_fiber_error(self, fiber: Fiber, exc: BaseException) -> None:
+        for h in self._error_handlers:
+            try:
+                h(fiber, exc)
+            except Exception:
+                pass
+        if not self._error_handlers:
+            import logging
+            logging.getLogger("brpc_tpu.fiber").exception(
+                "fiber %r crashed", fiber.name, exc_info=exc)
+
+    def add_error_handler(self, h: Callable) -> None:
+        self._error_handlers.append(h)
+
+    def expose_vars(self, prefix: str = "fiber") -> None:
+        self.nfibers.expose(f"{prefix}_count")
+        self.nfibers_created.expose(f"{prefix}_created")
+        PassiveStatus(lambda: self.concurrency).expose(f"{prefix}_worker_count")
+        PassiveStatus(lambda: sum(g.nswitches for g in self.groups)).expose(
+            f"{prefix}_switch_count")
+        PassiveStatus(lambda: sum(g.nsteals for g in self.groups)).expose(
+            f"{prefix}_steal_count")
+
+
+# ----------------------------------------------------------------- globals
+_global_control: Optional[TaskControl] = None
+_global_lock = threading.Lock()
+
+
+def global_control() -> TaskControl:
+    global _global_control
+    if _global_control is None:
+        with _global_lock:
+            if _global_control is None:
+                _global_control = TaskControl()
+    return _global_control
+
+
+def set_concurrency(n: int) -> None:
+    """bthread_setconcurrency: must run before the first spawn."""
+    global _global_control
+    with _global_lock:
+        if _global_control is not None and _global_control._started:
+            raise RuntimeError("fiber workers already started")
+        _global_control = TaskControl(concurrency=n)
+
+
+def spawn(fn, *args, **kwargs) -> Fiber:
+    return global_control().spawn(fn, *args, **kwargs)
+
+
+def spawn_urgent(fn, *args, **kwargs) -> Fiber:
+    return global_control().spawn(fn, *args, urgent=True, **kwargs)

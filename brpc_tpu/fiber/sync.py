@@ -1,0 +1,146 @@
+"""Blocking primitives built on Butex, mirroring bthread's mutex /
+condition_variable / countdown_event (all butex-based in the reference).
+
+Every primitive is dual-mode: awaitable from fibers, blocking from plain
+threads — the same duality bthread keeps (butex serves both waiter kinds).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from brpc_tpu.fiber.butex import WAIT_OK, WAIT_TIMEOUT, Butex
+from brpc_tpu.fiber.scheduler import current_fiber
+
+
+class FiberMutex:
+    """bthread_mutex: butex-based; never blocks the worker thread when
+    contended from a fiber (the fiber suspends instead)."""
+
+    def __init__(self):
+        self._butex = Butex(0)  # 0 = unlocked, 1 = locked
+
+    async def lock(self):
+        while not self._butex.compare_exchange(0, 1):
+            await self._butex.wait(expected=1)
+
+    def unlock(self):
+        self._butex.set_value(0)
+        self._butex.wake(1)
+
+    def lock_pthread(self, timeout_s: Optional[float] = None) -> bool:
+        import time
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        while not self._butex.compare_exchange(0, 1):
+            remain = None if deadline is None else deadline - time.monotonic()
+            if remain is not None and remain <= 0:
+                return False
+            self._butex.wait_pthread(expected=1, timeout_s=remain)
+        return True
+
+    async def __aenter__(self):
+        await self.lock()
+        return self
+
+    async def __aexit__(self, *exc):
+        self.unlock()
+        return False
+
+
+class FiberEvent:
+    """One-shot event (set stays set)."""
+
+    def __init__(self):
+        self._butex = Butex(0)
+
+    def is_set(self) -> bool:
+        return self._butex.value == 1
+
+    def set(self):
+        self._butex.set_and_wake_all(1)
+
+    async def wait(self, timeout_s: Optional[float] = None) -> bool:
+        if self._butex.value == 1:
+            return True
+        res = await self._butex.wait(expected=0, timeout_s=timeout_s)
+        return res != WAIT_TIMEOUT or self._butex.value == 1
+
+    def wait_pthread(self, timeout_s: Optional[float] = None) -> bool:
+        if self._butex.value == 1:
+            return True
+        res = self._butex.wait_pthread(expected=0, timeout_s=timeout_s)
+        return res != WAIT_TIMEOUT or self._butex.value == 1
+
+
+class CountdownEvent:
+    """bthread::CountdownEvent — the fan-out joiner ParallelChannel uses."""
+
+    def __init__(self, count: int = 1):
+        self._butex = Butex(count)
+
+    def signal(self, n: int = 1):
+        with self._butex._lock:
+            self._butex._value = max(0, self._butex._value - n)
+            done = self._butex._value == 0
+        if done:
+            # wake only at zero: waiters parked on a nonzero count stay
+            # parked (their add_waiter re-checked the value at registration,
+            # so no intermediate decrement can be missed)
+            self._butex.wake_all()
+
+    def add_count(self, n: int = 1):
+        self._butex.fetch_add(n)
+
+    @property
+    def count(self) -> int:
+        return self._butex.value
+
+    async def wait(self, timeout_s: Optional[float] = None) -> bool:
+        import time
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        while True:
+            v = self._butex.value
+            if v == 0:
+                return True
+            remain = None if deadline is None else deadline - time.monotonic()
+            if remain is not None and remain <= 0:
+                return False
+            res = await self._butex.wait(expected=v, timeout_s=remain)
+            if res == WAIT_TIMEOUT:
+                return self._butex.value == 0
+
+    def wait_pthread(self, timeout_s: Optional[float] = None) -> bool:
+        import time
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        while True:
+            v = self._butex.value
+            if v == 0:
+                return True
+            remain = None if deadline is None else deadline - time.monotonic()
+            if remain is not None and remain <= 0:
+                return False
+            self._butex.wait_pthread(expected=v, timeout_s=remain)
+
+
+class FiberCondition:
+    """Condition variable over FiberMutex (bthread_cond)."""
+
+    def __init__(self, mutex: FiberMutex):
+        self._mutex = mutex
+        self._butex = Butex(0)
+
+    async def wait(self, timeout_s: Optional[float] = None) -> bool:
+        seq = self._butex.value
+        self._mutex.unlock()
+        res = await self._butex.wait(expected=seq, timeout_s=timeout_s)
+        await self._mutex.lock()
+        return res != WAIT_TIMEOUT
+
+    def notify(self, n: int = 1):
+        self._butex.fetch_add(1)
+        self._butex.wake(n)
+
+    def notify_all(self):
+        self._butex.fetch_add(1)
+        self._butex.wake_all()

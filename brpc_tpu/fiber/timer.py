@@ -1,0 +1,127 @@
+"""TimerThread: one dedicated thread, nearest-deadline sleep
+(bthread/timer_thread.h:53). Backs fiber sleeps, RPC timeouts, butex wait
+timeouts, and periodic tasks."""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from brpc_tpu.fiber.scheduler import Fiber, SchedAwaitable
+
+
+class TimerThread:
+    def __init__(self, name: str = "fiber_timer"):
+        self._cond = threading.Condition()
+        self._heap: list = []
+        self._cancelled: Dict[int, bool] = {}
+        self._seq = itertools.count()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._name = name
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop = False
+            self._thread = threading.Thread(target=self._run, name=self._name,
+                                            daemon=True)
+            self._thread.start()
+
+    def schedule_at(self, deadline: float, fn: Callable[[], None]) -> int:
+        """deadline is time.monotonic() seconds; returns a timer id."""
+        with self._cond:
+            tid = next(self._seq)
+            heapq.heappush(self._heap, (deadline, tid, fn))
+            self._ensure_thread()
+            self._cond.notify()
+        return tid
+
+    def schedule_after(self, delay_s: float, fn: Callable[[], None]) -> int:
+        return self.schedule_at(time.monotonic() + max(0.0, delay_s), fn)
+
+    def unschedule(self, tid: int) -> None:
+        with self._cond:
+            self._cancelled[tid] = True
+
+    def _run(self) -> None:
+        while not self._stop:
+            with self._cond:
+                now = time.monotonic()
+                while self._heap and self._heap[0][0] <= now:
+                    deadline, tid, fn = heapq.heappop(self._heap)
+                    if self._cancelled.pop(tid, False):
+                        fn = None
+                    if fn is not None:
+                        self._cond.release()
+                        try:
+                            fn()
+                        except Exception:
+                            import logging
+                            logging.getLogger("brpc_tpu.fiber").exception(
+                                "timer callback failed")
+                        finally:
+                            self._cond.acquire()
+                        now = time.monotonic()
+                wait = (self._heap[0][0] - now) if self._heap else 1.0
+                self._cond.wait(min(max(wait, 0.0), 1.0))
+
+    def stop(self) -> None:
+        self._stop = True
+        with self._cond:
+            self._cond.notify()
+
+
+_global_timer: Optional[TimerThread] = None
+_lock = threading.Lock()
+
+
+def global_timer() -> TimerThread:
+    global _global_timer
+    if _global_timer is None:
+        with _lock:
+            if _global_timer is None:
+                _global_timer = TimerThread()
+    return _global_timer
+
+
+def sleep(seconds: float) -> SchedAwaitable:
+    """Awaitable fiber sleep (bthread_usleep)."""
+
+    class _Sleep(SchedAwaitable):
+        def _register(self, fiber: Fiber):
+            global_timer().schedule_after(
+                seconds, lambda: fiber.control.schedule(fiber, None))
+    return _Sleep()
+
+
+def sleep_us(us: float) -> SchedAwaitable:
+    return sleep(us / 1e6)
+
+
+class PeriodicTask:
+    """Re-arms itself after each run (brpc/periodic_task.*)."""
+
+    def __init__(self, interval_s: float, fn: Callable[[], bool | None],
+                 timer: Optional[TimerThread] = None):
+        self._interval = interval_s
+        self._fn = fn
+        self._timer = timer or global_timer()
+        self._stopped = False
+        self._arm()
+
+    def _arm(self):
+        self._tid = self._timer.schedule_after(self._interval, self._tick)
+
+    def _tick(self):
+        if self._stopped:
+            return
+        keep = self._fn()
+        if keep is not False and not self._stopped:
+            self._arm()
+
+    def stop(self):
+        self._stopped = True
+        self._timer.unschedule(self._tid)

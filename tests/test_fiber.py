@@ -1,0 +1,300 @@
+"""Fiber runtime tests, modeled on the reference's bthread unittests
+(test/bthread_unittest.cpp, bthread_butex_unittest.cpp,
+bthread_ping_pong_unittest.cpp — SURVEY.md §4)."""
+
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import fiber
+from brpc_tpu.fiber import (
+    Butex, CountdownEvent, ExecutionQueue, FiberEvent, FiberMutex,
+    TaskControl, WAIT_TIMEOUT, device_ready, sleep, yield_now,
+)
+
+
+@pytest.fixture()
+def ctrl():
+    c = TaskControl(concurrency=4, name="test")
+    yield c
+    c.stop_and_join()
+
+
+class TestSpawnJoin:
+    def test_plain_callable(self, ctrl):
+        f = ctrl.spawn(lambda: 42)
+        assert f.join(2)
+        assert f.value() == 42
+
+    def test_coroutine_fn(self, ctrl):
+        async def work(x):
+            await yield_now()
+            return x * 2
+
+        f = ctrl.spawn(work, 21)
+        assert f.join(2)
+        assert f.value() == 42
+
+    def test_exception_propagates(self, ctrl):
+        ctrl.add_error_handler(lambda f, e: None)
+
+        def boom():
+            raise ValueError("boom")
+
+        f = ctrl.spawn(boom)
+        assert f.join(2)
+        with pytest.raises(ValueError):
+            f.value()
+
+    def test_join_async_from_fiber(self, ctrl):
+        async def child():
+            await sleep(0.01)
+            return "child-done"
+
+        async def parent():
+            c = ctrl.spawn(child)
+            await c.join_async()
+            return c.value()
+
+        f = ctrl.spawn(parent)
+        assert f.join(3)
+        assert f.value() == "child-done"
+
+    def test_many_fibers(self, ctrl):
+        total = CountdownEvent(1000)
+        for i in range(1000):
+            ctrl.spawn(lambda: total.signal())
+        assert total.wait_pthread(5)
+
+    def test_bound_group_pinning(self, ctrl):
+        ran_on = []
+
+        def probe():
+            ran_on.append(fiber.current_group().index)
+
+        fs = [ctrl.spawn(probe, bound_group=2) for _ in range(20)]
+        [f.join(2) for f in fs]
+        assert set(ran_on) == {2}
+
+
+class TestButex:
+    def test_wait_wake(self, ctrl):
+        b = Butex(0)
+        results = []
+
+        async def waiter():
+            results.append(await b.wait(expected=0))
+
+        f = ctrl.spawn(waiter)
+        time.sleep(0.05)
+        assert b.wake(1) == 1
+        assert f.join(2)
+        assert results == ["ok"]
+
+    def test_value_changed_short_circuits(self, ctrl):
+        b = Butex(5)
+
+        async def waiter():
+            return await b.wait(expected=0)
+
+        f = ctrl.spawn(waiter)
+        assert f.join(2)
+        assert f.value() == "value_changed"
+
+    def test_timeout(self, ctrl):
+        b = Butex(0)
+
+        async def waiter():
+            return await b.wait(expected=0, timeout_s=0.05)
+
+        f = ctrl.spawn(waiter)
+        assert f.join(2)
+        assert f.value() == WAIT_TIMEOUT
+
+    def test_pthread_waiter(self, ctrl):
+        b = Butex(0)
+        woke = []
+
+        def thread_waiter():
+            woke.append(b.wait_pthread(expected=0, timeout_s=5))
+
+        t = threading.Thread(target=thread_waiter)
+        t.start()
+        time.sleep(0.05)
+        b.wake_all()
+        t.join(2)
+        assert woke == ["ok"]
+
+    def test_ping_pong(self, ctrl):
+        """Two fibers alternate on two butexes (bthread_ping_pong style)."""
+        a, b = Butex(0), Butex(0)
+        log = []
+
+        async def ping():
+            for i in range(50):
+                log.append(("ping", i))
+                b.fetch_add(1)
+                b.wake(1)
+                while a.value < i + 1:  # wait on absolute sequence: no lost wakeup
+                    await a.wait(expected=a.value, timeout_s=1)
+
+        async def pong():
+            for i in range(50):
+                while b.value < i + 1:
+                    await b.wait(expected=b.value, timeout_s=1)
+                log.append(("pong", i))
+                a.fetch_add(1)
+                a.wake(1)
+
+        f1 = ctrl.spawn(ping)
+        f2 = ctrl.spawn(pong)
+        assert f1.join(10) and f2.join(10)
+        assert len(log) == 100
+
+
+class TestSync:
+    def test_mutex_mutual_exclusion(self, ctrl):
+        m = FiberMutex()
+        counter = {"v": 0}
+
+        async def worker():
+            for _ in range(200):
+                async with m:
+                    v = counter["v"]
+                    await yield_now()  # force interleaving inside the CS
+                    counter["v"] = v + 1
+
+        fs = [ctrl.spawn(worker) for _ in range(4)]
+        assert all(f.join(30) for f in fs)
+        for f in fs:
+            f.value()
+        assert counter["v"] == 800
+
+    def test_countdown_event(self, ctrl):
+        ev = CountdownEvent(3)
+
+        async def waiter():
+            return await ev.wait(timeout_s=5)
+
+        f = ctrl.spawn(waiter)
+        for _ in range(3):
+            ev.signal()
+        assert f.join(2)
+        assert f.value() is True
+
+    def test_fiber_event(self, ctrl):
+        ev = FiberEvent()
+
+        async def waiter():
+            return await ev.wait(timeout_s=5)
+
+        fs = [ctrl.spawn(waiter) for _ in range(5)]
+        ev.set()
+        assert all(f.join(2) for f in fs)
+        assert all(f.value() for f in fs)
+
+
+class TestTimer:
+    def test_sleep(self, ctrl):
+        async def napper():
+            t0 = time.monotonic()
+            await sleep(0.05)
+            return time.monotonic() - t0
+
+        f = ctrl.spawn(napper)
+        assert f.join(2)
+        assert f.value() >= 0.045
+
+    def test_periodic_task(self):
+        from brpc_tpu.fiber.timer import PeriodicTask, TimerThread
+        timer = TimerThread("t")
+        hits = []
+        p = PeriodicTask(0.02, lambda: hits.append(1), timer=timer)
+        time.sleep(0.2)
+        p.stop()
+        n = len(hits)
+        assert n >= 3
+        time.sleep(0.06)
+        assert len(hits) <= n + 1  # stopped tasks stop re-arming
+        timer.stop()
+
+
+class TestExecutionQueue:
+    def test_serialized_batches(self, ctrl):
+        seen = []
+        running = {"n": 0, "max": 0}
+
+        def execute(tasks):
+            running["n"] += 1
+            running["max"] = max(running["max"], running["n"])
+            seen.extend(tasks)
+            running["n"] -= 1
+
+        q = ExecutionQueue(execute, control=ctrl)
+        for i in range(500):
+            assert q.execute(i)
+        assert q.join(5)
+        assert sorted(seen) == list(range(500))
+        assert running["max"] == 1  # exactly one drainer at a time
+
+    def test_multi_producer_ordering_per_producer(self, ctrl):
+        seen = []
+        q = ExecutionQueue(lambda ts: seen.extend(ts), control=ctrl)
+
+        def producer(tag):
+            for i in range(200):
+                q.execute((tag, i))
+
+        ts = [threading.Thread(target=producer, args=(t,)) for t in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert q.join(5)
+        assert len(seen) == 800
+        for tag in range(4):
+            mine = [i for (t, i) in seen if t == tag]
+            assert mine == sorted(mine)  # FIFO per producer
+
+
+class TestDevicePoller:
+    def test_park_on_future(self, ctrl):
+        import concurrent.futures
+        fut = concurrent.futures.Future()
+
+        async def waiter():
+            return await device_ready(fut)
+
+        f = ctrl.spawn(waiter)
+        time.sleep(0.02)
+        fut.set_result("payload")
+        assert f.join(2)
+        assert f.value() == "payload"
+
+    def test_park_on_jax_array(self, ctrl):
+        import jax
+        import jax.numpy as jnp
+
+        async def waiter():
+            x = jax.jit(lambda a: a * 2)(jnp.ones((64, 64)))
+            await device_ready(x)
+            return float(x[0, 0])
+
+        f = ctrl.spawn(waiter)
+        assert f.join(30)
+        assert f.value() == 2.0
+
+
+class TestWorkStealing:
+    def test_fibers_spread_across_workers(self, ctrl):
+        seen = set()
+        ev = CountdownEvent(200)
+
+        def probe():
+            seen.add(fiber.current_group().index)
+            time.sleep(0.001)  # keep this worker busy so others steal
+            ev.signal()
+
+        for _ in range(200):
+            ctrl.spawn(probe)
+        assert ev.wait_pthread(10)
+        assert len(seen) >= 2
