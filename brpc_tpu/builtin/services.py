@@ -1,0 +1,54 @@
+"""Builtin observability services, registered on every server
+(brpc/builtin/*, server.cpp:468-540). Served over tpu_std for now; the
+HTTP front-end arrives with the http protocol (SURVEY.md §7 stage 6)."""
+
+from __future__ import annotations
+
+import json
+
+from brpc_tpu.bvar.prometheus import dump_prometheus
+from brpc_tpu.bvar.variable import dump_exposed
+from brpc_tpu.rpc.service import Service
+
+
+def add_builtin_services(server) -> None:
+    builtin = Service("builtin")
+
+    @builtin.method()
+    def health(cntl, request):
+        return b"OK"
+
+    @builtin.method()
+    def status(cntl, request):
+        methods = {k: lr.get_value() for k, lr in server.method_status.items()}
+        return json.dumps({
+            "running": server.is_running,
+            "endpoint": str(server.endpoint) if server.endpoint else None,
+            "services": {n: sorted(s.methods) for n, s in server.services().items()},
+            "concurrency": server.concurrency,
+            "processed": server.nprocessed,
+            "errors": server.nerror,
+            "method_status": methods,
+        }, default=str).encode()
+
+    @builtin.method()
+    def vars(cntl, request):
+        prefix = bytes(request).decode() if request else ""
+        return json.dumps(dict(dump_exposed(prefix)), default=str).encode()
+
+    @builtin.method()
+    def prometheus_metrics(cntl, request):
+        return dump_prometheus().encode()
+
+    @builtin.method()
+    def connections(cntl, request):
+        conns = server.connections()
+        return json.dumps([{
+            "remote": str(s.remote_endpoint) if s.remote_endpoint else None,
+            "failed": s.failed,
+        } for s in conns]).encode()
+
+    try:
+        server.add_service(builtin)
+    except ValueError:
+        pass

@@ -220,7 +220,10 @@ class TaskControl:
 
     def __init__(self, concurrency: Optional[int] = None, name: str = "fiber"):
         if concurrency is None:
-            concurrency = min(8, os.cpu_count() or 4)
+            # like bthread's default (8+1 workers even on small hosts):
+            # fibers may run blocking user code, so a floor of spare workers
+            # matters more than matching core count under the GIL
+            concurrency = max(8, os.cpu_count() or 0)
         self.name = name
         self.concurrency = concurrency
         self.groups: List[TaskGroup] = [TaskGroup(self, i) for i in range(concurrency)]
@@ -266,7 +269,10 @@ class TaskControl:
             coro = fn(*args, **kwargs)
         else:
             async def _runner():
-                return fn(*args, **kwargs)
+                r = fn(*args, **kwargs)
+                if inspect.isawaitable(r):
+                    r = await r
+                return r
             coro = _runner()
         fiber = Fiber(coro, self, name=name)
         if bound_group is not None:

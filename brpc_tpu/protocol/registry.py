@@ -1,0 +1,66 @@
+"""Protocol registry: one object per wire format, registered globally
+(the fn-pointer table of brpc/protocol.h:77-166 and the global table in
+global.cpp:401-581).
+
+A Protocol provides:
+  parse(portal, socket) -> (status, msg)
+      Cut one complete message off the portal. MUST be peek-only unless
+      returning PARSE_OK (the InputMessenger retries other protocols on
+      PARSE_TRY_OTHERS). Returns:
+        PARSE_OK              — msg cut and returned
+        PARSE_NOT_ENOUGH_DATA — bytes are mine but incomplete; wait
+        PARSE_TRY_OTHERS      — not my framing
+  process(msg, socket)    — handle one inbound message (runs in a fiber;
+                            may be async). Client and server sides both
+                            land here, like process_request/response.
+  serialize_request / pack_request — client-side encoding hooks used by
+      Channel/Controller (protocol.h serialize_request/pack_request).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Tuple
+
+PARSE_OK = "ok"
+PARSE_NOT_ENOUGH_DATA = "not_enough_data"
+PARSE_TRY_OTHERS = "try_others"
+
+
+class Protocol:
+    name: str = "?"
+
+    def parse(self, portal, socket) -> Tuple[str, object]:
+        raise NotImplementedError
+
+    def process(self, msg, socket):
+        raise NotImplementedError
+
+
+_protocols: List[Protocol] = []
+_lock = threading.Lock()
+
+
+def register_protocol(p: Protocol) -> None:
+    with _lock:
+        if any(x.name == p.name for x in _protocols):
+            return
+        _protocols.append(p)
+
+
+def get_protocols() -> List[Protocol]:
+    if not _protocols:
+        _register_builtins()
+    return list(_protocols)
+
+
+def find_protocol(name: str) -> Optional[Protocol]:
+    for p in get_protocols():
+        if p.name == name:
+            return p
+    return None
+
+
+def _register_builtins() -> None:
+    from brpc_tpu.protocol import tpu_std  # registers itself on import
+    tpu_std.ensure_registered()

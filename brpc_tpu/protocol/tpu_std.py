@@ -1,0 +1,183 @@
+"""tpu_std: the native protocol — fixed header + proto meta + payload +
+attachment, a re-design of baidu_std framing
+(policy/baidu_rpc_protocol.cpp: ParseRpcMessage:95, PackRpcRequest:646,
+ProcessRpcRequest:314, ProcessRpcResponse:565).
+
+Wire layout:
+    "TRPC" | body_size:u32be | meta_size:u32be | meta | payload | attachment
+body_size = meta_size + len(payload) + len(attachment).
+
+Device payloads do NOT serialize into the byte stream on device-capable
+transports: meta.device_payloads describes them and the arrays ride the
+socket's device lane (write_device_payload / take_device_payload) — the
+tpu analogue of RDMA SGEs pointing into registered blocks. On plain byte
+transports they are inlined into the attachment (inline_bytes=true).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
+from brpc_tpu.protocol.registry import (
+    PARSE_NOT_ENOUGH_DATA, PARSE_OK, PARSE_TRY_OTHERS, Protocol,
+    register_protocol,
+)
+
+MAGIC = b"TRPC"
+HEADER_SIZE = 12
+_HDR = struct.Struct(">4sII")
+
+
+class RpcMessage:
+    """One parsed tpu_std message."""
+
+    __slots__ = ("meta", "payload", "attachment", "device_arrays")
+
+    def __init__(self, meta: pb.RpcMeta, payload: IOBuf, attachment: IOBuf,
+                 device_arrays: Optional[List] = None):
+        self.meta = meta
+        self.payload = payload
+        self.attachment = attachment
+        self.device_arrays = device_arrays or []
+
+
+def serialize_payload(obj) -> bytes:
+    """Shared request/response serialization ladder: bytes-likes pass
+    through, IOBufs flatten, protobuf messages serialize."""
+    if obj is None:
+        return b""
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return bytes(obj)
+    if isinstance(obj, IOBuf):
+        return obj.to_bytes()
+    ser = getattr(obj, "SerializeToString", None)
+    if ser is not None:
+        return ser()
+    raise TypeError(f"cannot serialize payload of type {type(obj)!r}")
+
+
+def pack_message(meta: pb.RpcMeta, payload: bytes | IOBuf,
+                 attachment: Optional[IOBuf] = None,
+                 device_arrays: Optional[List] = None,
+                 device_lane: bool = False) -> Tuple[IOBuf, Optional[List]]:
+    """Encode a frame. Returns (wire_iobuf, device_arrays_for_lane|None).
+
+    device_arrays: jax/numpy arrays. With device_lane they stay out of the
+    byte stream; otherwise their bytes are appended to the attachment.
+    """
+    user_attachment = attachment if attachment is not None else IOBuf()
+    lane = None
+    attachment = IOBuf()
+    if device_arrays:
+        del meta.device_payloads[:]
+        for arr in device_arrays:
+            dp = meta.device_payloads.add()
+            dp.dtype = str(arr.dtype)
+            dp.shape.extend(int(s) for s in arr.shape)
+            dp.inline_bytes = not device_lane
+            nbytes = int(np.prod(arr.shape or (1,))) * arr.dtype.itemsize
+            dp.nbytes = nbytes
+            if not device_lane:
+                host = np.asarray(arr)
+                attachment.append(host.tobytes())
+        if device_lane:
+            lane = list(device_arrays)
+    # layout: inline device bytes FIRST, then the user attachment — the
+    # receiver front-cuts dp.nbytes per inline payload and what remains is
+    # the user attachment (unpack_inline_device_arrays)
+    attachment.append_buf(user_attachment)
+    meta.attachment_size = len(attachment)
+    meta_bytes = meta.SerializeToString()
+    if isinstance(payload, IOBuf):
+        payload_buf = payload
+    else:
+        payload_buf = IOBuf()
+        payload_buf.append(payload)
+    body_size = len(meta_bytes) + payload_buf.size + attachment.size
+    out = IOBuf()
+    out.append(_HDR.pack(MAGIC, body_size, len(meta_bytes)))
+    out.append(meta_bytes)
+    out.append_buf(payload_buf)
+    out.append_buf(attachment)
+    return out, lane
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def unpack_inline_device_arrays(msg: RpcMessage) -> List:
+    """Materialize inline device payloads from the attachment bytes."""
+    out = []
+    buf = msg.attachment
+    for dp in msg.meta.device_payloads:
+        if dp.inline_bytes:
+            raw = buf.cut(dp.nbytes).to_bytes()
+            arr = np.frombuffer(raw, dtype=_np_dtype(dp.dtype)).reshape(tuple(dp.shape))
+            out.append(arr)
+        else:
+            out.append(None)  # filled from the device lane by the caller
+    return out
+
+
+class TpuStdProtocol(Protocol):
+    name = "tpu_std"
+
+    # ---------------------------------------------------------------- parse
+    def parse(self, portal, socket) -> Tuple[str, object]:
+        if portal.size < HEADER_SIZE:
+            head = portal.peek_bytes(min(4, portal.size))
+            if MAGIC[:len(head)] != head:
+                return PARSE_TRY_OTHERS, None
+            return PARSE_NOT_ENOUGH_DATA, None
+        magic, body_size, meta_size = _HDR.unpack(portal.peek_bytes(HEADER_SIZE))
+        if magic != MAGIC:
+            return PARSE_TRY_OTHERS, None
+        if meta_size > body_size:
+            return PARSE_TRY_OTHERS, None
+        if portal.size < HEADER_SIZE + body_size:
+            return PARSE_NOT_ENOUGH_DATA, None
+        portal.pop_front(HEADER_SIZE)
+        meta = pb.RpcMeta()
+        meta.ParseFromString(portal.cut(meta_size).to_bytes())
+        att_size = meta.attachment_size
+        payload = portal.cut(body_size - meta_size - att_size)
+        attachment = portal.cut(att_size)
+        device_arrays: List = []
+        if meta.device_payloads and any(not dp.inline_bytes
+                                        for dp in meta.device_payloads):
+            lane = socket.take_device_payload()
+            if lane is not None:
+                device_arrays = list(lane)
+        msg = RpcMessage(meta, payload, attachment, device_arrays)
+        return PARSE_OK, msg
+
+    # -------------------------------------------------------------- process
+    def process(self, msg: RpcMessage, socket):
+        # dispatch to server or client side, like ProcessRpcRequest /
+        # ProcessRpcResponse; imported lazily to keep layering acyclic
+        if msg.meta.HasField("request"):
+            from brpc_tpu.rpc.server_dispatch import process_request
+            return process_request(self, msg, socket)
+        else:
+            from brpc_tpu.rpc.client_dispatch import process_response
+            return process_response(self, msg, socket)
+
+
+_instance: Optional[TpuStdProtocol] = None
+
+
+def ensure_registered() -> TpuStdProtocol:
+    global _instance
+    if _instance is None:
+        _instance = TpuStdProtocol()
+        register_protocol(_instance)
+    return _instance

@@ -1,0 +1,12 @@
+"""RPC core: Channel/Controller/Server + cluster features (SURVEY.md §2.6)."""
+
+from brpc_tpu.rpc import errno_codes
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.channel import Channel, ChannelOptions
+from brpc_tpu.rpc.server import Server, ServerOptions
+from brpc_tpu.rpc.service import Method, Service, service_from_object
+
+__all__ = [
+    "errno_codes", "Controller", "Channel", "ChannelOptions",
+    "Server", "ServerOptions", "Method", "Service", "service_from_object",
+]

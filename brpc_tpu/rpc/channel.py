@@ -1,0 +1,210 @@
+"""Channel: the client stub (brpc/channel.{h,cpp}).
+
+Owns protocol choice, timeout/retry/backup-request defaults, and the
+connection to a single server (naming-service + load-balanced cluster
+channels compose on top — see rpc/cluster_channel.py). The call path
+mirrors Channel::CallMethod -> Controller::IssueRPC -> Socket::Write
+(SURVEY.md §3.1): serialize, register correlation id, pack, enqueue,
+arm deadline/backup timers, wait.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional
+
+from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.fiber import TaskControl, global_control
+from brpc_tpu.fiber.timer import global_timer
+from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
+from brpc_tpu.protocol.tpu_std import pack_message, serialize_payload
+from brpc_tpu.rpc import errno_codes as berr
+from brpc_tpu.rpc.controller import Controller, address_call, take_call
+from brpc_tpu.transport.input_messenger import InputMessenger
+from brpc_tpu.transport.socket import Socket, create_client_socket
+
+
+@dataclass
+class ChannelOptions:
+    protocol: str = "tpu_std"
+    connection_type: str = "single"      # single | pooled | short
+    timeout_ms: Optional[float] = 1000.0
+    max_retry: int = 3
+    backup_request_ms: Optional[float] = None
+    auth_token: str = ""
+
+
+
+
+class Channel:
+    def __init__(self, address: Optional[str | EndPoint] = None,
+                 options: Optional[ChannelOptions] = None,
+                 control: Optional[TaskControl] = None):
+        self.options = options or ChannelOptions()
+        self._control = control or global_control()
+        self._messenger = InputMessenger(control=self._control)
+        self._socket: Optional[Socket] = None
+        self._socket_lock = threading.Lock()
+        self._endpoint: Optional[EndPoint] = None
+        if address is not None:
+            self.init(address)
+
+    def init(self, address: str | EndPoint) -> None:
+        self._endpoint = (address if isinstance(address, EndPoint)
+                          else str2endpoint(address))
+
+    # ---------------------------------------------------------- connection
+    def _get_socket(self) -> Socket:
+        s = self._socket
+        if s is not None and not s.failed:
+            return s
+        # connect OUTSIDE the lock: a slow/blackholed peer must not stall
+        # every concurrent call on this channel
+        new = create_client_socket(
+            self._endpoint, on_input=self._messenger.on_new_messages,
+            control=self._control)
+        with self._socket_lock:
+            cur = self._socket
+            if cur is not None and not cur.failed:
+                loser = new  # raced with another connector; keep theirs
+            else:
+                self._socket, loser = new, None
+        if loser is not None:
+            loser.set_failed(ConnectionError("duplicate connect discarded"))
+            return self._socket
+        return new
+
+    def close(self) -> None:
+        """Release the connection; the channel may be re-used (it will
+        reconnect lazily)."""
+        with self._socket_lock:
+            s, self._socket = self._socket, None
+        if s is not None and not s.failed:
+            s.set_failed(ConnectionError("channel closed"))
+
+    # ---------------------------------------------------------------- call
+    def call(self, service_name: str, method_name: str, request: Any = b"",
+             cntl: Optional[Controller] = None,
+             done: Optional[Callable[[Controller], None]] = None,
+             request_device_arrays: Optional[List] = None,
+             response_class=None) -> Controller:
+        """Begin an RPC; returns the Controller immediately. Wait with
+        cntl.join() (thread) / await cntl.join_async() (fiber), or pass
+        ``done`` for callback style — the async CallMethod triple."""
+        cntl = cntl or Controller()
+        cntl.start_us = time.monotonic_ns() // 1000
+        if cntl.timeout_ms is None:
+            cntl.timeout_ms = self.options.timeout_ms
+        if cntl.max_retry is None:
+            cntl.max_retry = self.options.max_retry
+        if cntl.backup_request_ms is None:
+            cntl.backup_request_ms = self.options.backup_request_ms
+        cntl._done_cb = done
+        cntl.auth_token = cntl.auth_token or self.options.auth_token
+        if request_device_arrays:
+            cntl.request_device_arrays = list(request_device_arrays)
+        cntl.response_msg = response_class() if response_class is not None else None
+        cntl._service_name = service_name
+        cntl._method_name = method_name
+        cntl._request_bytes = serialize_payload(request)
+        cntl._register_call()
+        self._issue_rpc(cntl)
+        # deadline timer: final — no retry after it fires (HandleTimeout)
+        if cntl.timeout_ms is not None:
+            tid = global_timer().schedule_after(
+                cntl.timeout_ms / 1e3, lambda: self._on_timeout(cntl))
+            cntl._timer_ids.append(tid)
+        if cntl.backup_request_ms is not None and cntl.backup_request_ms > 0:
+            tid = global_timer().schedule_after(
+                cntl.backup_request_ms / 1e3, lambda: self._on_backup_timer(cntl))
+            cntl._timer_ids.append(tid)
+        return cntl
+
+    def call_sync(self, service_name: str, method_name: str, request: Any = b"",
+                  cntl: Optional[Controller] = None, **kw) -> Controller:
+        cntl = self.call(service_name, method_name, request, cntl=cntl, **kw)
+        budget = None if cntl.timeout_ms is None else cntl.timeout_ms / 1e3 + 5.0
+        cntl.join(budget)
+        return cntl
+
+    async def call_async(self, service_name: str, method_name: str,
+                         request: Any = b"", cntl: Optional[Controller] = None,
+                         **kw) -> Controller:
+        cntl = self.call(service_name, method_name, request, cntl=cntl, **kw)
+        budget = None if cntl.timeout_ms is None else cntl.timeout_ms / 1e3 + 5.0
+        await cntl.join_async(budget)
+        return cntl
+
+    # ------------------------------------------------------------ internals
+    def _issue_rpc(self, cntl: Controller) -> None:
+        """Pick socket, pack, enqueue (Controller::IssueRPC,
+        controller.cpp:1010)."""
+        try:
+            sock = self._get_socket()
+        except (ConnectionError, OSError, ValueError) as e:
+            self._maybe_retry(cntl, berr.EFAILEDSOCKET, str(e))
+            return
+        cntl.remote_side = sock.remote_endpoint
+        cntl.local_side = sock.local_endpoint
+        meta = pb.RpcMeta()
+        meta.request.service_name = cntl._service_name
+        meta.request.method_name = cntl._method_name
+        meta.request.log_id = cntl.log_id
+        if cntl.timeout_ms is not None:
+            meta.request.timeout_ms = int(cntl.timeout_ms)
+        if cntl.auth_token:
+            meta.request.auth_token = cntl.auth_token
+        meta.correlation_id = cntl.correlation_id
+        meta.compress_type = cntl.compress_type
+        if cntl.trace_id:
+            meta.trace_id = cntl.trace_id
+            meta.span_id = cntl.span_id
+        use_lane = (bool(cntl.request_device_arrays)
+                    and sock.conn.supports_device_lane)
+        wire, lane = pack_message(
+            meta, cntl._request_bytes, attachment=_copy_buf(cntl.request_attachment),
+            device_arrays=cntl.request_device_arrays, device_lane=use_lane)
+        if lane is not None:
+            sock.write_device_payload(lane)
+        sock.write(wire, on_done=lambda err: self._on_write_done(cntl, err))
+
+    def _on_write_done(self, cntl: Controller, err: Optional[BaseException]):
+        if err is None:
+            return
+        self._maybe_retry(cntl, berr.EFAILEDSOCKET, str(err))
+
+    def _maybe_retry(self, cntl: Controller, code: int, text: str) -> None:
+        """Retry on transport errors while the call is still live
+        (OnVersionedRPCReturned's error branch, controller.cpp:634)."""
+        if address_call(cntl.correlation_id) is not cntl:
+            return  # already completed (response/timeout won)
+        if cntl.current_try < cntl.max_retry:
+            cntl.current_try += 1
+            self._issue_rpc(cntl)
+            return
+        if take_call(cntl.correlation_id) is cntl:
+            cntl.set_failed(code, text)
+            cntl._complete()
+
+    def _on_timeout(self, cntl: Controller) -> None:
+        if take_call(cntl.correlation_id) is cntl:
+            cntl.set_failed(berr.ERPCTIMEDOUT,
+                            f"deadline {cntl.timeout_ms}ms exceeded")
+            cntl._complete()
+
+    def _on_backup_timer(self, cntl: Controller) -> None:
+        """Send a duplicate request; first response wins
+        (backup_request_ms, controller.cpp:331)."""
+        if address_call(cntl.correlation_id) is not cntl:
+            return
+        cntl.used_backup = True
+        self._issue_rpc(cntl)
+
+
+def _copy_buf(buf: IOBuf) -> IOBuf:
+    out = IOBuf()
+    out.append_buf(buf)
+    return out

@@ -1,0 +1,34 @@
+"""Client-side response processing (ProcessRpcResponse,
+policy/baidu_rpc_protocol.cpp:565 -> OnVersionedRPCReturned)."""
+
+from __future__ import annotations
+
+from brpc_tpu.protocol.tpu_std import RpcMessage, unpack_inline_device_arrays
+from brpc_tpu.rpc import errno_codes as berr
+from brpc_tpu.rpc.controller import take_call
+
+
+def process_response(proto, msg: RpcMessage, socket) -> None:
+    cid = msg.meta.correlation_id
+    cntl = take_call(cid)
+    if cntl is None:
+        return  # stale: the call already completed (timeout/backup winner)
+    if msg.meta.HasField("response") and msg.meta.response.error_code != 0:
+        cntl.set_failed(msg.meta.response.error_code,
+                        msg.meta.response.error_text)
+    else:
+        cntl.response_payload = msg.payload
+        if cntl.response_msg is not None:
+            try:
+                cntl.response_msg.ParseFromString(msg.payload.to_bytes())
+            except Exception as e:
+                cntl.set_failed(berr.ERESPONSE, f"cannot parse response: {e}")
+        if msg.meta.device_payloads:
+            inline = unpack_inline_device_arrays(msg)
+            lane_iter = iter(msg.device_arrays)
+            arrays = []
+            for dp, inl in zip(msg.meta.device_payloads, inline):
+                arrays.append(inl if dp.inline_bytes else next(lane_iter, None))
+            cntl.response_device_arrays = arrays
+        cntl.response_attachment = msg.attachment
+    cntl._complete()

@@ -1,0 +1,119 @@
+"""Controller: per-call context & state machine for both sides
+(brpc/controller.{h,cpp}, SURVEY.md §2.6).
+
+Client side owns: correlation id (a versioned slot in a global pool — the
+bthread_id of the reference), deadline timer, retries, backup request,
+response data. Completion is a one-shot event that both fibers (await) and
+plain threads (block) can wait on, matching Join(cid)'s dual waiters.
+
+Server side owns: error state, attachments, device payloads, the response
+path handle.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.butil.resource_pool import ResourcePool
+from brpc_tpu.fiber.sync import FiberEvent
+from brpc_tpu.rpc import errno_codes as berr
+
+# global correlation-id pool: id -> client Controller (the reference's
+# bthread_id space, id.h:46)
+_call_pool: ResourcePool = ResourcePool()
+# reserve slot 0 forever: correlation id 0 must stay invalid, because
+# proto3 serializes 0 as an absent field (a frame with no/zero
+# correlation_id must never address a live call)
+_call_pool.insert(None)
+
+
+def address_call(correlation_id: int):
+    return _call_pool.address(correlation_id)
+
+
+def take_call(correlation_id: int):
+    """Remove-and-return: the first finisher wins; stale responses and
+    fired timers lose the race here (OnVersionedRPCReturned's version
+    check, controller.cpp:575)."""
+    return _call_pool.remove(correlation_id)
+
+
+class Controller:
+    def __init__(self):
+        # ---- shared
+        self.error_code: int = berr.OK
+        self.error_text: str = ""
+        self.log_id: int = 0
+        self.request_attachment = IOBuf()
+        self.response_attachment = IOBuf()
+        self.request_device_arrays: List = []
+        self.response_device_arrays: List = []
+        self.remote_side: Optional[EndPoint] = None
+        self.local_side: Optional[EndPoint] = None
+        self.auth_token: str = ""
+        self.compress_type: int = 0
+        self.trace_id: int = 0
+        self.span_id: int = 0
+        # ---- client side
+        self.timeout_ms: Optional[float] = None
+        self.max_retry: Optional[int] = None  # None = inherit channel option
+        self.backup_request_ms: Optional[float] = None
+        self.correlation_id: int = 0
+        self.response_payload: Optional[IOBuf] = None
+        self.response_msg: Any = None
+        self._done_event = FiberEvent()
+        self._done_cb: Optional[Callable[["Controller"], None]] = None
+        self._timer_ids: List[int] = []
+        self.current_try: int = 0
+        self.start_us: int = 0
+        self.end_us: int = 0
+        self.used_backup: bool = False
+        # ---- client call internals (set by Channel.call)
+        self._service_name: str = ""
+        self._method_name: str = ""
+        self._request_bytes: bytes = b""
+        # ---- server side
+        self._server_socket = None
+        self._response_sender: Optional[Callable] = None
+
+    # --------------------------------------------------------------- error
+    def failed(self) -> bool:
+        return self.error_code != berr.OK
+
+    def set_failed(self, code: int, text: str = "") -> None:
+        self.error_code = code
+        self.error_text = text or berr.errno_name(code)
+
+    def reset_error(self) -> None:
+        self.error_code = berr.OK
+        self.error_text = ""
+
+    def latency_us(self) -> int:
+        return max(0, self.end_us - self.start_us)
+
+    # ---------------------------------------------------- client completion
+    def _register_call(self) -> int:
+        self.correlation_id = _call_pool.insert(self)
+        return self.correlation_id
+
+    def _complete(self) -> None:
+        self.end_us = time.monotonic_ns() // 1000
+        from brpc_tpu.fiber.timer import global_timer
+        for tid in self._timer_ids:
+            global_timer().unschedule(tid)
+        self._timer_ids.clear()
+        cb = self._done_cb
+        self._done_event.set()
+        if cb is not None:
+            cb(self)
+
+    def join(self, timeout_s: Optional[float] = None) -> bool:
+        """Block the calling thread until the call finishes."""
+        return self._done_event.wait_pthread(timeout_s)
+
+    async def join_async(self, timeout_s: Optional[float] = None) -> bool:
+        return await self._done_event.wait(timeout_s)

@@ -1,0 +1,161 @@
+"""Server: service registry + acceptor + graceful stop
+(brpc/server.{h,cpp}: StartInternal :750, Stop/Join :691).
+
+start() listens on any registered transport scheme; accepted conns become
+Sockets whose input callback is the shared InputMessenger. The server
+rides along in socket.user_data so protocol dispatch finds it
+(the reference reaches the server through the Socket's acceptor back-ref).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+from brpc_tpu.bvar.latency_recorder import LatencyRecorder
+from brpc_tpu.fiber import TaskControl, global_control
+from brpc_tpu.rpc.service import Method, Service
+from brpc_tpu.transport.base import get_transport
+from brpc_tpu.transport.input_messenger import InputMessenger
+from brpc_tpu.transport.socket import Socket
+
+
+class ServerOptions:
+    def __init__(self, num_workers: Optional[int] = None,
+                 max_concurrency: Optional[int] = None,
+                 auth_token: Optional[str] = None,
+                 enable_builtin_services: bool = True):
+        self.num_workers = num_workers
+        self.max_concurrency = max_concurrency
+        self.auth_token = auth_token
+        self.enable_builtin_services = enable_builtin_services
+
+
+class Server:
+    def __init__(self, options: Optional[ServerOptions] = None,
+                 control: Optional[TaskControl] = None):
+        self.options = options or ServerOptions()
+        self._control = control or global_control()
+        self._messenger = InputMessenger(control=self._control)
+        self._services: Dict[str, Service] = {}
+        self._listener = None
+        self._endpoint: Optional[EndPoint] = None
+        self._conns: List[Socket] = []
+        self._conns_lock = threading.Lock()
+        self._running = False
+        self._stopped_event = threading.Event()
+        self.method_status: Dict[str, LatencyRecorder] = {}
+        self.concurrency = 0            # in-flight requests
+        self._concurrency_lock = threading.Lock()
+        self.nprocessed = 0
+        self.nerror = 0
+
+    # ------------------------------------------------------------ services
+    def add_service(self, service: Service) -> None:
+        if self._running:
+            raise RuntimeError("add_service after start")
+        if service.name in self._services:
+            raise ValueError(f"service {service.name!r} already added")
+        self._services[service.name] = service
+
+    def find_method(self, service_name: str, method_name: str) -> Optional[Method]:
+        svc = self._services.get(service_name)
+        if svc is None:
+            return None
+        return svc.methods.get(method_name)
+
+    def services(self) -> Dict[str, Service]:
+        return dict(self._services)
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self, address: str | EndPoint) -> EndPoint:
+        """Listen and serve; returns the bound endpoint (with the real
+        port for tcp://host:0)."""
+        if self._running:
+            raise RuntimeError("server already started")
+        ep = address if isinstance(address, EndPoint) else str2endpoint(address)
+        if self.options.enable_builtin_services:
+            from brpc_tpu.builtin.services import add_builtin_services
+            add_builtin_services(self)
+        transport = get_transport(ep.scheme)
+        self._listener = transport.listen(ep, self._on_new_conn)
+        self._endpoint = self._listener.endpoint
+        self._running = True
+        self._stopped_event.clear()
+        return self._endpoint
+
+    @property
+    def endpoint(self) -> Optional[EndPoint]:
+        return self._endpoint
+
+    def _on_new_conn(self, conn) -> None:
+        sock = Socket(conn, on_input=self._messenger.on_new_messages,
+                      control=self._control)
+        sock.user_data["server"] = self
+        with self._conns_lock:
+            self._conns.append(sock)
+            # opportunistic sweep of dead conns
+            if len(self._conns) > 64:
+                self._conns = [s for s in self._conns if not s.failed]
+
+    def connections(self) -> List[Socket]:
+        with self._conns_lock:
+            return [s for s in self._conns if not s.failed]
+
+    def stop(self) -> None:
+        """Stop accepting; existing connections are closed after in-flight
+        requests drain (graceful, server.cpp:691)."""
+        if not self._running:
+            return
+        self._running = False
+        if self._listener is not None:
+            self._listener.stop()
+
+    def join(self, timeout_s: float = 5.0) -> None:
+        """Wait for in-flight requests, then close connections."""
+        import time
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._concurrency_lock:
+                if self.concurrency == 0:
+                    break
+            time.sleep(0.005)
+        with self._conns_lock:
+            conns, self._conns = self._conns, []
+        for s in conns:
+            s.set_failed(ConnectionError("server stopped"))
+        self._stopped_event.set()
+
+    def run_until_asked_to_quit(self) -> None:
+        import signal
+        ev = threading.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: ev.set())
+        ev.wait()
+        self.stop()
+        self.join()
+
+    # ----------------------------------------------------------- accounting
+    def on_request_start(self) -> bool:
+        with self._concurrency_lock:
+            if (self.options.max_concurrency is not None
+                    and self.concurrency >= self.options.max_concurrency):
+                return False
+            self.concurrency += 1
+        return True
+
+    def on_request_end(self, method_key: str, latency_us: float, failed: bool):
+        with self._concurrency_lock:
+            self.concurrency -= 1
+            self.nprocessed += 1
+            if failed:
+                self.nerror += 1
+        lr = self.method_status.get(method_key)
+        if lr is None:
+            lr = self.method_status.setdefault(method_key, LatencyRecorder())
+        lr.record(latency_us)
+
+    @property
+    def is_running(self) -> bool:
+        return self._running

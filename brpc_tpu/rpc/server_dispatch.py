@@ -1,0 +1,119 @@
+"""Server-side request processing (ProcessRpcRequest,
+policy/baidu_rpc_protocol.cpp:314 -> user service -> SendRpcResponse :139).
+
+Runs inside the fiber the InputMessenger dispatched; the user handler may
+be async (awaited in place) or sync.
+"""
+
+from __future__ import annotations
+
+import inspect
+import time
+from typing import Optional
+
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
+from brpc_tpu.protocol.tpu_std import (
+    RpcMessage, pack_message, serialize_payload, unpack_inline_device_arrays)
+from brpc_tpu.rpc import errno_codes as berr
+from brpc_tpu.rpc.controller import Controller
+
+
+async def process_request(proto, msg: RpcMessage, socket) -> None:
+    server = socket.user_data.get("server")
+    meta = msg.meta
+    cid = meta.correlation_id
+    if server is None:
+        _send_error(socket, cid, berr.EINTERNAL, "no server bound to socket")
+        return
+    req_meta = meta.request
+    # auth precedes lookup: unauthenticated peers must not be able to
+    # enumerate the service/method namespace from distinct error codes
+    if server.options.auth_token is not None and \
+            req_meta.auth_token != server.options.auth_token:
+        _send_error(socket, cid, berr.ERPCAUTH, "authentication failed")
+        return
+    method = server.find_method(req_meta.service_name, req_meta.method_name)
+    if method is None:
+        has_svc = req_meta.service_name in server.services()
+        _send_error(socket, cid,
+                    berr.ENOMETHOD if has_svc else berr.ENOSERVICE,
+                    f"unknown {req_meta.service_name}.{req_meta.method_name}")
+        return
+    if not server.on_request_start():
+        _send_error(socket, cid, berr.ELIMIT, "max_concurrency reached")
+        return
+
+    method_key = f"{req_meta.service_name}.{req_meta.method_name}"
+    t0 = time.monotonic_ns()
+    cntl = Controller()
+    cntl.log_id = req_meta.log_id
+    cntl.remote_side = socket.remote_endpoint
+    cntl.local_side = socket.local_endpoint
+    cntl.auth_token = req_meta.auth_token
+    cntl.trace_id = meta.trace_id
+    cntl.span_id = meta.span_id
+    cntl._server_socket = socket
+    cntl.request_attachment = msg.attachment
+    if meta.device_payloads:
+        inline = unpack_inline_device_arrays(msg)
+        lane_iter = iter(msg.device_arrays)
+        cntl.request_device_arrays = [
+            inl if dp.inline_bytes else next(lane_iter, None)
+            for dp, inl in zip(meta.device_payloads, inline)]
+
+    # decode request payload
+    request = None
+    try:
+        if method.request_class is not None:
+            request = method.request_class()
+            request.ParseFromString(msg.payload.to_bytes())
+        else:
+            request = msg.payload.to_bytes()
+    except Exception as e:
+        server.on_request_end(method_key, 0, failed=True)
+        _send_error(socket, cid, berr.EREQUEST, f"cannot parse request: {e}")
+        return
+
+    response = None
+    try:
+        r = method.handler(cntl, request)
+        if inspect.isawaitable(r):
+            r = await r
+        response = r
+    except Exception as e:
+        cntl.set_failed(berr.EINTERNAL, f"{type(e).__name__}: {e}")
+
+    latency_us = (time.monotonic_ns() - t0) / 1e3
+    server.on_request_end(method_key, latency_us, failed=cntl.failed())
+    _send_response(socket, cid, cntl, response)
+
+
+def _send_response(socket, cid: int, cntl: Controller, response) -> None:
+    meta = pb.RpcMeta()
+    meta.correlation_id = cid
+    meta.response.error_code = cntl.error_code
+    meta.response.error_text = cntl.error_text
+    payload = b""
+    if not cntl.failed():
+        try:
+            payload = serialize_payload(response)
+        except TypeError as e:
+            meta.response.error_code = berr.EINTERNAL
+            meta.response.error_text = str(e)
+    use_lane = (bool(cntl.response_device_arrays)
+                and socket.conn.supports_device_lane)
+    att = IOBuf()
+    att.append_buf(cntl.response_attachment)
+    wire, lane = pack_message(meta, payload, attachment=att,
+                              device_arrays=cntl.response_device_arrays,
+                              device_lane=use_lane)
+    if lane is not None:
+        socket.write_device_payload(lane)
+    socket.write(wire)
+
+
+def _send_error(socket, cid: int, code: int, text: str) -> None:
+    cntl = Controller()
+    cntl.set_failed(code, text)
+    _send_response(socket, cid, cntl, None)

@@ -1,0 +1,56 @@
+"""Service & method registration.
+
+The reference registers protobuf Services whose methods arrive via
+CallMethod (server.h AddService). We register named methods with optional
+protobuf request/response classes; handlers are sync or async callables
+``handler(cntl, request) -> response`` where response may be bytes, an
+IOBuf, or a protobuf message. Device arrays ride on the controller
+(cntl.request_device_arrays / cntl.response_device_arrays).
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class Method:
+    name: str
+    handler: Callable
+    request_class: Optional[type] = None
+    response_class: Optional[type] = None
+
+
+class Service:
+    def __init__(self, name: str):
+        self.name = name
+        self.methods: Dict[str, Method] = {}
+
+    def register_method(self, name: str, handler: Callable,
+                        request_class: Optional[type] = None,
+                        response_class: Optional[type] = None) -> None:
+        self.methods[name] = Method(name, handler, request_class, response_class)
+
+    def method(self, name: Optional[str] = None, request_class=None,
+               response_class=None):
+        """Decorator: ``@svc.method()`` over ``def Echo(cntl, req): ...``"""
+        def deco(fn):
+            self.register_method(name or fn.__name__, fn, request_class,
+                                 response_class)
+            return fn
+        return deco
+
+
+def service_from_object(obj: Any, name: Optional[str] = None) -> Service:
+    """Build a Service from an object's public methods (duck-typed
+    convenience for hand-written service classes)."""
+    svc = Service(name or type(obj).__name__)
+    for attr in dir(obj):
+        if attr.startswith("_"):
+            continue
+        fn = getattr(obj, attr)
+        if callable(fn):
+            svc.register_method(attr, fn)
+    return svc

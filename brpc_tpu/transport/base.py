@@ -1,0 +1,115 @@
+"""Transport plugin interface.
+
+The reference hides three data planes behind one Socket (epoll TCP, verbs
+RDMA, io_uring — SURVEY.md §2.4); we do the same behind ``Transport``:
+
+  mem://  in-process loopback — the test fabric every layer above runs on
+          (the reference's 127.0.0.1 fixture pattern, SURVEY.md §4)
+  tcp://  real sockets via a selectors EventDispatcher (bootstrap + DCN)
+  tpu://  device transport: metadata rides a host stream, payload tensors
+          move device-to-device (transport/tpu.py)
+
+A Conn is a non-blocking byte stream; BlockingIOError means "would block"
+and the owning Socket parks until the dispatcher reports readiness.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from brpc_tpu.butil.endpoint import EndPoint
+
+
+class Conn:
+    """One established byte-stream connection (non-blocking)."""
+
+    # True when the transport can move device arrays out of band (the
+    # zero-copy lane); host-byte transports serialize payloads instead
+    supports_device_lane: bool = False
+
+    def write(self, mv: memoryview) -> int:
+        """Write some bytes; raises BlockingIOError if none can be taken."""
+        raise NotImplementedError
+
+    def read_into(self, mv: memoryview) -> int:
+        """Read some bytes; 0 = peer closed; raises BlockingIOError."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+    def start_events(self, on_readable: Callable[[], None],
+                     on_writable: Callable[[], None]) -> None:
+        """Begin edge-style readiness callbacks (may fire from any thread)."""
+        raise NotImplementedError
+
+    def request_writable_event(self) -> None:
+        """Ask for one on_writable callback when the conn can take bytes
+        again (epollout registration for a blocked writer)."""
+        raise NotImplementedError
+
+    # device-native transports may move jax arrays out of band; host-byte
+    # transports leave this None
+    def write_device_payload(self, arrays) -> Optional[object]:
+        return None
+
+    @property
+    def local_endpoint(self) -> Optional[EndPoint]:
+        return None
+
+    @property
+    def remote_endpoint(self) -> Optional[EndPoint]:
+        return None
+
+
+class Listener:
+    def stop(self) -> None:
+        raise NotImplementedError
+
+    @property
+    def endpoint(self) -> EndPoint:
+        raise NotImplementedError
+
+
+class Transport:
+    scheme: str = ""
+
+    def connect(self, ep: EndPoint) -> Conn:
+        raise NotImplementedError
+
+    def listen(self, ep: EndPoint, on_new_conn: Callable[[Conn], None]) -> Listener:
+        raise NotImplementedError
+
+
+_transports: Dict[str, Transport] = {}
+_lock = threading.Lock()
+
+
+def register_transport(t: Transport) -> None:
+    with _lock:
+        _transports[t.scheme] = t
+
+
+def get_transport(scheme: str) -> Transport:
+    t = _transports.get(scheme)
+    if t is None:
+        # lazy-register builtins on first use
+        _register_builtins()
+        t = _transports.get(scheme)
+    if t is None:
+        raise ValueError(f"no transport registered for scheme {scheme!r}")
+    return t
+
+
+def _register_builtins() -> None:
+    with _lock:
+        if "mem" not in _transports:
+            from brpc_tpu.transport.mem import MemTransport
+            _transports["mem"] = MemTransport()
+        if "tcp" not in _transports:
+            from brpc_tpu.transport.tcp import TcpTransport
+            _transports["tcp"] = TcpTransport()
+        if "tpu" not in _transports:
+            from brpc_tpu.transport.tpu import TpuTransport
+            _transports["tpu"] = TpuTransport()

@@ -1,0 +1,137 @@
+"""EventDispatcher: readiness poller for fd-based transports
+(brpc/event_dispatcher.h:32 — epoll/kqueue there, selectors here).
+
+One thread runs the selector; callbacks fire on it and must be cheap —
+they schedule fibers and return (the reference's edge-trigger handlers do
+the same: StartInputEvent only bumps an atomic and maybe spawns a bthread).
+Write-readiness registrations are one-shot (epollout for blocked writers).
+"""
+
+from __future__ import annotations
+
+import os
+import selectors
+import socket as pysocket
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+
+class EventDispatcher:
+    def __init__(self, name: str = "event_dispatcher"):
+        self._selector = selectors.DefaultSelector()
+        self._lock = threading.Lock()
+        # fd -> [on_readable, on_writable(one-shot), persistent_mask]
+        self._handlers: Dict[int, list] = {}
+        self._wakeup_r, self._wakeup_w = pysocket.socketpair()
+        self._wakeup_r.setblocking(False)
+        self._selector.register(self._wakeup_r, selectors.EVENT_READ, None)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._name = name
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop = False
+            self._thread = threading.Thread(target=self._run, name=self._name,
+                                            daemon=True)
+            self._thread.start()
+
+    def _wakeup(self):
+        try:
+            self._wakeup_w.send(b"x")
+        except (BlockingIOError, OSError):
+            pass
+
+    def add_consumer(self, fd: int, on_readable: Callable[[], None]) -> None:
+        """Register persistent read-readiness callbacks for fd."""
+        with self._lock:
+            self._handlers[fd] = [on_readable, None, selectors.EVENT_READ]
+            try:
+                self._selector.register(fd, selectors.EVENT_READ, fd)
+            except KeyError:
+                self._selector.modify(fd, selectors.EVENT_READ, fd)
+            self._ensure_thread()
+        self._wakeup()
+
+    def request_writable(self, fd: int, on_writable: Callable[[], None]) -> None:
+        """One-shot write-readiness callback (the epollout dance the
+        reference does for connecting/blocked sockets)."""
+        with self._lock:
+            h = self._handlers.get(fd)
+            if h is None:
+                self._handlers[fd] = [None, on_writable, 0]
+                self._selector.register(fd, selectors.EVENT_WRITE, fd)
+            else:
+                h[1] = on_writable
+                mask = h[2] | selectors.EVENT_WRITE
+                self._selector.modify(fd, mask, fd)
+            self._ensure_thread()
+        self._wakeup()
+
+    def remove_consumer(self, fd: int) -> None:
+        with self._lock:
+            self._handlers.pop(fd, None)
+            try:
+                self._selector.unregister(fd)
+            except (KeyError, ValueError, OSError):
+                pass
+        self._wakeup()
+
+    def _run(self):
+        while not self._stop:
+            try:
+                events = self._selector.select(timeout=0.5)
+            except OSError:
+                continue
+            for key, mask in events:
+                if key.data is None:  # wakeup pipe
+                    try:
+                        while self._wakeup_r.recv(4096):
+                            pass
+                    except (BlockingIOError, OSError):
+                        pass
+                    continue
+                fd = key.data
+                on_readable = on_writable = None
+                with self._lock:
+                    h = self._handlers.get(fd)
+                    if h is None:
+                        continue
+                    if mask & selectors.EVENT_READ:
+                        on_readable = h[0]
+                    if mask & selectors.EVENT_WRITE:
+                        on_writable, h[1] = h[1], None  # one-shot
+                        new_mask = h[2]
+                        try:
+                            if new_mask:
+                                self._selector.modify(fd, new_mask, fd)
+                            else:
+                                self._selector.unregister(fd)
+                                del self._handlers[fd]
+                        except (KeyError, ValueError, OSError):
+                            pass
+                for cb in (on_readable, on_writable):
+                    if cb is not None:
+                        try:
+                            cb()
+                        except Exception:
+                            import logging
+                            logging.getLogger("brpc_tpu.transport").exception(
+                                "event callback failed for fd %d", fd)
+
+    def stop(self):
+        self._stop = True
+        self._wakeup()
+
+
+_global: Optional[EventDispatcher] = None
+_glock = threading.Lock()
+
+
+def global_dispatcher() -> EventDispatcher:
+    global _global
+    if _global is None:
+        with _glock:
+            if _global is None:
+                _global = EventDispatcher()
+    return _global

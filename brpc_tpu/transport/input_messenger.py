@@ -1,0 +1,71 @@
+"""InputMessenger: cuts complete messages out of a socket's byte stream by
+trying registered protocols' Parse functions (brpc/input_messenger.{h,cpp}).
+
+Keeps the reference's two hot-path tricks: the per-socket preferred
+protocol index (first successful parser is remembered,
+input_messenger.cpp:219), and in-place processing of the *last* message
+while earlier ones get fresh fibers (QueueMessage, :183 — so a pipelined
+burst parallelizes but the common single-message case pays no extra
+handoff).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.fiber import TaskControl, global_control
+from brpc_tpu.protocol.registry import PARSE_OK, PARSE_NOT_ENOUGH_DATA, PARSE_TRY_OTHERS, get_protocols
+from brpc_tpu.transport.socket import Socket
+
+
+class InputMessenger:
+    def __init__(self, protocols: Optional[List] = None,
+                 control: Optional[TaskControl] = None):
+        self._protocols = protocols  # None = global registry snapshot per call
+        self._control = control or global_control()
+
+    def protocols(self) -> List:
+        return self._protocols if self._protocols is not None else get_protocols()
+
+    async def on_new_messages(self, socket: Socket):
+        """The socket's input callback: parse-loop the portal, dispatch."""
+        msgs = []  # (protocol, msg)
+        protocols = self.protocols()
+        while socket.input_portal:
+            idx = socket.preferred_protocol
+            order = range(len(protocols)) if idx < 0 else (
+                [idx] + [i for i in range(len(protocols)) if i != idx])
+            claimed = None
+            waiting_for_bytes = False
+            for i in order:
+                proto = protocols[i]
+                # parse contract: peek-only unless returning PARSE_OK
+                status, msg = proto.parse(socket.input_portal, socket)
+                if status == PARSE_OK:
+                    socket.preferred_protocol = i
+                    claimed = (proto, msg)
+                    break
+                if status == PARSE_NOT_ENOUGH_DATA:
+                    # these bytes are this protocol's, just incomplete:
+                    # stop and wait for more input
+                    waiting_for_bytes = True
+                    break
+                # PARSE_TRY_OTHERS: not this protocol's bytes, try next
+            if claimed is not None:
+                msgs.append(claimed)
+                continue
+            if not waiting_for_bytes and socket.input_portal:
+                # every protocol disclaimed the bytes: drop the connection
+                socket.set_failed(ValueError("unparsable input"))
+            break
+        if not msgs:
+            return
+        # earlier messages -> fresh fibers; last one processed in place
+        for proto, msg in msgs[:-1]:
+            self._control.spawn(proto.process, msg, socket,
+                                name=f"process_{proto.name}")
+        proto, msg = msgs[-1]
+        r = proto.process(msg, socket)
+        if hasattr(r, "__await__"):
+            await r

@@ -1,0 +1,183 @@
+"""In-process loopback transport (``mem://name``).
+
+The fake fabric required by SURVEY.md §4's lesson: the whole stack must be
+testable without real networking. A mem conn is a pair of byte queues with
+direct readiness callbacks; it also carries device payloads by reference
+(zero-copy), which is exactly what a same-host tpu:// hop degenerates to.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+from brpc_tpu.transport.base import Conn, Listener, Transport
+
+_MAX_BUFFER = 4 * 1024 * 1024  # per-direction; apply backpressure beyond
+
+
+class _MemPipe:
+    """One direction of a mem connection."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.chunks: deque = deque()
+        self.size = 0
+        self.closed = False
+        self.device_payloads: deque = deque()
+
+
+class MemConn(Conn):
+    supports_device_lane = True
+
+    def __init__(self, rx: _MemPipe, tx: _MemPipe, local: EndPoint, remote: EndPoint):
+        self._rx = rx
+        self._tx = tx
+        self._local = local
+        self._remote = remote
+        self.peer: Optional["MemConn"] = None
+        self._on_readable: Optional[Callable[[], None]] = None
+        self._on_writable: Optional[Callable[[], None]] = None
+        self._want_writable = False
+
+    # ------------------------------------------------------------- stream
+    def write(self, mv: memoryview) -> int:
+        with self._tx.lock:
+            if self._tx.closed:
+                raise BrokenPipeError("mem conn closed")
+            if self._tx.size >= _MAX_BUFFER:
+                raise BlockingIOError
+            data = bytes(mv)
+            self._tx.chunks.append(data)
+            self._tx.size += len(data)
+        peer = self.peer
+        if peer is not None:
+            peer._notify_readable()
+        return len(data)
+
+    def read_into(self, mv: memoryview) -> int:
+        with self._rx.lock:
+            if not self._rx.chunks:
+                if self._rx.closed:
+                    return 0
+                raise BlockingIOError
+            chunk = self._rx.chunks[0]
+            n = min(len(chunk), len(mv))
+            mv[:n] = chunk[:n]
+            if n == len(chunk):
+                self._rx.chunks.popleft()
+            else:
+                self._rx.chunks[0] = chunk[n:]
+            self._rx.size -= n
+            was_full = self._rx.size + n >= _MAX_BUFFER > self._rx.size
+        peer = self.peer
+        if was_full and peer is not None:
+            peer._notify_writable()
+        return n
+
+    def write_device_payload(self, arrays) -> bool:
+        """Zero-copy: hand device arrays to the peer by reference."""
+        with self._tx.lock:
+            if self._tx.closed:
+                raise BrokenPipeError("mem conn closed")
+            self._tx.device_payloads.append(arrays)
+        return True
+
+    def take_device_payload(self):
+        with self._rx.lock:
+            if self._rx.device_payloads:
+                return self._rx.device_payloads.popleft()
+        return None
+
+    def close(self) -> None:
+        for pipe in (self._rx, self._tx):
+            with pipe.lock:
+                pipe.closed = True
+        peer = self.peer
+        if peer is not None:
+            peer._notify_readable()  # peer reads EOF
+
+    # ------------------------------------------------------------- events
+    def start_events(self, on_readable, on_writable) -> None:
+        self._on_readable = on_readable
+        self._on_writable = on_writable
+        with self._rx.lock:
+            pending = bool(self._rx.chunks) or self._rx.closed
+        if pending:
+            self._notify_readable()
+
+    def request_writable_event(self) -> None:
+        with self._tx.lock:
+            if self._tx.size < _MAX_BUFFER:
+                fire = True
+            else:
+                self._want_writable = True
+                fire = False
+        if fire:
+            self._notify_writable()
+
+    def _notify_readable(self) -> None:
+        cb = self._on_readable
+        if cb is not None:
+            cb()
+
+    def _notify_writable(self) -> None:
+        self._want_writable = False
+        cb = self._on_writable
+        if cb is not None:
+            cb()
+
+    @property
+    def local_endpoint(self):
+        return self._local
+
+    @property
+    def remote_endpoint(self):
+        return self._remote
+
+
+class _MemListener(Listener):
+    def __init__(self, transport: "MemTransport", ep: EndPoint,
+                 on_new_conn: Callable[[Conn], None]):
+        self._transport = transport
+        self._ep = ep
+        self.on_new_conn = on_new_conn
+
+    def stop(self) -> None:
+        self._transport._listeners.pop(self._ep.host, None)
+
+    @property
+    def endpoint(self) -> EndPoint:
+        return self._ep
+
+
+class MemTransport(Transport):
+    scheme = "mem"
+
+    def __init__(self):
+        self._listeners: Dict[str, _MemListener] = {}
+        self._lock = threading.Lock()
+
+    def listen(self, ep: EndPoint, on_new_conn) -> Listener:
+        with self._lock:
+            if ep.host in self._listeners:
+                raise OSError(f"mem://{ep.host} already listening")
+            lst = _MemListener(self, ep, on_new_conn)
+            self._listeners[ep.host] = lst
+            return lst
+
+    def connect(self, ep: EndPoint) -> Conn:
+        with self._lock:
+            lst = self._listeners.get(ep.host)
+        if lst is None:
+            raise ConnectionRefusedError(f"no listener at mem://{ep.host}")
+        a2b, b2a = _MemPipe(), _MemPipe()
+        client_ep = str2endpoint(f"mem://client-{id(a2b):x}")
+        client = MemConn(rx=b2a, tx=a2b, local=client_ep, remote=ep)
+        server = MemConn(rx=a2b, tx=b2a, local=ep, remote=client_ep)
+        client.peer = server
+        server.peer = client
+        lst.on_new_conn(server)
+        return client

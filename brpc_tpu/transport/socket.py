@@ -1,0 +1,241 @@
+"""Socket: THE connection object (brpc/socket.h, SURVEY.md §2.4).
+
+Keeps the reference's load-bearing properties, re-expressed for the fiber
+runtime:
+
+- **Versioned refs**: sockets live in a global ResourcePool; a SocketId
+  goes stale atomically on SetFailed (socket.cpp:776-800's _versioned_ref
+  race-freedom between address() and SetFailed()).
+- **Serialized wait-free-ish writes**: producers append to an MPSC queue
+  and return; a single KeepWrite fiber drains it (socket.cpp:1924-2160's
+  _write_head exchange + KeepWrite bthread). On EAGAIN it parks on a
+  butex armed by the transport's one-shot writable event.
+- **Edge-triggered input**: readiness events bump an atomic counter; only
+  the 0->1 transition spawns the processing fiber (StartInputEvent's
+  _nevent dance, socket.cpp:2527), which drains input until EAGAIN.
+- **Device payload lane**: device arrays ride next to the byte stream on
+  transports that support it (the HBM zero-copy slot where the reference
+  has RDMA SGEs).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Optional
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.butil.iobuf import IOBuf, IOPortal
+from brpc_tpu.butil.resource_pool import INVALID_ID, ResourcePool, VersionedId
+from brpc_tpu.bvar.reducer import Adder
+from brpc_tpu.fiber import TaskControl, global_control
+from brpc_tpu.fiber.butex import Butex
+from brpc_tpu.transport.base import Conn, get_transport
+
+_socket_pool: ResourcePool = ResourcePool()
+
+nwrites = Adder()
+nreads = Adder()
+
+SocketId = VersionedId
+
+
+def address_socket(sid: SocketId) -> Optional["Socket"]:
+    return _socket_pool.address(sid)
+
+
+class Socket:
+    def __init__(self, conn: Conn, on_input: Optional[Callable] = None,
+                 control: Optional[TaskControl] = None):
+        """``on_input(socket)`` runs in a fiber when bytes arrive
+        (InputMessenger.on_new_messages in the assembled stack)."""
+        self.conn = conn
+        self._control = control or global_control()
+        self._on_input = on_input
+        self.input_portal = IOPortal()
+        self.failed = False
+        self.fail_reason: Optional[BaseException] = None
+        self._write_q: deque = deque()           # (IOBuf, done_cb|None)
+        self._write_flag_lock = threading.Lock()
+        self._writing = False
+        self._writable_butex = Butex(0)
+        self._nevent = 0                          # edge-trigger input counter
+        self._nevent_lock = threading.Lock()
+        self.preferred_protocol = -1              # InputMessenger cache
+        self.user_data: dict = {}                 # per-conn session state
+        self._on_failed_cbs: list = []
+        self.id: SocketId = _socket_pool.insert(self)
+        conn.start_events(self._on_readable_event, self._on_writable_event)
+
+    # ----------------------------------------------------------- identity
+    @property
+    def remote_endpoint(self) -> Optional[EndPoint]:
+        return self.conn.remote_endpoint
+
+    @property
+    def local_endpoint(self) -> Optional[EndPoint]:
+        return self.conn.local_endpoint
+
+    # -------------------------------------------------------------- write
+    def write(self, buf: IOBuf, on_done: Optional[Callable] = None) -> bool:
+        """Enqueue and return immediately; ordering is FIFO per socket.
+        On an already-failed socket the done callback still fires (with the
+        failure) so callers' retry paths run — never a silent drop."""
+        if self.failed:
+            if on_done is not None:
+                try:
+                    on_done(self.fail_reason)
+                except Exception:
+                    pass
+            return False
+        self._write_q.append((buf, on_done))
+        nwrites.add(1)
+        self._maybe_start_keep_write()
+        return True
+
+    def write_device_payload(self, arrays) -> bool:
+        """Out-of-band device lane (mem/tpu transports); host transports
+        must serialize instead."""
+        r = self.conn.write_device_payload(arrays)
+        return bool(r)
+
+    def _maybe_start_keep_write(self):
+        with self._write_flag_lock:
+            if self._writing or not self._write_q:
+                return
+            self._writing = True
+        self._control.spawn(self._keep_write, name="keep_write")
+
+    async def _keep_write(self):
+        while True:
+            try:
+                item = self._write_q.popleft()
+            except IndexError:
+                item = None
+            if item is None:
+                with self._write_flag_lock:
+                    if not self._write_q:
+                        self._writing = False
+                        return
+                continue
+            buf, on_done = item
+            err: Optional[BaseException] = None
+            while buf and not self.failed:
+                try:
+                    buf.cut_into_writer(self.conn.write)
+                except (BrokenPipeError, ConnectionError, OSError) as e:
+                    err = e
+                    break
+                if buf:
+                    # blocked: arm one-shot writable event, park on butex
+                    seq = self._writable_butex.value
+                    self.conn.request_writable_event()
+                    await self._writable_butex.wait(expected=seq, timeout_s=1.0)
+            if err is None and buf and self.failed:
+                err = self.fail_reason  # failed mid-write: not a success
+            if err is not None:
+                self.set_failed(err)
+            if on_done is not None:
+                try:
+                    on_done(err)
+                except Exception:
+                    pass
+            if self.failed:
+                # drain remaining writes with failure callbacks
+                while True:
+                    try:
+                        _, cb = self._write_q.popleft()
+                    except IndexError:
+                        break
+                    if cb is not None:
+                        try:
+                            cb(self.fail_reason)
+                        except Exception:
+                            pass
+                with self._write_flag_lock:
+                    self._writing = False
+                return
+
+    def _on_writable_event(self):
+        self._writable_butex.fetch_add(1)
+        self._writable_butex.wake_all()
+
+    # -------------------------------------------------------------- input
+    def _on_readable_event(self):
+        """May fire from the dispatcher thread or a peer's fiber; only the
+        0->1 transition starts a processing fiber."""
+        with self._nevent_lock:
+            self._nevent += 1
+            if self._nevent > 1:
+                return
+        self._control.spawn(self._process_input, name="socket_input")
+
+    async def _process_input(self):
+        while True:
+            with self._nevent_lock:
+                pending = self._nevent
+            progressed = self._drain_readable()
+            if self._on_input is not None and (self.input_portal or self.failed):
+                r = self._on_input(self)
+                if hasattr(r, "__await__"):
+                    await r
+            with self._nevent_lock:
+                self._nevent -= pending
+                if self._nevent > 0:
+                    continue
+                return
+
+    def _drain_readable(self) -> int:
+        """Read until EAGAIN/EOF into the portal; returns bytes read."""
+        total = 0
+        while not self.failed:
+            try:
+                n = self.input_portal.append_from_reader(self.conn.read_into)
+            except BlockingIOError:
+                break
+            except (ConnectionError, OSError) as e:
+                self.set_failed(e)
+                break
+            if n == 0:  # EOF
+                self.set_failed(ConnectionResetError("peer closed"))
+                break
+            total += n
+            nreads.add(n)
+        return total
+
+    def take_device_payload(self):
+        take = getattr(self.conn, "take_device_payload", None)
+        return take() if take is not None else None
+
+    # ------------------------------------------------------------ failure
+    def set_failed(self, reason: Optional[BaseException] = None) -> None:
+        """Version-bump the id (outstanding SocketIds go stale), close the
+        conn, fire failure callbacks (SetFailed, socket.cpp)."""
+        if self.failed:
+            return
+        self.failed = True
+        self.fail_reason = reason or ConnectionError("socket set_failed")
+        _socket_pool.remove(self.id)
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        self._writable_butex.fetch_add(1)
+        self._writable_butex.wake_all()
+        for cb in list(self._on_failed_cbs):
+            try:
+                cb(self)
+            except Exception:
+                pass
+
+    def on_failed(self, cb: Callable[["Socket"], None]) -> None:
+        if self.failed:
+            cb(self)
+        else:
+            self._on_failed_cbs.append(cb)
+
+
+def create_client_socket(ep: EndPoint, on_input: Optional[Callable] = None,
+                         control: Optional[TaskControl] = None) -> Socket:
+    conn = get_transport(ep.scheme).connect(ep)
+    return Socket(conn, on_input=on_input, control=control)

@@ -1,0 +1,99 @@
+"""tpu:// transport: the device data plane.
+
+Where the reference grafts ibverbs RDMA onto Socket (rdma/rdma_endpoint.*,
+SURVEY.md §2.4 + §3.5), we graft the accelerator fabric: metadata rides a
+host byte stream (here: in-process pipes; cross-host: the DCN/TCP
+bootstrap), while tensor payloads move device-to-device on the transfer
+lane — `jax.device_put` onto the receiver's device, which XLA lowers to an
+ICI copy when source and target are distinct TPU chips, and which
+degenerates to a zero-copy reference hand-off when they are the same
+device.
+
+Endpoint form: ``tpu://name:port#device=K`` — K is the receiver's local
+device ordinal. The RDMA-style handshake (exchange mesh coords/channel
+ids over TCP, then bring up the device channel) slots in here for the
+multi-host path; single-host needs none.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.transport.base import Conn, Listener, Transport
+from brpc_tpu.transport.mem import MemConn, _MemPipe, _MemListener
+
+
+def _device_for(ordinal: Optional[int]):
+    import jax
+    devs = jax.devices()
+    if ordinal is None or ordinal >= len(devs):
+        return devs[0]
+    return devs[ordinal]
+
+
+class TpuConn(MemConn):
+    """Host stream = mem pipes; device lane = device_put to the peer's
+    device (the PjRt Send/Recv slot)."""
+
+    supports_device_lane = True
+
+    def __init__(self, rx, tx, local, remote, peer_device_ordinal: Optional[int]):
+        super().__init__(rx, tx, local, remote)
+        self._peer_device_ordinal = peer_device_ordinal
+
+    def write_device_payload(self, arrays) -> bool:
+        import jax
+        target = _device_for(self._peer_device_ordinal)
+        moved = []
+        for arr in arrays:
+            if getattr(arr, "devices", None) is not None and callable(arr.devices) \
+                    and target in arr.devices():
+                moved.append(arr)  # already resident: zero-copy hand-off
+            else:
+                moved.append(jax.device_put(arr, target))
+        return super().write_device_payload(moved)
+
+
+class TpuTransport(Transport):
+    scheme = "tpu"
+
+    def __init__(self):
+        self._listeners: Dict[str, _MemListener] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(ep: EndPoint) -> str:
+        return f"{ep.host}:{ep.port}"
+
+    def listen(self, ep: EndPoint, on_new_conn) -> Listener:
+        with self._lock:
+            key = self._key(ep)
+            if key in self._listeners:
+                raise OSError(f"tpu://{key} already listening")
+            lst = _MemListener(self, ep, on_new_conn)
+            self._listeners[key] = lst
+            # _MemListener.stop() pops by ep.host; patch key-based removal
+            lst.stop = lambda: self._listeners.pop(key, None)  # type: ignore
+            return lst
+
+    def connect(self, ep: EndPoint) -> Conn:
+        with self._lock:
+            lst = self._listeners.get(self._key(ep))
+        if lst is None:
+            raise ConnectionRefusedError(f"no listener at tpu://{self._key(ep)}")
+        a2b, b2a = _MemPipe(), _MemPipe()
+        server_ep = lst.endpoint
+        client_ep = EndPoint("tpu", f"client-{id(a2b):x}", 0)
+        # requests land on the server's device; responses land on the
+        # client's reply device (the `reply_device` extra, default dev 0)
+        reply = ep.extra("reply_device")
+        client = TpuConn(rx=b2a, tx=a2b, local=client_ep, remote=ep,
+                         peer_device_ordinal=ep.device)
+        server = TpuConn(rx=a2b, tx=b2a, local=server_ep, remote=client_ep,
+                         peer_device_ordinal=int(reply) if reply else None)
+        client.peer = server
+        server.peer = client
+        lst.on_new_conn(server)
+        return client

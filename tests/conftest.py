@@ -6,9 +6,16 @@ SURVEY.md §4)."""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the env presets axon (real TPU)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the axon sitecustomize calls register() which programmatically sets
+# jax_platforms to "axon,cpu" — env vars lose; force it back before any
+# backend initializes
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
