@@ -1,0 +1,79 @@
+"""Benchmark: tpu:// loopback RPC bandwidth on 1MB device payloads.
+
+Mirrors the reference's headline 'max single-client throughput, large
+payloads' = 2.3 GB/s over 10GbE (docs/cn/benchmark.md:104, BASELINE.md).
+Ours moves 1MB tensors through the full RPC stack — channel -> tpu_std
+framing -> socket write queue -> device lane -> server fiber -> response —
+on the local TPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N/2.3}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+BASELINE_GBPS = 2.3  # reference max single-client large-payload throughput
+PAYLOAD_BYTES = 1 << 20
+WARMUP = 20
+ITERS = 200
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from brpc_tpu.rpc import Channel, ChannelOptions, Server, ServerOptions, Service
+
+    server = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("Bench")
+
+    @svc.method()
+    def Echo(cntl, request):
+        # device payload echoes back over the lane untouched (zero-copy)
+        cntl.response_device_arrays = cntl.request_device_arrays
+        return b""
+
+    server.add_service(svc)
+    ep = server.start("tpu://bench:1#device=0")
+    ch = Channel(str(ep), ChannelOptions(timeout_ms=30000))
+
+    n = PAYLOAD_BYTES // 4
+    payload = jax.block_until_ready(jnp.ones((n,), jnp.float32))
+
+    def one_call():
+        cntl = ch.call_sync("Bench", "Echo", b"",
+                            request_device_arrays=[payload])
+        if cntl.failed():
+            raise RuntimeError(f"bench call failed: {cntl.error_text}")
+        return cntl
+
+    for _ in range(WARMUP):
+        one_call()
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        one_call()
+    dt = time.perf_counter() - t0
+
+    # request + response both moved PAYLOAD_BYTES over the lane
+    gbytes = ITERS * PAYLOAD_BYTES * 2 / 1e9
+    gbps = gbytes / dt
+
+    server.stop()
+    server.join(2)
+    print(json.dumps({
+        "metric": "tpu_loopback_rpc_1mb_bandwidth",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+"""Collective lowering of RPC fan-out/streaming onto device meshes."""
+
+from brpc_tpu.parallel.mesh import (
+    REPLICA_AXIS, SHARD_AXIS, endpoint_for_coords, make_rpc_mesh, sharding,
+    shard_spec,
+)
+from brpc_tpu.parallel.collective import (
+    CollectiveChannel, all_to_all_reshard, replicated_call,
+)
+from brpc_tpu.parallel.ring import ring_allreduce, ring_scan, ring_shift
+
+__all__ = [
+    "REPLICA_AXIS", "SHARD_AXIS", "endpoint_for_coords", "make_rpc_mesh",
+    "sharding", "shard_spec",
+    "CollectiveChannel", "all_to_all_reshard", "replicated_call",
+    "ring_allreduce", "ring_scan", "ring_shift",
+]

@@ -1,0 +1,134 @@
+"""Collective lowering: the combo-channel shapes compiled onto the mesh.
+
+SURVEY.md §2.8's table, realized. When a ParallelChannel's sub-targets are
+the devices of one mesh, N point-to-point RPCs + a host merge is the wrong
+program for a TPU pod — the same dataflow is ONE SPMD computation whose
+fan-out/merge are XLA collectives riding ICI:
+
+  ParallelChannel fan-out + merge  -> scatter_gather(): shard_map of the
+      service fn over the 'shard' axis, merge lowered to psum/all_gather
+  Sharded addressing (Partition)   -> the in_spec partitioning itself
+  Replica selection (Selective)    -> 'replica' axis; replicated in_spec
+  Fan-in reduce (allreduce bench)  -> all_reduce()
+
+Everything here is jit-compiled once per shape and reused — the RPC-side
+analogue of the reference registering protocols once at GlobalInitialize.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from brpc_tpu.parallel.mesh import REPLICA_AXIS, SHARD_AXIS
+
+
+_MERGES = ("sum", "mean", "max", "min", "concat", "stack", "none")
+
+
+class CollectiveChannel:
+    """The ParallelChannel of a device mesh.
+
+    call(service_fn, request): request is sharded over the 'shard' axis,
+    service_fn runs per shard, responses merge on-device. service_fn must
+    be a jax-traceable function shard -> shard_response.
+    """
+
+    def __init__(self, mesh: Mesh, merge: str = "concat"):
+        if merge not in _MERGES:
+            raise ValueError(f"merge must be one of {_MERGES}")
+        self.mesh = mesh
+        self.merge = merge
+        self._compiled: Dict[Any, Callable] = {}
+
+    # ------------------------------------------------------------ lowering
+    def _lower(self, service_fn: Callable, merge: str) -> Callable:
+        mesh = self.mesh
+
+        def per_shard(x):
+            y = service_fn(x)
+            if merge == "sum":
+                return jax.lax.psum(y, SHARD_AXIS)
+            if merge == "mean":
+                return jax.lax.pmean(y, SHARD_AXIS)
+            if merge == "max":
+                return jax.lax.pmax(y, SHARD_AXIS)
+            if merge == "min":
+                return jax.lax.pmin(y, SHARD_AXIS)
+            return y  # concat/stack/none: stitching via out_specs
+
+        if merge in ("sum", "mean", "max", "min"):
+            out_spec = P()              # merged result replicated
+        elif merge == "none":
+            out_spec = P(SHARD_AXIS)    # leave sharded (response stays put)
+        else:                           # concat / stack
+            out_spec = P(SHARD_AXIS)
+        fn = jax.shard_map(per_shard, mesh=mesh, in_specs=P(SHARD_AXIS),
+                           out_specs=out_spec)
+        return jax.jit(fn)
+
+    def call(self, service_fn: Callable, request, merge: Optional[str] = None):
+        """One fan-out/merge over the shard axis. ``request``'s leading dim
+        is scattered across shards (it must divide by shard count)."""
+        merge = merge or self.merge
+        key = (id(service_fn), merge)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._lower(service_fn, merge)
+            self._compiled[key] = fn
+        return fn(request)
+
+    # ------------------------------------------------- common collectives
+    def all_reduce(self, x, op: str = "sum"):
+        return self.call(lambda s: s, x, merge=op)
+
+    def all_gather(self, x):
+        """Every shard sees the full request (fan-out broadcast side)."""
+        fn = jax.jit(jax.shard_map(
+            lambda s: jax.lax.all_gather(s, SHARD_AXIS, tiled=True),
+            mesh=self.mesh, in_specs=P(SHARD_AXIS), out_specs=P(),
+            check_vma=False))  # replication holds post-all_gather; not inferable
+        return fn(x)
+
+    def reduce_scatter(self, x):
+        fn = jax.jit(jax.shard_map(
+            lambda s: jax.lax.psum_scatter(s, SHARD_AXIS, tiled=True),
+            mesh=self.mesh, in_specs=P(None), out_specs=P(SHARD_AXIS)))
+        return fn(x)
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.shape[SHARD_AXIS]
+
+
+def all_to_all_reshard(mesh: Mesh, x, concat_axis: int, split_axis: int):
+    """Ulysses-style resharding: move the sharded dimension of ``x`` from
+    ``split_axis`` to ``concat_axis`` with one all-to-all over 'shard' —
+    e.g. [seq/N, heads] -> [seq, heads/N] for long-sequence attention.
+    The all-to-all is the sequence-parallel workhorse (SURVEY.md §5
+    long-context analog)."""
+
+    def per_shard(s):
+        return jax.lax.all_to_all(s, SHARD_AXIS, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
+
+    in_spec = [None] * x.ndim
+    in_spec[concat_axis] = SHARD_AXIS
+    out_spec = [None] * x.ndim
+    out_spec[split_axis] = SHARD_AXIS
+    fn = jax.shard_map(per_shard, mesh=mesh, in_specs=P(*in_spec),
+                       out_specs=P(*out_spec))
+    return jax.jit(fn)(x)
+
+
+def replicated_call(mesh: Mesh, service_fn: Callable, request):
+    """SelectiveChannel's degenerate mesh form: every replica holds the
+    full request; the caller reads any replica's response (they're
+    identical — replica choice becomes a scheduling detail, not a data
+    movement)."""
+    fn = jax.shard_map(service_fn, mesh=mesh, in_specs=P(), out_specs=P())
+    return jax.jit(fn)(request)

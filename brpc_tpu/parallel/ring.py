@@ -1,0 +1,108 @@
+"""Ring streaming: StreamingRPC lowered onto the ICI ring.
+
+SURVEY.md §2.8: "StreamingRPC over a ring of ICI links = ring-attention-
+style neighbor exchange". The shapes here:
+
+  ring_shift      — every shard hands its block to the next ring neighbor
+                    (one ppermute = one credit-window'd stream frame)
+  ring_allreduce  — the classic reduce-scatter + all-gather ring (2(N-1)
+                    neighbor exchanges, bandwidth-optimal on a torus)
+  ring_scan       — fori_loop of shifts with a per-step combine: the
+                    blockwise consumer pattern ring attention uses (each
+                    step consumes a neighbor block while the next is in
+                    flight, compute/comm overlapped by XLA)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from brpc_tpu.parallel.mesh import SHARD_AXIS
+
+
+def _ring_perm(n: int, step: int = 1):
+    return [(i, (i + step) % n) for i in range(n)]
+
+
+def ring_shift(mesh: Mesh, x, step: int = 1):
+    """Shift shard blocks around the ring by ``step`` positions."""
+    n = mesh.shape[SHARD_AXIS]
+
+    def per_shard(s):
+        return jax.lax.ppermute(s, SHARD_AXIS, perm=_ring_perm(n, step))
+
+    fn = jax.shard_map(per_shard, mesh=mesh, in_specs=P(SHARD_AXIS),
+                       out_specs=P(SHARD_AXIS))
+    return jax.jit(fn)(x)
+
+
+def ring_allreduce(mesh: Mesh, x):
+    """Bandwidth-optimal allreduce built from ppermute hops (what XLA's
+    psum lowers to on a ring; spelled out here as the streaming bench and
+    as the template for custom fused versions)."""
+    n = mesh.shape[SHARD_AXIS]
+    perm = _ring_perm(n, 1)
+
+    def per_shard(block):
+        # block: this shard's [n, chunk] stack of chunks
+        chunks = block  # [n, chunk]
+
+        def rs_step(i, st):
+            acc, send = st
+            recv = jax.lax.ppermute(send, SHARD_AXIS, perm=perm)
+            idx = jax.lax.axis_index(SHARD_AXIS)
+            # chunk each rank accumulates at step i of reduce-scatter
+            j = (idx - i - 1) % n
+            acc = acc.at[j].add(recv[j])
+            send = acc
+            return acc, send
+
+        acc, _ = jax.lax.fori_loop(0, n - 1, rs_step, (chunks, chunks))
+
+        def ag_step(i, st):
+            acc, send = st
+            recv = jax.lax.ppermute(send, SHARD_AXIS, perm=perm)
+            idx = jax.lax.axis_index(SHARD_AXIS)
+            j = (idx - i) % n
+            acc = acc.at[j].set(recv[j])
+            send = acc
+            return acc, send
+
+        acc, _ = jax.lax.fori_loop(0, n - 1, ag_step, (acc, acc))
+        return acc
+
+    # check_vma off: the carry flips between replicated and ring-varying
+    # across loop steps, which the static varying-axes checker can't type
+    fn = jax.shard_map(per_shard, mesh=mesh, in_specs=P(None),
+                       out_specs=P(None), check_vma=False)
+    # x: [n, chunk] replicated; result: allreduced [n, chunk] replicated
+    return jax.jit(fn)(x)
+
+
+def ring_scan(mesh: Mesh, x, combine: Callable, init=None):
+    """Blockwise ring consumption: each shard starts with its own block
+    and, over n steps, combines every other shard's block as it arrives
+    from the ring neighbor — the ring-attention dataflow
+    (combine(carry, block) -> carry)."""
+    n = mesh.shape[SHARD_AXIS]
+    perm = _ring_perm(n, 1)
+
+    def per_shard(block):
+        carry0 = combine(init, block) if init is not None else block
+
+        def step(i, st):
+            carry, inflight = st
+            recv = jax.lax.ppermute(inflight, SHARD_AXIS, perm=perm)
+            carry = combine(carry, recv)
+            return carry, recv
+
+        carry, _ = jax.lax.fori_loop(0, n - 1, step, (carry0, block))
+        return carry
+
+    fn = jax.shard_map(per_shard, mesh=mesh, in_specs=P(SHARD_AXIS),
+                       out_specs=P(SHARD_AXIS))
+    return jax.jit(fn)(x)

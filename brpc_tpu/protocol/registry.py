@@ -36,6 +36,13 @@ class Protocol:
     def process(self, msg, socket):
         raise NotImplementedError
 
+    def process_inline(self, msg, socket) -> bool:
+        """Order-critical cheap dispatch in parse order (stream frames:
+        enqueue to the per-stream ExecutionQueue and return True). The
+        InputMessenger calls this for every message before considering
+        fiber fan-out; returning False routes to process()."""
+        return False
+
 
 _protocols: List[Protocol] = []
 _lock = threading.Lock()

@@ -162,14 +162,26 @@ class TpuStdProtocol(Protocol):
 
     # -------------------------------------------------------------- process
     def process(self, msg: RpcMessage, socket):
-        # dispatch to server or client side, like ProcessRpcRequest /
-        # ProcessRpcResponse; imported lazily to keep layering acyclic
+        # dispatch to server/client/stream side, like ProcessRpcRequest /
+        # ProcessRpcResponse / the streaming_rpc policy; imported lazily to
+        # keep layering acyclic
         if msg.meta.HasField("request"):
             from brpc_tpu.rpc.server_dispatch import process_request
             return process_request(self, msg, socket)
         else:
+            # pure stream frames never reach here: process_inline consumes
+            # them in parse order
             from brpc_tpu.rpc.client_dispatch import process_response
             return process_response(self, msg, socket)
+
+    def process_inline(self, msg: RpcMessage, socket) -> bool:
+        meta = msg.meta
+        if (meta.HasField("stream_settings") and not meta.HasField("request")
+                and not meta.HasField("response") and not meta.correlation_id):
+            from brpc_tpu.rpc.stream import process_stream_frame
+            process_stream_frame(msg, socket)
+            return True
+        return False
 
 
 _instance: Optional[TpuStdProtocol] = None

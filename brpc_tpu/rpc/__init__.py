@@ -5,8 +5,19 @@ from brpc_tpu.rpc.controller import Controller
 from brpc_tpu.rpc.channel import Channel, ChannelOptions
 from brpc_tpu.rpc.server import Server, ServerOptions
 from brpc_tpu.rpc.service import Method, Service, service_from_object
+from brpc_tpu.rpc.cluster_channel import ClusterChannel
+from brpc_tpu.rpc.combo_channels import (
+    CallMapper, ParallelChannel, PartitionChannel, PartitionParser,
+    ResponseMerger, SelectiveChannel, SubCall,
+)
+from brpc_tpu.rpc.load_balancer import LoadBalancer, new_load_balancer
+from brpc_tpu.rpc.naming import NamingService, NamingServiceThread, register_naming_service
 
 __all__ = [
     "errno_codes", "Controller", "Channel", "ChannelOptions",
     "Server", "ServerOptions", "Method", "Service", "service_from_object",
+    "ClusterChannel", "CallMapper", "ParallelChannel", "PartitionChannel",
+    "PartitionParser", "ResponseMerger", "SelectiveChannel", "SubCall",
+    "LoadBalancer", "new_load_balancer",
+    "NamingService", "NamingServiceThread", "register_naming_service",
 ]

@@ -39,6 +39,30 @@ class ChannelOptions:
 
 
 
+def connect_dedup(lock, read_fn, write_fn, make_fn):
+    """Connect outside the lock, publish under it; exactly one winner per
+    slot, losers are discarded (shared by Channel and ClusterChannel)."""
+    cur = read_fn()
+    if cur is not None and not cur.failed:
+        return cur
+    new = make_fn()
+    with lock:
+        cur = read_fn()
+        if cur is not None and not cur.failed:
+            loser = new
+        else:
+            write_fn(new)
+            loser = None
+    if loser is not None:
+        loser.set_failed(ConnectionError("duplicate connect discarded"))
+        with lock:
+            cur = read_fn()
+        if cur is None or cur.failed:
+            raise ConnectionError("connection closed concurrently")
+        return cur
+    return new
+
+
 class Channel:
     def __init__(self, address: Optional[str | EndPoint] = None,
                  options: Optional[ChannelOptions] = None,
@@ -58,24 +82,16 @@ class Channel:
 
     # ---------------------------------------------------------- connection
     def _get_socket(self) -> Socket:
-        s = self._socket
-        if s is not None and not s.failed:
-            return s
-        # connect OUTSIDE the lock: a slow/blackholed peer must not stall
-        # every concurrent call on this channel
-        new = create_client_socket(
-            self._endpoint, on_input=self._messenger.on_new_messages,
-            control=self._control)
-        with self._socket_lock:
-            cur = self._socket
-            if cur is not None and not cur.failed:
-                loser = new  # raced with another connector; keep theirs
-            else:
-                self._socket, loser = new, None
-        if loser is not None:
-            loser.set_failed(ConnectionError("duplicate connect discarded"))
-            return self._socket
-        return new
+        def _make():
+            return create_client_socket(
+                self._endpoint, on_input=self._messenger.on_new_messages,
+                control=self._control)
+
+        def _write(s):
+            self._socket = s
+
+        return connect_dedup(self._socket_lock, lambda: self._socket,
+                             _write, _make)
 
     def close(self) -> None:
         """Release the connection; the channel may be re-used (it will
@@ -90,7 +106,7 @@ class Channel:
              cntl: Optional[Controller] = None,
              done: Optional[Callable[[Controller], None]] = None,
              request_device_arrays: Optional[List] = None,
-             response_class=None) -> Controller:
+             response_class=None, stream_options=None) -> Controller:
         """Begin an RPC; returns the Controller immediately. Wait with
         cntl.join() (thread) / await cntl.join_async() (fiber), or pass
         ``done`` for callback style — the async CallMethod triple."""
@@ -110,6 +126,10 @@ class Channel:
         cntl._service_name = service_name
         cntl._method_name = method_name
         cntl._request_bytes = serialize_payload(request)
+        if stream_options is not None:
+            # stream setup piggybacks on this RPC (StreamCreate)
+            from brpc_tpu.rpc.stream import Stream
+            cntl.stream = Stream(stream_options)
         cntl._register_call()
         self._issue_rpc(cntl)
         # deadline timer: final — no retry after it fires (HandleTimeout)
@@ -139,11 +159,16 @@ class Channel:
         return cntl
 
     # ------------------------------------------------------------ internals
+    def _pick_socket(self, cntl: Controller) -> Socket:
+        """Server/connection selection for one (re)issue; cluster channels
+        override this with LB selection (controller.cpp:1048-1135)."""
+        return self._get_socket()
+
     def _issue_rpc(self, cntl: Controller) -> None:
         """Pick socket, pack, enqueue (Controller::IssueRPC,
         controller.cpp:1010)."""
         try:
-            sock = self._get_socket()
+            sock = self._pick_socket(cntl)
         except (ConnectionError, OSError, ValueError) as e:
             self._maybe_retry(cntl, berr.EFAILEDSOCKET, str(e))
             return
@@ -162,6 +187,10 @@ class Channel:
         if cntl.trace_id:
             meta.trace_id = cntl.trace_id
             meta.span_id = cntl.span_id
+        stream = getattr(cntl, "stream", None)
+        if stream is not None:
+            meta.stream_settings.stream_id = stream.id
+            stream.socket = sock
         use_lane = (bool(cntl.request_device_arrays)
                     and sock.conn.supports_device_lane)
         wire, lane = pack_message(
@@ -183,11 +212,18 @@ class Channel:
             return  # already completed (response/timeout won)
         if cntl.current_try < cntl.max_retry:
             cntl.current_try += 1
+            # report the failed attempt before moving on (the final
+            # attempt is reported by the completion hook instead)
+            self._on_attempt_failed(cntl, code, text)
             self._issue_rpc(cntl)
             return
         if take_call(cntl.correlation_id) is cntl:
             cntl.set_failed(code, text)
             cntl._complete()
+
+    def _on_attempt_failed(self, cntl: Controller, code: int, text: str) -> None:
+        """Per-attempt failure hook for cluster channels (LB feedback +
+        circuit breaker on intermediate retries)."""
 
     def _on_timeout(self, cntl: Controller) -> None:
         if take_call(cntl.correlation_id) is cntl:

@@ -1,0 +1,94 @@
+"""CircuitBreaker: per-server EMA error-rate isolation
+(brpc/circuit_breaker.h:25-52) plus the cluster-wide revival gate
+(cluster_recover_policy.*): when too much of the cluster is isolated,
+stop isolating (otherwise a full outage can never recover).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+from brpc_tpu.butil.endpoint import EndPoint
+
+
+class CircuitBreaker:
+    """One per server endpoint. Two EMA windows like the reference: a
+    short twitchy one and a long stable one; tripping either isolates."""
+
+    SHORT_ALPHA = 0.3
+    LONG_ALPHA = 0.02
+    ERROR_THRESHOLD = 0.5      # short-window trip
+    LONG_THRESHOLD = 0.2       # long-window trip
+    MIN_SAMPLES = 5
+    BASE_ISOLATION_S = 0.1
+    MAX_ISOLATION_S = 30.0
+
+    def __init__(self):
+        self._short = 0.0
+        self._long = 0.0
+        self._samples = 0
+        self._isolated_until = 0.0
+        self._isolation_s = self.BASE_ISOLATION_S
+        self._lock = threading.Lock()
+
+    def on_call(self, failed: bool) -> None:
+        x = 1.0 if failed else 0.0
+        with self._lock:
+            self._samples += 1
+            self._short = (1 - self.SHORT_ALPHA) * self._short + self.SHORT_ALPHA * x
+            self._long = (1 - self.LONG_ALPHA) * self._long + self.LONG_ALPHA * x
+            if self._samples >= self.MIN_SAMPLES and (
+                    self._short > self.ERROR_THRESHOLD
+                    or self._long > self.LONG_THRESHOLD):
+                # isolate with exponential backoff on repeat trips
+                now = time.monotonic()
+                if now >= self._isolated_until:
+                    self._isolated_until = now + self._isolation_s
+                    self._isolation_s = min(self._isolation_s * 2,
+                                            self.MAX_ISOLATION_S)
+                self._short = 0.0
+                self._samples = 0
+
+    def on_success_streak(self) -> None:
+        """Reward sustained health: shrink the next isolation."""
+        with self._lock:
+            self._isolation_s = max(self.BASE_ISOLATION_S,
+                                    self._isolation_s / 2)
+
+    def isolated(self) -> bool:
+        return time.monotonic() < self._isolated_until
+
+    def error_rate(self) -> float:
+        return self._short
+
+
+class ClusterBreakers:
+    """Breaker per endpoint + the recovery gate
+    (ClusterRecoverPolicy: if >= half the cluster is isolated, ignore
+    isolation so revival traffic can flow)."""
+
+    RECOVER_FRACTION = 0.5
+
+    def __init__(self):
+        self._breakers: Dict[EndPoint, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+
+    def breaker(self, ep: EndPoint) -> CircuitBreaker:
+        b = self._breakers.get(ep)
+        if b is None:
+            with self._lock:
+                b = self._breakers.setdefault(ep, CircuitBreaker())
+        return b
+
+    def on_call(self, ep: EndPoint, failed: bool) -> None:
+        self.breaker(ep).on_call(failed)
+
+    def isolated_set(self, servers) -> set:
+        """Endpoints to exclude, honoring the cluster recover gate."""
+        iso = {s for s in servers
+               if s in self._breakers and self._breakers[s].isolated()}
+        if servers and len(iso) >= max(1, int(len(servers) * self.RECOVER_FRACTION)):
+            return set()  # too many down: let traffic probe everything
+        return iso

@@ -16,6 +16,7 @@ def process_response(proto, msg: RpcMessage, socket) -> None:
     if msg.meta.HasField("response") and msg.meta.response.error_code != 0:
         cntl.set_failed(msg.meta.response.error_code,
                         msg.meta.response.error_text)
+        # (a piggybacked stream is closed by cntl._complete on failure)
     else:
         cntl.response_payload = msg.payload
         if cntl.response_msg is not None:
@@ -23,6 +24,11 @@ def process_response(proto, msg: RpcMessage, socket) -> None:
                 cntl.response_msg.ParseFromString(msg.payload.to_bytes())
             except Exception as e:
                 cntl.set_failed(berr.ERESPONSE, f"cannot parse response: {e}")
+        stream = getattr(cntl, "stream", None)
+        if stream is not None and msg.meta.HasField("stream_settings"):
+            stream.peer_id = msg.meta.stream_settings.stream_id
+            stream.socket = socket
+            stream._on_established()
         if msg.meta.device_payloads:
             inline = unpack_inline_device_arrays(msg)
             lane_iter = iter(msg.device_arrays)

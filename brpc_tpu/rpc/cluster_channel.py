@@ -1,0 +1,111 @@
+"""ClusterChannel: Channel over a naming service + load balancer
+(Channel::Init(ns_url, lb_name) + details/load_balancer_with_naming.*).
+
+Per (re)issue: excluded = circuit-breaker-isolated + already-tried (retry
+goes elsewhere); the LB picks; completion feeds latency back to the LB and
+the breaker. Failed endpoints enter the health checker, which probes them
+with backoff and revives them (details/health_check.cpp:59-146).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.fiber import TaskControl
+from brpc_tpu.rpc import errno_codes as berr
+from brpc_tpu.rpc.channel import Channel, ChannelOptions
+from brpc_tpu.rpc.circuit_breaker import ClusterBreakers
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.health_check import HealthChecker
+from brpc_tpu.rpc.load_balancer import LoadBalancer, new_load_balancer
+from brpc_tpu.rpc.naming import NamingServiceThread
+from brpc_tpu.transport.socket import Socket, create_client_socket
+
+
+class ClusterChannel(Channel):
+    def __init__(self, naming_url: str, load_balancer: str | LoadBalancer = "rr",
+                 options: Optional[ChannelOptions] = None,
+                 control: Optional[TaskControl] = None):
+        super().__init__(address=None, options=options, control=control)
+        self._lb = (load_balancer if isinstance(load_balancer, LoadBalancer)
+                    else new_load_balancer(load_balancer))
+        self._breakers = ClusterBreakers()
+        self._sockets: Dict[EndPoint, Socket] = {}
+        self._sockets_lock = threading.Lock()
+        self._servers: list = []
+        self._health = HealthChecker(control=self._control)
+        self._ns = NamingServiceThread(naming_url, control=self._control)
+        self._ns.watch(self._on_servers)
+        self._ns.wait_first_update(5.0)
+
+    # ------------------------------------------------------------- naming
+    def _on_servers(self, servers):
+        self._servers = servers
+        self._lb.reset_servers(servers)
+        self._health.retain(servers)
+
+    def servers(self):
+        return list(self._servers)
+
+    # ----------------------------------------------------------- selection
+    def _pick_socket(self, cntl: Controller) -> Socket:
+        exclude = set(cntl.tried_servers)
+        exclude |= self._breakers.isolated_set(self._servers)
+        exclude |= self._health.dead_set()
+        key = getattr(cntl, "request_key", None)
+        ep = self._lb.select_server(exclude or None, request_key=key)
+        if ep is None:
+            # every server excluded: last resort, try anyone the LB knows
+            ep = self._lb.select_server(None, request_key=key)
+        if ep is None:
+            raise ConnectionError("no server available")
+        cntl.tried_servers.append(ep)
+        if cntl._complete_hook is None:
+            cntl._complete_hook = self._on_call_complete
+        return self._socket_for(ep)
+
+    def _socket_for(self, ep: EndPoint) -> Socket:
+        from brpc_tpu.rpc.channel import connect_dedup
+
+        def _make():
+            s = create_client_socket(ep, on_input=self._messenger.on_new_messages,
+                                     control=self._control)
+            s.on_failed(lambda sock, ep=ep: self._on_socket_failed(ep))
+            return s
+
+        def _write(s):
+            self._sockets[ep] = s
+
+        return connect_dedup(self._sockets_lock,
+                             lambda: self._sockets.get(ep), _write, _make)
+
+    def _on_socket_failed(self, ep: EndPoint):
+        self._health.mark_dead(ep)
+
+    # ------------------------------------------------------------ feedback
+    def _on_attempt_failed(self, cntl: Controller, code: int, text: str):
+        """Intermediate retry attempts: the failed server must hear about
+        it (else it never isolates while retries keep saving the call)."""
+        if cntl.tried_servers:
+            ep = cntl.tried_servers[-1]
+            self._lb.feedback(ep, cntl.latency_us(), True)
+            self._breakers.on_call(ep, failed=True)
+
+    def _on_call_complete(self, cntl: Controller):
+        if not cntl.tried_servers:
+            return
+        ep = cntl.tried_servers[-1]
+        failed = cntl.failed() and cntl.error_code != berr.ERPCTIMEDOUT
+        self._lb.feedback(ep, cntl.latency_us(), cntl.failed())
+        self._breakers.on_call(ep, failed)
+
+    def close(self):
+        self._ns.stop()
+        self._health.stop()
+        with self._sockets_lock:
+            sockets, self._sockets = dict(self._sockets), {}
+        for s in sockets.values():
+            if not s.failed:
+                s.set_failed(ConnectionError("channel closed"))

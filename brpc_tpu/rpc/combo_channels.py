@@ -1,0 +1,247 @@
+"""Combo channels (SURVEY.md §2.6):
+
+  ParallelChannel  — one call fans out to N sub-channels, responses merge
+                     (parallel_channel.h: CallMapper :94, ResponseMerger
+                     :127, fail_limit :168).
+  SelectiveChannel — LB over heterogeneous sub-channels with
+                     retry-elsewhere (selective_channel.h:52).
+  PartitionChannel — shard fan-out by partition index; each partition is
+                     its own server group (partition_channel.h:46-136).
+
+These are host-side fan-outs over arbitrary transports. When every
+sub-target is a device on one mesh, prefer parallel/collective.py which
+lowers the same shape onto XLA collectives instead of N point-to-point
+calls.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+from brpc_tpu.rpc import errno_codes as berr
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.load_balancer import LoadBalancer, new_load_balancer
+from brpc_tpu.butil.endpoint import EndPoint
+
+
+class SubCall:
+    """What a CallMapper returns for one sub-channel."""
+
+    __slots__ = ("service", "method", "request", "device_arrays", "skip")
+
+    def __init__(self, service: str, method: str, request: Any,
+                 device_arrays: Optional[List] = None, skip: bool = False):
+        self.service = service
+        self.method = method
+        self.request = request
+        self.device_arrays = device_arrays
+        self.skip = skip
+
+    @classmethod
+    def skipped(cls) -> "SubCall":
+        return cls("", "", b"", skip=True)
+
+
+class CallMapper:
+    """Maps the logical call onto sub-channel i (parallel_channel.h:94)."""
+
+    def map(self, sub_index: int, nsub: int, service: str, method: str,
+            request: Any, cntl: Controller) -> SubCall:
+        return SubCall(service, method, request,
+                       device_arrays=cntl.request_device_arrays or None)
+
+
+class ResponseMerger:
+    """Folds one finished sub-call into the final controller
+    (parallel_channel.h:127). Default: collect payload bytes in order."""
+
+    def merge(self, final_cntl: Controller, sub_index: int,
+              sub_cntl: Controller) -> None:
+        final_cntl.sub_responses[sub_index] = (
+            sub_cntl.response_payload.to_bytes()
+            if sub_cntl.response_payload is not None else None)
+        if sub_cntl.response_device_arrays:
+            final_cntl.sub_device_arrays[sub_index] = \
+                sub_cntl.response_device_arrays
+
+
+class ParallelChannel:
+    def __init__(self, fail_limit: Optional[int] = None,
+                 call_mapper: Optional[CallMapper] = None,
+                 response_merger: Optional[ResponseMerger] = None):
+        self._subs: List[Channel] = []
+        self.fail_limit = fail_limit
+        self.call_mapper = call_mapper or CallMapper()
+        self.response_merger = response_merger or ResponseMerger()
+
+    def add_sub_channel(self, ch: Channel) -> None:
+        self._subs.append(ch)
+
+    @property
+    def sub_channel_count(self) -> int:
+        return len(self._subs)
+
+    def call(self, service: str, method: str, request: Any = b"",
+             cntl: Optional[Controller] = None,
+             done: Optional[Callable] = None, **kw) -> Controller:
+        cntl = cntl or Controller()
+        cntl._done_cb = done
+        nsub = len(self._subs)
+        cntl.sub_responses = [None] * nsub
+        cntl.sub_device_arrays = [None] * nsub
+        cntl.sub_errors = [None] * nsub
+        if nsub == 0:
+            cntl.set_failed(berr.EINTERNAL, "no sub channels")
+            cntl._complete()
+            return cntl
+        fail_limit = (self.fail_limit if self.fail_limit is not None else nsub)
+        state = {"pending": 0, "failed": 0, "done": False}
+        lock = threading.Lock()
+        sub_calls = []
+        for i, sub in enumerate(self._subs):
+            sc = self.call_mapper.map(i, nsub, service, method, request, cntl)
+            if sc is None or sc.skip:
+                continue
+            sub_calls.append((i, sub, sc))
+        if not sub_calls:
+            cntl.set_failed(berr.EREQUEST, "call mapper skipped every sub call")
+            cntl._complete()
+            return cntl
+        state["pending"] = len(sub_calls)
+
+        def on_sub_done(i):
+            def _cb(sub_cntl):
+                finish = False
+                with lock:
+                    if state["done"]:
+                        return
+                    if sub_cntl.failed():
+                        state["failed"] += 1
+                        cntl.sub_errors[i] = (sub_cntl.error_code,
+                                              sub_cntl.error_text)
+                    state["pending"] -= 1
+                    if state["failed"] >= fail_limit or state["pending"] == 0:
+                        state["done"] = True
+                        finish = True
+                if not sub_cntl.failed():
+                    try:
+                        self.response_merger.merge(cntl, i, sub_cntl)
+                    except Exception as e:
+                        with lock:
+                            state["failed"] += 1
+                        cntl.sub_errors[i] = (berr.ERESPONSE,
+                                              f"merger failed: {e}")
+                if finish:
+                    if state["failed"] >= fail_limit:
+                        cntl.set_failed(
+                            berr.ETOOMANYFAILS,
+                            f"{state['failed']}/{len(sub_calls)} sub calls failed")
+                    cntl._complete()
+            return _cb
+
+        for i, sub, sc in sub_calls:
+            sub.call(sc.service, sc.method, sc.request,
+                     done=on_sub_done(i),
+                     request_device_arrays=sc.device_arrays, **kw)
+        return cntl
+
+    def call_sync(self, service, method, request=b"", timeout_s: float = 30.0,
+                  **kw) -> Controller:
+        cntl = self.call(service, method, request, **kw)
+        cntl.join(timeout_s)
+        return cntl
+
+
+class SelectiveChannel:
+    """Pick ONE healthy sub-channel per call; retries go to a different
+    one (selective_channel.h:52)."""
+
+    def __init__(self, load_balancer: str | LoadBalancer = "rr",
+                 max_retry: int = 2):
+        self._subs: List[Channel] = []
+        self._lb = (load_balancer if isinstance(load_balancer, LoadBalancer)
+                    else new_load_balancer(load_balancer))
+        self.max_retry = max_retry
+
+    def add_sub_channel(self, ch: Channel) -> None:
+        self._subs.append(ch)
+        # the LB keys sub-channels by synthetic endpoints (index as host)
+        self._lb.reset_servers(
+            tuple(EndPoint("sub", str(i), 0) for i in range(len(self._subs))))
+
+    def call(self, service: str, method: str, request: Any = b"",
+             cntl: Optional[Controller] = None,
+             done: Optional[Callable] = None, **kw) -> Controller:
+        cntl = cntl or Controller()
+        cntl._done_cb = done
+        tried: set = set()
+        outer = self
+
+        def attempt(tries_left: int):
+            ep = outer._lb.select_server(tried or None)
+            if ep is None:
+                cntl.set_failed(berr.ETOOMANYFAILS, "no sub channel left")
+                cntl._complete()
+                return
+            tried.add(ep)
+            sub = outer._subs[int(ep.host)]
+
+            def _cb(sub_cntl):
+                outer._lb.feedback(ep, sub_cntl.latency_us(), sub_cntl.failed())
+                if sub_cntl.failed() and tries_left > 0:
+                    attempt(tries_left - 1)
+                    return
+                cntl.error_code = sub_cntl.error_code
+                cntl.error_text = sub_cntl.error_text
+                cntl.response_payload = sub_cntl.response_payload
+                cntl.response_device_arrays = sub_cntl.response_device_arrays
+                cntl.response_attachment = sub_cntl.response_attachment
+                cntl._complete()
+
+            sub.call(service, method, request, done=_cb, **kw)
+
+        attempt(self.max_retry)
+        return cntl
+
+    def call_sync(self, service, method, request=b"", timeout_s: float = 30.0,
+                  **kw) -> Controller:
+        cntl = self.call(service, method, request, **kw)
+        cntl.join(timeout_s)
+        return cntl
+
+
+class PartitionParser:
+    """Splits a logical request into per-partition requests
+    (partition_channel.h:46)."""
+
+    def parse(self, partition_index: int, num_partitions: int, service: str,
+              method: str, request: Any, cntl: Controller) -> SubCall:
+        return SubCall(service, method, request)
+
+
+class PartitionChannel(ParallelChannel):
+    """Fan out one call to all partitions of a sharded service; partition
+    i's servers come from sub-channel i (partition_channel.h:75)."""
+
+    def __init__(self, partition_parser: Optional[PartitionParser] = None,
+                 fail_limit: Optional[int] = 1,
+                 response_merger: Optional[ResponseMerger] = None):
+        parser = partition_parser or PartitionParser()
+        outer_self = self
+
+        class _Mapper(CallMapper):
+            def map(self, i, nsub, service, method, request, cntl):
+                return parser.parse(i, nsub, service, method, request, cntl)
+
+        super().__init__(fail_limit=fail_limit, call_mapper=_Mapper(),
+                         response_merger=response_merger)
+        self.partition_parser = parser
+
+    def add_partition(self, ch: Channel) -> None:
+        self.add_sub_channel(ch)
+
+    @property
+    def partition_count(self) -> int:
+        return self.sub_channel_count

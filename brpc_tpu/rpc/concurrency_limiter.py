@@ -1,0 +1,112 @@
+"""Concurrency limiters (brpc/concurrency_limiter.h:29;
+policy/auto_concurrency_limiter.cpp).
+
+``constant``: fixed max in-flight. ``auto``: gradient/Vegas-style — track
+the best observed latency; if current latency inflates, shrink the limit,
+else grow it (the reference's AutoConcurrencyLimiter in miniature).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class ConcurrencyLimiter:
+    def on_requested(self) -> bool:
+        """False = reject with ELIMIT."""
+        raise NotImplementedError
+
+    def on_responded(self, latency_us: float, failed: bool) -> None:
+        raise NotImplementedError
+
+    @property
+    def max_concurrency(self) -> int:
+        raise NotImplementedError
+
+
+class ConstantLimiter(ConcurrencyLimiter):
+    def __init__(self, limit: int):
+        self._limit = limit
+        self._inflight = 0
+        self._lock = threading.Lock()
+
+    def on_requested(self) -> bool:
+        with self._lock:
+            if self._inflight >= self._limit:
+                return False
+            self._inflight += 1
+            return True
+
+    def on_responded(self, latency_us, failed):
+        with self._lock:
+            self._inflight -= 1
+
+    @property
+    def max_concurrency(self):
+        return self._limit
+
+
+class AutoLimiter(ConcurrencyLimiter):
+    MIN_LIMIT = 4
+    MAX_LIMIT = 4096
+    SAMPLE_WINDOW = 100
+    INFLATE_TOLERANCE = 1.5     # latency may inflate this much before shrink
+    GROW = 1.1
+    SHRINK = 0.8
+
+    def __init__(self, initial: int = 32):
+        self._limit = float(initial)
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self._best_latency = float("inf")
+        self._lat_sum = 0.0
+        self._lat_n = 0
+
+    def on_requested(self) -> bool:
+        with self._lock:
+            if self._inflight >= int(self._limit):
+                return False
+            self._inflight += 1
+            return True
+
+    def on_responded(self, latency_us, failed):
+        with self._lock:
+            self._inflight -= 1
+            if failed:
+                return
+            self._lat_sum += latency_us
+            self._lat_n += 1
+            if self._lat_n < self.SAMPLE_WINDOW:
+                return
+            avg = self._lat_sum / self._lat_n
+            self._lat_sum = 0.0
+            self._lat_n = 0
+            self._best_latency = min(self._best_latency, avg)
+            if avg > self._best_latency * self.INFLATE_TOLERANCE:
+                self._limit = max(self.MIN_LIMIT, self._limit * self.SHRINK)
+                # forgive the past: latency regimes change
+                self._best_latency = min(avg, self._best_latency * 1.1)
+            else:
+                self._limit = min(self.MAX_LIMIT, self._limit * self.GROW)
+
+    @property
+    def max_concurrency(self):
+        return int(self._limit)
+
+
+def new_limiter(spec) -> Optional[ConcurrencyLimiter]:
+    """spec: None | int | 'constant:N' | 'auto' (AdaptiveMaxConcurrency)."""
+    if spec is None:
+        return None
+    if isinstance(spec, int):
+        return ConstantLimiter(spec)
+    if isinstance(spec, str):
+        if spec == "auto":
+            return AutoLimiter()
+        if spec.startswith("constant:"):
+            return ConstantLimiter(int(spec.split(":", 1)[1]))
+        if spec.isdigit():
+            return ConstantLimiter(int(spec))
+    raise ValueError(f"bad concurrency limiter spec {spec!r}")

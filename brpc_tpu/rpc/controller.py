@@ -72,6 +72,10 @@ class Controller:
         self.start_us: int = 0
         self.end_us: int = 0
         self.used_backup: bool = False
+        # cluster bookkeeping: endpoints tried (for retry-elsewhere) and a
+        # completion hook (LB feedback / circuit breaker)
+        self.tried_servers: list = []
+        self._complete_hook: Optional[Callable[["Controller"], None]] = None
         # ---- client call internals (set by Channel.call)
         self._service_name: str = ""
         self._method_name: str = ""
@@ -106,6 +110,19 @@ class Controller:
         for tid in self._timer_ids:
             global_timer().unschedule(tid)
         self._timer_ids.clear()
+        if self.failed():
+            # a stream piggybacked on a failed call must not leak in the
+            # global stream pool (timeout/socket-failure completion paths
+            # never reach client_dispatch)
+            stream = getattr(self, "stream", None)
+            if stream is not None:
+                stream.close()
+        hook = self._complete_hook
+        if hook is not None:
+            try:
+                hook(self)
+            except Exception:
+                pass
         cb = self._done_cb
         self._done_event.set()
         if cb is not None:

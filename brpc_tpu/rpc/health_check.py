@@ -1,0 +1,67 @@
+"""Health checking: failed endpoints are probed with exponential backoff
+until a connect succeeds, then revived (details/health_check.cpp:146 —
+there a failed Socket enters a periodic HealthCheckTask; revival restores
+it to the LB)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Set
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.fiber import TaskControl, global_control, sleep
+from brpc_tpu.transport.base import get_transport
+
+
+class HealthChecker:
+    BASE_BACKOFF_S = 0.05
+    MAX_BACKOFF_S = 5.0
+
+    def __init__(self, control: Optional[TaskControl] = None):
+        self._control = control or global_control()
+        self._dead: Set[EndPoint] = set()
+        self._checking: Set[EndPoint] = set()
+        self._lock = threading.Lock()
+        self._stopped = False
+
+    def dead_set(self) -> Set[EndPoint]:
+        with self._lock:
+            return set(self._dead)
+
+    def mark_dead(self, ep: EndPoint) -> None:
+        with self._lock:
+            if self._stopped or ep in self._checking:
+                if ep in self._checking:
+                    self._dead.add(ep)
+                return
+            self._dead.add(ep)
+            self._checking.add(ep)
+        self._control.spawn(self._check_loop, ep, name=f"health_{ep.host}")
+
+    def retain(self, servers) -> None:
+        """Forget endpoints no longer in the naming list."""
+        keep = set(servers)
+        with self._lock:
+            self._dead &= keep
+
+    async def _check_loop(self, ep: EndPoint):
+        backoff = self.BASE_BACKOFF_S
+        while not self._stopped:
+            with self._lock:
+                if ep not in self._dead:
+                    break  # dropped from naming or already revived
+            await sleep(backoff)
+            try:
+                conn = get_transport(ep.scheme).connect(ep)
+                conn.close()
+            except Exception:
+                backoff = min(backoff * 2, self.MAX_BACKOFF_S)
+                continue
+            with self._lock:
+                self._dead.discard(ep)
+            break
+        with self._lock:
+            self._checking.discard(ep)
+
+    def stop(self):
+        self._stopped = True

@@ -1,0 +1,195 @@
+"""Load balancers (brpc/load_balancer.h:35; impls in brpc/policy/).
+
+Server lists live in a DoublyBufferedData snapshot so selection is
+lock-free, exactly as the reference keeps them. ``select_server`` takes an
+exclusion set (failed/tried servers for retries) and returns an EndPoint;
+``feedback`` reports call latency/errors for adaptive balancers.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from brpc_tpu.butil.doubly_buffered import DoublyBufferedData
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.butil.fast_rand import fast_rand_less_than
+
+
+class LoadBalancer:
+    def reset_servers(self, servers: Sequence[EndPoint]) -> None:
+        raise NotImplementedError
+
+    def select_server(self, exclude: Optional[set] = None,
+                      request_key: Optional[bytes] = None) -> Optional[EndPoint]:
+        raise NotImplementedError
+
+    def feedback(self, server: EndPoint, latency_us: float, failed: bool) -> None:
+        pass
+
+
+class _SnapshotLB(LoadBalancer):
+    def __init__(self):
+        self._servers: DoublyBufferedData = DoublyBufferedData(tuple())
+
+    def reset_servers(self, servers):
+        snapshot = tuple(servers)
+        self._servers.modify(lambda _: snapshot)
+        self._on_reset(snapshot)
+
+    def _on_reset(self, snapshot):
+        pass
+
+    def _alive(self, exclude):
+        servers = self._servers.read()
+        if not exclude:
+            return servers
+        return tuple(s for s in servers if s not in exclude)
+
+
+class RoundRobinLB(_SnapshotLB):
+    name = "rr"
+
+    def __init__(self):
+        super().__init__()
+        self._idx = 0
+        self._lock = threading.Lock()
+
+    def select_server(self, exclude=None, request_key=None):
+        servers = self._alive(exclude)
+        if not servers:
+            return None
+        with self._lock:
+            self._idx = (self._idx + 1) % len(servers)
+            return servers[self._idx]
+
+
+class RandomLB(_SnapshotLB):
+    name = "random"
+
+    def select_server(self, exclude=None, request_key=None):
+        servers = self._alive(exclude)
+        if not servers:
+            return None
+        return servers[fast_rand_less_than(len(servers))]
+
+
+class WeightedRoundRobinLB(_SnapshotLB):
+    """wrr — weight from endpoint extra 'w' (default 1)."""
+
+    name = "wrr"
+
+    def __init__(self):
+        super().__init__()
+        self._expanded: Tuple[EndPoint, ...] = ()
+        self._idx = 0
+        self._lock = threading.Lock()
+
+    def _on_reset(self, snapshot):
+        out: List[EndPoint] = []
+        for s in snapshot:
+            w = int(s.extra("w", "1") or "1")
+            out.extend([s] * max(1, w))
+        self._expanded = tuple(out)
+
+    def select_server(self, exclude=None, request_key=None):
+        servers = self._expanded
+        if exclude:
+            servers = tuple(s for s in servers if s not in exclude)
+        if not servers:
+            return None
+        with self._lock:
+            self._idx = (self._idx + 1) % len(servers)
+            return servers[self._idx]
+
+
+class ConsistentHashLB(_SnapshotLB):
+    """c_murmurhash-style ketama ring (policy/hasher.cpp) — 100 virtual
+    nodes per server; request_key picks the ring position."""
+
+    name = "c_hash"
+    VIRTUAL_NODES = 100
+
+    def __init__(self):
+        super().__init__()
+        self._ring: List[Tuple[int, EndPoint]] = []
+
+    def _on_reset(self, snapshot):
+        ring = []
+        for s in snapshot:
+            for v in range(self.VIRTUAL_NODES):
+                h = int.from_bytes(
+                    hashlib.md5(f"{s}#{v}".encode()).digest()[:8], "big")
+                ring.append((h, s))
+        ring.sort(key=lambda t: t[0])
+        self._ring = ring
+
+    def select_server(self, exclude=None, request_key=None):
+        ring = self._ring
+        if not ring:
+            return None
+        key = request_key or b""
+        h = int.from_bytes(hashlib.md5(key).digest()[:8], "big")
+        idx = bisect.bisect_left(ring, (h, ))
+        n = len(ring)
+        for i in range(n):
+            _, s = ring[(idx + i) % n]
+            if not exclude or s not in exclude:
+                return s
+        return None
+
+
+class LocalityAwareLB(_SnapshotLB):
+    """la — latency-weighted pick (policy/locality_aware_load_balancer.cpp
+    simplified): weight ~ 1/EMA(latency); errors decay weight sharply."""
+
+    name = "la"
+    ALPHA = 0.2
+
+    def __init__(self):
+        super().__init__()
+        self._lat: Dict[EndPoint, float] = {}
+        self._lock = threading.Lock()
+
+    def feedback(self, server, latency_us, failed):
+        with self._lock:
+            cur = self._lat.get(server, 1000.0)
+            sample = latency_us if not failed else max(cur * 10, 1e6)
+            self._lat[server] = (1 - self.ALPHA) * cur + self.ALPHA * sample
+
+    def select_server(self, exclude=None, request_key=None):
+        servers = self._alive(exclude)
+        if not servers:
+            return None
+        with self._lock:
+            weights = [1.0 / max(self._lat.get(s, 1000.0), 1.0) for s in servers]
+        total = sum(weights)
+        r = (fast_rand_less_than(1 << 30) / float(1 << 30)) * total
+        acc = 0.0
+        for s, w in zip(servers, weights):
+            acc += w
+            if r <= acc:
+                return s
+        return servers[-1]
+
+
+_factories = {
+    "rr": RoundRobinLB,
+    "random": RandomLB,
+    "wrr": WeightedRoundRobinLB,
+    "c_hash": ConsistentHashLB,
+    "la": LocalityAwareLB,
+}
+
+
+def new_load_balancer(name: str) -> LoadBalancer:
+    cls = _factories.get(name)
+    if cls is None:
+        raise ValueError(f"unknown load balancer {name!r}")
+    return cls()
+
+
+def register_load_balancer(name: str, factory) -> None:
+    _factories[name] = factory

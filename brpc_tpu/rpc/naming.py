@@ -1,0 +1,165 @@
+"""Naming services: url -> live server list (brpc/naming_service.h:36,
+SURVEY.md §2.6).
+
+A NamingService runs in its own fiber (details/naming_service_thread.*)
+and pushes full server lists through actions.reset_servers(). Builtins:
+
+  list://ep1,ep2,...      static list (test/brpc_naming_service style)
+  file://path             one endpoint per line, re-read periodically
+  dns://host:port         resolved via socket.getaddrinfo
+  mesh://                 one endpoint per local JAX device — the pod
+                          fabric enumerated as servers (the `mesh://` NS
+                          from SURVEY.md §7 stage 7); multi-host expands
+                          via jax.process_count/device coords
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+from brpc_tpu.fiber import TaskControl, global_control, sleep
+
+
+class NamingServiceActions:
+    """Receives server-list updates (NamingServiceActions::ResetServers)."""
+
+    def reset_servers(self, servers: List[EndPoint]) -> None:
+        raise NotImplementedError
+
+
+class NamingService:
+    def run(self, param: str, actions: NamingServiceActions, stop_event) -> None:
+        """Async or sync; loops until stop_event.is_set()."""
+        raise NotImplementedError
+
+
+class StaticNamingService(NamingService):
+    """list:// — servers fixed at init."""
+
+    async def run(self, param, actions, stop_event):
+        eps = [str2endpoint(p.strip()) for p in param.split(",") if p.strip()]
+        actions.reset_servers(eps)
+
+
+class FileNamingService(NamingService):
+    interval_s = 1.0
+
+    async def run(self, param, actions, stop_event):
+        last = None
+        while not stop_event.is_set():
+            try:
+                with open(param) as f:
+                    lines = [l.strip() for l in f if l.strip()
+                             and not l.startswith("#")]
+            except OSError:
+                lines = []
+            if lines != last:
+                last = lines
+                actions.reset_servers([str2endpoint(l) for l in lines])
+            await sleep(self.interval_s)
+
+
+class DnsNamingService(NamingService):
+    interval_s = 5.0
+
+    async def run(self, param, actions, stop_event):
+        import socket as pysocket
+        ep = str2endpoint(param, default_scheme="tcp")
+        last = None
+        while not stop_event.is_set():
+            try:
+                infos = pysocket.getaddrinfo(ep.host, ep.port,
+                                             pysocket.AF_INET,
+                                             pysocket.SOCK_STREAM)
+                ips = sorted({i[4][0] for i in infos})
+            except OSError:
+                ips = []
+            if ips != last:
+                last = ips
+                actions.reset_servers(
+                    [EndPoint("tcp", ip, ep.port) for ip in ips])
+            await sleep(self.interval_s)
+
+
+class MeshNamingService(NamingService):
+    """mesh:// — every local JAX device is a server endpoint; the param is
+    the base name the per-device tpu:// listeners were started under,
+    e.g. mesh://podsvc:9000 -> tpu://podsvc:9000#device=K for each K."""
+
+    async def run(self, param, actions, stop_event):
+        import jax
+        base = str2endpoint(param, default_scheme="tpu")
+        eps = [EndPoint("tpu", base.host, base.port).with_extras(device=d.id)
+               for d in jax.devices()]
+        actions.reset_servers(eps)
+
+
+_registry: Dict[str, NamingService] = {}
+
+
+def register_naming_service(scheme: str, ns: NamingService) -> None:
+    _registry[scheme] = ns
+
+
+def get_naming_service(scheme: str) -> NamingService:
+    if not _registry:
+        _registry.update({
+            "list": StaticNamingService(),
+            "file": FileNamingService(),
+            "dns": DnsNamingService(),
+            "mesh": MeshNamingService(),
+        })
+    ns = _registry.get(scheme)
+    if ns is None:
+        raise ValueError(f"no naming service for scheme {scheme!r}")
+    return ns
+
+
+class NamingServiceThread:
+    """Runs one naming service in a fiber and fans updates out to
+    watchers (details/naming_service_thread.{h,cpp})."""
+
+    def __init__(self, url: str, control: Optional[TaskControl] = None):
+        scheme, _, param = url.partition("://")
+        self._ns = get_naming_service(scheme)
+        self._param = param
+        self._control = control or global_control()
+        self._watchers: List[Callable[[List[EndPoint]], None]] = []
+        self._servers: List[EndPoint] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._first_update = threading.Event()
+
+        outer = self
+
+        class _Actions(NamingServiceActions):
+            def reset_servers(self, servers):
+                with outer._lock:
+                    outer._servers = list(servers)
+                    watchers = list(outer._watchers)
+                outer._first_update.set()
+                for w in watchers:
+                    w(list(servers))
+
+        self._fiber = self._control.spawn(
+            self._ns.run, self._param, _Actions(), self._stop,
+            name=f"naming_{scheme}")
+
+    def watch(self, cb: Callable[[List[EndPoint]], None]) -> None:
+        with self._lock:
+            self._watchers.append(cb)
+            servers = list(self._servers)
+        if self._first_update.is_set():
+            cb(servers)
+
+    def servers(self) -> List[EndPoint]:
+        with self._lock:
+            return list(self._servers)
+
+    def wait_first_update(self, timeout_s: float = 5.0) -> bool:
+        return self._first_update.wait(timeout_s)
+
+    def stop(self) -> None:
+        self._stop.set()

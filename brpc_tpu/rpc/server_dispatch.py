@@ -54,6 +54,8 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     cntl.trace_id = meta.trace_id
     cntl.span_id = meta.span_id
     cntl._server_socket = socket
+    if meta.HasField("stream_settings") and meta.stream_settings.stream_id:
+        cntl._peer_stream_id = meta.stream_settings.stream_id
     cntl.request_attachment = msg.attachment
     if meta.device_payloads:
         inline = unpack_inline_device_arrays(msg)
@@ -94,6 +96,9 @@ def _send_response(socket, cid: int, cntl: Controller, response) -> None:
     meta.correlation_id = cid
     meta.response.error_code = cntl.error_code
     meta.response.error_text = cntl.error_text
+    accepted = getattr(cntl, "_accepted_stream", None)
+    if accepted is not None:
+        meta.stream_settings.stream_id = accepted.id
     payload = b""
     if not cntl.failed():
         try:

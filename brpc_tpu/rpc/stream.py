@@ -1,0 +1,222 @@
+"""Streaming RPC (brpc/stream.h:103-120, stream_impl.h, SURVEY.md §2.6).
+
+Stream setup piggybacks on a normal RPC (stream ids ride RpcMeta's
+stream_settings on the request and response), after which STREAM frames —
+meta with stream_settings but neither request nor response — flow both
+ways on the same socket with credit-based flow control:
+
+  - each side starts with ``initial_credits`` frames of send budget
+  - the receiver returns credits in batches (piggybacked on its own
+    frames or as bare credit grants) after delivering frames
+  - a writer with no credits parks on a butex until a grant arrives
+
+Device arrays stream over the same device lane as unary RPC. Ordered
+delivery comes from the socket's FIFO write queue + per-stream
+ExecutionQueue on the receive side (the reference's per-stream
+ExecutionQueue write path, SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.butil.resource_pool import ResourcePool
+from brpc_tpu.fiber import ExecutionQueue, global_control
+from brpc_tpu.fiber.butex import Butex, WAIT_TIMEOUT
+from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
+from brpc_tpu.protocol.tpu_std import pack_message
+
+_stream_pool: ResourcePool = ResourcePool()
+_stream_pool.insert(None)  # stream id 0 = invalid (proto3 zero default)
+
+DEFAULT_CREDITS = 64
+CREDIT_BATCH = 16  # grant credits back every K delivered frames
+
+
+class StreamOptions:
+    def __init__(self, on_received: Optional[Callable] = None,
+                 initial_credits: int = DEFAULT_CREDITS):
+        self.on_received = on_received
+        self.initial_credits = initial_credits
+
+
+class Stream:
+    def __init__(self, options: Optional[StreamOptions] = None):
+        self.options = options or StreamOptions()
+        self.id: int = _stream_pool.insert(self)
+        self.peer_id: int = 0
+        self.socket = None
+        self.closed = False
+        self.remote_closed = False
+        self._frame_seq = 0
+        self._credits = Butex(self.options.initial_credits)
+        self._pending_grants = 0
+        self._grant_lock = threading.Lock()
+        self._recv_q = ExecutionQueue(self._deliver, name=f"stream_{self.id}")
+        self._close_cbs: List[Callable] = []
+        from brpc_tpu.fiber.sync import FiberEvent
+        self._established = FiberEvent()
+
+    def _on_established(self) -> None:
+        """Peer id bound (client: response arrived; server: accept).
+        Flush any credit grants deferred while peer_id was unknown."""
+        self._established.set()
+        with self._grant_lock:
+            grant = 0
+            if self._pending_grants >= CREDIT_BATCH:
+                grant, self._pending_grants = self._pending_grants, 0
+        if grant and not self.closed:
+            self._send_frame(b"", None, credits=grant, data=False)
+
+    # --------------------------------------------------------------- write
+    async def write(self, payload: bytes | IOBuf = b"",
+                    device_arrays: Optional[List] = None,
+                    timeout_s: Optional[float] = 10.0) -> bool:
+        """Send one frame; parks on the credit butex when the window is
+        exhausted. Returns False if the stream closed."""
+        if self.closed or self.remote_closed:
+            return False
+        if self.peer_id == 0:
+            # establishment still in flight: a frame to stream id 0 would
+            # be dropped and its credit lost
+            if not await self._established.wait(timeout_s):
+                return False
+            if self.closed or self.remote_closed:
+                return False
+        while True:
+            v = self._credits.value
+            if v > 0 and self._credits.compare_exchange(v, v - 1):
+                break
+            if self.closed or self.remote_closed:
+                return False
+            r = await self._credits.wait(expected=0, timeout_s=timeout_s)
+            if r == WAIT_TIMEOUT:
+                return False
+        self._send_frame(payload, device_arrays)
+        return True
+
+    def write_nowait(self, payload: bytes | IOBuf = b"",
+                     device_arrays: Optional[List] = None) -> bool:
+        """Non-blocking write: fails immediately when out of credits or
+        before the stream is established."""
+        if self.closed or self.remote_closed or self.peer_id == 0:
+            return False
+        while True:
+            v = self._credits.value
+            if v <= 0:
+                return False
+            if self._credits.compare_exchange(v, v - 1):
+                break
+        self._send_frame(payload, device_arrays)
+        return True
+
+    def _send_frame(self, payload, device_arrays, close: bool = False,
+                    credits: int = 0, data: bool = True) -> None:
+        meta = pb.RpcMeta()
+        ss = meta.stream_settings
+        ss.stream_id = self.peer_id
+        if data:
+            # frame_seq marks DATA frames (they consume a credit and must
+            # be delivered, even with an empty payload); bare credit grants
+            # and close frames leave it 0
+            self._frame_seq += 1
+            ss.frame_seq = self._frame_seq
+        if close:
+            ss.close = True
+        if credits:
+            ss.credits = credits
+        use_lane = bool(device_arrays) and self.socket.conn.supports_device_lane
+        wire, lane = pack_message(meta, payload, device_arrays=device_arrays,
+                                  device_lane=use_lane)
+        if lane is not None:
+            self.socket.write_device_payload(lane)
+        self.socket.write(wire)
+
+    # -------------------------------------------------------------- receive
+    def _on_frame(self, msg) -> None:
+        ss = msg.meta.stream_settings
+        if ss.credits:
+            self._credits.fetch_add(ss.credits)
+            self._credits.wake_all()
+        if ss.close:
+            self.remote_closed = True
+            self._credits.wake_all()
+            self._recv_q.execute(("close", None))
+            return
+        if ss.frame_seq:  # DATA frame (possibly empty payload)
+            self._recv_q.execute(("frame", msg))
+
+    async def _deliver(self, batch) -> None:
+        import inspect
+        for kind, msg in batch:
+            if kind == "close":
+                for cb in self._close_cbs:
+                    try:
+                        cb(self)
+                    except Exception:
+                        pass
+                continue
+            if self.options.on_received is not None:
+                try:
+                    r = self.options.on_received(self, msg)
+                    if inspect.isawaitable(r):
+                        await r  # runs in the drainer fiber: stays serial
+                except Exception:
+                    import logging
+                    logging.getLogger("brpc_tpu.rpc").exception(
+                        "stream on_received failed")
+            with self._grant_lock:
+                self._pending_grants += 1
+                grant = 0
+                if self._pending_grants >= CREDIT_BATCH and self.peer_id:
+                    grant, self._pending_grants = self._pending_grants, 0
+            if grant and not self.closed:
+                self._send_frame(b"", None, credits=grant, data=False)
+
+    # ---------------------------------------------------------------- close
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        if self.socket is not None and self.peer_id and not self.remote_closed:
+            try:
+                self._send_frame(b"", None, close=True, data=False)
+            except Exception:
+                pass
+        _stream_pool.remove(self.id)
+        self._credits.wake_all()
+
+    def on_close(self, cb: Callable) -> None:
+        self._close_cbs.append(cb)
+
+    def join_drained(self, timeout_s: float = 5.0) -> bool:
+        return self._recv_q.join(timeout_s)
+
+
+def address_stream(stream_id: int) -> Optional[Stream]:
+    return _stream_pool.address(stream_id)
+
+
+def process_stream_frame(msg, socket) -> None:
+    """Dispatch a STREAM frame (called from tpu_std.process)."""
+    stream = _stream_pool.address(msg.meta.stream_settings.stream_id)
+    if stream is None:
+        return  # stream already closed; drop (reference drops too)
+    stream._on_frame(msg)
+
+
+# ------------------------------------------------------------- establishment
+def stream_accept(cntl, options: Optional[StreamOptions] = None) -> Optional[Stream]:
+    """Server side: accept the stream the client attached to this RPC
+    (StreamAccept). Must be called inside the handler."""
+    peer_id = getattr(cntl, "_peer_stream_id", 0)
+    if not peer_id:
+        return None
+    s = Stream(options)
+    s.peer_id = peer_id
+    s.socket = cntl._server_socket
+    s._on_established()
+    cntl._accepted_stream = s
+    return s

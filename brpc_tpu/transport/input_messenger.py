@@ -53,7 +53,11 @@ class InputMessenger:
                     break
                 # PARSE_TRY_OTHERS: not this protocol's bytes, try next
             if claimed is not None:
-                msgs.append(claimed)
+                proto, msg = claimed
+                # order-critical messages (stream frames) dispatch inline
+                # in parse order; everything else may fan out to fibers
+                if not proto.process_inline(msg, socket):
+                    msgs.append(claimed)
                 continue
             if not waiting_for_bytes and socket.input_portal:
                 # every protocol disclaimed the bytes: drop the connection

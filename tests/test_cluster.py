@@ -1,0 +1,285 @@
+"""Cluster features: naming, LB, circuit breaker, health check, combo
+channels — N in-process servers simulate the cluster, exactly like the
+reference's brpc_load_balancer_unittest / brpc_naming_service_unittest
+(SURVEY.md §4 'distributed without a cluster')."""
+
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.rpc import (
+    Channel, ChannelOptions, ClusterChannel, Controller, ParallelChannel,
+    PartitionChannel, PartitionParser, SelectiveChannel, Server,
+    ServerOptions, Service, SubCall,
+)
+from brpc_tpu.rpc import errno_codes as berr
+from brpc_tpu.rpc.load_balancer import (
+    ConsistentHashLB, LocalityAwareLB, RandomLB, RoundRobinLB,
+    WeightedRoundRobinLB,
+)
+from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+
+_seq = iter(range(100000))
+
+
+def start_server(tag: str):
+    server = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("EchoService")
+
+    @svc.method()
+    def Echo(cntl, request):
+        return tag.encode() + b":" + request
+
+    @svc.method()
+    def WhoAmI(cntl, request):
+        return tag.encode()
+
+    server.add_service(svc)
+    ep = server.start(f"mem://{tag}-{next(_seq)}")
+    return server, ep
+
+
+class TestLoadBalancers:
+    EPS = [str2endpoint(f"tcp://10.0.0.{i}:80") for i in range(1, 5)]
+
+    def test_round_robin_covers_all(self):
+        lb = RoundRobinLB()
+        lb.reset_servers(self.EPS)
+        picks = [lb.select_server() for _ in range(8)]
+        assert set(picks) == set(self.EPS)
+
+    def test_rr_excludes(self):
+        lb = RoundRobinLB()
+        lb.reset_servers(self.EPS)
+        ex = {self.EPS[0], self.EPS[1]}
+        for _ in range(10):
+            assert lb.select_server(ex) not in ex
+
+    def test_random(self):
+        lb = RandomLB()
+        lb.reset_servers(self.EPS)
+        assert {lb.select_server() for _ in range(100)} == set(self.EPS)
+
+    def test_weighted_rr(self):
+        lb = WeightedRoundRobinLB()
+        a = str2endpoint("tcp://a:1#w=3")
+        b = str2endpoint("tcp://b:1#w=1")
+        lb.reset_servers([a, b])
+        picks = [lb.select_server() for _ in range(40)]
+        assert picks.count(a) == 30 and picks.count(b) == 10
+
+    def test_consistent_hash_stability(self):
+        lb = ConsistentHashLB()
+        lb.reset_servers(self.EPS)
+        key = b"user-42"
+        first = lb.select_server(request_key=key)
+        assert all(lb.select_server(request_key=key) == first for _ in range(10))
+        # removing an unrelated server keeps most keys stable
+        keys = [f"k{i}".encode() for i in range(200)]
+        before = {k: lb.select_server(request_key=k) for k in keys}
+        lb.reset_servers(self.EPS[:-1])
+        moved = sum(1 for k in keys
+                    if before[k] != lb.select_server(request_key=k)
+                    and before[k] != self.EPS[-1])
+        assert moved < 40  # only keys of the removed node should move (mostly)
+
+    def test_locality_aware_prefers_fast(self):
+        lb = LocalityAwareLB()
+        fast, slow = self.EPS[0], self.EPS[1]
+        lb.reset_servers([fast, slow])
+        for _ in range(50):
+            lb.feedback(fast, 100.0, False)
+            lb.feedback(slow, 100000.0, False)
+        picks = [lb.select_server() for _ in range(200)]
+        assert picks.count(fast) > picks.count(slow) * 3
+
+    def test_empty_list(self):
+        lb = RoundRobinLB()
+        lb.reset_servers([])
+        assert lb.select_server() is None
+
+
+class TestClusterChannel:
+    def test_spreads_over_cluster(self):
+        servers = [start_server(f"s{i}") for i in range(3)]
+        try:
+            urls = ",".join(str(ep) for _, ep in servers)
+            ch = ClusterChannel(f"list://{urls}", "rr")
+            seen = set()
+            for _ in range(12):
+                cntl = ch.call_sync("EchoService", "WhoAmI", b"")
+                assert not cntl.failed(), cntl.error_text
+                seen.add(cntl.response_payload.to_bytes())
+            assert seen == {b"s0", b"s1", b"s2"}
+            ch.close()
+        finally:
+            for s, _ in servers:
+                s.stop(); s.join(2)
+
+    def test_retry_skips_dead_server(self):
+        servers = [start_server(f"r{i}") for i in range(3)]
+        try:
+            urls = ",".join(str(ep) for _, ep in servers)
+            ch = ClusterChannel(f"list://{urls}", "rr",
+                                ChannelOptions(timeout_ms=2000, max_retry=3))
+            # kill one server hard
+            servers[0][0].stop(); servers[0][0].join(2)
+            ok = 0
+            for _ in range(12):
+                cntl = ch.call_sync("EchoService", "WhoAmI", b"")
+                if not cntl.failed():
+                    ok += 1
+            assert ok == 12  # retries route around the dead server
+            ch.close()
+        finally:
+            for s, _ in servers[1:]:
+                s.stop(); s.join(2)
+
+    def test_naming_update_adds_servers(self):
+        s1, ep1 = start_server("n1")
+        s2, ep2 = start_server("n2")
+        try:
+            import tempfile, os
+            with tempfile.NamedTemporaryFile("w", suffix=".lst", delete=False) as f:
+                f.write(str(ep1) + "\n")
+                path = f.name
+            ch = ClusterChannel(f"file://{path}", "rr")
+            time.sleep(0.1)
+            cntl = ch.call_sync("EchoService", "WhoAmI", b"")
+            assert cntl.response_payload.to_bytes() == b"n1"
+            with open(path, "w") as f:
+                f.write(str(ep1) + "\n" + str(ep2) + "\n")
+            deadline = time.monotonic() + 5
+            seen = set()
+            while time.monotonic() < deadline and len(seen) < 2:
+                cntl = ch.call_sync("EchoService", "WhoAmI", b"")
+                if not cntl.failed():
+                    seen.add(cntl.response_payload.to_bytes())
+            assert seen == {b"n1", b"n2"}
+            ch.close()
+            os.unlink(path)
+        finally:
+            s1.stop(); s1.join(2)
+            s2.stop(); s2.join(2)
+
+
+class TestParallelChannel:
+    def test_fan_out_merge(self):
+        servers = [start_server(f"p{i}") for i in range(4)]
+        try:
+            pch = ParallelChannel()
+            for _, ep in servers:
+                pch.add_sub_channel(Channel(str(ep)))
+            cntl = pch.call_sync("EchoService", "WhoAmI", b"")
+            assert not cntl.failed(), cntl.error_text
+            assert cntl.sub_responses == [b"p0", b"p1", b"p2", b"p3"]
+        finally:
+            for s, _ in servers:
+                s.stop(); s.join(2)
+
+    def test_fail_limit(self):
+        servers = [start_server(f"f{i}") for i in range(2)]
+        try:
+            pch = ParallelChannel(fail_limit=1)
+            pch.add_sub_channel(Channel(str(servers[0][1])))
+            dead = Channel("mem://nobody", ChannelOptions(timeout_ms=300, max_retry=0))
+            pch.add_sub_channel(dead)
+            pch.add_sub_channel(Channel(str(servers[1][1])))
+            cntl = pch.call_sync("EchoService", "WhoAmI", b"")
+            assert cntl.error_code == berr.ETOOMANYFAILS
+        finally:
+            for s, _ in servers:
+                s.stop(); s.join(2)
+
+    def test_call_mapper_partition(self):
+        servers = [start_server(f"m{i}") for i in range(3)]
+        try:
+            class ShardParser(PartitionParser):
+                def parse(self, i, n, service, method, request, cntl):
+                    shard = request[i::n]  # strided shard of the payload
+                    return SubCall(service, "Echo", shard)
+
+            pch = PartitionChannel(partition_parser=ShardParser())
+            for _, ep in servers:
+                pch.add_partition(Channel(str(ep)))
+            cntl = pch.call_sync("EchoService", "ignored", b"abcdef")
+            assert not cntl.failed(), cntl.error_text
+            assert cntl.sub_responses == [b"m0:ad", b"m1:be", b"m2:cf"]
+        finally:
+            for s, _ in servers:
+                s.stop(); s.join(2)
+
+
+class TestSelectiveChannel:
+    def test_retries_other_sub_channel(self):
+        s1, ep1 = start_server("alive")
+        try:
+            sch = SelectiveChannel("rr", max_retry=2)
+            sch.add_sub_channel(Channel("mem://corpse",
+                                        ChannelOptions(timeout_ms=300, max_retry=0)))
+            sch.add_sub_channel(Channel(str(ep1)))
+            ok = 0
+            for _ in range(6):
+                cntl = sch.call_sync("EchoService", "WhoAmI", b"")
+                if not cntl.failed():
+                    ok += 1
+                    assert cntl.response_payload.to_bytes() == b"alive"
+            assert ok == 6
+        finally:
+            s1.stop(); s1.join(2)
+
+
+class TestCircuitBreaker:
+    def test_isolates_after_errors(self):
+        from brpc_tpu.rpc.circuit_breaker import CircuitBreaker
+        cb = CircuitBreaker()
+        for _ in range(10):
+            cb.on_call(failed=True)
+        assert cb.isolated()
+        time.sleep(0.15)
+        assert not cb.isolated()  # isolation expires
+
+    def test_cluster_recover_gate(self):
+        from brpc_tpu.rpc.circuit_breaker import ClusterBreakers
+        cbs = ClusterBreakers()
+        eps = [str2endpoint(f"tcp://h{i}:1") for i in range(4)]
+        for ep in eps[:3]:
+            for _ in range(10):
+                cbs.on_call(ep, failed=True)
+        # 3/4 isolated >= half: the gate opens everything for revival
+        assert cbs.isolated_set(eps) == set()
+        # only 1 isolated: normal exclusion
+        cbs2 = ClusterBreakers()
+        for _ in range(10):
+            cbs2.on_call(eps[0], failed=True)
+        assert cbs2.isolated_set(eps) == {eps[0]}
+
+
+class TestConcurrencyLimiter:
+    def test_constant(self):
+        from brpc_tpu.rpc.concurrency_limiter import ConstantLimiter
+        lim = ConstantLimiter(2)
+        assert lim.on_requested() and lim.on_requested()
+        assert not lim.on_requested()
+        lim.on_responded(100, False)
+        assert lim.on_requested()
+
+    def test_auto_grows_when_healthy(self):
+        from brpc_tpu.rpc.concurrency_limiter import AutoLimiter
+        lim = AutoLimiter(initial=8)
+        start = lim.max_concurrency
+        for _ in range(500):
+            assert lim.on_requested()
+            lim.on_responded(100.0, False)
+        assert lim.max_concurrency > start
+
+    def test_auto_shrinks_on_latency_inflation(self):
+        from brpc_tpu.rpc.concurrency_limiter import AutoLimiter
+        lim = AutoLimiter(initial=64)
+        for _ in range(200):
+            lim.on_requested(); lim.on_responded(100.0, False)
+        grown = lim.max_concurrency
+        for _ in range(300):
+            lim.on_requested(); lim.on_responded(10000.0, False)
+        assert lim.max_concurrency < grown

@@ -1,0 +1,197 @@
+"""Streaming RPC tests (test/brpc_streaming_rpc_unittest style): stream
+setup piggybacks on an RPC, frames flow both ways with credit-based flow
+control, device arrays ride the lane."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from brpc_tpu import fiber
+from brpc_tpu.rpc import Channel, ChannelOptions, Server, ServerOptions, Service
+from brpc_tpu.rpc.stream import (
+    CREDIT_BATCH, DEFAULT_CREDITS, Stream, StreamOptions, stream_accept,
+)
+
+_seq = iter(range(100000))
+
+
+def start_stream_server(server_received, echo_back=False):
+    server = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("StreamService")
+
+    @svc.method()
+    def Open(cntl, request):
+        def on_received(stream, msg):
+            payload = msg.payload.to_bytes()
+            server_received.append((payload, list(msg.device_arrays)))
+            if echo_back:
+                stream.write_nowait(b"echo:" + payload)
+        st = stream_accept(cntl, StreamOptions(on_received=on_received))
+        assert st is not None
+        return b"accepted"
+
+    @svc.method()
+    def NoStream(cntl, request):
+        assert stream_accept(cntl) is None
+        return b"no-stream"
+
+    server.add_service(svc)
+    ep = server.start(f"mem://stream-{next(_seq)}")
+    return server, ep
+
+
+class TestStreaming:
+    def test_client_to_server_frames(self):
+        received = []
+        server, ep = start_stream_server(received)
+        try:
+            ch = Channel(str(ep))
+            client_got = []
+            cntl = ch.call_sync("StreamService", "Open", b"",
+                                stream_options=StreamOptions(
+                                    on_received=lambda s, m: client_got.append(m)))
+            assert not cntl.failed(), cntl.error_text
+            stream = cntl.stream
+            assert stream.peer_id != 0
+
+            async def writer():
+                for i in range(20):
+                    ok = await stream.write(f"frame-{i}".encode())
+                    assert ok
+            f = fiber.spawn(writer)
+            assert f.join(5)
+            f.value()
+            deadline = time.monotonic() + 5
+            while len(received) < 20 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert [p for p, _ in received] == [f"frame-{i}".encode()
+                                               for i in range(20)]
+            stream.close()
+        finally:
+            server.stop(); server.join(2)
+
+    def test_bidirectional_echo(self):
+        received = []
+        server, ep = start_stream_server(received, echo_back=True)
+        try:
+            ch = Channel(str(ep))
+            client_got = []
+            cntl = ch.call_sync(
+                "StreamService", "Open", b"",
+                stream_options=StreamOptions(
+                    on_received=lambda s, m: client_got.append(m.payload.to_bytes())))
+            stream = cntl.stream
+
+            async def writer():
+                for i in range(10):
+                    assert await stream.write(f"m{i}".encode())
+            f = fiber.spawn(writer)
+            assert f.join(5)
+            deadline = time.monotonic() + 5
+            while len(client_got) < 10 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert client_got == [f"echo:m{i}".encode() for i in range(10)]
+            stream.close()
+        finally:
+            server.stop(); server.join(2)
+
+    def test_flow_control_blocks_writer(self):
+        """With a tiny window and a receiver that can't drain, the writer
+        must run out of credits rather than buffer unboundedly."""
+        received = []
+        server, ep = start_stream_server(received)
+        try:
+            ch = Channel(str(ep))
+            cntl = ch.call_sync("StreamService", "Open", b"",
+                                stream_options=StreamOptions(initial_credits=4))
+            stream = cntl.stream
+            sent = 0
+            for i in range(10):
+                if not stream.write_nowait(f"f{i}".encode()):
+                    break
+                sent += 1
+            assert sent == 4  # window exhausted without grants
+            stream.close()
+        finally:
+            server.stop(); server.join(2)
+
+    def test_credits_replenish(self):
+        """Receiver grants credits back after CREDIT_BATCH frames, so a
+        long stream sustains more than the initial window."""
+        received = []
+        server, ep = start_stream_server(received)
+        try:
+            ch = Channel(str(ep))
+            n = DEFAULT_CREDITS + CREDIT_BATCH * 2
+            cntl = ch.call_sync("StreamService", "Open", b"",
+                                stream_options=StreamOptions())
+            stream = cntl.stream
+
+            async def writer():
+                sent = 0
+                for i in range(n):
+                    if await stream.write(f"x{i}".encode(), timeout_s=5):
+                        sent += 1
+                return sent
+            f = fiber.spawn(writer)
+            assert f.join(20)
+            assert f.value() == n
+            deadline = time.monotonic() + 5
+            while len(received) < n and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert len(received) == n
+            stream.close()
+        finally:
+            server.stop(); server.join(2)
+
+    def test_device_arrays_over_stream(self):
+        received = []
+        server, ep = start_stream_server(received)
+        try:
+            ch = Channel(str(ep))
+            cntl = ch.call_sync("StreamService", "Open", b"",
+                                stream_options=StreamOptions())
+            stream = cntl.stream
+            arr = np.arange(32, dtype=np.float32)
+
+            async def writer():
+                return await stream.write(b"tensor", device_arrays=[arr])
+            f = fiber.spawn(writer)
+            assert f.join(5) and f.value()
+            deadline = time.monotonic() + 5
+            while not received and time.monotonic() < deadline:
+                time.sleep(0.01)
+            payload, arrays = received[0]
+            assert payload == b"tensor"
+            np.testing.assert_array_equal(np.asarray(arrays[0]), arr)
+            stream.close()
+        finally:
+            server.stop(); server.join(2)
+
+    def test_close_propagates(self):
+        received = []
+        server, ep = start_stream_server(received)
+        try:
+            ch = Channel(str(ep))
+            closed = threading.Event()
+            cntl = ch.call_sync("StreamService", "Open", b"",
+                                stream_options=StreamOptions())
+            stream = cntl.stream
+            stream.on_close(lambda s: closed.set())
+            stream.close()
+            assert stream.closed
+        finally:
+            server.stop(); server.join(2)
+
+    def test_no_stream_requested(self):
+        received = []
+        server, ep = start_stream_server(received)
+        try:
+            ch = Channel(str(ep))
+            cntl = ch.call_sync("StreamService", "NoStream", b"")
+            assert not cntl.failed(), cntl.error_text
+            assert cntl.response_payload.to_bytes() == b"no-stream"
+        finally:
+            server.stop(); server.join(2)
