@@ -1,0 +1,263 @@
+"""HTTP/1.1 protocol: curl-able observability + JSON access to services
+(policy/http_rpc_protocol.cpp + builtin/* — SURVEY.md §2.5, §2.7).
+
+Server side:
+  GET  /            index of builtin pages
+  GET  /status      server + per-method stats        (StatusService)
+  GET  /vars[?prefix=] exposed bvars                 (VarsService)
+  GET  /flags       runtime flags; POST /flags/<name>?setvalue=v mutates
+  GET  /health      liveness                         (HealthService)
+  GET  /connections live connections                 (ConnectionsService)
+  GET  /brpc_metrics prometheus text                 (PrometheusMetrics)
+  GET  /rpcz[?trace_id=] recent spans                (RpczService)
+  POST /<Service>/<Method>  JSON (pb methods) or raw-byte body -> RPC
+
+The parser is peek-based like every protocol here: TRY_OTHERS unless the
+bytes start with an HTTP method. pb messages render via protobuf's
+json_format (the reference's json2pb bridge)."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+from typing import Optional, Tuple
+
+from brpc_tpu.butil.flags import list_flags, set_flag
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.protocol.registry import (
+    PARSE_NOT_ENOUGH_DATA, PARSE_OK, PARSE_TRY_OTHERS, Protocol,
+    register_protocol,
+)
+
+_METHODS = (b"GET ", b"POST ", b"PUT ", b"DELETE ", b"HEAD ", b"OPTIONS ",
+            b"PATCH ")
+_MAX_HEADER = 64 * 1024
+_MAX_BODY = 256 * 1024 * 1024
+
+
+class HttpRequest:
+    __slots__ = ("method", "path", "query", "headers", "body", "keep_alive")
+
+    def __init__(self, method, path, query, headers, body, keep_alive):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+        self.body = body
+        self.keep_alive = keep_alive
+
+
+def _response(status: int, body: bytes, content_type: str = "text/plain",
+              keep_alive: bool = True) -> IOBuf:
+    reason = {200: "OK", 400: "Bad Request", 403: "Forbidden",
+              404: "Not Found", 405: "Method Not Allowed",
+              500: "Internal Server Error"}.get(status, "OK")
+    head = (f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n"
+            f"\r\n").encode()
+    out = IOBuf()
+    out.append(head)
+    out.append(body)
+    return out
+
+
+class HttpProtocol(Protocol):
+    name = "http"
+
+    # ---------------------------------------------------------------- parse
+    def parse(self, portal, socket) -> Tuple[str, object]:
+        head = portal.peek_bytes(min(8, portal.size))
+        if not any(m.startswith(head[:len(m)]) if len(head) < len(m)
+                   else head.startswith(m) for m in _METHODS):
+            return PARSE_TRY_OTHERS, None
+        raw = portal.peek_bytes(min(portal.size, _MAX_HEADER))
+        sep = raw.find(b"\r\n\r\n")
+        if sep < 0:
+            if portal.size >= _MAX_HEADER:
+                return PARSE_TRY_OTHERS, None  # header flood: drop conn
+            return PARSE_NOT_ENOUGH_DATA, None
+        header_bytes = raw[:sep]
+        lines = header_bytes.split(b"\r\n")
+        try:
+            method, target, _version = lines[0].decode("latin1").split(" ", 2)
+        except ValueError:
+            return PARSE_TRY_OTHERS, None
+        headers = {}
+        for line in lines[1:]:
+            k, _, v = line.decode("latin1").partition(":")
+            headers[k.strip().lower()] = v.strip()
+        try:
+            body_len = int(headers.get("content-length", "0") or "0")
+        except ValueError:
+            return PARSE_TRY_OTHERS, None  # malformed: drop the connection
+        if body_len < 0 or body_len > _MAX_BODY:
+            return PARSE_TRY_OTHERS, None
+        total = sep + 4 + body_len
+        if portal.size < total:
+            return PARSE_NOT_ENOUGH_DATA, None
+        portal.pop_front(sep + 4)
+        body = portal.cut(body_len).to_bytes()
+        parsed = urllib.parse.urlsplit(target)
+        query = dict(urllib.parse.parse_qsl(parsed.query))
+        keep_alive = headers.get("connection", "keep-alive").lower() != "close"
+        return PARSE_OK, HttpRequest(method.upper(), parsed.path, query,
+                                     headers, body, keep_alive)
+
+    # -------------------------------------------------------------- process
+    async def process(self, req: HttpRequest, socket):
+        server = socket.user_data.get("server")
+        if server is None:
+            socket.write(_response(500, b"no server bound", keep_alive=False))
+            return
+        try:
+            status, ctype, body = await self._route(server, req)
+        except Exception as e:
+            status, ctype, body = 500, "text/plain", f"error: {e}".encode()
+        socket.write(_response(status, body, ctype, req.keep_alive))
+        if not req.keep_alive:
+            socket.set_failed(ConnectionError("http connection: close"))
+
+    # --------------------------------------------------------------- routes
+    async def _route(self, server, req: HttpRequest):
+        path = req.path.rstrip("/") or "/"
+        if server.options.auth_token is not None and path != "/health":
+            # the tpu_std auth gate must not have an HTTP side door: require
+            # the token (Authorization: Bearer ... or ?token=) everywhere
+            # except liveness
+            auth = req.headers.get("authorization", "")
+            token = auth[7:] if auth.startswith("Bearer ") else \
+                req.query.get("token", "")
+            if token != server.options.auth_token:
+                return 403, "text/plain", b"authentication failed"
+        if path == "/":
+            return 200, "text/html", self._index(server)
+        if path == "/health":
+            return 200, "text/plain", b"OK"
+        if path == "/status":
+            return 200, "application/json", self._status(server)
+        if path == "/vars" or path.startswith("/vars/"):
+            from brpc_tpu.bvar.variable import dump_exposed
+            prefix = req.query.get("prefix", path[6:] if len(path) > 6 else "")
+            lines = [f"{n} : {v}" for n, v in dump_exposed(prefix)]
+            return 200, "text/plain", ("\n".join(lines) + "\n").encode()
+        if path == "/brpc_metrics" or path == "/metrics":
+            from brpc_tpu.bvar.prometheus import dump_prometheus
+            return 200, "text/plain", dump_prometheus().encode()
+        if path == "/flags" or path.startswith("/flags/"):
+            return self._flags(req, path)
+        if path == "/connections":
+            conns = [{"remote": str(s.remote_endpoint), "failed": s.failed}
+                     for s in server.connections()]
+            return 200, "application/json", json.dumps(conns).encode()
+        if path == "/rpcz":
+            from brpc_tpu.rpc.span import global_collector
+            tid = req.query.get("trace_id")
+            if tid:
+                spans = global_collector.find_trace(int(tid, 16))
+            else:
+                spans = global_collector.recent(int(req.query.get("n", "50")))
+            return 200, "application/json", json.dumps(
+                [s.to_dict() for s in spans]).encode()
+        # /Service/Method RPC access
+        parts = [p for p in path.split("/") if p]
+        if len(parts) == 2:
+            return await self._call_method(server, req, parts[0], parts[1])
+        return 404, "text/plain", f"no such page {req.path}".encode()
+
+    def _index(self, server) -> bytes:
+        pages = ["status", "vars", "flags", "health", "connections",
+                 "brpc_metrics", "rpcz"]
+        links = "".join(f'<li><a href="/{p}">/{p}</a></li>' for p in pages)
+        svcs = "".join(
+            f"<li>{n}: {', '.join(sorted(s.methods))}</li>"
+            for n, s in server.services().items())
+        return (f"<html><body><h1>brpc_tpu</h1><ul>{links}</ul>"
+                f"<h2>services</h2><ul>{svcs}</ul></body></html>").encode()
+
+    def _status(self, server) -> bytes:
+        return json.dumps({
+            "running": server.is_running,
+            "endpoint": str(server.endpoint) if server.endpoint else None,
+            "concurrency": server.concurrency,
+            "processed": server.nprocessed,
+            "errors": server.nerror,
+            "services": {n: sorted(s.methods)
+                         for n, s in server.services().items()},
+            "method_status": {k: lr.get_value()
+                              for k, lr in server.method_status.items()},
+        }, default=str).encode()
+
+    def _flags(self, req: HttpRequest, path: str):
+        if path.startswith("/flags/") and ("setvalue" in req.query
+                                           or req.method == "POST"):
+            name = path[len("/flags/"):]
+            value = req.query.get("setvalue", req.body.decode() or "")
+            if set_flag(name, value):
+                return 200, "text/plain", b"OK"
+            return 400, "text/plain", f"cannot set flag {name!r}".encode()
+        rows = [f"{n} = {v!r} (default {d!r})  # {h}"
+                for n, v, d, h in list_flags()]
+        return 200, "text/plain", ("\n".join(rows) + "\n").encode()
+
+    async def _call_method(self, server, req: HttpRequest, service: str, method_name: str):
+        method = server.find_method(service, method_name)
+        if method is None:
+            return 404, "text/plain", b"no such service/method"
+        from brpc_tpu.rpc.controller import Controller
+        cntl = Controller()
+        cntl.remote_side = None
+        if method.request_class is not None:
+            from google.protobuf import json_format
+            request = method.request_class()
+            if req.body:
+                try:
+                    json_format.Parse(req.body.decode(), request)
+                except Exception as e:
+                    return 400, "text/plain", f"bad json: {e}".encode()
+        else:
+            request = req.body
+        if not server.on_request_start():
+            return 500, "text/plain", b"max_concurrency reached"
+        t0 = time.monotonic_ns()
+        try:
+            import inspect
+            r = method.handler(cntl, request)
+            if inspect.isawaitable(r):
+                r = await r  # we run inside the dispatch fiber
+            response = r
+        except Exception as e:
+            server.on_request_end(f"{service}.{method_name}",
+                                  (time.monotonic_ns() - t0) / 1e3, True)
+            return 500, "text/plain", f"handler error: {e}".encode()
+        server.on_request_end(f"{service}.{method_name}",
+                              (time.monotonic_ns() - t0) / 1e3, cntl.failed())
+        if cntl.failed():
+            # honor the cntl.set_failed error pattern over HTTP too
+            from brpc_tpu.rpc import errno_codes as berr
+            status = 400 if cntl.error_code == berr.EREQUEST else 500
+            return (status, "text/plain",
+                    f"[{cntl.error_code}] {cntl.error_text}".encode())
+        if response is None:
+            return 200, "application/json", b"{}"
+        if hasattr(response, "SerializeToString") and not isinstance(
+                response, (bytes, bytearray)):
+            from google.protobuf import json_format
+            return (200, "application/json",
+                    json_format.MessageToJson(response).encode())
+        if isinstance(response, IOBuf):
+            return 200, "application/octet-stream", response.to_bytes()
+        return 200, "application/octet-stream", bytes(response)
+
+
+_instance: Optional[HttpProtocol] = None
+
+
+def ensure_registered() -> HttpProtocol:
+    global _instance
+    if _instance is None:
+        _instance = HttpProtocol()
+        register_protocol(_instance)
+    return _instance

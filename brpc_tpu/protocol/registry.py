@@ -69,5 +69,6 @@ def find_protocol(name: str) -> Optional[Protocol]:
 
 
 def _register_builtins() -> None:
-    from brpc_tpu.protocol import tpu_std  # registers itself on import
+    from brpc_tpu.protocol import tpu_std, http  # register in preference order
     tpu_std.ensure_registered()
+    http.ensure_registered()

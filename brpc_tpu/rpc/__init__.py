@@ -1,6 +1,7 @@
 """RPC core: Channel/Controller/Server + cluster features (SURVEY.md §2.6)."""
 
 from brpc_tpu.rpc import errno_codes
+from brpc_tpu.rpc import rpc_dump as _rpc_dump  # registers rpc_dump_* flags
 from brpc_tpu.rpc.controller import Controller
 from brpc_tpu.rpc.channel import Channel, ChannelOptions
 from brpc_tpu.rpc.server import Server, ServerOptions

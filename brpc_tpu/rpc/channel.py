@@ -126,10 +126,22 @@ class Channel:
         cntl._service_name = service_name
         cntl._method_name = method_name
         cntl._request_bytes = serialize_payload(request)
+        if cntl.compress_type:
+            # compress once here, not per (re)issue attempt
+            from brpc_tpu.rpc.compress import compress
+            cntl._request_bytes = compress(cntl._request_bytes,
+                                           cntl.compress_type)
         if stream_options is not None:
             # stream setup piggybacks on this RPC (StreamCreate)
             from brpc_tpu.rpc.stream import Stream
             cntl.stream = Stream(stream_options)
+        from brpc_tpu.butil.flags import flag
+        if flag("rpcz_enabled"):
+            from brpc_tpu.rpc.span import finish_span, start_client_span
+            span = start_client_span(cntl, service_name, method_name)
+            span.request_size = len(cntl._request_bytes)
+            cntl._complete_hooks.append(
+                lambda c, s=span: finish_span(s, c))
         cntl._register_call()
         self._issue_rpc(cntl)
         # deadline timer: final — no retry after it fires (HandleTimeout)
@@ -184,6 +196,7 @@ class Channel:
             meta.request.auth_token = cntl.auth_token
         meta.correlation_id = cntl.correlation_id
         meta.compress_type = cntl.compress_type
+        request_bytes = cntl._request_bytes  # already compressed in call()
         if cntl.trace_id:
             meta.trace_id = cntl.trace_id
             meta.span_id = cntl.span_id
@@ -194,7 +207,7 @@ class Channel:
         use_lane = (bool(cntl.request_device_arrays)
                     and sock.conn.supports_device_lane)
         wire, lane = pack_message(
-            meta, cntl._request_bytes, attachment=_copy_buf(cntl.request_attachment),
+            meta, request_bytes, attachment=_copy_buf(cntl.request_attachment),
             device_arrays=cntl.request_device_arrays, device_lane=use_lane)
         if lane is not None:
             sock.write_device_payload(lane)

@@ -13,11 +13,27 @@ def process_response(proto, msg: RpcMessage, socket) -> None:
     cntl = take_call(cid)
     if cntl is None:
         return  # stale: the call already completed (timeout/backup winner)
+    try:
+        _fill_response(cntl, msg, socket)
+    except Exception as e:
+        # the controller is already out of the pool: it MUST complete here
+        # or join() hangs forever (e.g. corrupt compressed payload)
+        cntl.set_failed(berr.ERESPONSE, f"bad response: {e}")
+    cntl._complete()
+
+
+def _fill_response(cntl, msg: RpcMessage, socket) -> None:
     if msg.meta.HasField("response") and msg.meta.response.error_code != 0:
         cntl.set_failed(msg.meta.response.error_code,
                         msg.meta.response.error_text)
         # (a piggybacked stream is closed by cntl._complete on failure)
     else:
+        if msg.meta.compress_type:
+            from brpc_tpu.butil.iobuf import IOBuf
+            from brpc_tpu.rpc.compress import decompress
+            raw = decompress(msg.payload.to_bytes(), msg.meta.compress_type)
+            msg.payload = IOBuf()
+            msg.payload.append(raw)
         cntl.response_payload = msg.payload
         if cntl.response_msg is not None:
             try:
@@ -37,4 +53,3 @@ def process_response(proto, msg: RpcMessage, socket) -> None:
                 arrays.append(inl if dp.inline_bytes else next(lane_iter, None))
             cntl.response_device_arrays = arrays
         cntl.response_attachment = msg.attachment
-    cntl._complete()

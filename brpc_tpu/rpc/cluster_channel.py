@@ -62,8 +62,8 @@ class ClusterChannel(Channel):
         if ep is None:
             raise ConnectionError("no server available")
         cntl.tried_servers.append(ep)
-        if cntl._complete_hook is None:
-            cntl._complete_hook = self._on_call_complete
+        if self._on_call_complete not in cntl._complete_hooks:
+            cntl._complete_hooks.append(self._on_call_complete)
         return self._socket_for(ep)
 
     def _socket_for(self, ep: EndPoint) -> Socket:

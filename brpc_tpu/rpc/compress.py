@@ -1,0 +1,52 @@
+"""Compression registry (brpc/compress.{h,cpp} + policy/gzip_compress.cpp,
+snappy_compress.cpp). Payload compression by numeric type carried in
+RpcMeta.compress_type; both sides look the codec up here.
+
+Builtin: 0=none, 1=gzip, 2=zlib. (The reference's snappy slot is served by
+zlib here — snappy has no stdlib codec; a C++ one can plug in via
+register_compressor.)"""
+
+from __future__ import annotations
+
+import gzip
+import zlib
+from typing import Callable, Dict, Optional, Tuple
+
+COMPRESS_NONE = 0
+COMPRESS_GZIP = 1
+COMPRESS_ZLIB = 2
+
+_codecs: Dict[int, Tuple[Callable[[bytes], bytes], Callable[[bytes], bytes], str]] = {
+    COMPRESS_GZIP: (lambda b: gzip.compress(b, 6), gzip.decompress, "gzip"),
+    COMPRESS_ZLIB: (zlib.compress, zlib.decompress, "zlib"),
+}
+
+
+def register_compressor(ctype: int, compress: Callable, decompress: Callable,
+                        name: str) -> None:
+    _codecs[ctype] = (compress, decompress, name)
+
+
+def compress(data: bytes, ctype: int) -> bytes:
+    if ctype == COMPRESS_NONE or not data:
+        return data
+    codec = _codecs.get(ctype)
+    if codec is None:
+        raise ValueError(f"unknown compress_type {ctype}")
+    return codec[0](data)
+
+
+def decompress(data: bytes, ctype: int) -> bytes:
+    if ctype == COMPRESS_NONE or not data:
+        return data
+    codec = _codecs.get(ctype)
+    if codec is None:
+        raise ValueError(f"unknown compress_type {ctype}")
+    return codec[1](data)
+
+
+def compressor_name(ctype: int) -> str:
+    if ctype == COMPRESS_NONE:
+        return "none"
+    c = _codecs.get(ctype)
+    return c[2] if c else f"unknown({ctype})"

@@ -72,10 +72,10 @@ class Controller:
         self.start_us: int = 0
         self.end_us: int = 0
         self.used_backup: bool = False
-        # cluster bookkeeping: endpoints tried (for retry-elsewhere) and a
-        # completion hook (LB feedback / circuit breaker)
+        # cluster bookkeeping: endpoints tried (for retry-elsewhere) and
+        # completion hooks (LB feedback / circuit breaker / client spans)
         self.tried_servers: list = []
-        self._complete_hook: Optional[Callable[["Controller"], None]] = None
+        self._complete_hooks: list = []
         # ---- client call internals (set by Channel.call)
         self._service_name: str = ""
         self._method_name: str = ""
@@ -117,8 +117,7 @@ class Controller:
             stream = getattr(self, "stream", None)
             if stream is not None:
                 stream.close()
-        hook = self._complete_hook
-        if hook is not None:
+        for hook in self._complete_hooks:
             try:
                 hook(self)
             except Exception:

@@ -1,0 +1,70 @@
+"""rpc_dump: sampled request recording for offline replay
+(brpc/rpc_dump.h:50-95 + tools/rpc_replay — SURVEY.md §5 checkpoint/
+resume analog). Enable by setting the ``rpc_dump_dir`` flag; a bounded
+per-second sample of inbound requests is appended as JSONL
+({service, method, payload(b64), log_id, ts}); tools/rpc_replay.py
+re-issues them at a target QPS."""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from brpc_tpu.butil.flags import define_flag, flag
+
+define_flag("rpc_dump_dir", "", "directory for sampled request dumps "
+            "(empty = disabled)")
+define_flag("rpc_dump_max_requests_per_second", 100,
+            "sampling budget per second", validator=lambda v: v >= 1)
+
+
+class RpcDumper:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fh = None
+        self._dir = None
+        self._second = 0
+        self._taken = 0
+
+    def maybe_dump(self, service: str, method: str, payload: bytes,
+                   log_id: int = 0) -> bool:
+        d = flag("rpc_dump_dir")
+        if not d:
+            return False
+        now = int(time.time())
+        with self._lock:
+            if now != self._second:
+                self._second, self._taken = now, 0
+            if self._taken >= flag("rpc_dump_max_requests_per_second"):
+                return False
+            self._taken += 1
+            if self._fh is None or self._dir != d:
+                os.makedirs(d, exist_ok=True)
+                path = os.path.join(d, f"rpc_dump.{os.getpid()}.jsonl")
+                self._fh = open(path, "a")
+                self._dir = d
+            self._fh.write(json.dumps({
+                "service": service, "method": method,
+                "payload": base64.b64encode(payload).decode(),
+                "log_id": log_id, "ts": time.time(),
+            }) + "\n")
+            self._fh.flush()
+        return True
+
+
+global_dumper = RpcDumper()
+
+
+def load_dump(path: str):
+    """Yield (service, method, payload_bytes, log_id) records."""
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            yield (rec["service"], rec["method"],
+                   base64.b64decode(rec["payload"]), rec.get("log_id", 0))

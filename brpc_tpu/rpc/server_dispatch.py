@@ -47,13 +47,16 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     method_key = f"{req_meta.service_name}.{req_meta.method_name}"
     t0 = time.monotonic_ns()
     cntl = Controller()
+    cntl.trace_id = meta.trace_id
+    cntl.span_id = meta.span_id
     cntl.log_id = req_meta.log_id
     cntl.remote_side = socket.remote_endpoint
     cntl.local_side = socket.local_endpoint
     cntl.auth_token = req_meta.auth_token
-    cntl.trace_id = meta.trace_id
-    cntl.span_id = meta.span_id
     cntl._server_socket = socket
+    from brpc_tpu.rpc.span import finish_span, start_server_span
+    span = start_server_span(cntl, req_meta.service_name, req_meta.method_name)
+    span.request_size = msg.payload.size + msg.attachment.size
     if meta.HasField("stream_settings") and meta.stream_settings.stream_id:
         cntl._peer_stream_id = meta.stream_settings.stream_id
     cntl.request_attachment = msg.attachment
@@ -67,14 +70,25 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     # decode request payload
     request = None
     try:
+        payload_bytes = msg.payload.to_bytes()
+        if meta.compress_type:
+            from brpc_tpu.rpc.compress import decompress
+            payload_bytes = decompress(payload_bytes, meta.compress_type)
+            cntl.compress_type = meta.compress_type  # reply in kind
+        # dump AFTER decompression so rpc_replay re-issues plaintext
+        from brpc_tpu.rpc.rpc_dump import global_dumper
+        global_dumper.maybe_dump(req_meta.service_name, req_meta.method_name,
+                                 payload_bytes, req_meta.log_id)
         if method.request_class is not None:
             request = method.request_class()
-            request.ParseFromString(msg.payload.to_bytes())
+            request.ParseFromString(payload_bytes)
         else:
-            request = msg.payload.to_bytes()
+            request = payload_bytes
     except Exception as e:
         server.on_request_end(method_key, 0, failed=True)
+        cntl.set_failed(berr.EREQUEST, f"cannot parse request: {e}")
         _send_error(socket, cid, berr.EREQUEST, f"cannot parse request: {e}")
+        finish_span(span, cntl)  # malformed traffic must show in /rpcz
         return
 
     response = None
@@ -89,6 +103,7 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     latency_us = (time.monotonic_ns() - t0) / 1e3
     server.on_request_end(method_key, latency_us, failed=cntl.failed())
     _send_response(socket, cid, cntl, response)
+    finish_span(span, cntl)
 
 
 def _send_response(socket, cid: int, cntl: Controller, response) -> None:
@@ -103,6 +118,10 @@ def _send_response(socket, cid: int, cntl: Controller, response) -> None:
     if not cntl.failed():
         try:
             payload = serialize_payload(response)
+            if cntl.compress_type and payload:
+                from brpc_tpu.rpc.compress import compress
+                payload = compress(payload, cntl.compress_type)
+                meta.compress_type = cntl.compress_type
         except TypeError as e:
             meta.response.error_code = berr.EINTERNAL
             meta.response.error_text = str(e)

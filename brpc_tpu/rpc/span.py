@@ -1,0 +1,144 @@
+"""rpcz tracing: per-RPC spans through a bounded collector
+(brpc/span.h:47, bvar/collector.* — SURVEY.md §5).
+
+Spans are cheap dataclass records annotated at each stage and kept in a
+ring buffer (the reference persists to leveldb; ours keeps a bounded
+in-memory ring, dumped by /rpcz). Trace ids propagate in RpcMeta
+(trace_id/span_id/parent_span_id fields), so multi-hop call trees link up.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional, Tuple
+
+from brpc_tpu.butil.fast_rand import fast_rand
+from brpc_tpu.butil.flags import flag
+
+
+@dataclass
+class Span:
+    trace_id: int
+    span_id: int
+    parent_span_id: int = 0
+    side: str = "server"            # server | client
+    service: str = ""
+    method: str = ""
+    remote_side: str = ""
+    start_us: int = 0
+    end_us: int = 0
+    error_code: int = 0
+    log_id: int = 0
+    request_size: int = 0
+    response_size: int = 0
+    annotations: List[Tuple[int, str]] = field(default_factory=list)
+
+    def annotate(self, text: str) -> None:
+        self.annotations.append((time.monotonic_ns() // 1000, text))
+
+    @property
+    def latency_us(self) -> int:
+        return max(0, self.end_us - self.start_us)
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": f"{self.trace_id:016x}",
+            "span_id": f"{self.span_id:016x}",
+            "parent_span_id": f"{self.parent_span_id:016x}",
+            "side": self.side,
+            "service": self.service,
+            "method": self.method,
+            "remote_side": self.remote_side,
+            "latency_us": self.latency_us,
+            "error_code": self.error_code,
+            "log_id": self.log_id,
+            "request_size": self.request_size,
+            "response_size": self.response_size,
+            "annotations": [
+                {"us": us, "text": t} for us, t in self.annotations],
+        }
+
+
+class SpanCollector:
+    """Bounded ring; submission is O(1) and never blocks the RPC path
+    (the reference bounds collection cost via bvar::Collector's
+    per-second budget — a ring buffer gives the same property)."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._capacity = capacity
+        self._ring: Deque[Span] = deque(maxlen=capacity or flag("rpcz_max_spans"))
+
+    def submit(self, span: Span) -> None:
+        if not flag("rpcz_enabled"):
+            return
+        with self._lock:
+            self._ring.append(span)
+
+    def recent(self, n: int = 100) -> List[Span]:
+        with self._lock:
+            return list(self._ring)[-n:]
+
+    def find_trace(self, trace_id: int) -> List[Span]:
+        with self._lock:
+            return [s for s in self._ring if s.trace_id == trace_id]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+global_collector = SpanCollector()
+
+
+def new_trace_id() -> int:
+    return fast_rand() or 1
+
+
+def start_server_span(cntl, service: str, method: str) -> Span:
+    """CreateServerSpan (span.cpp:149): trace context from the request
+    meta, or a fresh trace."""
+    trace_id = cntl.trace_id or new_trace_id()
+    span = Span(
+        trace_id=trace_id,
+        span_id=new_trace_id(),
+        parent_span_id=cntl.span_id,
+        side="server",
+        service=service,
+        method=method,
+        remote_side=str(cntl.remote_side) if cntl.remote_side else "",
+        start_us=time.monotonic_ns() // 1000,
+        log_id=cntl.log_id,
+    )
+    cntl.trace_id = trace_id       # propagate to downstream client calls
+    cntl.span_id = span.span_id
+    return span
+
+
+def start_client_span(cntl, service: str, method: str) -> Span:
+    trace_id = cntl.trace_id or new_trace_id()
+    span = Span(
+        trace_id=trace_id,
+        span_id=new_trace_id(),
+        parent_span_id=cntl.span_id,
+        side="client",
+        service=service,
+        method=method,
+        start_us=time.monotonic_ns() // 1000,
+        log_id=cntl.log_id,
+    )
+    cntl.trace_id = trace_id
+    cntl.span_id = span.span_id
+    return span
+
+
+def finish_span(span: Span, cntl) -> None:
+    span.end_us = time.monotonic_ns() // 1000
+    span.error_code = cntl.error_code
+    if cntl.remote_side and not span.remote_side:
+        span.remote_side = str(cntl.remote_side)
+    global_collector.submit(span)

@@ -176,9 +176,19 @@ class Socket:
                 pending = self._nevent
             progressed = self._drain_readable()
             if self._on_input is not None and (self.input_portal or self.failed):
-                r = self._on_input(self)
-                if hasattr(r, "__await__"):
-                    await r
+                try:
+                    r = self._on_input(self)
+                    if hasattr(r, "__await__"):
+                        await r
+                except BaseException as e:
+                    # an escaping parse/process error must not wedge the
+                    # socket (the fiber dying would leave _nevent elevated
+                    # and no future event would respawn us): drop the conn
+                    import logging
+                    logging.getLogger("brpc_tpu.transport").exception(
+                        "input processing failed; dropping connection")
+                    self.set_failed(e if isinstance(e, Exception)
+                                    else ConnectionError(str(e)))
             with self._nevent_lock:
                 self._nevent -= pending
                 if self._nevent > 0:

@@ -1,0 +1,24 @@
+"""Sync echo client (example/echo_c++/client.cpp)."""
+
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+
+from brpc_tpu.rpc import Channel, ChannelOptions
+
+
+def main(addr: str = "tcp://127.0.0.1:8000", n: int = 10) -> None:
+    ch = Channel(addr, ChannelOptions(timeout_ms=1000))
+    for i in range(int(n)):
+        cntl = ch.call_sync("EchoService", "Echo", f"hello {i}".encode())
+        if cntl.failed():
+            print(f"call failed: {cntl.error_text}")
+        else:
+            print(f"{cntl.response_payload.to_bytes().decode()}  "
+                  f"latency={cntl.latency_us()}us")
+        time.sleep(0.2)
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

@@ -1,0 +1,26 @@
+"""Sync echo server (example/echo_c++/server.cpp). Serves tpu_std AND
+http on one port — try `curl localhost:8000/status`."""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+
+from brpc_tpu.rpc import Server, Service
+
+
+def main(addr: str = "tcp://127.0.0.1:8000") -> None:
+    server = Server()
+    svc = Service("EchoService")
+
+    @svc.method()
+    def Echo(cntl, request):
+        return request
+
+    server.add_service(svc)
+    ep = server.start(addr)
+    print(f"EchoServer listening at {ep} (curl http://{ep.host}:{ep.port}/status)")
+    server.run_until_asked_to_quit()
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

@@ -1,0 +1,52 @@
+"""Bidirectional streaming echo (example/streaming_echo_c++)."""
+
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+
+from brpc_tpu import fiber
+from brpc_tpu.rpc import Channel, Server, ServerOptions, Service
+from brpc_tpu.rpc.stream import StreamOptions, stream_accept
+
+
+def main(n_frames: int = 20) -> None:
+    n_frames = int(n_frames)
+    server = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("StreamEcho")
+
+    @svc.method()
+    def Open(cntl, request):
+        def on_received(stream, msg):
+            stream.write_nowait(b"echo:" + msg.payload.to_bytes())
+        stream_accept(cntl, StreamOptions(on_received=on_received))
+        return b"accepted"
+
+    server.add_service(svc)
+    ep = server.start("mem://streaming-echo")
+
+    got = []
+    ch = Channel(str(ep))
+    cntl = ch.call_sync("StreamEcho", "Open", b"", stream_options=StreamOptions(
+        on_received=lambda s, m: got.append(m.payload.to_bytes())))
+    stream = cntl.stream
+
+    async def producer():
+        for i in range(n_frames):
+            ok = await stream.write(f"frame-{i}".encode())
+            assert ok, "stream write failed"
+
+    f = fiber.spawn(producer)
+    f.join(10)
+    deadline = time.monotonic() + 5
+    while len(got) < n_frames and time.monotonic() < deadline:
+        time.sleep(0.01)
+    print(f"sent {n_frames} frames, got {len(got)} echoes; "
+          f"first={got[0]!r} last={got[-1]!r}")
+    stream.close()
+    server.stop()
+    server.join(2)
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

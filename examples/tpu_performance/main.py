@@ -1,0 +1,51 @@
+"""tpu_performance: the 4B-4MB payload sweep (example/rdma_performance
+rebuilt for tpu:// — BASELINE.md's north-star config). Reports per-size
+throughput and latency over the device lane."""
+
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+
+
+def main(iters: int = 50) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from brpc_tpu.rpc import Channel, ChannelOptions, Server, ServerOptions, Service
+
+    iters = int(iters)
+    server = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("Perf")
+
+    @svc.method()
+    def Echo(cntl, request):
+        cntl.response_device_arrays = cntl.request_device_arrays
+        return b""
+
+    server.add_service(svc)
+    ep = server.start("tpu://perf:1#device=0")
+    ch = Channel(str(ep), ChannelOptions(timeout_ms=60000))
+
+    print(f"{'size':>10} {'avg_us':>10} {'GB/s':>8}")
+    size = 4
+    while size <= 4 * 1024 * 1024:
+        n = max(1, size // 4)
+        payload = jax.block_until_ready(jnp.ones((n,), jnp.float32))
+        for _ in range(5):
+            ch.call_sync("Perf", "Echo", b"", request_device_arrays=[payload])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            cntl = ch.call_sync("Perf", "Echo", b"",
+                                request_device_arrays=[payload])
+            assert not cntl.failed(), cntl.error_text
+        dt = time.perf_counter() - t0
+        gbps = iters * n * 4 * 2 / dt / 1e9
+        print(f"{n*4:>10} {dt/iters*1e6:>10.1f} {gbps:>8.3f}")
+        size *= 4
+    server.stop()
+    server.join(2)
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

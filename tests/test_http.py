@@ -1,0 +1,216 @@
+"""HTTP protocol tests: drive a real server with a raw HTTP client over
+tcp:// (brpc_http_rpc_protocol_unittest style)."""
+
+import json
+import socket as pysocket
+import time
+
+import pytest
+
+from brpc_tpu.rpc import Channel, Server, ServerOptions, Service
+from brpc_tpu.bvar import Adder, unexpose_all
+
+
+def http_get(ep, path, body=None, method=None):
+    method = method or ("POST" if body else "GET")
+    s = pysocket.create_connection((ep.host, ep.port), timeout=5)
+    body = body or b""
+    req = (f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+           f"Content-Length: {len(body)}\r\n\r\n").encode() + body
+    s.sendall(req)
+    data = b""
+    while b"\r\n\r\n" not in data:
+        chunk = s.recv(65536)
+        if not chunk:
+            break
+        data += chunk
+    head, _, rest = data.partition(b"\r\n\r\n")
+    headers = head.decode().split("\r\n")
+    status = int(headers[0].split(" ")[1])
+    clen = 0
+    for h in headers[1:]:
+        if h.lower().startswith("content-length:"):
+            clen = int(h.split(":")[1])
+    while len(rest) < clen:
+        chunk = s.recv(65536)
+        if not chunk:
+            break
+        rest += chunk
+    s.close()
+    return status, rest
+
+
+@pytest.fixture()
+def server():
+    server = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("EchoService")
+
+    @svc.method()
+    def Echo(cntl, request):
+        return request
+
+    @svc.method()
+    async def AsyncEcho(cntl, request):
+        from brpc_tpu import fiber
+        await fiber.sleep(0.001)
+        return b"async:" + request
+
+    server.add_service(svc)
+    ep = server.start("tcp://127.0.0.1:0")
+    yield server, ep
+    server.stop()
+    server.join(2)
+
+
+class TestHttpPages:
+    def test_index(self, server):
+        _, ep = server
+        status, body = http_get(ep, "/")
+        assert status == 200
+        assert b"/status" in body and b"EchoService" in body
+
+    def test_health(self, server):
+        _, ep = server
+        assert http_get(ep, "/health") == (200, b"OK")
+
+    def test_status_json(self, server):
+        srv, ep = server
+        # generate some traffic first over tpu_std on the same port
+        ch = Channel(str(ep))
+        assert not ch.call_sync("EchoService", "Echo", b"x").failed()
+        status, body = http_get(ep, "/status")
+        st = json.loads(body)
+        assert status == 200
+        assert st["processed"] >= 1
+        assert "EchoService" in st["services"]
+
+    def test_vars(self, server):
+        _, ep = server
+        unexpose_all()
+        a = Adder()
+        a.add(7)
+        a.expose("http_test_var")
+        status, body = http_get(ep, "/vars")
+        assert status == 200
+        assert b"http_test_var : 7" in body
+        unexpose_all()
+
+    def test_metrics_prometheus(self, server):
+        _, ep = server
+        unexpose_all()
+        Adder().expose("prom_var")
+        status, body = http_get(ep, "/brpc_metrics")
+        assert status == 200
+        assert b"prom_var 0" in body
+        unexpose_all()
+
+    def test_flags_get_and_set(self, server):
+        _, ep = server
+        from brpc_tpu.butil.flags import flag
+        status, body = http_get(ep, "/flags")
+        assert status == 200 and b"rpcz_enabled" in body
+        status, _ = http_get(ep, "/flags/rpcz_enabled?setvalue=false")
+        assert status == 200
+        assert flag("rpcz_enabled") is False
+        http_get(ep, "/flags/rpcz_enabled?setvalue=true")
+        assert flag("rpcz_enabled") is True
+
+    def test_flags_bad_value(self, server):
+        _, ep = server
+        status, _ = http_get(ep, "/flags/rpcz_max_spans?setvalue=3")
+        assert status == 400  # validator requires >= 16
+
+    def test_404(self, server):
+        _, ep = server
+        status, _ = http_get(ep, "/no/such/page/here")
+        assert status == 404
+
+    def test_rpcz_records_spans(self, server):
+        _, ep = server
+        ch = Channel(str(ep))
+        assert not ch.call_sync("EchoService", "Echo", b"traced").failed()
+        deadline = time.monotonic() + 2
+        while time.monotonic() < deadline:
+            status, body = http_get(ep, "/rpcz")
+            spans = json.loads(body)
+            if any(s["method"] == "Echo" and s["side"] == "server"
+                   for s in spans):
+                break
+            time.sleep(0.05)
+        sides = {(s["side"], s["method"]) for s in spans}
+        assert ("server", "Echo") in sides
+        assert ("client", "Echo") in sides
+        srv_span = next(s for s in spans
+                        if s["side"] == "server" and s["method"] == "Echo")
+        cli_span = next(s for s in spans
+                        if s["side"] == "client" and s["method"] == "Echo")
+        assert srv_span["trace_id"] == cli_span["trace_id"]  # linked trace
+
+
+class TestHttpAuth:
+    def test_auth_gates_http_side_door(self):
+        from brpc_tpu.butil.flags import flag
+        server = Server(ServerOptions(enable_builtin_services=False,
+                                      auth_token="sekrit"))
+        svc = Service("S")
+        svc.register_method("Echo", lambda c, r: r)
+        server.add_service(svc)
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            # no token: RPC access and flag mutation both rejected
+            status, _ = http_get(ep, "/S/Echo", b"x")
+            assert status == 403
+            status, _ = http_get(ep, "/flags/rpcz_enabled?setvalue=false")
+            assert status == 403
+            assert flag("rpcz_enabled") is True
+            # health stays open; token opens the rest
+            assert http_get(ep, "/health")[0] == 200
+            status, body = http_get(ep, "/S/Echo?token=sekrit", b"x")
+            assert (status, body) == (200, b"x")
+        finally:
+            server.stop(); server.join(2)
+
+    def test_bad_content_length_drops_conn_not_server(self):
+        server = Server(ServerOptions(enable_builtin_services=False))
+        svc = Service("S")
+        svc.register_method("Echo", lambda c, r: r)
+        server.add_service(svc)
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            s = pysocket.create_connection((ep.host, ep.port), timeout=2)
+            s.sendall(b"POST /S/Echo HTTP/1.1\r\nContent-Length: abc\r\n\r\n")
+            time.sleep(0.2)
+            s.close()
+            # the server keeps serving fresh connections
+            assert http_get(ep, "/S/Echo", b"ok") == (200, b"ok")
+        finally:
+            server.stop(); server.join(2)
+
+
+class TestHttpRpc:
+    def test_call_method_raw(self, server):
+        _, ep = server
+        status, body = http_get(ep, "/EchoService/Echo", b"over http")
+        assert status == 200
+        assert body == b"over http"
+
+    def test_call_async_method(self, server):
+        _, ep = server
+        status, body = http_get(ep, "/EchoService/AsyncEcho", b"hi")
+        assert status == 200
+        assert body == b"async:hi"
+
+    def test_unknown_method(self, server):
+        _, ep = server
+        status, _ = http_get(ep, "/EchoService/Nope", b"x")
+        assert status == 404
+
+    def test_both_protocols_one_port(self, server):
+        """tpu_std and http multiplex on the same listener (the
+        InputMessenger protocol-sniffing design)."""
+        _, ep = server
+        ch = Channel(str(ep))
+        cntl = ch.call_sync("EchoService", "Echo", b"binary")
+        assert not cntl.failed()
+        status, body = http_get(ep, "/EchoService/Echo", b"text")
+        assert status == 200 and body == b"text"

@@ -200,6 +200,63 @@ class TestErrors:
             server.join(2)
 
 
+class TestTypedAndCompressed:
+    def test_protobuf_typed_method(self, mem_server):
+        from tests.proto import echo_pb2
+        server, ep = mem_server
+        svc = server.services()["EchoService"]
+
+        def TypedEcho(cntl, request):
+            resp = echo_pb2.EchoResponse()
+            resp.message = request.message * max(1, request.times)
+            resp.count = request.times
+            return resp
+        svc.register_method("TypedEcho", TypedEcho,
+                            request_class=echo_pb2.EchoRequest,
+                            response_class=echo_pb2.EchoResponse)
+        ch = Channel(str(ep))
+        req = echo_pb2.EchoRequest(message="hi", times=3)
+        cntl = ch.call_sync("EchoService", "TypedEcho", req,
+                            response_class=echo_pb2.EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        assert cntl.response_msg.message == "hihihi"
+        assert cntl.response_msg.count == 3
+
+    def test_gzip_compression_roundtrip(self, mem_server):
+        from brpc_tpu.rpc.compress import COMPRESS_GZIP
+        server, ep = mem_server
+        ch = Channel(str(ep))
+        cntl = Controller()
+        cntl.compress_type = COMPRESS_GZIP
+        payload = b"A" * 100_000  # compresses well
+        cntl = ch.call_sync("EchoService", "Echo", payload, cntl=cntl)
+        assert not cntl.failed(), cntl.error_text
+        assert cntl.response_payload.to_bytes() == payload
+
+    def test_http_json_typed_method(self):
+        from tests.proto import echo_pb2
+        import json as _json
+        from tests.test_http import http_get
+        server = make_echo_server()
+        svc = server.services()["EchoService"]
+
+        def TypedEcho(cntl, request):
+            return echo_pb2.EchoResponse(message=request.message.upper(),
+                                         count=1)
+        svc.register_method("TypedEcho", TypedEcho,
+                            request_class=echo_pb2.EchoRequest,
+                            response_class=echo_pb2.EchoResponse)
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            status, body = http_get(
+                ep, "/EchoService/TypedEcho",
+                _json.dumps({"message": "json in"}).encode())
+            assert status == 200
+            assert _json.loads(body)["message"] == "JSON IN"
+        finally:
+            server.stop(); server.join(2)
+
+
 class TestBuiltinServices:
     def test_health_and_status(self, mem_server):
         server, ep = mem_server

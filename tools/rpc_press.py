@@ -1,0 +1,71 @@
+"""rpc_press: load generator (tools/rpc_press in the reference).
+
+    python tools/rpc_press.py tcp://127.0.0.1:8000 EchoService Echo \
+        --qps 5000 --duration 10 --payload-size 64 --fibers 16
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/tools", 1)[0])
+
+from brpc_tpu import fiber
+from brpc_tpu.bvar import LatencyRecorder
+from brpc_tpu.rpc import Channel, ChannelOptions
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="brpc_tpu load generator")
+    ap.add_argument("address")
+    ap.add_argument("service")
+    ap.add_argument("method")
+    ap.add_argument("--qps", type=float, default=0, help="0 = unthrottled")
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--payload-size", type=int, default=64)
+    ap.add_argument("--fibers", type=int, default=16)
+    ap.add_argument("--timeout-ms", type=float, default=2000)
+    args = ap.parse_args(argv)
+
+    ch = Channel(args.address, ChannelOptions(timeout_ms=args.timeout_ms))
+    payload = b"x" * args.payload_size
+    lat = LatencyRecorder()
+    stop_at = time.monotonic() + args.duration
+    stats = {"ok": 0, "fail": 0}
+    interval = (args.fibers / args.qps) if args.qps > 0 else 0.0
+
+    async def worker():
+        while time.monotonic() < stop_at:
+            t0 = time.perf_counter_ns()
+            cntl = await ch.call_async(args.service, args.method, payload)
+            if cntl.failed():
+                stats["fail"] += 1
+            else:
+                stats["ok"] += 1
+                lat.record((time.perf_counter_ns() - t0) / 1e3)
+            if interval:
+                spent = (time.perf_counter_ns() - t0) / 1e9
+                if spent < interval:
+                    await fiber.sleep(interval - spent)
+
+    fibers = [fiber.spawn(worker) for _ in range(args.fibers)]
+    last_ok = 0
+    while time.monotonic() < stop_at:
+        time.sleep(1.0)
+        ok = stats["ok"]
+        print(f"qps={ok - last_ok} ok={ok} fail={stats['fail']} "
+              f"avg={lat.latency():.0f}us p99={lat.latency_percentile(0.99):.0f}us")
+        last_ok = ok
+    for f in fibers:
+        f.join(args.timeout_ms / 1e3 + 5)
+    total = stats["ok"] + stats["fail"]
+    print(f"\ntotal={total} ok={stats['ok']} fail={stats['fail']} "
+          f"qps={stats['ok']/args.duration:.0f} avg={lat.latency():.0f}us "
+          f"p50={lat.latency_percentile(0.5):.0f}us "
+          f"p99={lat.latency_percentile(0.99):.0f}us "
+          f"p999={lat.latency_percentile(0.999):.0f}us "
+          f"max={lat.max_latency():.0f}us")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""rpc_replay: re-issue requests recorded by rpc_dump
+(tools/rpc_replay in the reference).
+
+    python tools/rpc_replay.py dump/rpc_dump.1234.jsonl tcp://host:port \
+        --qps 100
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/tools", 1)[0])
+
+from brpc_tpu.rpc import Channel, ChannelOptions
+from brpc_tpu.rpc.rpc_dump import load_dump
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="replay rpc_dump samples")
+    ap.add_argument("dump_file")
+    ap.add_argument("address")
+    ap.add_argument("--qps", type=float, default=0, help="0 = as fast as possible")
+    ap.add_argument("--timeout-ms", type=float, default=2000)
+    args = ap.parse_args(argv)
+
+    ch = Channel(args.address, ChannelOptions(timeout_ms=args.timeout_ms))
+    interval = 1.0 / args.qps if args.qps > 0 else 0.0
+    ok = fail = 0
+    t_start = time.monotonic()
+    for service, method, payload, log_id in load_dump(args.dump_file):
+        t0 = time.monotonic()
+        cntl = ch.call_sync(service, method, payload)
+        if cntl.failed():
+            fail += 1
+            print(f"FAIL {service}.{method}: {cntl.error_text}")
+        else:
+            ok += 1
+        if interval:
+            spent = time.monotonic() - t0
+            if spent < interval:
+                time.sleep(interval - spent)
+    dt = time.monotonic() - t_start
+    print(f"replayed ok={ok} fail={fail} in {dt:.2f}s")
+
+
+if __name__ == "__main__":
+    main()
