@@ -1,0 +1,87 @@
+"""Hashes used on hot paths: crc32c (payload checksums — the reference's
+butil/crc32c.cc role) and murmur3 (consistent-hash LB — the reference's
+butil/third_party/murmurhash3 role, policy/hasher.cpp).
+
+Native-accelerated via brpc_tpu.native when the C++ library is loadable;
+pure-Python fallbacks otherwise, bit-identical.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from brpc_tpu import native
+
+_CRC_POLY = 0x82F63B78
+_crc_table: List[int] = []
+
+
+def _crc_init() -> None:
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ _CRC_POLY if c & 1 else c >> 1
+        _crc_table.append(c)
+
+
+_crc_init()
+
+
+def crc32c(data: bytes, init: int = 0) -> int:
+    v = native.crc32c(data, init)
+    if v is not None:
+        return v
+    crc = init ^ 0xFFFFFFFF
+    for b in data:
+        crc = _crc_table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _rotl64(x: int, r: int) -> int:
+    return ((x << r) | (x >> (64 - r))) & 0xFFFFFFFFFFFFFFFF
+
+
+def _fmix64(k: int) -> int:
+    k ^= k >> 33
+    k = (k * 0xFF51AFD7ED558CCD) & 0xFFFFFFFFFFFFFFFF
+    k ^= k >> 33
+    k = (k * 0xC4CEB9FE1A85EC53) & 0xFFFFFFFFFFFFFFFF
+    k ^= k >> 33
+    return k
+
+
+def murmur3_x64_128(data: bytes, seed: int = 0) -> int:
+    """Returns the 128-bit hash as an int: (h2 << 64) | h1."""
+    v = native.murmur3_x64_128(data, seed)
+    if v is not None:
+        return v
+    M = 0xFFFFFFFFFFFFFFFF
+    c1, c2 = 0x87C37B91114253D5, 0x4CF5AD432745937F
+    h1 = h2 = seed
+    length = len(data)
+    nblocks = length // 16
+    for i in range(nblocks):
+        k1 = int.from_bytes(data[i * 16:i * 16 + 8], "little")
+        k2 = int.from_bytes(data[i * 16 + 8:i * 16 + 16], "little")
+        k1 = (k1 * c1) & M; k1 = _rotl64(k1, 31); k1 = (k1 * c2) & M; h1 ^= k1
+        h1 = _rotl64(h1, 27); h1 = (h1 + h2) & M; h1 = (h1 * 5 + 0x52DCE729) & M
+        k2 = (k2 * c2) & M; k2 = _rotl64(k2, 33); k2 = (k2 * c1) & M; h2 ^= k2
+        h2 = _rotl64(h2, 31); h2 = (h2 + h1) & M; h2 = (h2 * 5 + 0x38495AB5) & M
+    tail = data[nblocks * 16:]
+    k1 = k2 = 0
+    if len(tail) > 8:
+        k2 = int.from_bytes(tail[8:], "little")
+        k2 = (k2 * c2) & M; k2 = _rotl64(k2, 33); k2 = (k2 * c1) & M; h2 ^= k2
+    if tail:
+        k1 = int.from_bytes(tail[:8], "little")
+        k1 = (k1 * c1) & M; k1 = _rotl64(k1, 31); k1 = (k1 * c2) & M; h1 ^= k1
+    h1 ^= length; h2 ^= length
+    h1 = (h1 + h2) & M; h2 = (h2 + h1) & M
+    h1 = _fmix64(h1); h2 = _fmix64(h2)
+    h1 = (h1 + h2) & M; h2 = (h2 + h1) & M
+    return (h2 << 64) | h1
+
+
+def murmur3_32of128(data: bytes, seed: int = 0) -> int:
+    """Low 32 bits — what consistent-hash rings key on."""
+    return murmur3_x64_128(data, seed) & 0xFFFFFFFF

@@ -1,0 +1,186 @@
+"""Native (C++) core of brpc_tpu, loaded via ctypes.
+
+The reference implements its data plane in C++ (butil/iobuf, bthread's
+work-stealing queues, socket write queue, resource pools); this package is
+our native equivalent: a shared library built from ``src/*.cc`` exposing a
+C ABI, with every facility mirrored by a pure-Python fallback so the
+framework still runs where no compiler exists.
+
+Facilities (see the .cc headers for the design citations):
+  hash.cc        crc32c (HW-accelerated) + murmur3_x64_128
+  block_pool.cc  size-classed refcounted block pool (rdma/block_pool design)
+  nbuf.cc        chained zero-copy buffer (butil/iobuf core)
+  framing.cc     tpu_std frame scanner (input_messenger hot loop)
+  queues.cc      Chase-Lev WSQ + wait-free MPSC write queue
+  respool.cc     versioned id resource pool (socket versioned-ref trick)
+
+Use ``lib()`` to get the loaded ctypes library or None.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+c_u8p = ctypes.POINTER(ctypes.c_uint8)
+c_u32 = ctypes.c_uint32
+c_u64 = ctypes.c_uint64
+c_size = ctypes.c_size_t
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    L = lib
+    # hash
+    L.bt_crc32c.restype = c_u32
+    L.bt_crc32c.argtypes = [ctypes.c_char_p, c_size, c_u32]
+    L.bt_murmur3_x64_128.restype = None
+    L.bt_murmur3_x64_128.argtypes = [ctypes.c_char_p, c_size, c_u32,
+                                     ctypes.POINTER(c_u64)]
+    # block pool
+    L.bt_block_alloc.restype = ctypes.c_void_p
+    L.bt_block_alloc.argtypes = [ctypes.c_int]
+    L.bt_block_ref.argtypes = [ctypes.c_void_p]
+    L.bt_block_unref.argtypes = [ctypes.c_void_p]
+    L.bt_block_refcount.restype = c_u32
+    L.bt_block_refcount.argtypes = [ctypes.c_void_p]
+    L.bt_block_size.restype = c_size
+    L.bt_block_size.argtypes = [ctypes.c_int]
+    L.bt_block_class_for.restype = ctypes.c_int
+    L.bt_block_class_for.argtypes = [c_size]
+    L.bt_block_pool_stats.restype = c_u64
+    L.bt_block_pool_stats.argtypes = [ctypes.c_int, ctypes.c_int]
+    # nbuf
+    L.bt_nbuf_create.restype = ctypes.c_void_p
+    L.bt_nbuf_destroy.argtypes = [ctypes.c_void_p]
+    L.bt_nbuf_clear.argtypes = [ctypes.c_void_p]
+    L.bt_nbuf_size.restype = c_size
+    L.bt_nbuf_size.argtypes = [ctypes.c_void_p]
+    L.bt_nbuf_block_count.restype = c_size
+    L.bt_nbuf_block_count.argtypes = [ctypes.c_void_p]
+    L.bt_nbuf_append.restype = c_size
+    L.bt_nbuf_append.argtypes = [ctypes.c_void_p, ctypes.c_char_p, c_size]
+    L.bt_nbuf_append_nbuf.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    L.bt_nbuf_cut.restype = ctypes.c_void_p
+    L.bt_nbuf_cut.argtypes = [ctypes.c_void_p, c_size]
+    L.bt_nbuf_pop_front.restype = c_size
+    L.bt_nbuf_pop_front.argtypes = [ctypes.c_void_p, c_size]
+    L.bt_nbuf_copy_to.restype = c_size
+    L.bt_nbuf_copy_to.argtypes = [ctypes.c_void_p, ctypes.c_char_p, c_size, c_size]
+    L.bt_nbuf_ref_at.restype = ctypes.c_int
+    L.bt_nbuf_ref_at.argtypes = [ctypes.c_void_p, c_size,
+                                 ctypes.POINTER(ctypes.c_void_p),
+                                 ctypes.POINTER(c_size)]
+    # framing
+    L.bt_trpc_scan.restype = ctypes.c_long
+    L.bt_trpc_scan.argtypes = [ctypes.c_char_p, c_size, ctypes.POINTER(c_u64),
+                               c_size, ctypes.POINTER(c_size),
+                               ctypes.POINTER(c_size)]
+    L.bt_trpc_probe.restype = ctypes.c_int
+    L.bt_trpc_probe.argtypes = [ctypes.c_char_p, c_size,
+                                ctypes.POINTER(c_u32), ctypes.POINTER(c_u32)]
+    # wsq
+    L.bt_wsq_create.restype = ctypes.c_void_p
+    L.bt_wsq_create.argtypes = [c_size]
+    L.bt_wsq_destroy.argtypes = [ctypes.c_void_p]
+    L.bt_wsq_size.restype = c_size
+    L.bt_wsq_size.argtypes = [ctypes.c_void_p]
+    L.bt_wsq_push.restype = ctypes.c_bool
+    L.bt_wsq_push.argtypes = [ctypes.c_void_p, c_u64]
+    L.bt_wsq_pop.restype = ctypes.c_bool
+    L.bt_wsq_pop.argtypes = [ctypes.c_void_p, ctypes.POINTER(c_u64)]
+    L.bt_wsq_steal.restype = ctypes.c_bool
+    L.bt_wsq_steal.argtypes = [ctypes.c_void_p, ctypes.POINTER(c_u64)]
+    # mpsc
+    L.bt_mpsc_create.restype = ctypes.c_void_p
+    L.bt_mpsc_destroy.argtypes = [ctypes.c_void_p]
+    L.bt_mpsc_push.restype = ctypes.c_bool
+    L.bt_mpsc_push.argtypes = [ctypes.c_void_p, c_u64]
+    L.bt_mpsc_drain.restype = c_size
+    L.bt_mpsc_drain.argtypes = [ctypes.c_void_p, ctypes.POINTER(c_u64), c_size]
+    L.bt_mpsc_pushed.restype = c_u64
+    L.bt_mpsc_pushed.argtypes = [ctypes.c_void_p]
+    # respool
+    L.bt_respool_create.restype = ctypes.c_void_p
+    L.bt_respool_create.argtypes = [c_size]
+    L.bt_respool_destroy.argtypes = [ctypes.c_void_p]
+    L.bt_respool_acquire.restype = c_u64
+    L.bt_respool_acquire.argtypes = [ctypes.c_void_p, c_u64]
+    L.bt_respool_get.restype = ctypes.c_bool
+    L.bt_respool_get.argtypes = [ctypes.c_void_p, c_u64, ctypes.POINTER(c_u64)]
+    L.bt_respool_release.restype = ctypes.c_bool
+    L.bt_respool_release.argtypes = [ctypes.c_void_p, c_u64]
+    L.bt_respool_live.restype = c_u64
+    L.bt_respool_live.argtypes = [ctypes.c_void_p]
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first call. None if unavailable
+    (no compiler / build failure) — callers fall back to pure Python."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("BRPC_TPU_NO_NATIVE"):
+            return None
+        try:
+            from brpc_tpu.native.build import build
+            path = build()
+            L = ctypes.CDLL(path)
+            _declare(L)
+            _lib = L
+        except Exception:
+            _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+# ------------------------------------------------------ high-level wraps
+
+
+def crc32c(data: bytes, init: int = 0) -> Optional[int]:
+    L = lib()
+    if L is None:
+        return None
+    return L.bt_crc32c(bytes(data), len(data), init)
+
+
+def murmur3_x64_128(data: bytes, seed: int = 0) -> Optional[int]:
+    L = lib()
+    if L is None:
+        return None
+    out = (c_u64 * 2)()
+    L.bt_murmur3_x64_128(bytes(data), len(data), seed, out)
+    return (int(out[1]) << 64) | int(out[0])
+
+
+def trpc_scan(data: bytes, max_frames: int = 256):
+    """Scan a contiguous window for complete TRPC frames.
+
+    Returns (frames, consumed, need) where frames is a list of
+    (offset, total_len), or None when the native lib is unavailable.
+    Raises ValueError on bad magic.
+    """
+    L = lib()
+    if L is None:
+        return None
+    out = (c_u64 * (2 * max_frames))()
+    consumed = c_size()
+    need = c_size()
+    n = L.bt_trpc_scan(data, len(data), out, max_frames,
+                       ctypes.byref(consumed), ctypes.byref(need))
+    if n < 0:
+        raise ValueError("not a TRPC stream")
+    frames = [(int(out[2 * i]), int(out[2 * i + 1])) for i in range(n)]
+    return frames, int(consumed.value), int(need.value)

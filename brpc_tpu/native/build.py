@@ -1,0 +1,50 @@
+"""Builds libbrpc_tpu_native.so from src/*.cc with g++.
+
+Invoked automatically on first import of brpc_tpu.native (and rebuilt when
+any source is newer than the library). Can also be run directly:
+    python -m brpc_tpu.native.build
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC_DIR = os.path.join(_DIR, "src")
+LIB_PATH = os.path.join(_DIR, "libbrpc_tpu_native.so")
+
+CXX = os.environ.get("CXX", "g++")
+CXXFLAGS = ["-O2", "-g", "-std=c++17", "-fPIC", "-shared", "-pthread",
+            "-Wall", "-Wextra", "-fno-exceptions"]
+
+
+def sources() -> list:
+    return sorted(
+        os.path.join(SRC_DIR, f) for f in os.listdir(SRC_DIR) if f.endswith(".cc")
+    )
+
+
+def needs_build() -> bool:
+    if not os.path.exists(LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(LIB_PATH)
+    return any(os.path.getmtime(s) > lib_mtime for s in sources())
+
+
+def build(force: bool = False) -> str:
+    """Compile if stale; returns the library path. Raises on failure."""
+    if not force and not needs_build():
+        return LIB_PATH
+    cmd = [CXX, *CXXFLAGS, "-o", LIB_PATH, *sources()]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed:\n$ {' '.join(cmd)}\n{proc.stderr}")
+    return LIB_PATH
+
+
+if __name__ == "__main__":
+    path = build(force="--force" in sys.argv)
+    print(path)

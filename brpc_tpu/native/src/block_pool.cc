@@ -1,0 +1,179 @@
+// Size-classed refcounted block pool — the native allocator under TpuBuf
+// host blocks and pre-posted transport receive buffers.
+//
+// Design follows the reference's RDMA registered-memory pool
+// (rdma/block_pool.cpp:52,271-340): three size classes (8KB / 64KB / 2MB),
+// blocks carved out of large regions, per-class global freelists, and a
+// per-thread cache in front so the hot path takes no lock. Regions are
+// kept for the process lifetime (in the TPU build a region maps 1:1 onto a
+// host-pinned DMA arena that PjRt can transfer from without staging).
+//
+// Each block has a 64-byte header (class id + atomic refcount) directly
+// before the data pointer handed to callers, so unref needs no lookup.
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+constexpr int kNumClasses = 3;
+constexpr size_t kClassSizes[kNumClasses] = {8 * 1024, 64 * 1024, 2 * 1024 * 1024};
+constexpr size_t kHeaderSize = 64;  // keeps data 64B-aligned (cacheline / DMA)
+constexpr size_t kRegionBytes = 16 * 1024 * 1024;
+constexpr int kTlsCacheCap[kNumClasses] = {64, 16, 2};
+
+struct BlockHeader {
+  std::atomic<uint32_t> refcount;
+  uint32_t size_class;
+  BlockHeader* next_free;  // freelist link (only while free)
+  char pad[kHeaderSize - sizeof(std::atomic<uint32_t>) - sizeof(uint32_t) -
+           sizeof(BlockHeader*)];
+};
+static_assert(sizeof(BlockHeader) == kHeaderSize, "header must stay 64B");
+
+struct ClassPool {
+  std::mutex mu;
+  BlockHeader* free_head = nullptr;
+  size_t free_count = 0;
+  std::vector<void*> regions;
+  std::atomic<uint64_t> total_blocks{0};
+  std::atomic<uint64_t> live_blocks{0};
+};
+
+ClassPool g_pools[kNumClasses];
+
+struct TlsCache {
+  BlockHeader* head[kNumClasses] = {nullptr, nullptr, nullptr};
+  int count[kNumClasses] = {0, 0, 0};
+  ~TlsCache() {
+    // thread exit: hand cached blocks back to the global freelist
+    for (int c = 0; c < kNumClasses; ++c) {
+      while (head[c]) {
+        BlockHeader* h = head[c];
+        head[c] = h->next_free;
+        std::lock_guard<std::mutex> lk(g_pools[c].mu);
+        h->next_free = g_pools[c].free_head;
+        g_pools[c].free_head = h;
+        ++g_pools[c].free_count;
+      }
+    }
+  }
+};
+
+thread_local TlsCache tls_cache;
+
+BlockHeader* header_of(void* data) {
+  return reinterpret_cast<BlockHeader*>(static_cast<char*>(data) - kHeaderSize);
+}
+
+void* data_of(BlockHeader* h) {
+  return reinterpret_cast<char*>(h) + kHeaderSize;
+}
+
+// Carve a fresh region into blocks and push them on the class freelist.
+// Called with the class mutex held.
+bool extend_locked(int cls) {
+  ClassPool& pool = g_pools[cls];
+  const size_t stride = kHeaderSize + kClassSizes[cls];
+  const size_t nblocks = kRegionBytes >= stride ? kRegionBytes / stride : 1;
+  void* region = nullptr;
+  if (posix_memalign(&region, 64, nblocks * stride) != 0) return false;
+  pool.regions.push_back(region);
+  for (size_t i = 0; i < nblocks; ++i) {
+    BlockHeader* h =
+        reinterpret_cast<BlockHeader*>(static_cast<char*>(region) + i * stride);
+    new (&h->refcount) std::atomic<uint32_t>(0);
+    h->size_class = static_cast<uint32_t>(cls);
+    h->next_free = pool.free_head;
+    pool.free_head = h;
+  }
+  pool.free_count += nblocks;
+  pool.total_blocks.fetch_add(nblocks, std::memory_order_relaxed);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+int bt_block_class_for(size_t nbytes) {
+  for (int c = 0; c < kNumClasses; ++c)
+    if (nbytes <= kClassSizes[c]) return c;
+  return -1;
+}
+
+size_t bt_block_size(int size_class) {
+  if (size_class < 0 || size_class >= kNumClasses) return 0;
+  return kClassSizes[size_class];
+}
+
+// Returns the data pointer (refcount == 1), or NULL on OOM/bad class.
+void* bt_block_alloc(int cls) {
+  if (cls < 0 || cls >= kNumClasses) return nullptr;
+  TlsCache& tc = tls_cache;
+  BlockHeader* h = tc.head[cls];
+  if (h != nullptr) {
+    tc.head[cls] = h->next_free;
+    --tc.count[cls];
+  } else {
+    ClassPool& pool = g_pools[cls];
+    std::lock_guard<std::mutex> lk(pool.mu);
+    if (pool.free_head == nullptr && !extend_locked(cls)) return nullptr;
+    h = pool.free_head;
+    pool.free_head = h->next_free;
+    --pool.free_count;
+  }
+  h->refcount.store(1, std::memory_order_relaxed);
+  g_pools[cls].live_blocks.fetch_add(1, std::memory_order_relaxed);
+  return data_of(h);
+}
+
+void bt_block_ref(void* data) {
+  header_of(data)->refcount.fetch_add(1, std::memory_order_relaxed);
+}
+
+uint32_t bt_block_refcount(void* data) {
+  return header_of(data)->refcount.load(std::memory_order_relaxed);
+}
+
+void bt_block_unref(void* data) {
+  BlockHeader* h = header_of(data);
+  if (h->refcount.fetch_sub(1, std::memory_order_acq_rel) != 1) return;
+  const int cls = h->size_class;
+  g_pools[cls].live_blocks.fetch_sub(1, std::memory_order_relaxed);
+  TlsCache& tc = tls_cache;
+  if (tc.count[cls] < kTlsCacheCap[cls]) {
+    h->next_free = tc.head[cls];
+    tc.head[cls] = h;
+    ++tc.count[cls];
+    return;
+  }
+  ClassPool& pool = g_pools[cls];
+  std::lock_guard<std::mutex> lk(pool.mu);
+  h->next_free = pool.free_head;
+  pool.free_head = h;
+  ++pool.free_count;
+}
+
+// what: 0 = total blocks ever carved, 1 = live (ref'd) blocks,
+//       2 = global freelist length (excludes TLS caches)
+uint64_t bt_block_pool_stats(int cls, int what) {
+  if (cls < 0 || cls >= kNumClasses) return 0;
+  ClassPool& pool = g_pools[cls];
+  switch (what) {
+    case 0: return pool.total_blocks.load(std::memory_order_relaxed);
+    case 1: return pool.live_blocks.load(std::memory_order_relaxed);
+    case 2: {
+      std::lock_guard<std::mutex> lk(pool.mu);
+      return pool.free_count;
+    }
+    default: return 0;
+  }
+}
+
+}  // extern "C"

@@ -21,9 +21,10 @@ from __future__ import annotations
 import json
 import time
 import urllib.parse
+from collections import deque
 from typing import Optional, Tuple
 
-from brpc_tpu.butil.flags import list_flags, set_flag
+from brpc_tpu.butil.flags import flag, list_flags, set_flag
 from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.protocol.registry import (
     PARSE_NOT_ENOUGH_DATA, PARSE_OK, PARSE_TRY_OTHERS, Protocol,
@@ -33,7 +34,6 @@ from brpc_tpu.protocol.registry import (
 _METHODS = (b"GET ", b"POST ", b"PUT ", b"DELETE ", b"HEAD ", b"OPTIONS ",
             b"PATCH ")
 _MAX_HEADER = 64 * 1024
-_MAX_BODY = 256 * 1024 * 1024
 
 
 class HttpRequest:
@@ -93,7 +93,7 @@ class HttpProtocol(Protocol):
             body_len = int(headers.get("content-length", "0") or "0")
         except ValueError:
             return PARSE_TRY_OTHERS, None  # malformed: drop the connection
-        if body_len < 0 or body_len > _MAX_BODY:
+        if body_len < 0 or body_len > flag("max_body_size"):
             return PARSE_TRY_OTHERS, None
         total = sep + 4 + body_len
         if portal.size < total:
@@ -107,6 +107,32 @@ class HttpProtocol(Protocol):
                                      headers, body, keep_alive)
 
     # -------------------------------------------------------------- process
+    def process_inline(self, req: HttpRequest, socket) -> bool:
+        """HTTP/1.1 requires responses in request order: pipelined
+        requests must NOT fan out to concurrent fibers (the
+        InputMessenger default). Queue per connection and drain in
+        parse order with a single fiber."""
+        pending = socket.user_data.setdefault("http_pending", deque())
+        pending.append(req)
+        if not socket.user_data.get("http_draining"):
+            socket.user_data["http_draining"] = True
+            socket._control.spawn(self._drain_ordered, socket,
+                                  name="http_serial")
+        return True
+
+    async def _drain_ordered(self, socket):
+        pending = socket.user_data["http_pending"]
+        while True:
+            try:
+                req = pending.popleft()
+            except IndexError:
+                socket.user_data["http_draining"] = False
+                if not pending:  # re-check: producer may have raced
+                    return
+                socket.user_data["http_draining"] = True
+                continue
+            await self.process(req, socket)
+
     async def process(self, req: HttpRequest, socket):
         server = socket.user_data.get("server")
         if server is None:
@@ -116,9 +142,16 @@ class HttpProtocol(Protocol):
             status, ctype, body = await self._route(server, req)
         except Exception as e:
             status, ctype, body = 500, "text/plain", f"error: {e}".encode()
-        socket.write(_response(status, body, ctype, req.keep_alive))
-        if not req.keep_alive:
-            socket.set_failed(ConnectionError("http connection: close"))
+        if req.keep_alive:
+            socket.write(_response(status, body, ctype, True))
+        else:
+            # close only after the response actually flushes — set_failed
+            # right after write() would race the async keep_write fiber
+            # and drop the response
+            socket.write(
+                _response(status, body, ctype, False),
+                on_done=lambda ok: socket.set_failed(
+                    ConnectionError("http connection: close")))
 
     # --------------------------------------------------------------- routes
     async def _route(self, server, req: HttpRequest):

@@ -140,8 +140,15 @@ class Channel:
             from brpc_tpu.rpc.span import finish_span, start_client_span
             span = start_client_span(cntl, service_name, method_name)
             span.request_size = len(cntl._request_bytes)
-            cntl._complete_hooks.append(
-                lambda c, s=span: finish_span(s, c))
+            # a reused Controller must not accumulate span hooks across
+            # calls (stale spans would be re-finished with this call's
+            # data and resubmitted)
+            cntl._complete_hooks = [
+                h for h in cntl._complete_hooks
+                if not getattr(h, "_span_hook", False)]
+            hook = lambda c, s=span: finish_span(s, c)  # noqa: E731
+            hook._span_hook = True
+            cntl._complete_hooks.append(hook)
         cntl._register_call()
         self._issue_rpc(cntl)
         # deadline timer: final — no retry after it fires (HandleTimeout)

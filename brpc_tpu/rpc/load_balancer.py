@@ -116,13 +116,15 @@ class ConsistentHashLB(_SnapshotLB):
         super().__init__()
         self._ring: List[Tuple[int, EndPoint]] = []
 
+    @staticmethod
+    def _hash(data: bytes) -> int:
+        return int.from_bytes(hashlib.md5(data).digest()[:8], "big")
+
     def _on_reset(self, snapshot):
         ring = []
         for s in snapshot:
             for v in range(self.VIRTUAL_NODES):
-                h = int.from_bytes(
-                    hashlib.md5(f"{s}#{v}".encode()).digest()[:8], "big")
-                ring.append((h, s))
+                ring.append((self._hash(f"{s}#{v}".encode()), s))
         ring.sort(key=lambda t: t[0])
         self._ring = ring
 
@@ -130,8 +132,7 @@ class ConsistentHashLB(_SnapshotLB):
         ring = self._ring
         if not ring:
             return None
-        key = request_key or b""
-        h = int.from_bytes(hashlib.md5(key).digest()[:8], "big")
+        h = self._hash(request_key or b"")
         idx = bisect.bisect_left(ring, (h, ))
         n = len(ring)
         for i in range(n):
@@ -139,6 +140,19 @@ class ConsistentHashLB(_SnapshotLB):
             if not exclude or s not in exclude:
                 return s
         return None
+
+
+class MurmurHashLB(ConsistentHashLB):
+    """c_murmurhash — the same ketama ring keyed by murmur3
+    (policy/hasher.cpp MurmurHash32), native-accelerated via
+    brpc_tpu.native."""
+
+    name = "c_murmurhash"
+
+    @staticmethod
+    def _hash(data: bytes) -> int:
+        from brpc_tpu.butil.hash import murmur3_32of128
+        return murmur3_32of128(data)
 
 
 class LocalityAwareLB(_SnapshotLB):
@@ -180,6 +194,7 @@ _factories = {
     "random": RandomLB,
     "wrr": WeightedRoundRobinLB,
     "c_hash": ConsistentHashLB,
+    "c_murmurhash": MurmurHashLB,
     "la": LocalityAwareLB,
 }
 

@@ -139,9 +139,13 @@ class NamingServiceThread:
                 with outer._lock:
                     outer._servers = list(servers)
                     watchers = list(outer._watchers)
-                outer._first_update.set()
+                # notify watchers BEFORE releasing wait_first_update():
+                # a ClusterChannel constructor blocked on that event must
+                # find its LB already seeded when it wakes, or its first
+                # call races an empty server list
                 for w in watchers:
                     w(list(servers))
+                outer._first_update.set()
 
         self._fiber = self._control.spawn(
             self._ns.run, self._param, _Actions(), self._stop,

@@ -83,7 +83,34 @@ class Server:
         self._endpoint = self._listener.endpoint
         self._running = True
         self._stopped_event.clear()
+        self._maybe_install_sigterm()
         return self._endpoint
+
+    def _maybe_install_sigterm(self) -> None:
+        """graceful_quit_on_sigterm (server.cpp graceful Stop/Join:691):
+        SIGTERM drains this server instead of killing the process
+        mid-request. Only installable from the main thread; chained so a
+        prior handler still runs."""
+        from brpc_tpu.butil.flags import flag
+        if not flag("graceful_quit_on_sigterm"):
+            return
+        import signal
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def _on_term(signum, frame):
+                try:
+                    self.stop()
+                finally:
+                    if callable(prev):
+                        prev(signum, frame)
+                    elif prev == signal.SIG_DFL:
+                        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                        signal.raise_signal(signal.SIGTERM)
+
+            signal.signal(signal.SIGTERM, _on_term)
+        except ValueError:
+            pass  # not the main thread: flag is best-effort there
 
     @property
     def endpoint(self) -> Optional[EndPoint]:

@@ -75,10 +75,16 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
             from brpc_tpu.rpc.compress import decompress
             payload_bytes = decompress(payload_bytes, meta.compress_type)
             cntl.compress_type = meta.compress_type  # reply in kind
-        # dump AFTER decompression so rpc_replay re-issues plaintext
-        from brpc_tpu.rpc.rpc_dump import global_dumper
-        global_dumper.maybe_dump(req_meta.service_name, req_meta.method_name,
-                                 payload_bytes, req_meta.log_id)
+        # dump AFTER decompression so rpc_replay re-issues plaintext.
+        # Observability must never fail serving: a broken rpc_dump_dir
+        # (perms, disk full) is swallowed here, not turned into EREQUEST.
+        try:
+            from brpc_tpu.rpc.rpc_dump import global_dumper
+            global_dumper.maybe_dump(req_meta.service_name,
+                                     req_meta.method_name,
+                                     payload_bytes, req_meta.log_id)
+        except Exception:
+            pass
         if method.request_class is not None:
             request = method.request_class()
             request.ParseFromString(payload_bytes)

@@ -77,6 +77,12 @@ class SpanCollector:
         if not flag("rpcz_enabled"):
             return
         with self._lock:
+            # honor runtime /flags mutation of rpcz_max_spans: resize the
+            # ring when the flag moved (constructor-captured maxlen would
+            # make the advertised knob a no-op)
+            want = self._capacity or flag("rpcz_max_spans")
+            if want != self._ring.maxlen:
+                self._ring = deque(self._ring, maxlen=want)
             self._ring.append(span)
 
     def recent(self, n: int = 100) -> List[Span]:
