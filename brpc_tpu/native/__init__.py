@@ -2,19 +2,28 @@
 
 The reference implements its data plane in C++ (butil/iobuf, bthread's
 work-stealing queues, socket write queue, resource pools); this package is
-our native equivalent: a shared library built from ``src/*.cc`` exposing a
-C ABI, with every facility mirrored by a pure-Python fallback so the
-framework still runs where no compiler exists.
+our native counterpart: a shared library built from ``src/*.cc`` exposing
+a C ABI.
 
-Facilities (see the .cc headers for the design citations):
-  hash.cc        crc32c (HW-accelerated) + murmur3_x64_128
-  block_pool.cc  size-classed refcounted block pool (rdma/block_pool design)
-  nbuf.cc        chained zero-copy buffer (butil/iobuf core)
-  framing.cc     tpu_std frame scanner (input_messenger hot loop)
-  queues.cc      Chase-Lev WSQ + wait-free MPSC write queue
-  respool.cc     versioned id resource pool (socket versioned-ref trick)
+What is wired where today:
+  hash.cc        crc32c (HW-accelerated) + murmur3_x64_128 — consumed by
+                 butil.hash and the c_murmurhash load balancer, with
+                 bit-identical pure-Python fallbacks.
+  framing.cc     TRPC frame scanner/probe — `trpc_scan` for batch frame
+                 cutting of pipelined bursts.
+  block_pool.cc  size-classed refcounted block pool (rdma/block_pool
+  nbuf.cc        design) and the chained zero-copy buffer over it — the
+                 native data-plane substrate (C++-side counterpart of
+                 butil.iobuf; parity-tested against it).
+  queues.cc      Chase-Lev WSQ + wait-free MPSC write queue — the native
+                 scheduler/socket-queue primitives (Python's fiber
+                 scheduler keeps its own implementation; these carry the
+                 reference semantics incl. the UNCONNECTED-sentinel
+                 write-queue contract, concurrency-tested).
+  respool.cc     versioned id resource pool (socket versioned-ref trick).
 
-Use ``lib()`` to get the loaded ctypes library or None.
+Use ``lib()`` to get the loaded ctypes library or None (no compiler /
+build failure — callers must fall back to pure Python).
 """
 
 from __future__ import annotations

@@ -4,8 +4,8 @@
 // BlockRef iobuf.h:77) natively: a buffer is a list of (block, offset,
 // length) refs onto pooled refcounted blocks (block_pool.cc); append
 // copies into the writable tail block, while cut / append_nbuf / slice
-// move refs only — never payload bytes. Python's IOBuf delegates its
-// byte-path to this through ctypes when the native library is loaded.
+// move refs only — never payload bytes. Exposed to Python as
+// butil.iobuf.NativeIOBuf via ctypes.
 
 #include <cstddef>
 #include <cstdint>

@@ -104,8 +104,25 @@ namespace {
 
 struct MpscNode {
   uint64_t value;
-  MpscNode* next;
+  std::atomic<MpscNode*> next;
 };
+
+// Sentinel marking "producer exchanged the head but hasn't linked next
+// yet" — the reference's WriteRequest::UNCONNECTED trick
+// (socket.cpp IsWriteComplete): the consumer spins the handful of cycles
+// until the producer stores the real link, instead of the producer
+// publishing an unlinked node (which would let a concurrent drain orphan
+// the rest of the queue and free the node under the producer).
+MpscNode* const kUnlinked = reinterpret_cast<MpscNode*>(1);
+
+MpscNode* resolve_next(MpscNode* n) {
+  MpscNode* nx = n->next.load(std::memory_order_acquire);
+  while (nx == kUnlinked) {
+    // producer is between exchange and link: momentary by construction
+    nx = n->next.load(std::memory_order_acquire);
+  }
+  return nx;
+}
 
 }  // namespace
 
@@ -123,9 +140,13 @@ bt_mpsc* bt_mpsc_create() { return new bt_mpsc(); }
 void bt_mpsc_destroy(bt_mpsc* q) {
   if (q == nullptr) return;
   MpscNode* n = q->head.exchange(nullptr, std::memory_order_acquire);
-  while (n) { MpscNode* nx = n->next; delete n; n = nx; }
+  while (n) { MpscNode* nx = resolve_next(n); delete n; n = nx; }
   n = q->pending;
-  while (n) { MpscNode* nx = n->next; delete n; n = nx; }
+  while (n) {
+    MpscNode* nx = n->next.load(std::memory_order_relaxed);
+    delete n;
+    n = nx;
+  }
   delete q;
 }
 
@@ -133,9 +154,9 @@ void bt_mpsc_destroy(bt_mpsc* q) {
 // producer becomes the writer (starts the KeepWrite fiber), everyone else
 // just leaves their node and returns (socket.cpp:1924-2005 contract).
 bool bt_mpsc_push(bt_mpsc* q, uint64_t v) {
-  MpscNode* n = new MpscNode{v, nullptr};
+  MpscNode* n = new MpscNode{v, {kUnlinked}};
   MpscNode* prev = q->head.exchange(n, std::memory_order_acq_rel);
-  n->next = prev;  // list is newest→oldest; consumer reverses
+  n->next.store(prev, std::memory_order_release);
   q->pushed.fetch_add(1, std::memory_order_relaxed);
   return prev == nullptr;
 }
@@ -147,18 +168,18 @@ size_t bt_mpsc_drain(bt_mpsc* q, uint64_t* out, size_t max) {
     if (q->pending == nullptr) {
       MpscNode* grabbed = q->head.exchange(nullptr, std::memory_order_acq_rel);
       if (grabbed == nullptr) break;
-      // reverse newest→oldest into FIFO
+      // reverse newest→oldest into FIFO, resolving in-flight links
       MpscNode* rev = nullptr;
       while (grabbed) {
-        MpscNode* nx = grabbed->next;
-        grabbed->next = rev;
+        MpscNode* nx = resolve_next(grabbed);
+        grabbed->next.store(rev, std::memory_order_relaxed);
         rev = grabbed;
         grabbed = nx;
       }
       q->pending = rev;
     }
     MpscNode* node = q->pending;
-    q->pending = node->next;
+    q->pending = node->next.load(std::memory_order_relaxed);
     out[n++] = node->value;
     delete node;
   }

@@ -1,0 +1,234 @@
+"""Blockwise (flash) attention — the on-device compute body for
+long-payload / long-sequence RPC services, and the per-step inner kernel
+of ring attention (ops/ring_attention.py).
+
+Two interchangeable backends with identical numerics:
+
+  * a Pallas TPU kernel (`_flash_pallas`): grid over (batch*heads,
+    q_blocks), fori_loop over k blocks, online-softmax running (m, l, o)
+    accumulators in VMEM scratch — MXU-shaped 128-multiple tiles,
+    bfloat16-friendly, O(seq) memory;
+  * a lax implementation (`_flash_lax`): the same online-softmax
+    recurrence as a lax.scan over k blocks — used off-TPU (tests run it
+    on the 8-device CPU mesh) and as the autodiff-friendly reference.
+
+The reference framework has no attention op — this is TPU-native new
+capability sitting where its large-payload streaming sits (SURVEY.md §5
+"long-context": blockwise transfer + blockwise compute).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() clean in bf16
+
+
+# --------------------------------------------------------------- helpers
+
+def _online_softmax_step(q, k, v, m, l, o, scale, mask=None):
+    """One blockwise online-softmax update.
+
+    q: [sq, d]; k, v: [sk, d]; m, l: [sq]; o: [sq, d] (fp32 accumulators).
+    mask: optional [sq, sk] bool, True = attend.
+    Returns updated (m, l, o).
+    """
+    s = jnp.einsum("qd,kd->qk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # rows that have seen nothing stay at NEG_INF; exp(NEG_INF-NEG_INF)=1
+    # would pollute l, so clamp the correction for untouched rows
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    l_new = l * correction + p.sum(axis=-1)
+    o_new = o * correction[:, None] + jnp.einsum(
+        "qk,kd->qd", p, v.astype(jnp.float32))
+    return m_new, l_new, o_new
+
+
+def _finalize(m, l, o, dtype):
+    # all-masked rows (l == 0) emit zeros, not NaNs
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return (o / safe_l[:, None]).astype(dtype), m, l
+
+
+# ----------------------------------------------------------- lax backend
+
+def _flash_lax(q, k, v, scale, causal, block_k, q_offset=0, k_offset=0):
+    """[sq, d] x [sk, d] blockwise attention via lax.scan over k blocks.
+    q_offset/k_offset give the global positions of row/col 0 (ring
+    attention passes the shard offsets for causal masking)."""
+    sq, d = q.shape
+    sk = k.shape[0]
+    block_k = min(block_k, sk)
+    nblocks = (sk + block_k - 1) // block_k
+    pad = nblocks * block_k - sk
+    if pad:
+        k = jnp.pad(k, ((0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, pad), (0, 0)))
+    kb = k.reshape(nblocks, block_k, d)
+    vb = v.reshape(nblocks, block_k, d)
+
+    q_pos = q_offset + jnp.arange(sq)
+
+    def step(carry, blk):
+        m, l, o = carry
+        kblk, vblk, bidx = blk
+        k_pos = k_offset + bidx * block_k + jnp.arange(block_k)
+        mask = k_pos[None, :] < (k_offset + sk)  # padding mask
+        if causal:
+            mask = mask & (k_pos[None, :] <= q_pos[:, None])
+        m, l, o = _online_softmax_step(q, kblk, vblk, m, l, o, scale, mask)
+        return (m, l, o), None
+
+    m0 = jnp.full((sq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((sq,), jnp.float32)
+    o0 = jnp.zeros((sq, d), jnp.float32)
+    (m, l, o), _ = lax.scan(step, (m0, l0, o0),
+                            (kb, vb, jnp.arange(nblocks)))
+    out, _, _ = _finalize(m, l, o, q.dtype)
+    return out
+
+
+# -------------------------------------------------------- pallas backend
+
+def _flash_pallas_2d(q, k, v, scale, causal, block_q, block_k,
+                     interpret=False):
+    """[sq, d] x [sk, d] flash attention as a Pallas TPU kernel."""
+    from jax.experimental import pallas as pl
+
+    sq, d = q.shape
+    sk = k.shape[0]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    n_q = (sq + block_q - 1) // block_q
+    n_k = (sk + block_k - 1) // block_k
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        qi = pl.program_id(0)
+        qblk = q_ref[...].astype(jnp.float32)  # [block_q, d]
+
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+
+        def body(ki, carry):
+            m, l, o = carry
+            kblk = k_ref[pl.dslice(ki * block_k, block_k), :].astype(
+                jnp.float32)
+            vblk = v_ref[pl.dslice(ki * block_k, block_k), :].astype(
+                jnp.float32)
+            s = jnp.dot(qblk, kblk.T,
+                        preferred_element_type=jnp.float32) * scale
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = k_pos < sk
+            if causal:
+                mask = mask & (k_pos <= q_pos)
+            s = jnp.where(mask, s, NEG_INF)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            corr = jnp.exp(m - m_new)
+            p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+            l_new = l * corr + p.sum(axis=-1)
+            o_new = o * corr[:, None] + jnp.dot(
+                p, vblk, preferred_element_type=jnp.float32)
+            return m_new, l_new, o_new
+
+        m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((block_q,), jnp.float32)
+        o0 = jnp.zeros((block_q, d), jnp.float32)
+        if causal:
+            # only k blocks that can be visible to this q block
+            n_vis = lax.min(((qi + 1) * block_q + block_k - 1) // block_k,
+                            n_k)
+        else:
+            n_vis = n_k
+        m, l, o = lax.fori_loop(0, n_vis, body, (m0, l0, o0))
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (o / safe_l[:, None]).astype(o_ref.dtype)
+
+    pad_q = n_q * block_q - sq
+    qp = jnp.pad(q, ((0, pad_q), (0, 0))) if pad_q else q
+    # pad k/v to whole blocks too: an out-of-range dslice start would be
+    # clamped and silently misalign loaded rows against the k_pos mask
+    pad_k = n_k * block_k - sk
+    kp = jnp.pad(k, ((0, pad_k), (0, 0))) if pad_k else k
+    vp = jnp.pad(v, ((0, pad_k), (0, 0))) if pad_k else v
+    sk_padded = n_k * block_k
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_q,),
+        in_specs=[
+            pl.BlockSpec((block_q, d), lambda i: (i, 0)),
+            pl.BlockSpec((sk_padded, d), lambda i: (0, 0)),
+            pl.BlockSpec((sk_padded, d), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_q, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_q * block_q, d), q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:sq] if pad_q else out
+
+
+# ------------------------------------------------------------ public API
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    backend: Optional[str] = None):
+    """Blockwise attention over [..., seq, head_dim] operands.
+
+    backend: "pallas" | "lax" | None (auto: pallas on TPU, lax elsewhere).
+    Leading dims (batch, heads) are vmapped.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if backend is None:
+        backend = "pallas" if jax.default_backend() == "tpu" else "lax"
+
+    if backend == "pallas":
+        fn = functools.partial(_flash_pallas_2d, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    elif backend == "pallas_interpret":
+        fn = functools.partial(_flash_pallas_2d, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               interpret=True)
+    elif backend == "lax":
+        fn = functools.partial(_flash_lax, scale=scale, causal=causal,
+                               block_k=block_k)
+    else:
+        raise ValueError(f"unknown backend {backend!r}")
+
+    ndim = q.ndim
+    if ndim == 2:
+        return fn(q, k, v)
+    batch_shape = q.shape[:-2]
+    q2 = q.reshape((-1,) + q.shape[-2:])
+    k2 = k.reshape((-1,) + k.shape[-2:])
+    v2 = v.reshape((-1,) + v.shape[-2:])
+    out = jax.vmap(fn)(q2, k2, v2)
+    return out.reshape(batch_shape + out.shape[-2:])
+
+
+def attention_reference(q, k, v, *, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Naive full-matrix softmax attention — the numerics oracle."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("...qd,...kd->...qk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,151 @@
+"""Ring attention: sequence/context parallelism over the ICI ring.
+
+The long-context story of the framework (SURVEY.md §5 "long-context /
+sequence parallelism"): sequences too long for one device's HBM are
+sharded over the mesh's shard axis; each device computes blockwise
+attention of its local queries against every device's k/v shard as the
+shards stream around the ring — one ppermute neighbor exchange per step,
+exactly the StreamingRPC-over-ICI dataflow of parallel/ring.py
+(ring_scan), with the online-softmax (m, l, o) carry making the result
+independent of arrival order.
+
+n_shards ppermute hops, each overlapping the next transfer with the
+current block's compute (XLA schedules the collective-permute
+asynchronously); peak memory is O(seq/n) per device.
+
+Also here: `ulysses_attention` — the all-to-all alternative (DeepSpeed-
+Ulysses style): reshard seq→heads with one all-to-all, attend locally
+over full sequence per head, reshard back. Two all-to-alls instead of
+n-1 permutes; better when heads ≥ shards and ICI all-to-all bandwidth is
+plentiful.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from brpc_tpu.ops.flash_attention import (
+    NEG_INF, _finalize, _online_softmax_step,
+)
+from brpc_tpu.parallel.mesh import SHARD_AXIS
+
+
+def _ring_perm(n: int):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _local_ring_attention(q, k, v, axis_name: str, n_shards: int,
+                          scale: float, causal: bool):
+    """Per-shard body (runs inside shard_map). q/k/v: [sq, d] local
+    shards of a globally [n*sq, d] sequence, shard i owning rows
+    [i*sq, (i+1)*sq)."""
+    sq, d = q.shape
+    sk = k.shape[0]
+    my = lax.axis_index(axis_name)
+    qf = q.astype(jnp.float32)
+    q_pos = my * sq + jnp.arange(sq)
+
+    def step(t, carry):
+        m, l, o, kv = carry
+        kcur, vcur = kv
+        src = (my - t) % n_shards  # original owner of the chunk in hand
+        k_pos = src * sk + jnp.arange(sk)
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]
+        else:
+            mask = None
+        m, l, o = _online_softmax_step(qf, kcur, vcur, m, l, o, scale, mask)
+        # hand the chunk to the next ring neighbor while the next step's
+        # compute proceeds (skipped-value on the last iteration is unused)
+        knext = lax.ppermute(kcur, axis_name, perm=_ring_perm(n_shards))
+        vnext = lax.ppermute(vcur, axis_name, perm=_ring_perm(n_shards))
+        return m, l, o, (knext, vnext)
+
+    m0 = jnp.full((sq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((sq,), jnp.float32)
+    o0 = jnp.zeros((sq, d), jnp.float32)
+    m, l, o, _ = lax.fori_loop(0, n_shards, step, (m0, l0, o0, (k, v)))
+    out, _, _ = _finalize(m, l, o, q.dtype)
+    return out
+
+
+def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = False,
+                   scale: Optional[float] = None,
+                   axis_name: str = SHARD_AXIS):
+    """Sequence-parallel attention over ``mesh``'s ``axis_name`` ring.
+
+    q/k/v: [..., seq, head_dim] global arrays (seq divisible by the axis
+    size). Returns attention output with the same sharding: seq sharded
+    over ``axis_name``. Leading dims are vmapped (replicated). The
+    blocking unit is the shard itself (seq/n rows per ring step).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = mesh.shape[axis_name]
+
+    body = functools.partial(_local_ring_attention, axis_name=axis_name,
+                             n_shards=n, scale=scale, causal=causal)
+
+    ndim = q.ndim
+    if ndim > 2:
+        nbatch = ndim - 2
+        inner = body
+        for _ in range(nbatch):
+            inner = jax.vmap(inner)
+        spec = P(*([None] * nbatch), axis_name, None)
+    else:
+        inner = body
+        spec = P(axis_name, None)
+
+    # check_vma off: the (m, l, o) accumulators start axis-invariant and
+    # become ring-varying after the first ppermute step, which the static
+    # varying-axes checker can't type (same situation as ring_allreduce)
+    fn = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return jax.jit(fn)(q, k, v)
+
+
+def ulysses_attention(mesh: Mesh, q, k, v, *, causal: bool = False,
+                      scale: Optional[float] = None,
+                      axis_name: str = SHARD_AXIS):
+    """All-to-all sequence parallelism (Ulysses-style reshard).
+
+    q/k/v: [heads, seq, head_dim] with seq sharded over ``axis_name`` and
+    heads divisible by the axis size. One all-to-all reshards seq→heads
+    (each device gets heads/n full-sequence heads), attention runs fully
+    local, a second all-to-all reshards back.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = mesh.shape[axis_name]
+    h, s, d = q.shape
+    if h % n or s % n:
+        raise ValueError(f"heads ({h}) and seq ({s}) must divide the "
+                         f"{axis_name} axis size {n}")
+
+    from brpc_tpu.ops.flash_attention import attention_reference
+
+    def local(qs, ks, vs):
+        # local shard: [h, s/n, d] → all-to-all → [h/n, s, d]
+        def reshard_fwd(x):
+            return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1,
+                                  tiled=True)
+
+        def reshard_bwd(x):
+            return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0,
+                                  tiled=True)
+
+        qh, kh, vh = reshard_fwd(qs), reshard_fwd(ks), reshard_fwd(vs)
+        out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+        return reshard_bwd(out)
+
+    spec = P(None, axis_name, None)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    return jax.jit(fn)(q, k, v)

@@ -19,6 +19,7 @@ json_format (the reference's json2pb bridge)."""
 from __future__ import annotations
 
 import json
+import threading
 import time
 import urllib.parse
 from collections import deque
@@ -111,26 +112,28 @@ class HttpProtocol(Protocol):
         """HTTP/1.1 requires responses in request order: pipelined
         requests must NOT fan out to concurrent fibers (the
         InputMessenger default). Queue per connection and drain in
-        parse order with a single fiber."""
-        pending = socket.user_data.setdefault("http_pending", deque())
-        pending.append(req)
-        if not socket.user_data.get("http_draining"):
+        parse order with a single fiber. Fibers run on multiple OS
+        threads, so the pending/draining handoff takes a real lock."""
+        lock = socket.user_data.setdefault("http_lock", threading.Lock())
+        with lock:
+            pending = socket.user_data.setdefault("http_pending", deque())
+            pending.append(req)
+            if socket.user_data.get("http_draining"):
+                return True
             socket.user_data["http_draining"] = True
-            socket._control.spawn(self._drain_ordered, socket,
-                                  name="http_serial")
+        socket._control.spawn(self._drain_ordered, socket,
+                              name="http_serial")
         return True
 
     async def _drain_ordered(self, socket):
+        lock = socket.user_data["http_lock"]
         pending = socket.user_data["http_pending"]
         while True:
-            try:
-                req = pending.popleft()
-            except IndexError:
-                socket.user_data["http_draining"] = False
-                if not pending:  # re-check: producer may have raced
+            with lock:
+                if not pending:
+                    socket.user_data["http_draining"] = False
                     return
-                socket.user_data["http_draining"] = True
-                continue
+                req = pending.popleft()
             await self.process(req, socket)
 
     async def process(self, req: HttpRequest, socket):

@@ -15,6 +15,7 @@ and pushes full server lists through actions.reset_servers(). Builtins:
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable, Dict, List, Optional
 
@@ -142,10 +143,17 @@ class NamingServiceThread:
                 # notify watchers BEFORE releasing wait_first_update():
                 # a ClusterChannel constructor blocked on that event must
                 # find its LB already seeded when it wakes, or its first
-                # call races an empty server list
-                for w in watchers:
-                    w(list(servers))
-                outer._first_update.set()
+                # call races an empty server list. One watcher blowing up
+                # must neither starve the others nor leave the event
+                # unset forever.
+                try:
+                    for w in watchers:
+                        try:
+                            w(list(servers))
+                        except Exception:
+                            logging.exception("naming watcher failed")
+                finally:
+                    outer._first_update.set()
 
         self._fiber = self._control.spawn(
             self._ns.run, self._param, _Actions(), self._stop,

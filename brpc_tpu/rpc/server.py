@@ -10,6 +10,7 @@ rides along in socket.user_data so protocol dispatch finds it
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Dict, List, Optional
 
 from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
@@ -19,6 +20,39 @@ from brpc_tpu.rpc.service import Method, Service
 from brpc_tpu.transport.base import get_transport
 from brpc_tpu.transport.input_messenger import InputMessenger
 from brpc_tpu.transport.socket import Socket
+
+# process-wide graceful-SIGTERM state: weak so stopped/forgotten servers
+# don't linger, installed once so restart cycles don't chain handlers
+_sigterm_registry: "weakref.WeakSet" = weakref.WeakSet()
+_sigterm_lock = threading.Lock()
+_sigterm_installed = False
+
+
+def _install_sigterm_handler_once() -> None:
+    global _sigterm_installed
+    with _sigterm_lock:
+        if _sigterm_installed:
+            return
+        import signal
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            for srv in list(_sigterm_registry):
+                try:
+                    srv.stop()
+                except Exception:
+                    pass
+            if callable(prev):
+                prev(signum, frame)
+            elif prev == signal.SIG_DFL:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                signal.raise_signal(signal.SIGTERM)
+
+        try:
+            signal.signal(signal.SIGTERM, _on_term)
+            _sigterm_installed = True
+        except ValueError:
+            pass  # not the main thread: best-effort
 
 
 class ServerOptions:
@@ -88,29 +122,14 @@ class Server:
 
     def _maybe_install_sigterm(self) -> None:
         """graceful_quit_on_sigterm (server.cpp graceful Stop/Join:691):
-        SIGTERM drains this server instead of killing the process
-        mid-request. Only installable from the main thread; chained so a
-        prior handler still runs."""
+        SIGTERM drains running servers instead of killing the process
+        mid-request. One process-wide handler over a weak registry —
+        start/stop cycles must not chain handlers or pin dead Servers."""
         from brpc_tpu.butil.flags import flag
         if not flag("graceful_quit_on_sigterm"):
             return
-        import signal
-        try:
-            prev = signal.getsignal(signal.SIGTERM)
-
-            def _on_term(signum, frame):
-                try:
-                    self.stop()
-                finally:
-                    if callable(prev):
-                        prev(signum, frame)
-                    elif prev == signal.SIG_DFL:
-                        signal.signal(signal.SIGTERM, signal.SIG_DFL)
-                        signal.raise_signal(signal.SIGTERM)
-
-            signal.signal(signal.SIGTERM, _on_term)
-        except ValueError:
-            pass  # not the main thread: flag is best-effort there
+        _sigterm_registry.add(self)
+        _install_sigterm_handler_once()
 
     @property
     def endpoint(self) -> Optional[EndPoint]:
@@ -136,6 +155,7 @@ class Server:
         if not self._running:
             return
         self._running = False
+        _sigterm_registry.discard(self)
         if self._listener is not None:
             self._listener.stop()
 
