@@ -1,0 +1,848 @@
+"""HTTP/2 (RFC 7540) + gRPC protocol.
+
+TPU-native counterpart of the reference's h2/gRPC support
+(policy/http2_rpc_protocol.cpp, details/hpack.cpp, grpc.{h,cpp},
+http2.cpp): a full h2 connection — preface, SETTINGS exchange, HPACK
+header compression, stream multiplexing, both-direction flow control,
+PING/GOAWAY/RST_STREAM — carrying two request families:
+
+  * gRPC  (content-type: application/grpc*): unary calls into the same
+    Service/method registry tpu_std dispatches to, with grpc-status /
+    grpc-message / grpc-timeout mapping. Interops with stock grpcio.
+  * plain HTTP over h2: routed through the HTTP/1.1 protocol's router,
+    so every builtin observability page is h2-reachable.
+
+Server side registers as a Protocol (preface-sniffing parse); client
+side is GrpcChannel, which drives the same H2Session over a client
+socket. Frame processing is serialized on the socket's input fiber
+(process_inline), so recv-side state needs no lock; the send side is
+guarded by a per-session lock because handler fibers write responses
+concurrently.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import time
+import urllib.parse
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.protocol.hpack import HpackDecoder, HpackEncoder, HpackError
+from brpc_tpu.protocol.registry import (
+    PARSE_NOT_ENOUGH_DATA, PARSE_OK, PARSE_TRY_OTHERS, Protocol,
+    register_protocol,
+)
+
+PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+# frame types
+DATA, HEADERS, PRIORITY, RST_STREAM, SETTINGS, PUSH_PROMISE, PING, GOAWAY, \
+    WINDOW_UPDATE, CONTINUATION = range(10)
+
+# flags
+FLAG_END_STREAM = 0x1     # DATA, HEADERS
+FLAG_ACK = 0x1            # SETTINGS, PING
+FLAG_END_HEADERS = 0x4
+FLAG_PADDED = 0x8
+FLAG_PRIORITY = 0x20
+
+# settings ids
+S_HEADER_TABLE_SIZE = 1
+S_ENABLE_PUSH = 2
+S_MAX_CONCURRENT_STREAMS = 3
+S_INITIAL_WINDOW_SIZE = 4
+S_MAX_FRAME_SIZE = 5
+S_MAX_HEADER_LIST_SIZE = 6
+
+# h2 error codes (RFC 7540 §7)
+NO_ERROR, PROTOCOL_ERROR, INTERNAL_ERROR, FLOW_CONTROL_ERROR, \
+    SETTINGS_TIMEOUT, STREAM_CLOSED, FRAME_SIZE_ERROR, REFUSED_STREAM, \
+    CANCEL, COMPRESSION_ERROR, CONNECT_ERROR, ENHANCE_YOUR_CALM, \
+    INADEQUATE_SECURITY, HTTP_1_1_REQUIRED = range(14)
+
+DEFAULT_WINDOW = 65535
+DEFAULT_FRAME_SIZE = 16384
+OUR_INITIAL_WINDOW = 1 << 20      # advertise 1MB stream windows
+OUR_MAX_FRAME_SIZE = 16384
+
+_HDR = struct.Struct(">HBBI")     # we pack len as 1+2 manually
+
+
+def pack_frame(ftype: int, flags: int, stream_id: int,
+               payload: bytes = b"") -> bytes:
+    n = len(payload)
+    return (bytes(((n >> 16) & 0xFF, (n >> 8) & 0xFF, n & 0xFF, ftype,
+                   flags)) + struct.pack(">I", stream_id & 0x7FFFFFFF)
+            + payload)
+
+
+class H2Error(Exception):
+    def __init__(self, code: int, msg: str):
+        super().__init__(msg)
+        self.code = code
+
+
+class H2Stream:
+    __slots__ = ("id", "session", "headers", "trailers", "data",
+                 "recv_window", "send_window", "closed_local",
+                 "closed_remote", "blocked", "on_complete", "on_headers",
+                 "on_data", "user")
+
+    def __init__(self, sid: int, session: "H2Session"):
+        self.id = sid
+        self.session = session
+        self.headers: List[Tuple[str, str]] = []
+        self.trailers: List[Tuple[str, str]] = []
+        self.data = bytearray()
+        self.recv_window = session.our_initial_window
+        self.send_window = session.peer_initial_window
+        self.closed_local = False
+        self.closed_remote = False
+        self.blocked: deque = deque()   # (bytes, end_stream) awaiting window
+        self.on_complete: Optional[Callable] = None
+        self.on_headers: Optional[Callable] = None
+        self.on_data: Optional[Callable] = None   # progressive consumer
+        self.user = None
+
+    def header(self, name: str, default: str = "") -> str:
+        for k, v in self.headers:
+            if k == name:
+                return v
+        return default
+
+
+class H2Session:
+    """One h2 connection, either role. Recv path runs on the socket input
+    fiber (ordered); send path takes `_lock`."""
+
+    def __init__(self, socket, is_server: bool,
+                 on_request: Optional[Callable] = None):
+        self.socket = socket
+        self.is_server = is_server
+        self.on_request = on_request     # server: stream completed
+        self._lock = threading.Lock()
+        self.encoder = HpackEncoder()
+        self.decoder = HpackDecoder()
+        self.streams: Dict[int, H2Stream] = {}
+        self.next_stream_id = 2 if is_server else 1
+        self.our_initial_window = OUR_INITIAL_WINDOW
+        self.peer_initial_window = DEFAULT_WINDOW
+        self.peer_max_frame = DEFAULT_FRAME_SIZE
+        self.conn_recv_window = DEFAULT_WINDOW
+        self.conn_send_window = DEFAULT_WINDOW
+        self.goaway_sent = False
+        self.goaway_received = False
+        self.last_peer_stream = 0
+        self._hdr_accum: Optional[Tuple[int, int, bytearray]] = None
+        self._settings_acked = False
+
+    # ------------------------------------------------------------- sending
+    def _write(self, data: bytes) -> None:
+        buf = IOBuf()
+        buf.append(data)
+        self.socket.write(buf)
+
+    def send_preface_and_settings(self) -> None:
+        out = b"" if self.is_server else PREFACE
+        out += pack_frame(SETTINGS, 0, 0, struct.pack(
+            ">HIHIHI",
+            S_INITIAL_WINDOW_SIZE, self.our_initial_window,
+            S_MAX_FRAME_SIZE, OUR_MAX_FRAME_SIZE,
+            S_MAX_CONCURRENT_STREAMS, 1024))
+        # widen the connection window up front (never shrinks below 64KB)
+        out += pack_frame(WINDOW_UPDATE, 0, 0,
+                          struct.pack(">I", (1 << 24) - DEFAULT_WINDOW))
+        self.conn_recv_window = 1 << 24
+        with self._lock:
+            self._write(out)
+
+    def new_stream(self) -> H2Stream:
+        with self._lock:
+            sid = self.next_stream_id
+            self.next_stream_id += 2
+            st = H2Stream(sid, self)
+            self.streams[sid] = st
+            return st
+
+    def send_headers(self, stream: H2Stream, headers: List[Tuple[str, str]],
+                     end_stream: bool = False) -> None:
+        with self._lock:
+            block = self.encoder.encode(headers)
+            flags = FLAG_END_HEADERS | (FLAG_END_STREAM if end_stream else 0)
+            self._write(pack_frame(HEADERS, flags, stream.id, block))
+            if end_stream:
+                stream.closed_local = True
+                self._maybe_gc(stream)
+
+    def send_data(self, stream: H2Stream, data: bytes,
+                  end_stream: bool = False) -> None:
+        with self._lock:
+            stream.blocked.append((bytes(data), end_stream))
+            self._flush_stream(stream)
+
+    def _flush_stream(self, stream: H2Stream) -> None:
+        # under _lock; emit as much blocked data as windows allow
+        while stream.blocked:
+            data, end = stream.blocked[0]
+            if data:
+                room = min(self.conn_send_window, stream.send_window,
+                           self.peer_max_frame)
+                if room <= 0:
+                    return
+                chunk, rest = data[:room], data[room:]
+                self.conn_send_window -= len(chunk)
+                stream.send_window -= len(chunk)
+                if rest:
+                    stream.blocked[0] = (rest, end)
+                    self._write(pack_frame(DATA, 0, stream.id, chunk))
+                    continue
+                stream.blocked.popleft()
+                flags = FLAG_END_STREAM if end else 0
+                self._write(pack_frame(DATA, flags, stream.id, chunk))
+            else:
+                stream.blocked.popleft()
+                flags = FLAG_END_STREAM if end else 0
+                self._write(pack_frame(DATA, flags, stream.id, b""))
+            if end:
+                stream.closed_local = True
+                self._maybe_gc(stream)
+                return
+
+    def _flush_all(self) -> None:
+        for st in list(self.streams.values()):
+            if st.blocked:
+                self._flush_stream(st)
+                if self.conn_send_window <= 0:
+                    return
+
+    def send_trailers(self, stream: H2Stream,
+                      trailers: List[Tuple[str, str]]) -> None:
+        self.send_headers(stream, trailers, end_stream=True)
+
+    def send_rst(self, stream_id: int, code: int) -> None:
+        with self._lock:
+            self._write(pack_frame(RST_STREAM, 0, stream_id,
+                                   struct.pack(">I", code)))
+            self.streams.pop(stream_id, None)
+
+    def send_goaway(self, code: int = NO_ERROR, debug: bytes = b"") -> None:
+        with self._lock:
+            if self.goaway_sent:
+                return
+            self.goaway_sent = True
+            self._write(pack_frame(GOAWAY, 0, 0, struct.pack(
+                ">II", self.last_peer_stream, code) + debug))
+
+    def ping(self, payload: bytes = b"\0" * 8) -> None:
+        with self._lock:
+            self._write(pack_frame(PING, 0, 0, payload[:8].ljust(8, b"\0")))
+
+    def _maybe_gc(self, stream: H2Stream) -> None:
+        if stream.closed_local and stream.closed_remote:
+            self.streams.pop(stream.id, None)
+
+    # ------------------------------------------------------------ receiving
+    def feed_frame(self, ftype: int, flags: int, sid: int,
+                   payload: bytes) -> None:
+        """Runs on the socket input fiber, frames in wire order."""
+        if self._hdr_accum is not None and ftype != CONTINUATION:
+            raise H2Error(PROTOCOL_ERROR,
+                          "expected CONTINUATION in header block")
+        if ftype == DATA:
+            self._on_data(flags, sid, payload)
+        elif ftype == HEADERS:
+            self._on_headers(flags, sid, payload)
+        elif ftype == CONTINUATION:
+            self._on_continuation(flags, sid, payload)
+        elif ftype == SETTINGS:
+            self._on_settings(flags, payload)
+        elif ftype == WINDOW_UPDATE:
+            self._on_window_update(sid, payload)
+        elif ftype == RST_STREAM:
+            st = self.streams.pop(sid, None)
+            if st is not None and st.on_complete:
+                code = struct.unpack(">I", payload[:4])[0] if len(payload) >= 4 else 0
+                st.trailers.append(("grpc-status", "1"))
+                st.trailers.append(("grpc-message", f"stream reset by peer (h2 error {code})"))
+                st.on_complete(st)
+        elif ftype == PING:
+            if not flags & FLAG_ACK:
+                with self._lock:
+                    self._write(pack_frame(PING, FLAG_ACK, 0, payload[:8]))
+        elif ftype == GOAWAY:
+            self.goaway_received = True
+        elif ftype in (PRIORITY, PUSH_PROMISE):
+            pass      # PRIORITY ignored; we never enable push
+        # unknown frame types are ignored per RFC 7540 §4.1
+
+    @staticmethod
+    def _strip_padding(flags: int, payload: bytes) -> bytes:
+        if flags & FLAG_PADDED:
+            if not payload:
+                raise H2Error(PROTOCOL_ERROR, "empty padded frame")
+            pad = payload[0]
+            if pad >= len(payload):
+                raise H2Error(PROTOCOL_ERROR, "padding exceeds frame")
+            payload = payload[1:len(payload) - pad]
+        return payload
+
+    def _on_data(self, flags: int, sid: int, payload: bytes) -> None:
+        consumed = len(payload)
+        payload = self._strip_padding(flags, payload)
+        st = self.streams.get(sid)
+        self.conn_recv_window -= consumed
+        refill = []
+        if self.conn_recv_window < (1 << 23):
+            refill.append(pack_frame(WINDOW_UPDATE, 0, 0, struct.pack(
+                ">I", (1 << 24) - self.conn_recv_window)))
+            self.conn_recv_window = 1 << 24
+        if st is None:
+            # closed/reset stream: still account connection flow control
+            if refill:
+                with self._lock:
+                    self._write(b"".join(refill))
+            return
+        st.recv_window -= consumed
+        if st.recv_window < self.our_initial_window // 2:
+            refill.append(pack_frame(WINDOW_UPDATE, 0, sid, struct.pack(
+                ">I", self.our_initial_window - st.recv_window)))
+            st.recv_window = self.our_initial_window
+        if refill:
+            with self._lock:
+                self._write(b"".join(refill))
+        if st.on_data is not None:
+            st.on_data(payload, bool(flags & FLAG_END_STREAM))
+        else:
+            st.data.extend(payload)
+        if flags & FLAG_END_STREAM:
+            self._remote_closed(st)
+
+    def _on_headers(self, flags: int, sid: int, payload: bytes) -> None:
+        payload = self._strip_padding(flags, payload)
+        if flags & FLAG_PRIORITY:
+            payload = payload[5:]
+        if sid > self.last_peer_stream and (sid % 2 == 1) == self.is_server:
+            self.last_peer_stream = sid
+        if flags & FLAG_END_HEADERS:
+            self._header_block_done(sid, flags, bytes(payload))
+        else:
+            self._hdr_accum = (sid, flags, bytearray(payload))
+
+    def _on_continuation(self, flags: int, sid: int, payload: bytes) -> None:
+        if self._hdr_accum is None or self._hdr_accum[0] != sid:
+            raise H2Error(PROTOCOL_ERROR, "unexpected CONTINUATION")
+        self._hdr_accum[2].extend(payload)
+        if flags & FLAG_END_HEADERS:
+            sid, first_flags, block = self._hdr_accum
+            self._hdr_accum = None
+            self._header_block_done(sid, first_flags, bytes(block))
+
+    def _header_block_done(self, sid: int, flags: int, block: bytes) -> None:
+        try:
+            headers = self.decoder.decode(block)
+        except HpackError as e:
+            raise H2Error(COMPRESSION_ERROR, str(e))
+        st = self.streams.get(sid)
+        if st is None:
+            if self.is_server:
+                st = H2Stream(sid, self)
+                self.streams[sid] = st
+            else:
+                return   # headers for a stream we already tore down
+        if st.headers and not st.closed_remote:
+            st.trailers = headers      # second HEADERS block = trailers
+        else:
+            st.headers = headers
+            if st.on_headers:
+                st.on_headers(st)
+        if flags & FLAG_END_STREAM or (st.headers and st.trailers):
+            self._remote_closed(st)
+
+    def _remote_closed(self, st: H2Stream) -> None:
+        if st.closed_remote:
+            return
+        st.closed_remote = True
+        if self.is_server and self.on_request is not None:
+            self.on_request(st)
+        elif st.on_complete is not None:
+            st.on_complete(st)
+        self._maybe_gc(st)
+
+    def _on_settings(self, flags: int, payload: bytes) -> None:
+        if flags & FLAG_ACK:
+            self._settings_acked = True
+            return
+        if len(payload) % 6:
+            raise H2Error(FRAME_SIZE_ERROR, "bad SETTINGS size")
+        for off in range(0, len(payload), 6):
+            ident, value = struct.unpack_from(">HI", payload, off)
+            if ident == S_HEADER_TABLE_SIZE:
+                self.encoder.set_max_table_size(value)
+            elif ident == S_INITIAL_WINDOW_SIZE:
+                if value > 0x7FFFFFFF:
+                    raise H2Error(FLOW_CONTROL_ERROR, "window too large")
+                delta = value - self.peer_initial_window
+                self.peer_initial_window = value
+                with self._lock:
+                    for st in self.streams.values():
+                        st.send_window += delta
+                    if delta > 0:
+                        self._flush_all()
+            elif ident == S_MAX_FRAME_SIZE:
+                if not 16384 <= value <= 16777215:
+                    raise H2Error(PROTOCOL_ERROR, "bad MAX_FRAME_SIZE")
+                self.peer_max_frame = value
+        with self._lock:
+            self._write(pack_frame(SETTINGS, FLAG_ACK, 0))
+
+    def _on_window_update(self, sid: int, payload: bytes) -> None:
+        if len(payload) != 4:
+            raise H2Error(FRAME_SIZE_ERROR, "bad WINDOW_UPDATE")
+        inc = struct.unpack(">I", payload)[0] & 0x7FFFFFFF
+        if inc == 0:
+            raise H2Error(PROTOCOL_ERROR, "zero WINDOW_UPDATE")
+        with self._lock:
+            if sid == 0:
+                self.conn_send_window += inc
+                self._flush_all()
+            else:
+                st = self.streams.get(sid)
+                if st is not None:
+                    st.send_window += inc
+                    self._flush_stream(st)
+
+
+# --------------------------------------------------------------- gRPC bits
+
+# gRPC status codes (grpc.h GrpcStatus in the reference)
+GRPC_OK = 0
+GRPC_CANCELLED = 1
+GRPC_UNKNOWN = 2
+GRPC_INVALID_ARGUMENT = 3
+GRPC_DEADLINE_EXCEEDED = 4
+GRPC_NOT_FOUND = 5
+GRPC_INTERNAL = 13
+GRPC_UNAVAILABLE = 14
+
+_TIMEOUT_UNITS = {"H": 3600.0, "M": 60.0, "S": 1.0, "m": 1e-3, "u": 1e-6,
+                  "n": 1e-9}
+
+
+def parse_grpc_timeout(value: str) -> Optional[float]:
+    """grpc-timeout header -> seconds (grpc.cpp timeout mapping)."""
+    if not value or value[-1] not in _TIMEOUT_UNITS:
+        return None
+    try:
+        return int(value[:-1]) * _TIMEOUT_UNITS[value[-1]]
+    except ValueError:
+        return None
+
+
+def format_grpc_timeout(seconds: float) -> str:
+    us = max(1, int(seconds * 1e6))
+    if us < 1e8:
+        return f"{us}u"
+    return f"{int(seconds * 1e3)}m"
+
+
+def pack_grpc_message(data: bytes, compressed: bool = False) -> bytes:
+    return struct.pack(">BI", 1 if compressed else 0, len(data)) + data
+
+
+def unpack_grpc_messages(data: bytes) -> List[bytes]:
+    out = []
+    pos = 0
+    while pos + 5 <= len(data):
+        compressed, n = struct.unpack_from(">BI", data, pos)
+        pos += 5
+        if pos + n > len(data):
+            raise ValueError("truncated grpc message")
+        body = data[pos:pos + n]
+        pos += n
+        if compressed:
+            import gzip
+            body = gzip.decompress(body)
+        out.append(bytes(body))
+    if pos != len(data):
+        raise ValueError("trailing bytes after grpc message")
+    return out
+
+
+def percent_encode(msg: str) -> str:
+    return urllib.parse.quote(msg, safe=" !#$&'()*+,-./:;<=>?@[]^_`{|}~")
+
+
+def percent_decode(msg: str) -> str:
+    return urllib.parse.unquote(msg)
+
+
+_ERRNO_TO_GRPC = None
+
+
+def errno_to_grpc_status(code: int) -> int:
+    global _ERRNO_TO_GRPC
+    if _ERRNO_TO_GRPC is None:
+        from brpc_tpu.rpc import errno_codes as berr
+        _ERRNO_TO_GRPC = {
+            0: GRPC_OK,
+            berr.ENOMETHOD: GRPC_NOT_FOUND,
+            berr.ENOSERVICE: GRPC_NOT_FOUND,
+            berr.EREQUEST: GRPC_INVALID_ARGUMENT,
+            berr.ERPCTIMEDOUT: GRPC_DEADLINE_EXCEEDED,
+            berr.ELIMIT: GRPC_UNAVAILABLE,
+            berr.ECANCELED: GRPC_CANCELLED,
+        }
+    return _ERRNO_TO_GRPC.get(code, GRPC_INTERNAL)
+
+
+# ---------------------------------------------------------- server protocol
+
+class _FrameMsg:
+    __slots__ = ("ftype", "flags", "sid", "payload", "is_preface")
+
+    def __init__(self, ftype, flags, sid, payload, is_preface=False):
+        self.ftype = ftype
+        self.flags = flags
+        self.sid = sid
+        self.payload = payload
+        self.is_preface = is_preface
+
+
+class H2ServerProtocol(Protocol):
+    """Server-side h2: sniffs the client preface, then cuts frames and
+    feeds the per-connection session in parse order."""
+
+    name = "h2"
+
+    def parse(self, portal, socket) -> Tuple[str, object]:
+        started = socket.user_data.get("h2_started")
+        if not started:
+            head = portal.peek_bytes(min(len(PREFACE), portal.size))
+            if not PREFACE.startswith(head[:len(PREFACE)]):
+                return PARSE_TRY_OTHERS, None
+            if portal.size < len(PREFACE):
+                return PARSE_NOT_ENOUGH_DATA, None
+            portal.pop_front(len(PREFACE))
+            socket.user_data["h2_started"] = True
+            return PARSE_OK, _FrameMsg(-1, 0, 0, b"", is_preface=True)
+        if portal.size < 9:
+            return PARSE_NOT_ENOUGH_DATA, None
+        head = portal.peek_bytes(9)
+        length = (head[0] << 16) | (head[1] << 8) | head[2]
+        if length > (1 << 24):
+            return PARSE_TRY_OTHERS, None
+        if portal.size < 9 + length:
+            return PARSE_NOT_ENOUGH_DATA, None
+        portal.pop_front(9)
+        payload = portal.cut(length).to_bytes() if length else b""
+        sid = struct.unpack(">I", head[5:9])[0] & 0x7FFFFFFF
+        return PARSE_OK, _FrameMsg(head[3], head[4], sid, payload)
+
+    def process_inline(self, msg: _FrameMsg, socket) -> bool:
+        session: Optional[H2Session] = socket.user_data.get("h2_session")
+        if msg.is_preface:
+            session = H2Session(socket, is_server=True,
+                                on_request=self._dispatch)
+            socket.user_data["h2_session"] = session
+            session.send_preface_and_settings()
+            return True
+        if session is None:
+            socket.set_failed(ConnectionError("h2 frame before preface"))
+            return True
+        try:
+            session.feed_frame(msg.ftype, msg.flags, msg.sid, msg.payload)
+        except H2Error as e:
+            session.send_goaway(e.code, str(e).encode())
+            socket.set_failed(ConnectionError(f"h2: {e}"))
+        return True
+
+    def process(self, msg, socket):   # pragma: no cover - inline-only
+        return None
+
+    # ------------------------------------------------------------ dispatch
+    def _dispatch(self, stream: H2Stream) -> None:
+        """Stream fully received (runs on the input fiber): hand the
+        request to a handler fiber so the connection keeps parsing."""
+        session = stream.session
+        socket = session.socket
+        server = socket.user_data.get("server")
+        if server is None:
+            session.send_rst(stream.id, REFUSED_STREAM)
+            return
+        ctype = stream.header("content-type")
+        if ctype.startswith("application/grpc"):
+            socket._control.spawn(self._handle_grpc, server, stream,
+                                  name="h2_grpc")
+        else:
+            socket._control.spawn(self._handle_http, server, stream,
+                                  name="h2_http")
+
+    async def _handle_grpc(self, server, stream: H2Stream):
+        session = stream.session
+        path = stream.header(":path")
+        parts = [p for p in path.split("/") if p]
+        resp_headers = [(":status", "200"),
+                        ("content-type", "application/grpc")]
+        if len(parts) != 2:
+            session.send_headers(stream, resp_headers)
+            session.send_trailers(stream, [
+                ("grpc-status", str(GRPC_NOT_FOUND)),
+                ("grpc-message", percent_encode(f"bad path {path}"))])
+            return
+        service, method_name = parts
+        # gRPC paths are package-qualified; our registry may not be
+        method = (server.find_method(service, method_name)
+                  or server.find_method(service.rsplit(".", 1)[-1],
+                                        method_name))
+        if method is None:
+            session.send_headers(stream, resp_headers)
+            session.send_trailers(stream, [
+                ("grpc-status", str(GRPC_NOT_FOUND)),
+                ("grpc-message",
+                 percent_encode(f"no method {service}/{method_name}"))])
+            return
+        from brpc_tpu.rpc.controller import Controller
+        cntl = Controller()
+        cntl.remote_side = stream.session.socket.remote_endpoint
+        timeout = parse_grpc_timeout(stream.header("grpc-timeout"))
+        if timeout is not None:
+            cntl.timeout_ms = timeout * 1e3
+        status, message, payload = GRPC_OK, "", b""
+        try:
+            msgs = unpack_grpc_messages(bytes(stream.data))
+            raw = msgs[0] if msgs else b""
+            if method.request_class is not None:
+                request = method.request_class()
+                request.ParseFromString(raw)
+            else:
+                request = raw
+        except Exception as e:
+            status, message = GRPC_INTERNAL, f"bad request: {e}"
+            request = None
+        if status == GRPC_OK:
+            if not server.on_request_start():
+                status, message = GRPC_UNAVAILABLE, "max_concurrency reached"
+            else:
+                t0 = time.monotonic_ns()
+                try:
+                    import inspect
+                    r = method.handler(cntl, request)
+                    if inspect.isawaitable(r):
+                        r = await r
+                    if r is None:
+                        payload = b""
+                    elif hasattr(r, "SerializeToString") and not isinstance(
+                            r, (bytes, bytearray)):
+                        payload = r.SerializeToString()
+                    elif isinstance(r, IOBuf):
+                        payload = r.to_bytes()
+                    else:
+                        payload = bytes(r)
+                except Exception as e:
+                    status, message = GRPC_INTERNAL, f"handler error: {e}"
+                finally:
+                    server.on_request_end(
+                        f"{service}.{method_name}",
+                        (time.monotonic_ns() - t0) / 1e3,
+                        status != GRPC_OK or cntl.failed())
+                if status == GRPC_OK and cntl.failed():
+                    status = errno_to_grpc_status(cntl.error_code)
+                    message = cntl.error_text
+        session.send_headers(stream, resp_headers)
+        if status == GRPC_OK:
+            session.send_data(stream, pack_grpc_message(payload))
+        trailers = [("grpc-status", str(status))]
+        if message:
+            trailers.append(("grpc-message", percent_encode(message)))
+        session.send_trailers(stream, trailers)
+
+    async def _handle_http(self, server, stream: H2Stream):
+        """Plain HTTP over h2: reuse the HTTP/1.1 router so /status,
+        /vars, /rpcz ... are h2-reachable."""
+        from brpc_tpu.protocol.http import HttpRequest, ensure_registered
+        http = ensure_registered()
+        target = stream.header(":path", "/")
+        parsed = urllib.parse.urlsplit(target)
+        req = HttpRequest(
+            stream.header(":method", "GET").upper(), parsed.path,
+            dict(urllib.parse.parse_qsl(parsed.query)),
+            {k: v for k, v in stream.headers if not k.startswith(":")},
+            bytes(stream.data), True)
+        session = stream.session
+        try:
+            status, ctype, body = await http._route(server, req)
+        except Exception as e:
+            status, ctype, body = 500, "text/plain", f"error: {e}".encode()
+        session.send_headers(stream, [
+            (":status", str(status)), ("content-type", ctype),
+            ("content-length", str(len(body)))])
+        session.send_data(stream, body, end_stream=True)
+
+
+# ----------------------------------------------------------------- client
+
+class GrpcCall:
+    """One in-flight unary call (completion signalled via butex so both
+    fibers and plain threads can wait)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self.status: int = GRPC_INTERNAL
+        self.message: str = ""
+        self.response: bytes = b""
+        self.headers: List[Tuple[str, str]] = []
+
+    def _complete(self, stream: H2Stream) -> None:
+        trailers = stream.trailers or stream.headers
+        status = msg = None
+        for k, v in trailers:
+            if k == "grpc-status":
+                status = v
+            elif k == "grpc-message":
+                msg = v
+        if status is None:
+            for k, v in stream.headers:   # trailers-only response
+                if k == "grpc-status":
+                    status = v
+                elif k == "grpc-message":
+                    msg = v
+        self.status = int(status) if status is not None else GRPC_INTERNAL
+        self.message = percent_decode(msg) if msg else ""
+        try:
+            msgs = unpack_grpc_messages(bytes(stream.data))
+            self.response = msgs[0] if msgs else b""
+        except ValueError as e:
+            if self.status == GRPC_OK:
+                self.status = GRPC_INTERNAL
+                self.message = str(e)
+        self.headers = stream.headers
+        self._event.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def ok(self) -> bool:
+        return self.status == GRPC_OK
+
+
+class GrpcChannel:
+    """Client stub speaking gRPC-over-h2 (the client half of
+    policy/http2_rpc_protocol.cpp). Interops with stock gRPC servers."""
+
+    def __init__(self, address: str, control=None):
+        from brpc_tpu.butil.endpoint import str2endpoint
+        from brpc_tpu.fiber import global_control
+        if "://" not in address:
+            address = "tcp://" + address
+        self._endpoint = str2endpoint(address)
+        self._control = control or global_control()
+        self._lock = threading.Lock()
+        self._socket = None
+        self._session: Optional[H2Session] = None
+
+    def _connect(self) -> H2Session:
+        with self._lock:
+            if self._session is not None and not self._socket.failed:
+                return self._session
+            from brpc_tpu.transport.socket import create_client_socket
+            self._socket = create_client_socket(
+                self._endpoint, on_input=self._on_input,
+                control=self._control)
+            self._session = H2Session(self._socket, is_server=False)
+            self._session.send_preface_and_settings()
+            return self._session
+
+    def _on_input(self, socket) -> None:
+        portal = socket.input_portal
+        session = self._session
+        if session is None or session.socket is not socket:
+            # the first bytes can arrive before _connect publishes the
+            # session; the lock orders us behind it
+            with self._lock:
+                session = self._session
+            if session is None or session.socket is not socket:
+                return
+        while portal.size >= 9:
+            head = portal.peek_bytes(9)
+            length = (head[0] << 16) | (head[1] << 8) | head[2]
+            if portal.size < 9 + length:
+                return
+            portal.pop_front(9)
+            payload = portal.cut(length).to_bytes() if length else b""
+            sid = struct.unpack(">I", head[5:9])[0] & 0x7FFFFFFF
+            try:
+                session.feed_frame(head[3], head[4], sid, payload)
+            except H2Error as e:
+                session.send_goaway(e.code, str(e).encode())
+                socket.set_failed(ConnectionError(f"h2: {e}"))
+                return
+
+    def call(self, method_path: str, request, timeout: Optional[float] = 5.0,
+             metadata: Optional[List[Tuple[str, str]]] = None,
+             response_class=None) -> GrpcCall:
+        """Unary call. `method_path` is "/package.Service/Method"."""
+        if hasattr(request, "SerializeToString"):
+            payload = request.SerializeToString()
+        else:
+            payload = bytes(request or b"")
+        session = self._connect()
+        call = GrpcCall()
+        stream = session.new_stream()
+        stream.on_complete = call._complete
+
+        def _fail_call(_socket):
+            if not call._event.is_set():
+                call.status = GRPC_UNAVAILABLE
+                call.message = "connection failed"
+                call._event.set()
+
+        self._socket.on_failed(_fail_call)
+        headers = [
+            (":method", "POST"), (":scheme", "http"),
+            (":path", method_path),
+            (":authority", f"{self._endpoint.host}:{self._endpoint.port}"),
+            ("content-type", "application/grpc"),
+            ("user-agent", "brpc-tpu-grpc/1.0"),
+            ("te", "trailers"),
+        ]
+        if timeout is not None:
+            headers.append(("grpc-timeout", format_grpc_timeout(timeout)))
+        for kv in metadata or []:
+            headers.append(kv)
+        session.send_headers(stream, headers)
+        session.send_data(stream, pack_grpc_message(payload),
+                          end_stream=True)
+        if timeout is not None:
+            if not call.wait(timeout + 1.0):
+                call.status = GRPC_DEADLINE_EXCEEDED
+                call.message = "deadline exceeded"
+                call._event.set()
+                session.send_rst(stream.id, CANCEL)
+            if response_class is not None and call.ok():
+                resp = response_class()
+                resp.ParseFromString(call.response)
+                call.response = resp
+        return call
+
+    def close(self) -> None:
+        with self._lock:
+            if self._session is not None:
+                self._session.send_goaway()
+            if self._socket is not None and not self._socket.failed:
+                self._socket.set_failed(ConnectionError("channel closed"))
+            self._socket = None
+            self._session = None
+
+
+_instance: Optional[H2ServerProtocol] = None
+
+
+def ensure_registered() -> H2ServerProtocol:
+    global _instance
+    if _instance is None:
+        _instance = H2ServerProtocol()
+        register_protocol(_instance)
+    return _instance

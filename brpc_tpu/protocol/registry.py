@@ -46,6 +46,10 @@ class Protocol:
 
 _protocols: List[Protocol] = []
 _lock = threading.Lock()
+_init_lock = threading.Lock()
+
+
+_builtins_done = False
 
 
 def register_protocol(p: Protocol) -> None:
@@ -56,8 +60,14 @@ def register_protocol(p: Protocol) -> None:
 
 
 def get_protocols() -> List[Protocol]:
-    if not _protocols:
-        _register_builtins()
+    global _builtins_done
+    if not _builtins_done:
+        # _lock is not reentrant and _register_builtins calls
+        # register_protocol, so guard with a dedicated init lock
+        with _init_lock:
+            if not _builtins_done:
+                _register_builtins()
+                _builtins_done = True
     return list(_protocols)
 
 
@@ -69,6 +79,8 @@ def find_protocol(name: str) -> Optional[Protocol]:
 
 
 def _register_builtins() -> None:
-    from brpc_tpu.protocol import tpu_std, http  # register in preference order
+    # register in preference order
+    from brpc_tpu.protocol import tpu_std, http, h2
     tpu_std.ensure_registered()
     http.ensure_registered()
+    h2.ensure_registered()
