@@ -532,8 +532,13 @@ class H2ServerProtocol(Protocol):
             return PARSE_NOT_ENOUGH_DATA, None
         head = portal.peek_bytes(9)
         length = (head[0] << 16) | (head[1] << 8) | head[2]
-        if length > (1 << 24):
-            return PARSE_TRY_OTHERS, None
+        if length > OUR_MAX_FRAME_SIZE:
+            # we advertised SETTINGS_MAX_FRAME_SIZE=16384: a bigger frame
+            # is FRAME_SIZE_ERROR (RFC 7540 §4.2) — fail the connection
+            # instead of buffering a peer-controlled 16MB frame
+            socket.set_failed(ConnectionError(
+                f"h2 frame of {length} bytes exceeds max_frame_size"))
+            return PARSE_NOT_ENOUGH_DATA, None
         if portal.size < 9 + length:
             return PARSE_NOT_ENOUGH_DATA, None
         portal.pop_front(9)
@@ -742,18 +747,38 @@ class GrpcChannel:
         self._lock = threading.Lock()
         self._socket = None
         self._session: Optional[H2Session] = None
+        # calls in flight, failed wholesale when their socket dies (ONE
+        # on_failed registered per socket in _connect — a per-call
+        # registration would leak a closure per call on the shared socket)
+        self._pending: set = set()
 
     def _connect(self) -> H2Session:
         with self._lock:
             if self._session is not None and not self._socket.failed:
                 return self._session
             from brpc_tpu.transport.socket import create_client_socket
-            self._socket = create_client_socket(
+            sock = create_client_socket(
                 self._endpoint, on_input=self._on_input,
                 control=self._control)
-            self._session = H2Session(self._socket, is_server=False)
+            self._socket = sock
+            self._session = H2Session(sock, is_server=False)
             self._session.send_preface_and_settings()
-            return self._session
+            session = self._session
+        # outside the lock: on_failed fires the callback synchronously if
+        # the socket is already dead, and _fail_pending takes _lock
+        sock.on_failed(self._fail_pending)
+        return session
+
+    def _fail_pending(self, socket) -> None:
+        with self._lock:
+            mine = [c for c in self._pending
+                    if getattr(c, "_socket", None) is socket]
+            self._pending.difference_update(mine)
+        for call in mine:
+            if not call._event.is_set():
+                call.status = GRPC_UNAVAILABLE
+                call.message = "connection failed"
+                call._event.set()
 
     def _on_input(self, socket) -> None:
         portal = socket.input_portal
@@ -791,15 +816,16 @@ class GrpcChannel:
         session = self._connect()
         call = GrpcCall()
         stream = session.new_stream()
-        stream.on_complete = call._complete
+        with self._lock:
+            call._socket = session.socket
+            self._pending.add(call)
 
-        def _fail_call(_socket):
-            if not call._event.is_set():
-                call.status = GRPC_UNAVAILABLE
-                call.message = "connection failed"
-                call._event.set()
+        def _done(stream_):
+            call._complete(stream_)
+            with self._lock:
+                self._pending.discard(call)
 
-        self._socket.on_failed(_fail_call)
+        stream.on_complete = _done
         headers = [
             (":method", "POST"), (":scheme", "http"),
             (":path", method_path),
@@ -815,26 +841,32 @@ class GrpcChannel:
         session.send_headers(stream, headers)
         session.send_data(stream, pack_grpc_message(payload),
                           end_stream=True)
-        if timeout is not None:
-            if not call.wait(timeout + 1.0):
-                call.status = GRPC_DEADLINE_EXCEEDED
-                call.message = "deadline exceeded"
-                call._event.set()
-                session.send_rst(stream.id, CANCEL)
-            if response_class is not None and call.ok():
-                resp = response_class()
-                resp.ParseFromString(call.response)
-                call.response = resp
+        # timeout=None waits indefinitely (like gRPC with no deadline);
+        # either way the call is resolved before returning
+        if not call.wait(timeout + 1.0 if timeout is not None else None):
+            call.status = GRPC_DEADLINE_EXCEEDED
+            call.message = "deadline exceeded"
+            call._event.set()
+            with self._lock:
+                self._pending.discard(call)
+            session.send_rst(stream.id, CANCEL)
+        if response_class is not None and call.ok():
+            resp = response_class()
+            resp.ParseFromString(call.response)
+            call.response = resp
         return call
 
     def close(self) -> None:
         with self._lock:
-            if self._session is not None:
-                self._session.send_goaway()
-            if self._socket is not None and not self._socket.failed:
-                self._socket.set_failed(ConnectionError("channel closed"))
+            session, socket = self._session, self._socket
             self._socket = None
             self._session = None
+        # set_failed fires _fail_pending synchronously, which takes _lock:
+        # must not hold it here
+        if session is not None:
+            session.send_goaway()
+        if socket is not None and not socket.failed:
+            socket.set_failed(ConnectionError("channel closed"))
 
 
 _instance: Optional[H2ServerProtocol] = None

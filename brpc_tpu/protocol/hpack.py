@@ -183,11 +183,13 @@ def huffman_decode(data: bytes) -> bytes:
     tree = _DECODE_TREE
     node = 0
     depth = 0  # bits consumed since last symbol (for padding validation)
+    pad = 0    # those bits' values: must end up all-ones (EOS prefix)
     for byte in data:
         for i in range(7, -1, -1):
             bit = (byte >> i) & 1
             node = tree[node][bit]
             depth += 1
+            pad = (pad << 1) | bit
             if node == -1:
                 raise HpackError("invalid huffman code")
             sym = tree[node][2]
@@ -197,11 +199,13 @@ def huffman_decode(data: bytes) -> bytes:
                 out.append(sym)
                 node = 0
                 depth = 0
+                pad = 0
     if depth > 7:
         raise HpackError("huffman padding too long")
-    # remaining bits must be a prefix of EOS (all ones); walking 1-bits
-    # from the root never reaches a symbol in <8 steps, so `node` is a
-    # valid mid-trie position — nothing more to check beyond depth.
+    # RFC 7541 §5.2: padding must be the most-significant bits of EOS,
+    # i.e. all ones — any 0 bit in it is a decoding error
+    if pad != (1 << depth) - 1:
+        raise HpackError("huffman padding is not an EOS prefix")
     return bytes(out)
 
 

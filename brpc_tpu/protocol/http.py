@@ -19,10 +19,8 @@ json_format (the reference's json2pb bridge)."""
 from __future__ import annotations
 
 import json
-import threading
 import time
 import urllib.parse
-from collections import deque
 from typing import Optional, Tuple
 
 from brpc_tpu.butil.flags import flag, list_flags, set_flag
@@ -111,30 +109,10 @@ class HttpProtocol(Protocol):
     def process_inline(self, req: HttpRequest, socket) -> bool:
         """HTTP/1.1 requires responses in request order: pipelined
         requests must NOT fan out to concurrent fibers (the
-        InputMessenger default). Queue per connection and drain in
-        parse order with a single fiber. Fibers run on multiple OS
-        threads, so the pending/draining handoff takes a real lock."""
-        lock = socket.user_data.setdefault("http_lock", threading.Lock())
-        with lock:
-            pending = socket.user_data.setdefault("http_pending", deque())
-            pending.append(req)
-            if socket.user_data.get("http_draining"):
-                return True
-            socket.user_data["http_draining"] = True
-        socket._control.spawn(self._drain_ordered, socket,
-                              name="http_serial")
+        InputMessenger default)."""
+        from brpc_tpu.transport.input_messenger import process_in_parse_order
+        process_in_parse_order(socket, "http", req, self.process)
         return True
-
-    async def _drain_ordered(self, socket):
-        lock = socket.user_data["http_lock"]
-        pending = socket.user_data["http_pending"]
-        while True:
-            with lock:
-                if not pending:
-                    socket.user_data["http_draining"] = False
-                    return
-                req = pending.popleft()
-            await self.process(req, socket)
 
     async def process(self, req: HttpRequest, socket):
         server = socket.user_data.get("server")

@@ -1,0 +1,507 @@
+"""Redis protocol (RESP2): pipelined client + server-side command registry.
+
+Re-design of the reference's redis support (src/brpc/redis.{h,cpp} —
+RedisService registry redis.h:240, command handlers; wire codec + server
+dispatch policy/redis_protocol.cpp:428; client pipelining rides the
+socket's FIFO write order exactly like pipelined_count on Socket).
+
+Client replies carry no correlation id: RESP is strictly FIFO per
+connection, so the client keeps an ordered queue of outstanding batches
+and the response processor fills them in parse order. The server side
+must answer in request order too, so commands drain through a per-socket
+serial fiber (same pattern as HTTP/1.1 pipelining in protocol/http.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.fiber import TaskControl, global_control
+from brpc_tpu.fiber.sync import FiberEvent
+from brpc_tpu.protocol.registry import (
+    PARSE_NOT_ENOUGH_DATA, PARSE_OK, PARSE_TRY_OTHERS, Protocol,
+    register_protocol,
+)
+from brpc_tpu.transport.input_messenger import InputMessenger
+from brpc_tpu.transport.socket import create_client_socket
+
+_MAX_LINE = 1 << 20            # cap unterminated scans (flood guard)
+
+
+class RedisStatus(str):
+    """A +simple-string reply ("OK", "PONG"): distinct from bulk data."""
+
+
+class RedisError(Exception):
+    """An -error reply. Returned (not raised) inside pipeline results."""
+
+    def __eq__(self, other):
+        return isinstance(other, RedisError) and self.args == other.args
+
+    def __hash__(self):
+        return hash(("RedisError",) + self.args)
+
+
+class _NeedMore(Exception):
+    pass
+
+
+class _BadWire(Exception):
+    pass
+
+
+# ------------------------------------------------------------------ codec
+
+def encode_command(args) -> bytes:
+    """Multi-bulk encode one command: ["SET", "k", 1] -> *3$3SET$1k$11."""
+    out = [b"*%d\r\n" % len(args)]
+    for a in args:
+        if isinstance(a, str):
+            a = a.encode()
+        elif isinstance(a, bool):
+            # bool before int: repr() would yield b"True"/b"False"
+            a = b"1" if a else b"0"
+        elif isinstance(a, (int, float)):
+            a = repr(a).encode()
+        elif not isinstance(a, (bytes, bytearray, memoryview)):
+            raise TypeError(f"bad redis argument type {type(a)!r}")
+        a = bytes(a)
+        out.append(b"$%d\r\n%s\r\n" % (len(a), a))
+    return b"".join(out)
+
+
+def encode_reply(value) -> bytes:
+    """Server->client encoding for handler return values."""
+    if isinstance(value, RedisStatus):
+        return b"+%s\r\n" % str(value).encode()
+    if isinstance(value, RedisError):
+        msg = value.args[0] if value.args else "ERR"
+        return b"-%s\r\n" % str(msg).encode()
+    if isinstance(value, bool):
+        return b":%d\r\n" % int(value)
+    if isinstance(value, int):
+        return b":%d\r\n" % value
+    if value is None:
+        return b"$-1\r\n"
+    if isinstance(value, str):
+        value = value.encode()
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        value = bytes(value)
+        return b"$%d\r\n%s\r\n" % (len(value), value)
+    if isinstance(value, (list, tuple)):
+        return b"*%d\r\n" % len(value) + b"".join(encode_reply(v) for v in value)
+    raise TypeError(f"cannot encode redis reply of type {type(value)!r}")
+
+
+def parse_value(data: bytes, pos: int, inline_ok: bool = False,
+                depth: int = 0) -> Tuple[Any, int]:
+    """Parse one RESP value starting at ``pos``. Raises _NeedMore when the
+    bytes are a valid prefix, _BadWire when they can never be RESP."""
+    if depth > 32:
+        # nesting is attacker-controlled ("*1\r\n" repeated): cap it so a
+        # hostile peer cannot blow the Python stack (RecursionError would
+        # escape the _NeedMore/_BadWire handling in parse())
+        raise _BadWire("RESP nesting too deep")
+    if pos >= len(data):
+        raise _NeedMore
+    t = data[pos:pos + 1]
+    eol = data.find(b"\r\n", pos)
+    if eol < 0:
+        if len(data) - pos > _MAX_LINE:
+            raise _BadWire("unterminated line")
+        raise _NeedMore
+    line = data[pos + 1:eol]
+    nxt = eol + 2
+    if t == b"+":
+        return RedisStatus(line.decode("latin1")), nxt
+    if t == b"-":
+        return RedisError(line.decode("latin1")), nxt
+    if t == b":":
+        try:
+            return int(line), nxt
+        except ValueError:
+            raise _BadWire("bad integer")
+    if t == b"$":
+        try:
+            n = int(line)
+        except ValueError:
+            raise _BadWire("bad bulk length")
+        if n == -1:
+            return None, nxt
+        if n < 0:
+            raise _BadWire("negative bulk length")
+        if len(data) < nxt + n + 2:
+            raise _NeedMore
+        if data[nxt + n:nxt + n + 2] != b"\r\n":
+            raise _BadWire("bulk not CRLF-terminated")
+        return data[nxt:nxt + n], nxt + n + 2
+    if t == b"*":
+        try:
+            n = int(line)
+        except ValueError:
+            raise _BadWire("bad array length")
+        if n == -1:
+            return None, nxt
+        if n < 0:
+            raise _BadWire("negative array length")
+        out = []
+        for _ in range(n):
+            v, nxt = parse_value(data, nxt, inline_ok=False, depth=depth + 1)
+            out.append(v)
+        return out, nxt
+    if inline_ok:
+        # telnet-style inline command: whole line is whitespace-split words
+        words = data[pos:eol].split()
+        if not words:
+            raise _BadWire("empty inline command")
+        return [bytes(w) for w in words], nxt
+    raise _BadWire(f"bad RESP type byte {t!r}")
+
+
+# ----------------------------------------------------------------- server
+
+class RedisService:
+    """Server-side command table (redis.h:240 RedisService +
+    RedisCommandHandler). Handlers take (cntl_socket, args) where args is
+    the full command as a list of bytes (args[0] = command name) and
+    return any value ``encode_reply`` accepts."""
+
+    def __init__(self):
+        self._handlers: Dict[str, Callable] = {}
+
+    def add_command_handler(self, name: str, fn: Callable) -> None:
+        self._handlers[name.upper()] = fn
+
+    def command(self, name: Optional[str] = None):
+        def deco(fn):
+            self.add_command_handler(name or fn.__name__, fn)
+            return fn
+        return deco
+
+    def find(self, name: bytes) -> Optional[Callable]:
+        return self._handlers.get(name.decode("latin1").upper())
+
+
+# --------------------------------------------------------------- protocol
+
+class _Burst(list):
+    """Several RESP values cut from one peek (a pipelined burst arriving
+    in a single read): delivered as one parse result so N messages cost
+    one O(bytes) pass instead of N re-peeks (O(N^2)); process_inline
+    fans them back out in order."""
+
+
+class RedisProtocol(Protocol):
+    name = "redis"
+
+    # ---------------------------------------------------------------- parse
+    def parse(self, portal, socket) -> Tuple[str, object]:
+        first = portal.peek_bytes(1)
+        seen = socket.user_data.get("redis_seen", False)
+        is_client = "redis_client" in socket.user_data
+        if not first:
+            return PARSE_NOT_ENOUGH_DATA, None
+        if first not in (b"*", b"+", b"-", b":", b"$") and not (seen or is_client):
+            # inline commands are only accepted once the peer has already
+            # spoken RESP on this connection — otherwise any text protocol
+            # would false-match here
+            return PARSE_TRY_OTHERS, None
+        data = portal.peek_bytes(portal.size)
+        values: List = []
+        consumed = 0
+        while consumed < len(data):
+            try:
+                value, consumed = parse_value(data, consumed,
+                                              inline_ok=not is_client)
+                values.append(value)
+            except _NeedMore:
+                break
+            except _BadWire:
+                if values or seen or is_client:
+                    # mid-stream corruption on an established redis conn:
+                    # fail the connection rather than let another protocol
+                    # eat it
+                    socket.set_failed(ConnectionError("corrupt RESP stream"))
+                    return PARSE_NOT_ENOUGH_DATA, None
+                return PARSE_TRY_OTHERS, None
+        if not values:
+            return PARSE_NOT_ENOUGH_DATA, None
+        socket.user_data["redis_seen"] = True
+        portal.pop_front(consumed)
+        if len(values) == 1:
+            return PARSE_OK, values[0]
+        return PARSE_OK, _Burst(values)
+
+    # -------------------------------------------------------------- process
+    def process_inline(self, msg, socket) -> bool:
+        """Both sides are order-critical: client replies fill the FIFO
+        batch queue (cheap, done right here); server commands drain
+        through one serial fiber per connection."""
+        vals = msg if isinstance(msg, _Burst) else (msg,)
+        client = socket.user_data.get("redis_client")
+        if client is not None:
+            for v in vals:
+                client._on_reply(socket, v)
+            return True
+        from brpc_tpu.transport.input_messenger import process_in_parse_order
+        for v in vals:
+            process_in_parse_order(socket, "redis", v, self._run_command)
+        return True
+
+    async def _run_command(self, cmd, socket):
+        import inspect
+        import time
+        server = socket.user_data.get("server")
+        service: Optional[RedisService] = (
+            getattr(server.options, "redis_service", None)
+            if server is not None else None)
+        if service is None:
+            socket.write(_reply_buf(RedisError(
+                "ERR this server has no redis_service installed")))
+            return
+        if not isinstance(cmd, list) or not cmd or \
+                not all(isinstance(a, bytes) for a in cmd):
+            socket.write(_reply_buf(RedisError("ERR bad command frame")))
+            return
+        handler = service.find(cmd[0])
+        name = cmd[0].decode("latin1").upper()
+        if handler is None:
+            if name == "PING":
+                socket.write(_reply_buf(RedisStatus("PONG")))
+                return
+            socket.write(_reply_buf(RedisError(
+                f"ERR unknown command '{name}'")))
+            return
+        if not server.on_request_start():
+            socket.write(_reply_buf(RedisError("ERR max_concurrency reached")))
+            return
+        t0 = time.monotonic_ns()
+        error = False
+        try:
+            r = handler(socket, cmd)
+            if inspect.isawaitable(r):
+                r = await r
+            out = _reply_buf(r)
+        except Exception as e:
+            error = True
+            out = _reply_buf(RedisError(f"ERR handler error: {e}"))
+        server.on_request_end(f"redis.{name}",
+                              (time.monotonic_ns() - t0) / 1e3, error)
+        socket.write(out)
+
+    def process(self, msg, socket):
+        # everything is order-critical and consumed by process_inline
+        raise AssertionError("redis messages are processed inline")
+
+
+def _reply_buf(value) -> IOBuf:
+    buf = IOBuf()
+    buf.append(encode_reply(value))
+    return buf
+
+
+# ---------------------------------------------------------------- client
+
+class _Batch:
+    __slots__ = ("n", "results", "event", "error", "socket")
+
+    def __init__(self, n: int, socket=None):
+        self.n = n
+        self.results: List[Any] = []
+        self.event = FiberEvent()
+        self.error: Optional[BaseException] = None
+        self.socket = socket
+
+
+class RedisClient:
+    """Pipelined RESP client over one connection.
+
+    ``execute`` sends one command and returns its reply (raising
+    RedisError replies); ``pipeline`` sends N commands in one write and
+    returns N replies (RedisError instances returned in-place). Both have
+    ``_async`` variants for fiber contexts."""
+
+    def __init__(self, address: str | EndPoint, password: Optional[str] = None,
+                 db: Optional[int] = None, timeout_s: float = 5.0,
+                 control: Optional[TaskControl] = None):
+        self._endpoint = (address if isinstance(address, EndPoint)
+                          else str2endpoint(address))
+        self._password = password
+        self._db = db
+        self._timeout_s = timeout_s
+        self._control = control or global_control()
+        self._proto = ensure_registered()
+        self._messenger = InputMessenger(protocols=[self._proto],
+                                         control=self._control)
+        self._lock = threading.Lock()
+        self._socket = None
+        self._inflight: deque[_Batch] = deque()
+
+    # ------------------------------------------------------------ plumbing
+    def _get_socket(self):
+        with self._lock:
+            s = self._socket
+        if s is not None and not s.failed:
+            return s
+        new = create_client_socket(
+            self._endpoint, on_input=self._messenger.on_new_messages,
+            control=self._control)
+        new.user_data["redis_client"] = self
+        new.on_failed(self._on_socket_failed)
+        hello: List[List] = []
+        if self._password is not None:
+            hello.append(["AUTH", self._password])
+        if self._db is not None:
+            hello.append(["SELECT", self._db])
+        hello_batch = None
+        with self._lock:
+            if self._socket is not None and not self._socket.failed:
+                loser, new = new, self._socket
+            else:
+                self._socket, loser = new, None
+                if hello:
+                    # first batch on the fresh connection, before any user
+                    # command can enqueue
+                    hello_batch = _Batch(len(hello), new)
+                    self._inflight.append(hello_batch)
+                    buf = IOBuf()
+                    for cmd in hello:
+                        buf.append(encode_command(cmd))
+                    new.write(buf)
+        if loser is not None:
+            loser.set_failed(ConnectionError("duplicate connect discarded"))
+        if hello_batch is not None:
+            # surface AUTH/SELECT failure at connect time instead of
+            # letting every later command fail with opaque NOAUTH
+            if not hello_batch.event.wait_pthread(self._timeout_s):
+                new.set_failed(TimeoutError("redis AUTH/SELECT timed out"))
+                raise TimeoutError("redis AUTH/SELECT timed out")
+            if hello_batch.error is not None:
+                raise hello_batch.error
+            for v in hello_batch.results:
+                if isinstance(v, RedisError):
+                    new.set_failed(ConnectionError(f"redis hello failed: {v}"))
+                    raise v
+        return new
+
+    def _on_socket_failed(self, socket):
+        """Fail only the batches written on THIS socket: the loser of a
+        duplicate-connect race dies with no batches, and flushing the
+        winner's queue here would desync its FIFO matching."""
+        failed = []
+        with self._lock:
+            kept = deque()
+            for batch in self._inflight:
+                (failed if batch.socket is socket else kept).append(batch)
+            self._inflight = kept
+            if self._socket is socket:
+                self._socket = None
+        err = getattr(socket, "fail_reason", None) or \
+            ConnectionError("redis connection failed")
+        for batch in failed:
+            batch.error = err
+            batch.event.set()
+
+    def _on_reply(self, socket, value):
+        with self._lock:
+            if not self._inflight or self._inflight[0].socket is not socket:
+                return      # stale socket's leftovers / abandoned timeout
+            batch = self._inflight[0]
+            batch.results.append(value)
+            if len(batch.results) >= batch.n:
+                self._inflight.popleft()
+                done = batch
+            else:
+                done = None
+        if done is not None:
+            done.event.set()
+
+    def _start(self, cmds: List) -> _Batch:
+        socket = self._get_socket()
+        buf = IOBuf()
+        for cmd in cmds:
+            buf.append(encode_command(cmd))
+        # enqueue + write under one lock: batch order in _inflight MUST
+        # match write order on the wire or FIFO matching cross-wires
+        # (socket.write only enqueues to the wait-free MPSC list, so
+        # holding the client lock across it is cheap and deadlock-free)
+        with self._lock:
+            batch = _Batch(len(cmds), socket)
+            self._inflight.append(batch)
+            ok = socket.write(buf)
+        if not ok:
+            self._on_socket_failed(socket)
+        return batch
+
+    def _on_timeout(self, batch: _Batch):
+        # a FIFO stream cannot resync past a lost reply: fail the
+        # connection so the next command reconnects cleanly (the
+        # reference does the same for pipelined connections)
+        if batch.socket is not None:
+            batch.socket.set_failed(
+                TimeoutError("redis command timed out"))
+
+    @staticmethod
+    def _finish(batch: _Batch, single: bool):
+        if batch.error is not None:
+            raise batch.error
+        if single:
+            v = batch.results[0]
+            if isinstance(v, RedisError):
+                raise v
+            return v
+        return list(batch.results)
+
+    # ----------------------------------------------------------------- api
+    def execute(self, *args):
+        batch = self._start([list(args)])
+        if not batch.event.wait_pthread(self._timeout_s):
+            self._on_timeout(batch)
+            raise TimeoutError(f"redis command timed out: {args[0]!r}")
+        return self._finish(batch, single=True)
+
+    def pipeline(self, cmds: List[List]) -> List:
+        if not cmds:
+            return []
+        batch = self._start([list(c) for c in cmds])
+        if not batch.event.wait_pthread(self._timeout_s):
+            self._on_timeout(batch)
+            raise TimeoutError("redis pipeline timed out")
+        return self._finish(batch, single=False)
+
+    async def execute_async(self, *args):
+        batch = self._start([list(args)])
+        if not await batch.event.wait(self._timeout_s):
+            self._on_timeout(batch)
+            raise TimeoutError(f"redis command timed out: {args[0]!r}")
+        return self._finish(batch, single=True)
+
+    async def pipeline_async(self, cmds: List[List]) -> List:
+        if not cmds:
+            return []
+        batch = self._start([list(c) for c in cmds])
+        if not await batch.event.wait(self._timeout_s):
+            self._on_timeout(batch)
+            raise TimeoutError("redis pipeline timed out")
+        return self._finish(batch, single=False)
+
+    def close(self):
+        with self._lock:
+            s, self._socket = self._socket, None
+        if s is not None and not s.failed:
+            s.set_failed(ConnectionError("redis client closed"))
+
+
+_instance: Optional[RedisProtocol] = None
+
+
+def ensure_registered() -> RedisProtocol:
+    global _instance
+    if _instance is None:
+        _instance = RedisProtocol()
+        register_protocol(_instance)
+    return _instance

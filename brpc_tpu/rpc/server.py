@@ -59,11 +59,15 @@ class ServerOptions:
     def __init__(self, num_workers: Optional[int] = None,
                  max_concurrency: Optional[int] = None,
                  auth_token: Optional[str] = None,
-                 enable_builtin_services: bool = True):
+                 enable_builtin_services: bool = True,
+                 redis_service=None):
         self.num_workers = num_workers
         self.max_concurrency = max_concurrency
         self.auth_token = auth_token
         self.enable_builtin_services = enable_builtin_services
+        # server-side redis command table (ServerOptions::redis_service in
+        # the reference, brpc/redis.h:240)
+        self.redis_service = redis_service
 
 
 class Server:

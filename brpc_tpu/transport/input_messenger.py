@@ -11,6 +11,8 @@ handoff).
 
 from __future__ import annotations
 
+import threading
+from collections import deque
 from typing import Callable, List, Optional
 
 from brpc_tpu.butil.iobuf import IOBuf
@@ -73,3 +75,43 @@ class InputMessenger:
         r = proto.process(msg, socket)
         if hasattr(r, "__await__"):
             await r
+
+
+def process_in_parse_order(socket: Socket, key: str, item,
+                           handler: Callable) -> None:
+    """Serialize order-critical message handling per connection: append to
+    a per-socket queue and let exactly one drain fiber run ``handler(item,
+    socket)`` for each item in parse order. Fibers run on multiple OS
+    threads, so the pending/draining handoff takes a real lock. Used by
+    HTTP/1.1 pipelining and the RESP FIFO (any protocol whose responses
+    must leave in request order)."""
+    lock = socket.user_data.setdefault(key + "_lock", threading.Lock())
+    with lock:
+        pending = socket.user_data.setdefault(key + "_pending", deque())
+        pending.append(item)
+        if socket.user_data.get(key + "_draining"):
+            return
+        socket.user_data[key + "_draining"] = True
+
+    async def _drain():
+        while True:
+            # popleft outside the flag check would race a new enqueue;
+            # keep both under one lock acquisition
+            with lock:
+                if not pending:
+                    socket.user_data[key + "_draining"] = False
+                    return
+                it = pending.popleft()
+            try:
+                await handler(it, socket)
+            except BaseException as e:
+                # a dead drain fiber with _draining still True would wedge
+                # the connection forever: fail it so the peer sees a close
+                # instead of a silent hang
+                with lock:
+                    socket.user_data[key + "_draining"] = False
+                socket.set_failed(e if isinstance(e, Exception)
+                                  else ConnectionError(f"drain died: {e!r}"))
+                raise
+
+    socket._control.spawn(_drain, name=key + "_serial")

@@ -250,3 +250,16 @@ def test_our_client_against_grpcio_server():
         ch.close()
     finally:
         gserver.stop(0)
+
+
+def test_huffman_padding_must_be_eos_prefix():
+    from brpc_tpu.protocol import hpack
+    # '0' encodes as 00000 (5 bits); pad with zeros -> must be rejected
+    import pytest
+    code, length = hpack.HUFFMAN_TABLE[ord("0")]
+    byte = (code << (8 - length)) & 0xFF  # zero padding bits
+    with pytest.raises(hpack.HpackError, match="padding"):
+        hpack.huffman_decode(bytes([byte]))
+    # correct all-ones padding decodes fine
+    byte_ok = (code << (8 - length)) | ((1 << (8 - length)) - 1)
+    assert hpack.huffman_decode(bytes([byte_ok])) == b"0"

@@ -1,0 +1,245 @@
+"""Redis protocol tests: RESP codec units + loopback client/server e2e
+(the reference's brpc_redis_unittest.cpp pattern: raw-byte codec checks
+plus a real in-process server driven by a real client)."""
+
+import threading
+
+import pytest
+
+from brpc_tpu.protocol import redis as r
+from brpc_tpu.rpc import Server, ServerOptions
+
+_name_seq = iter(range(10_000))
+
+
+# ---------------------------------------------------------------- codec
+
+def test_encode_command():
+    assert r.encode_command(["SET", "k", 1]) == \
+        b"*3\r\n$3\r\nSET\r\n$1\r\nk\r\n$1\r\n1\r\n"
+    assert r.encode_command([b"PING"]) == b"*1\r\n$4\r\nPING\r\n"
+
+
+def test_encode_reply_types():
+    assert r.encode_reply(r.RedisStatus("OK")) == b"+OK\r\n"
+    assert r.encode_reply(r.RedisError("ERR nope")) == b"-ERR nope\r\n"
+    assert r.encode_reply(7) == b":7\r\n"
+    assert r.encode_reply(None) == b"$-1\r\n"
+    assert r.encode_reply(b"hi") == b"$2\r\nhi\r\n"
+    assert r.encode_reply("hi") == b"$2\r\nhi\r\n"
+    assert r.encode_reply([1, b"a", None]) == b"*3\r\n:1\r\n$1\r\na\r\n$-1\r\n"
+
+
+def test_parse_roundtrip():
+    for v in [r.RedisStatus("OK"), 42, None, b"payload",
+              [1, 2, b"three", None, [b"nested"]]]:
+        data = r.encode_reply(v)
+        out, used = r.parse_value(data, 0)
+        assert used == len(data)
+        assert out == v
+    e, used = r.parse_value(b"-ERR boom\r\n", 0)
+    assert isinstance(e, r.RedisError) and e.args == ("ERR boom",)
+
+
+def test_parse_incremental_need_more():
+    full = r.encode_reply([b"abc", 5])
+    for cut in range(len(full)):
+        with pytest.raises(r._NeedMore):
+            r.parse_value(full[:cut], 0)
+
+
+def test_parse_inline_command():
+    v, used = r.parse_value(b"SET key value\r\n", 0, inline_ok=True)
+    assert v == [b"SET", b"key", b"value"]
+    with pytest.raises(r._BadWire):
+        r.parse_value(b"SET key\r\n", 0, inline_ok=False)
+
+
+def test_parse_bad_wire():
+    for bad in [b"$x\r\n", b":notint\r\n", b"*2\r\n:1\r\n$abc\r\n",
+                b"$3\r\nabcd\r\n"]:
+        with pytest.raises(r._BadWire):
+            r.parse_value(bad, 0)
+
+
+# ------------------------------------------------------------------ e2e
+
+def make_kv_service():
+    svc = r.RedisService()
+    store = {}
+    lock = threading.Lock()
+
+    @svc.command("SET")
+    def set_(sock, args):
+        if len(args) != 3:
+            return r.RedisError("ERR wrong number of arguments for 'set'")
+        with lock:
+            store[args[1]] = args[2]
+        return r.RedisStatus("OK")
+
+    @svc.command("GET")
+    def get(sock, args):
+        with lock:
+            return store.get(args[1])
+
+    @svc.command("INCR")
+    def incr(sock, args):
+        with lock:
+            v = int(store.get(args[1], b"0")) + 1
+            store[args[1]] = str(v).encode()
+        return v
+
+    @svc.command("BOOM")
+    def boom(sock, args):
+        raise RuntimeError("kaput")
+
+    @svc.command("SLOWECHO")
+    async def slowecho(sock, args):
+        from brpc_tpu import fiber
+        await fiber.sleep(0.005)
+        return args[1]
+
+    return svc
+
+
+@pytest.fixture()
+def redis_server():
+    server = Server(ServerOptions(redis_service=make_kv_service()))
+    ep = server.start(f"mem://redis-{next(_name_seq)}")
+    client = r.RedisClient(ep)
+    yield client
+    client.close()
+    server.stop()
+    server.join(2)
+
+
+def test_set_get(redis_server):
+    c = redis_server
+    assert c.execute("SET", "k", "v") == "OK"
+    assert c.execute("GET", "k") == b"v"
+    assert c.execute("GET", "missing") is None
+
+
+def test_incr_and_int_replies(redis_server):
+    c = redis_server
+    assert c.execute("INCR", "n") == 1
+    assert c.execute("INCR", "n") == 2
+
+
+def test_pipeline_order_and_errors(redis_server):
+    c = redis_server
+    out = c.pipeline([["SET", "a", "1"], ["INCR", "a"], ["GET", "a"],
+                      ["NOSUCHCMD"], ["GET", "missing"]])
+    assert out[0] == "OK"
+    assert out[1] == 2
+    assert out[2] == b"2"
+    assert isinstance(out[3], r.RedisError)
+    assert out[4] is None
+
+
+def test_default_ping(redis_server):
+    assert redis_server.execute("PING") == "PONG"
+
+
+def test_unknown_command_raises(redis_server):
+    with pytest.raises(r.RedisError, match="unknown command"):
+        redis_server.execute("WHATISTHIS")
+
+
+def test_handler_exception_is_error_reply(redis_server):
+    with pytest.raises(r.RedisError, match="handler error"):
+        redis_server.execute("BOOM")
+
+
+def test_async_handler(redis_server):
+    assert redis_server.execute("SLOWECHO", "deferred") == b"deferred"
+
+
+def test_large_pipeline_fifo(redis_server):
+    c = redis_server
+    n = 200
+    out = c.pipeline([["INCR", "ctr"] for _ in range(n)])
+    assert out == list(range(1, n + 1))
+
+
+def test_concurrent_clients(redis_server):
+    # redis_server fixture owns one client; hammer with 4 more threads on
+    # their own connections to stress FIFO matching under interleaving
+    errs = []
+
+    def worker(i):
+        try:
+            c = r.RedisClient(redis_server._endpoint)
+            for j in range(50):
+                key = f"t{i}"
+                c.execute("INCR", key)
+            assert c.execute("GET", f"t{i}") == b"50"
+            c.close()
+        except Exception as e:      # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(20)
+    assert not errs
+
+
+def test_no_redis_service_installed():
+    server = Server(ServerOptions())
+    ep = server.start(f"mem://redis-{next(_name_seq)}")
+    c = r.RedisClient(ep)
+    try:
+        with pytest.raises(r.RedisError, match="no redis_service"):
+            c.execute("GET", "k")
+    finally:
+        c.close()
+        server.stop()
+        server.join(2)
+
+
+def test_redis_over_tcp():
+    server = Server(ServerOptions(redis_service=make_kv_service()))
+    ep = server.start("tcp://127.0.0.1:0")
+    c = r.RedisClient(ep)
+    try:
+        assert c.execute("SET", "tk", "tv") == "OK"
+        out = c.pipeline([["GET", "tk"], ["INCR", "tn"], ["PING"]])
+        assert out == [b"tv", 1, "PONG"]
+    finally:
+        c.close()
+        server.stop()
+        server.join(2)
+
+
+def test_bool_args_encode_as_ints():
+    assert r.encode_command(["X", True, False]) == \
+        b"*3\r\n$1\r\nX\r\n$1\r\n1\r\n$1\r\n0\r\n"
+
+
+def test_shared_client_multithreaded_fifo(redis_server):
+    # many threads share ONE client/connection: enqueue order must match
+    # wire order or replies cross-wire between threads
+    c = redis_server
+    errs = []
+
+    def worker(i):
+        try:
+            for _ in range(100):
+                assert c.execute("SLOWECHO", f"v{i}") == f"v{i}".encode()
+        except Exception as e:      # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs
+
+
+def test_deep_nesting_fails_connection_not_process():
+    # "*1\r\n" * big: unbounded recursion must be _BadWire, not a crash
+    with pytest.raises(r._BadWire, match="nesting"):
+        r.parse_value(b"*1\r\n" * 200, 0)
