@@ -1,0 +1,326 @@
+"""Memcached binary protocol client (src/brpc/memcache.{h,cpp} — the
+890-line MemcacheRequest/Response pair — and
+policy/memcache_binary_protocol.cpp). Client-side only, like the
+reference.
+
+Binary framing: 24-byte header
+  magic:u8 opcode:u8 key_len:u16 extras_len:u8 data_type:u8
+  vbucket_or_status:u16 total_body:u32 opaque:u32 cas:u64
+Responses arrive strictly in request order per connection (memcached
+serializes per-conn), so FIFO batch matching applies — the opaque field
+is still checked as a desync tripwire."""
+
+from __future__ import annotations
+
+import itertools
+import struct
+from typing import List, NamedTuple, Optional, Tuple
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.fiber import TaskControl
+from brpc_tpu.protocol.registry import (
+    PARSE_NOT_ENOUGH_DATA, PARSE_OK, PARSE_TRY_OTHERS, Protocol,
+    register_protocol,
+)
+from brpc_tpu.transport.pipelined import PipelinedClient
+
+_HDR = struct.Struct(">BBHBBHIIQ")
+HEADER_SIZE = 24
+MAGIC_REQUEST = 0x80
+MAGIC_RESPONSE = 0x81
+
+# opcodes (protocol_binary.h of upstream memcached)
+OP_GET = 0x00
+OP_SET = 0x01
+OP_ADD = 0x02
+OP_REPLACE = 0x03
+OP_DELETE = 0x04
+OP_INCREMENT = 0x05
+OP_DECREMENT = 0x06
+OP_FLUSH = 0x08
+OP_NOOP = 0x0A
+OP_VERSION = 0x0B
+OP_APPEND = 0x0E
+OP_PREPEND = 0x0F
+OP_TOUCH = 0x1C
+
+# status codes
+STATUS_OK = 0x0000
+STATUS_KEY_NOT_FOUND = 0x0001
+STATUS_KEY_EXISTS = 0x0002
+STATUS_VALUE_TOO_LARGE = 0x0003
+STATUS_INVALID_ARGUMENTS = 0x0004
+STATUS_ITEM_NOT_STORED = 0x0005
+STATUS_NON_NUMERIC = 0x0006
+
+_MAX_BODY = 64 << 20
+
+
+class MemcacheError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"[0x{status:04x}] {message}")
+        self.status = status
+        self.message = message
+
+
+class Response(NamedTuple):
+    opcode: int
+    status: int
+    opaque: int
+    cas: int
+    extras: bytes
+    key: bytes
+    value: bytes
+
+
+class GetResult(NamedTuple):
+    value: bytes
+    flags: int
+    cas: int
+
+
+def pack_request(opcode: int, key: bytes = b"", value: bytes = b"",
+                 extras: bytes = b"", opaque: int = 0, cas: int = 0) -> bytes:
+    total = len(extras) + len(key) + len(value)
+    return _HDR.pack(MAGIC_REQUEST, opcode, len(key), len(extras), 0, 0,
+                     total, opaque, cas) + extras + key + value
+
+
+def parse_response(data: bytes, pos: int) -> Optional[Tuple[Response, int]]:
+    """One complete response frame at ``pos`` or None if incomplete.
+    Raises ValueError on a frame that can never be a binary response."""
+    if len(data) - pos < HEADER_SIZE:
+        return None
+    (magic, opcode, key_len, extras_len, _dtype, status, total, opaque,
+     cas) = _HDR.unpack_from(data, pos)
+    if magic != MAGIC_RESPONSE:
+        raise ValueError(f"bad response magic 0x{magic:02x}")
+    if total > _MAX_BODY or extras_len + key_len > total:
+        raise ValueError("bad body lengths")
+    if len(data) - pos < HEADER_SIZE + total:
+        return None
+    body = pos + HEADER_SIZE
+    extras = data[body:body + extras_len]
+    key = data[body + extras_len:body + extras_len + key_len]
+    value = data[body + extras_len + key_len:body + total]
+    return (Response(opcode, status, opaque, cas, extras, key, value),
+            body + total)
+
+
+class MemcacheProtocol(Protocol):
+    """Client-side parser: binary responses on sockets owned by a
+    MemcacheClient. Never claims server-side bytes."""
+
+    name = "memcache"
+
+    def parse(self, portal, socket) -> Tuple[str, object]:
+        client = socket.user_data.get("memcache_client")
+        if client is None:
+            return PARSE_TRY_OTHERS, None
+        # parse every complete frame off one flattened peek (a pipelined
+        # multi-get burst would otherwise cost O(N^2) in re-peeks)
+        data = portal.peek_bytes(portal.size)
+        frames: List[Response] = []
+        pos = 0
+        while pos < len(data):
+            try:
+                got = parse_response(data, pos)
+            except ValueError as e:
+                socket.set_failed(
+                    ConnectionError(f"corrupt memcache stream: {e}"))
+                return PARSE_NOT_ENOUGH_DATA, None
+            if got is None:
+                break
+            resp, pos = got
+            frames.append(resp)
+        if not frames:
+            return PARSE_NOT_ENOUGH_DATA, None
+        portal.pop_front(pos)
+        return PARSE_OK, frames
+
+    def process_inline(self, msgs: List[Response], socket) -> bool:
+        client = socket.user_data.get("memcache_client")
+        if client is not None:
+            for msg in msgs:
+                client._on_reply(socket, msg)
+        return True
+
+    def process(self, msg, socket):
+        raise AssertionError("memcache responses are processed inline")
+
+
+class MemcacheClient(PipelinedClient):
+    """get/set/add/replace/append/prepend/delete/incr/decr/touch/version/
+    flush_all over one pipelined connection (MemcacheRequest's batched
+    api maps to ``pipeline_get``)."""
+
+    user_data_key = "memcache_client"
+
+    def __init__(self, address: str | EndPoint, timeout_s: float = 5.0,
+                 control: Optional[TaskControl] = None):
+        super().__init__(address, ensure_registered(), timeout_s=timeout_s,
+                         control=control)
+        self._opaque = itertools.count(1)
+
+    # ------------------------------------------------------------ helpers
+    def _call(self, opcode: int, key: bytes = b"", value: bytes = b"",
+              extras: bytes = b"", cas: int = 0) -> Response:
+        opaque = next(self._opaque)
+        wire = pack_request(opcode, key, value, extras, opaque, cas)
+        batch = self._start(wire, 1)
+        resp: Response = self._wait(batch, f"memcache op 0x{opcode:02x}")[0]
+        if resp.opaque != opaque or resp.opcode != opcode:
+            # FIFO desync: fail the connection, nothing after this can match
+            if batch.socket is not None:
+                batch.socket.set_failed(
+                    ConnectionError("memcache reply desync"))
+            raise MemcacheError(-1, "reply desync (opaque mismatch)")
+        return resp
+
+    @staticmethod
+    def _key(key) -> bytes:
+        return key.encode() if isinstance(key, str) else bytes(key)
+
+    @staticmethod
+    def _val(value) -> bytes:
+        return value.encode() if isinstance(value, str) else bytes(value)
+
+    @staticmethod
+    def _raise(resp: Response):
+        raise MemcacheError(resp.status,
+                            resp.value.decode("latin1", "replace")
+                            or f"status 0x{resp.status:04x}")
+
+    # ---------------------------------------------------------------- api
+    def get(self, key) -> Optional[GetResult]:
+        resp = self._call(OP_GET, self._key(key))
+        if resp.status == STATUS_KEY_NOT_FOUND:
+            return None
+        if resp.status != STATUS_OK:
+            self._raise(resp)
+        flags = struct.unpack(">I", resp.extras)[0] if len(resp.extras) >= 4 else 0
+        return GetResult(resp.value, flags, resp.cas)
+
+    def _store(self, opcode: int, key, value, flags: int, exptime: int,
+               cas: int) -> int:
+        extras = struct.pack(">II", flags, exptime)
+        resp = self._call(opcode, self._key(key), self._val(value), extras,
+                          cas)
+        if resp.status != STATUS_OK:
+            self._raise(resp)
+        return resp.cas
+
+    def set(self, key, value, flags: int = 0, exptime: int = 0,
+            cas: int = 0) -> int:
+        """Returns the new cas. With cas != 0 this is a check-and-set
+        (raises MemcacheError(STATUS_KEY_EXISTS) on conflict)."""
+        return self._store(OP_SET, key, value, flags, exptime, cas)
+
+    def add(self, key, value, flags: int = 0, exptime: int = 0) -> int:
+        return self._store(OP_ADD, key, value, flags, exptime, 0)
+
+    def replace(self, key, value, flags: int = 0, exptime: int = 0) -> int:
+        return self._store(OP_REPLACE, key, value, flags, exptime, 0)
+
+    def _concat(self, opcode: int, key, value) -> int:
+        resp = self._call(opcode, self._key(key), self._val(value))
+        if resp.status != STATUS_OK:
+            self._raise(resp)
+        return resp.cas
+
+    def append(self, key, value) -> int:
+        return self._concat(OP_APPEND, key, value)
+
+    def prepend(self, key, value) -> int:
+        return self._concat(OP_PREPEND, key, value)
+
+    def delete(self, key) -> bool:
+        resp = self._call(OP_DELETE, self._key(key))
+        if resp.status == STATUS_KEY_NOT_FOUND:
+            return False
+        if resp.status != STATUS_OK:
+            self._raise(resp)
+        return True
+
+    def _arith(self, opcode: int, key, delta: int, initial: int,
+               exptime: int) -> int:
+        extras = struct.pack(">QQI", delta, initial, exptime)
+        resp = self._call(opcode, self._key(key), extras=extras)
+        if resp.status != STATUS_OK:
+            self._raise(resp)
+        return struct.unpack(">Q", resp.value)[0]
+
+    def incr(self, key, delta: int = 1, initial: int = 0,
+             exptime: int = 0) -> int:
+        return self._arith(OP_INCREMENT, key, delta, initial, exptime)
+
+    def decr(self, key, delta: int = 1, initial: int = 0,
+             exptime: int = 0) -> int:
+        return self._arith(OP_DECREMENT, key, delta, initial, exptime)
+
+    def touch(self, key, exptime: int) -> bool:
+        resp = self._call(OP_TOUCH, self._key(key),
+                          extras=struct.pack(">I", exptime))
+        if resp.status == STATUS_KEY_NOT_FOUND:
+            return False
+        if resp.status != STATUS_OK:
+            self._raise(resp)
+        return True
+
+    def version(self) -> str:
+        resp = self._call(OP_VERSION)
+        if resp.status != STATUS_OK:
+            self._raise(resp)
+        return resp.value.decode("latin1")
+
+    def flush_all(self, delay: int = 0) -> None:
+        extras = struct.pack(">I", delay) if delay else b""
+        resp = self._call(OP_FLUSH, extras=extras)
+        if resp.status != STATUS_OK:
+            self._raise(resp)
+
+    def noop(self) -> None:
+        resp = self._call(OP_NOOP)
+        if resp.status != STATUS_OK:
+            self._raise(resp)
+
+    def pipeline_get(self, keys: List) -> List[Optional[GetResult]]:
+        """Batched multi-get: N GET requests in one write, N replies."""
+        if not keys:
+            return []
+        opaques = []
+        buf = IOBuf()
+        for key in keys:
+            opaque = next(self._opaque)
+            opaques.append(opaque)
+            buf.append(pack_request(OP_GET, self._key(key), opaque=opaque))
+        batch = self._start(buf, len(keys))
+        results = self._wait(batch, "memcache pipeline_get")
+        out: List[Optional[GetResult]] = []
+        for resp, opaque in zip(results, opaques):
+            if resp.opaque != opaque:
+                if batch.socket is not None:
+                    batch.socket.set_failed(
+                        ConnectionError("memcache reply desync"))
+                raise MemcacheError(-1, "reply desync (opaque mismatch)")
+            if resp.status == STATUS_KEY_NOT_FOUND:
+                out.append(None)
+            elif resp.status != STATUS_OK:
+                self._raise(resp)
+            else:
+                flags = (struct.unpack(">I", resp.extras)[0]
+                         if len(resp.extras) >= 4 else 0)
+                out.append(GetResult(resp.value, flags, resp.cas))
+        return out
+
+
+_instance: Optional[MemcacheProtocol] = None
+
+
+def ensure_registered() -> MemcacheProtocol:
+    global _instance
+    if _instance is None:
+        _instance = MemcacheProtocol()
+        register_protocol(_instance)
+    return _instance

@@ -14,20 +14,16 @@ serial fiber (same pattern as HTTP/1.1 pipelining in protocol/http.py).
 
 from __future__ import annotations
 
-import threading
-from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+from brpc_tpu.butil.endpoint import EndPoint
 from brpc_tpu.butil.iobuf import IOBuf
-from brpc_tpu.fiber import TaskControl, global_control
-from brpc_tpu.fiber.sync import FiberEvent
+from brpc_tpu.fiber import TaskControl
 from brpc_tpu.protocol.registry import (
     PARSE_NOT_ENOUGH_DATA, PARSE_OK, PARSE_TRY_OTHERS, Protocol,
     register_protocol,
 )
-from brpc_tpu.transport.input_messenger import InputMessenger
-from brpc_tpu.transport.socket import create_client_socket
+from brpc_tpu.transport.pipelined import PipelinedClient
 
 _MAX_LINE = 1 << 20            # cap unterminated scans (flood guard)
 
@@ -306,18 +302,7 @@ def _reply_buf(value) -> IOBuf:
 
 # ---------------------------------------------------------------- client
 
-class _Batch:
-    __slots__ = ("n", "results", "event", "error", "socket")
-
-    def __init__(self, n: int, socket=None):
-        self.n = n
-        self.results: List[Any] = []
-        self.event = FiberEvent()
-        self.error: Optional[BaseException] = None
-        self.socket = socket
-
-
-class RedisClient:
+class RedisClient(PipelinedClient):
     """Pipelined RESP client over one connection.
 
     ``execute`` sends one command and returns its reply (raising
@@ -325,175 +310,61 @@ class RedisClient:
     returns N replies (RedisError instances returned in-place). Both have
     ``_async`` variants for fiber contexts."""
 
+    user_data_key = "redis_client"
+
     def __init__(self, address: str | EndPoint, password: Optional[str] = None,
                  db: Optional[int] = None, timeout_s: float = 5.0,
                  control: Optional[TaskControl] = None):
-        self._endpoint = (address if isinstance(address, EndPoint)
-                          else str2endpoint(address))
+        super().__init__(address, ensure_registered(), timeout_s=timeout_s,
+                         control=control)
         self._password = password
         self._db = db
-        self._timeout_s = timeout_s
-        self._control = control or global_control()
-        self._proto = ensure_registered()
-        self._messenger = InputMessenger(protocols=[self._proto],
-                                         control=self._control)
-        self._lock = threading.Lock()
-        self._socket = None
-        self._inflight: deque[_Batch] = deque()
 
-    # ------------------------------------------------------------ plumbing
-    def _get_socket(self):
-        with self._lock:
-            s = self._socket
-        if s is not None and not s.failed:
-            return s
-        new = create_client_socket(
-            self._endpoint, on_input=self._messenger.on_new_messages,
-            control=self._control)
-        new.user_data["redis_client"] = self
-        new.on_failed(self._on_socket_failed)
-        hello: List[List] = []
+    def _hello_commands(self) -> List[bytes]:
+        hello = []
         if self._password is not None:
-            hello.append(["AUTH", self._password])
+            hello.append(encode_command(["AUTH", self._password]))
         if self._db is not None:
-            hello.append(["SELECT", self._db])
-        hello_batch = None
-        with self._lock:
-            if self._socket is not None and not self._socket.failed:
-                loser, new = new, self._socket
-            else:
-                self._socket, loser = new, None
-                if hello:
-                    # first batch on the fresh connection, before any user
-                    # command can enqueue
-                    hello_batch = _Batch(len(hello), new)
-                    self._inflight.append(hello_batch)
-                    buf = IOBuf()
-                    for cmd in hello:
-                        buf.append(encode_command(cmd))
-                    new.write(buf)
-        if loser is not None:
-            loser.set_failed(ConnectionError("duplicate connect discarded"))
-        if hello_batch is not None:
-            # surface AUTH/SELECT failure at connect time instead of
-            # letting every later command fail with opaque NOAUTH
-            if not hello_batch.event.wait_pthread(self._timeout_s):
-                new.set_failed(TimeoutError("redis AUTH/SELECT timed out"))
-                raise TimeoutError("redis AUTH/SELECT timed out")
-            if hello_batch.error is not None:
-                raise hello_batch.error
-            for v in hello_batch.results:
-                if isinstance(v, RedisError):
-                    new.set_failed(ConnectionError(f"redis hello failed: {v}"))
-                    raise v
-        return new
+            hello.append(encode_command(["SELECT", self._db]))
+        return hello
 
-    def _on_socket_failed(self, socket):
-        """Fail only the batches written on THIS socket: the loser of a
-        duplicate-connect race dies with no batches, and flushing the
-        winner's queue here would desync its FIFO matching."""
-        failed = []
-        with self._lock:
-            kept = deque()
-            for batch in self._inflight:
-                (failed if batch.socket is socket else kept).append(batch)
-            self._inflight = kept
-            if self._socket is socket:
-                self._socket = None
-        err = getattr(socket, "fail_reason", None) or \
-            ConnectionError("redis connection failed")
-        for batch in failed:
-            batch.error = err
-            batch.event.set()
+    def _check_hello_reply(self, reply) -> None:
+        if isinstance(reply, RedisError):
+            raise reply
 
-    def _on_reply(self, socket, value):
-        with self._lock:
-            if not self._inflight or self._inflight[0].socket is not socket:
-                return      # stale socket's leftovers / abandoned timeout
-            batch = self._inflight[0]
-            batch.results.append(value)
-            if len(batch.results) >= batch.n:
-                self._inflight.popleft()
-                done = batch
-            else:
-                done = None
-        if done is not None:
-            done.event.set()
-
-    def _start(self, cmds: List) -> _Batch:
-        socket = self._get_socket()
+    def _encode_batch(self, cmds: List[List]) -> IOBuf:
         buf = IOBuf()
         for cmd in cmds:
             buf.append(encode_command(cmd))
-        # enqueue + write under one lock: batch order in _inflight MUST
-        # match write order on the wire or FIFO matching cross-wires
-        # (socket.write only enqueues to the wait-free MPSC list, so
-        # holding the client lock across it is cheap and deadlock-free)
-        with self._lock:
-            batch = _Batch(len(cmds), socket)
-            self._inflight.append(batch)
-            ok = socket.write(buf)
-        if not ok:
-            self._on_socket_failed(socket)
-        return batch
-
-    def _on_timeout(self, batch: _Batch):
-        # a FIFO stream cannot resync past a lost reply: fail the
-        # connection so the next command reconnects cleanly (the
-        # reference does the same for pipelined connections)
-        if batch.socket is not None:
-            batch.socket.set_failed(
-                TimeoutError("redis command timed out"))
+        return buf
 
     @staticmethod
-    def _finish(batch: _Batch, single: bool):
-        if batch.error is not None:
-            raise batch.error
-        if single:
-            v = batch.results[0]
-            if isinstance(v, RedisError):
-                raise v
-            return v
-        return list(batch.results)
+    def _one(results: List):
+        v = results[0]
+        if isinstance(v, RedisError):
+            raise v
+        return v
 
     # ----------------------------------------------------------------- api
     def execute(self, *args):
-        batch = self._start([list(args)])
-        if not batch.event.wait_pthread(self._timeout_s):
-            self._on_timeout(batch)
-            raise TimeoutError(f"redis command timed out: {args[0]!r}")
-        return self._finish(batch, single=True)
+        batch = self._start(self._encode_batch([list(args)]), 1)
+        return self._one(self._wait(batch, f"redis {args[0]!r}"))
 
     def pipeline(self, cmds: List[List]) -> List:
         if not cmds:
             return []
-        batch = self._start([list(c) for c in cmds])
-        if not batch.event.wait_pthread(self._timeout_s):
-            self._on_timeout(batch)
-            raise TimeoutError("redis pipeline timed out")
-        return self._finish(batch, single=False)
+        batch = self._start(self._encode_batch(cmds), len(cmds))
+        return self._wait(batch, "redis pipeline")
 
     async def execute_async(self, *args):
-        batch = self._start([list(args)])
-        if not await batch.event.wait(self._timeout_s):
-            self._on_timeout(batch)
-            raise TimeoutError(f"redis command timed out: {args[0]!r}")
-        return self._finish(batch, single=True)
+        batch = self._start(self._encode_batch([list(args)]), 1)
+        return self._one(await self._wait_async(batch, f"redis {args[0]!r}"))
 
     async def pipeline_async(self, cmds: List[List]) -> List:
         if not cmds:
             return []
-        batch = self._start([list(c) for c in cmds])
-        if not await batch.event.wait(self._timeout_s):
-            self._on_timeout(batch)
-            raise TimeoutError("redis pipeline timed out")
-        return self._finish(batch, single=False)
-
-    def close(self):
-        with self._lock:
-            s, self._socket = self._socket, None
-        if s is not None and not s.failed:
-            s.set_failed(ConnectionError("redis client closed"))
+        batch = self._start(self._encode_batch(cmds), len(cmds))
+        return await self._wait_async(batch, "redis pipeline")
 
 
 _instance: Optional[RedisProtocol] = None

@@ -1,0 +1,191 @@
+"""Pipelined FIFO client base: the connection + batch-matching machinery
+shared by protocols whose responses carry no correlation id and arrive
+strictly in request order (redis RESP, memcached binary). The reference
+gets this behavior from `pipelined_count` on Socket (socket.h write
+options) — here it is a small base class.
+
+Invariants:
+- batch order in `_inflight` equals write order on the wire (enqueue and
+  write happen under one lock; Socket.write only enqueues to the
+  wait-free MPSC list, so holding the lock across it is cheap).
+- batches are tied to the socket they were written on; a socket failure
+  fails exactly its own batches.
+- a reply timeout fails the connection: a FIFO stream cannot resync past
+  a lost reply.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, List, Optional
+
+from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.fiber import TaskControl, global_control
+from brpc_tpu.fiber.sync import FiberEvent
+from brpc_tpu.transport.input_messenger import InputMessenger
+from brpc_tpu.transport.socket import create_client_socket
+
+
+class Batch:
+    __slots__ = ("n", "results", "event", "error", "socket")
+
+    def __init__(self, n: int, socket=None):
+        self.n = n
+        self.results: List[Any] = []
+        self.event = FiberEvent()
+        self.error: Optional[BaseException] = None
+        self.socket = socket
+
+
+class PipelinedClient:
+    """Subclasses set `user_data_key` (how the protocol's parse/process
+    recognizes a client socket) and may override `_hello_commands()` ->
+    list of wire-bytes whose replies are checked by `_check_hello_reply`.
+    """
+
+    user_data_key = "pipelined_client"
+
+    def __init__(self, address: str | EndPoint, protocol,
+                 timeout_s: float = 5.0,
+                 control: Optional[TaskControl] = None):
+        self._endpoint = (address if isinstance(address, EndPoint)
+                          else str2endpoint(address))
+        self._timeout_s = timeout_s
+        self._control = control or global_control()
+        self._messenger = InputMessenger(protocols=[protocol],
+                                         control=self._control)
+        self._lock = threading.Lock()
+        self._socket = None
+        self._inflight: deque[Batch] = deque()
+
+    # ---------------------------------------------------------- overrides
+    def _hello_commands(self) -> List[bytes]:
+        """Wire bytes to send first on a fresh connection (AUTH/SELECT...),
+        one reply expected per entry."""
+        return []
+
+    def _check_hello_reply(self, reply) -> None:
+        """Raise to reject the connection based on a hello reply."""
+
+    # ------------------------------------------------------------ plumbing
+    def _get_socket(self):
+        with self._lock:
+            s = self._socket
+        if s is not None and not s.failed:
+            return s
+        new = create_client_socket(
+            self._endpoint, on_input=self._messenger.on_new_messages,
+            control=self._control)
+        new.user_data[self.user_data_key] = self
+        new.on_failed(self._on_socket_failed)
+        hello = self._hello_commands()
+        hello_batch = None
+        with self._lock:
+            if self._socket is not None and not self._socket.failed:
+                loser, new = new, self._socket
+            else:
+                self._socket, loser = new, None
+                if hello:
+                    # first batch on the fresh connection, before any user
+                    # command can enqueue
+                    hello_batch = Batch(len(hello), new)
+                    self._inflight.append(hello_batch)
+                    buf = IOBuf()
+                    for wire in hello:
+                        buf.append(wire)
+                    new.write(buf)
+        if loser is not None:
+            loser.set_failed(ConnectionError("duplicate connect discarded"))
+        if hello_batch is not None:
+            # surface AUTH/SELECT failure at connect time instead of
+            # letting every later command fail opaquely
+            if not hello_batch.event.wait_pthread(self._timeout_s):
+                new.set_failed(TimeoutError("connection hello timed out"))
+                raise TimeoutError("connection hello timed out")
+            if hello_batch.error is not None:
+                raise hello_batch.error
+            for v in hello_batch.results:
+                try:
+                    self._check_hello_reply(v)
+                except BaseException:
+                    new.set_failed(ConnectionError("connection hello failed"))
+                    raise
+        return new
+
+    def _on_socket_failed(self, socket):
+        """Fail only the batches written on THIS socket: the loser of a
+        duplicate-connect race dies with no batches, and flushing the
+        winner's queue here would desync its FIFO matching."""
+        failed = []
+        with self._lock:
+            kept = deque()
+            for batch in self._inflight:
+                (failed if batch.socket is socket else kept).append(batch)
+            self._inflight = kept
+            if self._socket is socket:
+                self._socket = None
+        err = getattr(socket, "fail_reason", None) or \
+            ConnectionError("connection failed")
+        for batch in failed:
+            batch.error = err
+            batch.event.set()
+
+    def _on_reply(self, socket, value):
+        with self._lock:
+            if not self._inflight or self._inflight[0].socket is not socket:
+                return      # stale socket's leftovers / abandoned timeout
+            batch = self._inflight[0]
+            batch.results.append(value)
+            if len(batch.results) >= batch.n:
+                self._inflight.popleft()
+                done = batch
+            else:
+                done = None
+        if done is not None:
+            done.event.set()
+
+    def _start(self, wire: bytes | IOBuf, nreplies: int) -> Batch:
+        socket = self._get_socket()
+        if isinstance(wire, IOBuf):
+            buf = wire
+        else:
+            buf = IOBuf()
+            buf.append(wire)
+        # enqueue + write under one lock: batch order in _inflight MUST
+        # match write order on the wire or FIFO matching cross-wires
+        with self._lock:
+            batch = Batch(nreplies, socket)
+            self._inflight.append(batch)
+            ok = socket.write(buf)
+        if not ok:
+            self._on_socket_failed(socket)
+        return batch
+
+    def _wait(self, batch: Batch, what: str = "command") -> List[Any]:
+        if not batch.event.wait_pthread(self._timeout_s):
+            self._fail_timeout(batch, what)
+        return self._finish(batch)
+
+    async def _wait_async(self, batch: Batch, what: str = "command") -> List[Any]:
+        if not await batch.event.wait(self._timeout_s):
+            self._fail_timeout(batch, what)
+        return self._finish(batch)
+
+    def _fail_timeout(self, batch: Batch, what: str):
+        if batch.socket is not None:
+            batch.socket.set_failed(TimeoutError(f"{what} timed out"))
+        raise TimeoutError(f"{what} timed out")
+
+    @staticmethod
+    def _finish(batch: Batch) -> List[Any]:
+        if batch.error is not None:
+            raise batch.error
+        return batch.results
+
+    def close(self):
+        with self._lock:
+            s, self._socket = self._socket, None
+        if s is not None and not s.failed:
+            s.set_failed(ConnectionError("client closed"))

@@ -1,0 +1,255 @@
+"""Memcached binary protocol tests: codec units + a real TCP mock server
+implementing the binary protocol semantics (get/set/add/replace/delete/
+incr/append/version), mirroring the reference's
+brpc_memcache_unittest pattern of crafting and checking binary frames."""
+
+import socketserver
+import struct
+import threading
+
+import pytest
+
+from brpc_tpu.protocol import memcache as mc
+
+
+# ----------------------------------------------------------- mock server
+
+class _Store:
+    def __init__(self):
+        self.data = {}          # key -> (value, flags, cas)
+        self.cas_seq = 0
+        self.lock = threading.Lock()
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def _reply(self, opcode, opaque, status=mc.STATUS_OK, extras=b"",
+               key=b"", value=b"", cas=0):
+        if status != mc.STATUS_OK and not value:
+            value = {mc.STATUS_KEY_NOT_FOUND: b"Not found",
+                     mc.STATUS_KEY_EXISTS: b"Data exists for key",
+                     mc.STATUS_ITEM_NOT_STORED: b"Not stored",
+                     mc.STATUS_NON_NUMERIC: b"Non-numeric value",
+                     }.get(status, b"error")
+        total = len(extras) + len(key) + len(value)
+        self.request.sendall(mc._HDR.pack(
+            mc.MAGIC_RESPONSE, opcode, len(key), len(extras), 0, status,
+            total, opaque, cas) + extras + key + value)
+
+    def handle(self):
+        store = self.server.store
+        buf = b""
+        while True:
+            try:
+                chunk = self.request.recv(65536)
+            except OSError:
+                return
+            if not chunk:
+                return
+            buf += chunk
+            while len(buf) >= mc.HEADER_SIZE:
+                (magic, opcode, key_len, extras_len, _dt, _vb, total,
+                 opaque, cas) = mc._HDR.unpack_from(buf, 0)
+                assert magic == mc.MAGIC_REQUEST
+                if len(buf) < mc.HEADER_SIZE + total:
+                    break
+                body = buf[mc.HEADER_SIZE:mc.HEADER_SIZE + total]
+                buf = buf[mc.HEADER_SIZE + total:]
+                extras = body[:extras_len]
+                key = body[extras_len:extras_len + key_len]
+                value = body[extras_len + key_len:]
+                self._dispatch(store, opcode, extras, key, value, opaque,
+                               cas)
+
+    def _dispatch(self, store, opcode, extras, key, value, opaque, cas):
+        with store.lock:
+            if opcode == mc.OP_GET:
+                if key not in store.data:
+                    self._reply(opcode, opaque, mc.STATUS_KEY_NOT_FOUND)
+                    return
+                v, flags, kcas = store.data[key]
+                self._reply(opcode, opaque, extras=struct.pack(">I", flags),
+                            value=v, cas=kcas)
+            elif opcode in (mc.OP_SET, mc.OP_ADD, mc.OP_REPLACE):
+                flags, _exp = struct.unpack(">II", extras)
+                if opcode == mc.OP_ADD and key in store.data:
+                    self._reply(opcode, opaque, mc.STATUS_KEY_EXISTS)
+                    return
+                if opcode == mc.OP_REPLACE and key not in store.data:
+                    self._reply(opcode, opaque, mc.STATUS_KEY_NOT_FOUND)
+                    return
+                if opcode == mc.OP_SET and cas:
+                    cur = store.data.get(key)
+                    if cur is not None and cur[2] != cas:
+                        self._reply(opcode, opaque, mc.STATUS_KEY_EXISTS)
+                        return
+                store.cas_seq += 1
+                store.data[key] = (value, flags, store.cas_seq)
+                self._reply(opcode, opaque, cas=store.cas_seq)
+            elif opcode in (mc.OP_APPEND, mc.OP_PREPEND):
+                if key not in store.data:
+                    self._reply(opcode, opaque, mc.STATUS_ITEM_NOT_STORED)
+                    return
+                v, flags, _ = store.data[key]
+                v = v + value if opcode == mc.OP_APPEND else value + v
+                store.cas_seq += 1
+                store.data[key] = (v, flags, store.cas_seq)
+                self._reply(opcode, opaque, cas=store.cas_seq)
+            elif opcode == mc.OP_DELETE:
+                if key not in store.data:
+                    self._reply(opcode, opaque, mc.STATUS_KEY_NOT_FOUND)
+                    return
+                del store.data[key]
+                self._reply(opcode, opaque)
+            elif opcode in (mc.OP_INCREMENT, mc.OP_DECREMENT):
+                delta, initial, _exp = struct.unpack(">QQI", extras)
+                cur = store.data.get(key)
+                if cur is None:
+                    n = initial
+                else:
+                    try:
+                        n = int(cur[0])
+                    except ValueError:
+                        self._reply(opcode, opaque, mc.STATUS_NON_NUMERIC)
+                        return
+                    n = n + delta if opcode == mc.OP_INCREMENT else \
+                        max(0, n - delta)
+                store.cas_seq += 1
+                store.data[key] = (str(n).encode(), 0, store.cas_seq)
+                self._reply(opcode, opaque, value=struct.pack(">Q", n),
+                            cas=store.cas_seq)
+            elif opcode == mc.OP_VERSION:
+                self._reply(opcode, opaque, value=b"1.6.0-mock")
+            elif opcode == mc.OP_FLUSH:
+                store.data.clear()
+                self._reply(opcode, opaque)
+            elif opcode == mc.OP_NOOP:
+                self._reply(opcode, opaque)
+            else:
+                self._reply(opcode, opaque, 0x0081)  # unknown command
+
+
+class _MockMemcached(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self):
+        super().__init__(("127.0.0.1", 0), _Handler)
+        self.store = _Store()
+
+
+@pytest.fixture()
+def client():
+    server = _MockMemcached()
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    host, port = server.server_address
+    c = mc.MemcacheClient(f"tcp://{host}:{port}")
+    yield c
+    c.close()
+    server.shutdown()
+    server.server_close()
+
+
+# ---------------------------------------------------------------- codec
+
+def test_pack_request_layout():
+    wire = mc.pack_request(mc.OP_SET, b"key", b"val",
+                           struct.pack(">II", 7, 0), opaque=9, cas=3)
+    assert len(wire) == 24 + 8 + 3 + 3
+    magic, opcode, key_len, extras_len, _, _, total, opaque, cas = \
+        mc._HDR.unpack(wire[:24])
+    assert (magic, opcode, key_len, extras_len, total, opaque, cas) == \
+        (0x80, mc.OP_SET, 3, 8, 14, 9, 3)
+
+
+def test_parse_response_incomplete_and_bad():
+    full = mc._HDR.pack(mc.MAGIC_RESPONSE, mc.OP_GET, 0, 4, 0, 0, 9, 1, 5) \
+        + struct.pack(">I", 2) + b"hello"
+    for cut in range(len(full)):
+        assert mc.parse_response(full[:cut], 0) is None
+    resp, used = mc.parse_response(full, 0)
+    assert used == len(full)
+    assert resp.value == b"hello" and resp.cas == 5 and resp.extras == \
+        struct.pack(">I", 2)
+    with pytest.raises(ValueError):
+        mc.parse_response(b"\x80" + full[1:], 0)   # request magic
+
+
+# ------------------------------------------------------------------ e2e
+
+def test_set_get_delete(client):
+    cas = client.set("k", "v", flags=42)
+    assert cas > 0
+    got = client.get("k")
+    assert got.value == b"v" and got.flags == 42 and got.cas == cas
+    assert client.get("missing") is None
+    assert client.delete("k") is True
+    assert client.delete("k") is False
+    assert client.get("k") is None
+
+
+def test_add_replace_semantics(client):
+    client.add("a", "1")
+    with pytest.raises(mc.MemcacheError) as ei:
+        client.add("a", "2")
+    assert ei.value.status == mc.STATUS_KEY_EXISTS
+    client.replace("a", "3")
+    assert client.get("a").value == b"3"
+    with pytest.raises(mc.MemcacheError):
+        client.replace("nope", "x")
+
+
+def test_cas_conflict(client):
+    cas = client.set("c", "v1")
+    client.set("c", "v2")  # bumps cas
+    with pytest.raises(mc.MemcacheError) as ei:
+        client.set("c", "v3", cas=cas)
+    assert ei.value.status == mc.STATUS_KEY_EXISTS
+
+
+def test_incr_decr(client):
+    assert client.incr("n", 5, initial=10) == 10   # created at initial
+    assert client.incr("n", 5) == 15
+    assert client.decr("n", 3) == 12
+
+
+def test_append_prepend(client):
+    client.set("s", "mid")
+    client.append("s", ">")
+    client.prepend("s", "<")
+    assert client.get("s").value == b"<mid>"
+
+
+def test_version_noop_flush(client):
+    assert client.version() == "1.6.0-mock"
+    client.noop()
+    client.set("f", "x")
+    client.flush_all()
+    assert client.get("f") is None
+
+
+def test_pipeline_get(client):
+    for i in range(20):
+        client.set(f"k{i}", f"v{i}")
+    out = client.pipeline_get([f"k{i}" for i in range(20)] + ["nope"])
+    assert [g.value for g in out[:20]] == [f"v{i}".encode() for i in range(20)]
+    assert out[20] is None
+
+
+def test_concurrent_shared_client(client):
+    errs = []
+
+    def worker(i):
+        try:
+            for j in range(30):
+                client.set(f"t{i}.{j}", f"val{i}.{j}")
+                assert client.get(f"t{i}.{j}").value == f"val{i}.{j}".encode()
+        except Exception as e:      # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs
