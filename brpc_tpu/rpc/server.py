@@ -60,7 +60,7 @@ class ServerOptions:
                  max_concurrency: Optional[int] = None,
                  auth_token: Optional[str] = None,
                  enable_builtin_services: bool = True,
-                 redis_service=None):
+                 redis_service=None, thrift_service=None):
         self.num_workers = num_workers
         self.max_concurrency = max_concurrency
         self.auth_token = auth_token
@@ -68,6 +68,8 @@ class ServerOptions:
         # server-side redis command table (ServerOptions::redis_service in
         # the reference, brpc/redis.h:240)
         self.redis_service = redis_service
+        # native thrift method table (brpc/thrift_service.h)
+        self.thrift_service = thrift_service
 
 
 class Server:
