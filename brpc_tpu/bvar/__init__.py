@@ -10,10 +10,14 @@ from brpc_tpu.bvar.percentile import Percentile
 from brpc_tpu.bvar.window import Window, PerSecond, Sampler, global_sampler
 from brpc_tpu.bvar.latency_recorder import LatencyRecorder
 from brpc_tpu.bvar.prometheus import dump_prometheus
+from brpc_tpu.bvar.multi_dimension import MultiDimension
+from brpc_tpu.bvar.default_variables import expose_default_variables
+from brpc_tpu.bvar.gflag import FlagVar, expose_flag, expose_all_flags
 
 __all__ = [
     "Variable", "expose", "dump_exposed", "describe_exposed", "unexpose_all",
     "Adder", "Maxer", "Miner", "IntRecorder", "PassiveStatus", "Status",
     "Percentile", "Window", "PerSecond", "Sampler", "global_sampler",
-    "LatencyRecorder", "dump_prometheus",
+    "LatencyRecorder", "dump_prometheus", "MultiDimension",
+    "expose_default_variables", "FlagVar", "expose_flag", "expose_all_flags",
 ]

@@ -5,7 +5,7 @@ from __future__ import annotations
 
 from typing import List
 
-from brpc_tpu.bvar.variable import dump_exposed
+from brpc_tpu.bvar.variable import dump_exposed_variables
 
 
 def _sanitize(name: str) -> str:
@@ -18,10 +18,35 @@ def _sanitize(name: str) -> str:
     return s
 
 
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def dump_prometheus(prefix: str = "") -> str:
+    from brpc_tpu.bvar.multi_dimension import MultiDimension
     lines: List[str] = []
-    for name, value in dump_exposed(prefix):
+    for name, var in dump_exposed_variables(prefix):
         mname = _sanitize(name)
+        if isinstance(var, MultiDimension):
+            # labeled series: name{k="v",...} value
+            label_names = [_sanitize(ln) for ln in var.label_names]
+            for key, v in sorted(var.get_value().items()):
+                if isinstance(v, dict):
+                    # composite stat (e.g. LatencyRecorder): one line per
+                    # numeric component
+                    for ck, cv in v.items():
+                        if isinstance(cv, (int, float)):
+                            labels = ",".join(
+                                f'{ln}="{_escape_label(str(kv))}"'
+                                for ln, kv in zip(label_names, key))
+                            lines.append(
+                                f"{mname}_{_sanitize(str(ck))}{{{labels}}} {cv}")
+                elif isinstance(v, (int, float)):
+                    labels = ",".join(f'{ln}="{_escape_label(str(kv))}"'
+                                      for ln, kv in zip(label_names, key))
+                    lines.append(f"{mname}{{{labels}}} {v}")
+            continue
+        value = var.get_value()
         if isinstance(value, dict):
             for k, v in value.items():
                 if isinstance(v, (int, float)):

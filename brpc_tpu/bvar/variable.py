@@ -59,6 +59,14 @@ def dump_exposed(prefix: str = "") -> List[Tuple[str, object]]:
     return sorted((n, v.get_value()) for n, v in items)
 
 
+def dump_exposed_variables(prefix: str = "") -> List[Tuple[str, "Variable"]]:
+    """Snapshot of (name, variable) — for dumpers that need the variable
+    itself (e.g. prometheus labeling of MultiDimension series)."""
+    with _registry_lock:
+        return sorted((n, v) for n, v in _registry.items()
+                      if n.startswith(prefix))
+
+
 def describe_exposed(name: str) -> Optional[str]:
     with _registry_lock:
         v = _registry.get(name)

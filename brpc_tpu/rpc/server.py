@@ -117,7 +117,10 @@ class Server:
         ep = address if isinstance(address, EndPoint) else str2endpoint(address)
         if self.options.enable_builtin_services:
             from brpc_tpu.builtin.services import add_builtin_services
+            from brpc_tpu.bvar.default_variables import (
+                expose_default_variables)
             add_builtin_services(self)
+            expose_default_variables()   # process_* vars (idempotent)
         transport = get_transport(ep.scheme)
         self._listener = transport.listen(ep, self._on_new_conn)
         self._endpoint = self._listener.endpoint

@@ -1,0 +1,105 @@
+"""MultiDimension / default process vars / flag-bvar bridge tests
+(reference: bvar/multi_dimension_inl.h mbvar tests,
+default_variables.cpp, bvar/gflag.cpp)."""
+
+import threading
+
+import pytest
+
+from brpc_tpu import bvar
+from brpc_tpu.butil import flags as bflags
+
+
+def test_multi_dimension_basic():
+    md = bvar.MultiDimension(["method", "status"], bvar.Adder)
+    md.get_stats(("Echo", "ok")).add(3)
+    md.get_stats(("Echo", "ok")).add(2)
+    md.get_stats(("Echo", "err")).add(1)
+    assert md.count_stats() == 2
+    assert md.get_value() == {("Echo", "ok"): 5, ("Echo", "err"): 1}
+    assert md.has_stats(("Echo", "ok"))
+    assert not md.has_stats(("Nope", "ok"))
+    md.delete_stats(("Echo", "err"))
+    assert md.count_stats() == 1
+    assert md.list_stats() == [("Echo", "ok")]
+
+
+def test_multi_dimension_label_arity_checked():
+    md = bvar.MultiDimension(["a", "b"], bvar.Adder)
+    with pytest.raises(ValueError):
+        md.get_stats(("only-one",))
+
+
+def test_multi_dimension_same_stat_instance():
+    md = bvar.MultiDimension(["k"], bvar.Adder)
+    assert md.get_stats(("x",)) is md.get_stats(("x",))
+
+
+def test_multi_dimension_concurrent_create():
+    md = bvar.MultiDimension(["tid"], bvar.Adder)
+
+    def worker(i):
+        for j in range(200):
+            md.get_stats((f"t{i}",)).add(1)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(10)
+    assert md.get_value() == {(f"t{i}",): 200 for i in range(8)}
+
+
+def test_multi_dimension_prometheus_labels():
+    md = bvar.MultiDimension(["method"], bvar.Adder)
+    md.get_stats(("Echo",)).add(7)
+    md.expose("test_md_qps")
+    try:
+        text = bvar.dump_prometheus("test_md_qps")
+        assert 'test_md_qps{method="Echo"} 7' in text
+    finally:
+        md.hide()
+
+
+def test_multi_dimension_composite_stat_prometheus():
+    md = bvar.MultiDimension(["m"], bvar.LatencyRecorder)
+    md.get_stats(("E",)).record(100)
+    md.expose("test_md_lat")
+    try:
+        text = bvar.dump_prometheus("test_md_lat")
+        # one line per numeric component, all labeled
+        assert 'test_md_lat_count{m="E"}' in text
+    finally:
+        md.hide()
+
+
+def test_default_process_variables():
+    bvar.expose_default_variables()
+    vals = dict(bvar.dump_exposed("process_"))
+    assert vals["process_fd_count"] > 0
+    assert vals["process_memory_resident"] > 1 << 20
+    assert vals["process_thread_count"] >= 1
+    assert vals["process_uptime_seconds"] >= 0
+    import os
+    assert vals["process_pid"] == os.getpid()
+
+
+def test_flag_bridge():
+    try:
+        bflags.define_flag("test_bridge_flag", 17, "test")
+    except ValueError:
+        pass
+    fv = bvar.expose_flag("test_bridge_flag")
+    try:
+        assert fv.get_value() == 17
+        bflags.set_flag("test_bridge_flag", "42")
+        assert fv.get_value() == 42          # live view, not a snapshot
+        assert dict(bvar.dump_exposed("flag_test_bridge"))[
+            "flag_test_bridge_flag"] == 42
+    finally:
+        fv.hide()
+
+
+def test_flag_bridge_undefined_raises_at_expose():
+    with pytest.raises(KeyError):
+        bvar.FlagVar("no_such_flag_xyz")
