@@ -72,7 +72,6 @@ class _ProcSampler:
 
 
 _sampler = _ProcSampler()
-_exposed = False
 
 
 def _getter(key: str):
@@ -81,11 +80,11 @@ def _getter(key: str):
 
 def expose_default_variables() -> None:
     """Idempotent: register the process_* vars (default_variables.cpp
-    exposes at global init; here the first Server.start does it)."""
-    global _exposed
-    if _exposed:
+    exposes at global init; here the first Server.start does it). Always
+    (re)exposes — a flag would go stale after unexpose_all()."""
+    from brpc_tpu.bvar.variable import dump_exposed_variables
+    if any(n == "process_pid" for n, _ in dump_exposed_variables("process_")):
         return
-    _exposed = True
     for key, name in [
         ("cpu_usage", "process_cpu_usage"),
         ("cpu_seconds_total", "process_cpu_seconds_total"),

@@ -120,7 +120,7 @@ class HttpProtocol(Protocol):
             socket.write(_response(500, b"no server bound", keep_alive=False))
             return
         try:
-            status, ctype, body = await self._route(server, req)
+            status, ctype, body = await self._route(server, req, socket)
         except Exception as e:
             status, ctype, body = 500, "text/plain", f"error: {e}".encode()
         if req.keep_alive:
@@ -135,17 +135,29 @@ class HttpProtocol(Protocol):
                     ConnectionError("http connection: close")))
 
     # --------------------------------------------------------------- routes
-    async def _route(self, server, req: HttpRequest):
+    async def _route(self, server, req: HttpRequest, socket=None):
+        from brpc_tpu.rpc.auth import AuthError, resolve_server_auth
         path = req.path.rstrip("/") or "/"
-        if server.options.auth_token is not None and path != "/health":
+        authenticator = resolve_server_auth(server.options)
+        if authenticator is not None and path != "/health":
             # the tpu_std auth gate must not have an HTTP side door: require
-            # the token (Authorization: Bearer ... or ?token=) everywhere
-            # except liveness
-            auth = req.headers.get("authorization", "")
-            token = auth[7:] if auth.startswith("Bearer ") else \
-                req.query.get("token", "")
-            if token != server.options.auth_token:
-                return 403, "text/plain", b"authentication failed"
+            # the credential (Authorization: Bearer ... or ?token=)
+            # everywhere except liveness; verified once per connection
+            ctx = socket.user_data.get("auth_context") if socket else None
+            if ctx is None:
+                header = req.headers.get("authorization", "")
+                cred = header[7:] if header.startswith("Bearer ") else \
+                    req.query.get("token", "")
+                try:
+                    ctx = authenticator.verify_credential(
+                        cred, socket.remote_endpoint if socket else None)
+                except AuthError as e:
+                    return 403, "text/plain", (
+                        str(e) or "authentication failed").encode()
+                except Exception:
+                    return 403, "text/plain", b"authentication failed"
+                if socket is not None:
+                    socket.user_data["auth_context"] = ctx
         if path == "/":
             return 200, "text/html", self._index(server)
         if path == "/health":
@@ -178,7 +190,8 @@ class HttpProtocol(Protocol):
         # /Service/Method RPC access
         parts = [p for p in path.split("/") if p]
         if len(parts) == 2:
-            return await self._call_method(server, req, parts[0], parts[1])
+            return await self._call_method(server, req, parts[0], parts[1],
+                                           socket)
         return 404, "text/plain", f"no such page {req.path}".encode()
 
     def _index(self, server) -> bytes:
@@ -216,13 +229,18 @@ class HttpProtocol(Protocol):
                 for n, v, d, h in list_flags()]
         return 200, "text/plain", ("\n".join(rows) + "\n").encode()
 
-    async def _call_method(self, server, req: HttpRequest, service: str, method_name: str):
+    async def _call_method(self, server, req: HttpRequest, service: str,
+                           method_name: str, socket=None):
         method = server.find_method(service, method_name)
         if method is None:
             return 404, "text/plain", b"no such service/method"
         from brpc_tpu.rpc.controller import Controller
         cntl = Controller()
-        cntl.remote_side = None
+        cntl.remote_side = socket.remote_endpoint if socket else None
+        cntl._service_name = service
+        cntl._method_name = method_name
+        if socket is not None:
+            cntl.auth_context = socket.user_data.get("auth_context")
         if method.request_class is not None:
             from google.protobuf import json_format
             request = method.request_class()
@@ -235,6 +253,18 @@ class HttpProtocol(Protocol):
             request = req.body
         if not server.on_request_start():
             return 500, "text/plain", b"max_concurrency reached"
+        interceptor = getattr(server.options, "interceptor", None)
+        if interceptor is not None:
+            from brpc_tpu.rpc.auth import InterceptorError
+            try:
+                verdict = interceptor(cntl)
+            except InterceptorError as e:
+                verdict = (e.error_code, e.reason)
+            except Exception as e:
+                verdict = (500, f"interceptor error: {e}")
+            if verdict is not None:
+                server.on_request_end(f"{service}.{method_name}", 0, True)
+                return 403, "text/plain", str(verdict[1]).encode()
         t0 = time.monotonic_ns()
         try:
             import inspect

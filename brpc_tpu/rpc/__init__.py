@@ -13,6 +13,10 @@ from brpc_tpu.rpc.combo_channels import (
 )
 from brpc_tpu.rpc.load_balancer import LoadBalancer, new_load_balancer
 from brpc_tpu.rpc.naming import NamingService, NamingServiceThread, register_naming_service
+from brpc_tpu.rpc.auth import (
+    AuthContext, AuthError, Authenticator, InterceptorError,
+    TokenAuthenticator,
+)
 
 __all__ = [
     "errno_codes", "Controller", "Channel", "ChannelOptions",
@@ -21,4 +25,6 @@ __all__ = [
     "PartitionParser", "ResponseMerger", "SelectiveChannel", "SubCall",
     "LoadBalancer", "new_load_balancer",
     "NamingService", "NamingServiceThread", "register_naming_service",
+    "AuthContext", "AuthError", "Authenticator", "InterceptorError",
+    "TokenAuthenticator",
 ]

@@ -1,0 +1,85 @@
+"""Authenticator + Interceptor: pluggable per-connection auth and
+per-request admission (brpc/authenticator.h, brpc/interceptor.h:26-37).
+
+Client side: ``generate_credential()`` produces a string carried in the
+request meta (the reference sends it with the first message on a
+connection; here every tpu_std request carries it — the server still
+verifies only once per connection and caches the AuthContext).
+
+Server side: ``verify_credential(credential, remote_side)`` returns an
+AuthContext (stored on the connection, visible as cntl.auth_context) or
+raises AuthError to reject. The Interceptor runs after auth on every
+request and may reject with (error_code, reason)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Tuple
+
+
+class AuthError(Exception):
+    """verify_credential rejection; the text goes back to the caller."""
+
+
+@dataclass
+class AuthContext:
+    """Verified peer identity (brpc/authenticator.h AuthContext)."""
+    user: str = ""
+    group: str = ""
+    roles: str = ""
+    starter: str = ""
+    is_service: bool = False
+    extra: dict = field(default_factory=dict)
+
+
+class Authenticator:
+    def generate_credential(self) -> str:
+        """Client: the credential string to send."""
+        raise NotImplementedError
+
+    def verify_credential(self, credential: str,
+                          remote_side) -> AuthContext:
+        """Server: verify; return the peer's AuthContext or raise
+        AuthError. Called once per connection (first request), the
+        result is cached on the socket."""
+        raise NotImplementedError
+
+
+class TokenAuthenticator(Authenticator):
+    """Shared-secret bearer token (what ServerOptions.auth_token was)."""
+
+    def __init__(self, token: str, user: str = "token-peer"):
+        self._token = token
+        self._user = user
+
+    def generate_credential(self) -> str:
+        return self._token
+
+    def verify_credential(self, credential: str, remote_side) -> AuthContext:
+        if credential != self._token:
+            raise AuthError("authentication failed")
+        return AuthContext(user=self._user)
+
+
+# Interceptor (brpc/interceptor.h): callable(cntl) -> None to accept, or
+# (error_code, reason) / raise InterceptorError to reject.
+Interceptor = Callable[[object], Optional[Tuple[int, str]]]
+
+
+class InterceptorError(Exception):
+    def __init__(self, error_code: int, reason: str):
+        super().__init__(reason)
+        self.error_code = error_code
+        self.reason = reason
+
+
+def resolve_server_auth(options) -> Optional[Authenticator]:
+    """ServerOptions.auth wins; auth_token is sugar for
+    TokenAuthenticator (kept for compat)."""
+    auth = getattr(options, "auth", None)
+    if auth is not None:
+        return auth
+    token = getattr(options, "auth_token", None)
+    if token is not None:
+        return TokenAuthenticator(token)
+    return None

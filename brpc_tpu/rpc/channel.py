@@ -35,6 +35,9 @@ class ChannelOptions:
     max_retry: int = 3
     backup_request_ms: Optional[float] = None
     auth_token: str = ""
+    # pluggable Authenticator (rpc/auth.py): generate_credential() result
+    # rides the request meta; wins over auth_token
+    auth: Optional[Any] = None
 
 
 
@@ -119,7 +122,11 @@ class Channel:
         if cntl.backup_request_ms is None:
             cntl.backup_request_ms = self.options.backup_request_ms
         cntl._done_cb = done
-        cntl.auth_token = cntl.auth_token or self.options.auth_token
+        if not cntl.auth_token:
+            if self.options.auth is not None:
+                cntl.auth_token = self.options.auth.generate_credential()
+            else:
+                cntl.auth_token = self.options.auth_token
         if request_device_arrays:
             cntl.request_device_arrays = list(request_device_arrays)
         cntl.response_msg = response_class() if response_class is not None else None

@@ -55,6 +55,7 @@ class Controller:
         self.remote_side: Optional[EndPoint] = None
         self.local_side: Optional[EndPoint] = None
         self.auth_token: str = ""
+        self.auth_context = None   # server side: verified peer identity
         self.compress_type: int = 0
         self.trace_id: int = 0
         self.span_id: int = 0
@@ -83,6 +84,15 @@ class Controller:
         # ---- server side
         self._server_socket = None
         self._response_sender: Optional[Callable] = None
+
+    # ---------------------------------------------------------------- names
+    @property
+    def service_name(self) -> str:
+        return self._service_name
+
+    @property
+    def method_name(self) -> str:
+        return self._method_name
 
     # --------------------------------------------------------------- error
     def failed(self) -> bool:

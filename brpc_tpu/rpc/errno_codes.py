@@ -11,6 +11,7 @@ ERPCTIMEDOUT = 1008     # RPC deadline exceeded
 EFAILEDSOCKET = 1009    # connection broken during call
 EHTTP = 1010            # HTTP-level error
 EOVERCROWDED = 1011     # too many buffered writes / server concurrency full
+EPERM = 1012            # rejected by server interceptor / permission
 EINTERNAL = 2001        # server-side handler exception
 ERESPONSE = 2002        # bad response
 ELOGOFF = 2003          # server is stopping

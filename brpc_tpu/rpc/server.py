@@ -59,11 +59,18 @@ class ServerOptions:
     def __init__(self, num_workers: Optional[int] = None,
                  max_concurrency: Optional[int] = None,
                  auth_token: Optional[str] = None,
+                 auth=None, interceptor=None,
                  enable_builtin_services: bool = True,
                  redis_service=None, thrift_service=None):
         self.num_workers = num_workers
         self.max_concurrency = max_concurrency
         self.auth_token = auth_token
+        # pluggable Authenticator (rpc/auth.py; brpc/authenticator.h) —
+        # wins over auth_token, which is sugar for TokenAuthenticator
+        self.auth = auth
+        # Interceptor (brpc/interceptor.h): callable(cntl) -> None accepts,
+        # (error_code, reason) or raise InterceptorError rejects
+        self.interceptor = interceptor
         self.enable_builtin_services = enable_builtin_services
         # server-side redis command table (ServerOptions::redis_service in
         # the reference, brpc/redis.h:240)

@@ -28,11 +28,24 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
         return
     req_meta = meta.request
     # auth precedes lookup: unauthenticated peers must not be able to
-    # enumerate the service/method namespace from distinct error codes
-    if server.options.auth_token is not None and \
-            req_meta.auth_token != server.options.auth_token:
-        _send_error(socket, cid, berr.ERPCAUTH, "authentication failed")
-        return
+    # enumerate the service/method namespace from distinct error codes.
+    # verify once per connection, cache the AuthContext on the socket
+    # (authenticator.h: only the first message carries/verifies auth)
+    from brpc_tpu.rpc.auth import AuthError, resolve_server_auth
+    auth = resolve_server_auth(server.options)
+    auth_ctx = socket.user_data.get("auth_context")
+    if auth is not None and auth_ctx is None:
+        try:
+            auth_ctx = auth.verify_credential(req_meta.auth_token,
+                                              socket.remote_endpoint)
+        except AuthError as e:
+            _send_error(socket, cid, berr.ERPCAUTH,
+                        str(e) or "authentication failed")
+            return
+        except Exception:
+            _send_error(socket, cid, berr.ERPCAUTH, "authentication failed")
+            return
+        socket.user_data["auth_context"] = auth_ctx
     method = server.find_method(req_meta.service_name, req_meta.method_name)
     if method is None:
         has_svc = req_meta.service_name in server.services()
@@ -53,6 +66,9 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     cntl.remote_side = socket.remote_endpoint
     cntl.local_side = socket.local_endpoint
     cntl.auth_token = req_meta.auth_token
+    cntl.auth_context = auth_ctx
+    cntl._service_name = req_meta.service_name
+    cntl._method_name = req_meta.method_name
     cntl._server_socket = socket
     from brpc_tpu.rpc.span import finish_span, start_server_span
     span = start_server_span(cntl, req_meta.service_name, req_meta.method_name)
@@ -96,6 +112,26 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
         _send_error(socket, cid, berr.EREQUEST, f"cannot parse request: {e}")
         finish_span(span, cntl)  # malformed traffic must show in /rpcz
         return
+
+    # interceptor gate (interceptor.h Accept): runs with the decoded
+    # request visible on cntl, before the user handler
+    interceptor = getattr(server.options, "interceptor", None)
+    if interceptor is not None:
+        from brpc_tpu.rpc.auth import InterceptorError
+        try:
+            verdict = interceptor(cntl)
+        except InterceptorError as e:
+            verdict = (e.error_code, e.reason)
+        except Exception as e:
+            verdict = (berr.EINTERNAL, f"interceptor error: {e}")
+        if verdict is not None:
+            code, reason = verdict
+            latency_us = (time.monotonic_ns() - t0) / 1e3
+            server.on_request_end(method_key, latency_us, failed=True)
+            cntl.set_failed(code, reason)
+            _send_error(socket, cid, code, reason)
+            finish_span(span, cntl)
+            return
 
     response = None
     try:
