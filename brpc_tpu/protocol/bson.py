@@ -1,0 +1,146 @@
+"""Minimal BSON codec for the mongo wire adaptor (the reference links a
+bson dependency for policy/mongo_protocol.cpp; this is a from-scratch
+subset covering the types mongo commands actually use).
+
+Supported: double, string, document, array, binary, bool, null, int32,
+int64, ObjectId (as 12 raw bytes), UTC datetime (as int64 ms).
+Python mapping: dict, list, str, bytes (binary subtype 0), bool, None,
+int (int32 when it fits else int64), float, ObjectId."""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple
+
+_MAX_DEPTH = 64
+
+
+class ObjectId:
+    __slots__ = ("raw",)
+
+    def __init__(self, raw: bytes):
+        if len(raw) != 12:
+            raise ValueError("ObjectId must be 12 bytes")
+        self.raw = bytes(raw)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectId) and self.raw == other.raw
+
+    def __hash__(self):
+        return hash(self.raw)
+
+    def __repr__(self):
+        return f"ObjectId({self.raw.hex()})"
+
+
+class DateTimeMs(int):
+    """UTC datetime as milliseconds since epoch (wire type 0x09)."""
+
+
+class BsonError(Exception):
+    pass
+
+
+def _encode_value(key: bytes, v, depth: int) -> bytes:
+    if depth > _MAX_DEPTH:
+        raise BsonError("document nesting too deep")
+    if isinstance(v, float):
+        return b"\x01" + key + b"\x00" + struct.pack("<d", v)
+    if isinstance(v, str):
+        s = v.encode()
+        return b"\x02" + key + b"\x00" + struct.pack("<i", len(s) + 1) + s + b"\x00"
+    if isinstance(v, dict):
+        return b"\x03" + key + b"\x00" + encode_doc(v, depth + 1)
+    if isinstance(v, (list, tuple)):
+        arr = {str(i): x for i, x in enumerate(v)}
+        return b"\x04" + key + b"\x00" + encode_doc(arr, depth + 1)
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        b = bytes(v)
+        return b"\x05" + key + b"\x00" + struct.pack("<ib", len(b), 0) + b
+    if isinstance(v, ObjectId):
+        return b"\x07" + key + b"\x00" + v.raw
+    if isinstance(v, bool):
+        return b"\x08" + key + b"\x00" + (b"\x01" if v else b"\x00")
+    if isinstance(v, DateTimeMs):
+        return b"\x09" + key + b"\x00" + struct.pack("<q", int(v))
+    if v is None:
+        return b"\x0a" + key + b"\x00"
+    if isinstance(v, int):
+        if -(1 << 31) <= v < (1 << 31):
+            return b"\x10" + key + b"\x00" + struct.pack("<i", v)
+        return b"\x12" + key + b"\x00" + struct.pack("<q", v)
+    raise BsonError(f"cannot encode {type(v)!r}")
+
+
+def encode_doc(doc: Dict[str, Any], depth: int = 0) -> bytes:
+    body = b"".join(_encode_value(k.encode(), v, depth)
+                    for k, v in doc.items())
+    return struct.pack("<i", len(body) + 5) + body + b"\x00"
+
+
+def _read_cstring(data: bytes, pos: int) -> Tuple[str, int]:
+    end = data.find(b"\x00", pos)
+    if end < 0:
+        raise BsonError("unterminated cstring")
+    return data[pos:end].decode("utf-8", "replace"), end + 1
+
+
+def _decode_value(t: int, data: bytes, pos: int, depth: int):
+    if t == 0x01:
+        return struct.unpack_from("<d", data, pos)[0], pos + 8
+    if t == 0x02:
+        n = struct.unpack_from("<i", data, pos)[0]
+        if n < 1 or pos + 4 + n > len(data):
+            raise BsonError("bad string length")
+        return data[pos + 4:pos + 4 + n - 1].decode("utf-8", "replace"), \
+            pos + 4 + n
+    if t == 0x03:
+        doc, end = decode_doc(data, pos, depth + 1)
+        return doc, end
+    if t == 0x04:
+        doc, end = decode_doc(data, pos, depth + 1)
+        return [doc[k] for k in sorted(doc, key=lambda x: int(x) if
+                                       x.isdigit() else 0)], end
+    if t == 0x05:
+        n, _subtype = struct.unpack_from("<ib", data, pos)
+        if n < 0 or pos + 5 + n > len(data):
+            raise BsonError("bad binary length")
+        return bytes(data[pos + 5:pos + 5 + n]), pos + 5 + n
+    if t == 0x07:
+        return ObjectId(data[pos:pos + 12]), pos + 12
+    if t == 0x08:
+        return data[pos:pos + 1] == b"\x01", pos + 1
+    if t == 0x09:
+        return DateTimeMs(struct.unpack_from("<q", data, pos)[0]), pos + 8
+    if t == 0x0a:
+        return None, pos
+    if t == 0x10:
+        return struct.unpack_from("<i", data, pos)[0], pos + 4
+    if t == 0x11:  # timestamp: surface as int64
+        return struct.unpack_from("<q", data, pos)[0], pos + 8
+    if t == 0x12:
+        return struct.unpack_from("<q", data, pos)[0], pos + 8
+    raise BsonError(f"unsupported bson type 0x{t:02x}")
+
+
+def decode_doc(data: bytes, pos: int = 0, depth: int = 0
+               ) -> Tuple[Dict[str, Any], int]:
+    """Decode one document at ``pos``; returns (doc, end_pos)."""
+    if depth > _MAX_DEPTH:
+        raise BsonError("document nesting too deep")
+    if pos + 4 > len(data):
+        raise BsonError("truncated document")
+    size = struct.unpack_from("<i", data, pos)[0]
+    if size < 5 or pos + size > len(data):
+        raise BsonError("bad document size")
+    end = pos + size
+    cur = pos + 4
+    out: Dict[str, Any] = {}
+    while cur < end - 1:
+        t = data[cur]
+        key, cur = _read_cstring(data, cur + 1)
+        value, cur = _decode_value(t, data, cur, depth)
+        out[key] = value
+    if data[end - 1:end] != b"\x00":
+        raise BsonError("document missing terminator")
+    return out, end

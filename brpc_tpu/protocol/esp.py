@@ -1,0 +1,203 @@
+"""esp protocol (policy/esp_protocol.cpp, esp_message.h — the legacy
+stargate messaging format). Re-designed compactly: a little-endian head
+{to:u32 from:u32 flags:u32 msg_id:u32 body_len:u32} behind a 2-byte
+magic "SG" so the parser can disambiguate, then the raw body. esp is
+client-addressed (to/from stargate ids) with msg_id correlation, so
+unlike nshead the client matches replies by msg_id, out-of-order safe."""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.fiber import TaskControl, global_control
+from brpc_tpu.fiber.sync import FiberEvent
+from brpc_tpu.protocol.registry import (
+    PARSE_NOT_ENOUGH_DATA, PARSE_OK, PARSE_TRY_OTHERS, Protocol,
+    register_protocol,
+)
+from brpc_tpu.transport.input_messenger import InputMessenger
+from brpc_tpu.transport.socket import create_client_socket
+
+MAGIC = b"SG"
+_HDR = struct.Struct("<2sIIIII")
+HEADER_SIZE = 22
+_MAX_BODY = 64 << 20
+
+
+class EspMessage:
+    __slots__ = ("to", "from_", "flags", "msg_id", "body")
+
+    def __init__(self, body: bytes = b"", to: int = 0, from_: int = 0,
+                 flags: int = 0, msg_id: int = 0):
+        self.to = to
+        self.from_ = from_
+        self.flags = flags
+        self.msg_id = msg_id
+        self.body = bytes(body)
+
+    def pack(self) -> bytes:
+        return _HDR.pack(MAGIC, self.to, self.from_, self.flags,
+                         self.msg_id, len(self.body)) + self.body
+
+
+class EspProtocol(Protocol):
+    name = "esp"
+
+    def parse(self, portal, socket) -> Tuple[str, object]:
+        head = portal.peek_bytes(min(HEADER_SIZE, portal.size))
+        if MAGIC[:len(head)] != head[:2]:
+            return PARSE_TRY_OTHERS, None
+        if len(head) < HEADER_SIZE:
+            return PARSE_NOT_ENOUGH_DATA, None
+        _magic, to, from_, flags, msg_id, body_len = _HDR.unpack(head)
+        if body_len > _MAX_BODY:
+            socket.set_failed(ConnectionError("esp body exceeds max"))
+            return PARSE_NOT_ENOUGH_DATA, None
+        if portal.size < HEADER_SIZE + body_len:
+            return PARSE_NOT_ENOUGH_DATA, None
+        portal.pop_front(HEADER_SIZE)
+        body = portal.cut(body_len).to_bytes()
+        return PARSE_OK, EspMessage(body, to, from_, flags, msg_id)
+
+    def process_inline(self, msg: EspMessage, socket) -> bool:
+        client = socket.user_data.get("esp_client")
+        if client is not None:
+            client._on_reply(msg)
+            return True
+        from brpc_tpu.transport.input_messenger import process_in_parse_order
+        process_in_parse_order(socket, "esp", msg, self._run_handler)
+        return True
+
+    async def _run_handler(self, msg: EspMessage, socket):
+        import inspect
+        import time
+        server = socket.user_data.get("server")
+        handler = (getattr(server.options, "esp_service", None)
+                   if server is not None else None)
+        if handler is None:
+            return       # esp has no error channel: drop, like the reference
+        if not server.on_request_start():
+            return
+        t0 = time.monotonic_ns()
+        error = False
+        reply = None
+        try:
+            r = handler(socket, msg)
+            if inspect.isawaitable(r):
+                r = await r
+            reply = r
+        except Exception:
+            error = True
+        server.on_request_end("esp.process",
+                              (time.monotonic_ns() - t0) / 1e3, error)
+        if reply is None:
+            return
+        if isinstance(reply, (bytes, bytearray, memoryview)):
+            reply = EspMessage(bytes(reply), to=msg.from_, from_=msg.to,
+                               msg_id=msg.msg_id)
+        out = IOBuf()
+        out.append(reply.pack())
+        socket.write(out)
+
+    def process(self, msg, socket):
+        raise AssertionError("esp messages are processed inline")
+
+
+class EspClient:
+    """msg_id-correlated client: safe for concurrent callers without FIFO
+    ordering assumptions (esp servers may reply out of order)."""
+
+    def __init__(self, address: str | EndPoint, stargate_id: int = 0,
+                 timeout_s: float = 5.0,
+                 control: Optional[TaskControl] = None):
+        from brpc_tpu.butil.endpoint import str2endpoint
+        self._endpoint = (address if isinstance(address, EndPoint)
+                          else str2endpoint(address))
+        self._stargate_id = stargate_id
+        self._timeout_s = timeout_s
+        self._control = control or global_control()
+        self._messenger = InputMessenger(protocols=[ensure_registered()],
+                                         control=self._control)
+        self._lock = threading.Lock()
+        self._socket = None
+        self._next_id = 1
+        self._pending: Dict[int, list] = {}   # msg_id -> [event, reply|err]
+
+    def _get_socket(self):
+        with self._lock:
+            s = self._socket
+        if s is not None and not s.failed:
+            return s
+        new = create_client_socket(
+            self._endpoint, on_input=self._messenger.on_new_messages,
+            control=self._control)
+        new.user_data["esp_client"] = self
+        new.on_failed(self._on_socket_failed)
+        with self._lock:
+            if self._socket is not None and not self._socket.failed:
+                loser, new = new, self._socket
+            else:
+                self._socket, loser = new, None
+        if loser is not None:
+            loser.set_failed(ConnectionError("duplicate connect discarded"))
+        return new
+
+    def _on_socket_failed(self, socket):
+        with self._lock:
+            if self._socket is socket:
+                self._socket = None
+            pending, self._pending = self._pending, {}
+        err = getattr(socket, "fail_reason", None) or \
+            ConnectionError("esp connection failed")
+        for slot in pending.values():
+            slot[1] = err
+            slot[0].set()
+
+    def _on_reply(self, msg: EspMessage):
+        with self._lock:
+            slot = self._pending.pop(msg.msg_id, None)
+        if slot is not None:
+            slot[1] = msg
+            slot[0].set()
+
+    def call(self, to: int, body: bytes, flags: int = 0) -> EspMessage:
+        socket = self._get_socket()
+        with self._lock:
+            msg_id = self._next_id
+            self._next_id = (self._next_id + 1) & 0xFFFFFFFF or 1
+            slot = [FiberEvent(), None]
+            self._pending[msg_id] = slot
+        msg = EspMessage(body, to=to, from_=self._stargate_id, flags=flags,
+                         msg_id=msg_id)
+        out = IOBuf()
+        out.append(msg.pack())
+        if not socket.write(out):
+            self._on_socket_failed(socket)
+        if not slot[0].wait_pthread(self._timeout_s):
+            with self._lock:
+                self._pending.pop(msg_id, None)
+            raise TimeoutError("esp call timed out")
+        if isinstance(slot[1], BaseException):
+            raise slot[1]
+        return slot[1]
+
+    def close(self):
+        with self._lock:
+            s, self._socket = self._socket, None
+        if s is not None and not s.failed:
+            s.set_failed(ConnectionError("esp client closed"))
+
+
+_instance: Optional[EspProtocol] = None
+
+
+def ensure_registered() -> EspProtocol:
+    global _instance
+    if _instance is None:
+        _instance = EspProtocol()
+        register_protocol(_instance)
+    return _instance

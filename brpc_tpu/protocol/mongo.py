@@ -1,0 +1,199 @@
+"""Mongo wire protocol server adaptor (policy/mongo_protocol.cpp:298,
+mongo_head.h, mongo_service_adaptor.h): speak enough OP_MSG / OP_QUERY
+for drivers and `mongosh`-style clients to issue commands at a brpc_tpu
+server; the user supplies a MongoServiceAdaptor mapping command
+documents to reply documents.
+
+Wire: little-endian header {messageLength, requestID, responseTo,
+opCode}; OP_MSG (2013) = flagBits:u32 + section kind 0 (one BSON doc);
+OP_QUERY (2004, legacy handshake) = flags, fullCollectionName cstring,
+numberToSkip, numberToReturn, query doc — answered with OP_REPLY (1)."""
+
+from __future__ import annotations
+
+import inspect
+import struct
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.protocol import bson
+from brpc_tpu.protocol.registry import (
+    PARSE_NOT_ENOUGH_DATA, PARSE_OK, PARSE_TRY_OTHERS, Protocol,
+    register_protocol,
+)
+
+_HDR = struct.Struct("<iiii")
+OP_REPLY = 1
+OP_QUERY = 2004
+OP_MSG = 2013
+_MAX_MESSAGE = 48 << 20
+_KNOWN_OPS = (OP_REPLY, OP_QUERY, OP_MSG, 2001, 2002, 2005, 2006, 2010,
+              2011, 2012)
+
+
+class MongoMessage:
+    __slots__ = ("request_id", "response_to", "op_code", "flags", "doc",
+                 "collection")
+
+    def __init__(self, request_id, response_to, op_code, flags, doc,
+                 collection=""):
+        self.request_id = request_id
+        self.response_to = response_to
+        self.op_code = op_code
+        self.flags = flags
+        self.doc = doc
+        self.collection = collection
+
+
+class MongoServiceAdaptor:
+    """Command table: ``@svc.command("ping")`` over
+    ``def ping(socket, doc) -> reply_doc``. Unknown commands get
+    {ok: 0, errmsg, code: 59} (CommandNotFound)."""
+
+    def __init__(self):
+        self._handlers: Dict[str, Callable] = {}
+
+    def command(self, name: Optional[str] = None):
+        def deco(fn):
+            self._handlers[(name or fn.__name__).lower()] = fn
+            return fn
+        return deco
+
+    def add_command_handler(self, name: str, fn: Callable) -> None:
+        self._handlers[name.lower()] = fn
+
+    def find(self, name: str) -> Optional[Callable]:
+        return self._handlers.get(name.lower())
+
+
+def _pack_msg(request_id: int, response_to: int, doc: dict) -> bytes:
+    body = struct.pack("<I", 0) + b"\x00" + bson.encode_doc(doc)
+    return _HDR.pack(16 + len(body), request_id, response_to, OP_MSG) + body
+
+
+def _pack_reply(request_id: int, response_to: int, doc: dict) -> bytes:
+    # legacy OP_REPLY: flags, cursorId, startingFrom, numberReturned, docs
+    body = struct.pack("<iqii", 8, 0, 0, 1) + bson.encode_doc(doc)
+    return _HDR.pack(16 + len(body), request_id, response_to, OP_REPLY) + body
+
+
+class MongoProtocol(Protocol):
+    name = "mongo"
+
+    def __init__(self):
+        self._id_lock = threading.Lock()
+        self._next_id = 1
+
+    def _reply_id(self) -> int:
+        with self._id_lock:
+            rid = self._next_id
+            self._next_id += 1
+            return rid
+
+    # ---------------------------------------------------------------- parse
+    def parse(self, portal, socket) -> Tuple[str, object]:
+        head = portal.peek_bytes(min(16, portal.size))
+        if len(head) < 16:
+            # can't rule ourselves in yet; mongo's header is all-binary so
+            # only claim bytes once a full header with a known opcode shows
+            return PARSE_TRY_OTHERS, None
+        length, request_id, response_to, op_code = _HDR.unpack(head)
+        if op_code not in _KNOWN_OPS or length < 16 or length > _MAX_MESSAGE:
+            return PARSE_TRY_OTHERS, None
+        if portal.size < length:
+            return PARSE_NOT_ENOUGH_DATA, None
+        portal.pop_front(16)
+        payload = portal.cut(length - 16).to_bytes()
+        try:
+            if op_code == OP_MSG:
+                flags = struct.unpack_from("<I", payload, 0)[0]
+                if payload[4:5] != b"\x00":
+                    raise bson.BsonError("only OP_MSG section kind 0")
+                doc, _ = bson.decode_doc(payload, 5)
+                return PARSE_OK, MongoMessage(request_id, response_to,
+                                              op_code, flags, doc)
+            if op_code == OP_QUERY:
+                flags = struct.unpack_from("<i", payload, 0)[0]
+                end = payload.index(b"\x00", 4)
+                collection = payload[4:end].decode("latin1")
+                doc, _ = bson.decode_doc(payload, end + 9)  # skip skip/ret
+                return PARSE_OK, MongoMessage(request_id, response_to,
+                                              op_code, flags, doc,
+                                              collection)
+            raise bson.BsonError(f"unsupported opcode {op_code}")
+        except (bson.BsonError, ValueError, struct.error) as e:
+            socket.set_failed(ConnectionError(f"corrupt mongo frame: {e}"))
+            return PARSE_NOT_ENOUGH_DATA, None
+
+    # -------------------------------------------------------------- process
+    def process_inline(self, msg: MongoMessage, socket) -> bool:
+        from brpc_tpu.transport.input_messenger import process_in_parse_order
+        process_in_parse_order(socket, "mongo", msg, self._run_command)
+        return True
+
+    async def _run_command(self, msg: MongoMessage, socket):
+        server = socket.user_data.get("server")
+        adaptor: Optional[MongoServiceAdaptor] = (
+            getattr(server.options, "mongo_service_adaptor", None)
+            if server is not None else None)
+
+        def send(doc: dict):
+            packer = _pack_reply if msg.op_code == OP_QUERY else _pack_msg
+            out = IOBuf()
+            out.append(packer(self._reply_id(), msg.request_id, doc))
+            socket.write(out)
+
+        if adaptor is None:
+            send({"ok": 0.0, "errmsg": "no mongo_service_adaptor installed",
+                  "code": 59})
+            return
+        if not msg.doc:
+            send({"ok": 0.0, "errmsg": "empty command", "code": 59})
+            return
+        cmd_name = next(iter(msg.doc))
+        handler = adaptor.find(cmd_name)
+        if handler is None:
+            if cmd_name.lower() in ("ismaster", "hello"):
+                # minimal topology handshake so drivers proceed
+                send({"ok": 1.0, "ismaster": True, "isWritablePrimary": True,
+                      "maxWireVersion": 13, "minWireVersion": 0,
+                      "maxBsonObjectSize": 16 << 20,
+                      "localTime": bson.DateTimeMs(int(time.time() * 1000))})
+                return
+            send({"ok": 0.0, "errmsg": f"no such command: '{cmd_name}'",
+                  "code": 59})
+            return
+        if not server.on_request_start():
+            send({"ok": 0.0, "errmsg": "max_concurrency reached", "code": 202})
+            return
+        t0 = time.monotonic_ns()
+        error = False
+        try:
+            r = handler(socket, msg.doc)
+            if inspect.isawaitable(r):
+                r = await r
+            reply = r if isinstance(r, dict) else {"ok": 1.0}
+            if "ok" not in reply:
+                reply["ok"] = 1.0
+        except Exception as e:
+            error = True
+            reply = {"ok": 0.0, "errmsg": f"handler error: {e}", "code": 8}
+        server.on_request_end(f"mongo.{cmd_name}",
+                              (time.monotonic_ns() - t0) / 1e3, error)
+        send(reply)
+
+    def process(self, msg, socket):
+        raise AssertionError("mongo messages are processed inline")
+
+
+_instance: Optional[MongoProtocol] = None
+
+
+def ensure_registered() -> MongoProtocol:
+    global _instance
+    if _instance is None:
+        _instance = MongoProtocol()
+        register_protocol(_instance)
+    return _instance

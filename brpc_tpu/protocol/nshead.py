@@ -1,0 +1,162 @@
+"""nshead protocol (baidu legacy family): 36-byte little-endian header +
+raw body (policy/nshead_protocol.cpp, nshead_service.h in the
+reference; the nshead struct is public baidu infra:
+id/version/log_id/provider[16]/magic/reserved/body_len, magic
+0xfb709394).
+
+Server side: ServerOptions.nshead_service — a handler
+``(socket, NsheadMessage) -> NsheadMessage | bytes | None`` (None = no
+reply, matching NsheadService's manual-response mode). Client:
+NsheadClient with FIFO matching (nshead has no correlation field; the
+reference matches by connection order too)."""
+
+from __future__ import annotations
+
+import inspect
+import struct
+import time
+from typing import List, Optional, Tuple
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.fiber import TaskControl
+from brpc_tpu.protocol.registry import (
+    PARSE_NOT_ENOUGH_DATA, PARSE_OK, PARSE_TRY_OTHERS, Protocol,
+    register_protocol,
+)
+from brpc_tpu.transport.pipelined import PipelinedClient
+
+NSHEAD_MAGIC = 0xFB709394
+_HDR = struct.Struct("<HHI16sIII")
+HEADER_SIZE = 36
+_MAX_BODY = 64 << 20
+
+
+class NsheadMessage:
+    __slots__ = ("id", "version", "log_id", "provider", "body")
+
+    def __init__(self, body: bytes = b"", id: int = 0, version: int = 0,
+                 log_id: int = 0, provider: bytes = b"brpc-tpu"):
+        self.id = id
+        self.version = version
+        self.log_id = log_id
+        self.provider = provider[:16]
+        self.body = bytes(body)
+
+    def pack(self) -> bytes:
+        return _HDR.pack(self.id, self.version, self.log_id,
+                         self.provider.ljust(16, b"\x00"), NSHEAD_MAGIC, 0,
+                         len(self.body)) + self.body
+
+
+def unpack_head(head: bytes) -> Tuple[int, int, int, bytes, int, int, int]:
+    return _HDR.unpack(head)
+
+
+class NsheadProtocol(Protocol):
+    name = "nshead"
+
+    # ---------------------------------------------------------------- parse
+    def parse(self, portal, socket) -> Tuple[str, object]:
+        head = portal.peek_bytes(min(HEADER_SIZE, portal.size))
+        if len(head) < 28:
+            # magic lives at offset 24; until visible we can only bail on
+            # impossible prefixes via the magic bytes themselves
+            return PARSE_TRY_OTHERS, None
+        magic = struct.unpack_from("<I", head, 24)[0]
+        if magic != NSHEAD_MAGIC:
+            return PARSE_TRY_OTHERS, None
+        if len(head) < HEADER_SIZE:
+            return PARSE_NOT_ENOUGH_DATA, None
+        id_, version, log_id, provider, _magic, _res, body_len = \
+            _HDR.unpack(head)
+        if body_len > _MAX_BODY:
+            socket.set_failed(ConnectionError(
+                f"nshead body of {body_len} bytes exceeds max"))
+            return PARSE_NOT_ENOUGH_DATA, None
+        if portal.size < HEADER_SIZE + body_len:
+            return PARSE_NOT_ENOUGH_DATA, None
+        portal.pop_front(HEADER_SIZE)
+        body = portal.cut(body_len).to_bytes()
+        msg = NsheadMessage(body, id_, version, log_id,
+                            provider.rstrip(b"\x00"))
+        return PARSE_OK, msg
+
+    # -------------------------------------------------------------- process
+    def process_inline(self, msg: NsheadMessage, socket) -> bool:
+        client = socket.user_data.get("nshead_client")
+        if client is not None:
+            client._on_reply(socket, msg)
+            return True
+        from brpc_tpu.transport.input_messenger import process_in_parse_order
+        process_in_parse_order(socket, "nshead", msg, self._run_handler)
+        return True
+
+    async def _run_handler(self, msg: NsheadMessage, socket):
+        server = socket.user_data.get("server")
+        handler = (getattr(server.options, "nshead_service", None)
+                   if server is not None else None)
+        if handler is None:
+            # no adaptor: echo the head with an empty body, erring visibly
+            out = IOBuf()
+            out.append(NsheadMessage(b"", msg.id, msg.version,
+                                     msg.log_id).pack())
+            socket.write(out)
+            return
+        if not server.on_request_start():
+            return
+        t0 = time.monotonic_ns()
+        error = False
+        reply = None
+        try:
+            r = handler(socket, msg)
+            if inspect.isawaitable(r):
+                r = await r
+            reply = r
+        except Exception:
+            error = True
+        server.on_request_end("nshead.process",
+                              (time.monotonic_ns() - t0) / 1e3, error)
+        if reply is None:
+            return
+        if isinstance(reply, (bytes, bytearray, memoryview)):
+            reply = NsheadMessage(bytes(reply), msg.id, msg.version,
+                                  msg.log_id)
+        out = IOBuf()
+        out.append(reply.pack())
+        socket.write(out)
+
+    def process(self, msg, socket):
+        raise AssertionError("nshead messages are processed inline")
+
+
+class NsheadClient(PipelinedClient):
+    user_data_key = "nshead_client"
+
+    def __init__(self, address: str | EndPoint, timeout_s: float = 5.0,
+                 control: Optional[TaskControl] = None):
+        super().__init__(address, ensure_registered(), timeout_s=timeout_s,
+                         control=control)
+
+    def call(self, msg: NsheadMessage | bytes) -> NsheadMessage:
+        if isinstance(msg, (bytes, bytearray, memoryview)):
+            msg = NsheadMessage(bytes(msg))
+        batch = self._start(msg.pack(), 1)
+        return self._wait(batch, "nshead call")[0]
+
+    async def call_async(self, msg: NsheadMessage | bytes) -> NsheadMessage:
+        if isinstance(msg, (bytes, bytearray, memoryview)):
+            msg = NsheadMessage(bytes(msg))
+        batch = self._start(msg.pack(), 1)
+        return (await self._wait_async(batch, "nshead call"))[0]
+
+
+_instance: Optional[NsheadProtocol] = None
+
+
+def ensure_registered() -> NsheadProtocol:
+    global _instance
+    if _instance is None:
+        _instance = NsheadProtocol()
+        register_protocol(_instance)
+    return _instance

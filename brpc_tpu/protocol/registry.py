@@ -81,10 +81,14 @@ def find_protocol(name: str) -> Optional[Protocol]:
 def _register_builtins() -> None:
     # register in preference order; redis is last since its inline-command
     # form only engages on connections that already spoke RESP
-    from brpc_tpu.protocol import tpu_std, http, h2, thrift, redis, memcache
+    from brpc_tpu.protocol import (
+        tpu_std, http, h2, thrift, nshead, esp, mongo, redis, memcache)
     tpu_std.ensure_registered()
     http.ensure_registered()
     h2.ensure_registered()
     thrift.ensure_registered()
+    nshead.ensure_registered()
+    esp.ensure_registered()
+    mongo.ensure_registered()
     redis.ensure_registered()
     memcache.ensure_registered()   # client-only: TRY_OTHERS on servers

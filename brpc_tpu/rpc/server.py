@@ -61,7 +61,9 @@ class ServerOptions:
                  auth_token: Optional[str] = None,
                  auth=None, interceptor=None,
                  enable_builtin_services: bool = True,
-                 redis_service=None, thrift_service=None):
+                 redis_service=None, thrift_service=None,
+                 nshead_service=None, esp_service=None,
+                 mongo_service_adaptor=None):
         self.num_workers = num_workers
         self.max_concurrency = max_concurrency
         self.auth_token = auth_token
@@ -77,6 +79,11 @@ class ServerOptions:
         self.redis_service = redis_service
         # native thrift method table (brpc/thrift_service.h)
         self.thrift_service = thrift_service
+        # legacy family adaptors (nshead_service.h, esp_message.h,
+        # mongo_service_adaptor.h)
+        self.nshead_service = nshead_service
+        self.esp_service = esp_service
+        self.mongo_service_adaptor = mongo_service_adaptor
 
 
 class Server:

@@ -1,0 +1,228 @@
+"""nshead / esp / mongo legacy protocol tests (reference:
+policy/nshead_protocol.cpp, esp_protocol.cpp, mongo_protocol.cpp) —
+codec units + loopback e2e."""
+
+import struct
+import threading
+
+import pytest
+
+from brpc_tpu.protocol import bson, esp, mongo, nshead
+from brpc_tpu.rpc import Server, ServerOptions
+
+_name_seq = iter(range(10_000))
+
+
+# --------------------------------------------------------------- nshead
+
+def test_nshead_pack_unpack():
+    m = nshead.NsheadMessage(b"body", id=3, version=1, log_id=99)
+    wire = m.pack()
+    assert len(wire) == 36 + 4
+    fields = nshead.unpack_head(wire[:36])
+    assert fields[0] == 3 and fields[2] == 99
+    assert fields[4] == nshead.NSHEAD_MAGIC
+    assert fields[6] == 4
+
+
+def test_nshead_e2e():
+    def handler(sock, msg):
+        return msg.body.upper()
+
+    server = Server(ServerOptions(nshead_service=handler))
+    ep = server.start(f"mem://nshead-{next(_name_seq)}")
+    c = nshead.NsheadClient(ep)
+    try:
+        reply = c.call(nshead.NsheadMessage(b"hello", log_id=7))
+        assert reply.body == b"HELLO"
+        assert reply.log_id == 7          # head echoed back
+        reply2 = c.call(b"raw bytes ok")
+        assert reply2.body == b"RAW BYTES OK"
+    finally:
+        c.close()
+        server.stop()
+        server.join(2)
+
+
+def test_nshead_full_message_reply():
+    def handler(sock, msg):
+        return nshead.NsheadMessage(b"custom", id=42, log_id=msg.log_id)
+
+    server = Server(ServerOptions(nshead_service=handler))
+    ep = server.start(f"mem://nshead-{next(_name_seq)}")
+    c = nshead.NsheadClient(ep)
+    try:
+        reply = c.call(nshead.NsheadMessage(b"x", log_id=5))
+        assert reply.id == 42 and reply.log_id == 5
+        assert reply.body == b"custom"
+    finally:
+        c.close()
+        server.stop()
+        server.join(2)
+
+
+# ------------------------------------------------------------------ esp
+
+def test_esp_pack_parse_roundtrip():
+    m = esp.EspMessage(b"payload", to=10, from_=20, flags=1, msg_id=33)
+    wire = m.pack()
+    assert wire[:2] == b"SG"
+    assert len(wire) == esp.HEADER_SIZE + 7
+
+
+def test_esp_e2e_out_of_order_safe():
+    import time as _time
+
+    def handler(sock, msg):
+        # reverse arrival order for even ids to prove msg_id matching
+        if msg.msg_id % 2 == 0:
+            _time.sleep(0.05)
+        return b"reply-" + msg.body
+
+    server = Server(ServerOptions(esp_service=handler))
+    ep = server.start(f"mem://esp-{next(_name_seq)}")
+    c = esp.EspClient(ep, stargate_id=7)
+    results = {}
+    errs = []
+
+    def worker(i):
+        try:
+            r = c.call(to=1, body=f"m{i}".encode())
+            results[i] = r.body
+        except Exception as e:      # pragma: no cover
+            errs.append(e)
+
+    try:
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert not errs
+        assert results == {i: f"reply-m{i}".encode() for i in range(6)}
+    finally:
+        c.close()
+        server.stop()
+        server.join(2)
+
+
+# ----------------------------------------------------------------- bson
+
+def test_bson_roundtrip():
+    doc = {
+        "str": "hello",
+        "int32": 7,
+        "int64": 1 << 40,
+        "double": 2.5,
+        "bool": True,
+        "none": None,
+        "bin": b"\x00\x01",
+        "oid": bson.ObjectId(b"A" * 12),
+        "sub": {"nested": "yes"},
+        "arr": [1, "two", 3.0],
+        "when": bson.DateTimeMs(1700000000000),
+    }
+    wire = bson.encode_doc(doc)
+    out, end = bson.decode_doc(wire)
+    assert end == len(wire)
+    assert out == doc
+
+
+def test_bson_rejects_bad():
+    with pytest.raises(bson.BsonError):
+        bson.decode_doc(b"\x03\x00\x00\x00")         # size < 5
+    with pytest.raises(bson.BsonError):
+        bson.decode_doc(struct.pack("<i", 100) + b"\x00" * 10)  # truncated
+
+
+# ---------------------------------------------------------------- mongo
+
+def make_mongo_server():
+    svc = mongo.MongoServiceAdaptor()
+    store = {}
+
+    @svc.command("ping")
+    def ping(sock, doc):
+        return {"ok": 1.0}
+
+    @svc.command("insert")
+    def insert(sock, doc):
+        coll = doc["insert"]
+        docs = doc.get("documents", [])
+        store.setdefault(coll, []).extend(docs)
+        return {"n": len(docs)}
+
+    @svc.command("find")
+    def find(sock, doc):
+        coll = doc["find"]
+        docs = store.get(coll, [])
+        return {"cursor": {"id": 0, "ns": f"db.{coll}",
+                           "firstBatch": docs}}
+
+    @svc.command("boom")
+    def boom(sock, doc):
+        raise RuntimeError("bad day")
+
+    server = Server(ServerOptions(mongo_service_adaptor=svc))
+    return server
+
+
+def _mongo_roundtrip(sock_file, doc, request_id=1):
+    import socket as pysock
+    payload = struct.pack("<I", 0) + b"\x00" + bson.encode_doc(doc)
+    msg = struct.pack("<iiii", 16 + len(payload), request_id, 0,
+                      mongo.OP_MSG) + payload
+    sock_file.sendall(msg)
+    head = b""
+    while len(head) < 16:
+        head += sock_file.recv(16 - len(head))
+    length = struct.unpack("<i", head[:4])[0]
+    body = b""
+    while len(body) < length - 16:
+        body += sock_file.recv(length - 16 - len(body))
+    assert struct.unpack("<i", head[12:16])[0] == mongo.OP_MSG
+    reply, _ = bson.decode_doc(body, 5)
+    return reply
+
+
+def test_mongo_op_msg_e2e():
+    import socket as pysock
+
+    server = make_mongo_server()
+    ep = server.start("tcp://127.0.0.1:0")
+    host, port = str(ep).replace("tcp://", "").rsplit(":", 1)
+    s = pysock.create_connection((host, int(port)), timeout=5)
+    try:
+        assert _mongo_roundtrip(s, {"ping": 1})["ok"] == 1.0
+        r = _mongo_roundtrip(s, {"insert": "things", "documents": [
+            {"x": 1}, {"x": 2}]})
+        assert r["n"] == 2 and r["ok"] == 1.0
+        r = _mongo_roundtrip(s, {"find": "things"})
+        assert [d["x"] for d in r["cursor"]["firstBatch"]] == [1, 2]
+        r = _mongo_roundtrip(s, {"hello": 1})
+        assert r["isWritablePrimary"] is True     # builtin handshake
+        r = _mongo_roundtrip(s, {"nosuchcmd": 1})
+        assert r["ok"] == 0.0 and r["code"] == 59
+        r = _mongo_roundtrip(s, {"boom": 1})
+        assert r["ok"] == 0.0 and "handler error" in r["errmsg"]
+    finally:
+        s.close()
+        server.stop()
+        server.join(2)
+
+
+def test_mongo_no_adaptor():
+    import socket as pysock
+
+    server = Server(ServerOptions())
+    ep = server.start("tcp://127.0.0.1:0")
+    host, port = str(ep).replace("tcp://", "").rsplit(":", 1)
+    s = pysock.create_connection((host, int(port)), timeout=5)
+    try:
+        r = _mongo_roundtrip(s, {"ping": 1})
+        assert r["ok"] == 0.0 and "adaptor" in r["errmsg"]
+    finally:
+        s.close()
+        server.stop()
+        server.join(2)
