@@ -1,0 +1,69 @@
+"""Sampling CPU profiler for /hotspots (builtin/hotspots_service.cpp —
+the reference shells into gperftools; a Python runtime profiles itself
+by sampling ``sys._current_frames()`` across ALL threads, which is what
+the fiber workers are).
+
+Output: aggregated top-of-stack counts plus folded stacks compatible
+with flamegraph tooling (the reference renders the same data through
+pprof+flamegraph)."""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import Counter
+from typing import Dict, List, Tuple
+
+_profile_lock = threading.Lock()     # one profile at a time, like /hotspots
+
+
+def _frame_id(frame) -> str:
+    code = frame.f_code
+    return f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}:{frame.f_lineno})"
+
+
+def sample_cpu(seconds: float = 1.0, interval_s: float = 0.005,
+               max_stack: int = 64) -> Tuple[Counter, Counter, int]:
+    """Sample every thread's stack for ``seconds``. Returns
+    (leaf_counts, folded_stack_counts, nsamples)."""
+    if not _profile_lock.acquire(blocking=False):
+        raise RuntimeError("another profile is already running")
+    try:
+        me = threading.get_ident()
+        leaves: Counter = Counter()
+        folded: Counter = Counter()
+        nsamples = 0
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                stack: List[str] = []
+                f = frame
+                while f is not None and len(stack) < max_stack:
+                    stack.append(_frame_id(f))
+                    f = f.f_back
+                if not stack:
+                    continue
+                leaves[stack[0]] += 1
+                folded[";".join(reversed(stack))] += 1
+                nsamples += 1
+            time.sleep(interval_s)
+        return leaves, folded, nsamples
+    finally:
+        _profile_lock.release()
+
+
+def render_text(leaves: Counter, nsamples: int, top: int = 40) -> str:
+    if nsamples == 0:
+        return "no samples (process idle?)\n"
+    lines = [f"{nsamples} samples\n", "count  pct  function\n"]
+    for fn, n in leaves.most_common(top):
+        lines.append(f"{n:6d} {100.0 * n / nsamples:4.1f}%  {fn}\n")
+    return "".join(lines)
+
+
+def render_folded(folded: Counter) -> str:
+    """flamegraph.pl-compatible: 'frame;frame;frame count' per line."""
+    return "".join(f"{stack} {n}\n" for stack, n in folded.most_common())

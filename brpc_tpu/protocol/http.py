@@ -63,6 +63,21 @@ def _response(status: int, body: bytes, content_type: str = "text/plain",
     return out
 
 
+def _thread_stacks() -> bytes:
+    """All OS threads' Python stacks (the /bthreads + /threads pages of
+    the reference — here workers ARE pthreads running fibers)."""
+    import sys
+    import traceback
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in __import__("threading").enumerate()}
+    out = []
+    for tid, frame in frames.items():
+        out.append(f"--- thread {tid} ({names.get(tid, '?')}) ---\n")
+        out.extend(traceback.format_stack(frame))
+        out.append("\n")
+    return "".join(out).encode()
+
+
 class HttpProtocol(Protocol):
     name = "http"
 
@@ -187,6 +202,29 @@ class HttpProtocol(Protocol):
                 spans = global_collector.recent(int(req.query.get("n", "50")))
             return 200, "application/json", json.dumps(
                 [s.to_dict() for s in spans]).encode()
+        if path == "/version":
+            import jax
+            from brpc_tpu import __version__
+            return 200, "application/json", json.dumps({
+                "brpc_tpu": __version__, "jax": jax.__version__,
+                "server": "brpc-tpu"}).encode()
+        if path == "/protobufs":
+            return 200, "application/json", self._protobufs(server)
+        if path == "/sockets":
+            return 200, "application/json", self._sockets(server)
+        if path == "/fibers" or path == "/bthreads":
+            return 200, "application/json", self._fibers(server)
+        if path == "/threads":
+            return 200, "text/plain", _thread_stacks()
+        if path == "/ids":
+            from brpc_tpu.rpc.controller import _call_pool
+            return 200, "application/json", json.dumps(
+                {"inflight_client_calls": max(0, len(_call_pool) - 1)}
+            ).encode()
+        if path == "/hotspots" or path == "/pprof/profile":
+            return await self._hotspots(req)
+        if path == "/vlog":
+            return self._vlog(req)
         # /Service/Method RPC access
         parts = [p for p in path.split("/") if p]
         if len(parts) == 2:
@@ -194,9 +232,88 @@ class HttpProtocol(Protocol):
                                            socket)
         return 404, "text/plain", f"no such page {req.path}".encode()
 
+    # ------------------------------------------------- introspection pages
+    def _protobufs(self, server) -> bytes:
+        out = {}
+        for sname, svc in server.services().items():
+            for mname, method in svc.methods.items():
+                entry = {}
+                for side, cls in (("request", method.request_class),
+                                  ("response", method.response_class)):
+                    if cls is None:
+                        entry[side] = "bytes"
+                    else:
+                        desc = getattr(cls, "DESCRIPTOR", None)
+                        entry[side] = desc.full_name if desc else cls.__name__
+                        if desc is not None:
+                            entry[f"{side}_fields"] = sorted(
+                                f.name for f in desc.fields)
+                out[f"{sname}.{mname}"] = entry
+        return json.dumps(out, indent=1).encode()
+
+    def _sockets(self, server) -> bytes:
+        rows = []
+        for s in server.connections():
+            rows.append({
+                "id": s.id,
+                "remote": str(s.remote_endpoint) if s.remote_endpoint else None,
+                "local": str(s.local_endpoint) if s.local_endpoint else None,
+                "failed": s.failed,
+                "fail_reason": str(getattr(s, "fail_reason", "") or ""),
+                "write_queue": len(getattr(s, "_write_q", []) or []),
+                "preferred_protocol": s.preferred_protocol,
+            })
+        return json.dumps(rows, indent=1).encode()
+
+    def _fibers(self, server) -> bytes:
+        c = server._control
+        return json.dumps({
+            "concurrency": c.concurrency,
+            "alive_fibers": c.nfibers.get_value(),
+            "fibers_created": c.nfibers_created.get_value(),
+            "switches_per_group": {g.index: g.nswitches for g in c.groups},
+            "steals_per_group": {g.index: g.nsteals for g in c.groups},
+            "runqueue_depth": {
+                g.index: len(g.rq) + len(g.remote_rq) + len(g.bound_rq)
+                for g in c.groups},
+        }).encode()
+
+    async def _hotspots(self, req: HttpRequest):
+        from brpc_tpu.builtin.profiler import (
+            render_folded, render_text, sample_cpu)
+        try:
+            seconds = min(30.0, float(req.query.get("seconds", "1")))
+        except ValueError:
+            return 400, "text/plain", b"bad seconds"
+        try:
+            leaves, folded, n = sample_cpu(seconds)
+        except RuntimeError as e:
+            return 503, "text/plain", str(e).encode()
+        if req.query.get("format") == "folded":
+            return 200, "text/plain", render_folded(folded).encode()
+        return 200, "text/plain", render_text(leaves, n).encode()
+
+    def _vlog(self, req: HttpRequest):
+        import logging as pylog
+        module = req.query.get("module", "")
+        level = req.query.get("level")
+        if level is not None:
+            try:
+                pylog.getLogger(module or None).setLevel(level.upper())
+            except ValueError as e:
+                return 400, "text/plain", f"bad level: {e}".encode()
+            return 200, "text/plain", b"OK"
+        loggers = {"root": pylog.getLevelName(pylog.getLogger().level)}
+        for name in sorted(pylog.root.manager.loggerDict):
+            lg = pylog.root.manager.loggerDict[name]
+            if isinstance(lg, pylog.Logger) and lg.level != pylog.NOTSET:
+                loggers[name] = pylog.getLevelName(lg.level)
+        return 200, "application/json", json.dumps(loggers).encode()
+
     def _index(self, server) -> bytes:
         pages = ["status", "vars", "flags", "health", "connections",
-                 "brpc_metrics", "rpcz"]
+                 "brpc_metrics", "rpcz", "version", "protobufs", "sockets",
+                 "fibers", "threads", "ids", "hotspots", "vlog"]
         links = "".join(f'<li><a href="/{p}">/{p}</a></li>' for p in pages)
         svcs = "".join(
             f"<li>{n}: {', '.join(sorted(s.methods))}</li>"

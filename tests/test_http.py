@@ -214,3 +214,73 @@ class TestHttpRpc:
         assert not cntl.failed()
         status, body = http_get(ep, "/EchoService/Echo", b"text")
         assert status == 200 and body == b"text"
+
+
+# ------------------------------------------------- new builtin pages
+
+def test_version_page(server):
+    srv, ep = server
+    status, body = http_get(ep, "/version")
+    assert status == 200
+    info = json.loads(body)
+    assert info["brpc_tpu"] and info["jax"]
+
+
+def test_protobufs_page(server):
+    srv, ep = server
+    status, body = http_get(ep, "/protobufs")
+    assert status == 200
+    table = json.loads(body)
+    assert any(k.startswith("EchoService.") for k in table)
+    for entry in table.values():
+        assert "request" in entry and "response" in entry
+
+
+def test_sockets_and_fibers_pages(server):
+    srv, ep = server
+    status, body = http_get(ep, "/sockets")
+    assert status == 200
+    rows = json.loads(body)
+    assert isinstance(rows, list) and rows        # at least our own conn
+    assert {"id", "remote", "failed"} <= set(rows[0])
+    status, body = http_get(ep, "/fibers")
+    assert status == 200
+    fib = json.loads(body)
+    assert fib["concurrency"] >= 1
+    assert fib["fibers_created"] >= 0
+
+
+def test_threads_page(server):
+    srv, ep = server
+    status, body = http_get(ep, "/threads")
+    assert status == 200
+    assert b"--- thread" in body
+
+
+def test_ids_page(server):
+    srv, ep = server
+    status, body = http_get(ep, "/ids")
+    assert status == 200
+    assert "inflight_client_calls" in json.loads(body)
+
+
+def test_hotspots_page(server):
+    srv, ep = server
+    status, body = http_get(ep, "/hotspots?seconds=0.2")
+    assert status == 200
+    assert b"samples" in body
+    status, body = http_get(ep, "/hotspots?seconds=0.2&format=folded")
+    assert status == 200
+
+
+def test_vlog_page(server):
+    import logging
+    srv, ep = server
+    status, _ = http_get(ep, "/vlog?module=test.vlog.mod&level=DEBUG")
+    assert status == 200
+    assert logging.getLogger("test.vlog.mod").level == logging.DEBUG
+    status, body = http_get(ep, "/vlog")
+    assert status == 200
+    assert json.loads(body).get("test.vlog.mod") == "DEBUG"
+    status, _ = http_get(ep, "/vlog?module=test.vlog.mod&level=BOGUS")
+    assert status == 400
