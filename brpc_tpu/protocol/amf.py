@@ -1,0 +1,183 @@
+"""AMF0 codec (src/brpc/amf.{h,cpp}, 1211 LoC in the reference): the
+serialization under RTMP command messages.
+
+Python mapping: float/int -> number, bool -> boolean, str -> string
+(long string when >64KB), dict -> object, AmfEcmaArray -> ECMA array,
+list -> strict array, None -> null, Undefined -> undefined."""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple
+
+_MAX_DEPTH = 32
+
+# markers
+_NUMBER = 0x00
+_BOOLEAN = 0x01
+_STRING = 0x02
+_OBJECT = 0x03
+_NULL = 0x05
+_UNDEFINED = 0x06
+_ECMA_ARRAY = 0x08
+_OBJECT_END = 0x09
+_STRICT_ARRAY = 0x0A
+_DATE = 0x0B
+_LONG_STRING = 0x0C
+
+
+class Undefined:
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "amf.Undefined"
+
+
+class AmfEcmaArray(dict):
+    """dict subclass marking ECMA-array encoding."""
+
+
+class AmfDate(float):
+    """milliseconds since epoch (timezone field written as 0)."""
+
+
+class AmfError(Exception):
+    pass
+
+
+# ----------------------------------------------------------------- encode
+
+def _encode_utf8(s: str) -> bytes:
+    b = s.encode("utf-8")
+    if len(b) > 0xFFFF:
+        raise AmfError("property name too long")
+    return struct.pack(">H", len(b)) + b
+
+
+def encode_value(v, depth: int = 0) -> bytes:
+    if depth > _MAX_DEPTH:
+        raise AmfError("AMF nesting too deep")
+    if isinstance(v, Undefined):
+        return bytes([_UNDEFINED])
+    if v is None:
+        return bytes([_NULL])
+    if isinstance(v, bool):
+        return bytes([_BOOLEAN, 1 if v else 0])
+    if isinstance(v, AmfDate):
+        return bytes([_DATE]) + struct.pack(">dH", float(v), 0)
+    if isinstance(v, (int, float)):
+        return bytes([_NUMBER]) + struct.pack(">d", float(v))
+    if isinstance(v, str):
+        b = v.encode("utf-8")
+        if len(b) > 0xFFFF:
+            return bytes([_LONG_STRING]) + struct.pack(">I", len(b)) + b
+        return bytes([_STRING]) + struct.pack(">H", len(b)) + b
+    if isinstance(v, AmfEcmaArray):
+        out = [bytes([_ECMA_ARRAY]), struct.pack(">I", len(v))]
+        for k, val in v.items():
+            out.append(_encode_utf8(str(k)))
+            out.append(encode_value(val, depth + 1))
+        out.append(b"\x00\x00" + bytes([_OBJECT_END]))
+        return b"".join(out)
+    if isinstance(v, dict):
+        out = [bytes([_OBJECT])]
+        for k, val in v.items():
+            out.append(_encode_utf8(str(k)))
+            out.append(encode_value(val, depth + 1))
+        out.append(b"\x00\x00" + bytes([_OBJECT_END]))
+        return b"".join(out)
+    if isinstance(v, (list, tuple)):
+        out = [bytes([_STRICT_ARRAY]), struct.pack(">I", len(v))]
+        for val in v:
+            out.append(encode_value(val, depth + 1))
+        return b"".join(out)
+    raise AmfError(f"cannot encode {type(v)!r}")
+
+
+def encode_values(*values) -> bytes:
+    return b"".join(encode_value(v) for v in values)
+
+
+# ----------------------------------------------------------------- decode
+
+def _read_utf8(data: bytes, pos: int) -> Tuple[str, int]:
+    if pos + 2 > len(data):
+        raise AmfError("truncated name")
+    n = struct.unpack_from(">H", data, pos)[0]
+    if pos + 2 + n > len(data):
+        raise AmfError("truncated name body")
+    return data[pos + 2:pos + 2 + n].decode("utf-8", "replace"), pos + 2 + n
+
+
+def decode_value(data: bytes, pos: int = 0, depth: int = 0) -> Tuple[Any, int]:
+    if depth > _MAX_DEPTH:
+        raise AmfError("AMF nesting too deep")
+    if pos >= len(data):
+        raise AmfError("truncated value")
+    marker = data[pos]
+    pos += 1
+    if marker == _NUMBER:
+        if pos + 8 > len(data):
+            raise AmfError("truncated number")
+        return struct.unpack_from(">d", data, pos)[0], pos + 8
+    if marker == _BOOLEAN:
+        if pos + 1 > len(data):
+            raise AmfError("truncated boolean")
+        return data[pos] != 0, pos + 1
+    if marker == _STRING:
+        return _read_utf8(data, pos)
+    if marker == _LONG_STRING:
+        if pos + 4 > len(data):
+            raise AmfError("truncated long string")
+        n = struct.unpack_from(">I", data, pos)[0]
+        if pos + 4 + n > len(data):
+            raise AmfError("truncated long string body")
+        return data[pos + 4:pos + 4 + n].decode("utf-8", "replace"), \
+            pos + 4 + n
+    if marker in (_OBJECT, _ECMA_ARRAY):
+        out: Dict[str, Any] = AmfEcmaArray() if marker == _ECMA_ARRAY else {}
+        if marker == _ECMA_ARRAY:
+            if pos + 4 > len(data):
+                raise AmfError("truncated ecma array")
+            pos += 4   # associative count is advisory
+        while True:
+            name, pos = _read_utf8(data, pos)
+            if name == "" and pos < len(data) and data[pos] == _OBJECT_END:
+                return out, pos + 1
+            out[name], pos = decode_value(data, pos, depth + 1)
+    if marker == _NULL:
+        return None, pos
+    if marker == _UNDEFINED:
+        return Undefined(), pos
+    if marker == _STRICT_ARRAY:
+        if pos + 4 > len(data):
+            raise AmfError("truncated strict array")
+        n = struct.unpack_from(">I", data, pos)[0]
+        if n > len(data):        # each element is >=1 byte
+            raise AmfError("bad strict array length")
+        pos += 4
+        out_l: List[Any] = []
+        for _ in range(n):
+            v, pos = decode_value(data, pos, depth + 1)
+            out_l.append(v)
+        return out_l, pos
+    if marker == _DATE:
+        if pos + 10 > len(data):
+            raise AmfError("truncated date")
+        ms = struct.unpack_from(">d", data, pos)[0]
+        return AmfDate(ms), pos + 10
+    raise AmfError(f"unsupported AMF0 marker 0x{marker:02x}")
+
+
+def decode_all(data: bytes) -> List[Any]:
+    out = []
+    pos = 0
+    while pos < len(data):
+        v, pos = decode_value(data, pos)
+        out.append(v)
+    return out

@@ -1,0 +1,72 @@
+"""FLV container mux/demux (the FLV writer half of the reference's rtmp
+stack, rtmp.cpp FlvWriter/ts.cpp; tag type ids are the RTMP message
+types, so RTMP media messages drop straight into tags)."""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, NamedTuple, Optional
+
+FLV_HEADER_AUDIO = 0x04
+FLV_HEADER_VIDEO = 0x01
+
+TAG_AUDIO = 8
+TAG_VIDEO = 9
+TAG_SCRIPT = 18
+
+
+class FlvTag(NamedTuple):
+    tag_type: int
+    timestamp: int
+    payload: bytes
+
+
+class FlvError(Exception):
+    pass
+
+
+def file_header(has_audio: bool = True, has_video: bool = True) -> bytes:
+    flags = (FLV_HEADER_AUDIO if has_audio else 0) | \
+        (FLV_HEADER_VIDEO if has_video else 0)
+    return b"FLV\x01" + bytes([flags]) + struct.pack(">I", 9) + \
+        struct.pack(">I", 0)   # PreviousTagSize0
+
+
+def pack_tag(tag: FlvTag) -> bytes:
+    ts = tag.timestamp & 0xFFFFFFFF
+    head = bytes([tag.tag_type]) + \
+        struct.pack(">I", len(tag.payload))[1:] + \
+        struct.pack(">I", ts & 0xFFFFFF)[1:] + bytes([(ts >> 24) & 0xFF]) + \
+        b"\x00\x00\x00"
+    return head + tag.payload + struct.pack(">I", 11 + len(tag.payload))
+
+
+def parse_header(data: bytes) -> int:
+    """Validates the 9-byte header + PreviousTagSize0; returns the offset
+    of the first tag."""
+    if len(data) < 13:
+        raise FlvError("short flv header")
+    if data[:4] != b"FLV\x01":
+        raise FlvError("bad flv signature")
+    offset = struct.unpack(">I", data[5:9])[0]
+    if offset < 9:
+        raise FlvError("bad flv data offset")
+    return offset + 4
+
+
+def iter_tags(data: bytes, pos: Optional[int] = None) -> Iterator[FlvTag]:
+    if pos is None:
+        pos = parse_header(data)
+    while pos + 11 <= len(data):
+        tag_type = data[pos]
+        size = int.from_bytes(data[pos + 1:pos + 4], "big")
+        ts = int.from_bytes(data[pos + 4:pos + 7], "big") | \
+            (data[pos + 7] << 24)
+        if pos + 11 + size + 4 > len(data):
+            raise FlvError("truncated flv tag")
+        payload = data[pos + 11:pos + 11 + size]
+        prev = struct.unpack(">I", data[pos + 11 + size:pos + 15 + size])[0]
+        if prev != 11 + size:
+            raise FlvError("bad PreviousTagSize")
+        yield FlvTag(tag_type, ts, payload)
+        pos += 11 + size + 4

@@ -279,16 +279,35 @@ class HttpProtocol(Protocol):
         }).encode()
 
     async def _hotspots(self, req: HttpRequest):
+        import threading
+
         from brpc_tpu.builtin.profiler import (
             render_folded, render_text, sample_cpu)
+        from brpc_tpu.fiber.sync import FiberEvent
         try:
             seconds = min(30.0, float(req.query.get("seconds", "1")))
         except ValueError:
             return 400, "text/plain", b"bad seconds"
-        try:
-            leaves, folded, n = sample_cpu(seconds)
-        except RuntimeError as e:
-            return 503, "text/plain", str(e).encode()
+        # sample on a dedicated pthread: the time.sleep loop would
+        # otherwise pin this worker (and profile an idle process)
+        done = FiberEvent()
+        result: dict = {}
+
+        def run():
+            try:
+                result["v"] = sample_cpu(seconds)
+            except Exception as e:
+                result["e"] = e
+            done.set()
+
+        threading.Thread(target=run, name="hotspots_sampler",
+                         daemon=True).start()
+        await done.wait(seconds + 30)
+        if "e" in result:
+            return 503, "text/plain", str(result["e"]).encode()
+        if "v" not in result:
+            return 503, "text/plain", b"profile did not complete"
+        leaves, folded, n = result["v"]
         if req.query.get("format") == "folded":
             return 200, "text/plain", render_folded(folded).encode()
         return 200, "text/plain", render_text(leaves, n).encode()

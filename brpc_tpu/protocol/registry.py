@@ -82,7 +82,7 @@ def _register_builtins() -> None:
     # register in preference order; redis is last since its inline-command
     # form only engages on connections that already spoke RESP
     from brpc_tpu.protocol import (
-        tpu_std, http, h2, thrift, nshead, esp, mongo, redis, memcache)
+        tpu_std, http, h2, thrift, nshead, esp, mongo, rtmp, redis, memcache)
     tpu_std.ensure_registered()
     http.ensure_registered()
     h2.ensure_registered()
@@ -90,5 +90,6 @@ def _register_builtins() -> None:
     nshead.ensure_registered()
     esp.ensure_registered()
     mongo.ensure_registered()
+    rtmp.ensure_registered()       # claims 0x03-version first bytes
     redis.ensure_registered()
     memcache.ensure_registered()   # client-only: TRY_OTHERS on servers

@@ -63,7 +63,7 @@ class ServerOptions:
                  enable_builtin_services: bool = True,
                  redis_service=None, thrift_service=None,
                  nshead_service=None, esp_service=None,
-                 mongo_service_adaptor=None):
+                 mongo_service_adaptor=None, rtmp_service=None):
         self.num_workers = num_workers
         self.max_concurrency = max_concurrency
         self.auth_token = auth_token
@@ -84,6 +84,8 @@ class ServerOptions:
         self.nshead_service = nshead_service
         self.esp_service = esp_service
         self.mongo_service_adaptor = mongo_service_adaptor
+        # live publish/play relay registry (rtmp.h RtmpService)
+        self.rtmp_service = rtmp_service
 
 
 class Server:

@@ -1,0 +1,303 @@
+"""RTMP/AMF0/FLV tests: codec roundtrips, chunk-layer units, and a real
+publish->relay->play e2e over TCP loopback (the reference's
+brpc_rtmp_unittest drives RtmpClient at an in-process server the same
+way)."""
+
+import struct
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.protocol import amf, flv, rtmp
+from brpc_tpu.rpc import Server, ServerOptions
+
+_name_seq = iter(range(10_000))
+
+
+# ----------------------------------------------------------------- amf0
+
+def test_amf_roundtrip():
+    vals = ["connect", 1.0, {"app": "live", "ok": True, "n": 3.5},
+            None, amf.Undefined(), ["a", 2.0], amf.AmfEcmaArray({"k": "v"}),
+            amf.AmfDate(1700000000000.0)]
+    wire = amf.encode_values(*vals)
+    out = amf.decode_all(wire)
+    assert out[0] == "connect" and out[1] == 1.0
+    assert out[2] == {"app": "live", "ok": True, "n": 3.5}
+    assert out[3] is None and isinstance(out[4], amf.Undefined)
+    assert out[5] == ["a", 2.0]
+    assert out[6] == {"k": "v"} and isinstance(out[6], amf.AmfEcmaArray)
+    assert float(out[7]) == 1700000000000.0
+
+
+def test_amf_long_string():
+    s = "x" * 70000
+    out = amf.decode_all(amf.encode_value(s))
+    assert out == [s]
+
+
+def test_amf_rejects_garbage():
+    with pytest.raises(amf.AmfError):
+        amf.decode_value(b"\xff")
+    with pytest.raises(amf.AmfError):
+        amf.decode_value(b"\x00\x01")        # truncated number
+
+
+# ---------------------------------------------------------------- chunks
+
+def _roundtrip_chunks(msgs, chunk_size=rtmp.OUT_CHUNK_SIZE,
+                      in_chunk=None):
+    state = rtmp._ConnState(is_client=False)
+    state.phase = rtmp._ConnState.PHASE_READY
+    state.in_chunk_size = in_chunk if in_chunk else chunk_size
+    data = b"".join(rtmp.pack_chunks(m, 3, chunk_size) for m in msgs)
+    out = []
+    pos = 0
+    while pos < len(data):
+        got = rtmp._parse_one_chunk(state, data, pos)
+        assert got is not None
+        msg, pos = got
+        if msg is not None:
+            out.append(msg)
+    return out
+
+
+def test_chunk_roundtrip_single():
+    msg = rtmp.RtmpMessage(rtmp.MSG_VIDEO, 1234, 1, b"\x17\x01" + b"v" * 100)
+    out = _roundtrip_chunks([msg])
+    assert len(out) == 1
+    got = out[0]
+    assert (got.msg_type, got.timestamp, got.stream_id, got.payload) == \
+        (msg.msg_type, msg.timestamp, msg.stream_id, msg.payload)
+
+
+def test_chunk_roundtrip_multi_chunk_message():
+    payload = bytes(range(256)) * 40          # > chunk size -> fmt3 parts
+    msg = rtmp.RtmpMessage(rtmp.MSG_AUDIO, 7, 2, payload)
+    out = _roundtrip_chunks([msg], chunk_size=128, in_chunk=128)
+    assert out[0].payload == payload
+
+
+def test_chunk_extended_timestamp():
+    msg = rtmp.RtmpMessage(rtmp.MSG_VIDEO, 0x1000000, 1, b"x" * 300)
+    out = _roundtrip_chunks([msg], chunk_size=128, in_chunk=128)
+    assert out[0].timestamp == 0x1000000
+
+
+def test_chunk_incremental_need_more():
+    msg = rtmp.RtmpMessage(rtmp.MSG_VIDEO, 5, 1, b"hello world")
+    data = rtmp.pack_chunks(msg, 3)
+    for cut in range(1, len(data)):
+        state = rtmp._ConnState(is_client=False)
+        state.phase = rtmp._ConnState.PHASE_READY
+        state.in_chunk_size = rtmp.OUT_CHUNK_SIZE
+        got = rtmp._parse_one_chunk(state, data[:cut], 0)
+        assert got is None or got[0] is None
+
+
+# ------------------------------------------------------------------ flv
+
+def test_flv_mux_demux():
+    tags = [flv.FlvTag(flv.TAG_SCRIPT, 0, b"meta"),
+            flv.FlvTag(flv.TAG_VIDEO, 40, b"\x17\x00cfg"),
+            flv.FlvTag(flv.TAG_AUDIO, 0x1234567, b"\xaf\x01aac")]
+    blob = flv.file_header() + b"".join(flv.pack_tag(t) for t in tags)
+    out = list(flv.iter_tags(blob))
+    assert out == tags
+
+
+def test_flv_rejects_corrupt():
+    with pytest.raises(flv.FlvError):
+        flv.parse_header(b"NOT\x01" + b"\x00" * 20)
+    blob = flv.file_header() + flv.pack_tag(
+        flv.FlvTag(flv.TAG_VIDEO, 0, b"xy"))
+    bad = blob[:-1] + b"\x99"                  # corrupt PreviousTagSize
+    with pytest.raises(flv.FlvError):
+        list(flv.iter_tags(bad))
+
+
+# ------------------------------------------------------------------ e2e
+
+@pytest.fixture()
+def rtmp_server():
+    svc = rtmp.RtmpService()
+    server = Server(ServerOptions(rtmp_service=svc))
+    ep = server.start("tcp://127.0.0.1:0")
+    yield svc, ep
+    server.stop()
+    server.join(2)
+
+
+def test_rtmp_connect_and_create_stream(rtmp_server):
+    svc, ep = rtmp_server
+    c = rtmp.RtmpClient(ep, app="live")
+    try:
+        info = c.connect()
+        assert info["code"] == "NetConnection.Connect.Success"
+        sid = c.create_stream()
+        assert sid >= 1
+        sid2 = c.create_stream()
+        assert sid2 != sid
+    finally:
+        c.close()
+
+
+def test_rtmp_publish_play_relay(rtmp_server):
+    svc, ep = rtmp_server
+    pub = rtmp.RtmpClient(ep, app="live")
+    sub = rtmp.RtmpClient(ep, app="live")
+    received = []
+    got_enough = threading.Event()
+
+    def on_media(msg):
+        received.append(msg)
+        if len([m for m in received if m.msg_type == rtmp.MSG_VIDEO]) >= 3:
+            got_enough.set()
+
+    try:
+        pub.connect()
+        psid = pub.create_stream()
+        assert pub.publish(psid, "room1")["code"] == "NetStream.Publish.Start"
+        # publisher sends metadata + AVC seq header BEFORE the player joins
+        pub.send_metadata(psid, {"width": 640.0, "height": 480.0})
+        pub.send_video(psid, 0, b"\x17\x00AVCCONFIG")     # seq header
+        time.sleep(0.1)
+
+        sub.connect()
+        ssid = sub.create_stream()
+        assert sub.play(ssid, "room1",
+                        on_media=on_media)["code"] == "NetStream.Play.Start"
+        time.sleep(0.1)   # let catch-up frames land before live ones
+
+        for i in range(3):
+            pub.send_video(psid, 40 * (i + 1), b"\x27\x01" + bytes([i]) * 50)
+        pub.send_audio(psid, 40, b"\xaf\x01AUDIO")
+
+        assert got_enough.wait(5), f"only got {received}"
+        types = [m.msg_type for m in received]
+        # late-joiner catch-up: metadata + cached seq header arrive first
+        assert types[0] == rtmp.MSG_DATA_AMF0
+        assert types[1] == rtmp.MSG_VIDEO
+        assert received[1].payload == b"\x17\x00AVCCONFIG"
+        live_video = [m for m in received
+                      if m.msg_type == rtmp.MSG_VIDEO][1:]
+        assert [m.payload[2] for m in live_video] == [0, 1, 2]
+        assert all(m.stream_id == ssid for m in received)
+    finally:
+        pub.close()
+        sub.close()
+
+
+def test_rtmp_publish_conflict(rtmp_server):
+    svc, ep = rtmp_server
+    a = rtmp.RtmpClient(ep)
+    b = rtmp.RtmpClient(ep)
+    try:
+        a.connect()
+        b.connect()
+        a.publish(a.create_stream(), "busy")
+        with pytest.raises(rtmp.RtmpError, match="BadName"):
+            b.publish(b.create_stream(), "busy")
+    finally:
+        a.close()
+        b.close()
+
+
+def test_rtmp_publish_auth_hook(rtmp_server):
+    svc, ep = rtmp_server
+    svc.on_publish = lambda name, sock: name != "forbidden"
+    c = rtmp.RtmpClient(ep)
+    try:
+        c.connect()
+        with pytest.raises(rtmp.RtmpError):
+            c.publish(c.create_stream(), "forbidden")
+        c.publish(c.create_stream(), "allowed")
+    finally:
+        svc.on_publish = None
+        c.close()
+
+
+def test_rtmp_publisher_disconnect_frees_stream(rtmp_server):
+    svc, ep = rtmp_server
+    a = rtmp.RtmpClient(ep)
+    a.connect()
+    a.publish(a.create_stream(), "transient")
+    a.close()
+    time.sleep(0.2)          # drop_socket fires via on_failed
+    b = rtmp.RtmpClient(ep)
+    try:
+        b.connect()
+        b.publish(b.create_stream(), "transient")   # now free again
+    finally:
+        b.close()
+
+
+def test_chunk_fmt12_delta_no_double_apply():
+    # hand-build fmt0 + fmt1-delta messages whose payload arrives split:
+    # re-parsing after a partial read must not re-apply the delta
+    state = rtmp._ConnState(is_client=False)
+    state.phase = rtmp._ConnState.PHASE_READY
+    state.in_chunk_size = 128
+    payload = b"z" * 100
+    fmt0 = bytes([(0 << 6) | 5]) + \
+        (1000).to_bytes(3, "big") + len(payload).to_bytes(3, "big") + \
+        bytes([rtmp.MSG_VIDEO]) + struct.pack("<I", 1) + payload
+    fmt1 = bytes([(1 << 6) | 5]) + \
+        (40).to_bytes(3, "big") + len(payload).to_bytes(3, "big") + \
+        bytes([rtmp.MSG_VIDEO]) + payload
+    data = fmt0 + fmt1
+    # feed with every possible split point inside the fmt1 chunk
+    for cut in range(len(fmt0) + 1, len(data)):
+        st = rtmp._ConnState(is_client=False)
+        st.phase = rtmp._ConnState.PHASE_READY
+        st.in_chunk_size = 128
+        msg0, pos = rtmp._parse_one_chunk(st, data[:cut], 0)
+        assert msg0 is not None and msg0.timestamp == 1000
+        # partial fmt1: may need several retries as more bytes "arrive"
+        got = rtmp._parse_one_chunk(st, data[:cut], pos)
+        assert got is None          # incomplete
+        got = rtmp._parse_one_chunk(st, data, pos)
+        assert got is not None
+        msg1, _ = got
+        assert msg1 is not None and msg1.timestamp == 1040, \
+            f"cut={cut}: delta applied twice -> {msg1.timestamp}"
+
+
+def test_rtmp_not_claimed_without_service():
+    # a 0x03 first byte at a server with no rtmp_service must not start
+    # a handshake
+    import socket as pysock
+
+    server = Server(ServerOptions())
+    ep = server.start("tcp://127.0.0.1:0")
+    host, port = str(ep).replace("tcp://", "").rsplit(":", 1)
+    s = pysock.create_connection((host, int(port)), timeout=2)
+    try:
+        s.sendall(b"\x03" + b"\x00" * 1536)
+        s.settimeout(0.5)
+        try:
+            got = s.recv(10)
+        except TimeoutError:
+            got = b""
+        assert got == b""          # no S0S1S2 came back
+    finally:
+        s.close()
+        server.stop()
+        server.join(2)
+
+
+def test_rtmp_client_reconnect_after_failure(rtmp_server):
+    svc, ep = rtmp_server
+    c = rtmp.RtmpClient(ep)
+    try:
+        c.connect()
+        # kill the transport under the client
+        c._socket.set_failed(ConnectionError("simulated drop"))
+        time.sleep(0.1)
+        # reconnect must re-handshake cleanly before any command flows
+        info = c.connect()
+        assert info["code"] == "NetConnection.Connect.Success"
+        c.publish(c.create_stream(), "after-reconnect")
+    finally:
+        c.close()
