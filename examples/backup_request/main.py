@@ -1,0 +1,46 @@
+"""Backup request example (example/backup_request_c++): hedge a slow
+replica with a second request; first response wins."""
+
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+
+from brpc_tpu.rpc import (
+    Channel, ChannelOptions, ClusterChannel, Server, Service, ServerOptions,
+)
+
+
+def start_server(delay_s):
+    server = Server()
+    svc = Service("EchoService")
+
+    @svc.method()
+    def Echo(cntl, request):
+        time.sleep(delay_s)
+        return f"served-after-{delay_s}s".encode()
+
+    server.add_service(svc)
+    ep = server.start("tcp://127.0.0.1:0")
+    return server, ep
+
+
+def main() -> None:
+    slow, slow_ep = start_server(0.5)
+    fast, fast_ep = start_server(0.0)
+    ch = ClusterChannel(f"list://{slow_ep.host}:{slow_ep.port},"
+                        f"{fast_ep.host}:{fast_ep.port}",
+                        "rr", ChannelOptions(backup_request_ms=50,
+                                             timeout_ms=3000))
+    for i in range(4):
+        t0 = time.monotonic()
+        cntl = ch.call_sync("EchoService", "Echo", b"x")
+        ms = (time.monotonic() - t0) * 1e3
+        print(f"call {i}: {cntl.response_payload.to_bytes().decode():20s} "
+              f"{ms:6.1f}ms  backup_used={cntl.used_backup}")
+    ch.close()
+    slow.stop(); fast.stop(); slow.join(); fast.join()
+
+
+if __name__ == "__main__":
+    main()

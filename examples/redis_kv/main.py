@@ -1,0 +1,53 @@
+"""Redis server + client example (example/redis_c++): an in-memory KV
+served over RESP — redis-cli compatible — plus a pipelined client
+driving it."""
+
+import sys
+import threading
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+
+from brpc_tpu.protocol import redis
+from brpc_tpu.rpc import Server, ServerOptions
+
+
+def main(addr: str = "tcp://127.0.0.1:6380") -> None:
+    svc = redis.RedisService()
+    store, lock = {}, threading.Lock()
+
+    @svc.command("SET")
+    def set_(sock, args):
+        with lock:
+            store[args[1]] = args[2]
+        return redis.RedisStatus("OK")
+
+    @svc.command("GET")
+    def get(sock, args):
+        with lock:
+            return store.get(args[1])
+
+    @svc.command("DEL")
+    def del_(sock, args):
+        with lock:
+            return sum(1 for k in args[1:] if store.pop(k, None) is not None)
+
+    @svc.command("KEYS")
+    def keys(sock, args):
+        with lock:
+            return sorted(store)
+
+    server = Server(ServerOptions(redis_service=svc))
+    ep = server.start(addr)
+    print(f"redis server at {ep} — try: redis-cli -p {ep.port} set k v")
+
+    client = redis.RedisClient(ep)
+    print("SET greeting hello ->", client.execute("SET", "greeting", "hello"))
+    print("GET greeting       ->", client.execute("GET", "greeting"))
+    print("pipeline           ->", client.pipeline(
+        [["SET", "a", "1"], ["SET", "b", "2"], ["KEYS"]]))
+    client.close()
+    server.run_until_asked_to_quit()
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

@@ -1,0 +1,43 @@
+"""RTMP live relay (example/rtmp_c++ / live_chat): one server, one
+publisher pushing frames, one player receiving the relay. Point OBS or
+`ffmpeg -f flv rtmp://127.0.0.1:1935/live/room` at it for real media."""
+
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+
+from brpc_tpu.protocol import rtmp
+from brpc_tpu.rpc import Server, ServerOptions
+
+
+def main(addr: str = "tcp://127.0.0.1:1935") -> None:
+    svc = rtmp.RtmpService()
+    server = Server(ServerOptions(rtmp_service=svc))
+    ep = server.start(addr)
+    print(f"rtmp server at rtmp://{ep.host}:{ep.port}/live")
+
+    pub = rtmp.RtmpClient(ep, app="live")
+    pub.connect()
+    psid = pub.create_stream()
+    pub.publish(psid, "room")
+    pub.send_metadata(psid, {"width": 1280.0, "height": 720.0})
+    pub.send_video(psid, 0, b"\x17\x00<codec-config>")
+
+    got = []
+    sub = rtmp.RtmpClient(ep, app="live")
+    sub.connect()
+    sub.play(sub.create_stream(), "room", on_media=lambda m: got.append(m))
+
+    for i in range(5):
+        pub.send_video(psid, i * 40, b"\x27\x01" + bytes([i]) * 32)
+    time.sleep(0.3)
+    print(f"player received {len(got)} messages "
+          f"({[m.msg_type for m in got]})")
+    pub.close()
+    sub.close()
+    server.stop(); server.join()
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

@@ -1,0 +1,31 @@
+"""Thrift framed server + client (example/thrift_extension_c++)."""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+
+from brpc_tpu.protocol import thrift as th
+from brpc_tpu.rpc import Server, ServerOptions
+
+
+def main(addr: str = "tcp://127.0.0.1:8019") -> None:
+    svc = th.ThriftService()
+
+    @svc.method("Echo")
+    def echo(sock, args):
+        # args: {1: TVal(T_STRING, data)} — the conventional request slot
+        return {0: th.TVal(th.T_STRING, args[1].value)}
+
+    server = Server(ServerOptions(thrift_service=svc))
+    ep = server.start(addr)
+    print(f"thrift server at {ep}")
+
+    client = th.ThriftClient(ep)
+    out = client.call("Echo", {1: th.TVal(th.T_STRING, b"hello thrift")})
+    print("Echo ->", out[0].value)
+    client.close()
+    server.run_until_asked_to_quit()
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

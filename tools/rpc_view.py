@@ -1,0 +1,69 @@
+"""rpc_view: inspect requests recorded by rpc_dump without re-issuing
+them (tools/rpc_view in the reference).
+
+    python tools/rpc_view.py dump/rpc_dump.1234.jsonl [--limit 20]
+    python tools/rpc_view.py dump/ --service EchoService
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tools", 1)[0])
+
+from brpc_tpu.rpc.rpc_dump import load_dump
+
+
+def _files(path: str):
+    if os.path.isdir(path):
+        for name in sorted(os.listdir(path)):
+            if "rpc_dump" in name:
+                yield os.path.join(path, name)
+    else:
+        yield path
+
+
+def _preview(payload: bytes, width: int = 60) -> str:
+    try:
+        text = payload.decode("utf-8")
+        if text.isprintable() or all(c.isprintable() or c in "\r\n\t"
+                                     for c in text):
+            return repr(text[:width])
+    except UnicodeDecodeError:
+        pass
+    return payload[:width // 2].hex() + ("…" if len(payload) > width // 2
+                                         else "")
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="view rpc_dump samples")
+    ap.add_argument("path", help="dump file or directory")
+    ap.add_argument("--service", default=None, help="filter by service")
+    ap.add_argument("--method", default=None, help="filter by method")
+    ap.add_argument("--limit", type=int, default=0, help="0 = all")
+    ap.add_argument("--raw", action="store_true",
+                    help="write payload bytes of the first match to stdout")
+    args = ap.parse_args(argv)
+
+    shown = 0
+    for path in _files(args.path):
+        for service, method, payload, log_id in load_dump(path):
+            if args.service and service != args.service:
+                continue
+            if args.method and method != args.method:
+                continue
+            if args.raw:
+                sys.stdout.buffer.write(payload)
+                return
+            print(f"{service}.{method}  log_id={log_id}  "
+                  f"{len(payload)}B  {_preview(payload)}")
+            shown += 1
+            if args.limit and shown >= args.limit:
+                return
+    if not shown:
+        print("no samples matched", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
