@@ -82,8 +82,10 @@ def _register_builtins() -> None:
     # register in preference order; redis is last since its inline-command
     # form only engages on connections that already spoke RESP
     from brpc_tpu.protocol import (
-        tpu_std, http, h2, thrift, nshead, esp, mongo, rtmp, redis, memcache)
+        tpu_std, http, h2, thrift, nshead, esp, mongo, rtmp, redis, memcache,
+        pbrpc_variants)
     tpu_std.ensure_registered()
+    pbrpc_variants.ensure_registered()
     http.ensure_registered()
     h2.ensure_registered()
     thrift.ensure_registered()

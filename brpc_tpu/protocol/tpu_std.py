@@ -64,7 +64,8 @@ def serialize_payload(obj) -> bytes:
 def pack_message(meta: pb.RpcMeta, payload: bytes | IOBuf,
                  attachment: Optional[IOBuf] = None,
                  device_arrays: Optional[List] = None,
-                 device_lane: bool = False) -> Tuple[IOBuf, Optional[List]]:
+                 device_lane: bool = False,
+                 magic: bytes = MAGIC) -> Tuple[IOBuf, Optional[List]]:
     """Encode a frame. Returns (wire_iobuf, device_arrays_for_lane|None).
 
     device_arrays: jax/numpy arrays. With device_lane they stay out of the
@@ -100,7 +101,7 @@ def pack_message(meta: pb.RpcMeta, payload: bytes | IOBuf,
         payload_buf.append(payload)
     body_size = len(meta_bytes) + payload_buf.size + attachment.size
     out = IOBuf()
-    out.append(_HDR.pack(MAGIC, body_size, len(meta_bytes)))
+    out.append(_HDR.pack(magic, body_size, len(meta_bytes)))
     out.append(meta_bytes)
     out.append_buf(payload_buf)
     out.append_buf(attachment)
@@ -130,16 +131,25 @@ def unpack_inline_device_arrays(msg: RpcMessage) -> List:
 
 class TpuStdProtocol(Protocol):
     name = "tpu_std"
+    MAGIC = MAGIC          # subclass variants (hulu/sofa pbrpc) re-magic it
+
+    def frame(self, meta, payload, attachment=None, device_arrays=None,
+              device_lane=False):
+        """Wire framing for this protocol family; Channel and the server
+        dispatch call this so replies match the request's framing."""
+        return pack_message(meta, payload, attachment=attachment,
+                            device_arrays=device_arrays,
+                            device_lane=device_lane, magic=self.MAGIC)
 
     # ---------------------------------------------------------------- parse
     def parse(self, portal, socket) -> Tuple[str, object]:
         if portal.size < HEADER_SIZE:
             head = portal.peek_bytes(min(4, portal.size))
-            if MAGIC[:len(head)] != head:
+            if self.MAGIC[:len(head)] != head:
                 return PARSE_TRY_OTHERS, None
             return PARSE_NOT_ENOUGH_DATA, None
         magic, body_size, meta_size = _HDR.unpack(portal.peek_bytes(HEADER_SIZE))
-        if magic != MAGIC:
+        if magic != self.MAGIC:
             return PARSE_TRY_OTHERS, None
         if meta_size > body_size:
             return PARSE_TRY_OTHERS, None

@@ -185,6 +185,20 @@ class Channel:
         return cntl
 
     # ------------------------------------------------------------ internals
+    def _framer(self):
+        """Wire framing per ChannelOptions.protocol: tpu_std (default) or
+        a frame-capable variant (hulu_pbrpc/sofa_pbrpc)."""
+        if self.options.protocol in ("", "tpu_std"):
+            return pack_message
+        from brpc_tpu.protocol.registry import find_protocol
+        proto = find_protocol(self.options.protocol)
+        framer = getattr(proto, "frame", None)
+        if framer is None:
+            raise ValueError(
+                f"protocol {self.options.protocol!r} cannot frame Channel "
+                f"requests (use RedisClient/GrpcChannel/... for it)")
+        return framer
+
     def _pick_socket(self, cntl: Controller) -> Socket:
         """Server/connection selection for one (re)issue; cluster channels
         override this with LB selection (controller.cpp:1048-1135)."""
@@ -220,7 +234,7 @@ class Channel:
             stream.socket = sock
         use_lane = (bool(cntl.request_device_arrays)
                     and sock.conn.supports_device_lane)
-        wire, lane = pack_message(
+        wire, lane = self._framer()(
             meta, request_bytes, attachment=_copy_buf(cntl.request_attachment),
             device_arrays=cntl.request_device_arrays, device_lane=use_lane)
         if lane is not None:

@@ -24,7 +24,7 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     meta = msg.meta
     cid = meta.correlation_id
     if server is None:
-        _send_error(socket, cid, berr.EINTERNAL, "no server bound to socket")
+        _send_error(proto, socket, cid, berr.EINTERNAL, "no server bound to socket")
         return
     req_meta = meta.request
     # auth precedes lookup: unauthenticated peers must not be able to
@@ -39,22 +39,22 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
             auth_ctx = auth.verify_credential(req_meta.auth_token,
                                               socket.remote_endpoint)
         except AuthError as e:
-            _send_error(socket, cid, berr.ERPCAUTH,
+            _send_error(proto, socket, cid, berr.ERPCAUTH,
                         str(e) or "authentication failed")
             return
         except Exception:
-            _send_error(socket, cid, berr.ERPCAUTH, "authentication failed")
+            _send_error(proto, socket, cid, berr.ERPCAUTH, "authentication failed")
             return
         socket.user_data["auth_context"] = auth_ctx
     method = server.find_method(req_meta.service_name, req_meta.method_name)
     if method is None:
         has_svc = req_meta.service_name in server.services()
-        _send_error(socket, cid,
+        _send_error(proto, socket, cid,
                     berr.ENOMETHOD if has_svc else berr.ENOSERVICE,
                     f"unknown {req_meta.service_name}.{req_meta.method_name}")
         return
     if not server.on_request_start():
-        _send_error(socket, cid, berr.ELIMIT, "max_concurrency reached")
+        _send_error(proto, socket, cid, berr.ELIMIT, "max_concurrency reached")
         return
 
     method_key = f"{req_meta.service_name}.{req_meta.method_name}"
@@ -109,7 +109,7 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     except Exception as e:
         server.on_request_end(method_key, 0, failed=True)
         cntl.set_failed(berr.EREQUEST, f"cannot parse request: {e}")
-        _send_error(socket, cid, berr.EREQUEST, f"cannot parse request: {e}")
+        _send_error(proto, socket, cid, berr.EREQUEST, f"cannot parse request: {e}")
         finish_span(span, cntl)  # malformed traffic must show in /rpcz
         return
 
@@ -129,7 +129,7 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
             latency_us = (time.monotonic_ns() - t0) / 1e3
             server.on_request_end(method_key, latency_us, failed=True)
             cntl.set_failed(code, reason)
-            _send_error(socket, cid, code, reason)
+            _send_error(proto, socket, cid, code, reason)
             finish_span(span, cntl)
             return
 
@@ -144,11 +144,12 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
 
     latency_us = (time.monotonic_ns() - t0) / 1e3
     server.on_request_end(method_key, latency_us, failed=cntl.failed())
-    _send_response(socket, cid, cntl, response)
+    _send_response(proto, socket, cid, cntl, response)
     finish_span(span, cntl)
 
 
-def _send_response(socket, cid: int, cntl: Controller, response) -> None:
+def _send_response(proto, socket, cid: int, cntl: Controller,
+                   response) -> None:
     meta = pb.RpcMeta()
     meta.correlation_id = cid
     meta.response.error_code = cntl.error_code
@@ -171,15 +172,21 @@ def _send_response(socket, cid: int, cntl: Controller, response) -> None:
                 and socket.conn.supports_device_lane)
     att = IOBuf()
     att.append_buf(cntl.response_attachment)
-    wire, lane = pack_message(meta, payload, attachment=att,
-                              device_arrays=cntl.response_device_arrays,
-                              device_lane=use_lane)
+    framer = getattr(proto, "frame", None)
+    if framer is not None:
+        wire, lane = framer(meta, payload, attachment=att,
+                            device_arrays=cntl.response_device_arrays,
+                            device_lane=use_lane)
+    else:
+        wire, lane = pack_message(meta, payload, attachment=att,
+                                  device_arrays=cntl.response_device_arrays,
+                                  device_lane=use_lane)
     if lane is not None:
         socket.write_device_payload(lane)
     socket.write(wire)
 
 
-def _send_error(socket, cid: int, code: int, text: str) -> None:
+def _send_error(proto, socket, cid: int, code: int, text: str) -> None:
     cntl = Controller()
     cntl.set_failed(code, text)
-    _send_response(socket, cid, cntl, None)
+    _send_response(proto, socket, cid, cntl, None)

@@ -1,0 +1,95 @@
+"""mcpack codec + pb bridge + nshead_mcpack adaptor tests
+(src/mcpack2pb/ in the reference)."""
+
+import pytest
+
+from brpc_tpu.protocol import mcpack, nshead
+from brpc_tpu.rpc import Server, ServerOptions, Service
+from tests.proto import echo_pb2
+
+_name_seq = iter(range(10_000))
+
+
+def test_roundtrip():
+    doc = {
+        "s": "hello",
+        "i": -42,
+        "u": (1 << 63) + 5,
+        "d": 2.5,
+        "b": True,
+        "n": None,
+        "raw": b"\x00\x01\x02",
+        "obj": {"nested": "yes", "deep": {"x": 1}},
+        "arr": [1, "two", 3.0, {"four": 4}],
+    }
+    out = mcpack.decode(mcpack.encode(doc))
+    assert out == doc
+
+
+def test_rejects_garbage():
+    with pytest.raises(mcpack.McpackError):
+        mcpack.decode(b"\xff\x00")
+    with pytest.raises(mcpack.McpackError):
+        mcpack.decode(mcpack.encode({"a": 1}) + b"trailing")
+    with pytest.raises(mcpack.McpackError):
+        mcpack.decode(b"\x50\x00\x04\x00\x00\x00ab")   # truncated string
+
+
+def test_depth_cap():
+    doc = {}
+    cur = doc
+    for _ in range(100):
+        cur["x"] = {}
+        cur = cur["x"]
+    with pytest.raises(mcpack.McpackError, match="deep"):
+        mcpack.encode(doc)
+
+
+def test_pb_bridge_roundtrip():
+    req = echo_pb2.EchoRequest()
+    req.message = "bridged"
+    doc = mcpack.pb_to_mcpack(req)
+    assert doc == {"message": "bridged"}
+    req2 = echo_pb2.EchoRequest()
+    mcpack.mcpack_to_pb(doc, req2)
+    assert req2.message == "bridged"
+
+
+def test_nshead_mcpack_e2e():
+    svc = Service("EchoService")
+
+    @svc.method(request_class=echo_pb2.EchoRequest)
+    def Echo(cntl, request):
+        resp = echo_pb2.EchoResponse()
+        resp.message = request.message.upper()
+        return resp
+
+    @svc.method()
+    def RawEcho(cntl, request):
+        return request
+
+    server = Server(ServerOptions(
+        nshead_service=mcpack.nshead_mcpack_adaptor(svc)))
+    ep = server.start(f"mem://mcpack-{next(_name_seq)}")
+    c = nshead.NsheadClient(ep)
+    try:
+        body = mcpack.encode({"method": "Echo",
+                              "request": {"message": "hello"}})
+        reply = mcpack.decode(c.call(nshead.NsheadMessage(body)).body)
+        assert reply["error_code"] == 0
+        assert reply["response"]["message"] == "HELLO"
+
+        body = mcpack.encode({"method": "RawEcho", "request": b"bytes"})
+        reply = mcpack.decode(c.call(nshead.NsheadMessage(body)).body)
+        assert reply["response"] == b"bytes"
+
+        body = mcpack.encode({"method": "Nope", "request": {}})
+        reply = mcpack.decode(c.call(nshead.NsheadMessage(body)).body)
+        assert reply["error_code"] == 1002
+
+        reply = mcpack.decode(c.call(nshead.NsheadMessage(b"garbage")).body)
+        assert reply["error_code"] == 1003
+    finally:
+        c.close()
+        server.stop()
+        server.join(2)

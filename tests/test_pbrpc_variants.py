@@ -1,0 +1,97 @@
+"""hulu_pbrpc / sofa_pbrpc framing tests: same RPC core behind baidu-
+family wire headers, selected via ChannelOptions.protocol (reference:
+policy/hulu_pbrpc_protocol.cpp, sofa_pbrpc_protocol.cpp)."""
+
+import pytest
+
+from brpc_tpu.rpc import Channel, ChannelOptions, Server, ServerOptions, Service
+from brpc_tpu.rpc import errno_codes as berr
+
+_name_seq = iter(range(10_000))
+
+
+@pytest.fixture()
+def server():
+    server = Server()
+    svc = Service("EchoService")
+
+    @svc.method()
+    def Echo(cntl, request):
+        return request
+
+    @svc.method()
+    def WithAttachment(cntl, request):
+        cntl.response_attachment.append_buf(cntl.request_attachment)
+        return request
+
+    server.add_service(svc)
+    ep = server.start(f"mem://variants-{next(_name_seq)}")
+    yield server, ep
+    server.stop()
+    server.join(2)
+
+
+@pytest.mark.parametrize("proto", ["hulu_pbrpc", "sofa_pbrpc"])
+def test_variant_roundtrip(server, proto):
+    _, ep = server
+    ch = Channel(ep, ChannelOptions(protocol=proto))
+    try:
+        cntl = ch.call_sync("EchoService", "Echo", b"via " + proto.encode())
+        assert not cntl.failed(), cntl.error_text
+        assert cntl.response_payload.to_bytes() == b"via " + proto.encode()
+    finally:
+        ch.close()
+
+
+@pytest.mark.parametrize("proto", ["hulu_pbrpc", "sofa_pbrpc"])
+def test_variant_attachment(server, proto):
+    _, ep = server
+    ch = Channel(ep, ChannelOptions(protocol=proto))
+    try:
+        from brpc_tpu.rpc import Controller
+        cntl = Controller()
+        cntl.request_attachment.append(b"att-bytes")
+        cntl = ch.call_sync("EchoService", "WithAttachment", b"body",
+                            cntl=cntl)
+        assert not cntl.failed(), cntl.error_text
+        assert cntl.response_payload.to_bytes() == b"body"
+        assert cntl.response_attachment.to_bytes() == b"att-bytes"
+    finally:
+        ch.close()
+
+
+@pytest.mark.parametrize("proto", ["hulu_pbrpc", "sofa_pbrpc"])
+def test_variant_error_reply_keeps_framing(server, proto):
+    _, ep = server
+    ch = Channel(ep, ChannelOptions(protocol=proto))
+    try:
+        cntl = ch.call_sync("EchoService", "Nope", b"")
+        assert cntl.failed()
+        assert cntl.error_code == berr.ENOMETHOD
+    finally:
+        ch.close()
+
+
+def test_mixed_protocols_one_server(server):
+    # three clients speaking three framings at ONE server socket pool
+    _, ep = server
+    chans = [Channel(ep, ChannelOptions(protocol=p))
+             for p in ("tpu_std", "hulu_pbrpc", "sofa_pbrpc")]
+    try:
+        for p, ch in zip(("tpu_std", "hulu_pbrpc", "sofa_pbrpc"), chans):
+            cntl = ch.call_sync("EchoService", "Echo", p.encode())
+            assert not cntl.failed(), f"{p}: {cntl.error_text}"
+            assert cntl.response_payload.to_bytes() == p.encode()
+    finally:
+        for ch in chans:
+            ch.close()
+
+
+def test_unframeable_protocol_rejected(server):
+    _, ep = server
+    ch = Channel(ep, ChannelOptions(protocol="redis"))
+    try:
+        with pytest.raises(ValueError, match="cannot frame"):
+            ch.call_sync("EchoService", "Echo", b"x")
+    finally:
+        ch.close()
