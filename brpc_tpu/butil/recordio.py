@@ -1,0 +1,91 @@
+"""recordio: length-prefixed, checksummed record stream with corruption
+resync (butil/recordio.{h,cc} — the record format under rpc_dump's
+original file layout).
+
+Record layout (re-designed, documented):
+    "RIO1" | meta_size:u32be | data_size:u32be | crc32c:u32be | meta | data
+crc covers meta+data. A Reader that hits a bad crc or garbage scans
+forward to the next magic — one torn write loses one record, not the
+file."""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, NamedTuple, Optional
+
+from brpc_tpu.butil.hash import crc32c
+
+MAGIC = b"RIO1"
+_HDR = struct.Struct(">4sIII")
+HEADER_SIZE = 16
+_MAX_RECORD = 256 << 20
+
+
+class Record(NamedTuple):
+    meta: bytes
+    data: bytes
+
+
+class RecordWriter:
+    def __init__(self, fobj):
+        self._f = fobj
+
+    def write(self, data: bytes, meta: bytes = b"") -> None:
+        data = bytes(data)
+        meta = bytes(meta)
+        crc = crc32c(meta + data)
+        self._f.write(_HDR.pack(MAGIC, len(meta), len(data), crc))
+        self._f.write(meta)
+        self._f.write(data)
+
+    def flush(self) -> None:
+        self._f.flush()
+
+
+class RecordReader:
+    """Iterates valid records; silently resyncs past corruption (the
+    reference's Reader returns false for the bad record and continues).
+    ``self.skipped_bytes`` counts what resync threw away."""
+
+    def __init__(self, fobj):
+        self._buf = fobj.read()
+        self._pos = 0
+        self.skipped_bytes = 0
+
+    def __iter__(self) -> Iterator[Record]:
+        return self
+
+    def __next__(self) -> Record:
+        r = self.read()
+        if r is None:
+            raise StopIteration
+        return r
+
+    def read(self) -> Optional[Record]:
+        while True:
+            idx = self._buf.find(MAGIC, self._pos)
+            if idx < 0:
+                self.skipped_bytes += len(self._buf) - self._pos
+                self._pos = len(self._buf)
+                return None
+            self.skipped_bytes += idx - self._pos
+            self._pos = idx
+            if self._pos + HEADER_SIZE > len(self._buf):
+                return None
+            magic, meta_size, data_size, crc = _HDR.unpack_from(
+                self._buf, self._pos)
+            total = meta_size + data_size
+            if total > _MAX_RECORD:
+                self._pos += 1      # false magic / corrupt header: resync
+                continue
+            end = self._pos + HEADER_SIZE + total
+            if end > len(self._buf):
+                return None         # truncated tail (torn final write)
+            meta = self._buf[self._pos + HEADER_SIZE:
+                             self._pos + HEADER_SIZE + meta_size]
+            data = self._buf[self._pos + HEADER_SIZE + meta_size:end]
+            if crc32c(meta + data) != crc:
+                self._pos += 1      # corrupt: scan to next magic
+                continue
+            self._pos = end
+            return Record(bytes(meta), bytes(data))

@@ -138,6 +138,18 @@ class HttpProtocol(Protocol):
             status, ctype, body = await self._route(server, req, socket)
         except Exception as e:
             status, ctype, body = 500, "text/plain", f"error: {e}".encode()
+        from brpc_tpu.rpc.progressive import ProgressiveAttachment
+        if isinstance(body, ProgressiveAttachment):
+            # chunked transfer: headers now, body as the handler feeds it
+            head = (f"HTTP/1.1 {status} OK\r\n"
+                    f"Content-Type: {body.content_type}\r\n"
+                    f"Transfer-Encoding: chunked\r\n"
+                    f"Connection: keep-alive\r\n\r\n").encode()
+            out = IOBuf()
+            out.append(head)
+            socket.write(out)
+            body._bind(socket)
+            return
         if req.keep_alive:
             socket.write(_response(status, body, ctype, True))
         else:
@@ -420,6 +432,10 @@ class HttpProtocol(Protocol):
             status = 400 if cntl.error_code == berr.EREQUEST else 500
             return (status, "text/plain",
                     f"[{cntl.error_code}] {cntl.error_text}".encode())
+        if cntl._progressive is not None:
+            # body arrives in chunks after the handler (progressive
+            # attachment); process() writes the chunked headers
+            return 200, cntl._progressive.content_type, cntl._progressive
         if response is None:
             return 200, "application/json", b"{}"
         if hasattr(response, "SerializeToString") and not isinstance(

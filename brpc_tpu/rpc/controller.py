@@ -84,6 +84,21 @@ class Controller:
         # ---- server side
         self._server_socket = None
         self._response_sender: Optional[Callable] = None
+        self._progressive = None    # ProgressiveAttachment (http chunked)
+        self._session_local = None  # borrowed from the server's data pool
+
+    def create_progressive_attachment(
+            self, content_type: str = "application/octet-stream"):
+        """HTTP chunked-response body fed after the handler returns
+        (progressive_attachment.h); native streams use Stream instead."""
+        from brpc_tpu.rpc.progressive import ProgressiveAttachment
+        self._progressive = ProgressiveAttachment(content_type)
+        return self._progressive
+
+    def session_local_data(self):
+        """Reusable per-request object from ServerOptions.
+        session_local_data_factory (server.h session_local_data)."""
+        return self._session_local
 
     # ---------------------------------------------------------------- names
     @property

@@ -1,0 +1,95 @@
+"""ProgressiveAttachment: stream an unbounded HTTP response body in
+chunks after the RPC handler returns (progressive_attachment.{h,cpp} +
+progressive_reader.h in the reference).
+
+Server handler usage:
+    @svc.method()
+    def Download(cntl, request):
+        pa = cntl.create_progressive_attachment()
+        def feed():
+            for block in blocks:
+                pa.write(block)
+            pa.close()
+        threading.Thread(target=feed).start()   # or a fiber
+        return None       # body comes from the attachment
+
+The HTTP layer sends ``Transfer-Encoding: chunked`` headers and the
+attachment writes chunks straight to the connection; close() sends the
+terminating 0-chunk and keeps the connection alive. (The tpu_std-native
+equivalent of unbounded transfer is the credit-based Stream — this is
+the curl-compatible path.)"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from brpc_tpu.butil.iobuf import IOBuf
+
+
+class ProgressiveAttachment:
+    def __init__(self, content_type: str = "application/octet-stream"):
+        self.content_type = content_type
+        self._lock = threading.Lock()
+        self._socket = None
+        self._pending: List[bytes] = []
+        self._closed = False
+        self._sent_terminator = False
+
+    # ----------------------------------------------------- handler side
+    def write(self, data) -> bool:
+        """Queue/send one chunk; False once closed or the peer is gone."""
+        data = bytes(data)
+        if not data:
+            return not self._closed
+        with self._lock:
+            if self._closed:
+                return False
+            if self._socket is None:
+                self._pending.append(data)
+                return True
+            socket = self._socket
+        return self._write_chunk(socket, data)
+
+    def close(self) -> None:
+        """Terminate the body (0-length chunk). Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            socket = self._socket
+            if socket is None:
+                return      # _bind sends the terminator after the flush
+            self._sent_terminator = True
+        buf = IOBuf()
+        buf.append(b"0\r\n\r\n")
+        socket.write(buf)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -------------------------------------------------------- http side
+    def _bind(self, socket) -> None:
+        """Called by the HTTP layer after response headers are written:
+        flush buffered chunks, and the terminator if already closed."""
+        with self._lock:
+            self._socket = socket
+            pending, self._pending = self._pending, []
+            need_term = self._closed and not self._sent_terminator
+            if need_term:
+                self._sent_terminator = True
+        for data in pending:
+            self._write_chunk(socket, data)
+        if need_term:
+            buf = IOBuf()
+            buf.append(b"0\r\n\r\n")
+            socket.write(buf)
+
+    @staticmethod
+    def _write_chunk(socket, data: bytes) -> bool:
+        buf = IOBuf()
+        buf.append(f"{len(data):x}\r\n".encode())
+        buf.append(data)
+        buf.append(b"\r\n")
+        return socket.write(buf)

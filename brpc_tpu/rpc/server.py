@@ -63,7 +63,9 @@ class ServerOptions:
                  enable_builtin_services: bool = True,
                  redis_service=None, thrift_service=None,
                  nshead_service=None, esp_service=None,
-                 mongo_service_adaptor=None, rtmp_service=None):
+                 mongo_service_adaptor=None, rtmp_service=None,
+                 session_local_data_factory=None,
+                 session_local_data_reset=None):
         self.num_workers = num_workers
         self.max_concurrency = max_concurrency
         self.auth_token = auth_token
@@ -86,6 +88,10 @@ class ServerOptions:
         self.mongo_service_adaptor = mongo_service_adaptor
         # live publish/play relay registry (rtmp.h RtmpService)
         self.rtmp_service = rtmp_service
+        # per-request reusable objects (ServerOptions.
+        # session_local_data_factory, simple_data_pool.h)
+        self.session_local_data_factory = session_local_data_factory
+        self.session_local_data_reset = session_local_data_reset
 
 
 class Server:
@@ -94,6 +100,13 @@ class Server:
         self.options = options or ServerOptions()
         self._control = control or global_control()
         self._messenger = InputMessenger(control=self._control)
+        if self.options.session_local_data_factory is not None:
+            from brpc_tpu.rpc.data_pool import SimpleDataPool
+            self.session_local_pool = SimpleDataPool(
+                self.options.session_local_data_factory,
+                reset=self.options.session_local_data_reset)
+        else:
+            self.session_local_pool = None
         self._services: Dict[str, Service] = {}
         self._listener = None
         self._endpoint: Optional[EndPoint] = None

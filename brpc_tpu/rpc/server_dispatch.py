@@ -133,6 +133,9 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
             finish_span(span, cntl)
             return
 
+    pool = getattr(server, "session_local_pool", None)
+    if pool is not None:
+        cntl._session_local = pool.borrow()
     response = None
     try:
         r = method.handler(cntl, request)
@@ -141,6 +144,10 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
         response = r
     except Exception as e:
         cntl.set_failed(berr.EINTERNAL, f"{type(e).__name__}: {e}")
+    finally:
+        if pool is not None:
+            pool.give_back(cntl._session_local)
+            cntl._session_local = None
 
     latency_us = (time.monotonic_ns() - t0) / 1e3
     server.on_request_end(method_key, latency_us, failed=cntl.failed())

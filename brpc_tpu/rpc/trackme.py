@@ -1,0 +1,84 @@
+"""trackme: version phone-home (brpc/trackme.{h,cpp} — clients ping a
+trackme server at most once per TrackMe interval; the server replies
+with severity + message for known-bad versions).
+
+Disabled by default (flag ``trackme_server`` empty — this environment
+has zero egress anyway); point it at a brpc_tpu server exposing
+``TrackMeService`` to light it up in a pod."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+from brpc_tpu.butil.flags import define_flag, flag
+
+define_flag("trackme_server", "", "address of the trackme server "
+            "(empty = disabled)")
+define_flag("trackme_interval_s", 30.0, "min seconds between pings")
+
+_lock = threading.Lock()
+_last_ping = 0.0
+_last_result: Optional[dict] = None
+
+TRACKME_OK = 0
+TRACKME_WARNING = 1
+TRACKME_FATAL = 2
+
+
+def trackme_service():
+    """Server half: a Service answering pings with per-version verdicts
+    (install with server.add_service(trackme_service()))."""
+    from brpc_tpu import __version__
+    from brpc_tpu.rpc.service import Service
+
+    svc = Service("TrackMeService")
+
+    @svc.method()
+    def Ping(cntl, request):
+        try:
+            info = json.loads(bytes(request) or b"{}")
+        except ValueError:
+            info = {}
+        severity = TRACKME_OK
+        message = ""
+        if info.get("version", __version__) != __version__:
+            severity = TRACKME_WARNING
+            message = (f"peer runs {info.get('version')}, "
+                       f"server runs {__version__}")
+        return json.dumps({"severity": severity, "message": message}).encode()
+
+    return svc
+
+
+def maybe_ping(control=None) -> Optional[dict]:
+    """Client half: rate-limited ping; returns the server verdict or None
+    when disabled/rate-limited/unreachable (failures never disturb the
+    caller — trackme.cpp swallows errors the same way)."""
+    global _last_ping, _last_result
+    server = flag("trackme_server")
+    if not server:
+        return None
+    now = time.monotonic()
+    with _lock:
+        if now - _last_ping < flag("trackme_interval_s"):
+            return _last_result
+        _last_ping = now
+    try:
+        from brpc_tpu import __version__
+        from brpc_tpu.rpc.channel import Channel, ChannelOptions
+        ch = Channel(server, ChannelOptions(timeout_ms=500, max_retry=0),
+                     control=control)
+        cntl = ch.call_sync("TrackMeService", "Ping",
+                            json.dumps({"version": __version__}).encode())
+        ch.close()
+        if cntl.failed():
+            return None
+        result = json.loads(cntl.response_payload.to_bytes())
+        with _lock:
+            _last_result = result
+        return result
+    except Exception:
+        return None

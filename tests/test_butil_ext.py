@@ -1,0 +1,152 @@
+"""recordio + containers tests (butil/recordio.cc, containers/
+bounded_queue.h, mru_cache.h, case_ignored_flat_map.h)."""
+
+import io
+import threading
+
+import pytest
+
+from brpc_tpu.butil.containers import BoundedQueue, CaseIgnoredDict, MRUCache
+from brpc_tpu.butil.recordio import RecordReader, RecordWriter
+
+
+# ------------------------------------------------------------- recordio
+
+def test_recordio_roundtrip():
+    buf = io.BytesIO()
+    w = RecordWriter(buf)
+    for i in range(10):
+        w.write(f"data-{i}".encode(), meta=f"m{i}".encode())
+    buf.seek(0)
+    records = list(RecordReader(buf))
+    assert len(records) == 10
+    assert records[3] == (b"m3", b"data-3")
+
+
+def test_recordio_resyncs_past_corruption():
+    buf = io.BytesIO()
+    w = RecordWriter(buf)
+    w.write(b"first")
+    mid = buf.tell()
+    w.write(b"second")
+    w.write(b"third")
+    raw = bytearray(buf.getvalue())
+    raw[mid + 18] ^= 0xFF            # flip a byte inside "second"'s body
+    r = RecordReader(io.BytesIO(bytes(raw)))
+    records = list(r)
+    assert [rec.data for rec in records] == [b"first", b"third"]
+    assert r.skipped_bytes > 0
+
+
+def test_recordio_truncated_tail():
+    buf = io.BytesIO()
+    w = RecordWriter(buf)
+    w.write(b"complete")
+    w.write(b"torn-final-record")
+    raw = buf.getvalue()[:-5]        # torn write of the last record
+    records = list(RecordReader(io.BytesIO(raw)))
+    assert [rec.data for rec in records] == [b"complete"]
+
+
+def test_recordio_garbage_prefix():
+    buf = io.BytesIO()
+    buf.write(b"\xde\xad\xbe\xef garbage leader")
+    w = RecordWriter(buf)
+    w.write(b"payload")
+    r = RecordReader(io.BytesIO(buf.getvalue()))
+    assert [rec.data for rec in r] == [b"payload"]
+    assert r.skipped_bytes >= 4
+
+
+# -------------------------------------------------------- bounded queue
+
+def test_bounded_queue():
+    q = BoundedQueue(3)
+    assert q.empty() and not q.full()
+    assert all(q.push(i) for i in range(3))
+    assert q.full() and not q.push(99)
+    assert q.top() == 0
+    assert q.pop() == 0
+    assert q.push(3)
+    assert [q.pop() for _ in range(3)] == [1, 2, 3]
+    assert q.pop() is None
+
+
+def test_bounded_queue_push_force():
+    q = BoundedQueue(2)
+    assert q.push_force(1) is None
+    assert q.push_force(2) is None
+    assert q.push_force(3) == 1      # evicts oldest
+    assert [q.pop(), q.pop()] == [2, 3]
+
+
+def test_bounded_queue_threaded():
+    q = BoundedQueue(64)
+    out = []
+    done = threading.Event()
+
+    def producer():
+        for i in range(1000):
+            while not q.push(i):
+                pass
+        done.set()
+
+    def consumer():
+        while not (done.is_set() and q.empty()):
+            v = q.pop()
+            if v is not None:
+                out.append(v)
+
+    t1 = threading.Thread(target=producer)
+    t2 = threading.Thread(target=consumer)
+    t1.start(); t2.start()
+    t1.join(10); t2.join(10)
+    assert out == list(range(1000))
+
+
+# ------------------------------------------------------------ mru cache
+
+def test_mru_cache_eviction_order():
+    evicted = []
+    c = MRUCache(3, deleter=lambda k, v: evicted.append(k))
+    for k in "abc":
+        c.put(k, k.upper())
+    assert c.get("a") == "A"          # refresh 'a'
+    c.put("d", "D")                   # evicts 'b' (LRU), not 'a'
+    assert evicted == ["b"]
+    assert "b" not in c and "a" in c
+
+
+def test_mru_cache_erase_and_clear():
+    evicted = []
+    c = MRUCache(4, deleter=lambda k, v: evicted.append((k, v)))
+    c.put("x", 1)
+    c.put("y", 2)
+    assert c.erase("x") is True
+    assert c.erase("x") is False
+    c.clear()
+    assert evicted == [("x", 1), ("y", 2)]
+    assert len(c) == 0
+
+
+def test_mru_cache_peek_no_refresh():
+    c = MRUCache(2)
+    c.put("a", 1)
+    c.put("b", 2)
+    c.peek("a")                       # must NOT refresh recency
+    c.put("c", 3)                     # evicts 'a'
+    assert "a" not in c
+
+
+# ---------------------------------------------------- case-ignored dict
+
+def test_case_ignored_dict():
+    d = CaseIgnoredDict({"Content-Type": "text/plain"})
+    assert d["content-type"] == "text/plain"
+    assert d.get("CONTENT-TYPE") == "text/plain"
+    d["X-Foo"] = 1
+    assert "x-foo" in d and "X-FOO" in d
+    del d["x-FOO"]
+    assert "x-foo" not in d
+    d.update({"Accept": "a"})
+    assert d.pop("ACCEPT") == "a"

@@ -1,0 +1,160 @@
+"""Progressive attachment / session-local data pool / trackme tests
+(progressive_attachment.*, simple_data_pool.*, trackme.* in the
+reference)."""
+
+import http.client
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.butil.flags import set_flag
+from brpc_tpu.rpc import Channel, Server, ServerOptions, Service
+from brpc_tpu.rpc.data_pool import SimpleDataPool
+from brpc_tpu.rpc.trackme import maybe_ping, trackme_service
+
+_name_seq = iter(range(10_000))
+
+
+# ------------------------------------------------- progressive attachment
+
+def test_progressive_http_chunked():
+    server = Server()
+    svc = Service("FileService")
+
+    @svc.method()
+    def Download(cntl, request):
+        pa = cntl.create_progressive_attachment("text/plain")
+
+        def feed():
+            for i in range(5):
+                pa.write(f"block-{i};".encode())
+                time.sleep(0.01)
+            pa.close()
+
+        threading.Thread(target=feed, daemon=True).start()
+        return None
+
+    server.add_service(svc)
+    ep = server.start("tcp://127.0.0.1:0")
+    try:
+        conn = http.client.HTTPConnection(ep.host, ep.port, timeout=5)
+        conn.request("POST", "/FileService/Download")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Transfer-Encoding") == "chunked"
+        body = resp.read()      # http.client de-chunks
+        assert body == b"".join(f"block-{i};".encode() for i in range(5))
+        # connection stays usable (keep-alive after the 0-chunk)
+        conn.request("GET", "/health")
+        assert conn.getresponse().read() == b"OK"
+        conn.close()
+    finally:
+        server.stop()
+        server.join(2)
+
+
+def test_progressive_write_before_bind_buffers():
+    server = Server()
+    svc = Service("S")
+
+    @svc.method()
+    def Pre(cntl, request):
+        pa = cntl.create_progressive_attachment()
+        # written BEFORE the http layer binds the socket: must buffer
+        pa.write(b"early-")
+        pa.write(b"bytes")
+        pa.close()
+        return None
+
+    server.add_service(svc)
+    ep = server.start("tcp://127.0.0.1:0")
+    try:
+        conn = http.client.HTTPConnection(ep.host, ep.port, timeout=5)
+        conn.request("POST", "/S/Pre")
+        assert conn.getresponse().read() == b"early-bytes"
+        conn.close()
+    finally:
+        server.stop()
+        server.join(2)
+
+
+def test_progressive_write_after_close_fails():
+    from brpc_tpu.rpc.progressive import ProgressiveAttachment
+    pa = ProgressiveAttachment()
+    assert pa.write(b"x") is True
+    pa.close()
+    assert pa.write(b"y") is False
+    pa.close()   # idempotent
+
+
+# ------------------------------------------------------ simple data pool
+
+def test_simple_data_pool_reuse():
+    created = []
+
+    class Ctx:
+        def __init__(self):
+            created.append(self)
+            self.uses = 0
+
+    pool = SimpleDataPool(Ctx, reset=lambda c: None, max_free=4)
+    a = pool.borrow()
+    pool.give_back(a)
+    b = pool.borrow()
+    assert b is a                 # recycled, not re-created
+    assert pool.ncreated == 1
+
+
+def test_session_local_data_end_to_end():
+    seen_ids = []
+
+    class Ctx:
+        pass
+
+    server = Server(ServerOptions(session_local_data_factory=Ctx))
+    svc = Service("S")
+
+    @svc.method()
+    def Use(cntl, request):
+        ctx = cntl.session_local_data()
+        assert isinstance(ctx, Ctx)
+        seen_ids.append(id(ctx))
+        return b"ok"
+
+    server.add_service(svc)
+    ep = server.start(f"mem://pool-{next(_name_seq)}")
+    ch = Channel(ep)
+    try:
+        for _ in range(5):
+            assert not ch.call_sync("S", "Use", b"").failed()
+        # sequential requests reuse one pooled object
+        assert len(set(seen_ids)) == 1
+        assert server.session_local_pool.ncreated == 1
+    finally:
+        ch.close()
+        server.stop()
+        server.join(2)
+
+
+# --------------------------------------------------------------- trackme
+
+def test_trackme_disabled_by_default():
+    assert maybe_ping() is None
+
+
+def test_trackme_ping_roundtrip():
+    server = Server()
+    server.add_service(trackme_service())
+    ep = server.start(f"mem://trackme-{next(_name_seq)}")
+    set_flag("trackme_server", str(ep))
+    try:
+        verdict = maybe_ping()
+        assert verdict is not None
+        assert verdict["severity"] == 0
+        # rate limited: second call returns the cached verdict
+        assert maybe_ping() is verdict or maybe_ping() == verdict
+    finally:
+        set_flag("trackme_server", "")
+        server.stop()
+        server.join(2)
