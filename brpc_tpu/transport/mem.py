@@ -31,6 +31,9 @@ class _MemPipe:
 
 class MemConn(Conn):
     supports_device_lane = True
+    # mem pipes never block the writer (bounded only by _MAX_BUFFER):
+    # Socket.write may run inline in the caller's context
+    inline_write_ok = True
 
     def __init__(self, rx: _MemPipe, tx: _MemPipe, local: EndPoint, remote: EndPoint):
         self._rx = rx
