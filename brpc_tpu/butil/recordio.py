@@ -45,11 +45,18 @@ class RecordWriter:
 class RecordReader:
     """Iterates valid records; silently resyncs past corruption (the
     reference's Reader returns false for the bad record and continues).
-    ``self.skipped_bytes`` counts what resync threw away."""
+    ``self.skipped_bytes`` counts what resync threw away.
+
+    Streams from the file object — memory stays bounded by the largest
+    record, not the file size (dump files reach hundreds of MB)."""
+
+    _CHUNK = 256 << 10
 
     def __init__(self, fobj):
-        self._buf = fobj.read()
+        self._f = fobj
+        self._buf = bytearray()
         self._pos = 0
+        self._eof = False
         self.skipped_bytes = 0
 
     def __iter__(self) -> Iterator[Record]:
@@ -61,31 +68,59 @@ class RecordReader:
             raise StopIteration
         return r
 
+    def _compact(self) -> None:
+        if self._pos > self._CHUNK:
+            del self._buf[:self._pos]
+            self._pos = 0
+
+    def _fill(self, need: int) -> bool:
+        """Ensure ``need`` bytes are available from _pos; False at EOF."""
+        while len(self._buf) - self._pos < need and not self._eof:
+            chunk = self._f.read(self._CHUNK)
+            if not chunk:
+                self._eof = True
+                break
+            self._buf += chunk
+        return len(self._buf) - self._pos >= need
+
     def read(self) -> Optional[Record]:
         while True:
-            idx = self._buf.find(MAGIC, self._pos)
-            if idx < 0:
+            self._compact()
+            if not self._fill(len(MAGIC)):
                 self.skipped_bytes += len(self._buf) - self._pos
                 self._pos = len(self._buf)
                 return None
+            idx = self._buf.find(MAGIC, self._pos)
+            while idx < 0:
+                # keep a magic-sized tail: the magic may straddle reads
+                keep = len(self._buf) - (len(MAGIC) - 1)
+                if keep > self._pos:
+                    self.skipped_bytes += keep - self._pos
+                    self._pos = keep
+                self._compact()
+                if self._eof:
+                    self.skipped_bytes += len(self._buf) - self._pos
+                    self._pos = len(self._buf)
+                    return None
+                self._fill(len(self._buf) - self._pos + 1)
+                idx = self._buf.find(MAGIC, self._pos)
             self.skipped_bytes += idx - self._pos
             self._pos = idx
-            if self._pos + HEADER_SIZE > len(self._buf):
-                return None
+            if not self._fill(HEADER_SIZE):
+                return None         # truncated tail (torn final write)
             magic, meta_size, data_size, crc = _HDR.unpack_from(
                 self._buf, self._pos)
             total = meta_size + data_size
             if total > _MAX_RECORD:
                 self._pos += 1      # false magic / corrupt header: resync
                 continue
-            end = self._pos + HEADER_SIZE + total
-            if end > len(self._buf):
-                return None         # truncated tail (torn final write)
-            meta = self._buf[self._pos + HEADER_SIZE:
-                             self._pos + HEADER_SIZE + meta_size]
-            data = self._buf[self._pos + HEADER_SIZE + meta_size:end]
+            if not self._fill(HEADER_SIZE + total):
+                return None         # truncated tail
+            start = self._pos + HEADER_SIZE
+            meta = bytes(self._buf[start:start + meta_size])
+            data = bytes(self._buf[start + meta_size:start + total])
             if crc32c(meta + data) != crc:
                 self._pos += 1      # corrupt: scan to next magic
                 continue
-            self._pos = end
-            return Record(bytes(meta), bytes(data))
+            self._pos += HEADER_SIZE + total
+            return Record(meta, data)

@@ -141,14 +141,22 @@ class HttpProtocol(Protocol):
         from brpc_tpu.rpc.progressive import ProgressiveAttachment
         if isinstance(body, ProgressiveAttachment):
             # chunked transfer: headers now, body as the handler feeds it
+            conn_hdr = "keep-alive" if req.keep_alive else "close"
             head = (f"HTTP/1.1 {status} OK\r\n"
                     f"Content-Type: {body.content_type}\r\n"
                     f"Transfer-Encoding: chunked\r\n"
-                    f"Connection: keep-alive\r\n\r\n").encode()
+                    f"Connection: {conn_hdr}\r\n\r\n").encode()
             out = IOBuf()
             out.append(head)
             socket.write(out)
             body._bind(socket)
+            # hold the per-connection drain here until the body completes:
+            # a pipelined request behind us would otherwise interleave its
+            # response into the open chunked stream
+            await body.wait_finished()
+            if not req.keep_alive and not socket.failed:
+                socket.write(IOBuf(), on_done=lambda ok: socket.set_failed(
+                    ConnectionError("http connection: close")))
             return
         if req.keep_alive:
             socket.write(_response(status, body, ctype, True))

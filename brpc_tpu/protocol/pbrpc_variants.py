@@ -45,16 +45,18 @@ class SofaPbrpcProtocol(TpuStdProtocol):
     def frame(self, meta, payload, attachment=None, device_arrays=None,
               device_lane=False):
         # reuse tpu_std body building (device payload inlining included),
-        # then swap the 12-byte header for sofa's 16-byte one
+        # then swap the 12-byte header for sofa's 16-byte one — header
+        # only, never flattening the body (zero-copy preserved)
         wire, lane = pack_message(meta, payload, attachment=attachment,
                                   device_arrays=device_arrays,
                                   device_lane=device_lane, magic=b"\x00\x00\x00\x00")
-        raw = wire.to_bytes()
-        _magic, body_size, meta_size = struct.unpack(">4sII", raw[:12])
+        _magic, body_size, meta_size = struct.unpack(
+            ">4sII", wire.peek_bytes(12))
+        wire.pop_front(12)
         out = IOBuf()
         out.append(_SOFA_HDR.pack(self.MAGIC, meta_size,
                                   body_size - meta_size, 0))
-        out.append(raw[12:])
+        out.append_buf(wire)
         return out, lane
 
     def parse(self, portal, socket) -> Tuple[str, object]:
@@ -74,6 +76,12 @@ class SofaPbrpcProtocol(TpuStdProtocol):
         meta = pb.RpcMeta()
         meta.ParseFromString(portal.cut(meta_size).to_bytes())
         att_size = meta.attachment_size
+        if att_size < 0 or att_size > data_size:
+            # a lying attachment_size would eat the next frame's bytes and
+            # desync the whole connection: fail it instead
+            socket.set_failed(ConnectionError(
+                f"sofa frame attachment_size {att_size} > data {data_size}"))
+            return PARSE_NOT_ENOUGH_DATA, None
         payload = portal.cut(data_size - att_size)
         attachment = portal.cut(att_size)
         device_arrays = []

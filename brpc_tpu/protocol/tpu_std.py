@@ -159,6 +159,12 @@ class TpuStdProtocol(Protocol):
         meta = pb.RpcMeta()
         meta.ParseFromString(portal.cut(meta_size).to_bytes())
         att_size = meta.attachment_size
+        if att_size < 0 or meta_size + att_size > body_size:
+            # a lying attachment_size would eat the next frame's bytes and
+            # desync the whole connection: fail it instead
+            socket.set_failed(ConnectionError(
+                f"frame attachment_size {att_size} exceeds body"))
+            return PARSE_NOT_ENOUGH_DATA, None
         payload = portal.cut(body_size - meta_size - att_size)
         attachment = portal.cut(att_size)
         device_arrays: List = []

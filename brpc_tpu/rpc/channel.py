@@ -76,6 +76,7 @@ class Channel:
         self._socket: Optional[Socket] = None
         self._socket_lock = threading.Lock()
         self._endpoint: Optional[EndPoint] = None
+        self._framer_cache = None
         if address is not None:
             self.init(address)
 
@@ -187,16 +188,24 @@ class Channel:
     # ------------------------------------------------------------ internals
     def _framer(self):
         """Wire framing per ChannelOptions.protocol: tpu_std (default) or
-        a frame-capable variant (hulu_pbrpc/sofa_pbrpc)."""
+        a frame-capable variant (hulu_pbrpc/sofa_pbrpc). Resolved once —
+        the protocol is fixed for the channel's lifetime and this sits on
+        the per-issue hot path."""
+        framer = self._framer_cache
+        if framer is not None:
+            return framer
         if self.options.protocol in ("", "tpu_std"):
-            return pack_message
-        from brpc_tpu.protocol.registry import find_protocol
-        proto = find_protocol(self.options.protocol)
-        framer = getattr(proto, "frame", None)
-        if framer is None:
-            raise ValueError(
-                f"protocol {self.options.protocol!r} cannot frame Channel "
-                f"requests (use RedisClient/GrpcChannel/... for it)")
+            framer = pack_message
+        else:
+            from brpc_tpu.protocol.registry import find_protocol
+            proto = find_protocol(self.options.protocol)
+            framer = getattr(proto, "frame", None)
+            if framer is None:
+                raise ValueError(
+                    f"protocol {self.options.protocol!r} cannot frame "
+                    f"Channel requests (use RedisClient/GrpcChannel/... "
+                    f"for it)")
+        self._framer_cache = framer
         return framer
 
     def _pick_socket(self, cntl: Controller) -> Socket:

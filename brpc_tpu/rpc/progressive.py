@@ -14,10 +14,14 @@ Server handler usage:
         return None       # body comes from the attachment
 
 The HTTP layer sends ``Transfer-Encoding: chunked`` headers and the
-attachment writes chunks straight to the connection; close() sends the
-terminating 0-chunk and keeps the connection alive. (The tpu_std-native
-equivalent of unbounded transfer is the credit-based Stream — this is
-the curl-compatible path.)"""
+attachment writes chunks to the connection; close() sends the
+terminating 0-chunk. All state transitions (buffer -> bound -> closed)
+happen under one lock so a feeder racing _bind can never reorder chunks
+or emit the terminator before buffered data. ``wait_finished`` lets the
+HTTP drain fiber hold the connection until the body is complete —
+pipelined requests behind a progressive response must not interleave.
+(The tpu_std-native equivalent of unbounded transfer is the credit-based
+Stream — this is the curl-compatible path.)"""
 
 from __future__ import annotations
 
@@ -25,6 +29,7 @@ import threading
 from typing import List, Optional
 
 from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.fiber.sync import FiberEvent
 
 
 class ProgressiveAttachment:
@@ -34,7 +39,7 @@ class ProgressiveAttachment:
         self._socket = None
         self._pending: List[bytes] = []
         self._closed = False
-        self._sent_terminator = False
+        self._finished = FiberEvent()   # terminator written (or conn dead)
 
     # ----------------------------------------------------- handler side
     def write(self, data) -> bool:
@@ -48,8 +53,10 @@ class ProgressiveAttachment:
             if self._socket is None:
                 self._pending.append(data)
                 return True
-            socket = self._socket
-        return self._write_chunk(socket, data)
+            # chunk write under the lock: serializes against _bind's
+            # pending flush and close's terminator (socket.write only
+            # enqueues, so holding the lock is cheap)
+            return self._write_chunk(self._socket, data)
 
     def close(self) -> None:
         """Terminate the body (0-length chunk). Idempotent."""
@@ -57,13 +64,10 @@ class ProgressiveAttachment:
             if self._closed:
                 return
             self._closed = True
-            socket = self._socket
-            if socket is None:
+            if self._socket is None:
                 return      # _bind sends the terminator after the flush
-            self._sent_terminator = True
-        buf = IOBuf()
-        buf.append(b"0\r\n\r\n")
-        socket.write(buf)
+            self._send_terminator(self._socket)
+        self._finished.set()
 
     @property
     def closed(self) -> bool:
@@ -72,19 +76,29 @@ class ProgressiveAttachment:
     # -------------------------------------------------------- http side
     def _bind(self, socket) -> None:
         """Called by the HTTP layer after response headers are written:
-        flush buffered chunks, and the terminator if already closed."""
+        flush buffered chunks — and the terminator if already closed —
+        atomically, so concurrent write()/close() order behind us."""
         with self._lock:
             self._socket = socket
-            pending, self._pending = self._pending, []
-            need_term = self._closed and not self._sent_terminator
-            if need_term:
-                self._sent_terminator = True
-        for data in pending:
-            self._write_chunk(socket, data)
-        if need_term:
-            buf = IOBuf()
-            buf.append(b"0\r\n\r\n")
-            socket.write(buf)
+            for data in self._pending:
+                self._write_chunk(socket, data)
+            self._pending = []
+            done = self._closed
+            if done:
+                self._send_terminator(socket)
+        socket.on_failed(lambda _s: self._finished.set())
+        if done:
+            self._finished.set()
+
+    async def wait_finished(self) -> None:
+        """Await body completion (terminator sent or connection dead)."""
+        await self._finished.wait()
+
+    @staticmethod
+    def _send_terminator(socket) -> None:
+        buf = IOBuf()
+        buf.append(b"0\r\n\r\n")
+        socket.write(buf)
 
     @staticmethod
     def _write_chunk(socket, data: bytes) -> bool:

@@ -62,10 +62,12 @@ def maybe_ping(control=None) -> Optional[dict]:
     if not server:
         return None
     now = time.monotonic()
+    interval = flag("trackme_interval_s")
     with _lock:
-        if now - _last_ping < flag("trackme_interval_s"):
+        if now - _last_ping < interval:
             return _last_result
         _last_ping = now
+    ok = False
     try:
         from brpc_tpu import __version__
         from brpc_tpu.rpc.channel import Channel, ChannelOptions
@@ -79,6 +81,15 @@ def maybe_ping(control=None) -> Optional[dict]:
         result = json.loads(cntl.response_payload.to_bytes())
         with _lock:
             _last_result = result
+        ok = True
         return result
     except Exception:
         return None
+    finally:
+        if not ok:
+            # a transient failure must not burn the whole interval, but
+            # also must not hammer a dead server: retry after a short
+            # backoff instead
+            retry_after = min(5.0, interval)
+            with _lock:
+                _last_ping = now - max(0.0, interval - retry_after)

@@ -150,3 +150,54 @@ def test_case_ignored_dict():
     assert "x-foo" not in d
     d.update({"Accept": "a"})
     assert d.pop("ACCEPT") == "a"
+
+
+def test_recordio_streaming_bounded_memory():
+    # reader must not slurp the file: feed via an object whose read()
+    # hands out small chunks and counts calls
+    buf = io.BytesIO()
+    w = RecordWriter(buf)
+    for i in range(50):
+        w.write(bytes([i]) * 1000)
+
+    class CountingFile:
+        def __init__(self, data):
+            self.data = data
+            self.pos = 0
+            self.reads = 0
+
+        def read(self, n):
+            self.reads += 1
+            out = self.data[self.pos:self.pos + n]
+            self.pos += len(out)
+            return out
+
+    f = CountingFile(buf.getvalue())
+    r = RecordReader(f)
+    first = r.read()
+    assert first.data == bytes([0]) * 1000
+    # only ~one chunk read so far, not the whole file
+    assert f.pos <= 2 * (256 << 10)
+    rest = list(r)
+    assert len(rest) == 49
+
+
+def test_recordio_magic_straddles_chunk_boundary():
+    buf = io.BytesIO()
+    w = RecordWriter(buf)
+    w.write(b"second")
+    raw = b"\x01" * ((256 << 10) - 2) + buf.getvalue()  # magic straddles
+
+    class F:
+        def __init__(self, data):
+            self.data = data
+            self.pos = 0
+
+        def read(self, n):
+            out = self.data[self.pos:self.pos + n]
+            self.pos += len(out)
+            return out
+
+    r = RecordReader(F(raw))
+    assert r.read().data == b"second"
+    assert r.skipped_bytes >= (256 << 10) - 2 - 3

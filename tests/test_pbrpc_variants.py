@@ -95,3 +95,35 @@ def test_unframeable_protocol_rejected(server):
             ch.call_sync("EchoService", "Echo", b"x")
     finally:
         ch.close()
+
+
+def test_corrupt_attachment_size_fails_connection():
+    # a frame whose meta lies about attachment_size must kill the conn,
+    # not desync it (both tpu_std and sofa layouts)
+    import struct as _struct
+
+    from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
+    from brpc_tpu.protocol.registry import PARSE_NOT_ENOUGH_DATA
+    from brpc_tpu.protocol.tpu_std import TpuStdProtocol
+    from brpc_tpu.butil.iobuf import IOBuf
+
+    class FakeSocket:
+        user_data: dict = {}
+        failed = False
+
+        def set_failed(self, reason=None):
+            self.failed = True
+
+        def take_device_payload(self):
+            return None
+
+    meta = pb.RpcMeta()
+    meta.correlation_id = 1
+    meta.attachment_size = 999      # lie: way beyond the body
+    mb = meta.SerializeToString()
+    body = mb + b"xx"
+    portal = IOBuf()
+    portal.append(_struct.pack(">4sII", b"TRPC", len(body), len(mb)) + body)
+    sock = FakeSocket()
+    status, msg = TpuStdProtocol().parse(portal, sock)
+    assert status == PARSE_NOT_ENOUGH_DATA and sock.failed

@@ -158,3 +158,88 @@ def test_trackme_ping_roundtrip():
         set_flag("trackme_server", "")
         server.stop()
         server.join(2)
+
+
+def test_progressive_pipelined_request_does_not_interleave():
+    import socket as pysock
+
+    server = Server()
+    svc = Service("S")
+    release = threading.Event()
+
+    @svc.method()
+    def Slow(cntl, request):
+        pa = cntl.create_progressive_attachment("text/plain")
+
+        def feed():
+            pa.write(b"AAAA")
+            release.wait(5)
+            pa.write(b"BBBB")
+            pa.close()
+
+        threading.Thread(target=feed, daemon=True).start()
+        return None
+
+    @svc.method()
+    def Fast(cntl, request):
+        return b"fast-reply"
+
+    server.add_service(svc)
+    ep = server.start("tcp://127.0.0.1:0")
+    try:
+        s = pysock.create_connection((ep.host, ep.port), timeout=10)
+        # pipeline: progressive request A, then plain request B
+        s.sendall(b"POST /S/Slow HTTP/1.1\r\nHost: x\r\nContent-Length: 0\r\n\r\n"
+                  b"POST /S/Fast HTTP/1.1\r\nHost: x\r\nContent-Length: 0\r\n\r\n")
+        time.sleep(0.3)
+        release.set()       # let A finish AFTER B was pipelined behind it
+        data = b""
+        s.settimeout(5)
+        while b"fast-reply" not in data:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+        s.close()
+        # A's entire chunked body must come before B's status line
+        a_end = data.index(b"0\r\n\r\n")
+        assert b"AAAA" in data[:a_end] and b"BBBB" in data[:a_end]
+        b_start = data.index(b"fast-reply")
+        assert b_start > a_end
+    finally:
+        server.stop()
+        server.join(2)
+
+
+def test_progressive_connection_close_honored():
+    import socket as pysock
+
+    server = Server()
+    svc = Service("S")
+
+    @svc.method()
+    def Dl(cntl, request):
+        pa = cntl.create_progressive_attachment()
+        pa.write(b"x" * 10)
+        pa.close()
+        return None
+
+    server.add_service(svc)
+    ep = server.start("tcp://127.0.0.1:0")
+    try:
+        s = pysock.create_connection((ep.host, ep.port), timeout=5)
+        s.sendall(b"POST /S/Dl HTTP/1.1\r\nHost: x\r\n"
+                  b"Connection: close\r\nContent-Length: 0\r\n\r\n")
+        data = b""
+        s.settimeout(5)
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break               # server closed, as requested
+            data += chunk
+        s.close()
+        assert b"Connection: close" in data
+        assert data.endswith(b"0\r\n\r\n")
+    finally:
+        server.stop()
+        server.join(2)
