@@ -16,14 +16,25 @@ from brpc_tpu.fiber.scheduler import current_fiber
 
 class FiberMutex:
     """bthread_mutex: butex-based; never blocks the worker thread when
-    contended from a fiber (the fiber suspends instead)."""
+    contended from a fiber (the fiber suspends instead).
+
+    Contended acquisitions are sampled into the contention profiler
+    (the reference hooks the same way inside bthread/mutex.cpp —
+    bounded by the collector's per-second budget, so the hot path pays
+    one CAS when uncontended and one submit attempt when contended)."""
 
     def __init__(self):
         self._butex = Butex(0)  # 0 = unlocked, 1 = locked
 
     async def lock(self):
+        if self._butex.compare_exchange(0, 1):
+            return
+        from brpc_tpu.fiber.contention import record_contention
+        import time
+        t0 = time.monotonic_ns()
         while not self._butex.compare_exchange(0, 1):
             await self._butex.wait(expected=1)
+        record_contention(self, (time.monotonic_ns() - t0) / 1e3)
 
     def unlock(self):
         self._butex.set_value(0)
@@ -31,12 +42,17 @@ class FiberMutex:
 
     def lock_pthread(self, timeout_s: Optional[float] = None) -> bool:
         import time
+        if self._butex.compare_exchange(0, 1):
+            return True
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        t0 = time.monotonic_ns()
         while not self._butex.compare_exchange(0, 1):
             remain = None if deadline is None else deadline - time.monotonic()
             if remain is not None and remain <= 0:
                 return False
             self._butex.wait_pthread(expected=1, timeout_s=remain)
+        from brpc_tpu.fiber.contention import record_contention
+        record_contention(self, (time.monotonic_ns() - t0) / 1e3)
         return True
 
     async def __aenter__(self):

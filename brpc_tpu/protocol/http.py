@@ -243,6 +243,12 @@ class HttpProtocol(Protocol):
             ).encode()
         if path == "/hotspots" or path == "/pprof/profile":
             return await self._hotspots(req)
+        if path == "/contentions":
+            from brpc_tpu.fiber.contention import contention_report
+            rows = contention_report(int(req.query.get("n", "30")))
+            lines = ["count  total_wait_us  site\n"] + [
+                f"{c:6d} {w:13.1f}  {site}\n" for site, c, w in rows]
+            return 200, "text/plain", "".join(lines).encode()
         if path == "/vlog":
             return self._vlog(req)
         # /Service/Method RPC access

@@ -65,7 +65,8 @@ class ServerOptions:
                  nshead_service=None, esp_service=None,
                  mongo_service_adaptor=None, rtmp_service=None,
                  session_local_data_factory=None,
-                 session_local_data_reset=None):
+                 session_local_data_reset=None,
+                 usercode_in_pthread: bool = False):
         self.num_workers = num_workers
         self.max_concurrency = max_concurrency
         self.auth_token = auth_token
@@ -92,6 +93,9 @@ class ServerOptions:
         # session_local_data_factory, simple_data_pool.h)
         self.session_local_data_factory = session_local_data_factory
         self.session_local_data_reset = session_local_data_reset
+        # run blocking sync handlers on a reserve pthread pool
+        # (usercode_in_pthread + usercode_backup_pool in the reference)
+        self.usercode_in_pthread = usercode_in_pthread
 
 
 class Server:

@@ -138,7 +138,14 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
         cntl._session_local = pool.borrow()
     response = None
     try:
-        r = method.handler(cntl, request)
+        if getattr(server.options, "usercode_in_pthread", False) and \
+                not inspect.iscoroutinefunction(method.handler):
+            # blocking user code runs on the backup pthread pool; this
+            # fiber (and its worker) stays free to pump IO
+            from brpc_tpu.rpc.usercode import run_usercode
+            r = await run_usercode(method.handler, cntl, request)
+        else:
+            r = method.handler(cntl, request)
         if inspect.isawaitable(r):
             r = await r
         response = r

@@ -1,0 +1,52 @@
+"""Usercode backup pool (details/usercode_backup_pool.* +
+usercode_in_pthread in the reference): run blocking user handlers on a
+reserve pthread pool so fiber workers stay free to pump IO.
+
+Enable with ``ServerOptions(usercode_in_pthread=True)`` — sync handlers
+then run on the pool while the dispatch fiber awaits completion; async
+handlers keep running on fibers (they are cooperative already)."""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from brpc_tpu.butil.flags import define_flag, flag
+from brpc_tpu.fiber.sync import FiberEvent
+
+define_flag("usercode_backup_threads", 16,
+            "reserve pthreads for usercode_in_pthread handlers")
+
+_pool: Optional[ThreadPoolExecutor] = None
+_pool_lock = threading.Lock()
+
+
+def _get_pool() -> ThreadPoolExecutor:
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = ThreadPoolExecutor(
+                max_workers=flag("usercode_backup_threads"),
+                thread_name_prefix="usercode")
+        return _pool
+
+
+async def run_usercode(fn, *args):
+    """Run ``fn(*args)`` on the backup pool; the calling fiber suspends
+    (not its worker thread) until done."""
+    done = FiberEvent()
+    box: list = [None, None]
+
+    def run():
+        try:
+            box[0] = fn(*args)
+        except BaseException as e:
+            box[1] = e
+        done.set()
+
+    _get_pool().submit(run)
+    await done.wait()
+    if box[1] is not None:
+        raise box[1]
+    return box[0]
