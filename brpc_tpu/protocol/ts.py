@@ -1,0 +1,221 @@
+"""MPEG-TS muxer (src/brpc/ts.{h,cpp} in the reference, 1477 LoC — the
+HLS leg of the media stack: RTMP/FLV media remuxed into transport
+stream segments).
+
+Covers: 188-byte packets, PAT/PMT with MPEG-2 CRC32, PES packetization
+with PTS (+PCR on the video PID), adaptation-field stuffing, continuity
+counters. Stream types: H.264 video (0x1B), AAC audio (0x0F)."""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, List, NamedTuple, Optional
+
+TS_PACKET_SIZE = 188
+PAT_PID = 0x0000
+PMT_PID = 0x1000
+VIDEO_PID = 0x0100
+AUDIO_PID = 0x0101
+PROGRAM = 1
+STREAM_TYPE_H264 = 0x1B
+STREAM_TYPE_AAC = 0x0F
+_SYNC = 0x47
+
+
+def mpeg_crc32(data: bytes) -> int:
+    """MPEG-2 CRC32: poly 0x04C11DB7, MSB-first, init 0xFFFFFFFF, no
+    final xor, no reflection (different from crc32c)."""
+    crc = 0xFFFFFFFF
+    for byte in data:
+        crc ^= byte << 24
+        for _ in range(8):
+            crc = ((crc << 1) ^ 0x04C11DB7) & 0xFFFFFFFF if crc & 0x80000000 \
+                else (crc << 1) & 0xFFFFFFFF
+    return crc
+
+
+class TsError(Exception):
+    pass
+
+
+def _packet(pid: int, payload: bytes, counter: int, start: bool,
+            adaptation: bytes = b"", pcr: Optional[int] = None):
+    """One 188-byte packet; pads with an adaptation field as needed.
+    Returns (packet_bytes, payload_bytes_consumed)."""
+    if pcr is not None:
+        base = pcr // 300
+        ext = pcr % 300
+        pcr_bytes = struct.pack(">Q", (base << 15) | (0x3F << 9) | ext)[3:]
+        adaptation = bytes([0x10]) + pcr_bytes + adaptation  # PCR flag
+    space = TS_PACKET_SIZE - 4
+    af_len = len(adaptation)
+    has_af = af_len > 0
+    body_space = space - (1 + af_len if has_af else 0)
+    if len(payload) < body_space:
+        # stuff the adaptation field so payload fills to exactly 188
+        pad = body_space - len(payload)
+        if not has_af:
+            if pad == 1:
+                adaptation = b""
+                has_af = True
+                pad = 0
+            else:
+                adaptation = bytes([0x00]) + b"\xff" * (pad - 2)
+                has_af = True
+                pad = 0
+        else:
+            adaptation = adaptation + b"\xff" * pad
+        af_len = len(adaptation)
+        body_space = space - 1 - af_len
+    take = payload[:body_space]
+    header = bytes([
+        _SYNC,
+        (0x40 if start else 0) | (pid >> 8) & 0x1F,
+        pid & 0xFF,
+        (0x30 if has_af else 0x10) | (counter & 0x0F),
+    ])
+    out = header
+    if has_af:
+        out += bytes([af_len]) + adaptation
+    out += take
+    if len(out) != TS_PACKET_SIZE:
+        raise TsError(f"internal: packet size {len(out)}")
+    return out, len(take)
+
+
+def _psi_section(table_id: int, body: bytes) -> bytes:
+    # section_length covers body + crc
+    sec = bytes([table_id]) + \
+        struct.pack(">H", 0xB000 | (len(body) + 4 + 5)) + \
+        struct.pack(">H", PROGRAM) + bytes([0xC1, 0x00, 0x00]) + body
+    return sec + struct.pack(">I", mpeg_crc32(sec))
+
+
+def pat_section() -> bytes:
+    return _psi_section(0x00, struct.pack(">HH", PROGRAM,
+                                          0xE000 | PMT_PID))
+
+
+def pmt_section(has_video: bool = True, has_audio: bool = True) -> bytes:
+    streams = b""
+    if has_video:
+        streams += bytes([STREAM_TYPE_H264]) + \
+            struct.pack(">HH", 0xE000 | VIDEO_PID, 0xF000)
+    if has_audio:
+        streams += bytes([STREAM_TYPE_AAC]) + \
+            struct.pack(">HH", 0xE000 | AUDIO_PID, 0xF000)
+    body = struct.pack(">HH", 0xE000 | VIDEO_PID, 0xF000) + streams
+    return _psi_section(0x02, body)
+
+
+def pes_packet(stream_id: int, payload: bytes, pts_90k: Optional[int]) -> bytes:
+    """PES with optional PTS (90kHz units)."""
+    if pts_90k is None:
+        header_data = b""
+        flags = 0x00
+    else:
+        p = pts_90k & ((1 << 33) - 1)
+        header_data = bytes([
+            0x21 | ((p >> 29) & 0x0E),
+            (p >> 22) & 0xFF,
+            0x01 | ((p >> 14) & 0xFE),
+            (p >> 7) & 0xFF,
+            0x01 | ((p << 1) & 0xFE),
+        ])
+        flags = 0x80
+    length = 3 + len(header_data) + len(payload)
+    if length > 0xFFFF:
+        length = 0      # unbounded (video PES commonly uses 0)
+    return (b"\x00\x00\x01" + bytes([stream_id]) +
+            struct.pack(">H", length) + bytes([0x80, flags,
+                                               len(header_data)]) +
+            header_data + payload)
+
+
+class TsMuxer:
+    """Feed ES frames, collect 188-byte packets. write_tables() first
+    (and at segment boundaries for HLS)."""
+
+    def __init__(self, has_video: bool = True, has_audio: bool = True):
+        self._has_video = has_video
+        self._has_audio = has_audio
+        self._counters = {PAT_PID: 0, PMT_PID: 0, VIDEO_PID: 0,
+                          AUDIO_PID: 0}
+        self.packets: List[bytes] = []
+
+    def _emit(self, pid: int, payload: bytes, pcr: Optional[int] = None):
+        start = True
+        while payload or start:
+            pkt, consumed = _packet(pid, payload, self._counters[pid],
+                                    start, pcr=pcr if start else None)
+            self.packets.append(pkt)
+            payload = payload[consumed:]
+            self._counters[pid] = (self._counters[pid] + 1) & 0x0F
+            start = False
+            pcr = None
+
+    def write_tables(self) -> None:
+        # PSI sections are pointer_field-prefixed
+        self._emit(PAT_PID, b"\x00" + pat_section())
+        self._emit(PMT_PID, b"\x00" + pmt_section(self._has_video,
+                                                  self._has_audio))
+
+    def write_video(self, es: bytes, pts_90k: int) -> None:
+        self._emit(VIDEO_PID, pes_packet(0xE0, es, pts_90k),
+                   pcr=pts_90k * 300)
+
+    def write_audio(self, es: bytes, pts_90k: int) -> None:
+        self._emit(AUDIO_PID, pes_packet(0xC0, es, pts_90k))
+
+    def flush(self) -> bytes:
+        out, self.packets = b"".join(self.packets), []
+        return out
+
+
+# ------------------------------------------------------------- demux (test)
+
+class TsPacket(NamedTuple):
+    pid: int
+    start: bool
+    counter: int
+    payload: bytes
+
+
+def iter_packets(data: bytes) -> Iterator[TsPacket]:
+    if len(data) % TS_PACKET_SIZE:
+        raise TsError("stream not packet-aligned")
+    for off in range(0, len(data), TS_PACKET_SIZE):
+        pkt = data[off:off + TS_PACKET_SIZE]
+        if pkt[0] != _SYNC:
+            raise TsError(f"lost sync at {off}")
+        pid = ((pkt[1] & 0x1F) << 8) | pkt[2]
+        start = bool(pkt[1] & 0x40)
+        counter = pkt[3] & 0x0F
+        pos = 4
+        if pkt[3] & 0x20:           # adaptation field
+            pos += 1 + pkt[4]
+        yield TsPacket(pid, start, counter, pkt[pos:])
+
+
+def extract_pes(data: bytes, pid: int) -> List[bytes]:
+    """Reassembled PES payloads (ES data after the PES header) for a pid."""
+    out: List[bytes] = []
+    cur: Optional[bytearray] = None
+    for pkt in iter_packets(data):
+        if pkt.pid != pid:
+            continue
+        if pkt.start:
+            if cur is not None:
+                out.append(bytes(cur))
+            cur = bytearray(pkt.payload)
+        elif cur is not None:
+            cur += pkt.payload
+    if cur is not None:
+        out.append(bytes(cur))
+    es_out = []
+    for pes in out:
+        if pes[:3] != b"\x00\x00\x01":
+            raise TsError("bad PES start code")
+        header_len = pes[8]
+        es_out.append(bytes(pes[9 + header_len:]))
+    return es_out

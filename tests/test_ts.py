@@ -1,0 +1,86 @@
+"""MPEG-TS muxer tests (src/brpc/ts.{h,cpp}): packet structure, PSI
+CRCs, PES reassembly, continuity counters."""
+
+import struct
+
+import pytest
+
+from brpc_tpu.protocol import ts
+
+
+def test_mpeg_crc32_known_vector():
+    # CRC of an empty PAT-style section must verify round-trip
+    sec = ts.pat_section()
+    body, crc = sec[:-4], struct.unpack(">I", sec[-4:])[0]
+    assert ts.mpeg_crc32(body) == crc
+    # MPEG-2 CRC32 of "123456789" is 0x0376E6E7 (standard check value)
+    assert ts.mpeg_crc32(b"123456789") == 0x0376E6E7
+
+
+def test_packets_are_188_aligned_and_synced():
+    m = ts.TsMuxer()
+    m.write_tables()
+    m.write_video(b"\x00\x00\x00\x01\x65" + b"v" * 1000, pts_90k=90000)
+    m.write_audio(b"\xff\xf1" + b"a" * 300, pts_90k=90000)
+    blob = m.flush()
+    assert len(blob) % ts.TS_PACKET_SIZE == 0
+    pkts = list(ts.iter_packets(blob))
+    assert all(True for _ in pkts)
+    pids = {p.pid for p in pkts}
+    assert {ts.PAT_PID, ts.PMT_PID, ts.VIDEO_PID, ts.AUDIO_PID} <= pids
+
+
+def test_pes_roundtrip_multi_packet():
+    es = bytes(range(256)) * 10          # spans many TS packets
+    m = ts.TsMuxer()
+    m.write_tables()
+    m.write_video(es, pts_90k=123456)
+    blob = m.flush()
+    out = ts.extract_pes(blob, ts.VIDEO_PID)
+    assert out == [es]
+    out_a = ts.extract_pes(blob, ts.AUDIO_PID)
+    assert out_a == []
+
+
+def test_continuity_counters_increment():
+    m = ts.TsMuxer()
+    m.write_tables()
+    for i in range(3):
+        m.write_video(b"x" * 500, pts_90k=i * 3000)
+    blob = m.flush()
+    counters = [p.counter for p in ts.iter_packets(blob)
+                if p.pid == ts.VIDEO_PID]
+    for a, b in zip(counters, counters[1:]):
+        assert b == (a + 1) & 0x0F
+
+
+def test_pts_encoded_in_pes():
+    pes = ts.pes_packet(0xE0, b"data", pts_90k=0x1FFFFFFFF)
+    assert pes[:4] == b"\x00\x00\x01\xe0"
+    flags = pes[7]
+    assert flags & 0x80                 # PTS present
+    # decode the 33-bit PTS back
+    p = pes[9:14]
+    pts = (((p[0] >> 1) & 0x07) << 30) | (p[1] << 22) | \
+        ((p[2] >> 1) << 15) | (p[3] << 7) | (p[4] >> 1)
+    assert pts == 0x1FFFFFFFF
+
+
+def test_demux_rejects_garbage():
+    with pytest.raises(ts.TsError):
+        list(ts.iter_packets(b"\x00" * 188))
+    with pytest.raises(ts.TsError):
+        list(ts.iter_packets(b"\x47" + b"\x00" * 100))   # misaligned
+
+
+def test_flv_to_ts_bridge():
+    """RTMP/FLV media payload carried into TS — the HLS remux path."""
+    from brpc_tpu.protocol import flv
+    tags = [flv.FlvTag(flv.TAG_VIDEO, 0, b"\x17\x01" + b"frame0"),
+            flv.FlvTag(flv.TAG_VIDEO, 40, b"\x27\x01" + b"frame1")]
+    m = ts.TsMuxer(has_audio=False)
+    m.write_tables()
+    for tag in tags:
+        m.write_video(tag.payload[2:], pts_90k=tag.timestamp * 90)
+    blob = m.flush()
+    assert ts.extract_pes(blob, ts.VIDEO_PID) == [b"frame0", b"frame1"]
