@@ -196,8 +196,12 @@ def _send_response(proto, socket, cid: int, cntl: Controller,
                                   device_arrays=cntl.response_device_arrays,
                                   device_lane=use_lane)
     if lane is not None:
-        socket.write_device_payload(lane)
-    socket.write(wire)
+        # adjacent pair under the lane lock (see Channel._issue_rpc)
+        with socket.lane_lock:
+            socket.write_device_payload(lane)
+            socket.write(wire)
+    else:
+        socket.write(wire)
 
 
 def _send_error(proto, socket, cid: int, code: int, text: str) -> None:

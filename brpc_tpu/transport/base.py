@@ -113,3 +113,6 @@ def _register_builtins() -> None:
         if "tpu" not in _transports:
             from brpc_tpu.transport.tpu import TpuTransport
             _transports["tpu"] = TpuTransport()
+        if "tpud" not in _transports:
+            from brpc_tpu.transport.tpud import TpudTransport
+            _transports["tpud"] = TpudTransport()

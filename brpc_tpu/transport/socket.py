@@ -63,6 +63,10 @@ class Socket:
         self._nevent_lock = threading.Lock()
         self.preferred_protocol = -1              # InputMessenger cache
         self.user_data: dict = {}                 # per-conn session state
+        # pairs a device-lane batch with its wire frame: concurrent
+        # device-payload writers must not interleave (lane batches are
+        # matched to messages by FIFO order)
+        self.lane_lock = threading.Lock()
         self._on_failed_cbs: list = []
         self.id: SocketId = _socket_pool.insert(self)
         conn.start_events(self._on_readable_event, self._on_writable_event)

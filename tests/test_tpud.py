@@ -1,0 +1,148 @@
+"""tpud:// cross-host device transport tests: enveloped TCP stream with
+a staged device lane + hello handshake (the DCN slot — SURVEY §2.8's
+'TCP slot' with device payload support; handshake = the RdmaEndpoint
+GID/QPN exchange re-shaped)."""
+
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from brpc_tpu.rpc import Channel, Server, ServerOptions, Service
+from brpc_tpu.transport import tpud
+
+
+# ---------------------------------------------------------------- codec
+
+def test_device_batch_roundtrip():
+    arrays = [np.arange(12, dtype=np.float32).reshape(3, 4),
+              np.array(7, dtype=np.int64),
+              np.zeros((0, 5), dtype=np.uint8)]
+    out = tpud._decode_device_batch(tpud._encode_device_batch(arrays))
+    assert len(out) == 3
+    for a, b in zip(arrays, out):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert np.array_equal(a, b)
+
+
+def test_frame_header_layout():
+    assert tpud._HDR.pack(tpud._F_BYTES, 5) == b"\x00\x00\x00\x00\x05"
+
+
+# ------------------------------------------------------------------ e2e
+
+def make_server():
+    server = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("EchoService")
+
+    @svc.method()
+    def Echo(cntl, request):
+        return request
+
+    @svc.method()
+    def EchoDevice(cntl, request):
+        cntl.response_device_arrays = [
+            np.asarray(a) * 2 for a in cntl.request_device_arrays]
+        return b"dev"
+
+    server.add_service(svc)
+    return server
+
+
+def test_tpud_byte_rpc():
+    server = make_server()
+    ep = server.start("tpud://127.0.0.1:0")
+    assert str(ep).startswith("tpud://")
+    ch = Channel(str(ep))
+    try:
+        cntl = ch.call_sync("EchoService", "Echo", b"over the DCN")
+        assert not cntl.failed(), cntl.error_text
+        assert cntl.response_payload.to_bytes() == b"over the DCN"
+    finally:
+        ch.close()
+        server.stop()
+        server.join(2)
+
+
+def test_tpud_device_lane_rpc():
+    server = make_server()
+    ep = server.start("tpud://127.0.0.1:0#device=0")
+    ch = Channel(str(ep))
+    try:
+        x = np.arange(1024, dtype=np.float32)
+        cntl = ch.call_sync("EchoService", "EchoDevice", b"",
+                            request_device_arrays=[x])
+        assert not cntl.failed(), cntl.error_text
+        assert len(cntl.response_device_arrays) == 1
+        out = np.asarray(cntl.response_device_arrays[0])
+        assert np.array_equal(out, x * 2)
+    finally:
+        ch.close()
+        server.stop()
+        server.join(2)
+
+
+def test_tpud_concurrent_device_calls_no_cross_match():
+    """Concurrent device-payload callers on ONE socket: each must get
+    its own arrays back (lane/wire pairing is locked)."""
+    server = make_server()
+    ep = server.start("tpud://127.0.0.1:0")
+    ch = Channel(str(ep))
+    errs = []
+
+    def worker(i):
+        try:
+            x = np.full((256,), i, dtype=np.int32)
+            for _ in range(20):
+                cntl = ch.call_sync("EchoService", "EchoDevice", b"",
+                                    request_device_arrays=[x])
+                assert not cntl.failed(), cntl.error_text
+                out = np.asarray(cntl.response_device_arrays[0])
+                assert out[0] == i * 2, f"worker {i} got {out[0]}"
+        except Exception as e:      # pragma: no cover
+            errs.append(e)
+
+    try:
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(1, 7)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not errs, errs
+    finally:
+        ch.close()
+        server.stop()
+        server.join(2)
+
+
+def test_tpud_handshake_peer_info():
+    server = make_server()
+    ep = server.start("tpud://127.0.0.1:0#device=0")
+    ch = Channel(str(ep))
+    try:
+        assert not ch.call_sync("EchoService", "Echo", b"hi").failed()
+        conn = ch._socket.conn
+        assert conn.peer_info is not None
+        assert "device" in conn.peer_info      # the hello exchange landed
+    finally:
+        ch.close()
+        server.stop()
+        server.join(2)
+
+
+def test_tpud_large_payload():
+    server = make_server()
+    ep = server.start("tpud://127.0.0.1:0")
+    ch = Channel(str(ep), )
+    try:
+        x = np.random.default_rng(0).random((1 << 18,)).astype(np.float32)
+        cntl = ch.call_sync("EchoService", "EchoDevice", b"",
+                            request_device_arrays=[x])
+        assert not cntl.failed(), cntl.error_text
+        assert np.allclose(np.asarray(cntl.response_device_arrays[0]), x * 2)
+    finally:
+        ch.close()
+        server.stop()
+        server.join(2)
