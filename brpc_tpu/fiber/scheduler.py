@@ -310,14 +310,22 @@ class TaskControl:
 
     # ------------------------------------------------------------- worker
     def _worker(self, group: TaskGroup) -> None:
+        from brpc_tpu.fiber import worker_module
         _tls.group = group
+        worker_module.notify_start(group.index)
         while not self._stop:
+            # co-scheduled engine work first (the fork's EloqModule hook:
+            # TaskGroup::ProcessModulesTask runs before wait_task pops)
+            ran_module = worker_module.process_modules(group.index) \
+                if worker_module.registered_modules() else False
             fiber = group.pop_local()
             if fiber is None:
                 fiber = self._steal(group)
             if fiber is not None:
                 self._step(group, fiber)
                 continue
+            if ran_module:
+                continue          # engine made progress: don't park yet
             expected = self.parking_lot.signal_count()
             # re-check after reading the signal count (no lost wakeups)
             fiber = group.pop_local() or self._steal(group)
@@ -325,6 +333,7 @@ class TaskControl:
                 self._step(group, fiber)
                 continue
             self.parking_lot.wait(expected, timeout=0.5)
+        worker_module.notify_stop(group.index)
         _tls.group = None
 
     def _steal(self, group: TaskGroup) -> Optional[Fiber]:

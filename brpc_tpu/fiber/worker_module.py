@@ -1,0 +1,85 @@
+"""Worker modules: co-schedule an external engine with the fiber workers
+(the fork's EloqModule hook, eloq_module.h:60-64 + TaskGroup::
+NotifyRegisteredModules — modules register process/has_task callbacks
+that every worker's main loop polls, so a database/compute engine shares
+the worker threads instead of fighting them).
+
+    class MyEngine(WorkerModule):
+        def has_task(self): ...
+        def process(self, group_index): ...   # run a slice of work
+    register_module(MyEngine())
+
+``on_worker_start/on_worker_stop`` mirror ExtThdStart/ExtThdEnd."""
+
+from __future__ import annotations
+
+import threading
+from typing import List
+
+
+class WorkerModule:
+    def has_task(self) -> bool:
+        """Cheap check: is there engine work pending?"""
+        return False
+
+    def process(self, group_index: int) -> None:
+        """Run a bounded slice of engine work on this worker."""
+
+    def on_worker_start(self, group_index: int) -> None:
+        """Called once per worker thread before its loop."""
+
+    def on_worker_stop(self, group_index: int) -> None:
+        """Called once per worker thread after its loop."""
+
+
+_modules: List[WorkerModule] = []
+_lock = threading.Lock()
+
+
+def register_module(module: WorkerModule) -> None:
+    with _lock:
+        _modules.append(module)
+
+
+def unregister_module(module: WorkerModule) -> None:
+    with _lock:
+        try:
+            _modules.remove(module)
+        except ValueError:
+            pass
+
+
+def registered_modules() -> List[WorkerModule]:
+    return list(_modules)
+
+
+def process_modules(group_index: int) -> bool:
+    """One pass over registered modules from a worker loop; True if any
+    ran work (the worker then skips parking this round)."""
+    ran = False
+    for m in _modules:
+        try:
+            if m.has_task():
+                m.process(group_index)
+                ran = True
+        except Exception:
+            import logging
+            logging.getLogger("brpc_tpu.fiber").exception(
+                "worker module failed")
+    return ran
+
+
+def notify_start(group_index: int) -> None:
+    for m in _modules:
+        try:
+            m.on_worker_start(group_index)
+        except Exception:
+            pass
+
+
+def notify_stop(group_index: int) -> None:
+    for m in _modules:
+        try:
+            m.on_worker_stop(group_index)
+        except Exception:
+            pass

@@ -17,7 +17,7 @@ calls.
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from brpc_tpu.rpc import errno_codes as berr
 from brpc_tpu.rpc.channel import Channel
@@ -88,7 +88,10 @@ class ParallelChannel:
              done: Optional[Callable] = None, **kw) -> Controller:
         cntl = cntl or Controller()
         cntl._done_cb = done
-        nsub = len(self._subs)
+        # snapshot: DynamicPartitionChannel swaps self._subs atomically on
+        # re-partition; one call must see one consistent generation
+        subs = self._subs
+        nsub = len(subs)
         cntl.sub_responses = [None] * nsub
         cntl.sub_device_arrays = [None] * nsub
         cntl.sub_errors = [None] * nsub
@@ -100,7 +103,7 @@ class ParallelChannel:
         state = {"pending": 0, "failed": 0, "done": False}
         lock = threading.Lock()
         sub_calls = []
-        for i, sub in enumerate(self._subs):
+        for i, sub in enumerate(subs):
             sc = self.call_mapper.map(i, nsub, service, method, request, cntl)
             if sc is None or sc.skip:
                 continue
@@ -245,3 +248,74 @@ class PartitionChannel(ParallelChannel):
     @property
     def partition_count(self) -> int:
         return self.sub_channel_count
+
+
+class DynamicPartitionChannel(PartitionChannel):
+    """PartitionChannel that re-shards as the naming service changes the
+    partition map (partition_channel.h:136 DynamicPartitionChannel +
+    the _dynpart LB). Server entries carry ``#partition=K/N``; on every
+    update the sub-channel list is rebuilt to N partitions, each backed
+    by a list:// cluster of that partition's replicas, and swapped in
+    atomically (in-flight calls keep the generation they started with)."""
+
+    def __init__(self, naming_url: str,
+                 partition_parser: Optional[PartitionParser] = None,
+                 fail_limit: Optional[int] = 1,
+                 response_merger: Optional[ResponseMerger] = None,
+                 options=None, control=None):
+        super().__init__(partition_parser, fail_limit, response_merger)
+        from brpc_tpu.rpc.naming import NamingServiceThread
+        self._options = options
+        self._control = control
+        self._generation = 0
+        self._ready = threading.Event()
+        self._ns = NamingServiceThread(naming_url, control=control)
+        self._ns.watch(self._rebuild)
+
+    def wait_ready(self, timeout_s: float = 5.0) -> bool:
+        return self._ready.wait(timeout_s)
+
+    def _rebuild(self, servers) -> None:
+        from brpc_tpu.rpc.cluster_channel import ClusterChannel
+        by_partition: Dict[int, list] = {}
+        nparts = 0
+        for ep in servers:
+            spec = ep.extra("partition")
+            if not spec or "/" not in spec:
+                continue
+            k_s, n_s = spec.split("/", 1)
+            try:
+                k, n = int(k_s), int(n_s)
+            except ValueError:
+                continue
+            if n <= 0 or not 0 <= k < n:
+                continue
+            nparts = max(nparts, n)
+            by_partition.setdefault(k, []).append(
+                EndPoint(ep.scheme, ep.host, ep.port))
+        new_subs = []
+        for k in range(nparts):
+            eps = by_partition.get(k)
+            if not eps:
+                # a hole in the partition map: serve what we can; calls
+                # hitting the missing shard fail via the empty cluster
+                eps = []
+            url = "list://" + ",".join(str(e) for e in eps)
+            new_subs.append(ClusterChannel(url, "rr", self._options,
+                                           control=self._control))
+        old, self._subs = self._subs, new_subs   # atomic ref swap
+        self._generation += 1
+        self._ready.set()
+        for ch in old:
+            try:
+                ch.close()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self._ns.stop()
+        for ch in self._subs:
+            try:
+                ch.close()
+            except Exception:
+                pass

@@ -97,6 +97,39 @@ class MeshNamingService(NamingService):
         actions.reset_servers(eps)
 
 
+class RemoteFileNamingService(NamingService):
+    """remotefile:// — poll a server list over HTTP (the reference's
+    remote_file_naming_service + the generic shape of its consul/nacos
+    pollers: GET an endpoint, parse one server per line).
+    Param: ``host:port/path``."""
+
+    interval_s = 2.0
+
+    async def run(self, param, actions, stop_event):
+        import http.client
+        hostport, _, path = param.partition("/")
+        host, _, port = hostport.partition(":")
+        last = None
+        while not stop_event.is_set():
+            lines: List[str] = []
+            try:
+                conn = http.client.HTTPConnection(host, int(port or 80),
+                                                  timeout=3)
+                conn.request("GET", "/" + path)
+                resp = conn.getresponse()
+                if resp.status == 200:
+                    lines = [ln.strip() for ln in
+                             resp.read().decode().splitlines()
+                             if ln.strip() and not ln.startswith("#")]
+                conn.close()
+            except (OSError, ValueError):
+                pass   # keep the last good list on fetch failure
+            if lines and lines != last:
+                last = lines
+                actions.reset_servers([str2endpoint(ln) for ln in lines])
+            await sleep(self.interval_s)
+
+
 _registry: Dict[str, NamingService] = {}
 
 
@@ -111,6 +144,7 @@ def get_naming_service(scheme: str) -> NamingService:
             "file": FileNamingService(),
             "dns": DnsNamingService(),
             "mesh": MeshNamingService(),
+            "remotefile": RemoteFileNamingService(),
         })
     ns = _registry.get(scheme)
     if ns is None:

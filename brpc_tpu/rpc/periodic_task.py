@@ -1,0 +1,56 @@
+"""PeriodicTask: run a callback on an interval via the TimerThread
+(brpc/periodic_task.{h,cpp} — health-check/naming/trackme style
+periodic work without a dedicated thread).
+
+The next run is scheduled AFTER the current one completes (fixed delay,
+like the reference — a slow task never stacks up). ``interval_s`` may be
+a callable for adaptive intervals. destroy() stops it."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, Union
+
+from brpc_tpu.fiber.timer import global_timer
+
+
+class PeriodicTask:
+    def __init__(self, fn: Callable[[], None],
+                 interval_s: Union[float, Callable[[], float]],
+                 run_immediately: bool = False):
+        self._fn = fn
+        self._interval = interval_s
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._timer_id: Optional[int] = None
+        if run_immediately:
+            self._run()
+        else:
+            self._schedule()
+
+    def _delay(self) -> float:
+        return self._interval() if callable(self._interval) \
+            else float(self._interval)
+
+    def _schedule(self) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            self._timer_id = global_timer().schedule_after(
+                self._delay(), self._run)
+
+    def _run(self) -> None:
+        try:
+            self._fn()
+        except Exception:
+            import logging
+            logging.getLogger("brpc_tpu.rpc").exception(
+                "periodic task failed")
+        self._schedule()
+
+    def destroy(self) -> None:
+        with self._lock:
+            self._stopped = True
+            tid, self._timer_id = self._timer_id, None
+        if tid is not None:
+            global_timer().unschedule(tid)

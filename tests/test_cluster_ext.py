@@ -1,0 +1,177 @@
+"""Worker modules, DynamicPartitionChannel, remotefile naming,
+PeriodicTask (eloq_module.h, partition_channel.h:136,
+remote_file_naming_service, periodic_task.*)."""
+
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import fiber
+from brpc_tpu.fiber.worker_module import (
+    WorkerModule, register_module, unregister_module)
+from brpc_tpu.rpc import Server, ServerOptions, Service
+from brpc_tpu.rpc.combo_channels import DynamicPartitionChannel
+from brpc_tpu.rpc.periodic_task import PeriodicTask
+
+_name_seq = iter(range(10_000))
+
+
+# --------------------------------------------------------- worker module
+
+def test_worker_module_coscheduled():
+    class Engine(WorkerModule):
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.todo = 0
+            self.done = 0
+            self.started_on = set()
+
+        def on_worker_start(self, gi):
+            self.started_on.add(gi)
+
+        def has_task(self):
+            return self.todo > 0
+
+        def process(self, gi):
+            with self.lock:
+                if self.todo > 0:
+                    self.todo -= 1
+                    self.done += 1
+
+    eng = Engine()
+    control = fiber.TaskControl(concurrency=2, name="modtest")
+    register_module(eng)
+    try:
+        control.start()
+        eng.todo = 50
+        deadline = time.monotonic() + 5
+        while eng.done < 50 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.done == 50
+        # fibers still run alongside the engine
+        out = []
+        f = control.spawn(lambda: out.append("ran"))
+        f.join(5)
+        assert out == ["ran"]
+    finally:
+        unregister_module(eng)
+        control.stop_and_join()
+
+
+# ------------------------------------------------ dynamic partitioning
+
+def make_part_server(tag):
+    server = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("Shard")
+
+    @svc.method()
+    def Which(cntl, request):
+        return tag.encode()
+
+    server.add_service(svc)
+    ep = server.start("tcp://127.0.0.1:0")
+    return server, ep
+
+
+def test_dynamic_partition_channel_reshards(tmp_path):
+    servers = [make_part_server(f"s{i}") for i in range(3)]
+    ns_file = tmp_path / "partitions"
+
+    def write_map(entries):
+        ns_file.write_text("".join(
+            f"tcp://{ep.host}:{ep.port}#partition={k}/{n}\n"
+            for (srv, ep), k, n in entries))
+
+    # generation 1: two partitions
+    write_map([(servers[0], 0, 2), (servers[1], 1, 2)])
+    ch = DynamicPartitionChannel(f"file://{ns_file}")
+    try:
+        assert ch.wait_ready(5)
+        assert ch.partition_count == 2
+        cntl = ch.call_sync("Shard", "Which", b"")
+        assert not cntl.failed(), cntl.error_text
+        assert sorted(cntl.sub_responses) == [b"s0", b"s1"]
+
+        # generation 2: re-shard to three partitions
+        write_map([(servers[0], 0, 3), (servers[1], 1, 3),
+                   (servers[2], 2, 3)])
+        deadline = time.monotonic() + 10
+        while ch.partition_count != 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert ch.partition_count == 3
+        cntl = ch.call_sync("Shard", "Which", b"")
+        assert not cntl.failed(), cntl.error_text
+        assert sorted(cntl.sub_responses) == [b"s0", b"s1", b"s2"]
+    finally:
+        ch.close()
+        for srv, _ in servers:
+            srv.stop()
+            srv.join(2)
+
+
+# ------------------------------------------------------ remotefile naming
+
+def test_remotefile_naming_service():
+    from brpc_tpu.rpc import ClusterChannel
+
+    backend = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("Echo")
+
+    @svc.method()
+    def Hi(cntl, request):
+        return b"hello"
+
+    backend.add_service(svc)
+    bep = backend.start("tcp://127.0.0.1:0")
+
+    # the "remote file" is served by another brpc_tpu server's raw method
+    listsvc = Service("NS")
+
+    @listsvc.method()
+    def servers(cntl, request):
+        return f"tcp://{bep.host}:{bep.port}\n".encode()
+
+    ns_server = Server()
+    ns_server.add_service(listsvc)
+    nep = ns_server.start("tcp://127.0.0.1:0")
+
+    ch = ClusterChannel(f"remotefile://{nep.host}:{nep.port}/NS/servers",
+                        "rr")
+    try:
+        cntl = ch.call_sync("Echo", "Hi", b"")
+        assert not cntl.failed(), cntl.error_text
+        assert cntl.response_payload.to_bytes() == b"hello"
+    finally:
+        ch.close()
+        ns_server.stop()
+        backend.stop()
+        ns_server.join(2)
+        backend.join(2)
+
+
+# ---------------------------------------------------------- periodic task
+
+def test_periodic_task_runs_and_stops():
+    runs = []
+    task = PeriodicTask(lambda: runs.append(time.monotonic()),
+                        interval_s=0.02)
+    time.sleep(0.3)
+    task.destroy()
+    n = len(runs)
+    assert n >= 3
+    time.sleep(0.1)
+    assert len(runs) == n          # destroyed: no more runs
+
+
+def test_periodic_task_survives_exceptions():
+    runs = []
+
+    def flaky():
+        runs.append(1)
+        raise RuntimeError("transient")
+
+    task = PeriodicTask(flaky, interval_s=0.02, run_immediately=True)
+    time.sleep(0.2)
+    task.destroy()
+    assert len(runs) >= 3          # kept rescheduling despite raising
