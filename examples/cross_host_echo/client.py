@@ -1,0 +1,26 @@
+"""Cross-host device-RPC client: sends arrays over the tpud envelope."""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+
+import numpy as np
+
+from brpc_tpu.rpc import Channel, ChannelOptions
+
+
+def main(addr: str = "tpud://127.0.0.1:8750") -> None:
+    ch = Channel(addr, ChannelOptions(timeout_ms=10000))
+    x = np.arange(8, dtype=np.float32)
+    cntl = ch.call_sync("TensorService", "Scale", b"3",
+                        request_device_arrays=[x])
+    assert not cntl.failed(), cntl.error_text
+    out = np.asarray(cntl.response_device_arrays[0])
+    print("sent     ", x)
+    print("received ", out)
+    print("peer info", ch._socket.conn.peer_info)
+    ch.close()
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

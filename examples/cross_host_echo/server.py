@@ -1,0 +1,31 @@
+"""Cross-host device-RPC server (tpud:// — the DCN path): run this on
+one host, client.py on another (or another process on the same host)."""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+
+import numpy as np
+
+from brpc_tpu.rpc import Server, Service
+
+
+def main(addr: str = "tpud://127.0.0.1:8750") -> None:
+    server = Server()
+    svc = Service("TensorService")
+
+    @svc.method()
+    def Scale(cntl, request):
+        factor = float(bytes(request) or b"2")
+        cntl.response_device_arrays = [
+            np.asarray(a) * factor for a in cntl.request_device_arrays]
+        return b"scaled"
+
+    server.add_service(svc)
+    ep = server.start(addr)
+    print(f"tensor server at {ep}", flush=True)
+    server.run_until_asked_to_quit()
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])
