@@ -77,6 +77,9 @@ class Channel:
         self._socket_lock = threading.Lock()
         self._endpoint: Optional[EndPoint] = None
         self._framer_cache = None
+        # pooled-connection_type freelist (socket.h connection pooling)
+        self._conn_pool: List[Socket] = []
+        self._pool_lock = threading.Lock()
         if address is not None:
             self.init(address)
 
@@ -98,12 +101,17 @@ class Channel:
                              _write, _make)
 
     def close(self) -> None:
-        """Release the connection; the channel may be re-used (it will
+        """Release the connection(s); the channel may be re-used (it will
         reconnect lazily)."""
         with self._socket_lock:
             s, self._socket = self._socket, None
         if s is not None and not s.failed:
             s.set_failed(ConnectionError("channel closed"))
+        with self._pool_lock:
+            pool, self._conn_pool = self._conn_pool, []
+        for sock in pool:
+            if not sock.failed:
+                sock.set_failed(ConnectionError("channel closed"))
 
     # ---------------------------------------------------------------- call
     def call(self, service_name: str, method_name: str, request: Any = b"",
@@ -210,8 +218,45 @@ class Channel:
 
     def _pick_socket(self, cntl: Controller) -> Socket:
         """Server/connection selection for one (re)issue; cluster channels
-        override this with LB selection (controller.cpp:1048-1135)."""
-        return self._get_socket()
+        override this with LB selection (controller.cpp:1048-1135).
+        connection_type (socket.h GetPooledSocket/GetShortSocket):
+          single — one multiplexed connection (default)
+          pooled — exclusive connection per in-flight call, returned to
+                   the pool on completion (protocols that can't
+                   interleave, or parallelism past one conn's pipeline)
+          short  — fresh connection per call, closed on completion"""
+        ctype = self.options.connection_type
+        if ctype in ("", "single"):
+            return self._get_socket()
+        if ctype == "pooled":
+            with self._pool_lock:
+                while self._conn_pool:
+                    sock = self._conn_pool.pop()
+                    if not sock.failed:
+                        break
+                else:
+                    sock = None
+            if sock is None:
+                sock = create_client_socket(
+                    self._endpoint, on_input=self._messenger.on_new_messages,
+                    control=self._control)
+
+            def _return(c, s=sock):
+                if not s.failed:
+                    with self._pool_lock:
+                        self._conn_pool.append(s)
+
+            cntl._complete_hooks.append(_return)
+            return sock
+        if ctype == "short":
+            sock = create_client_socket(
+                self._endpoint, on_input=self._messenger.on_new_messages,
+                control=self._control)
+            cntl._complete_hooks.append(
+                lambda c, s=sock: s.failed or s.set_failed(
+                    ConnectionError("short connection done")))
+            return sock
+        raise ValueError(f"unknown connection_type {ctype!r}")
 
     def _issue_rpc(self, cntl: Controller) -> None:
         """Pick socket, pack, enqueue (Controller::IssueRPC,

@@ -391,3 +391,51 @@ class TestTpuEcho:
         finally:
             server.stop()
             server.join(2)
+
+
+class TestConnectionTypes:
+    def test_pooled_connections(self):
+        server = make_echo_server()
+        ep = server.start("tcp://127.0.0.1:0")
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(connection_type="pooled",
+                                    timeout_ms=5000))
+        try:
+            # concurrent slow calls each take their own pooled conn
+            cntls = [ch.call("EchoService", "AsyncEcho", f"p{i}".encode())
+                     for i in range(4)]
+            for i, c in enumerate(cntls):
+                assert c.join(10) and not c.failed(), c.error_text
+                assert c.response_payload.to_bytes() == f"p{i}".encode()
+            # pool retains the connections for reuse
+            assert len(ch._conn_pool) >= 1
+            n_before = len(server.connections())
+            for i in range(4):
+                assert not ch.call_sync("EchoService", "Echo",
+                                        b"reuse").failed()
+            # sequential reuse must not grow the server's conn count
+            assert len(server.connections()) <= n_before
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+    def test_short_connections_close_after_call(self):
+        import time as _time
+        server = make_echo_server()
+        ep = server.start("tcp://127.0.0.1:0")
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(connection_type="short",
+                                    timeout_ms=5000))
+        try:
+            for i in range(3):
+                cntl = ch.call_sync("EchoService", "Echo", b"one-shot")
+                assert not cntl.failed(), cntl.error_text
+            _time.sleep(0.2)
+            # all short conns are gone (server prunes failed sockets)
+            alive = [s for s in server.connections() if not s.failed]
+            assert len(alive) == 0
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
