@@ -82,3 +82,32 @@ class ResourcePool(Generic[T]):
     def __len__(self) -> int:
         with self._lock:
             return len(self._objs) - len(self._free)
+
+
+class ObjectPool(Generic[T]):
+    """Freelist of reusable objects WITHOUT id addressing — the sibling
+    of ResourcePool (butil/object_pool.h): get_object/return_object
+    amortize allocation for types that don't need dense ids."""
+
+    def __init__(self, factory, max_free: int = 1024):
+        self._factory = factory
+        self._max_free = max_free
+        self._free: list = []
+        self._lock = threading.Lock()
+        self.ncreated = 0
+
+    def get_object(self) -> T:
+        with self._lock:
+            if self._free:
+                return self._free.pop()
+            self.ncreated += 1
+        return self._factory()
+
+    def return_object(self, obj: T) -> None:
+        with self._lock:
+            if len(self._free) < self._max_free:
+                self._free.append(obj)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)

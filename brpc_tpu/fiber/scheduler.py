@@ -317,7 +317,7 @@ class TaskControl:
             # co-scheduled engine work first (the fork's EloqModule hook:
             # TaskGroup::ProcessModulesTask runs before wait_task pops)
             ran_module = worker_module.process_modules(group.index) \
-                if worker_module.registered_modules() else False
+                if worker_module.has_modules() else False
             fiber = group.pop_local()
             if fiber is None:
                 fiber = self._steal(group)

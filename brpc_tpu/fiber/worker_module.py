@@ -53,6 +53,11 @@ def registered_modules() -> List[WorkerModule]:
     return list(_modules)
 
 
+def has_modules() -> bool:
+    """Allocation-free emptiness check for the worker hot loop."""
+    return bool(_modules)
+
+
 def process_modules(group_index: int) -> bool:
     """One pass over registered modules from a worker loop; True if any
     ran work (the worker then skips parking this round)."""

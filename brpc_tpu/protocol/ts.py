@@ -45,7 +45,10 @@ def _packet(pid: int, payload: bytes, counter: int, start: bool,
     if pcr is not None:
         base = pcr // 300
         ext = pcr % 300
-        pcr_bytes = struct.pack(">Q", (base << 15) | (0x3F << 9) | ext)[3:]
+        # 48-bit field: 33-bit base | 6 reserved (all-ones) | 9-bit ext =
+        # bytes 2..7 of the 8-byte pack ([3:] would drop the top base byte
+        # once the clock passes ~6 minutes)
+        pcr_bytes = struct.pack(">Q", (base << 15) | (0x3F << 9) | ext)[2:]
         adaptation = bytes([0x10]) + pcr_bytes + adaptation  # PCR flag
     space = TS_PACKET_SIZE - 4
     af_len = len(adaptation)
@@ -104,7 +107,10 @@ def pmt_section(has_video: bool = True, has_audio: bool = True) -> bytes:
     if has_audio:
         streams += bytes([STREAM_TYPE_AAC]) + \
             struct.pack(">HH", 0xE000 | AUDIO_PID, 0xF000)
-    body = struct.pack(">HH", 0xE000 | VIDEO_PID, 0xF000) + streams
+    # PCR must live on a PID that actually carries packets: audio-only
+    # muxes clock off the audio PID
+    pcr_pid = VIDEO_PID if has_video else AUDIO_PID
+    body = struct.pack(">HH", 0xE000 | pcr_pid, 0xF000) + streams
     return _psi_section(0x02, body)
 
 

@@ -13,6 +13,10 @@ from brpc_tpu.rpc.combo_channels import (
 )
 from brpc_tpu.rpc.load_balancer import LoadBalancer, new_load_balancer
 from brpc_tpu.rpc.naming import NamingService, NamingServiceThread, register_naming_service
+from brpc_tpu.rpc.combo_channels import DynamicPartitionChannel
+from brpc_tpu.rpc.periodic_task import PeriodicTask
+from brpc_tpu.rpc.progressive import ProgressiveAttachment
+from brpc_tpu.rpc.data_pool import SimpleDataPool
 from brpc_tpu.rpc.auth import (
     AuthContext, AuthError, Authenticator, InterceptorError,
     TokenAuthenticator,
@@ -26,5 +30,6 @@ __all__ = [
     "LoadBalancer", "new_load_balancer",
     "NamingService", "NamingServiceThread", "register_naming_service",
     "AuthContext", "AuthError", "Authenticator", "InterceptorError",
-    "TokenAuthenticator",
+    "TokenAuthenticator", "DynamicPartitionChannel", "PeriodicTask",
+    "ProgressiveAttachment", "SimpleDataPool",
 ]

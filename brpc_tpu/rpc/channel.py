@@ -291,16 +291,22 @@ class Channel:
         wire, lane = self._framer()(
             meta, request_bytes, attachment=_copy_buf(cntl.request_attachment),
             device_arrays=cntl.request_device_arrays, device_lane=use_lane)
-        if lane is not None:
-            # lane + wire must hit the conn as an adjacent pair: another
-            # device-payload call slipping between them would cross-match
-            # lane batches to the wrong messages on the receiver
-            with sock.lane_lock:
-                sock.write_device_payload(lane)
-                sock.write(wire,
-                           on_done=lambda err: self._on_write_done(cntl, err))
-        else:
-            sock.write(wire, on_done=lambda err: self._on_write_done(cntl, err))
+        try:
+            if lane is not None:
+                # lane + wire must hit the conn as an adjacent pair:
+                # another device-payload call slipping between them would
+                # cross-match lane batches on the receiver
+                with sock.lane_lock:
+                    sock.write_device_payload(lane)
+                    sock.write(wire, on_done=lambda err:
+                               self._on_write_done(cntl, err))
+            else:
+                sock.write(wire, on_done=lambda err:
+                           self._on_write_done(cntl, err))
+        except (BlockingIOError, ConnectionError, OSError) as e:
+            # lane backpressure / dead conn must fail the controller (or
+            # retry), never escape to the caller with the call leaked
+            self._maybe_retry(cntl, berr.EFAILEDSOCKET, str(e))
 
     def _on_write_done(self, cntl: Controller, err: Optional[BaseException]):
         if err is None:

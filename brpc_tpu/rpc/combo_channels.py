@@ -269,6 +269,8 @@ class DynamicPartitionChannel(PartitionChannel):
         self._control = control
         self._generation = 0
         self._ready = threading.Event()
+        self._retired: List[list] = []
+        self._retire_lock = threading.Lock()
         self._ns = NamingServiceThread(naming_url, control=control)
         self._ns.watch(self._rebuild)
 
@@ -306,16 +308,32 @@ class DynamicPartitionChannel(PartitionChannel):
         old, self._subs = self._subs, new_subs   # atomic ref swap
         self._generation += 1
         self._ready.set()
-        for ch in old:
-            try:
-                ch.close()
-            except Exception:
-                pass
+        if old:
+            # in-flight calls still hold the old generation: closing now
+            # would fail their sub-calls mid-flight. Retire after a grace
+            # period instead.
+            from brpc_tpu.fiber.timer import global_timer
+            with self._retire_lock:
+                self._retired.append(old)
+            global_timer().schedule_after(10.0, self._close_retired)
+
+    def _close_retired(self) -> None:
+        with self._retire_lock:
+            gens, self._retired = self._retired[:1], self._retired[1:]
+        for gen in gens:
+            for ch in gen:
+                try:
+                    ch.close()
+                except Exception:
+                    pass
 
     def close(self) -> None:
         self._ns.stop()
-        for ch in self._subs:
-            try:
-                ch.close()
-            except Exception:
-                pass
+        with self._retire_lock:
+            gens, self._retired = self._retired, []
+        for gen in gens + [self._subs]:
+            for ch in gen:
+                try:
+                    ch.close()
+                except Exception:
+                    pass

@@ -126,7 +126,14 @@ class RemoteFileNamingService(NamingService):
                 pass   # keep the last good list on fetch failure
             if lines and lines != last:
                 last = lines
-                actions.reset_servers([str2endpoint(ln) for ln in lines])
+                eps = []
+                for ln in lines:
+                    try:
+                        eps.append(str2endpoint(ln))
+                    except ValueError:
+                        # one malformed line must not kill the poller
+                        logging.warning("remotefile NS: bad line %r", ln)
+                actions.reset_servers(eps)
             await sleep(self.interval_s)
 
 

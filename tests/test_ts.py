@@ -84,3 +84,29 @@ def test_flv_to_ts_bridge():
         m.write_video(tag.payload[2:], pts_90k=tag.timestamp * 90)
     blob = m.flush()
     assert ts.extract_pes(blob, ts.VIDEO_PID) == [b"frame0", b"frame1"]
+
+
+def test_pcr_six_bytes_and_long_stream():
+    # PCR is a 48-bit field; clocks past ~6 minutes must keep the top
+    # base byte (regression: [3:] slicing dropped it)
+    long_ts = 90000 * 600          # 10 minutes in 90kHz
+    m = ts.TsMuxer()
+    m.write_tables()
+    m.write_video(b"x" * 10, pts_90k=long_ts)
+    blob = m.flush()
+    for off in range(0, len(blob), ts.TS_PACKET_SIZE):
+        pkt = blob[off:off + ts.TS_PACKET_SIZE]
+        pid = ((pkt[1] & 0x1F) << 8) | pkt[2]
+        if pid == ts.VIDEO_PID and pkt[3] & 0x20 and pkt[5] & 0x10:
+            af = pkt[5:5 + pkt[4]]
+            pcr_base = (af[1] << 25) | (af[2] << 17) | (af[3] << 9) | \
+                (af[4] << 1) | (af[5] >> 7)
+            assert pcr_base == long_ts * 300 // 300
+            return
+    pytest.fail("no PCR found")
+
+
+def test_audio_only_pmt_pcr_pid():
+    sec = ts.pmt_section(has_video=False, has_audio=True)
+    pcr_pid = ((sec[8] & 0x1F) << 8) | sec[9]
+    assert pcr_pid == ts.AUDIO_PID
