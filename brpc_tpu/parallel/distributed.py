@@ -1,0 +1,76 @@
+"""Multi-host bring-up: jax.distributed + pod-wide mesh + naming
+(the NCCL/MPI-backend slot of SURVEY §2.8 — XLA collectives over
+ICI/DCN are the data plane; this module is the control-plane bootstrap).
+
+    from brpc_tpu.parallel.distributed import init_pod, pod_mesh
+
+    init_pod(coordinator="10.0.0.1:8476", num_processes=4, process_id=i)
+    mesh = pod_mesh(n_replicas=2)     # global devices, all hosts
+
+Single-process (or already-initialized) environments skip the
+jax.distributed call, so the same code runs on a laptop, one TPU host,
+or a pod. ``pod_endpoints`` enumerates tpud:// endpoints for every
+process so RPC channels can reach each host's server (pair with the
+mesh:// naming scheme for in-host device addressing)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+_initialized = False
+
+
+def init_pod(coordinator: Optional[str] = None,
+             num_processes: Optional[int] = None,
+             process_id: Optional[int] = None) -> None:
+    """Initialize jax.distributed once (no-op when single-process or
+    when the TPU runtime auto-detects the pod: all args None)."""
+    global _initialized
+    if _initialized:
+        return
+    import jax
+    try:
+        if coordinator is None and num_processes is None:
+            # TPU pods auto-populate from the runtime; on CPU/single
+            # process this raises or is unnecessary — both fine to skip
+            if jax.process_count() > 1:
+                _initialized = True
+                return
+            try:
+                jax.distributed.initialize()
+            except Exception:
+                pass
+        else:
+            jax.distributed.initialize(coordinator_address=coordinator,
+                                       num_processes=num_processes,
+                                       process_id=process_id)
+    except RuntimeError:
+        pass          # already initialized
+    _initialized = True
+
+
+def pod_mesh(n_replicas: Optional[int] = None,
+             n_shards: Optional[int] = None):
+    """RpcMesh over ALL devices in the pod (jax.devices() is global
+    after init_pod)."""
+    import jax
+
+    from brpc_tpu.parallel.mesh import make_rpc_mesh
+    return make_rpc_mesh(n_replicas=n_replicas, n_shards=n_shards,
+                         devices=jax.devices())
+
+
+def pod_endpoints(base_port: int = 8750, scheme: str = "tpud",
+                  hosts: Optional[List[str]] = None) -> List[str]:
+    """One RPC endpoint per process: ``tpud://<host>:<base_port>``.
+    Hosts default to process indices on localhost (single-host testing);
+    pass the real host list in a pod (the coordinator knows it)."""
+    import jax
+
+    n = jax.process_count()
+    if hosts is None:
+        hosts = ["127.0.0.1"] * n
+    if len(hosts) != n:
+        raise ValueError(f"{len(hosts)} hosts for {n} processes")
+    return [f"{scheme}://{host}:{base_port + (0 if len(set(hosts)) == n else i)}"
+            for i, host in enumerate(hosts)]

@@ -109,3 +109,16 @@ class TestMesh:
     def test_bad_shape_raises(self):
         with pytest.raises(ValueError):
             make_rpc_mesh(n_replicas=3, n_shards=3)
+
+
+def test_distributed_single_process_bringup():
+    # init_pod is a no-op single-process; pod_mesh covers all devices;
+    # pod_endpoints gives one addr per process
+    from brpc_tpu.parallel.distributed import init_pod, pod_endpoints, pod_mesh
+    init_pod()
+    mesh = pod_mesh()
+    import jax
+    assert mesh.devices.size == len(jax.devices())
+    eps = pod_endpoints(base_port=9100)
+    assert len(eps) == jax.process_count()
+    assert eps[0].startswith("tpud://127.0.0.1:")
