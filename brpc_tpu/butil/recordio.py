@@ -115,6 +115,14 @@ class RecordReader:
                 self._pos += 1      # false magic / corrupt header: resync
                 continue
             if not self._fill(HEADER_SIZE + total):
+                # can't satisfy the declared size: either a torn final
+                # write (real truncated tail) or a FALSE magic whose bogus
+                # header claims more than the file holds. If another magic
+                # is visible past this one, it's the latter — resync so the
+                # valid records after it aren't silently discarded.
+                if self._buf.find(MAGIC, self._pos + 1) >= 0:
+                    self._pos += 1
+                    continue
                 return None         # truncated tail
             start = self._pos + HEADER_SIZE
             meta = bytes(self._buf[start:start + meta_size])

@@ -147,13 +147,19 @@ class EspClient:
         return new
 
     def _on_socket_failed(self, socket):
+        # Only fail calls that were written to THIS socket: a discarded
+        # duplicate-connect loser must not flush calls in flight on the
+        # winning connection (mirrors PipelinedClient._on_socket_failed).
         with self._lock:
             if self._socket is socket:
                 self._socket = None
-            pending, self._pending = self._pending, {}
+            failed = {i: s for i, s in self._pending.items()
+                      if s[2] is socket}
+            for i in failed:
+                del self._pending[i]
         err = getattr(socket, "fail_reason", None) or \
             ConnectionError("esp connection failed")
-        for slot in pending.values():
+        for slot in failed.values():
             slot[1] = err
             slot[0].set()
 
@@ -169,7 +175,7 @@ class EspClient:
         with self._lock:
             msg_id = self._next_id
             self._next_id = (self._next_id + 1) & 0xFFFFFFFF or 1
-            slot = [FiberEvent(), None]
+            slot = [FiberEvent(), None, socket]
             self._pending[msg_id] = slot
         msg = EspMessage(body, to=to, from_=self._stargate_id, flags=flags,
                          msg_id=msg_id)

@@ -81,6 +81,9 @@ def _pack_reply(request_id: int, response_to: int, doc: dict) -> bytes:
 
 class MongoProtocol(Protocol):
     name = "mongo"
+    min_probe_bytes = 16   # all-binary header; opcode at offset 12 is the
+    #                        only discriminator, so short prefixes are
+    #                        tentative disclaimers, not definitive
 
     def __init__(self):
         self._id_lock = threading.Lock()

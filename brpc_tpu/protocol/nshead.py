@@ -55,6 +55,8 @@ def unpack_head(head: bytes) -> Tuple[int, int, int, bytes, int, int, int]:
 
 class NsheadProtocol(Protocol):
     name = "nshead"
+    min_probe_bytes = 28   # magic lives at offset 24: shorter prefixes
+    #                        cannot be definitively disclaimed
 
     # ---------------------------------------------------------------- parse
     def parse(self, portal, socket) -> Tuple[str, object]:

@@ -30,6 +30,14 @@ PARSE_TRY_OTHERS = "try_others"
 class Protocol:
     name: str = "?"
 
+    #: bytes of prefix parse() needs before a PARSE_TRY_OTHERS is
+    #: *definitive*. Protocols whose discriminator sits deep in the header
+    #: (nshead's magic at offset 24, mongo's opcode at 12) disclaim short
+    #: prefixes only tentatively; the InputMessenger then waits for more
+    #: bytes instead of failing the connection when nothing else claims a
+    #: TCP-segmented frame (reference nshead returns NOT_ENOUGH_DATA here).
+    min_probe_bytes: int = 0
+
     def parse(self, portal, socket) -> Tuple[str, object]:
         raise NotImplementedError
 

@@ -604,9 +604,10 @@ class RtmpClient:
         self._lock = threading.Lock()
         self._socket = None
         self._handshake_done = FiberEvent()
+        self._handshake_socket = None            # socket the gate guards
         self._next_tid = 1
-        self._pending: Dict[float, list] = {}    # tid -> [event, result]
-        self._status_waiters: deque = deque()    # [event, payload]
+        self._pending: Dict[float, list] = {}    # tid -> [event, result, sock]
+        self._status_waiters: deque = deque()    # [event, payload, sock]
         self.on_media: Optional[Callable[[RtmpMessage], None]] = None
 
     # ------------------------------------------------------------ plumbing
@@ -628,6 +629,7 @@ class RtmpClient:
                 # reconnecting caller write commands mid-handshake — the
                 # server would eat them as C2 bytes
                 self._handshake_done = FiberEvent()
+                self._handshake_socket = sock
                 # C0 + C1
                 c1 = struct.pack(">II", int(time.time()) & 0x7FFFFFFF, 0) + \
                     os.urandom(HANDSHAKE_SIZE - 8)
@@ -648,15 +650,27 @@ class RtmpClient:
         return sock
 
     def _on_failed(self, socket):
+        # Per-socket flush: a discarded duplicate-connect loser must not
+        # flush calls in flight on the winner, nor release the winner's
+        # handshake gate early (callers would write commands the server
+        # consumes as C2 bytes, desyncing the winning connection). Slots
+        # are tagged with the socket they were written to.
         err = getattr(socket, "fail_reason", None) or \
             ConnectionError("rtmp connection failed")
         with self._lock:
             if self._socket is socket:
                 self._socket = None
-            pending, self._pending = self._pending, {}
-            waiters, self._status_waiters = self._status_waiters, deque()
-            handshake = self._handshake_done
-        handshake.set()   # wake connect() waiters; they fail on the dead conn
+            pending = {t: s for t, s in self._pending.items()
+                       if s[2] is socket}
+            for t in pending:
+                del self._pending[t]
+            waiters = [s for s in self._status_waiters if s[2] is socket]
+            for s in waiters:
+                self._status_waiters.remove(s)
+            handshake = (self._handshake_done
+                         if self._handshake_socket is socket else None)
+        if handshake is not None:
+            handshake.set()   # wake _get_socket waiters; they see .failed
         for slot in pending.values():
             slot[1] = err
             slot[0].set()
@@ -697,7 +711,7 @@ class RtmpClient:
         with self._lock:
             tid = float(self._next_tid)
             self._next_tid += 1
-            slot = [FiberEvent(), None]
+            slot = [FiberEvent(), None, sock]
             self._pending[tid] = slot
         _write_msg(sock, command_message(name, tid, *vals,
                                          stream_id=stream_id))
@@ -712,8 +726,8 @@ class RtmpClient:
             raise RtmpError(f"{name} failed: {rest}")
         return rest
 
-    def _wait_status(self, send_fn, what: str) -> dict:
-        slot = [FiberEvent(), None]
+    def _wait_status(self, sock, send_fn, what: str) -> dict:
+        slot = [FiberEvent(), None, sock]
         with self._lock:
             self._status_waiters.append(slot)
         send_fn()
@@ -754,6 +768,7 @@ class RtmpClient:
     def publish(self, stream_id: int, name: str) -> dict:
         sock = self._get_socket()
         return self._wait_status(
+            sock,
             lambda: _write_msg(sock, command_message(
                 "publish", 0, None, name, "live", stream_id=stream_id)),
             f"publish {name!r}")
@@ -764,6 +779,7 @@ class RtmpClient:
             self.on_media = on_media
         sock = self._get_socket()
         return self._wait_status(
+            sock,
             lambda: _write_msg(sock, command_message(
                 "play", 0, None, name, -2000.0, stream_id=stream_id)),
             f"play {name!r}")

@@ -80,6 +80,7 @@ class Channel:
         # pooled-connection_type freelist (socket.h connection pooling)
         self._conn_pool: List[Socket] = []
         self._pool_lock = threading.Lock()
+        self._pool_closed = False
         if address is not None:
             self.init(address)
 
@@ -109,6 +110,7 @@ class Channel:
             s.set_failed(ConnectionError("channel closed"))
         with self._pool_lock:
             pool, self._conn_pool = self._conn_pool, []
+            self._pool_closed = True
         for sock in pool:
             if not sock.failed:
                 sock.set_failed(ConnectionError("channel closed"))
@@ -230,6 +232,7 @@ class Channel:
             return self._get_socket()
         if ctype == "pooled":
             with self._pool_lock:
+                self._pool_closed = False   # channel in use again
                 while self._conn_pool:
                     sock = self._conn_pool.pop()
                     if not sock.failed:
@@ -242,9 +245,15 @@ class Channel:
                     control=self._control)
 
             def _return(c, s=sock):
-                if not s.failed:
-                    with self._pool_lock:
+                if s.failed:
+                    return
+                with self._pool_lock:
+                    if not self._pool_closed:
                         self._conn_pool.append(s)
+                        return
+                # a call completing after close() must not re-populate the
+                # emptied pool — nothing would ever close that socket again
+                s.set_failed(ConnectionError("channel closed"))
 
             cntl._complete_hooks.append(_return)
             return sock

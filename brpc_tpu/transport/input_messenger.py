@@ -40,6 +40,7 @@ class InputMessenger:
                 [idx] + [i for i in range(len(protocols)) if i != idx])
             claimed = None
             waiting_for_bytes = False
+            ambiguous = False
             for i in order:
                 proto = protocols[i]
                 # parse contract: peek-only unless returning PARSE_OK
@@ -53,7 +54,11 @@ class InputMessenger:
                     # stop and wait for more input
                     waiting_for_bytes = True
                     break
-                # PARSE_TRY_OTHERS: not this protocol's bytes, try next
+                # PARSE_TRY_OTHERS: not this protocol's bytes — but a
+                # disclaim on a prefix shorter than the protocol's
+                # discriminator is only tentative (segmented frame)
+                if socket.input_portal.size < proto.min_probe_bytes:
+                    ambiguous = True
             if claimed is not None:
                 proto, msg = claimed
                 # order-critical messages (stream frames) dispatch inline
@@ -61,8 +66,10 @@ class InputMessenger:
                 if not proto.process_inline(msg, socket):
                     msgs.append(claimed)
                 continue
-            if not waiting_for_bytes and socket.input_portal:
-                # every protocol disclaimed the bytes: drop the connection
+            if not waiting_for_bytes and not ambiguous and \
+                    socket.input_portal:
+                # every protocol definitively disclaimed: drop the
+                # connection (ambiguous short prefixes wait for more bytes)
                 socket.set_failed(ValueError("unparsable input"))
             break
         if not msgs:

@@ -48,6 +48,23 @@ def test_recordio_truncated_tail():
     assert [rec.data for rec in records] == [b"complete"]
 
 
+def test_recordio_false_magic_oversized_header_resyncs():
+    # a false magic whose corrupt header declares meta+data larger than the
+    # remaining file must not swallow the valid records that follow it
+    import struct
+    buf = io.BytesIO()
+    w = RecordWriter(buf)
+    w.write(b"first")
+    # forged frame: real magic, header claiming 1MB of data that isn't there
+    buf.write(b"RIO1" + struct.pack(">III", 0, 1 << 20, 0xDEAD))
+    w.write(b"second")
+    w.write(b"third")
+    r = RecordReader(io.BytesIO(buf.getvalue()))
+    records = list(r)
+    assert [rec.data for rec in records] == [b"first", b"second", b"third"]
+    assert r.skipped_bytes > 0
+
+
 def test_recordio_garbage_prefix():
     buf = io.BytesIO()
     buf.write(b"\xde\xad\xbe\xef garbage leader")

@@ -44,6 +44,38 @@ def test_nshead_e2e():
         server.join(2)
 
 
+def test_nshead_segmented_header_survives_multiprotocol_probe():
+    # a valid nshead frame arriving in a 10-byte sliver must not be
+    # definitively disclaimed by every protocol (the magic at offset 24
+    # is not visible yet) — the connection waits instead of failing
+    import socket as pysocket
+    import time
+
+    def handler(sock, msg):
+        return msg.body.upper()
+
+    server = Server(ServerOptions(nshead_service=handler))
+    ep = server.start("tcp://127.0.0.1:0")
+    try:
+        wire = nshead.NsheadMessage(b"sliced", log_id=11).pack()
+        with pysocket.create_connection(("127.0.0.1", ep.port), 5) as s:
+            s.sendall(wire[:10])          # header sliver, magic invisible
+            time.sleep(0.3)               # let the server probe and (not) fail
+            s.sendall(wire[10:])
+            s.settimeout(5)
+            got = b""
+            while len(got) < 36 + 6:
+                chunk = s.recv(4096)
+                assert chunk, "connection closed instead of parsing"
+                got += chunk
+        fields = nshead.unpack_head(got[:36])
+        assert fields[6] == 6
+        assert got[36:] == b"SLICED"
+    finally:
+        server.stop()
+        server.join(2)
+
+
 def test_nshead_full_message_reply():
     def handler(sock, msg):
         return nshead.NsheadMessage(b"custom", id=42, log_id=msg.log_id)

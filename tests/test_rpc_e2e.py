@@ -420,6 +420,25 @@ class TestConnectionTypes:
             server.stop()
             server.join(2)
 
+    def test_pooled_call_completing_after_close_does_not_leak(self):
+        server = make_echo_server()
+        ep = server.start("tcp://127.0.0.1:0")
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(connection_type="pooled",
+                                    timeout_ms=5000))
+        try:
+            # Slow holds the pooled socket in flight while we close()
+            cntl = ch.call("EchoService", "Slow", b"x")
+            time.sleep(0.05)
+            ch.close()
+            assert cntl.join(10)
+            # the late completion must not re-populate the emptied pool —
+            # nothing would ever close that socket again
+            assert ch._conn_pool == []
+        finally:
+            server.stop()
+            server.join(2)
+
     def test_short_connections_close_after_call(self):
         import time as _time
         server = make_echo_server()
