@@ -1,0 +1,120 @@
+"""DeviceRecvPool: size-classed admission control over device (HBM)
+receive memory — the tpu-native analog of the RDMA registered-memory
+block pool (reference: rdma/block_pool.cpp:52 size classes 8KB/64KB/2MB,
+:271-340 per-bucket freelists + region extend).
+
+Honest delta from the reference, documented: PjRt owns physical buffer
+placement and XLA arrays cannot be constructed into a caller-supplied
+region from Python, so this pool governs *budget*, not placement — every
+inbound device batch must reserve its (size-class-rounded) bytes before
+the pull DMA is issued, and the reservation is released when the
+application drops the arrays (tracked with weakref finalizers, the
+moral equivalent of the rbuf block being returned to the pool when the
+parsing IOBuf releases it, rdma_endpoint.h:145). The sliding window
+(transport/ici.py) is sized against this budget, so a peer can never
+oversubscribe the receiver's HBM — the same invariant RDMA gets from
+pre-posted recv buffers.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+# size classes mirror the reference's 8KB / 64KB / 2MB buckets
+BLOCK_CLASSES = (8 << 10, 64 << 10, 2 << 20)
+
+
+def round_to_class(nbytes: int) -> int:
+    """Round a payload size up to its block-class footprint: payloads
+    above the largest class take whole 2MB blocks (region extend)."""
+    if nbytes <= 0:
+        return BLOCK_CLASSES[0]
+    for c in BLOCK_CLASSES:
+        if nbytes <= c:
+            return c
+    big = BLOCK_CLASSES[-1]
+    return ((nbytes + big - 1) // big) * big
+
+
+class DeviceRecvPool:
+    """Byte-budget admission for inbound device payloads.
+
+    reserve() blocks (with timeout) when the budget is exhausted — the
+    out-of-credit state a too-small window would otherwise hide.
+    """
+
+    def __init__(self, capacity_bytes: int = 256 << 20):
+        self.capacity = capacity_bytes
+        self._used = 0
+        self._lock = threading.Lock()
+        self._freed = threading.Condition(self._lock)
+        # stats per class index (len+1 = oversized bucket)
+        self.reserved_blocks: List[int] = [0] * (len(BLOCK_CLASSES) + 1)
+
+    def _class_index(self, footprint: int) -> int:
+        for i, c in enumerate(BLOCK_CLASSES):
+            if footprint <= c:
+                return i
+        return len(BLOCK_CLASSES)
+
+    @property
+    def used(self) -> int:
+        with self._lock:
+            return self._used
+
+    @property
+    def available(self) -> int:
+        with self._lock:
+            return self.capacity - self._used
+
+    def reserve(self, nbytes: int, timeout_s: Optional[float] = 10.0) -> int:
+        """Reserve budget for one payload; returns the rounded footprint
+        (pass it to release). Raises MemoryError on timeout — the
+        connection-level error, not a silent stall."""
+        footprint = round_to_class(nbytes)
+        if footprint > self.capacity:
+            raise MemoryError(
+                f"device payload of {nbytes}B exceeds pool capacity "
+                f"{self.capacity}B")
+        with self._freed:
+            ok = self._freed.wait_for(
+                lambda: self.capacity - self._used >= footprint,
+                timeout=timeout_s)
+            if not ok:
+                raise MemoryError(
+                    f"device recv pool exhausted ({self._used}/"
+                    f"{self.capacity}B used, need {footprint}B)")
+            self._used += footprint
+            self.reserved_blocks[self._class_index(footprint)] += 1
+        return footprint
+
+    def try_reserve(self, nbytes: int) -> Optional[int]:
+        """Non-blocking reserve; None when out of budget."""
+        footprint = round_to_class(nbytes)
+        with self._lock:
+            if self.capacity - self._used < footprint:
+                return None
+            self._used += footprint
+            self.reserved_blocks[self._class_index(footprint)] += 1
+        return footprint
+
+    def release(self, footprint: int) -> None:
+        with self._freed:
+            self._used -= footprint
+            if self._used < 0:           # double-release guard
+                self._used = 0
+            self.reserved_blocks[self._class_index(footprint)] -= 1
+            self._freed.notify_all()
+
+    def attach_finalizer(self, obj, footprint: int) -> None:
+        """Release the reservation when ``obj`` is garbage-collected —
+        the app dropping the pulled arrays is the block returning to the
+        pool."""
+        import weakref
+        try:
+            weakref.finalize(obj, self.release, footprint)
+        except TypeError:
+            # object doesn't support weakrefs: release immediately rather
+            # than leak budget forever
+            self.release(footprint)

@@ -613,8 +613,15 @@ class RtmpClient:
     # ------------------------------------------------------------ plumbing
     def _get_socket(self):
         with self._lock:
-            if self._socket is not None and not self._socket.failed:
-                return self._socket
+            existing = self._socket
+            gate = self._handshake_done
+        if existing is not None and not existing.failed:
+            # the winner may still be mid-handshake (another fiber created
+            # it and is waiting): every caller path gates before writing
+            if not gate.wait_pthread(self._timeout_s):
+                raise TimeoutError("rtmp handshake timed out")
+            if not existing.failed:
+                return existing
         sock = create_client_socket(
             self._endpoint, on_input=self._messenger.on_new_messages,
             control=self._control)

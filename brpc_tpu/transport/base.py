@@ -6,8 +6,12 @@ RDMA, io_uring — SURVEY.md §2.4); we do the same behind ``Transport``:
   mem://  in-process loopback — the test fabric every layer above runs on
           (the reference's 127.0.0.1 fixture pattern, SURVEY.md §4)
   tcp://  real sockets via a selectors EventDispatcher (bootstrap + DCN)
-  tpu://  device transport: metadata rides a host stream, payload tensors
-          move device-to-device (transport/tpu.py)
+  ici://  THE device data plane: TCP bootstrap handshake, PjRt pull-DMA
+          device lane, windowed flow control (transport/ici.py — the
+          RDMA slot)
+  tpu://  in-process loopback variant of the device lane (test fabric)
+  tpud:// staged (numpy-over-TCP) device lane — the degraded fallback
+          ici:// uses when PjRt transfer is unavailable
 
 A Conn is a non-blocking byte stream; BlockingIOError means "would block"
 and the owning Socket parks until the dispatcher reports readiness.
@@ -116,3 +120,6 @@ def _register_builtins() -> None:
         if "tpud" not in _transports:
             from brpc_tpu.transport.tpud import TpudTransport
             _transports["tpud"] = TpudTransport()
+        if "ici" not in _transports:
+            from brpc_tpu.transport.ici import IciTransport
+            _transports["ici"] = IciTransport()

@@ -1,18 +1,20 @@
-"""tpu:// transport: the device data plane.
+"""tpu:// — the IN-PROCESS LOOPBACK device transport (the test fabric).
 
-Where the reference grafts ibverbs RDMA onto Socket (rdma/rdma_endpoint.*,
-SURVEY.md §2.4 + §3.5), we graft the accelerator fabric: metadata rides a
-host byte stream (here: in-process pipes; cross-host: the DCN/TCP
-bootstrap), while tensor payloads move device-to-device on the transfer
-lane — `jax.device_put` onto the receiver's device, which XLA lowers to an
-ICI copy when source and target are distinct TPU chips, and which
-degenerates to a zero-copy reference hand-off when they are the same
-device.
+This is the fake the reference's test strategy demands (SURVEY.md §4:
+everything testable over 127.0.0.1 without a cluster): host metadata
+rides in-process mem pipes, device payloads hand off by reference (or a
+`jax.device_put` D2D copy when src/dst ordinals differ). Both ends MUST
+live in one process — there is no wire and no flow control here by
+design, which also makes it the zero-overhead fixture for scheduler and
+protocol tests.
+
+The REAL device data plane is ``ici://`` (transport/ici.py): TCP
+bootstrap handshake, PjRt pull-DMA lane, sliding-window + piggyback-ACK
+flow control, recv-pool admission — use it for anything that crosses a
+process or host boundary, and for honest performance numbers.
 
 Endpoint form: ``tpu://name:port#device=K`` — K is the receiver's local
-device ordinal. The RDMA-style handshake (exchange mesh coords/channel
-ids over TCP, then bring up the device channel) slots in here for the
-multi-host path; single-host needs none.
+device ordinal.
 """
 
 from __future__ import annotations

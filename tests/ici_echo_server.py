@@ -1,0 +1,47 @@
+"""Subprocess helper for cross-process ici:// tests: starts an echo
+server whose EchoDevice doubles device arrays, prints the bound port,
+and serves until killed. Run on the forced-CPU 8-device platform like
+tests/conftest.py does."""
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from brpc_tpu.rpc import Server  # noqa: E402
+from brpc_tpu.rpc.service import Service  # noqa: E402
+
+svc = Service("EchoService")
+
+
+@svc.method()
+def Echo(cntl, request):
+    return bytes(request)
+
+
+@svc.method()
+def EchoDevice(cntl, request):
+    cntl.response_device_arrays = [a * 2 for a in cntl.request_device_arrays]
+    return b"dev"
+
+
+def main():
+    server = Server()
+    server.add_service(svc)
+    ep = server.start("ici://127.0.0.1:0#device=3")
+    print(f"PORT {ep.port}", flush=True)
+    while True:
+        time.sleep(1)
+
+
+if __name__ == "__main__":
+    main()

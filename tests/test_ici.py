@@ -1,0 +1,370 @@
+"""ici:// device data plane tests (reference: rdma/rdma_endpoint.h
+state machine + window flow control, rdma/block_pool.cpp size classes).
+
+Covers: in-process D2D echo, cross-device placement, window stall +
+ACK-driven resume, recv-pool budget + finalizer release, out-of-credit
+error, and REAL cross-process transfer (PjRt pull lane and the staged
+fallback) via a subprocess server."""
+
+import gc
+import os
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from brpc_tpu.butil.device_pool import (BLOCK_CLASSES, DeviceRecvPool,
+                                        round_to_class)
+from brpc_tpu.butil.endpoint import str2endpoint
+from brpc_tpu.rpc import Channel, Server
+from brpc_tpu.transport import ici
+
+_name_seq = iter(range(10_000))
+
+
+def make_echo_server():
+    from brpc_tpu.rpc.service import Service
+    server = Server()
+    svc = Service("EchoService")
+
+    @svc.method()
+    def Echo(cntl, request):
+        return bytes(request)
+
+    @svc.method()
+    def EchoDevice(cntl, request):
+        cntl.response_device_arrays = [a * 2
+                                       for a in cntl.request_device_arrays]
+        return b"dev"
+
+    server.add_service(svc)
+    return server
+
+
+# ---------------------------------------------------------- device pool
+
+class TestDeviceRecvPool:
+    def test_round_to_class(self):
+        assert round_to_class(1) == BLOCK_CLASSES[0]
+        assert round_to_class(8 << 10) == 8 << 10
+        assert round_to_class((8 << 10) + 1) == 64 << 10
+        assert round_to_class(1 << 20) == 2 << 20
+        assert round_to_class((2 << 20) + 1) == 4 << 20   # region extend
+
+    def test_reserve_release(self):
+        pool = DeviceRecvPool(capacity_bytes=1 << 20)
+        f = pool.reserve(100)
+        assert pool.used == 8 << 10
+        pool.release(f)
+        assert pool.used == 0
+
+    def test_exhaustion_raises(self):
+        pool = DeviceRecvPool(capacity_bytes=16 << 10)
+        pool.reserve(8 << 10)
+        pool.reserve(8 << 10)
+        with pytest.raises(MemoryError):
+            pool.reserve(1, timeout_s=0.05)
+
+    def test_oversized_payload_rejected(self):
+        pool = DeviceRecvPool(capacity_bytes=1 << 20)
+        with pytest.raises(MemoryError):
+            pool.reserve(2 << 20, timeout_s=0.05)
+
+    def test_blocked_reserve_wakes_on_release(self):
+        pool = DeviceRecvPool(capacity_bytes=8 << 10)
+        f = pool.reserve(1)
+        got = []
+
+        def waiter():
+            got.append(pool.reserve(1, timeout_s=5))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        pool.release(f)
+        t.join(5)
+        assert got and got[0] == 8 << 10
+
+    def test_try_reserve(self):
+        pool = DeviceRecvPool(capacity_bytes=8 << 10)
+        assert pool.try_reserve(1) == 8 << 10
+        assert pool.try_reserve(1) is None
+
+
+# --------------------------------------------------------- in-process e2e
+
+class TestIciLocal:
+    def test_e2e_device_roundtrip(self):
+        import jax.numpy as jnp
+        server = make_echo_server()
+        ep = server.start("ici://127.0.0.1:0#device=5")
+        try:
+            ch = Channel(f"ici://127.0.0.1:{ep.port}#reply_device=2")
+            arr = jnp.arange(64, dtype=jnp.float32)
+            cntl = ch.call_sync("EchoService", "EchoDevice", b"",
+                                request_device_arrays=[arr])
+            assert not cntl.failed(), cntl.error_text
+            out = cntl.response_device_arrays[0]
+            assert hasattr(out, "devices")    # stayed a device array
+            np.testing.assert_array_equal(np.asarray(out),
+                                          np.asarray(arr) * 2)
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_request_lands_on_server_device(self):
+        import jax
+        devs = jax.devices()
+        server = make_echo_server()
+        ep = server.start("ici://127.0.0.1:0#device=5")
+        got = {}
+        svc = server.services()["EchoService"]
+
+        def WhereAmI(cntl, request):
+            got["devices"] = cntl.request_device_arrays[0].devices()
+            return b"ok"
+
+        svc.register_method("WhereAmI", WhereAmI)
+        try:
+            ch = Channel(f"ici://127.0.0.1:{ep.port}")
+            arr = jax.device_put(
+                jax.numpy.ones((128,), jax.numpy.float32), devs[0])
+            cntl = ch.call_sync("EchoService", "WhereAmI", b"",
+                                request_device_arrays=[arr])
+            assert not cntl.failed(), cntl.error_text
+            assert devs[5] in got["devices"]
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_response_lands_on_reply_device(self):
+        import jax
+        import jax.numpy as jnp
+        devs = jax.devices()
+        server = make_echo_server()
+        ep = server.start("ici://127.0.0.1:0#device=3")
+        try:
+            ch = Channel(f"ici://127.0.0.1:{ep.port}#reply_device=6")
+            arr = jnp.ones((32,), jnp.float32)
+            cntl = ch.call_sync("EchoService", "EchoDevice", b"",
+                                request_device_arrays=[arr])
+            assert not cntl.failed(), cntl.error_text
+            out = cntl.response_device_arrays[0]
+            assert devs[6] in out.devices()
+        finally:
+            server.stop()
+            server.join(2)
+
+
+# ------------------------------------------------- window / flow control
+
+class _ConnHarness:
+    """Raw transport-level pair with manual pumping (no event loop)."""
+
+    def __init__(self, window=2, pool=None):
+        self.tr = ici.IciTransport(window=window, pool=pool)
+        self.server_conn = None
+        self._evt = threading.Event()
+        self.listener = self.tr.listen(
+            str2endpoint("ici://127.0.0.1:0"), self._on_conn)
+        self.client = self.tr.connect(
+            str2endpoint(f"ici://127.0.0.1:{self.listener.endpoint.port}"))
+        assert self._evt.wait(5), "no server conn"
+        # pump both sides until hellos land
+        deadline = time.monotonic() + 5
+        while (self.client.peer_info is None
+               or self.server_conn.peer_info is None):
+            self.pump(self.client)
+            self.pump(self.server_conn)
+            assert time.monotonic() < deadline, "handshake never completed"
+            time.sleep(0.01)
+
+    def _on_conn(self, conn):
+        self.server_conn = conn
+        self._evt.set()
+
+    @staticmethod
+    def pump(conn):
+        buf = bytearray(1 << 16)
+        try:
+            conn.read_into(memoryview(buf))
+        except BlockingIOError:
+            pass
+
+    def close(self):
+        self.client.close()
+        if self.server_conn is not None:
+            self.server_conn.close()
+        self.listener.stop()
+
+
+class TestWindowFlowControl:
+    def test_window_stall_and_ack_resume(self):
+        import jax.numpy as jnp
+        h = _ConnHarness(window=2)
+        try:
+            for i in range(3):
+                h.client.write_device_payload(
+                    [jnp.full((4,), i, jnp.float32)])
+            # third batch is gated: only 2 un-ACKed batches may fly
+            assert h.client.outstanding_batches == 2
+            assert any(it[0] == "lane" for it in h.client._outq)
+            # receiver consumes both -> bare ACK (2 >= window//2)
+            b0 = h.server_conn.take_device_payload()
+            b1 = h.server_conn.take_device_payload()
+            assert np.asarray(b0[0])[0] == 0 and np.asarray(b1[0])[0] == 1
+            # ack reaches the sender: window reopens, third batch flies
+            deadline = time.monotonic() + 5
+            while h.client.outstanding_batches != 1:
+                h.pump(h.client)
+                assert time.monotonic() < deadline, "window never reopened"
+                time.sleep(0.01)
+            assert not any(it[0] == "lane" for it in h.client._outq)
+            b2 = h.server_conn.take_device_payload()
+            assert np.asarray(b2[0])[0] == 2
+        finally:
+            h.close()
+
+    def test_stalled_sender_requests_writable(self):
+        import jax.numpy as jnp
+        h = _ConnHarness(window=1)
+        try:
+            h.client.write_device_payload([jnp.zeros((4,), jnp.float32)])
+            h.client.write_device_payload([jnp.ones((4,), jnp.float32)])
+            assert h.client.outstanding_batches == 1
+            fired = threading.Event()
+            h.client._on_writable_cb = fired.set
+            h.client._want_writable = True
+            h.server_conn.take_device_payload()     # consumes + acks
+            deadline = time.monotonic() + 5
+            while not fired.is_set():
+                h.pump(h.client)
+                assert time.monotonic() < deadline, "writable never fired"
+                time.sleep(0.01)
+        finally:
+            h.close()
+
+    def test_recv_pool_budget_reserved_and_finalized(self):
+        import jax.numpy as jnp
+        pool = DeviceRecvPool(capacity_bytes=4 << 20)
+        h = _ConnHarness(window=4, pool=pool)
+        try:
+            h.client.write_device_payload([jnp.zeros((16,), jnp.float32)])
+            batch = h.server_conn.take_device_payload()
+            assert batch is not None
+            assert pool.used == 8 << 10          # one small-class block
+            del batch
+            gc.collect()
+            deadline = time.monotonic() + 5
+            while pool.used != 0:
+                gc.collect()
+                assert time.monotonic() < deadline, "finalizer never ran"
+                time.sleep(0.05)
+        finally:
+            h.close()
+
+    def test_out_of_credit_pool_error(self):
+        import jax.numpy as jnp
+        pool = DeviceRecvPool(capacity_bytes=8 << 10)
+        h = _ConnHarness(window=4, pool=pool)
+        try:
+            held = pool.reserve(1)               # someone owns the budget
+            h.client.write_device_payload([jnp.zeros((16,), jnp.float32)])
+            # shrink the take-side wait so the test is fast
+            orig = pool.reserve
+            pool.reserve = lambda n, timeout_s=10.0: orig(n, timeout_s=0.05)
+            with pytest.raises(MemoryError):
+                h.server_conn.take_device_payload()
+            pool.reserve = orig
+            pool.release(held)
+        finally:
+            h.close()
+
+
+# ------------------------------------------------------- cross process
+
+def _spawn_server(extra_env=None):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)      # script sets its own
+    if extra_env:
+        env.update(extra_env)
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "ici_echo_server.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True)
+    port = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("PORT "):
+            port = int(line.split()[1])
+            break
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"server died: {proc.stderr.read()[-2000:]}")
+    assert port, "server never printed its port"
+    return proc, port
+
+
+class TestIciCrossProcess:
+    def _roundtrip(self, extra_env=None, expect_lane=None):
+        proc, port = _spawn_server(extra_env)
+        try:
+            import jax.numpy as jnp
+            ch = Channel(f"ici://127.0.0.1:{port}#reply_device=4")
+            arr = jnp.arange(256, dtype=jnp.float32)
+            cntl = ch.call_sync("EchoService", "EchoDevice", b"",
+                                cntl=None, request_device_arrays=[arr])
+            assert not cntl.failed(), cntl.error_text
+            out = cntl.response_device_arrays[0]
+            assert hasattr(out, "devices")
+            np.testing.assert_array_equal(np.asarray(out),
+                                          np.asarray(arr) * 2)
+            if expect_lane is not None:
+                sock = ch._socket
+                assert sock.conn.lane_kind == expect_lane
+            ch.close()
+        finally:
+            proc.terminate()
+            proc.wait(10)
+
+    def test_cross_process_pjrt_pull(self):
+        """Device payload crosses a process boundary via PjRt pull DMA —
+        no numpy round-trip on the data path (VERDICT #1's done bar)."""
+        self._roundtrip(expect_lane="pjrt-pull")
+
+    def test_cross_process_staged_fallback(self):
+        env = {"BRPC_TPU_ICI_FORCE_STAGED": "1"}
+        old = os.environ.get("BRPC_TPU_ICI_FORCE_STAGED")
+        os.environ["BRPC_TPU_ICI_FORCE_STAGED"] = "1"
+        try:
+            self._roundtrip(extra_env=env, expect_lane="staged")
+        finally:
+            if old is None:
+                os.environ.pop("BRPC_TPU_ICI_FORCE_STAGED", None)
+            else:
+                os.environ["BRPC_TPU_ICI_FORCE_STAGED"] = old
+
+
+# ------------------------------------------------------------- framing
+
+class TestFraming:
+    def test_descriptor_roundtrip(self):
+        import jax.numpy as jnp
+        arrs = [jnp.zeros((3, 4), jnp.float32),
+                jnp.ones((7,), jnp.int32)]
+        wire = ici._encode_descriptor(77, arrs)
+        uid, specs = ici._decode_descriptor(wire)
+        assert uid == 77
+        assert specs[0] == {"dtype": "float32", "shape": (3, 4),
+                            "nbytes": 48}
+        assert specs[1]["shape"] == (7,)
+
+    def test_frame_header_carries_ack(self):
+        hdr = ici._HDR.pack(ici.F_BYTES, 12345, 4)
+        ftype, ack, length = ici._HDR.unpack(hdr)
+        assert (ftype, ack, length) == (0, 12345, 4)
