@@ -71,23 +71,42 @@ class DeviceRecvPool:
     def reserve(self, nbytes: int, timeout_s: Optional[float] = 10.0) -> int:
         """Reserve budget for one payload; returns the rounded footprint
         (pass it to release). Raises MemoryError on timeout — the
-        connection-level error, not a silent stall."""
+        connection-level error, not a silent stall.
+
+        On pressure it runs gc.collect() OUTSIDE the lock (finalizers
+        re-enter release()): reservations are freed when the app drops
+        the pulled arrays, and arrays caught in reference cycles (a
+        Controller holding its arrays and callbacks is one) would
+        otherwise hold budget until an arbitrary future collection."""
+        import time as _time
+
         footprint = round_to_class(nbytes)
         if footprint > self.capacity:
             raise MemoryError(
                 f"device payload of {nbytes}B exceeds pool capacity "
                 f"{self.capacity}B")
-        with self._freed:
-            ok = self._freed.wait_for(
-                lambda: self.capacity - self._used >= footprint,
-                timeout=timeout_s)
-            if not ok:
-                raise MemoryError(
-                    f"device recv pool exhausted ({self._used}/"
-                    f"{self.capacity}B used, need {footprint}B)")
-            self._used += footprint
-            self.reserved_blocks[self._class_index(footprint)] += 1
-        return footprint
+        deadline = (None if timeout_s is None
+                    else _time.monotonic() + timeout_s)
+        gc_at = 0.0
+        while True:
+            with self._freed:
+                if self.capacity - self._used >= footprint:
+                    self._used += footprint
+                    self.reserved_blocks[self._class_index(footprint)] += 1
+                    return footprint
+                if deadline is not None and _time.monotonic() >= deadline:
+                    raise MemoryError(
+                        f"device recv pool exhausted ({self._used}/"
+                        f"{self.capacity}B used, need {footprint}B)")
+                if _time.monotonic() >= gc_at:
+                    collect = True
+                else:
+                    collect = False
+                    self._freed.wait(0.05)
+            if collect:
+                import gc
+                gc.collect()
+                gc_at = _time.monotonic() + 1.0
 
     def try_reserve(self, nbytes: int) -> Optional[int]:
         """Non-blocking reserve; None when out of budget."""

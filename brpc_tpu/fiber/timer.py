@@ -16,8 +16,9 @@ from brpc_tpu.fiber.scheduler import Fiber, SchedAwaitable
 class TimerThread:
     def __init__(self, name: str = "fiber_timer"):
         self._cond = threading.Condition()
-        self._heap: list = []
-        self._cancelled: Dict[int, bool] = {}
+        self._heap: list = []          # (deadline, tid, [fn]) — fn boxed so
+        #                                unschedule can drop it eagerly
+        self._boxes: Dict[int, list] = {}
         self._seq = itertools.count()
         self._thread: Optional[threading.Thread] = None
         self._stop = False
@@ -34,7 +35,9 @@ class TimerThread:
         """deadline is time.monotonic() seconds; returns a timer id."""
         with self._cond:
             tid = next(self._seq)
-            heapq.heappush(self._heap, (deadline, tid, fn))
+            box = [fn]
+            self._boxes[tid] = box
+            heapq.heappush(self._heap, (deadline, tid, box))
             self._ensure_thread()
             self._cond.notify()
         return tid
@@ -43,17 +46,24 @@ class TimerThread:
         return self.schedule_at(time.monotonic() + max(0.0, delay_s), fn)
 
     def unschedule(self, tid: int) -> None:
+        """Cancel a timer and drop its callback NOW: an RPC deadline
+        closure captures the Controller (and any device arrays it holds),
+        so retaining it in the heap until the deadline would pin megabytes
+        per completed call for the full timeout (seen as recv-pool
+        exhaustion under pipelined load)."""
         with self._cond:
-            self._cancelled[tid] = True
+            box = self._boxes.pop(tid, None)
+            if box is not None:
+                box[0] = None
 
     def _run(self) -> None:
         while not self._stop:
             with self._cond:
                 now = time.monotonic()
                 while self._heap and self._heap[0][0] <= now:
-                    deadline, tid, fn = heapq.heappop(self._heap)
-                    if self._cancelled.pop(tid, False):
-                        fn = None
+                    deadline, tid, box = heapq.heappop(self._heap)
+                    self._boxes.pop(tid, None)
+                    fn = box[0]
                     if fn is not None:
                         self._cond.release()
                         try:

@@ -206,6 +206,10 @@ class IciConn(Conn):
         self._pool = pool or _default_pool
         self._lock = threading.Lock()
         self._flush_lock = threading.Lock()
+        # _pump is reached from the input-drain fiber (read_into) AND
+        # from processing fibers (take_device_payload); the ingest state
+        # (_inbuf/_appbuf/_lane/ack counters) needs one owner at a time
+        self._pump_lock = threading.Lock()
         # outbound: FIFO of ("bytes"|"ctrl", payload) | ("lane", arrays)
         self._outq: Deque[Tuple] = deque()
         self._out_bytes = 0                      # backpressure accounting
@@ -331,6 +335,10 @@ class IciConn(Conn):
 
     # ---------------------------------------------------------- inbound
     def _pump(self) -> None:
+        with self._pump_lock:
+            self._pump_locked()
+
+    def _pump_locked(self) -> None:
         buf = bytearray(256 << 10)
         while True:
             try:
@@ -408,10 +416,16 @@ class IciConn(Conn):
             self._flush()
 
     def take_device_payload(self):
-        self._pump()
-        if not self._lane:
-            return None
-        kind, a, b = self._lane.popleft()
+        # NO TCP pump here: a descriptor frame always precedes its
+        # message's byte frames on the wire, so by the time the parser
+        # saw those bytes the descriptor was already de-enveloped into
+        # _lane. Pumping TCP from the parse path would steal the readable
+        # edge — frames drained into _appbuf with the event already
+        # consumed would never wake the input fiber again.
+        with self._pump_lock:
+            if not self._lane:
+                return None
+            kind, a, b = self._lane.popleft()
         import jax
         if kind == "staged":
             batch = _decode_device_batch(a)
@@ -450,7 +464,8 @@ class IciConn(Conn):
                 raise
             for arr, f in zip(out, footprints):
                 self._pool.attach_finalizer(arr, f)
-        self._consumed += 1
+        with self._pump_lock:
+            self._consumed += 1
         self._maybe_send_ack()
         return out
 

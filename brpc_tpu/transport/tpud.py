@@ -198,7 +198,10 @@ class TpudConn(Conn):
         raise BlockingIOError
 
     def take_device_payload(self):
-        self._pump()
+        # no TCP pump: the lane frame precedes its message's byte frames,
+        # so the batch is already decoded by the time the parser asks for
+        # it — and pumping from the parse path would consume the readable
+        # edge while leaving de-enveloped bytes nobody ever processes
         if not self._lane:
             return None
         batch = self._lane.popleft()

@@ -195,6 +195,20 @@ class _ConnHarness:
         except BlockingIOError:
             pass
 
+    @classmethod
+    def take(cls, conn, timeout_s=5.0):
+        """Pump until a lane batch is available, then take it (the
+        assembled stack's input fiber does the pumping via read_into;
+        take itself never touches the TCP socket)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            cls.pump(conn)
+            batch = conn.take_device_payload()
+            if batch is not None:
+                return batch
+            assert time.monotonic() < deadline, "no lane batch arrived"
+            time.sleep(0.01)
+
     def close(self):
         self.client.close()
         if self.server_conn is not None:
@@ -214,8 +228,8 @@ class TestWindowFlowControl:
             assert h.client.outstanding_batches == 2
             assert any(it[0] == "lane" for it in h.client._outq)
             # receiver consumes both -> bare ACK (2 >= window//2)
-            b0 = h.server_conn.take_device_payload()
-            b1 = h.server_conn.take_device_payload()
+            b0 = h.take(h.server_conn)
+            b1 = h.take(h.server_conn)
             assert np.asarray(b0[0])[0] == 0 and np.asarray(b1[0])[0] == 1
             # ack reaches the sender: window reopens, third batch flies
             deadline = time.monotonic() + 5
@@ -224,7 +238,7 @@ class TestWindowFlowControl:
                 assert time.monotonic() < deadline, "window never reopened"
                 time.sleep(0.01)
             assert not any(it[0] == "lane" for it in h.client._outq)
-            b2 = h.server_conn.take_device_payload()
+            b2 = h.take(h.server_conn)
             assert np.asarray(b2[0])[0] == 2
         finally:
             h.close()
@@ -239,7 +253,7 @@ class TestWindowFlowControl:
             fired = threading.Event()
             h.client._on_writable_cb = fired.set
             h.client._want_writable = True
-            h.server_conn.take_device_payload()     # consumes + acks
+            h.take(h.server_conn)                   # consumes + acks
             deadline = time.monotonic() + 5
             while not fired.is_set():
                 h.pump(h.client)
@@ -254,7 +268,7 @@ class TestWindowFlowControl:
         h = _ConnHarness(window=4, pool=pool)
         try:
             h.client.write_device_payload([jnp.zeros((16,), jnp.float32)])
-            batch = h.server_conn.take_device_payload()
+            batch = h.take(h.server_conn)
             assert batch is not None
             assert pool.used == 8 << 10          # one small-class block
             del batch
@@ -277,6 +291,11 @@ class TestWindowFlowControl:
             # shrink the take-side wait so the test is fast
             orig = pool.reserve
             pool.reserve = lambda n, timeout_s=10.0: orig(n, timeout_s=0.05)
+            deadline = time.monotonic() + 5
+            while not h.server_conn._lane:
+                h.pump(h.server_conn)
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
             with pytest.raises(MemoryError):
                 h.server_conn.take_device_payload()
             pool.reserve = orig
