@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import logging
 import os
 import struct
 import threading
@@ -54,7 +55,9 @@ import uuid as uuidlib
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
-from brpc_tpu.butil.device_pool import DeviceRecvPool
+from brpc_tpu.butil.device_pool import DeviceRecvPool, round_to_class
+
+logger = logging.getLogger("brpc_tpu.ici")
 from brpc_tpu.butil.endpoint import EndPoint
 from brpc_tpu.transport.base import Conn, Listener, Transport
 from brpc_tpu.transport.tcp import TcpConn, TcpTransport
@@ -89,14 +92,42 @@ def _next_uuid() -> int:
 _server_lock = threading.Lock()
 _transfer_server = None
 _transfer_failed = False
+_transfer_error: Optional[str] = None
 _conn_cache: Dict[str, object] = {}
+_lane_status_var = None
+
+
+def _publish_lane_status() -> None:
+    """Expose transfer-server state as a bvar (/vars ici_transfer_lane)
+    so lane degradation is observable, not a silent latch."""
+    global _lane_status_var
+    try:
+        from brpc_tpu.bvar import Status
+        if _lane_status_var is None:
+            _lane_status_var = Status("init").expose("ici_transfer_lane")
+        _lane_status_var.set_value(
+            "up" if _transfer_server is not None
+            else f"down: {_transfer_error or 'not started'}")
+    except Exception:
+        pass
+
+
+def transfer_lane_status() -> str:
+    """'up' | 'down: <reason>' | 'not started' — the startup-probe hook
+    (rdma_helper.cpp's global-init + fallback story made queryable)."""
+    if _transfer_server is not None:
+        return "up"
+    if _transfer_failed:
+        return f"down: {_transfer_error}"
+    return "not started"
 
 
 def _get_transfer_server():
     """Process-global PjRt transfer server (the rdma_helper.cpp global
     init slot). None when jax/the backend doesn't support it — the
-    staged lane takes over."""
-    global _transfer_server, _transfer_failed
+    staged lane takes over (loudly: warning log + bvar, and
+    BRPC_TPU_ICI_REQUIRE_PULL=1 turns degradation into an error)."""
+    global _transfer_server, _transfer_failed, _transfer_error
     if os.environ.get("BRPC_TPU_ICI_FORCE_STAGED"):
         return None       # test/ops knob: exercise the degraded lane
     if _transfer_server is not None or _transfer_failed:
@@ -114,9 +145,20 @@ def _get_transfer_server():
             host = os.environ.get("BRPC_TPU_TRANSFER_HOST", "0.0.0.0")
             _transfer_server = transfer.start_transfer_server(
                 client, f"{host}:0", [f"{host}:0"])
-        except Exception:
+            logger.info("ici: PjRt transfer server up at %s",
+                        _transfer_server.address())
+        except Exception as e:
             _transfer_failed = True
             _transfer_server = None
+            _transfer_error = f"{type(e).__name__}: {e}"
+            if os.environ.get("BRPC_TPU_ICI_REQUIRE_PULL"):
+                raise ConnectionError(
+                    f"ici: PjRt transfer server unavailable and "
+                    f"BRPC_TPU_ICI_REQUIRE_PULL is set: {_transfer_error}")
+            logger.warning(
+                "ici: PjRt transfer server unavailable — device payloads "
+                "DEGRADE to the host-staged lane (%s)", _transfer_error)
+        _publish_lane_status()
     return _transfer_server
 
 
@@ -147,6 +189,29 @@ def _canonical_addr(addr: str, peer_host: str) -> str:
 # shared default pool: one budget per process, like the reference's one
 # block pool per NIC (rdma/block_pool.cpp global region registry)
 _default_pool = DeviceRecvPool()
+
+
+class _LazyAdder:
+    """Counter that only materializes its bvar on first use."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._var = None
+
+    def add(self, n: int) -> None:
+        try:
+            if self._var is None:
+                from brpc_tpu.bvar import Adder
+                self._var = Adder().expose(self._name)
+            self._var.add(n)
+        except Exception:
+            pass
+
+
+# await_pull registrations whose peer died before pulling: the transfer
+# API has no cancel, so these stay pinned until process exit — counted
+# here so the leak is observable (/vars ici_unpulled_registrations)
+_unpulled_registrations = _LazyAdder("ici_unpulled_registrations")
 
 
 def _encode_descriptor(uid: int, arrays) -> bytes:
@@ -218,9 +283,18 @@ class IciConn(Conn):
         self._appbuf = bytearray()
         self._lane: Deque[Tuple] = deque()       # inbound batch descriptors
         self._closed_read = False
+        self._closed = False
         # flow-control state (sender side)
         self._sent = 0                           # device batches sent
         self._peer_acked = 0                     # cumulative acks from peer
+        # byte budget: footprints of un-ACKed batches, FIFO (the peer
+        # consumes lane batches in order), so bytes-in-flight is
+        # derivable from the cumulative ack count
+        self._inflight_footprints: Deque[int] = deque()
+        self._inflight_bytes = 0
+        # uids this connection registered for peer pull; reclaimed (or at
+        # least counted) on close/failure
+        self._issued_uids: List[int] = []
         # flow-control state (receiver side)
         self._consumed = 0                       # batches we pulled
         self._acked_sent = 0                     # last consumed count sent
@@ -234,6 +308,12 @@ class IciConn(Conn):
             "proc": _PROC_UUID,
             "transfer_addr": srv.address() if srv is not None else None,
             "window": self._window,
+            # advertised recv byte budget: the sender derives its
+            # effective window from this, so a 32-batch window of 8MB
+            # arrays can no longer oversubscribe the receiver's pool
+            # (RDMA sizes the window from pre-posted rbufs,
+            # rdma_endpoint.h:235-241)
+            "budget": self._pool.capacity,
             "device": recv_device_ordinal,
             "can_pull": srv is not None,
         }
@@ -255,28 +335,60 @@ class IciConn(Conn):
         self._acked_sent = self._consumed
         return _HDR.pack(ftype, self._consumed, len(payload)) + payload
 
+    @staticmethod
+    def _batch_footprint(arrays) -> int:
+        """The pool footprint the receiver will reserve for this batch
+        (same size-class rounding as DeviceRecvPool)."""
+        return sum(round_to_class(a.nbytes) for a in arrays)
+
+    def _apply_peer_ack(self, ack: int) -> None:
+        """Advance the cumulative-consumed count and retire the matching
+        FIFO footprints (bytes-in-flight accounting)."""
+        while self._peer_acked < ack and self._inflight_footprints:
+            self._inflight_bytes -= self._inflight_footprints.popleft()
+            self._peer_acked += 1
+        self._peer_acked = max(self._peer_acked, ack)
+
     def _lane_ready(self) -> bool:
-        """May the queue-head device batch go out? (hello + window gate)"""
+        """May the queue-head device batch go out? Gates: hello received
+        (QP up), batch window, and the peer's advertised byte budget —
+        bytes in flight plus this batch must fit, so the receiver's pool
+        admission can never be the thing that blocks a pull."""
         info = self.peer_info
         if info is None:
             return False                     # QP not up yet
-        return (self._sent - self._peer_acked) < int(info.get("window", 1))
+        if (self._sent - self._peer_acked) >= int(info.get("window", 1)):
+            return False
+        budget = info.get("budget")
+        if budget:
+            head = self._outq[0]
+            need = self._batch_footprint(head[1])
+            if (self._inflight_bytes + need > int(budget)
+                    and self._inflight_bytes > 0):
+                # never deadlock on a batch bigger than the whole budget:
+                # an oversized batch goes out alone once the lane drains
+                return False
+        return True
 
     def _stage_lane_frame(self, arrays) -> bytes:
         """Turn a lane batch into its wire frame, registering the arrays
         for peer pull (or falling back to the staged lane)."""
         info = self.peer_info or {}
+        self._inflight_footprints.append(self._batch_footprint(arrays))
+        self._inflight_bytes += self._inflight_footprints[-1]
         if info.get("proc") == _PROC_UUID:
             # same process: in-memory registry; take() device_puts (D2D)
             uid = _next_uuid()
             with _local_lock:
                 _local_exchange[uid] = list(arrays)
+            self._issued_uids.append(uid)
             self._sent += 1
             return self._frame(F_DESCRIPTOR, _encode_descriptor(uid, arrays))
         srv = _get_transfer_server()
         if srv is not None and info.get("can_pull"):
             uid = _next_uuid()
             srv.await_pull(uid, list(arrays))
+            self._issued_uids.append(uid)
             self._sent += 1
             return self._frame(F_DESCRIPTOR, _encode_descriptor(uid, arrays))
         # degraded lane: host-staged numpy bytes over the control stream
@@ -359,7 +471,7 @@ class IciConn(Conn):
             payload = bytes(self._inbuf[_HDR.size:_HDR.size + length])
             del self._inbuf[:_HDR.size + length]
             if ack > self._peer_acked:
-                self._peer_acked = ack
+                self._apply_peer_ack(ack)
                 window_opened = True
             if ftype == F_BYTES:
                 self._appbuf += payload
@@ -427,18 +539,22 @@ class IciConn(Conn):
                 return None
             kind, a, b = self._lane.popleft()
         import jax
-        if kind == "staged":
-            batch = _decode_device_batch(a)
-            target = self._recv_device()
-            out = [jax.device_put(x, target) for x in batch]
-        else:
-            uid, specs = a, b
-            info = self.peer_info or {}
-            target = self._recv_device()
-            footprints: List[int] = []
-            try:
-                # reserve inside the try: a partial multi-array reservation
-                # must be released when a later reserve raises
+        target = self._recv_device()
+        footprints: List[int] = []
+        try:
+            # reserve inside the try: a partial multi-array reservation
+            # must be released when a later reserve raises. BOTH lanes
+            # reserve — the staged fallback is subject to the same HBM
+            # admission control as the pull path (a peer without a
+            # transfer server must not escape the budget).
+            if kind == "staged":
+                batch = _decode_device_batch(a)
+                for x in batch:
+                    footprints.append(self._pool.reserve(x.nbytes))
+                out = [jax.device_put(x, target) for x in batch]
+            else:
+                uid, specs = a, b
+                info = self.peer_info or {}
                 for s in specs:
                     footprints.append(self._pool.reserve(s["nbytes"]))
                 if info.get("proc") == _PROC_UUID:
@@ -457,13 +573,22 @@ class IciConn(Conn):
                     sds = [jax.ShapeDtypeStruct(
                         s["shape"], _np_dtype(s["dtype"]),
                         sharding=sharding) for s in specs]
-                    out = pconn.pull(uid, sds)
-            except BaseException:
-                for f in footprints:
-                    self._pool.release(f)
-                raise
-            for arr, f in zip(out, footprints):
-                self._pool.attach_finalizer(arr, f)
+                    try:
+                        out = pconn.pull(uid, sds)
+                    except BaseException:
+                        # a failed pull poisons the cached connection
+                        # (peer restart leaves a half-dead channel):
+                        # drop it so the next pull redials
+                        with _server_lock:
+                            if _conn_cache.get(addr) is pconn:
+                                del _conn_cache[addr]
+                        raise
+        except BaseException:
+            for f in footprints:
+                self._pool.release(f)
+            raise
+        for arr, f in zip(out, footprints):
+            self._pool.attach_finalizer(arr, f)
         with self._pump_lock:
             self._consumed += 1
         self._maybe_send_ack()
@@ -471,7 +596,33 @@ class IciConn(Conn):
 
     # --------------------------------------------------------- plumbing
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        # best-effort flush: Socket's keep_write reported success for
+        # frames that may still sit in _outq/_wirebuf behind a window
+        # gate or TCP backpressure — don't silently drop them on close
+        try:
+            self._flush()
+        except Exception:
+            pass
         self._inner.close()
+        # reclaim sender-side lane registrations: same-process entries
+        # are dropped from the process-global exchange; cross-process
+        # await_pull registrations have no cancel API, so count the
+        # un-ACKed (≈ never-pulled) batches the peer left pinned
+        # (observable at /vars ici_unpulled_registrations, not silent)
+        with _local_lock:
+            for uid in self._issued_uids:
+                _local_exchange.pop(uid, None)
+        self._issued_uids.clear()
+        outstanding = self._sent - self._peer_acked
+        if outstanding > 0 and (self.peer_info or {}).get("proc") != _PROC_UUID:
+            _unpulled_registrations.add(outstanding)
+        # drop any inbound descriptors never taken (their uids live in
+        # the PEER's registry; our pool never reserved for them)
+        with self._pump_lock:
+            self._lane.clear()
 
     def start_events(self, on_readable: Callable[[], None],
                      on_writable: Callable[[], None]) -> None:
@@ -544,7 +695,12 @@ class IciTransport(Transport):
         ready = threading.Event()
 
         def wrap(conn: TcpConn):
-            ready.wait(5)
+            if not ready.wait(5):
+                # listener bring-up stalled: fail the accepted conn
+                # cleanly instead of NameError-ing on `bound` below
+                conn.close()
+                raise ConnectionError("ici: listener endpoint not bound "
+                                      "within 5s; dropping accepted conn")
             on_new_conn(IciConn(conn, bound, conn.remote_endpoint,
                                 recv_device_ordinal=ordinal,
                                 window=self._window, pool=self._pool))

@@ -39,8 +39,14 @@ def main():
     server.add_service(svc)
     ep = server.start("ici://127.0.0.1:0#device=3")
     print(f"PORT {ep.port}", flush=True)
+    # parent-death watchdog: if the pytest process dies without
+    # terminate() (crash, kill -9, harness timeout) we get reparented —
+    # exit instead of orphaning a chip-wedging process forever
+    parent = os.getppid()
     while True:
         time.sleep(1)
+        if os.getppid() != parent:
+            os._exit(0)
 
 
 if __name__ == "__main__":
