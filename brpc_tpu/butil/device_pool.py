@@ -10,10 +10,13 @@ inbound device batch must reserve its (size-class-rounded) bytes before
 the pull DMA is issued, and the reservation is released when the
 application drops the arrays (tracked with weakref finalizers, the
 moral equivalent of the rbuf block being returned to the pool when the
-parsing IOBuf releases it, rdma_endpoint.h:145). The sliding window
-(transport/ici.py) is sized against this budget, so a peer can never
-oversubscribe the receiver's HBM — the same invariant RDMA gets from
-pre-posted recv buffers.
+parsing IOBuf releases it, rdma_endpoint.h:145). Each connection
+advertises a per-connection byte budget (window x largest block class,
+capped by this pool) in its hello and the sender gates on bytes in
+flight, so a single peer's in-flight bytes are bounded exactly like
+RDMA's per-QP pre-posted rbufs; AGGREGATE pressure from many senders
+lands on this pool's blocking reserve() — the same way rbuf posting
+blocks when the shared block pool runs dry.
 """
 
 from __future__ import annotations

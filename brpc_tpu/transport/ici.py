@@ -55,7 +55,8 @@ import uuid as uuidlib
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
-from brpc_tpu.butil.device_pool import DeviceRecvPool, round_to_class
+from brpc_tpu.butil.device_pool import (BLOCK_CLASSES, DeviceRecvPool,
+                                        round_to_class)
 
 logger = logging.getLogger("brpc_tpu.ici")
 from brpc_tpu.butil.endpoint import EndPoint
@@ -210,8 +211,28 @@ class _LazyAdder:
 
 # await_pull registrations whose peer died before pulling: the transfer
 # API has no cancel, so these stay pinned until process exit — counted
-# here so the leak is observable (/vars ici_unpulled_registrations)
+# here so the leak is observable (/vars ici_unpulled_registrations).
+# UPPER BOUND: un-ACKed pull-registered batches at close; a batch the
+# peer pulled but had not yet acknowledged is counted too.
 _unpulled_registrations = _LazyAdder("ici_unpulled_registrations")
+
+# same-process exchange entries from closed connections are reclaimed on
+# a grace timer, not immediately: close() flushes queued descriptor
+# frames, so the peer may legitimately still take them — an instant pop
+# would turn that take into an error
+_RECLAIM_GRACE_S = 30.0
+_reclaim_queue: Deque[Tuple[float, int]] = deque()
+
+
+def _sweep_reclaim(now: Optional[float] = None) -> None:
+    """Drop expired same-process exchange entries (called
+    opportunistically from lane activity and close)."""
+    import time as _time
+    now = _time.monotonic() if now is None else now
+    with _local_lock:
+        while _reclaim_queue and _reclaim_queue[0][0] <= now:
+            _, uid = _reclaim_queue.popleft()
+            _local_exchange.pop(uid, None)
 
 
 def _encode_descriptor(uid: int, arrays) -> bytes:
@@ -285,6 +306,10 @@ class IciConn(Conn):
         self._closed_read = False
         self._closed = False
         # flow-control state (sender side)
+        # flow-control state below is touched from the flush path (under
+        # _flush_lock) AND the pump path (under _pump_lock) — it needs
+        # its own lock, not either of those
+        self._fc_lock = threading.Lock()
         self._sent = 0                           # device batches sent
         self._peer_acked = 0                     # cumulative acks from peer
         # byte budget: footprints of un-ACKed batches, FIFO (the peer
@@ -295,6 +320,7 @@ class IciConn(Conn):
         # uids this connection registered for peer pull; reclaimed (or at
         # least counted) on close/failure
         self._issued_uids: List[int] = []
+        self._pull_registered = 0                # await_pull count (no cancel)
         # flow-control state (receiver side)
         self._consumed = 0                       # batches we pulled
         self._acked_sent = 0                     # last consumed count sent
@@ -309,11 +335,18 @@ class IciConn(Conn):
             "transfer_addr": srv.address() if srv is not None else None,
             "window": self._window,
             # advertised recv byte budget: the sender derives its
-            # effective window from this, so a 32-batch window of 8MB
-            # arrays can no longer oversubscribe the receiver's pool
-            # (RDMA sizes the window from pre-posted rbufs,
-            # rdma_endpoint.h:235-241)
-            "budget": self._pool.capacity,
+            # effective window from this. Like RDMA's per-connection
+            # pre-posted rbufs (rdma_endpoint.h:235-241) it is a
+            # PER-CONNECTION bound — window × the largest block class —
+            # capped by the pool; aggregate pressure from many senders
+            # still lands on the pool's blocking admission, exactly as
+            # rbuf posting does when the block pool runs dry.
+            # max_batch is the pool capacity: the largest single batch
+            # the receiver could EVER admit (bigger ones are unsendable;
+            # batches between budget and max_batch go out alone)
+            "budget": min(self._pool.capacity,
+                          self._window * BLOCK_CLASSES[-1]),
+            "max_batch": self._pool.capacity,
             "device": recv_device_ordinal,
             "can_pull": srv is not None,
         }
@@ -344,29 +377,44 @@ class IciConn(Conn):
     def _apply_peer_ack(self, ack: int) -> None:
         """Advance the cumulative-consumed count and retire the matching
         FIFO footprints (bytes-in-flight accounting)."""
-        while self._peer_acked < ack and self._inflight_footprints:
-            self._inflight_bytes -= self._inflight_footprints.popleft()
-            self._peer_acked += 1
-        self._peer_acked = max(self._peer_acked, ack)
+        with self._fc_lock:
+            while self._peer_acked < ack and self._inflight_footprints:
+                self._inflight_bytes -= self._inflight_footprints.popleft()
+                self._peer_acked += 1
+            self._peer_acked = max(self._peer_acked, ack)
+
+    def _unsendable_reason(self, arrays) -> Optional[str]:
+        """A batch no receiver state could ever admit (footprint over
+        the peer's pool capacity — pool.reserve rejects those outright)
+        must fail at the source, not wedge the lane. Returns the error
+        text, or None when sendable / peer unknown."""
+        max_batch = int((self.peer_info or {}).get("max_batch") or 0)
+        if max_batch:
+            need = self._batch_footprint(arrays)
+            if need > max_batch:
+                return (f"ici: device batch footprint {need}B exceeds "
+                        f"the peer's pool capacity {max_batch}B — "
+                        f"unsendable (split the batch or raise the "
+                        f"peer's DeviceRecvPool capacity)")
+        return None
 
     def _lane_ready(self) -> bool:
         """May the queue-head device batch go out? Gates: hello received
         (QP up), batch window, and the peer's advertised byte budget —
-        bytes in flight plus this batch must fit, so the receiver's pool
-        admission can never be the thing that blocks a pull."""
+        bytes in flight plus this batch must fit, so per-connection
+        in-flight bytes can never exceed what the receiver advertised.
+        A batch larger than the budget (but within the peer's pool
+        capacity) goes out ALONE once the lane drains."""
         info = self.peer_info
         if info is None:
             return False                     # QP not up yet
-        if (self._sent - self._peer_acked) >= int(info.get("window", 1)):
-            return False
-        budget = info.get("budget")
-        if budget:
-            head = self._outq[0]
-            need = self._batch_footprint(head[1])
-            if (self._inflight_bytes + need > int(budget)
+        budget = int(info.get("budget") or 0)
+        need = self._batch_footprint(self._outq[0][1])
+        with self._fc_lock:
+            if (self._sent - self._peer_acked) >= int(info.get("window", 1)):
+                return False
+            if (budget and self._inflight_bytes + need > budget
                     and self._inflight_bytes > 0):
-                # never deadlock on a batch bigger than the whole budget:
-                # an oversized batch goes out alone once the lane drains
                 return False
         return True
 
@@ -374,26 +422,33 @@ class IciConn(Conn):
         """Turn a lane batch into its wire frame, registering the arrays
         for peer pull (or falling back to the staged lane)."""
         info = self.peer_info or {}
-        self._inflight_footprints.append(self._batch_footprint(arrays))
-        self._inflight_bytes += self._inflight_footprints[-1]
+        footprint = self._batch_footprint(arrays)
         if info.get("proc") == _PROC_UUID:
             # same process: in-memory registry; take() device_puts (D2D)
             uid = _next_uuid()
             with _local_lock:
                 _local_exchange[uid] = list(arrays)
             self._issued_uids.append(uid)
+            frame = self._frame(F_DESCRIPTOR, _encode_descriptor(uid, arrays))
+        else:
+            srv = _get_transfer_server()
+            if srv is not None and info.get("can_pull"):
+                uid = _next_uuid()
+                srv.await_pull(uid, list(arrays))
+                self._issued_uids.append(uid)
+                with self._fc_lock:
+                    self._pull_registered += 1
+                frame = self._frame(F_DESCRIPTOR,
+                                    _encode_descriptor(uid, arrays))
+            else:
+                # degraded lane: host-staged numpy over the control stream
+                frame = self._frame(F_STAGED, _encode_device_batch(arrays))
+        with self._fc_lock:
+            self._inflight_footprints.append(footprint)
+            self._inflight_bytes += footprint
             self._sent += 1
-            return self._frame(F_DESCRIPTOR, _encode_descriptor(uid, arrays))
-        srv = _get_transfer_server()
-        if srv is not None and info.get("can_pull"):
-            uid = _next_uuid()
-            srv.await_pull(uid, list(arrays))
-            self._issued_uids.append(uid)
-            self._sent += 1
-            return self._frame(F_DESCRIPTOR, _encode_descriptor(uid, arrays))
-        # degraded lane: host-staged numpy bytes over the control stream
-        self._sent += 1
-        return self._frame(F_STAGED, _encode_device_batch(arrays))
+        _sweep_reclaim()
+        return frame
 
     def _flush(self) -> bool:
         """Drain wirebuf + eligible queue items into TCP. Single-flight
@@ -407,17 +462,27 @@ class IciConn(Conn):
                         self._inner.request_writable_event()
                         return False
                     del self._wirebuf[:n]
+                poison = None
                 with self._lock:
                     if not self._outq:
                         return True
                     item = self._outq[0]
-                    if item[0] == "lane" and not self._lane_ready():
-                        # out of credit: park until an ACK frame arrives
-                        self._want_writable = True
-                        return False
-                    self._outq.popleft()
-                    if item[0] == "bytes":
-                        self._out_bytes -= len(item[1])
+                    if item[0] == "lane":
+                        poison = self._unsendable_reason(item[1])
+                        if poison is not None:
+                            # pop BEFORE raising: the poison item must
+                            # not re-fire on every later flush
+                            self._outq.popleft()
+                        elif not self._lane_ready():
+                            # out of credit: park until an ACK arrives
+                            self._want_writable = True
+                            return False
+                    if poison is None:
+                        self._outq.popleft()
+                        if item[0] == "bytes":
+                            self._out_bytes -= len(item[1])
+                if poison is not None:
+                    raise ConnectionError(poison)
                 if item[0] == "bytes":
                     self._wirebuf += self._frame(F_BYTES, item[1])
                 elif item[0] == "ctrl":
@@ -441,6 +506,11 @@ class IciConn(Conn):
             if not isinstance(a, jax.Array):
                 a = jax.device_put(a)
             staged.append(a)
+        # fail-fast at the call site when the peer is already known
+        # (otherwise flush-time detection fails the connection)
+        reason = self._unsendable_reason(staged)
+        if reason is not None:
+            raise ConnectionError(reason)
         self._enqueue(("lane", staged))
         self._flush()
         return True
@@ -561,7 +631,12 @@ class IciConn(Conn):
                     # same-process: receiver-driven device_put = the D2D
                     # copy (ICI hop on real multi-chip hardware)
                     with _local_lock:
-                        arrays = _local_exchange.pop(uid)
+                        arrays = _local_exchange.pop(uid, None)
+                    if arrays is None:
+                        raise ConnectionError(
+                            "ici: same-process batch no longer available "
+                            "(sender closed and its registration was "
+                            "reclaimed)")
                     out = [a if (hasattr(a, "devices")
                                  and target in a.devices())
                            else jax.device_put(a, target) for a in arrays]
@@ -584,6 +659,12 @@ class IciConn(Conn):
                                 del _conn_cache[addr]
                         raise
         except BaseException:
+            # admission timeout (MemoryError after reserve's 10s wait)
+            # or pull failure: the error escapes into the input path,
+            # which drops the CONNECTION — the batch is lost with it and
+            # the sender learns through the conn failure + RPC retry,
+            # the same resolution RDMA reaches when rbufs can't be
+            # posted and the QP tears down
             for f in footprints:
                 self._pool.release(f)
             raise
@@ -607,18 +688,39 @@ class IciConn(Conn):
         except Exception:
             pass
         self._inner.close()
-        # reclaim sender-side lane registrations: same-process entries
-        # are dropped from the process-global exchange; cross-process
-        # await_pull registrations have no cancel API, so count the
-        # un-ACKed (≈ never-pulled) batches the peer left pinned
-        # (observable at /vars ici_unpulled_registrations, not silent)
+        # reclaim sender-side lane registrations. Same-process entries
+        # go on a GRACE timer rather than being popped now: the flush
+        # above may have just delivered their descriptors, and the peer
+        # taking one after an instant pop would see a phantom error.
+        # Cross-process await_pull registrations have no cancel API, so
+        # the un-ACKed pull-registered batches are counted (an upper
+        # bound: pulled-but-unacked ones are included) at
+        # /vars ici_unpulled_registrations instead of pinning silently.
+        import time as _time
+        deadline = _time.monotonic() + _RECLAIM_GRACE_S
+        queued = False
         with _local_lock:
             for uid in self._issued_uids:
-                _local_exchange.pop(uid, None)
+                if uid in _local_exchange:
+                    _reclaim_queue.append((deadline, uid))
+                    queued = True
         self._issued_uids.clear()
-        outstanding = self._sent - self._peer_acked
+        if queued:
+            # a timer guarantees the sweep even if no further lane
+            # activity ever happens in this process (otherwise the
+            # queued entries would pin device arrays until exit)
+            try:
+                from brpc_tpu.fiber.timer import global_timer
+                global_timer().schedule_after(_RECLAIM_GRACE_S + 0.5,
+                                              _sweep_reclaim)
+            except Exception:
+                pass
+        with self._fc_lock:
+            outstanding = min(self._sent - self._peer_acked,
+                              self._pull_registered)
         if outstanding > 0 and (self.peer_info or {}).get("proc") != _PROC_UUID:
             _unpulled_registrations.add(outstanding)
+        _sweep_reclaim()
         # drop any inbound descriptors never taken (their uids live in
         # the PEER's registry; our pool never reserved for them)
         with self._pump_lock:
@@ -660,7 +762,8 @@ class IciConn(Conn):
 
     @property
     def outstanding_batches(self) -> int:
-        return self._sent - self._peer_acked
+        with self._fc_lock:
+            return self._sent - self._peer_acked
 
 
 class _IciListener(Listener):

@@ -343,30 +343,54 @@ class TestByteBudgetWindow:
         finally:
             h.close()
 
-    def test_oversized_batch_goes_alone(self):
-        """A batch bigger than the whole budget must not deadlock: it
-        flies once the lane drains (alone), rather than waiting for
-        budget that can never exist."""
+    def test_midsize_batch_goes_alone(self):
+        """A batch over the per-connection budget but within the peer's
+        pool capacity is admissible — it flies alone once the lane
+        drains instead of failing."""
+        import jax.numpy as jnp
+        pool = DeviceRecvPool(capacity_bytes=8 << 20)
+        h = _ConnHarness(window=1, pool=pool)   # budget = 1 x 2MB
+        try:
+            # 4MB floats: footprint 4MB > 2MB budget, <= 8MB capacity
+            h.client.write_device_payload(
+                [jnp.zeros((1 << 20,), jnp.float32)])
+            assert h.client.outstanding_batches == 1
+            b = h.take(h.server_conn)
+            assert b is not None and b[0].nbytes == 4 << 20
+        finally:
+            h.close()
+
+    def test_oversized_batch_fails_loudly(self):
+        """A batch bigger than the peer's whole budget could NEVER be
+        admitted (pool.reserve rejects footprints over capacity) — the
+        sender must fail it at the source, not wedge the lane."""
         import jax.numpy as jnp
         pool = DeviceRecvPool(capacity_bytes=16 << 10)
         h = _ConnHarness(window=4, pool=pool)
         try:
             # 64K of floats -> 64K-class footprint > 16K budget
-            h.client.write_device_payload(
-                [jnp.zeros((16 << 10,), jnp.float32)])
-            assert h.client.outstanding_batches == 1
+            with pytest.raises(ConnectionError, match="exceeds the"):
+                h.client.write_device_payload(
+                    [jnp.zeros((16 << 10,), jnp.float32)])
         finally:
             h.close()
 
 
 class TestLaneLifecycle:
-    def test_close_reclaims_local_exchange(self):
+    def test_close_reclaims_local_exchange_after_grace(self):
+        """Entries survive close() for a grace period (the peer may
+        still take a just-flushed descriptor), then the sweep drops
+        them."""
         import jax.numpy as jnp
         h = _ConnHarness(window=4)
         h.client.write_device_payload([jnp.zeros((4,), jnp.float32)])
         uids = list(h.client._issued_uids)
         assert uids and all(u in ici._local_exchange for u in uids)
         h.close()
+        # still takeable within the grace window
+        assert all(u in ici._local_exchange for u in uids)
+        # after the grace deadline the sweep reclaims
+        ici._sweep_reclaim(now=time.monotonic() + ici._RECLAIM_GRACE_S + 1)
         assert all(u not in ici._local_exchange for u in uids)
 
     def test_staged_lane_reserves_pool(self):
