@@ -305,6 +305,11 @@ class IciConn(Conn):
         self._lane: Deque[Tuple] = deque()       # inbound batch descriptors
         self._closed_read = False
         self._closed = False
+        # set when an unsendable batch is detected at flush time: every
+        # later write/flush refuses, so no frame can follow the popped
+        # poison item and the lane/envelope FIFO pairing stays intact
+        # even if a channel catches the error and retries
+        self._poisoned: Optional[str] = None
         # flow-control state (sender side)
         # flow-control state below is touched from the flush path (under
         # _flush_lock) AND the pump path (under _pump_lock) — it needs
@@ -453,6 +458,8 @@ class IciConn(Conn):
     def _flush(self) -> bool:
         """Drain wirebuf + eligible queue items into TCP. Single-flight
         (two flushers would interleave framed bytes). True = all drained."""
+        if self._poisoned is not None:
+            raise ConnectionError(self._poisoned)
         with self._flush_lock:
             while True:
                 while self._wirebuf:
@@ -470,9 +477,13 @@ class IciConn(Conn):
                     if item[0] == "lane":
                         poison = self._unsendable_reason(item[1])
                         if poison is not None:
-                            # pop BEFORE raising: the poison item must
-                            # not re-fire on every later flush
+                            # poison the whole connection, not just the
+                            # item: later writes must not slip past the
+                            # popped batch or the receiver would FIFO-
+                            # match some other RPC's arrays to this
+                            # RPC's envelope
                             self._outq.popleft()
+                            self._poisoned = poison
                         elif not self._lane_ready():
                             # out of credit: park until an ACK arrives
                             self._want_writable = True
@@ -491,6 +502,8 @@ class IciConn(Conn):
                     self._wirebuf += self._stage_lane_frame(item[1])
 
     def write(self, mv: memoryview) -> int:
+        if self._poisoned is not None:
+            raise ConnectionError(self._poisoned)
         data = bytes(mv)
         self._enqueue(("bytes", data))
         self._flush()
@@ -506,8 +519,10 @@ class IciConn(Conn):
             if not isinstance(a, jax.Array):
                 a = jax.device_put(a)
             staged.append(a)
+        if self._poisoned is not None:
+            raise ConnectionError(self._poisoned)
         # fail-fast at the call site when the peer is already known
-        # (otherwise flush-time detection fails the connection)
+        # (otherwise flush-time detection poisons the connection)
         reason = self._unsendable_reason(staged)
         if reason is not None:
             raise ConnectionError(reason)

@@ -1,4 +1,6 @@
-"""Cross-host device-RPC client: sends arrays over the tpud envelope."""
+"""Cross-host device-RPC client: arrays travel the ici:// device lane
+(receiver-driven PjRt pull DMA when both sides have a transfer server;
+check the printed lane_kind)."""
 
 import sys
 
@@ -9,8 +11,8 @@ import numpy as np
 from brpc_tpu.rpc import Channel, ChannelOptions
 
 
-def main(addr: str = "tpud://127.0.0.1:8750") -> None:
-    ch = Channel(addr, ChannelOptions(timeout_ms=10000))
+def main(addr: str = "ici://127.0.0.1:8750#reply_device=0") -> None:
+    ch = Channel(addr, ChannelOptions(timeout_ms=30000))
     x = np.arange(8, dtype=np.float32)
     cntl = ch.call_sync("TensorService", "Scale", b"3",
                         request_device_arrays=[x])
@@ -18,6 +20,7 @@ def main(addr: str = "tpud://127.0.0.1:8750") -> None:
     out = np.asarray(cntl.response_device_arrays[0])
     print("sent     ", x)
     print("received ", out)
+    print("lane     ", ch._socket.conn.lane_kind)
     print("peer info", ch._socket.conn.peer_info)
     ch.close()
 

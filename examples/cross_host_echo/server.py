@@ -1,24 +1,26 @@
-"""Cross-host device-RPC server (tpud:// — the DCN path): run this on
-one host, client.py on another (or another process on the same host)."""
+"""Cross-host device-RPC server over ici:// — the PjRt pull-DMA data
+plane (the RDMA slot; falls back to the host-staged lane when either
+side lacks a transfer server). Run this on one host, client.py on
+another (or another process on the same host)."""
 
 import sys
 
 sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
 
-import numpy as np
-
 from brpc_tpu.rpc import Server, Service
 
 
-def main(addr: str = "tpud://127.0.0.1:8750") -> None:
+def main(addr: str = "ici://127.0.0.1:8750#device=0") -> None:
     server = Server()
     svc = Service("TensorService")
 
     @svc.method()
     def Scale(cntl, request):
         factor = float(bytes(request) or b"2")
+        # the arrays already live on THIS process's device (the lane
+        # pulled them); scale on-device, no host round-trip
         cntl.response_device_arrays = [
-            np.asarray(a) * factor for a in cntl.request_device_arrays]
+            a * factor for a in cntl.request_device_arrays]
         return b"scaled"
 
     server.add_service(svc)

@@ -1,4 +1,9 @@
-"""Bidirectional streaming echo (example/streaming_echo_c++)."""
+"""Bidirectional streaming echo (example/streaming_echo_c++) over the
+ici:// device-fabric transport, with per-frame latency percentiles.
+
+Streams ride the same connection as ordinary RPCs (stream ids
+piggyback on the Open call), so this exercises credit-based stream
+flow control on top of the ici framing."""
 
 import sys
 import time
@@ -6,33 +11,48 @@ import time
 sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
 
 from brpc_tpu import fiber
+from brpc_tpu.bvar.latency_recorder import LatencyRecorder
 from brpc_tpu.rpc import Channel, Server, ServerOptions, Service
 from brpc_tpu.rpc.stream import StreamOptions, stream_accept
 
 
-def main(n_frames: int = 20) -> None:
+def main(n_frames: int = 20, address: str = "") -> None:
     n_frames = int(n_frames)
-    server = Server(ServerOptions(enable_builtin_services=False))
-    svc = Service("StreamEcho")
+    server = None
+    if not address:
+        server = Server(ServerOptions(enable_builtin_services=False))
+        svc = Service("StreamEcho")
 
-    @svc.method()
-    def Open(cntl, request):
-        def on_received(stream, msg):
-            stream.write_nowait(b"echo:" + msg.payload.to_bytes())
-        stream_accept(cntl, StreamOptions(on_received=on_received))
-        return b"accepted"
+        @svc.method()
+        def Open(cntl, request):
+            def on_received(stream, msg):
+                stream.write_nowait(b"echo:" + msg.payload.to_bytes())
+            stream_accept(cntl, StreamOptions(on_received=on_received))
+            return b"accepted"
 
-    server.add_service(svc)
-    ep = server.start("mem://streaming-echo")
+        server.add_service(svc)
+        ep = server.start("ici://127.0.0.1:0#device=0")
+        address = f"ici://127.0.0.1:{ep.port}"
 
     got = []
-    ch = Channel(str(ep))
-    cntl = ch.call_sync("StreamEcho", "Open", b"", stream_options=StreamOptions(
-        on_received=lambda s, m: got.append(m.payload.to_bytes())))
+    rec = LatencyRecorder()
+    sent_ns = {}
+    ch = Channel(address)
+    def on_echo(s, m):
+        body = m.payload.to_bytes()
+        got.append(body)
+        idx = body.rsplit(b"-", 1)[-1]
+        t0 = sent_ns.pop(idx, None)
+        if t0 is not None:
+            rec.record((time.perf_counter_ns() - t0) / 1e3)
+
+    cntl = ch.call_sync("StreamEcho", "Open", b"",
+                        stream_options=StreamOptions(on_received=on_echo))
     stream = cntl.stream
 
     async def producer():
         for i in range(n_frames):
+            sent_ns[str(i).encode()] = time.perf_counter_ns()
             ok = await stream.write(f"frame-{i}".encode())
             assert ok, "stream write failed"
 
@@ -43,9 +63,13 @@ def main(n_frames: int = 20) -> None:
         time.sleep(0.01)
     print(f"sent {n_frames} frames, got {len(got)} echoes; "
           f"first={got[0]!r} last={got[-1]!r}")
+    print(f"frame rtt: p50={rec.latency_percentile(0.5):.1f}us "
+          f"p99={rec.latency_percentile(0.99):.1f}us")
     stream.close()
-    server.stop()
-    server.join(2)
+    ch.close()
+    if server is not None:
+        server.stop()
+        server.join(2)
 
 
 if __name__ == "__main__":

@@ -376,6 +376,50 @@ class TestByteBudgetWindow:
             h.close()
 
 
+class TestPoisonedLane:
+    def test_pre_hello_oversized_poisons_connection(self):
+        """An unsendable batch that slips past the write-time check
+        (peer unknown) poisons the whole connection at flush time — no
+        later frame may follow it, or the receiver would FIFO-match
+        another RPC's arrays to the dead RPC's envelope."""
+        import jax.numpy as jnp
+        pool = DeviceRecvPool(capacity_bytes=16 << 10)
+        tr = ici.IciTransport(window=4, pool=pool)
+        holder = []
+        evt = threading.Event()
+        listener = tr.listen(
+            str2endpoint("ici://127.0.0.1:0"),
+            lambda c: (holder.append(c), evt.set()))
+        client = tr.connect(
+            str2endpoint(f"ici://127.0.0.1:{listener.endpoint.port}"))
+        try:
+            if client.peer_info is None:
+                # 64K floats -> 64K footprint > 16K pool capacity, but
+                # the peer is unknown yet so the write is accepted
+                client.write_device_payload(
+                    [jnp.zeros((16 << 10,), jnp.float32)])
+                deadline = time.monotonic() + 5
+                while (client._poisoned is None
+                       and time.monotonic() < deadline):
+                    try:
+                        _ConnHarness.pump(client)
+                    except ConnectionError:
+                        break
+                    time.sleep(0.01)
+                assert client._poisoned is not None
+                with pytest.raises(ConnectionError):
+                    client.write(memoryview(b"x"))
+                with pytest.raises(ConnectionError):
+                    client.write_device_payload(
+                        [jnp.zeros((4,), jnp.float32)])
+        finally:
+            client.close()
+            evt.wait(5)
+            for c in holder:
+                c.close()
+            listener.stop()
+
+
 class TestLaneLifecycle:
     def test_close_reclaims_local_exchange_after_grace(self):
         """Entries survive close() for a grace period (the peer may

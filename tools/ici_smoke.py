@@ -63,27 +63,40 @@ def main() -> None:
     from brpc_tpu.rpc import Channel, ChannelOptions
     from brpc_tpu.transport import ici
 
+    import tempfile
+
     evidence: dict = {
         "ok": False, "stage": "spawn",
         "mode": "cpu-dryrun" if os.environ.get("BRPC_TPU_SMOKE_CPU")
                 else "real-backend",
     }
+    # stderr to a FILE, not a pipe: a chatty child blocking on an
+    # undrained pipe would never print PORT; stdout is read
+    # non-blocking so the 180s deadline actually fires even when the
+    # child's backend bring-up hangs mid-line
+    errf = tempfile.NamedTemporaryFile("w+", suffix=".log", delete=False)
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--serve"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        stdout=subprocess.PIPE, stderr=errf)
     try:
+        os.set_blocking(proc.stdout.fileno(), False)
         port = None
+        pending = b""
         deadline = time.monotonic() + 180
-        while time.monotonic() < deadline:
-            line = proc.stdout.readline()
-            if line.startswith("PORT "):
-                port = int(line.split()[1])
-                break
-            if proc.poll() is not None:
-                raise RuntimeError(
-                    f"server died: {proc.stderr.read()[-2000:]}")
+        while time.monotonic() < deadline and port is None:
+            chunk = proc.stdout.read()
+            if chunk:
+                pending += chunk
+                for line in pending.decode("utf-8", "replace").splitlines():
+                    if line.startswith("PORT "):
+                        port = int(line.split()[1])
+                        break
+            if proc.poll() is not None and port is None:
+                errf.seek(0)
+                raise RuntimeError(f"server died: {errf.read()[-2000:]}")
+            time.sleep(0.1)
         if not port:
-            raise RuntimeError("server never printed its port")
+            raise RuntimeError("server never printed its port within 180s")
 
         evidence["stage"] = "backend_init"
         import jax
@@ -134,7 +147,8 @@ def main() -> None:
         os.path.abspath(__file__))), "ICI_SMOKE.json")
     with open(out_path, "w") as f:
         json.dump(evidence, f, indent=1)
-    print(json.dumps(evidence))
+    print(json.dumps(evidence), flush=True)
+    sys.stderr.flush()
     os._exit(0 if evidence["ok"] else 1)
 
 
