@@ -1,12 +1,17 @@
-"""DeviceEventPoller: park fibers on device/async futures.
+"""DeviceEventPoller: park fibers on device/async futures — event-driven.
 
 The north-star twist on the fork's RingListener/EloqModule design
 (bthread/ring_listener.h:115, eloq_module.h:60): instead of an io_uring
-CQE pump per worker group, one poller thread drains *device event*
-completions — jax.Array readiness (`.is_ready()` over PjRt's future) and
-concurrent.futures.Future — and reschedules the parked fiber into its
-(possibly bound) group, so RPC handlers can launch XLA computations
-without burning a worker thread on `block_until_ready`.
+CQE pump per worker group, device completions wake fibers through real
+blocking waits, not polling:
+
+* concurrent.futures.Future → its own ``add_done_callback`` (zero cost);
+* jax.Array (and anything with ``block_until_ready``) → a small pool of
+  waiter threads each parks INSIDE PjRt's C++ future wait (the GIL is
+  released), so the wake is the runtime's own completion signal — the
+  io_uring CQE analog — with µs latency instead of a sleep-loop quantum;
+* exotic objects with only ``is_ready()`` → the legacy spin-then-sleep
+  pump, kept as a fallback.
 """
 
 from __future__ import annotations
@@ -15,6 +20,11 @@ import threading
 from typing import Any, Callable, List, Optional, Tuple
 
 from brpc_tpu.fiber.scheduler import Fiber, SchedAwaitable
+
+# cap on concurrently-parked waiter threads; beyond it new waits fall
+# back to the fair poll pump (a bounded executor QUEUE would let 32
+# stalled waits starve a ready one behind them)
+_MAX_WAITERS = 128
 
 
 def _is_ready(obj: Any) -> bool:
@@ -28,7 +38,7 @@ def _is_ready(obj: Any) -> bool:
 
 
 class DeviceEventPoller:
-    """Single pump thread; adaptive spin-then-sleep polling."""
+    """Event-driven waits with a polling fallback pump."""
 
     def __init__(self, name: str = "device_poller"):
         self._cond = threading.Condition()
@@ -36,10 +46,13 @@ class DeviceEventPoller:
         self._thread: Optional[threading.Thread] = None
         self._name = name
         self._stop = False
+        self._active_waiters = 0
+        self._waiter_lock = threading.Lock()
 
     def watch(self, obj: Any, on_ready: Callable[[], None]) -> None:
-        """Call on_ready() once obj becomes ready. If a Future supports
-        callbacks, use them directly (no polling)."""
+        """Call on_ready() once obj becomes ready. Prefers real
+        completion signals (done-callback / blocking C++ wait) over
+        polling."""
         add_cb = getattr(obj, "add_done_callback", None)
         if add_cb is not None:
             add_cb(lambda _f: on_ready())
@@ -47,6 +60,36 @@ class DeviceEventPoller:
         if _is_ready(obj):
             on_ready()
             return
+        block = getattr(obj, "block_until_ready", None)
+        if block is not None:
+            with self._waiter_lock:
+                can_wait = self._active_waiters < _MAX_WAITERS
+                if can_wait:
+                    self._active_waiters += 1
+            if can_wait:
+                def wait_and_fire():
+                    try:
+                        block()       # parks in PjRt's future (GIL freed)
+                    except Exception:
+                        pass          # errors surface at use time
+                    finally:
+                        with self._waiter_lock:
+                            self._active_waiters -= 1
+                    try:
+                        on_ready()
+                    except Exception:
+                        import logging
+                        logging.getLogger("brpc_tpu.fiber").exception(
+                            "device waiter callback failed")
+                # one daemon thread per in-flight wait: a stalled wait
+                # pins only its own thread (no executor queue to starve
+                # ready objects behind it) and cannot hang interpreter
+                # exit the way non-daemon pool threads would
+                threading.Thread(target=wait_and_fire,
+                                 name=f"{self._name}_wait",
+                                 daemon=True).start()
+                return
+            # over the cap: fall through to the fair poll pump
         with self._cond:
             self._pending.append((obj, on_ready))
             self._ensure_thread()

@@ -462,6 +462,11 @@ class IciConn(Conn):
             raise ConnectionError(self._poisoned)
         with self._flush_lock:
             while True:
+                # re-check INSIDE the lock: a writer that passed the
+                # outer check while another flusher was poisoning must
+                # not drain its frame past the popped batch
+                if self._poisoned is not None:
+                    raise ConnectionError(self._poisoned)
                 while self._wirebuf:
                     try:
                         n = self._inner.write(memoryview(self._wirebuf))

@@ -87,7 +87,10 @@ def main() -> None:
             chunk = proc.stdout.read()
             if chunk:
                 pending += chunk
-                for line in pending.decode("utf-8", "replace").splitlines():
+                # parse COMPLETE lines only — a mid-line read must not
+                # yield a truncated "PORT 87" as a real port
+                complete, _, pending = pending.rpartition(b"\n")
+                for line in complete.decode("utf-8", "replace").splitlines():
                     if line.startswith("PORT "):
                         port = int(line.split()[1])
                         break
@@ -142,6 +145,11 @@ def main() -> None:
             proc.wait(10)
         except Exception:
             proc.kill()
+        try:
+            errf.close()
+            os.unlink(errf.name)
+        except Exception:
+            pass
 
     out_path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "ICI_SMOKE.json")
