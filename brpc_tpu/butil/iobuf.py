@@ -23,20 +23,35 @@ from typing import Callable, Iterator, List, Optional, Tuple
 
 DEFAULT_BLOCK_SIZE = 8192  # same default payload-block size as the reference
 _MAX_CACHED_BLOCKS_PER_THREAD = 64
+# bytes payloads at/above this size are wrapped zero-copy by append()
+# instead of being copied into 8KB blocks
+_APPEND_ZEROCOPY_MIN = 16384
+
+
+# large read blocks (adaptive drain hint) are recycled too, with a
+# smaller per-thread cap — 8 x 256KB = 2MB of cached read buffers max
+_BIG_BLOCK_SIZE = 262144
+_MAX_CACHED_BIG_BLOCKS_PER_THREAD = 8
 
 
 class _ThreadBlockCache(threading.local):
     def __init__(self) -> None:
         self.free: List[bytearray] = []
+        self.free_big: List[bytearray] = []
 
 
 _tls_cache = _ThreadBlockCache()
 
 
 def _recycle_buffer(buf: bytearray) -> None:
-    free = _tls_cache.free
-    if len(buf) == DEFAULT_BLOCK_SIZE and len(free) < _MAX_CACHED_BLOCKS_PER_THREAD:
-        free.append(buf)
+    if len(buf) == DEFAULT_BLOCK_SIZE:
+        free = _tls_cache.free
+        if len(free) < _MAX_CACHED_BLOCKS_PER_THREAD:
+            free.append(buf)
+    elif len(buf) == _BIG_BLOCK_SIZE:
+        free = _tls_cache.free_big
+        if len(free) < _MAX_CACHED_BIG_BLOCKS_PER_THREAD:
+            free.append(buf)
 
 
 class Block:
@@ -49,15 +64,17 @@ class Block:
     __slots__ = ("data", "size", "capacity", "user_meta", "__weakref__")
 
     def __init__(self, capacity: int = DEFAULT_BLOCK_SIZE, _recycle: bool = True):
-        free = _tls_cache.free
-        if capacity == DEFAULT_BLOCK_SIZE and free:
-            self.data = free.pop()
+        if capacity == DEFAULT_BLOCK_SIZE and _tls_cache.free:
+            self.data = _tls_cache.free.pop()
+        elif capacity == _BIG_BLOCK_SIZE and _tls_cache.free_big:
+            self.data = _tls_cache.free_big.pop()
         else:
             self.data = bytearray(capacity)
         self.size = 0
         self.capacity = len(self.data)
         self.user_meta = None
-        if _recycle and self.capacity == DEFAULT_BLOCK_SIZE:
+        if _recycle and self.capacity in (DEFAULT_BLOCK_SIZE,
+                                          _BIG_BLOCK_SIZE):
             weakref.finalize(self, _recycle_buffer, self.data)
 
     def left_space(self) -> int:
@@ -171,10 +188,17 @@ class IOBuf:
 
     # ------------------------------------------------------------- append
     def append(self, data) -> None:
-        """Append host bytes. Copies into pooled blocks (the only copy in
-        the system — at the producer edge, like the reference)."""
+        """Append host bytes. Small payloads copy into pooled blocks (the
+        only copy in the system — at the producer edge, like the
+        reference); large immutable ``bytes`` are wrapped zero-copy (the
+        append_user_data fast path — a 1MB payload must not be chopped
+        into 128 block copies)."""
         if isinstance(data, IOBuf):
             self.append_buf(data)
+            return
+        if isinstance(data, bytes) and len(data) >= _APPEND_ZEROCOPY_MIN:
+            self._refs.append(
+                BlockRef(Block.from_user_data(data), 0, len(data)))
             return
         mv = memoryview(data)
         if mv.nbytes == 0:
@@ -343,22 +367,30 @@ class IOPortal(IOBuf):
     def append_from_reader(self, recv_into: Callable[[memoryview], int], hint: int = 65536) -> int:
         """Read once into spare tail capacity (allocating blocks as needed).
         Returns bytes read; 0 means EOF; raises BlockingIOError if the
-        reader would block."""
+        reader would block.
+
+        ``hint`` sizes freshly-allocated read blocks: bulk drains want
+        few large recv syscalls (the reference gets the same effect by
+        readv'ing into an iovec of many 8KB blocks,
+        iobuf.h:469 append_from_file_descriptor)."""
         tail = self._writable_tail()
-        if tail is None:
-            blk = Block()
-            mv = memoryview(blk.data)[0:blk.capacity]
-            nr = recv_into(mv)
-            if nr and nr > 0:
-                blk.size = nr
-                self._refs.append(BlockRef(blk, 0, nr))
-                return nr
-            return 0
-        ref, blk = tail
-        mv = memoryview(blk.data)[blk.size:blk.capacity]
+        if tail is not None:
+            ref, blk = tail
+            # a nearly-full tail would cap this read at a few bytes;
+            # prefer a fresh block over a tiny syscall
+            if blk.left_space() >= 4096:
+                mv = memoryview(blk.data)[blk.size:blk.capacity]
+                nr = recv_into(mv)
+                if nr and nr > 0:
+                    blk.size += nr
+                    ref.length += nr
+                    return nr
+                return 0
+        blk = Block(max(hint, DEFAULT_BLOCK_SIZE))
+        mv = memoryview(blk.data)[0:blk.capacity]
         nr = recv_into(mv)
         if nr and nr > 0:
-            blk.size += nr
-            ref.length += nr
+            blk.size = nr
+            self._refs.append(BlockRef(blk, 0, nr))
             return nr
         return 0

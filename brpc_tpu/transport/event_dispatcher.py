@@ -20,7 +20,8 @@ class EventDispatcher:
     def __init__(self, name: str = "event_dispatcher"):
         self._selector = selectors.DefaultSelector()
         self._lock = threading.Lock()
-        # fd -> [on_readable, on_writable(one-shot), persistent_mask]
+        # fd -> [on_readable, on_writable(one-shot), armed_read_mask,
+        #        oneshot_read]
         self._handlers: Dict[int, list] = {}
         self._wakeup_r, self._wakeup_w = pysocket.socketpair()
         self._wakeup_r.setblocking(False)
@@ -42,15 +43,42 @@ class EventDispatcher:
         except (BlockingIOError, OSError):
             pass
 
-    def add_consumer(self, fd: int, on_readable: Callable[[], None]) -> None:
-        """Register persistent read-readiness callbacks for fd."""
+    def add_consumer(self, fd: int, on_readable: Callable[[], None],
+                     oneshot_read: bool = False) -> None:
+        """Register read-readiness callbacks for fd.
+
+        ``oneshot_read=True`` gives edge-trigger-style semantics: after a
+        read event fires, read interest is DISARMED until the consumer
+        calls resume_read(fd) (typically once its drain hits EAGAIN).
+        Level-triggered polling would otherwise spin the dispatcher for
+        the whole time a drain fiber works through a bulk transfer —
+        the reason the reference uses EPOLLET (event_dispatcher.h:32)."""
         with self._lock:
-            self._handlers[fd] = [on_readable, None, selectors.EVENT_READ]
+            self._handlers[fd] = [on_readable, None, selectors.EVENT_READ,
+                                  oneshot_read]
             try:
                 self._selector.register(fd, selectors.EVENT_READ, fd)
             except KeyError:
                 self._selector.modify(fd, selectors.EVENT_READ, fd)
             self._ensure_thread()
+        self._wakeup()
+
+    def resume_read(self, fd: int) -> None:
+        """Re-arm read interest after a one-shot read fire (safe to call
+        when already armed or after remove_consumer)."""
+        with self._lock:
+            h = self._handlers.get(fd)
+            if h is None or h[2] & selectors.EVENT_READ:
+                return
+            h[2] |= selectors.EVENT_READ
+            mask = h[2] | (selectors.EVENT_WRITE if h[1] else 0)
+            try:
+                self._selector.modify(fd, mask, fd)
+            except (KeyError, ValueError, OSError):
+                try:
+                    self._selector.register(fd, mask, fd)
+                except (KeyError, ValueError, OSError):
+                    return
         self._wakeup()
 
     def request_writable(self, fd: int, on_writable: Callable[[], None]) -> None:
@@ -59,12 +87,15 @@ class EventDispatcher:
         with self._lock:
             h = self._handlers.get(fd)
             if h is None:
-                self._handlers[fd] = [None, on_writable, 0]
+                self._handlers[fd] = [None, on_writable, 0, False]
                 self._selector.register(fd, selectors.EVENT_WRITE, fd)
             else:
                 h[1] = on_writable
                 mask = h[2] | selectors.EVENT_WRITE
-                self._selector.modify(fd, mask, fd)
+                try:
+                    self._selector.modify(fd, mask, fd)
+                except KeyError:
+                    self._selector.register(fd, mask, fd)
             self._ensure_thread()
         self._wakeup()
 
@@ -97,17 +128,27 @@ class EventDispatcher:
                     h = self._handlers.get(fd)
                     if h is None:
                         continue
+                    rearm = False
                     if mask & selectors.EVENT_READ:
                         on_readable = h[0]
+                        if h[3]:              # one-shot read: disarm
+                            h[2] &= ~selectors.EVENT_READ
+                            rearm = True
                     if mask & selectors.EVENT_WRITE:
                         on_writable, h[1] = h[1], None  # one-shot
-                        new_mask = h[2]
+                        rearm = True
+                    if rearm:
+                        new_mask = (h[2] | (selectors.EVENT_WRITE
+                                            if h[1] else 0))
                         try:
                             if new_mask:
                                 self._selector.modify(fd, new_mask, fd)
                             else:
+                                # keep the handler: resume_read /
+                                # request_writable re-register later
                                 self._selector.unregister(fd)
-                                del self._handlers[fd]
+                                if h[0] is None:
+                                    del self._handlers[fd]
                         except (KeyError, ValueError, OSError):
                             pass
                 for cb in (on_readable, on_writable):

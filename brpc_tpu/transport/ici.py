@@ -762,6 +762,11 @@ class IciConn(Conn):
         self._want_writable = True
         self._inner.request_writable_event()
 
+    def resume_read_events(self) -> None:
+        resume = getattr(self._inner, "resume_read_events", None)
+        if resume is not None:
+            resume()
+
     @property
     def local_endpoint(self):
         return self._local

@@ -25,7 +25,8 @@ from collections import deque
 from typing import Callable, Optional
 
 from brpc_tpu.butil.endpoint import EndPoint
-from brpc_tpu.butil.iobuf import IOBuf, IOPortal
+from brpc_tpu.butil.iobuf import (DEFAULT_BLOCK_SIZE, IOBuf, IOPortal,
+                                  _BIG_BLOCK_SIZE)
 from brpc_tpu.butil.resource_pool import INVALID_ID, ResourcePool, VersionedId
 from brpc_tpu.bvar.reducer import Adder
 from brpc_tpu.fiber import TaskControl, global_control
@@ -61,6 +62,7 @@ class Socket:
         self._writable_butex = Butex(0)
         self._nevent = 0                          # edge-trigger input counter
         self._nevent_lock = threading.Lock()
+        self._read_hint = 8192                    # adaptive read-block size
         self.preferred_protocol = -1              # InputMessenger cache
         self.user_data: dict = {}                 # per-conn session state
         # pairs a device-lane batch with its wire frame: concurrent
@@ -235,12 +237,25 @@ class Socket:
                 return
 
     def _drain_readable(self) -> int:
-        """Read until EAGAIN/EOF into the portal; returns bytes read."""
+        """Read until EAGAIN/EOF into the portal; returns bytes read.
+
+        Read blocks are sized adaptively: full reads grow the next
+        block (up to 256KB) so bulk transfers take few recv syscalls,
+        small reads shrink it back so idle connections don't hold large
+        buffers — the readv-into-many-blocks effect of
+        iobuf.h:469 without the iovec."""
         total = 0
         while not self.failed:
+            hint = self._read_hint
             try:
-                n = self.input_portal.append_from_reader(self.conn.read_into)
+                n = self.input_portal.append_from_reader(
+                    self.conn.read_into, hint=hint)
             except BlockingIOError:
+                # drained: with one-shot read arming, the dispatcher
+                # won't fire again until we re-arm
+                resume = getattr(self.conn, "resume_read_events", None)
+                if resume is not None:
+                    resume()
                 break
             except (ConnectionError, OSError) as e:
                 self.set_failed(e)
@@ -248,6 +263,12 @@ class Socket:
             if n == 0:  # EOF
                 self.set_failed(ConnectionResetError("peer closed"))
                 break
+            if n >= hint:
+                # jump straight to the big recyclable size: intermediate
+                # sizes would allocate non-poolable buffers
+                self._read_hint = _BIG_BLOCK_SIZE
+            elif n < 4096:
+                self._read_hint = DEFAULT_BLOCK_SIZE
             total += n
             nreads.add(n)
         return total

@@ -62,7 +62,14 @@ class TcpConn(Conn):
 
     def start_events(self, on_readable, on_writable) -> None:
         self._on_writable = on_writable
-        global_dispatcher().add_consumer(self._sock.fileno(), on_readable)
+        # one-shot read arming (edge-trigger style): the consumer's
+        # drain loop re-arms via resume_read_events() on EAGAIN, so the
+        # dispatcher doesn't spin while a fiber works through a transfer
+        global_dispatcher().add_consumer(self._sock.fileno(), on_readable,
+                                         oneshot_read=True)
+
+    def resume_read_events(self) -> None:
+        global_dispatcher().resume_read(self._sock.fileno())
 
     def request_writable_event(self) -> None:
         global_dispatcher().request_writable(self._sock.fileno(), self._on_writable)

@@ -232,6 +232,11 @@ class TpudConn(Conn):
     def request_writable_event(self) -> None:
         self._inner.request_writable_event()
 
+    def resume_read_events(self) -> None:
+        resume = getattr(self._inner, "resume_read_events", None)
+        if resume is not None:
+            resume()
+
     @property
     def local_endpoint(self):
         return self._local
