@@ -38,6 +38,15 @@ class ChannelOptions:
     # pluggable Authenticator (rpc/auth.py): generate_credential() result
     # rides the request meta; wins over auth_token
     auth: Optional[Any] = None
+    # app-level health check (details/health_check.cpp:59-144): a
+    # callable(EndPoint)->bool that must succeed before a dead server is
+    # revived — use rpc_health_check(...) for the RPC-probe flavor.
+    # Cluster channels only; None keeps the bare-connect gate.
+    app_health_check: Optional[Any] = None
+    # process-global connection sharing for connection_type="single"
+    # (socket_map.h:147): channels to the same (endpoint, protocol) reuse
+    # one Socket
+    share_connections: bool = True
 
 
 
@@ -75,6 +84,7 @@ class Channel:
         self._messenger = InputMessenger(control=self._control)
         self._socket: Optional[Socket] = None
         self._socket_lock = threading.Lock()
+        self._map_key = None                 # global SocketMap lease key
         self._endpoint: Optional[EndPoint] = None
         self._framer_cache = None
         # pooled-connection_type freelist (socket.h connection pooling)
@@ -95,6 +105,37 @@ class Channel:
                 self._endpoint, on_input=self._messenger.on_new_messages,
                 control=self._control)
 
+        if (self.options.connection_type == "single"
+                and self.options.share_connections):
+            # process-global sharing (socket_map.h:147): one multiplexed
+            # connection per (endpoint, protocol) across ALL channels;
+            # this channel holds one refcounted lease on it
+            from brpc_tpu.transport.socket_map import (SocketMap,
+                                                       global_socket_map)
+            with self._socket_lock:
+                s = self._socket
+                if s is not None and not s.failed:
+                    return s
+            # the key carries the credential flavor (socket_map.h keys
+            # include ssl/auth settings): channels with different
+            # credentials must not multiplex one verified connection
+            auth_part = (self.options.auth_token
+                         or (f"auth#{id(self.options.auth)}"
+                             if self.options.auth is not None else ""))
+            key = SocketMap.key(self._endpoint,
+                                f"{self.options.protocol}|{auth_part}")
+            s = global_socket_map().acquire(key, _make)
+            with self._socket_lock:
+                old, self._socket = self._socket, s
+                self._map_key = key
+            if old is not None:
+                # this channel holds exactly ONE lease: drop the stale
+                # socket's lease — or, when a concurrent first call
+                # already stored this very socket, the duplicate lease
+                # the second acquire() just took
+                global_socket_map().release(key, old)
+            return s
+
         def _write(s):
             self._socket = s
 
@@ -106,8 +147,15 @@ class Channel:
         reconnect lazily)."""
         with self._socket_lock:
             s, self._socket = self._socket, None
-        if s is not None and not s.failed:
-            s.set_failed(ConnectionError("channel closed"))
+            key, self._map_key = self._map_key, None
+        if s is not None:
+            if key is not None:
+                # shared socket: return the lease; it closes only when
+                # the last channel lets go
+                from brpc_tpu.transport.socket_map import global_socket_map
+                global_socket_map().release(key, s)
+            elif not s.failed:
+                s.set_failed(ConnectionError("channel closed"))
         with self._pool_lock:
             pool, self._conn_pool = self._conn_pool, []
             self._pool_closed = True

@@ -35,7 +35,9 @@ class ClusterChannel(Channel):
         self._sockets: Dict[EndPoint, Socket] = {}
         self._sockets_lock = threading.Lock()
         self._servers: list = []
-        self._health = HealthChecker(control=self._control)
+        self._health = HealthChecker(
+            control=self._control,
+            app_check=self.options.app_health_check)
         self._ns = NamingServiceThread(naming_url, control=self._control)
         self._ns.watch(self._on_servers)
         self._ns.wait_first_update(5.0)

@@ -97,7 +97,8 @@ class AutoLimiter(ConcurrencyLimiter):
 
 
 def new_limiter(spec) -> Optional[ConcurrencyLimiter]:
-    """spec: None | int | 'constant:N' | 'auto' (AdaptiveMaxConcurrency)."""
+    """spec: None | int | 'constant:N' | 'auto' | 'timeout:MS'
+    (AdaptiveMaxConcurrency)."""
     if spec is None:
         return None
     if isinstance(spec, int):
@@ -107,6 +108,57 @@ def new_limiter(spec) -> Optional[ConcurrencyLimiter]:
             return AutoLimiter()
         if spec.startswith("constant:"):
             return ConstantLimiter(int(spec.split(":", 1)[1]))
+        if spec.startswith("timeout:"):
+            return TimeoutLimiter(float(spec.split(":", 1)[1]))
         if spec.isdigit():
             return ConstantLimiter(int(spec))
     raise ValueError(f"bad concurrency limiter spec {spec!r}")
+
+
+class TimeoutLimiter(ConcurrencyLimiter):
+    """Timeout-aware limiter (policy/timeout_concurrency_limiter.cpp):
+    admit a request only while the expected queueing delay —
+    in-flight x EMA latency — still fits inside the timeout budget, so
+    requests that would certainly time out in the queue are shed at the
+    door instead of wasting a slot."""
+
+    MIN_LIMIT = 2
+    EMA_ALPHA = 0.2
+
+    def __init__(self, timeout_ms: float):
+        self._timeout_us = float(timeout_ms) * 1e3
+        self._ema_us = 0.0
+        self._inflight = 0
+        self._lock = threading.Lock()
+
+    def on_requested(self) -> bool:
+        with self._lock:
+            if self._inflight >= self.MIN_LIMIT and self._ema_us > 0:
+                # queueing behind `inflight` others plus its own service
+                expected_done = (self._inflight + 1) * self._ema_us
+                if expected_done > self._timeout_us:
+                    return False
+            self._inflight += 1
+            return True
+
+    def on_responded(self, latency_us, failed):
+        # failures count too: during sustained overload every request
+        # dies at the timeout, and skipping them would freeze the EMA at
+        # the last healthy value — exactly when shedding matters most.
+        # A timeout corpse's latency (~the timeout) pushes the estimate
+        # up; recovery pulls it back down through later successes.
+        with self._lock:
+            self._inflight -= 1
+            if latency_us > 0:
+                if self._ema_us == 0:
+                    self._ema_us = latency_us
+                else:
+                    self._ema_us += self.EMA_ALPHA * (latency_us - self._ema_us)
+
+    @property
+    def max_concurrency(self):
+        with self._lock:
+            if self._ema_us <= 0:
+                return 1 << 30
+            return max(self.MIN_LIMIT,
+                       int(self._timeout_us / self._ema_us))

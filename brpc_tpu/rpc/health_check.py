@@ -1,28 +1,62 @@
 """Health checking: failed endpoints are probed with exponential backoff
 until a connect succeeds, then revived (details/health_check.cpp:146 —
 there a failed Socket enters a periodic HealthCheckTask; revival restores
-it to the LB)."""
+it to the LB).
+
+With ``app_check`` set, revival is additionally gated on a SUCCESSFUL
+RPC, not just a bare TCP connect — the reference's app-level health
+check (details/health_check.cpp:59-144, the -health_check_path RPC on
+the revived socket): a server that accepts connections but can't answer
+stays dead."""
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Set
+from typing import Callable, Dict, Optional, Set, Tuple
 
 from brpc_tpu.butil.endpoint import EndPoint
 from brpc_tpu.fiber import TaskControl, global_control, sleep
 from brpc_tpu.transport.base import get_transport
 
 
+def rpc_health_check(service: str = "health", method: str = "Check",
+                     timeout_ms: float = 1000.0, request: bytes = b"",
+                     protocol: str = "tpu_std", auth_token: str = "",
+                     auth=None) -> Callable[[EndPoint], bool]:
+    """An app_check that issues one RPC at the endpoint and requires it
+    to succeed (the HealthCheckChannel RPC of health_check.cpp:59).
+    Pass the cluster's protocol/auth settings — an unauthenticated probe
+    against an authenticated server would keep it dead forever."""
+
+    def check(ep: EndPoint) -> bool:
+        from brpc_tpu.rpc.channel import Channel, ChannelOptions
+        ch = Channel(ep, ChannelOptions(
+            protocol=protocol, timeout_ms=timeout_ms, max_retry=0,
+            auth_token=auth_token, auth=auth,
+            share_connections=False))   # probe on its own connection
+        try:
+            cntl = ch.call_sync(service, method, request)
+            return not cntl.failed()
+        except Exception:
+            return False
+        finally:
+            ch.close()
+
+    return check
+
+
 class HealthChecker:
     BASE_BACKOFF_S = 0.05
     MAX_BACKOFF_S = 5.0
 
-    def __init__(self, control: Optional[TaskControl] = None):
+    def __init__(self, control: Optional[TaskControl] = None,
+                 app_check: Optional[Callable[[EndPoint], bool]] = None):
         self._control = control or global_control()
         self._dead: Set[EndPoint] = set()
         self._checking: Set[EndPoint] = set()
         self._lock = threading.Lock()
         self._stopped = False
+        self._app_check = app_check
 
     def dead_set(self) -> Set[EndPoint]:
         with self._lock:
@@ -57,11 +91,30 @@ class HealthChecker:
             except Exception:
                 backoff = min(backoff * 2, self.MAX_BACKOFF_S)
                 continue
+            if self._app_check is not None:
+                # connect succeeded but revival needs a working RPC
+                # (may block: run it right here in this check fiber)
+                try:
+                    ok = self._app_check(ep)
+                except Exception:
+                    ok = False
+                if not ok:
+                    backoff = min(backoff * 2, self.MAX_BACKOFF_S)
+                    continue
             with self._lock:
                 self._dead.discard(ep)
             break
         with self._lock:
             self._checking.discard(ep)
+            # an endpoint re-marked dead between revival and this exit
+            # would be stranded (mark_dead saw us in _checking and
+            # spawned nothing): take the checking slot back and respawn
+            respawn = not self._stopped and ep in self._dead
+            if respawn:
+                self._checking.add(ep)
+        if respawn:
+            self._control.spawn(self._check_loop, ep,
+                                name=f"health_{ep.host}")
 
     def stop(self):
         self._stopped = True

@@ -123,3 +123,6 @@ def _register_builtins() -> None:
         if "ici" not in _transports:
             from brpc_tpu.transport.ici import IciTransport
             _transports["ici"] = IciTransport()
+        if "ssl" not in _transports:
+            from brpc_tpu.transport.ssl import SslTransport
+            _transports["ssl"] = SslTransport()

@@ -1,0 +1,106 @@
+"""Global SocketMap: process-wide client connection sharing
+(src/brpc/socket_map.h:147).
+
+Two Channels pointed at the same server with connection_type="single"
+should multiplex ONE connection, not open two — the reference dedups
+via a global map keyed (EndPoint, connection type, ssl settings); here
+the ssl flavor lives in the endpoint scheme, so the key is
+(endpoint string, protocol). Entries are refcounted: each Channel holds
+a lease; the socket closes when the last lease is returned (SocketMap's
+insert/remove pairing), and a failed socket is replaced transparently on
+the next acquire.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from brpc_tpu.butil.endpoint import EndPoint
+
+Key = Tuple[str, str]
+
+
+class _Entry:
+    __slots__ = ("socket", "refs")
+
+    def __init__(self, socket):
+        self.socket = socket
+        self.refs = 0
+
+
+class SocketMap:
+    def __init__(self):
+        self._map: Dict[Key, _Entry] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def key(ep: EndPoint, protocol: str = "") -> Key:
+        return (str(ep), protocol)
+
+    def acquire(self, key: Key, make: Callable[[], object]):
+        """Return a shared live socket for key, creating it (outside the
+        lock) if absent or failed. Each acquire must be paired with one
+        release."""
+        with self._lock:
+            e = self._map.get(key)
+            if e is not None and not e.socket.failed:
+                e.refs += 1
+                return e.socket
+        new = make()
+        with self._lock:
+            e = self._map.get(key)
+            if e is not None and not e.socket.failed:
+                # lost the race: keep the winner, discard ours
+                e.refs += 1
+                winner = e.socket
+            else:
+                self._map[key] = e = _Entry(new)
+                e.refs = 1
+                winner = None
+        if winner is not None:
+            new.set_failed(ConnectionError("duplicate connect discarded"))
+            return winner
+        return new
+
+    def release(self, key: Key, socket) -> None:
+        """Drop one lease; the socket closes when the last lease goes
+        (and only if it is still the mapped one)."""
+        close = False
+        with self._lock:
+            e = self._map.get(key)
+            if e is None or e.socket is not socket:
+                close = True          # stale lease: not shared anymore
+            else:
+                e.refs -= 1
+                if e.refs <= 0:
+                    del self._map[key]
+                    close = True
+        if close and not socket.failed:
+            socket.set_failed(ConnectionError("socket map released"))
+
+    def evict_failed(self, key: Key, socket) -> None:
+        """Remove a failed socket's entry so the next acquire redials
+        (callers still hold their leases; release() of a stale lease is
+        a no-op close on an already-failed socket)."""
+        with self._lock:
+            e = self._map.get(key)
+            if e is not None and e.socket is socket:
+                del self._map[key]
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+
+_global: Optional[SocketMap] = None
+_glock = threading.Lock()
+
+
+def global_socket_map() -> SocketMap:
+    global _global
+    if _global is None:
+        with _glock:
+            if _global is None:
+                _global = SocketMap()
+    return _global

@@ -1,0 +1,225 @@
+"""ssl:// transport: TLS over the TCP lane (details/ssl_helper.cpp +
+Socket's SSL state machine, src/brpc/socket.h).
+
+The reference drives OpenSSL non-blocking: SSL_ERROR_WANT_READ/WRITE map
+to the same epoll readiness dance as plain TCP. Here Python's ssl module
+provides the engine; SSLWant{Read,Write}Error map to BlockingIOError (+
+a writable-event request for WANT_WRITE), so Socket/KeepWrite/dispatcher
+logic is untouched. The handshake runs lazily on the non-blocking
+socket: reads/writes before completion drive do_handshake() instead.
+
+Endpoint extras:
+  server:  ssl://0.0.0.0:443#cert=/path/cert.pem&key=/path/key.pem
+  client:  ssl://host:443            (no verification — test/dev default,
+           like the reference's default ssl_options.verify.verify_depth=0)
+           ssl://host:443#verify=1&ca=/path/ca.pem&sni=name
+"""
+
+from __future__ import annotations
+
+import errno
+import socket as pysocket
+import ssl as pyssl
+import threading
+from typing import Callable, Optional
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.transport.base import Conn, Listener, Transport
+from brpc_tpu.transport.event_dispatcher import global_dispatcher
+from brpc_tpu.transport.tcp import TcpConn, TcpTransport
+
+
+class SslConn(Conn):
+    """Non-blocking TLS connection with a lazy handshake state machine
+    (the reference's SSLState on Socket: SSL_CONNECTING -> SSL_CONNECTED,
+    socket.h)."""
+
+    def __init__(self, sock: pyssl.SSLSocket, local: EndPoint,
+                 remote: EndPoint):
+        sock.setblocking(False)
+        self._sock = sock
+        self._local = local
+        self._remote = remote
+        self._closed = False
+        self._handshaken = False
+        self._on_writable: Optional[Callable] = None
+        # one lock around every OpenSSL call: the drain fiber and the
+        # keep_write fiber otherwise race inside do_handshake()/the
+        # shared SSL state machine (observed segfault); all ops are
+        # non-blocking so the critical sections are short
+        self._ssl_lock = threading.Lock()
+
+    # ----------------------------------------------------- handshake
+    def _drive_handshake(self) -> bool:
+        """Advance the TLS handshake; True when established. Raises
+        BlockingIOError while in progress (requesting the right
+        readiness event first)."""
+        if self._handshaken:
+            return True
+        try:
+            self._sock.do_handshake()
+        except pyssl.SSLWantReadError:
+            raise BlockingIOError("tls handshake wants read")
+        except pyssl.SSLWantWriteError:
+            self.request_writable_event()
+            raise BlockingIOError("tls handshake wants write")
+        except pyssl.SSLError as e:
+            raise ConnectionError(f"tls handshake failed: {e}") from e
+        self._handshaken = True
+        return True
+
+    # ------------------------------------------------------------- io
+    def write(self, mv: memoryview) -> int:
+        with self._ssl_lock:
+            self._drive_handshake()
+            try:
+                return self._sock.send(mv)
+            except pyssl.SSLWantWriteError:
+                raise BlockingIOError from None
+            except pyssl.SSLWantReadError:
+                # renegotiation wants a read; the input path will pump
+                raise BlockingIOError from None
+            except pyssl.SSLError as e:
+                raise ConnectionError(f"tls write failed: {e}") from e
+            except OSError as e:
+                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                    raise BlockingIOError from e
+                raise
+
+    def read_into(self, mv: memoryview) -> int:
+        with self._ssl_lock:
+            self._drive_handshake()
+            try:
+                return self._sock.recv_into(mv)
+            except pyssl.SSLWantReadError:
+                raise BlockingIOError from None
+            except pyssl.SSLWantWriteError:
+                self.request_writable_event()
+                raise BlockingIOError from None
+            except pyssl.SSLZeroReturnError:
+                return 0                   # clean TLS close-notify = EOF
+            except pyssl.SSLError as e:
+                raise ConnectionError(f"tls read failed: {e}") from e
+            except OSError as e:
+                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                    raise BlockingIOError from e
+                raise
+
+    # ------------------------------------------------------- plumbing
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        global_dispatcher().remove_consumer(self._sock.fileno())
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def start_events(self, on_readable, on_writable) -> None:
+        self._on_writable = on_writable
+        global_dispatcher().add_consumer(self._sock.fileno(), on_readable,
+                                         oneshot_read=True)
+
+    def resume_read_events(self) -> None:
+        global_dispatcher().resume_read(self._sock.fileno())
+
+    def request_writable_event(self) -> None:
+        if self._on_writable is not None:
+            global_dispatcher().request_writable(self._sock.fileno(),
+                                                 self._on_writable)
+
+    @property
+    def local_endpoint(self):
+        return self._local
+
+    @property
+    def remote_endpoint(self):
+        return self._remote
+
+
+class _SslListener(Listener):
+    def __init__(self, inner: Listener, ep: EndPoint):
+        self._inner = inner
+        self._ep = ep
+
+    def stop(self) -> None:
+        self._inner.stop()
+
+    @property
+    def endpoint(self) -> EndPoint:
+        return self._ep
+
+
+class SslTransport(Transport):
+    scheme = "ssl"
+
+    def __init__(self):
+        self._tcp = TcpTransport()
+
+    # ------------------------------------------------------- contexts
+    @staticmethod
+    def _server_context(ep: EndPoint) -> pyssl.SSLContext:
+        cert = ep.extra("cert")
+        key = ep.extra("key")
+        if not cert:
+            raise ValueError(
+                "ssl:// listener needs #cert=/path.pem (and optionally "
+                "&key=/path.pem) endpoint extras")
+        ctx = pyssl.SSLContext(pyssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert, key or None)
+        return ctx
+
+    @staticmethod
+    def _client_context(ep: EndPoint) -> pyssl.SSLContext:
+        verify = ep.extra("verify")
+        ca = ep.extra("ca")
+        if verify:
+            ctx = pyssl.create_default_context(
+                cafile=ca if ca else None)
+        else:
+            ctx = pyssl.SSLContext(pyssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = pyssl.CERT_NONE
+        return ctx
+
+    # ------------------------------------------------------ transport
+    def listen(self, ep: EndPoint, on_new_conn) -> Listener:
+        ctx = self._server_context(ep)
+        tcp_ep = EndPoint("tcp", ep.host or "127.0.0.1", ep.port, ep.extras)
+        ready = threading.Event()
+
+        def wrap(conn: TcpConn):
+            if not ready.wait(5):
+                conn.close()
+                raise ConnectionError("ssl: listener endpoint not bound "
+                                      "within 5s; dropping accepted conn")
+            raw = conn._sock
+            tls = ctx.wrap_socket(raw, server_side=True,
+                                  do_handshake_on_connect=False)
+            on_new_conn(SslConn(tls, bound, conn.remote_endpoint))
+
+        inner = self._tcp.listen(tcp_ep, wrap)
+        bound = EndPoint("ssl", inner.endpoint.host, inner.endpoint.port,
+                         ep.extras)
+        ready.set()
+        return _SslListener(inner, bound)
+
+    def connect(self, ep: EndPoint) -> Conn:
+        ctx = self._client_context(ep)
+        sni = ep.extra("sni") or ep.host
+        sock = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM)
+        sock.setblocking(False)
+        try:
+            sock.connect((ep.host, ep.port))
+        except (BlockingIOError, InterruptedError):
+            pass
+        tls = ctx.wrap_socket(
+            sock, server_hostname=sni if ctx.check_hostname or sni else None,
+            do_handshake_on_connect=False)
+        try:
+            tls.setsockopt(pysocket.IPPROTO_TCP, pysocket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        local = EndPoint("ssl", "0.0.0.0", 0)
+        return SslConn(tls, local, ep)

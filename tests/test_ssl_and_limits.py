@@ -1,0 +1,232 @@
+"""ssl:// transport, global SocketMap, app-level health check, and the
+timeout concurrency limiter (reference: details/ssl_helper.cpp,
+socket_map.h:147, details/health_check.cpp:59-144,
+policy/timeout_concurrency_limiter.cpp)."""
+
+import os
+import subprocess
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.butil.endpoint import str2endpoint
+from brpc_tpu.rpc import Channel, ChannelOptions, Server, ServerOptions, Service
+from brpc_tpu.rpc.concurrency_limiter import TimeoutLimiter, new_limiter
+from brpc_tpu.rpc.health_check import HealthChecker, rpc_health_check
+from brpc_tpu.transport.socket_map import SocketMap, global_socket_map
+
+
+@pytest.fixture(scope="module")
+def certpair(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "2",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True)
+    return cert, key
+
+
+def make_echo_server():
+    server = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("EchoService")
+
+    @svc.method()
+    def Echo(cntl, request):
+        return bytes(request)
+
+    server.add_service(svc)
+    return server
+
+
+class TestSslTransport:
+    def test_e2e_rpc_over_tls(self, certpair):
+        cert, key = certpair
+        server = make_echo_server()
+        ep = server.start(f"ssl://127.0.0.1:0#cert={cert}&key={key}")
+        try:
+            ch = Channel(f"ssl://127.0.0.1:{ep.port}",
+                         ChannelOptions(timeout_ms=10000))
+            for i in range(3):
+                cntl = ch.call_sync("EchoService", "Echo",
+                                    f"tls-{i}".encode())
+                assert not cntl.failed(), cntl.error_text
+                assert cntl.response_payload.to_bytes() == f"tls-{i}".encode()
+            ch.close()
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_large_payload_over_tls(self, certpair):
+        cert, key = certpair
+        server = make_echo_server()
+        ep = server.start(f"ssl://127.0.0.1:0#cert={cert}&key={key}")
+        try:
+            ch = Channel(f"ssl://127.0.0.1:{ep.port}",
+                         ChannelOptions(timeout_ms=30000))
+            big = bytes(range(256)) * 4096            # 1MB patterned
+            cntl = ch.call_sync("EchoService", "Echo", big)
+            assert not cntl.failed(), cntl.error_text
+            assert cntl.response_payload.to_bytes() == big
+            ch.close()
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_plaintext_client_rejected(self, certpair):
+        cert, key = certpair
+        server = make_echo_server()
+        ep = server.start(f"ssl://127.0.0.1:0#cert={cert}&key={key}")
+        try:
+            ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                         ChannelOptions(timeout_ms=2000, max_retry=0))
+            cntl = ch.call_sync("EchoService", "Echo", b"nope")
+            assert cntl.failed()
+            ch.close()
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_listener_requires_cert(self):
+        server = make_echo_server()
+        with pytest.raises(ValueError, match="cert"):
+            server.start("ssl://127.0.0.1:0")
+
+
+class TestGlobalSocketMap:
+    def test_two_channels_share_one_connection(self):
+        server = make_echo_server()
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            addr = f"tcp://127.0.0.1:{ep.port}"
+            ch1 = Channel(addr)
+            ch2 = Channel(addr)
+            c1 = ch1.call_sync("EchoService", "Echo", b"one")
+            c2 = ch2.call_sync("EchoService", "Echo", b"two")
+            assert not c1.failed() and not c2.failed()
+            s1, s2 = ch1._socket, ch2._socket
+            assert s1 is s2                       # the socket_map.h dedup
+            # first close keeps the shared socket alive for the other
+            ch1.close()
+            assert not s2.failed
+            c2 = ch2.call_sync("EchoService", "Echo", b"still")
+            assert not c2.failed()
+            # last lease closes it
+            ch2.close()
+            assert s2.failed
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_sharing_optout(self):
+        server = make_echo_server()
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            addr = f"tcp://127.0.0.1:{ep.port}"
+            ch1 = Channel(addr, ChannelOptions(share_connections=False))
+            ch2 = Channel(addr, ChannelOptions(share_connections=False))
+            ch1.call_sync("EchoService", "Echo", b"a")
+            ch2.call_sync("EchoService", "Echo", b"b")
+            assert ch1._socket is not ch2._socket
+            ch1.close()
+            ch2.close()
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_failed_socket_replaced_on_acquire(self):
+        server = make_echo_server()
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            addr = f"tcp://127.0.0.1:{ep.port}"
+            ch = Channel(addr)
+            ch.call_sync("EchoService", "Echo", b"x")
+            old = ch._socket
+            old.set_failed(ConnectionError("induced"))
+            cntl = ch.call_sync("EchoService", "Echo", b"y")
+            assert not cntl.failed(), cntl.error_text
+            assert ch._socket is not old
+            ch.close()
+        finally:
+            server.stop()
+            server.join(2)
+
+
+class TestAppHealthCheck:
+    def test_revival_gated_on_rpc_success(self):
+        """A server that accepts TCP but fails the RPC keeps the
+        endpoint dead; once the RPC succeeds it revives
+        (health_check.cpp:59-144)."""
+        healthy = threading.Event()
+        server = Server(ServerOptions(enable_builtin_services=False))
+        svc = Service("health")
+
+        @svc.method()
+        def Check(cntl, request):
+            if not healthy.is_set():
+                cntl.set_failed(1001, "unhealthy")
+                return b""
+            return b"ok"
+
+        server.add_service(svc)
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            target = str2endpoint(f"tcp://127.0.0.1:{ep.port}")
+            hc = HealthChecker(app_check=rpc_health_check(
+                "health", "Check", timeout_ms=2000))
+            hc.mark_dead(target)
+            # connectable but unhealthy: stays dead
+            time.sleep(0.6)
+            assert target in hc.dead_set()
+            healthy.set()
+            deadline = time.monotonic() + 10
+            while target in hc.dead_set():
+                assert time.monotonic() < deadline, "never revived"
+                time.sleep(0.05)
+            hc.stop()
+        finally:
+            server.stop()
+            server.join(2)
+
+
+class TestTimeoutLimiter:
+    def test_spec_parsing(self):
+        lim = new_limiter("timeout:50")
+        assert isinstance(lim, TimeoutLimiter)
+
+    def test_sheds_when_queue_exceeds_timeout(self):
+        lim = TimeoutLimiter(timeout_ms=10)          # 10ms budget
+        # teach it ~5ms latency
+        for _ in range(20):
+            assert lim.on_requested()
+            lim.on_responded(5000.0, failed=False)
+        # admit while expected wait fits: 2 in flight x 5ms = 10ms (at
+        # the boundary), the 3rd (3 x 5ms = 15ms > 10ms) is shed
+        assert lim.on_requested()
+        assert lim.on_requested()
+        assert not lim.on_requested()
+        lim.on_responded(5000.0, False)
+        lim.on_responded(5000.0, False)
+
+    def test_failed_latencies_adapt_and_recover(self):
+        """Timeout corpses RAISE the estimate (overload must shed even
+        when every response is a failure), the MIN_LIMIT floor keeps
+        probing, and later successes pull the EMA back down."""
+        lim = TimeoutLimiter(timeout_ms=10)
+        for _ in range(10):
+            assert lim.on_requested()
+            lim.on_responded(20_000.0, failed=True)  # 20ms corpses
+        # overloaded: only the MIN_LIMIT probe slots admit
+        assert lim.max_concurrency == TimeoutLimiter.MIN_LIMIT
+        assert lim.on_requested()
+        assert lim.on_requested()
+        assert not lim.on_requested()
+        lim.on_responded(100.0, False)
+        lim.on_responded(100.0, False)
+        # recovery: healthy latencies re-open admission
+        for _ in range(30):
+            assert lim.on_requested()
+            lim.on_responded(100.0, False)
+        assert lim.max_concurrency > TimeoutLimiter.MIN_LIMIT
