@@ -94,6 +94,10 @@ class ClusterChannel(Channel):
             ep = cntl.tried_servers[-1]
             self._lb.feedback(ep, cntl.latency_us(), True)
             self._breakers.on_call(ep, failed=True)
+            fed = getattr(cntl, "_lb_fed", None)
+            if fed is None:
+                fed = cntl._lb_fed = []
+            fed.append(ep)
 
     def _on_call_complete(self, cntl: Controller):
         if not cntl.tried_servers:
@@ -102,6 +106,19 @@ class ClusterChannel(Channel):
         failed = cntl.failed() and cntl.error_code != berr.ERPCTIMEDOUT
         self._lb.feedback(ep, cntl.latency_us(), cntl.failed())
         self._breakers.on_call(ep, failed)
+        # every selection must be matched by exactly one feedback or
+        # abandon: attempts that never produced an observation (a backup
+        # request that lost the race) return their inflight slot, or an
+        # inflight-tracking LB would depress that server's weight
+        # forever. Multiset difference: tried selections minus delivered
+        # feedbacks (attempt failures + the final one above).
+        fed = list(getattr(cntl, "_lb_fed", ()))
+        fed.append(ep)
+        for s in cntl.tried_servers:
+            if s in fed:
+                fed.remove(s)
+            else:
+                self._lb.abandon(s)
 
     def close(self):
         self._ns.stop()

@@ -29,6 +29,12 @@ class LoadBalancer:
     def feedback(self, server: EndPoint, latency_us: float, failed: bool) -> None:
         pass
 
+    def abandon(self, server: EndPoint) -> None:
+        """A selected attempt finished without a latency observation
+        (backup request lost the race, stale retry): inflight-tracking
+        balancers must return the slot without polluting their stats."""
+        pass
+
 
 class _SnapshotLB(LoadBalancer):
     def __init__(self):
@@ -155,38 +161,149 @@ class MurmurHashLB(ConsistentHashLB):
         return murmur3_32of128(data)
 
 
+class _Fenwick:
+    """Partial-sum tree over float weights: O(log n) point update +
+    prefix-sum descent (the divide tree of
+    policy/locality_aware_load_balancer.cpp, where selection walks
+    left/right by accumulated weight)."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self._t = [0.0] * (n + 1)
+        self._w = [0.0] * n          # raw weights for point reads
+
+    def set(self, i: int, w: float) -> None:
+        delta = w - self._w[i]
+        self._w[i] = w
+        i += 1
+        while i <= self.n:
+            self._t[i] += delta
+            i += i & (-i)
+
+    def get(self, i: int) -> float:
+        return self._w[i]
+
+    @property
+    def total(self) -> float:
+        s = 0.0
+        i = self.n
+        while i > 0:
+            s += self._t[i]
+            i -= i & (-i)
+        return s
+
+    def find(self, target: float) -> int:
+        """Index whose weight range contains `target`
+        (0 <= target < total), by binary tree descent."""
+        idx = 0
+        bit = 1
+        while bit * 2 <= self.n:
+            bit *= 2
+        while bit:
+            nxt = idx + bit
+            if nxt <= self.n and self._t[nxt] <= target:
+                target -= self._t[nxt]
+                idx = nxt
+            bit //= 2
+        return min(idx, self.n - 1)
+
+
 class LocalityAwareLB(_SnapshotLB):
-    """la — latency-weighted pick (policy/locality_aware_load_balancer.cpp
-    simplified): weight ~ 1/EMA(latency); errors decay weight sharply."""
+    """la — locality-aware weighted pick
+    (policy/locality_aware_load_balancer.cpp): weight ~
+    1 / (EMA latency x (inflight + 1)), held in a partial-sum tree for
+    O(log n) selection. Selecting a server counts an in-flight request
+    against it immediately — a server accumulating un-answered requests
+    loses weight before its latency EMA even moves — and feedback()
+    returns the slot and folds the observed latency in (errors count as
+    a sharp latency penalty). New servers start at the cluster's best
+    observed latency so they get probed quickly."""
 
     name = "la"
     ALPHA = 0.2
+    DEFAULT_LAT_US = 1000.0
+    ERROR_PENALTY_US = 1e6
 
     def __init__(self):
         super().__init__()
-        self._lat: Dict[EndPoint, float] = {}
         self._lock = threading.Lock()
+        self._lat: Dict[EndPoint, float] = {}
+        self._inflight: Dict[EndPoint, int] = {}
+        self._tree: Optional[_Fenwick] = None
+        self._order: list = []          # index -> server
+        self._index: Dict[EndPoint, int] = {}
+
+    # ----------------------------------------------------------- weights
+    def _weight(self, s) -> float:
+        lat = max(self._lat.get(s, self.DEFAULT_LAT_US), 1.0)
+        return 1e9 / (lat * (self._inflight.get(s, 0) + 1))
+
+    def _on_reset(self, snapshot):
+        with self._lock:
+            keep = set(snapshot)
+            self._lat = {s: v for s, v in self._lat.items() if s in keep}
+            self._inflight = {s: v for s, v in self._inflight.items()
+                              if s in keep}
+            self._order = list(snapshot)
+            self._index = {s: i for i, s in enumerate(self._order)}
+            self._tree = _Fenwick(len(self._order)) if self._order else None
+            best = min(self._lat.values(), default=self.DEFAULT_LAT_US)
+            for i, s in enumerate(self._order):
+                self._lat.setdefault(s, best)   # optimistic probe weight
+                self._tree.set(i, self._weight(s))
+
+    # ---------------------------------------------------------- protocol
+    def abandon(self, server):
+        with self._lock:
+            inf = self._inflight.get(server, 0)
+            if inf > 0:
+                self._inflight[server] = inf - 1
+            i = self._index.get(server)
+            if i is not None and self._tree is not None:
+                self._tree.set(i, self._weight(server))
 
     def feedback(self, server, latency_us, failed):
         with self._lock:
-            cur = self._lat.get(server, 1000.0)
-            sample = latency_us if not failed else max(cur * 10, 1e6)
+            inf = self._inflight.get(server, 0)
+            if inf > 0:
+                self._inflight[server] = inf - 1
+            cur = self._lat.get(server, self.DEFAULT_LAT_US)
+            sample = (latency_us if not failed
+                      else max(cur * 10, self.ERROR_PENALTY_US))
             self._lat[server] = (1 - self.ALPHA) * cur + self.ALPHA * sample
+            i = self._index.get(server)
+            if i is not None and self._tree is not None:
+                self._tree.set(i, self._weight(server))
 
     def select_server(self, exclude=None, request_key=None):
-        servers = self._alive(exclude)
-        if not servers:
-            return None
         with self._lock:
-            weights = [1.0 / max(self._lat.get(s, 1000.0), 1.0) for s in servers]
-        total = sum(weights)
-        r = (fast_rand_less_than(1 << 30) / float(1 << 30)) * total
-        acc = 0.0
-        for s, w in zip(servers, weights):
-            acc += w
-            if r <= acc:
-                return s
-        return servers[-1]
+            tree = self._tree
+            if tree is None or not self._order:
+                return None
+            masked: list = []
+            try:
+                if exclude:
+                    # temporarily zero excluded weights; restored below
+                    for s in exclude:
+                        i = self._index.get(s)
+                        if i is not None and tree.get(i) > 0:
+                            masked.append((i, tree.get(i)))
+                            tree.set(i, 0.0)
+                total = tree.total
+                if total <= 0:
+                    return None
+                r = (fast_rand_less_than(1 << 30) / float(1 << 30)) * total
+                chosen = self._order[tree.find(r)]
+            finally:
+                for i, w in masked:
+                    tree.set(i, w)
+            if exclude and chosen in exclude:
+                return None
+            # count the in-flight request now: un-answered requests push
+            # weight down before latency feedback even arrives
+            self._inflight[chosen] = self._inflight.get(chosen, 0) + 1
+            tree.set(self._index[chosen], self._weight(chosen))
+            return chosen
 
 
 _factories = {

@@ -23,7 +23,7 @@ import ssl as pyssl
 import threading
 from typing import Callable, Optional
 
-from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
 from brpc_tpu.transport.base import Conn, Listener, Transport
 from brpc_tpu.transport.event_dispatcher import global_dispatcher
 from brpc_tpu.transport.tcp import TcpConn, TcpTransport
@@ -48,25 +48,45 @@ class SslConn(Conn):
         # shared SSL state machine (observed segfault); all ops are
         # non-blocking so the critical sections are short
         self._ssl_lock = threading.Lock()
+        # handshake readiness routing: when the WRITE path stalls on a
+        # handshake that wants a READ, arming epollout would busy-loop
+        # (an established socket is always writable); instead the writer
+        # parks and the read path fires its wakeup once the handshake
+        # completes
+        self._hs_want: Optional[str] = None
+        self._writer_waiting_on_hs = False
 
     # ----------------------------------------------------- handshake
     def _drive_handshake(self) -> bool:
         """Advance the TLS handshake; True when established. Raises
-        BlockingIOError while in progress (requesting the right
-        readiness event first)."""
+        BlockingIOError while in progress (recording which readiness
+        event would unblock it). Callers hold _ssl_lock."""
         if self._handshaken:
             return True
         try:
             self._sock.do_handshake()
         except pyssl.SSLWantReadError:
+            self._hs_want = "read"
             raise BlockingIOError("tls handshake wants read")
         except pyssl.SSLWantWriteError:
-            self.request_writable_event()
+            self._hs_want = "write"
             raise BlockingIOError("tls handshake wants write")
         except pyssl.SSLError as e:
             raise ConnectionError(f"tls handshake failed: {e}") from e
         self._handshaken = True
+        self._hs_want = None
         return True
+
+    def _wake_parked_writer(self) -> None:
+        """Fire the writable callback for a writer that parked on a
+        wants-read handshake (called with _ssl_lock NOT held)."""
+        fire = False
+        with self._ssl_lock:
+            if self._handshaken and self._writer_waiting_on_hs:
+                self._writer_waiting_on_hs = False
+                fire = True
+        if fire and self._on_writable is not None:
+            self._on_writable()
 
     # ------------------------------------------------------------- io
     def write(self, mv: memoryview) -> int:
@@ -87,6 +107,14 @@ class SslConn(Conn):
                 raise
 
     def read_into(self, mv: memoryview) -> int:
+        try:
+            return self._read_into_locked(mv)
+        finally:
+            # a read may have just completed the handshake: release any
+            # writer parked on it
+            self._wake_parked_writer()
+
+    def _read_into_locked(self, mv: memoryview) -> int:
         with self._ssl_lock:
             self._drive_handshake()
             try:
@@ -125,6 +153,13 @@ class SslConn(Conn):
         global_dispatcher().resume_read(self._sock.fileno())
 
     def request_writable_event(self) -> None:
+        with self._ssl_lock:
+            if not self._handshaken and self._hs_want == "read":
+                # epollout on an established socket fires instantly and
+                # would busy-loop for a whole handshake RTT; park the
+                # writer — the read path wakes it on completion
+                self._writer_waiting_on_hs = True
+                return
         if self._on_writable is not None:
             global_dispatcher().request_writable(self._sock.fileno(),
                                                  self._on_writable)
@@ -172,7 +207,7 @@ class SslTransport(Transport):
 
     @staticmethod
     def _client_context(ep: EndPoint) -> pyssl.SSLContext:
-        verify = ep.extra("verify")
+        verify = (ep.extra("verify") or "").lower() in ("1", "true", "yes")
         ca = ep.extra("ca")
         if verify:
             ctx = pyssl.create_default_context(
@@ -209,11 +244,14 @@ class SslTransport(Transport):
         ctx = self._client_context(ep)
         sni = ep.extra("sni") or ep.host
         sock = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM)
-        sock.setblocking(False)
-        try:
-            sock.connect((ep.host, ep.port))
-        except (BlockingIOError, InterruptedError):
-            pass
+        # blocking TCP connect, same contract as TcpTransport.connect:
+        # callers (the health checker's bare-connect probe above all)
+        # rely on connect() raising for an unreachable peer — a
+        # swallowed non-blocking connect would revive dead servers
+        sock.settimeout(10.0)
+        sock.connect((ep.host, ep.port))
+        sock.settimeout(None)
+        lh, lp = sock.getsockname()[:2]
         tls = ctx.wrap_socket(
             sock, server_hostname=sni if ctx.check_hostname or sni else None,
             do_handshake_on_connect=False)
@@ -221,5 +259,4 @@ class SslTransport(Transport):
             tls.setsockopt(pysocket.IPPROTO_TCP, pysocket.TCP_NODELAY, 1)
         except OSError:
             pass
-        local = EndPoint("ssl", "0.0.0.0", 0)
-        return SslConn(tls, local, ep)
+        return SslConn(tls, str2endpoint(f"ssl://{lh}:{lp}"), ep)

@@ -283,3 +283,93 @@ class TestConcurrencyLimiter:
         for _ in range(300):
             lim.on_requested(); lim.on_responded(10000.0, False)
         assert lim.max_concurrency < grown
+
+
+class TestLocalityAwareLB:
+    EPS = [str2endpoint(f"tcp://10.0.0.{i}:80") for i in range(3)]
+
+    def test_fairness_under_latency_skew(self):
+        """Induced skew: one slow server (5ms) vs two fast (1ms). The
+        slow one must receive materially fewer picks, but not starve
+        (policy/locality_aware_load_balancer.cpp's weighted tree)."""
+        lb = LocalityAwareLB()
+        slow, fast1, fast2 = self.EPS
+        lb.reset_servers(self.EPS)
+        lat = {slow: 5000.0, fast1: 1000.0, fast2: 1000.0}
+        counts = {ep: 0 for ep in self.EPS}
+        for _ in range(3000):
+            s = lb.select_server()
+            counts[s] += 1
+            lb.feedback(s, lat[s], False)
+        # steady state weights ~ 1/lat: fast ~5x the slow one's share
+        assert counts[fast1] > counts[slow] * 2.5
+        assert counts[fast2] > counts[slow] * 2.5
+        assert counts[slow] > 100          # never starved
+
+    def test_inflight_pushes_weight_down(self):
+        """A server with many un-answered selections loses weight even
+        though its latency EMA never moved (the inflight accounting the
+        divide tree keeps per node)."""
+        lb = LocalityAwareLB()
+        a, b = self.EPS[0], self.EPS[1]
+        lb.reset_servers([a, b])
+        # equal latency history
+        for _ in range(10):
+            for s in (a, b):
+                lb.select_server()
+                lb.feedback(s, 1000.0, False)
+        # 30 selections pile up on whichever is chosen, no feedback:
+        # the pile-up must spread across both, not hammer one
+        picks = [lb.select_server() for _ in range(30)]
+        assert 5 < picks.count(a) < 25
+        # now a holds a stuck backlog: release b's share only
+        for s in picks:
+            if s is b:
+                lb.feedback(b, 1000.0, False)
+        picks2 = [lb.select_server() for _ in range(20)]
+        assert picks2.count(b) > picks2.count(a)
+
+    def test_error_feedback_decays_weight(self):
+        lb = LocalityAwareLB()
+        good, bad = self.EPS[0], self.EPS[1]
+        lb.reset_servers([good, bad])
+        for _ in range(20):
+            for s, failed in ((good, False), (bad, True)):
+                lb.select_server()
+                lb.feedback(s, 1000.0, failed)
+        picks = [lb.select_server() for _ in range(100)]
+        assert picks.count(good) > 90
+
+    def test_new_server_gets_probed(self):
+        lb = LocalityAwareLB()
+        a, b = self.EPS[0], self.EPS[1]
+        lb.reset_servers([a])
+        for _ in range(20):
+            lb.select_server()
+            lb.feedback(a, 500.0, False)
+        lb.reset_servers([a, self.EPS[2]])
+        picks = [lb.select_server() for _ in range(50)]
+        assert picks.count(self.EPS[2]) > 5   # optimistic start weight
+
+    def test_exclusion_restores_weights(self):
+        lb = LocalityAwareLB()
+        lb.reset_servers(self.EPS)
+        s = lb.select_server(exclude={self.EPS[0], self.EPS[1]})
+        assert s is self.EPS[2]
+        # masked weights restored: unexcluded select can pick anyone
+        seen = {lb.select_server() for _ in range(100)}
+        assert len(seen) == 3
+
+    def test_abandon_returns_inflight_slot(self):
+        """A backup-request loser gets abandon(), not feedback: the
+        slot returns without touching the latency EMA."""
+        lb = LocalityAwareLB()
+        a, b = self.EPS[0], self.EPS[1]
+        lb.reset_servers([a, b])
+        for _ in range(50):
+            s = lb.select_server()
+            lb.abandon(s)
+        # all slots returned: weights unchanged, both still picked
+        seen = {lb.select_server() for _ in range(50)}
+        assert seen == {a, b}
+        assert lb._inflight.get(a, 0) <= 51 and lb._inflight.get(b, 0) <= 51
