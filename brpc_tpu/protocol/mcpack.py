@@ -164,7 +164,7 @@ def pb_to_mcpack(msg) -> Dict[str, Any]:
 
 
 def _pb_value(field, value):
-    if field.label == field.LABEL_REPEATED:
+    if field.is_repeated:
         return [_pb_scalar(field, v) for v in value]
     return _pb_scalar(field, value)
 
@@ -185,7 +185,7 @@ def mcpack_to_pb(doc: Dict[str, Any], msg) -> None:
         if field.name not in doc:
             continue
         v = doc[field.name]
-        if field.label == field.LABEL_REPEATED:
+        if field.is_repeated:
             target = getattr(msg, field.name)
             for item in (v if isinstance(v, list) else [v]):
                 if field.type == field.TYPE_MESSAGE:

@@ -33,20 +33,24 @@ _MAX_BODY = 64 << 20
 
 
 class NsheadMessage:
-    __slots__ = ("id", "version", "log_id", "provider", "body")
+    __slots__ = ("id", "version", "log_id", "provider", "body", "reserved")
 
     def __init__(self, body: bytes = b"", id: int = 0, version: int = 0,
-                 log_id: int = 0, provider: bytes = b"brpc-tpu"):
+                 log_id: int = 0, provider: bytes = b"brpc-tpu",
+                 reserved: int = 0):
         self.id = id
         self.version = version
         self.log_id = log_id
         self.provider = provider[:16]
         self.body = bytes(body)
+        # nova_pbrpc carries the method index here
+        # (nova_pbrpc_protocol.cpp ParseNsheadMeta)
+        self.reserved = reserved
 
     def pack(self) -> bytes:
         return _HDR.pack(self.id, self.version, self.log_id,
-                         self.provider.ljust(16, b"\x00"), NSHEAD_MAGIC, 0,
-                         len(self.body)) + self.body
+                         self.provider.ljust(16, b"\x00"), NSHEAD_MAGIC,
+                         self.reserved, len(self.body)) + self.body
 
 
 def unpack_head(head: bytes) -> Tuple[int, int, int, bytes, int, int, int]:
@@ -70,7 +74,7 @@ class NsheadProtocol(Protocol):
             return PARSE_TRY_OTHERS, None
         if len(head) < HEADER_SIZE:
             return PARSE_NOT_ENOUGH_DATA, None
-        id_, version, log_id, provider, _magic, _res, body_len = \
+        id_, version, log_id, provider, _magic, reserved, body_len = \
             _HDR.unpack(head)
         if body_len > _MAX_BODY:
             socket.set_failed(ConnectionError(
@@ -81,7 +85,7 @@ class NsheadProtocol(Protocol):
         portal.pop_front(HEADER_SIZE)
         body = portal.cut(body_len).to_bytes()
         msg = NsheadMessage(body, id_, version, log_id,
-                            provider.rstrip(b"\x00"))
+                            provider.rstrip(b"\x00"), reserved=reserved)
         return PARSE_OK, msg
 
     # -------------------------------------------------------------- process

@@ -1,0 +1,288 @@
+"""nova_pbrpc, public_pbrpc and ubrpc — the remaining nshead-family
+protocols (reference: policy/nova_pbrpc_protocol.cpp,
+policy/public_pbrpc_protocol.cpp, policy/ubrpc2pb_protocol.cpp).
+
+All three ride the nshead framing (protocol/nshead.py) and install as
+``ServerOptions(nshead_service=<adaptor>(svc))`` handlers, exactly as
+the reference funnels them through NsheadService adaptors:
+
+* **nova_pbrpc**: no meta at all — the method is addressed by INDEX in
+  the nshead ``reserved`` field over the server's single service, the
+  body is the bare pb request, and ``version & 0x1`` flags snappy.
+  There is no correlation id on the wire, so responses match requests
+  by connection order (the reference stores the correlation id on the
+  socket and forbids CONNECTION_TYPE_SINGLE).
+* **public_pbrpc**: body is a ``PublicPbrpcRequest`` pb envelope
+  (requestHead + requestBody[service, method_id, id,
+  serialized_request]); the ``id`` carries correlation. nshead id field
+  is unused.
+* **ubrpc (compack flavor)**: body is an mcpack object
+  ``{content: [{service_name, method, id, params}]}``; responses carry
+  ``{content: [{id, result|error:{code,message}}]}``. The reference
+  additionally supports the "nested" flavor and mcpack_v2 — this
+  implementation speaks the compack-object shape over our mcpack codec.
+"""
+
+from __future__ import annotations
+
+import inspect
+import itertools
+import struct
+from typing import Any, Dict, Optional
+
+from brpc_tpu.protocol.mcpack import (McpackError, decode, encode,
+                                      mcpack_to_pb, pb_to_mcpack)
+from brpc_tpu.protocol.nshead import NsheadClient, NsheadMessage
+from brpc_tpu.protocol.proto import public_pbrpc_meta_pb2 as ppb
+
+NOVA_SNAPPY_COMPRESS_FLAG = 0x1
+UBRPC_NSHEAD_VERSION = 1000
+
+
+def _methods_in_order(service):
+    return list(service.methods.values())
+
+
+def _serialize_reply(r) -> bytes:
+    if r is None:
+        return b""
+    if hasattr(r, "SerializeToString"):
+        return r.SerializeToString()
+    return bytes(r)
+
+
+async def _invoke(method, raw_body, socket, request=None):
+    """Shared guarded dispatch for the nshead-family adaptors: build the
+    request (unless pre-built), run the handler, never let an exception
+    escape into the framing layer (an unanswered FIFO slot desyncs every
+    later reply on the connection). Returns (reply, cntl, error_text) —
+    error_text None on success."""
+    from brpc_tpu.rpc.controller import Controller
+    cntl = Controller()
+    cntl.remote_side = socket.remote_endpoint
+    if request is None:
+        if method.request_class is not None:
+            request = method.request_class()
+            try:
+                request.ParseFromString(raw_body)
+            except Exception as e:
+                return None, cntl, f"bad request body: {e}"
+        else:
+            request = raw_body
+    try:
+        r = method.handler(cntl, request)
+        if inspect.isawaitable(r):
+            r = await r
+    except Exception as e:
+        return None, cntl, f"handler error: {e}"
+    if cntl.failed():
+        return None, cntl, cntl.error_text
+    return r, cntl, None
+
+
+# ------------------------------------------------------------------ nova
+
+def nova_adaptor(service):
+    """Serve a Service over nova_pbrpc. The method index in
+    ``head.reserved`` selects methods in registration order
+    (nova_pbrpc_protocol.cpp ParseNsheadMeta: first service, method by
+    |reserved|). Errors cannot be reported on the wire — the reference
+    closes the connection; we do the same by returning no reply."""
+    methods = _methods_in_order(service)
+
+    async def handler(socket, msg: NsheadMessage):
+        if not 0 <= msg.reserved < len(methods):
+            socket.set_failed(ConnectionError(
+                f"nova: no method at index {msg.reserved}"))
+            return None
+        if msg.version & NOVA_SNAPPY_COMPRESS_FLAG:
+            # no snappy codec in this image (rpc/compress.py note); nova
+            # compression is rejected loudly rather than mis-decoded
+            socket.set_failed(ConnectionError(
+                "nova: snappy-compressed request but no snappy codec"))
+            return None
+        r, _cntl, err = await _invoke(methods[msg.reserved], msg.body,
+                                      socket)
+        if err is not None:
+            # nova can not send feedback on failure: close the conn
+            # (nova_pbrpc_protocol.cpp CloseConnection) — an unanswered
+            # slot would silently hand the NEXT reply to this waiter
+            socket.set_failed(ConnectionError(f"nova: {err}"))
+            return None
+        return NsheadMessage(_serialize_reply(r), id=msg.id,
+                             log_id=msg.log_id)
+
+    return handler
+
+
+class NovaClient(NsheadClient):
+    """Call a nova_pbrpc server: method by index, pb or bytes payload.
+    Matching is by connection order (pipelined FIFO), the same
+    single-conn-forbidden model as the reference."""
+
+    def call_method(self, method_index: int, request, log_id: int = 0):
+        body = _serialize_reply(request)
+        reply = self.call(NsheadMessage(body, log_id=log_id,
+                                        reserved=method_index))
+        return reply.body
+
+
+# ---------------------------------------------------------- public_pbrpc
+
+def public_pbrpc_adaptor(service):
+    """Serve a Service over public_pbrpc: requestBody.method_id indexes
+    methods in registration order; the body envelope's id ties the
+    response (public_pbrpc_protocol.cpp ProcessPublicPbrpcRequest)."""
+    methods = _methods_in_order(service)
+
+    async def handler(socket, msg: NsheadMessage):
+        req = ppb.PublicPbrpcRequest()
+        try:
+            req.ParseFromString(msg.body)
+        except Exception:
+            socket.set_failed(ConnectionError("public_pbrpc: bad envelope"))
+            return None
+        res = ppb.PublicPbrpcResponse()
+        res.responseHead.code = 0
+        res.responseHead.from_host = "brpc-tpu"
+        for body in req.requestBody:
+            rb = res.responseBody.add()
+            rb.id = body.id
+            if not 0 <= body.method_id < len(methods):
+                rb.error = 1002
+                continue
+            r, cntl, err = await _invoke(methods[body.method_id],
+                                         bytes(body.serialized_request),
+                                         socket)
+            if err is not None:
+                # per-body error channel: one bad request must not
+                # drop the whole envelope (that desyncs FIFO matching)
+                rb.error = cntl.error_code or 2001
+            else:
+                rb.serialized_response = _serialize_reply(r)
+        return NsheadMessage(res.SerializeToString(), id=msg.id,
+                             log_id=msg.log_id)
+
+    return handler
+
+
+class PublicPbrpcClient(NsheadClient):
+    _ids = itertools.count(1)
+
+    def call_method(self, service: str, method_id: int, request,
+                    log_id: int = 0) -> bytes:
+        """Returns the serialized response bytes; raises on wire error."""
+        env = ppb.PublicPbrpcRequest()
+        env.requestHead.log_id = log_id
+        body = env.requestBody.add()
+        body.service = service
+        body.method_id = method_id
+        body.id = next(self._ids)
+        body.serialized_request = _serialize_reply(request)
+        reply = self.call(NsheadMessage(env.SerializeToString(),
+                                        log_id=log_id))
+        res = ppb.PublicPbrpcResponse()
+        res.ParseFromString(reply.body)
+        if not res.responseBody:
+            raise ConnectionError("public_pbrpc: empty response envelope")
+        rb = res.responseBody[0]
+        if rb.id != body.id:
+            raise ConnectionError(
+                f"public_pbrpc: response id {rb.id} != request id {body.id}")
+        if rb.error:
+            raise ConnectionError(f"public_pbrpc: remote error {rb.error}")
+        return bytes(rb.serialized_response)
+
+
+# ------------------------------------------------------------------ ubrpc
+
+def ubrpc_adaptor(service):
+    """Serve a Service over ubrpc's compack-object shape
+    (ubrpc2pb_protocol.cpp ParseNsheadMeta): request.content[0] holds
+    service_name/method/id/params; params maps to the pb request via the
+    mcpack bridge. Error replies carry {id, error:{code,message}}."""
+
+    async def handler(socket, msg: NsheadMessage):
+
+        def error_reply(corr_id, code, text):
+            return NsheadMessage(encode({"content": [
+                {"id": corr_id,
+                 "error": {"code": code, "message": text}}]}),
+                id=msg.id, version=UBRPC_NSHEAD_VERSION, log_id=msg.log_id)
+
+        try:
+            doc = decode(msg.body)
+        except McpackError as e:
+            return error_reply(0, 2001, f"bad compack body: {e}")
+        content = doc.get("content")
+        if not isinstance(content, list) or not content:
+            return error_reply(0, 2001, "missing request.content")
+        item = content[0]
+        corr_id = int(item.get("id", 0))
+        method_name = str(item.get("method", ""))
+        if not method_name:
+            return error_reply(corr_id, 1002, "missing method")
+        method = service.methods.get(method_name)
+        if method is None:
+            return error_reply(corr_id, 1002,
+                               f"unknown method {method_name!r}")
+        params = item.get("params")
+        if not isinstance(params, dict):
+            return error_reply(corr_id, 2001, "missing params object")
+        if method.request_class is not None:
+            request = method.request_class()
+            try:
+                mcpack_to_pb(params, request)
+            except Exception as e:
+                return error_reply(corr_id, 2001, f"bad params: {e}")
+        else:
+            request = params
+        r, cntl, err = await _invoke(method, b"", socket, request=request)
+        if err is not None:
+            return error_reply(corr_id, cntl.error_code or 2001, err)
+        if hasattr(r, "ListFields"):
+            result: Any = pb_to_mcpack(r)
+        elif isinstance(r, (bytes, bytearray, memoryview)):
+            result = bytes(r)
+        elif isinstance(r, dict) or r is None:
+            result = r or {}
+        else:
+            result = r
+        return NsheadMessage(encode({"content": [
+            {"id": corr_id, "result": result}]}),
+            id=msg.id, version=UBRPC_NSHEAD_VERSION, log_id=msg.log_id)
+
+    return handler
+
+
+class UbrpcClient(NsheadClient):
+    _ids = itertools.count(1)
+
+    def call_method(self, service_name: str, method: str,
+                    params: Dict[str, Any] | Any, log_id: int = 0):
+        """params: a dict (or pb message, converted via the bridge).
+        Returns the ``result`` value; raises on a remote error."""
+        if hasattr(params, "ListFields"):
+            params = pb_to_mcpack(params)
+        corr_id = next(self._ids)
+        body = encode({"content": [{
+            "service_name": service_name, "method": method,
+            "id": corr_id, "params": params}]})
+        reply = self.call(NsheadMessage(
+            body, version=UBRPC_NSHEAD_VERSION, log_id=log_id))
+        doc = decode(reply.body)
+        content = doc.get("content") or [{}]
+        item = content[0]
+        # surface a remote error FIRST: pre-dispatch server errors
+        # (undecodable body) legitimately carry id 0, and an id-mismatch
+        # complaint would mask the actual diagnostic
+        err = item.get("error")
+        if err:
+            raise ConnectionError(
+                f"ubrpc: remote error {err.get('code')}: "
+                f"{err.get('message')}")
+        got_id = int(item.get("id", -1))
+        if got_id != corr_id:
+            raise ConnectionError(
+                f"ubrpc: response id {got_id} != request id {corr_id}")
+        return item.get("result")

@@ -1,0 +1,158 @@
+"""nova_pbrpc / public_pbrpc / ubrpc over nshead framing
+(reference: policy/nova_pbrpc_protocol.cpp,
+policy/public_pbrpc_protocol.cpp, policy/ubrpc2pb_protocol.cpp)."""
+
+import itertools
+
+import pytest
+
+from brpc_tpu.protocol.nshead_pbrpc import (NovaClient, PublicPbrpcClient,
+                                            UbrpcClient, nova_adaptor,
+                                            public_pbrpc_adaptor,
+                                            ubrpc_adaptor)
+from brpc_tpu.rpc import Server, ServerOptions, Service
+from tests.proto import echo_pb2
+
+_seq = itertools.count()
+
+
+def start_server(adaptor_factory):
+    svc = Service("EchoService")
+
+    @svc.method(request_class=echo_pb2.EchoRequest)
+    def Echo(cntl, request):
+        res = echo_pb2.EchoResponse()
+        res.message = "re: " + request.message
+        return res
+
+    @svc.method()
+    def Fail(cntl, request):
+        cntl.set_failed(1007, "induced failure")
+        return b""
+
+    server = Server(ServerOptions(
+        enable_builtin_services=False,
+        nshead_service=adaptor_factory(svc)))
+    ep = server.start(f"tcp://127.0.0.1:0")
+    return server, ep
+
+
+class TestNova:
+    def test_pb_roundtrip_by_method_index(self):
+        server, ep = start_server(nova_adaptor)
+        try:
+            cl = NovaClient(f"tcp://{ep.host}:{ep.port}")
+            req = echo_pb2.EchoRequest(message="hi nova")
+            body = cl.call_method(0, req)          # Echo is index 0
+            res = echo_pb2.EchoResponse()
+            res.ParseFromString(body)
+            assert res.message == "re: hi nova"
+            cl.close()
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_bad_method_index_drops_connection(self):
+        server, ep = start_server(nova_adaptor)
+        try:
+            cl = NovaClient(f"tcp://{ep.host}:{ep.port}", timeout_s=2.0)
+            with pytest.raises(Exception):
+                cl.call_method(99, echo_pb2.EchoRequest(message="x"))
+            cl.close()
+        finally:
+            server.stop()
+            server.join(2)
+
+
+class TestPublicPbrpc:
+    def test_pb_roundtrip_with_envelope_id(self):
+        server, ep = start_server(public_pbrpc_adaptor)
+        try:
+            cl = PublicPbrpcClient(f"tcp://{ep.host}:{ep.port}")
+            req = echo_pb2.EchoRequest(message="hi public")
+            body = cl.call_method("EchoService", 0, req)
+            res = echo_pb2.EchoResponse()
+            res.ParseFromString(body)
+            assert res.message == "re: hi public"
+            cl.close()
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_remote_error_surfaces(self):
+        server, ep = start_server(public_pbrpc_adaptor)
+        try:
+            cl = PublicPbrpcClient(f"tcp://{ep.host}:{ep.port}")
+            with pytest.raises(ConnectionError, match="remote error"):
+                cl.call_method("EchoService", 1, b"")     # Fail method
+            cl.close()
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_unknown_method_id(self):
+        server, ep = start_server(public_pbrpc_adaptor)
+        try:
+            cl = PublicPbrpcClient(f"tcp://{ep.host}:{ep.port}")
+            with pytest.raises(ConnectionError, match="remote error 1002"):
+                cl.call_method("EchoService", 42, b"")
+            cl.close()
+        finally:
+            server.stop()
+            server.join(2)
+
+
+class TestUbrpc:
+    def test_params_bridge_roundtrip(self):
+        server, ep = start_server(ubrpc_adaptor)
+        try:
+            cl = UbrpcClient(f"tcp://{ep.host}:{ep.port}")
+            result = cl.call_method("EchoService", "Echo",
+                                    {"message": "hi ubrpc"})
+            assert result["message"] == "re: hi ubrpc"
+            cl.close()
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_remote_error_carries_code_and_message(self):
+        server, ep = start_server(ubrpc_adaptor)
+        try:
+            cl = UbrpcClient(f"tcp://{ep.host}:{ep.port}")
+            with pytest.raises(ConnectionError,
+                               match="1007: induced failure"):
+                cl.call_method("EchoService", "Fail", {})
+            cl.close()
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_unknown_method(self):
+        server, ep = start_server(ubrpc_adaptor)
+        try:
+            cl = UbrpcClient(f"tcp://{ep.host}:{ep.port}")
+            with pytest.raises(ConnectionError, match="unknown method"):
+                cl.call_method("EchoService", "Nope", {})
+            cl.close()
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_malformed_body_gets_per_body_error(self):
+        """One undecodable serialized_request must produce rb.error, not
+        drop the whole envelope (which would desync FIFO matching)."""
+        server, ep = start_server(public_pbrpc_adaptor)
+        try:
+            cl = PublicPbrpcClient(f"tcp://{ep.host}:{ep.port}")
+            with pytest.raises(ConnectionError, match="remote error"):
+                cl.call_method("EchoService", 0, b"\xff\xfe not-a-pb")
+            # connection still usable: FIFO not desynced
+            body = cl.call_method("EchoService", 0,
+                                  echo_pb2.EchoRequest(message="after"))
+            res = echo_pb2.EchoResponse()
+            res.ParseFromString(body)
+            assert res.message == "re: after"
+            cl.close()
+        finally:
+            server.stop()
+            server.join(2)
