@@ -67,3 +67,71 @@ def render_text(leaves: Counter, nsamples: int, top: int = 40) -> str:
 def render_folded(folded: Counter) -> str:
     """flamegraph.pl-compatible: 'frame;frame;frame count' per line."""
     return "".join(f"{stack} {n}\n" for stack, n in folded.most_common())
+
+
+# ------------------------------------------------------------------ heap
+# tracemalloc-backed heap/growth profiles: the /hotspots?type=heap and
+# type=growth pages (reference: MallocExtension heap/growth samples via
+# details/tcmalloc_extension.h + hotspots_service.cpp). tracemalloc has
+# runtime cost, so tracing starts on FIRST request and the page says so.
+
+_growth_baseline = None
+_heap_lock = threading.Lock()
+
+
+def heap_profile(top: int = 40) -> str:
+    """Top allocation sites by live bytes (start tracing on first call)."""
+    import tracemalloc
+    with _heap_lock:
+        if not tracemalloc.is_tracing():
+            tracemalloc.start(16)
+            return ("heap tracing STARTED (tracemalloc, 16 frames); "
+                    "allocations from this point on are tracked — "
+                    "request this page again for the profile\n")
+        snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")
+    total = sum(s.size for s in stats)
+    out = [f"live traced bytes: {total} in {len(stats)} sites "
+           f"(top {top})\n", f"{'bytes':>12} {'count':>8}  site\n"]
+    for s in stats[:top]:
+        frame = s.traceback[0]
+        out.append(f"{s.size:>12} {s.count:>8}  "
+                   f"{frame.filename}:{frame.lineno}\n")
+    return "".join(out)
+
+
+def growth_profile(top: int = 40) -> str:
+    """Allocation growth since the previous growth snapshot (the
+    MallocExtension growth-profile slot)."""
+    import tracemalloc
+    global _growth_baseline
+    with _heap_lock:
+        if not tracemalloc.is_tracing():
+            tracemalloc.start(16)
+            return ("heap tracing STARTED; request this page again to "
+                    "set the growth baseline\n")
+        snap = tracemalloc.take_snapshot()
+        prev, _growth_baseline = _growth_baseline, snap
+    if prev is None:
+        return "growth baseline SET; request again to see the delta\n"
+    stats = snap.compare_to(prev, "lineno")
+    out = [f"{'delta_bytes':>12} {'delta_cnt':>10}  site (top {top}, "
+           f"since last request)\n"]
+    for s in stats[:top]:
+        frame = s.traceback[0]
+        out.append(f"{s.size_diff:>12} {s.count_diff:>10}  "
+                   f"{frame.filename}:{frame.lineno}\n")
+    return "".join(out)
+
+
+def heap_stop() -> str:
+    """Stop tracemalloc tracing (it costs ~2x on allocation-heavy code;
+    the page exposes ?type=heap&stop=1 to turn it back off)."""
+    import tracemalloc
+    global _growth_baseline
+    with _heap_lock:
+        _growth_baseline = None
+        if tracemalloc.is_tracing():
+            tracemalloc.stop()
+            return "heap tracing STOPPED\n"
+        return "heap tracing was not running\n"

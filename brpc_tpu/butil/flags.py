@@ -80,3 +80,8 @@ define_flag("graceful_quit_on_sigterm", True,
 define_flag("rpcz_enabled", True, "collect per-RPC spans for /rpcz")
 define_flag("rpcz_max_spans", 1024, "span ring-buffer capacity",
             validator=lambda v: v >= 16)
+define_flag("rpcz_dir", "",
+            "directory for on-disk rpcz persistence (empty = memory only)")
+define_flag("rpcz_db_max_bytes", 16 << 20,
+            "rotate the rpcz span file at this size; one old file is kept",
+            validator=lambda v: v >= 1 << 20)

@@ -63,6 +63,15 @@ def _response(status: int, body: bytes, content_type: str = "text/plain",
     return out
 
 
+def _query_flag(req: "HttpRequest", name: str) -> bool:
+    """Boolean query param: ?x / ?x=1 / ?x=true are on; ?x=0 / ?x=false
+    are off (a raw truthy-string check would treat \"0\" as on)."""
+    v = req.query.get(name)
+    if v is None:
+        return False
+    return v == "" or v.lower() in ("1", "true", "yes")
+
+
 def _thread_stacks() -> bytes:
     """All OS threads' Python stacks (the /bthreads + /threads pages of
     the reference — here workers ARE pthreads running fibers)."""
@@ -214,12 +223,19 @@ class HttpProtocol(Protocol):
                      for s in server.connections()]
             return 200, "application/json", json.dumps(conns).encode()
         if path == "/rpcz":
-            from brpc_tpu.rpc.span import global_collector
+            from brpc_tpu.rpc.span import global_collector, global_store
             tid = req.query.get("trace_id")
+            n = max(1, int(req.query.get("n", "50")))
+            if _query_flag(req, "history"):
+                # read back from the on-disk SpanDB analog (rpcz_dir):
+                # spans that aged out of the in-memory ring
+                rows = global_store.read(
+                    n, trace_id=int(tid, 16) if tid else None)
+                return 200, "application/json", json.dumps(rows).encode()
             if tid:
                 spans = global_collector.find_trace(int(tid, 16))
             else:
-                spans = global_collector.recent(int(req.query.get("n", "50")))
+                spans = global_collector.recent(n)
             return 200, "application/json", json.dumps(
                 [s.to_dict() for s in spans]).encode()
         if path == "/version":
@@ -308,8 +324,23 @@ class HttpProtocol(Protocol):
         import threading
 
         from brpc_tpu.builtin.profiler import (
-            render_folded, render_text, sample_cpu)
+            growth_profile, heap_profile, heap_stop, render_folded,
+            render_text, sample_cpu)
         from brpc_tpu.fiber.sync import FiberEvent
+        ptype = req.query.get("type", "cpu")
+        if ptype in ("heap", "growth"):
+            # tracemalloc snapshots are quick; no sampler thread needed
+            if _query_flag(req, "stop"):
+                return 200, "text/plain", heap_stop().encode()
+            try:
+                top = min(200, int(req.query.get("top", "40")))
+            except ValueError:
+                return 400, "text/plain", b"bad top"
+            text = (heap_profile(top) if ptype == "heap"
+                    else growth_profile(top))
+            return 200, "text/plain", text.encode()
+        if ptype != "cpu":
+            return 400, "text/plain", b"type must be cpu|heap|growth"
         try:
             seconds = min(30.0, float(req.query.get("seconds", "1")))
         except ValueError:
@@ -356,15 +387,8 @@ class HttpProtocol(Protocol):
         return 200, "application/json", json.dumps(loggers).encode()
 
     def _index(self, server) -> bytes:
-        pages = ["status", "vars", "flags", "health", "connections",
-                 "brpc_metrics", "rpcz", "version", "protobufs", "sockets",
-                 "fibers", "threads", "ids", "hotspots", "vlog"]
-        links = "".join(f'<li><a href="/{p}">/{p}</a></li>' for p in pages)
-        svcs = "".join(
-            f"<li>{n}: {', '.join(sorted(s.methods))}</li>"
-            for n, s in server.services().items())
-        return (f"<html><body><h1>brpc_tpu</h1><ul>{links}</ul>"
-                f"<h2>services</h2><ul>{svcs}</ul></body></html>").encode()
+        from brpc_tpu.builtin.tabbed import render_index
+        return render_index(server)
 
     def _status(self, server) -> bytes:
         return json.dumps({

@@ -2,14 +2,19 @@
 (brpc/span.h:47, bvar/collector.* — SURVEY.md §5).
 
 Spans are cheap dataclass records annotated at each stage and kept in a
-ring buffer (the reference persists to leveldb; ours keeps a bounded
-in-memory ring, dumped by /rpcz). Trace ids propagate in RpcMeta
-(trace_id/span_id/parent_span_id fields), so multi-hop call trees link up.
+ring buffer, dumped by /rpcz. Setting the ``rpcz_dir`` flag additionally
+persists finished spans to a bounded on-disk store (the reference's
+leveldb SpanDB, span.cpp:308, as rotating JSON-lines files):
+/rpcz?history=1 reads back spans that have aged out of the ring. Trace
+ids propagate in RpcMeta (trace_id/span_id/parent_span_id fields), so
+multi-hop call trees link up.
 """
 
 from __future__ import annotations
 
 import itertools
+import json
+import os
 import threading
 import time
 from collections import deque
@@ -98,6 +103,75 @@ class SpanCollector:
             self._ring.clear()
 
 
+class SpanStore:
+    """Bounded on-disk persistence: JSON-lines, rotated once at
+    rpcz_db_max_bytes (current + one aged file ≈ the leveldb SpanDB's
+    bounded footprint). Writes are append+flush under a lock — rpcz is
+    sampled, not hot-path."""
+
+    FILE = "rpcz_spans.jsonl"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fh = None
+        self._dir = None
+
+    def _path(self, old: bool = False) -> str:
+        return os.path.join(self._dir, self.FILE + (".1" if old else ""))
+
+    def _ensure_open(self, dirpath: str):
+        if self._fh is not None and self._dir == dirpath:
+            return
+        if self._fh is not None:
+            self._fh.close()
+        self._dir = dirpath
+        os.makedirs(dirpath, exist_ok=True)
+        self._fh = open(self._path(), "a", encoding="utf-8")
+
+    def write(self, span: "Span") -> None:
+        dirpath = flag("rpcz_dir")
+        if not dirpath:
+            return
+        line = json.dumps(span.to_dict()) + "\n"
+        with self._lock:
+            try:
+                self._ensure_open(dirpath)
+                self._fh.write(line)
+                self._fh.flush()
+                if self._fh.tell() >= int(flag("rpcz_db_max_bytes")):
+                    self._fh.close()
+                    self._fh = None
+                    os.replace(self._path(), self._path(old=True))
+            except OSError:
+                pass            # persistence must never fail the RPC
+
+    def read(self, n: int = 100,
+             trace_id: Optional[int] = None) -> List[dict]:
+        dirpath = flag("rpcz_dir")
+        if not dirpath or n <= 0:
+            return []
+        # bounded ring while scanning: the files can hold 2x
+        # rpcz_db_max_bytes of lines — never materialize them all
+        rows: Deque[dict] = deque(maxlen=n)
+        for old in (True, False):       # aged file first: oldest→newest
+            try:
+                with open(os.path.join(dirpath,
+                                       self.FILE + (".1" if old else "")),
+                          encoding="utf-8") as f:
+                    for line in f:
+                        try:
+                            d = json.loads(line)
+                        except ValueError:
+                            continue
+                        if trace_id is None or \
+                                int(d.get("trace_id", "0"), 16) == trace_id:
+                            rows.append(d)
+            except OSError:
+                continue
+        return list(rows)
+
+
+global_store = SpanStore()
 global_collector = SpanCollector()
 
 
@@ -148,3 +222,5 @@ def finish_span(span: Span, cntl) -> None:
     if cntl.remote_side and not span.remote_side:
         span.remote_side = str(cntl.remote_side)
     global_collector.submit(span)
+    if flag("rpcz_enabled"):
+        global_store.write(span)

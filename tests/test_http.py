@@ -284,3 +284,74 @@ def test_vlog_page(server):
     assert json.loads(body).get("test.vlog.mod") == "DEBUG"
     status, _ = http_get(ep, "/vlog?module=test.vlog.mod&level=BOGUS")
     assert status == 400
+
+
+class TestObservabilityDepth:
+    def test_tabbed_index_shell(self, server):
+        _, ep = server
+        status, body = http_get(ep, "/")
+        assert status == 200
+        # the tab shell carries every page and the fetch-render script
+        for tab in (b"rpcz", b"hotspots", b"contentions", b"vlog"):
+            assert tab in body
+        assert b"<script>" in body and b"fetch(" in body
+
+    def test_heap_profile_two_phase(self, server):
+        _, ep = server
+        try:
+            status, body = http_get(ep, "/hotspots?type=heap")
+            assert status == 200
+            if b"STARTED" in body:
+                status, body = http_get(ep, "/hotspots?type=heap")
+                assert status == 200
+            assert b"live traced bytes" in body
+        finally:
+            # tracing costs ~2x on allocations: stop it for the rest of
+            # the suite (the page exposes the same control)
+            http_get(ep, "/hotspots?type=heap&stop=1")
+
+    def test_growth_profile(self, server):
+        _, ep = server
+        try:
+            for _ in range(3):   # start tracing -> baseline -> delta
+                status, body = http_get(ep, "/hotspots?type=growth")
+                assert status == 200
+                if b"delta_bytes" in body:
+                    break
+            assert b"delta_bytes" in body
+        finally:
+            status, body = http_get(ep, "/hotspots?type=heap&stop=1")
+            assert status == 200 and b"STOPPED" in body
+
+    def test_bad_profile_type(self, server):
+        _, ep = server
+        status, _ = http_get(ep, "/hotspots?type=nope")
+        assert status == 400
+
+    def test_rpcz_persistence_roundtrip(self, server, tmp_path):
+        from brpc_tpu.butil.flags import set_flag
+        _, ep = server
+        set_flag("rpcz_dir", str(tmp_path))
+        try:
+            ch = Channel(str(ep))
+            assert not ch.call_sync("EchoService", "Echo",
+                                    b"persisted").failed()
+            deadline = time.monotonic() + 3
+            rows = []
+            while time.monotonic() < deadline:
+                status, body = http_get(ep, "/rpcz?history=1")
+                assert status == 200
+                rows = json.loads(body)
+                if any(r["method"] == "Echo" for r in rows):
+                    break
+                time.sleep(0.05)
+            assert any(r["method"] == "Echo" for r in rows)
+            # filter by trace id through the disk path
+            tid = rows[-1]["trace_id"]
+            status, body = http_get(
+                ep, f"/rpcz?history=1&trace_id={tid}")
+            hits = json.loads(body)
+            assert hits and all(r["trace_id"] == tid for r in hits)
+            ch.close()
+        finally:
+            set_flag("rpcz_dir", "")
