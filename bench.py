@@ -167,10 +167,18 @@ def main() -> None:
     from brpc_tpu.rpc import (Channel, ChannelOptions, Server, ServerOptions,
                               Service)
 
+    from brpc_tpu import native
+
     result: dict = {
         "metric": "echo_rpc_1mb_bandwidth_tcp_loopback",
         "value": 0.0, "unit": "GB/s", "vs_baseline": 0.0,
         "partial": False, "device_lane": {},
+        # which C++ core pieces are load-bearing (hash + c_murmurhash LB
+        # always; the frame scanner is flag-gated — measured at parity
+        # with the per-frame path, see protocol/tpu_std.py batch_parse)
+        "native": {"available": native.available(),
+                   "wired": ["crc32c", "murmur3 (c_murmurhash LB)",
+                             "trpc_scan (flag tpu_std_batch_parse)"]},
     }
     deadline = Deadline(WALL_BUDGET_S)
 

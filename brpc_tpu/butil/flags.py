@@ -80,6 +80,10 @@ define_flag("graceful_quit_on_sigterm", True,
 define_flag("rpcz_enabled", True, "collect per-RPC spans for /rpcz")
 define_flag("rpcz_max_spans", 1024, "span ring-buffer capacity",
             validator=lambda v: v >= 16)
+define_flag("tpu_std_batch_parse", False,
+            "cut pipelined tpu_std bursts with the native frame scanner "
+            "(measured ~parity with the per-frame path under CPython; "
+            "see protocol/tpu_std.py batch_parse)")
 define_flag("rpcz_dir", "",
             "directory for on-disk rpcz persistence (empty = memory only)")
 define_flag("rpcz_db_max_bytes", 16 << 20,

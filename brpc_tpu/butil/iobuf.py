@@ -307,6 +307,14 @@ class IOBuf:
             return self._refs[0].to_bytes()
         return b"".join(r.to_bytes() for r in self._refs)
 
+    def first_host_view(self) -> Optional[memoryview]:
+        """Memoryview over the first (host) ref — the contiguous head
+        window batch parsers scan without copying. None when empty or
+        the head is a device ref."""
+        if self._refs and not self._refs[0].is_device:
+            return self._refs[0].memoryview()
+        return None
+
     def peek_bytes(self, n: int) -> bytes:
         """Copy out the first n bytes without consuming."""
         chunks = []

@@ -174,8 +174,9 @@ def murmur3_x64_128(data: bytes, seed: int = 0) -> Optional[int]:
     return (int(out[1]) << 64) | int(out[0])
 
 
-def trpc_scan(data: bytes, max_frames: int = 256):
-    """Scan a contiguous window for complete TRPC frames.
+def trpc_scan(data, max_frames: int = 256):
+    """Scan a contiguous window (bytes or memoryview) for complete TRPC
+    frames.
 
     Returns (frames, consumed, need) where frames is a list of
     (offset, total_len), or None when the native lib is unavailable.
@@ -184,10 +185,17 @@ def trpc_scan(data: bytes, max_frames: int = 256):
     L = lib()
     if L is None:
         return None
+    size = len(data)
+    if isinstance(data, memoryview):
+        try:
+            # zero-copy view into the portal's read block
+            data = (ctypes.c_char * size).from_buffer(data)
+        except TypeError:          # read-only buffer
+            data = bytes(data)
     out = (c_u64 * (2 * max_frames))()
     consumed = c_size()
     need = c_size()
-    n = L.bt_trpc_scan(data, len(data), out, max_frames,
+    n = L.bt_trpc_scan(data, size, out, max_frames,
                        ctypes.byref(consumed), ctypes.byref(need))
     if n < 0:
         raise ValueError("not a TRPC stream")

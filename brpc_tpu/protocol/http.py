@@ -64,12 +64,14 @@ def _response(status: int, body: bytes, content_type: str = "text/plain",
 
 
 def _query_flag(req: "HttpRequest", name: str) -> bool:
-    """Boolean query param: ?x / ?x=1 / ?x=true are on; ?x=0 / ?x=false
-    are off (a raw truthy-string check would treat \"0\" as on)."""
+    """Boolean query param: ?x=1 / ?x=true are on; ?x=0 / ?x=false are
+    off (a raw truthy-string check would treat \"0\" as on). Bare keys
+    (?x with no value) are dropped by the query parser — spell the
+    value out."""
     v = req.query.get(name)
     if v is None:
         return False
-    return v == "" or v.lower() in ("1", "true", "yes")
+    return v.lower() in ("1", "true", "yes")
 
 
 def _thread_stacks() -> bytes:

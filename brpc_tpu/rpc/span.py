@@ -106,15 +106,20 @@ class SpanCollector:
 class SpanStore:
     """Bounded on-disk persistence: JSON-lines, rotated once at
     rpcz_db_max_bytes (current + one aged file ≈ the leveldb SpanDB's
-    bounded footprint). Writes are append+flush under a lock — rpcz is
-    sampled, not hot-path."""
+    bounded footprint). finish_span runs for EVERY rpc, so writes buffer
+    in memory and hit disk in batches (every _FLUSH_EVERY lines or
+    _FLUSH_S seconds), never a per-RPC syscall."""
 
     FILE = "rpcz_spans.jsonl"
+    _FLUSH_EVERY = 32
+    _FLUSH_S = 0.5
 
     def __init__(self):
         self._lock = threading.Lock()
         self._fh = None
         self._dir = None
+        self._buf: List[str] = []
+        self._last_flush = 0.0
 
     def _path(self, old: bool = False) -> str:
         return os.path.join(self._dir, self.FILE + (".1" if old else ""))
@@ -128,22 +133,39 @@ class SpanStore:
         os.makedirs(dirpath, exist_ok=True)
         self._fh = open(self._path(), "a", encoding="utf-8")
 
+    def _flush_locked(self, dirpath: str) -> None:
+        self._ensure_open(dirpath)
+        self._fh.write("".join(self._buf))
+        self._fh.flush()
+        self._buf.clear()
+        self._last_flush = time.monotonic()
+        if self._fh.tell() >= int(flag("rpcz_db_max_bytes")):
+            self._fh.close()
+            self._fh = None
+            os.replace(self._path(), self._path(old=True))
+
     def write(self, span: "Span") -> None:
         dirpath = flag("rpcz_dir")
-        if not dirpath:
-            return
-        line = json.dumps(span.to_dict()) + "\n"
         with self._lock:
-            try:
-                self._ensure_open(dirpath)
-                self._fh.write(line)
-                self._fh.flush()
-                if self._fh.tell() >= int(flag("rpcz_db_max_bytes")):
-                    self._fh.close()
+            if not dirpath:
+                # flag cleared at runtime: drop buffered lines and the
+                # handle (an open fd would pin the old directory)
+                if self._fh is not None:
+                    try:
+                        self._fh.close()
+                    except OSError:
+                        pass
                     self._fh = None
-                    os.replace(self._path(), self._path(old=True))
+                self._buf.clear()
+                return
+            self._buf.append(json.dumps(span.to_dict()) + "\n")
+            if (len(self._buf) < self._FLUSH_EVERY
+                    and time.monotonic() - self._last_flush < self._FLUSH_S):
+                return
+            try:
+                self._flush_locked(dirpath)
             except OSError:
-                pass            # persistence must never fail the RPC
+                self._buf.clear()   # persistence must never fail the RPC
 
     def read(self, n: int = 100,
              trace_id: Optional[int] = None) -> List[dict]:
@@ -151,23 +173,33 @@ class SpanStore:
         if not dirpath or n <= 0:
             return []
         # bounded ring while scanning: the files can hold 2x
-        # rpcz_db_max_bytes of lines — never materialize them all
+        # rpcz_db_max_bytes of lines — never materialize them all.
+        # The lock covers the scan so rotation can't swap files mid-read,
+        # and flushes buffered lines first so history is current.
         rows: Deque[dict] = deque(maxlen=n)
-        for old in (True, False):       # aged file first: oldest→newest
-            try:
-                with open(os.path.join(dirpath,
-                                       self.FILE + (".1" if old else "")),
-                          encoding="utf-8") as f:
-                    for line in f:
-                        try:
-                            d = json.loads(line)
-                        except ValueError:
-                            continue
-                        if trace_id is None or \
-                                int(d.get("trace_id", "0"), 16) == trace_id:
-                            rows.append(d)
-            except OSError:
-                continue
+        with self._lock:
+            if self._buf:
+                try:
+                    self._flush_locked(dirpath)
+                except OSError:
+                    self._buf.clear()
+            for old in (True, False):   # aged file first: oldest→newest
+                try:
+                    with open(os.path.join(dirpath,
+                                           self.FILE + (".1" if old
+                                                        else "")),
+                              encoding="utf-8") as f:
+                        for line in f:
+                            try:
+                                d = json.loads(line)
+                            except ValueError:
+                                continue
+                            if trace_id is None or \
+                                    int(d.get("trace_id", "0"),
+                                        16) == trace_id:
+                                rows.append(d)
+                except OSError:
+                    continue
         return list(rows)
 
 

@@ -36,6 +36,19 @@ class InputMessenger:
         protocols = self.protocols()
         while socket.input_portal:
             idx = socket.preferred_protocol
+            if 0 <= idx < len(protocols):
+                # burst fast path: a protocol already claimed this
+                # connection and can batch-cut a pipelined window in one
+                # native scan (tpu_std.batch_parse)
+                bp = getattr(protocols[idx], "batch_parse", None)
+                if bp is not None:
+                    batch = bp(socket.input_portal, socket)
+                    if batch:
+                        proto = protocols[idx]
+                        for msg in batch:
+                            if not proto.process_inline(msg, socket):
+                                msgs.append((proto, msg))
+                        continue
             order = range(len(protocols)) if idx < 0 else (
                 [idx] + [i for i in range(len(protocols)) if i != idx])
             claimed = None

@@ -326,3 +326,78 @@ def test_murmur_lb_registered():
     # different keys spread across servers
     spread = {lb.select_server(request_key=f"k{i}".encode()) for i in range(64)}
     assert len(spread) > 1
+
+
+class TestBatchParseWired:
+    def test_burst_correctness_with_batch_parse(self):
+        """With the flag on, a pipelined burst round-trips identically
+        through the native-scanned batch path (payload integrity + all
+        responses delivered) — and the batch path must actually ENGAGE,
+        or a broken scanner would ship green via the classic fallback."""
+        import threading
+
+        from brpc_tpu import native
+        from brpc_tpu.butil.flags import set_flag
+        from brpc_tpu.protocol.tpu_std import TpuStdProtocol
+        from brpc_tpu.rpc import (Channel, ChannelOptions, Server,
+                                  ServerOptions, Service)
+        if not native.available():
+            pytest.skip("native library not built")
+        engaged = [0]
+        orig_bp = TpuStdProtocol.batch_parse
+
+        def counting_bp(self, portal, socket, max_frames=64):
+            out = orig_bp(self, portal, socket, max_frames)
+            if out:
+                engaged[0] += len(out)
+            return out
+
+        TpuStdProtocol.batch_parse = counting_bp
+        set_flag("tpu_std_batch_parse", True)
+        try:
+            server = Server(ServerOptions(enable_builtin_services=False))
+            svc = Service("B")
+
+            @svc.method()
+            def E(cntl, request):
+                return bytes(request)
+
+            server.add_service(svc)
+            ep = server.start("tcp://127.0.0.1:0")
+            ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                         ChannelOptions(timeout_ms=30000))
+            n = 500
+            got = {}
+            done = threading.Event()
+            left = [n]
+            lock = threading.Lock()
+
+            def mk(i):
+                def _d(cntl):
+                    with lock:
+                        got[i] = (cntl.failed(),
+                                  cntl.response_payload.to_bytes()
+                                  if not cntl.failed() else None)
+                        left[0] -= 1
+                        if left[0] == 0:
+                            done.set()
+                return _d
+
+            for i in range(n):
+                ch.call("B", "E", f"msg-{i}".encode(), done=mk(i))
+            assert done.wait(30)
+            for i in range(n):
+                failed, body = got[i]
+                assert not failed and body == f"msg-{i}".encode()
+            # mixed sizes: bodies over BATCH_MAX_BODY take the classic
+            # path mid-burst
+            big = b"z" * 65536
+            c = ch.call_sync("B", "E", big)
+            assert not c.failed() and c.response_payload.to_bytes() == big
+            ch.close()
+            server.stop()
+            server.join(2)
+            assert engaged[0] > 0, "batch path never engaged"
+        finally:
+            set_flag("tpu_std_batch_parse", False)
+            TpuStdProtocol.batch_parse = orig_bp
