@@ -375,18 +375,29 @@ class HttpProtocol(Protocol):
         import logging as pylog
         module = req.query.get("module", "")
         level = req.query.get("level")
+        vmod = req.query.get("vmodule")
+        if vmod is not None:
+            # per-module VLOG verbosity (--vmodule): "pat=N,pat=N" or "N"
+            from brpc_tpu.butil.logging import set_vmodule
+            try:
+                set_vmodule(vmod)
+            except ValueError as e:
+                return 400, "text/plain", f"bad vmodule: {e}".encode()
+            return 200, "text/plain", b"OK"
         if level is not None:
             try:
                 pylog.getLogger(module or None).setLevel(level.upper())
             except ValueError as e:
                 return 400, "text/plain", f"bad level: {e}".encode()
             return 200, "text/plain", b"OK"
+        from brpc_tpu.butil.logging import vmodule
         loggers = {"root": pylog.getLevelName(pylog.getLogger().level)}
         for name in sorted(pylog.root.manager.loggerDict):
             lg = pylog.root.manager.loggerDict[name]
             if isinstance(lg, pylog.Logger) and lg.level != pylog.NOTSET:
                 loggers[name] = pylog.getLevelName(lg.level)
-        return 200, "application/json", json.dumps(loggers).encode()
+        return 200, "application/json", json.dumps(
+            {"loggers": loggers, "vmodule": vmodule()}).encode()
 
     def _index(self, server) -> bytes:
         from brpc_tpu.builtin.tabbed import render_index

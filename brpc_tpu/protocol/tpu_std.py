@@ -202,6 +202,11 @@ class TpuStdProtocol(Protocol):
         from brpc_tpu.butil.flags import flag
         if not flag("tpu_std_batch_parse"):
             return None
+        if self.MAGIC != MAGIC:
+            # subclasses (hulu/sofa) inherit this method but the native
+            # scanner only knows the TRPC magic — don't pay a doomed
+            # scan + ValueError on every loop iteration for them
+            return None
         from brpc_tpu import native
         win = portal.first_host_view()
         if win is None or len(win) < HEADER_SIZE:

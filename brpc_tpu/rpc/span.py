@@ -172,39 +172,59 @@ class SpanStore:
         dirpath = flag("rpcz_dir")
         if not dirpath or n <= 0:
             return []
-        # bounded ring while scanning: the files can hold 2x
-        # rpcz_db_max_bytes of lines — never materialize them all.
-        # The lock covers the scan so rotation can't swap files mid-read,
-        # and flushes buffered lines first so history is current.
-        rows: Deque[dict] = deque(maxlen=n)
+        # flush pending lines under the lock so history is current, but
+        # SCAN outside it — parsing up to 2x rpcz_db_max_bytes of JSON
+        # under the write lock would stall every RPC's finish_span. A
+        # concurrent rotation mid-scan costs at most a transient miss on
+        # this diagnostic page (os.replace is atomic; open fds survive).
         with self._lock:
             if self._buf:
                 try:
                     self._flush_locked(dirpath)
                 except OSError:
                     self._buf.clear()
-            for old in (True, False):   # aged file first: oldest→newest
-                try:
-                    with open(os.path.join(dirpath,
-                                           self.FILE + (".1" if old
-                                                        else "")),
-                              encoding="utf-8") as f:
-                        for line in f:
-                            try:
-                                d = json.loads(line)
-                            except ValueError:
-                                continue
-                            if trace_id is None or \
-                                    int(d.get("trace_id", "0"),
-                                        16) == trace_id:
-                                rows.append(d)
-                except OSError:
-                    continue
+        # bounded ring while scanning — never materialize all lines
+        rows: Deque[dict] = deque(maxlen=n)
+        for old in (True, False):       # aged file first: oldest→newest
+            try:
+                with open(os.path.join(dirpath,
+                                       self.FILE + (".1" if old else "")),
+                          encoding="utf-8") as f:
+                    for line in f:
+                        try:
+                            d = json.loads(line)
+                        except ValueError:
+                            continue
+                        if trace_id is None or \
+                                int(d.get("trace_id", "0"),
+                                    16) == trace_id:
+                            rows.append(d)
+            except OSError:
+                continue
         return list(rows)
+
+
+    def flush(self) -> None:
+        """Force buffered lines to disk (server stop / process exit —
+        the last spans before a shutdown are usually the interesting
+        ones)."""
+        dirpath = flag("rpcz_dir")
+        if not dirpath:
+            return
+        with self._lock:
+            if self._buf:
+                try:
+                    self._flush_locked(dirpath)
+                except OSError:
+                    self._buf.clear()
 
 
 global_store = SpanStore()
 global_collector = SpanCollector()
+
+import atexit  # noqa: E402  (registration belongs with the store)
+
+atexit.register(global_store.flush)
 
 
 def new_trace_id() -> int:

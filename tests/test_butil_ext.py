@@ -218,3 +218,63 @@ def test_recordio_magic_straddles_chunk_boundary():
     r = RecordReader(F(raw))
     assert r.read().data == b"second"
     assert r.skipped_bytes >= (256 << 10) - 2 - 3
+
+
+class TestButilLogging:
+    def test_log_sink_redirection(self):
+        """SetLogSink contract (butil/logging.h): the sink sees every
+        record first and may consume it."""
+        from brpc_tpu.butil import logging as blog
+
+        captured = []
+
+        class Capture(blog.LogSink):
+            def on_log(self, record):
+                captured.append(record.getMessage())
+                return True          # consume
+
+        old = blog.set_log_sink(Capture())
+        try:
+            blog.log_info("hello %s", "sink", module="test.mod")
+            blog.log_error("bad thing", module="test.mod")
+        finally:
+            blog.set_log_sink(old)
+        assert captured == ["hello sink", "bad thing"]
+        blog.log_info("after restore", module="test.mod")
+        assert "after restore" not in captured
+
+    def test_vmodule_glob_levels(self):
+        from brpc_tpu.butil import logging as blog
+
+        blog.set_vmodule("rpc.*=2,rpc.channel=3")
+        try:
+            assert blog.vlog_is_on(2, "rpc.socket")
+            assert not blog.vlog_is_on(3, "rpc.socket")
+            assert blog.vlog_is_on(3, "rpc.channel")   # most specific wins
+            assert not blog.vlog_is_on(1, "other.mod")
+            blog.set_vmodule("1")                      # global verbosity
+            assert blog.vlog_is_on(1, "other.mod")
+            assert not blog.vlog_is_on(2, "other.mod")
+        finally:
+            blog.set_vmodule("")
+
+    def test_vlog_emits_through_sink(self):
+        from brpc_tpu.butil import logging as blog
+
+        captured = []
+
+        class Capture(blog.LogSink):
+            def on_log(self, record):
+                captured.append(record.getMessage())
+                return True
+
+        old = blog.set_log_sink(Capture())
+        blog.set_vmodule("chat*=2")
+        try:
+            blog.VLOG(2, "visible", module="chatty")
+            blog.VLOG(3, "hidden", module="chatty")
+            blog.VLOG(1, "also hidden", module="quiet")
+        finally:
+            blog.set_vmodule("")
+            blog.set_log_sink(old)
+        assert captured == ["visible"]

@@ -281,7 +281,7 @@ def test_vlog_page(server):
     assert logging.getLogger("test.vlog.mod").level == logging.DEBUG
     status, body = http_get(ep, "/vlog")
     assert status == 200
-    assert json.loads(body).get("test.vlog.mod") == "DEBUG"
+    assert json.loads(body)["loggers"].get("test.vlog.mod") == "DEBUG"
     status, _ = http_get(ep, "/vlog?module=test.vlog.mod&level=BOGUS")
     assert status == 400
 
