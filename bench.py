@@ -58,29 +58,46 @@ def _progress(obj: dict) -> None:
     print(json.dumps(obj), file=sys.stderr, flush=True)
 
 
-def _init_jax_with_retry():
-    """jax.devices() with backoff — round 2 died on one transient
-    UNAVAILABLE from the tunneled backend (BENCH_r02.json rc=1)."""
-    import jax
-    delays = [0, 3, 8, 15, 30]
-    last = None
-    for i, d in enumerate(delays):
-        if d:
-            time.sleep(d)
+def _init_jax_with_retry(deadline: "Deadline"):
+    """jax.devices() with backoff AND a hang guard — round 2 died on one
+    transient UNAVAILABLE; a wedged tunnel is worse: devices() HANGS
+    instead of raising (observed >110s), so each attempt runs on a
+    daemon thread joined with a timeout and a hung attempt counts as
+    failed (the thread is abandoned). Every wait is capped by the wall
+    budget — retrying past it would let a harness kill steal the final
+    JSON, the exact round-2 failure this exists to prevent."""
+    delays = [0, 3, 8]
+    timeouts = [45, 30, 30]
+    last = "?"
+    for i, (d, t_lim) in enumerate(zip(delays, timeouts)):
+        remaining = deadline.remaining()
+        if remaining < 10:
+            last = f"{last}; wall budget exhausted before attempt {i + 1}"
+            break
+        time.sleep(min(d, max(0.0, remaining - 10)))
         t0 = time.perf_counter()
-        try:
-            devs = jax.devices()
+        box: dict = {}
+
+        def attempt():
+            try:
+                import jax
+                box["devs"] = jax.devices()
+            except Exception as e:  # noqa: BLE001 - retried bring-up
+                box["err"] = f"{type(e).__name__}: {e}"[:300]
+
+        th = threading.Thread(target=attempt, daemon=True)
+        th.start()
+        th.join(min(t_lim, max(5.0, deadline.remaining() - 5)))
+        if "devs" in box:
             _progress({"progress": "backend_up",
-                       "devices": [str(x) for x in devs],
+                       "devices": [str(x) for x in box["devs"]],
                        "init_s": round(time.perf_counter() - t0, 1),
                        "attempt": i + 1})
-            return devs
-        except Exception as e:  # noqa: BLE001 - retrying backend bring-up
-            last = e
-            _progress({"progress": "backend_retry", "attempt": i + 1,
-                       "error": f"{type(e).__name__}: {e}"[:300]})
-    raise RuntimeError(f"backend never came up after {len(delays)} "
-                       f"attempts: {last}")
+            return box["devs"]
+        last = box.get("err", f"hung > {t_lim}s")
+        _progress({"progress": "backend_retry", "attempt": i + 1,
+                   "error": last})
+    raise RuntimeError(f"backend never came up: {last}")
 
 
 class Deadline:
@@ -248,7 +265,7 @@ def main() -> None:
     # ---------------- phase 2: device lane over ici:// (real movement)
     lane: dict = result["device_lane"]
     try:
-        devs = _init_jax_with_retry()
+        devs = _init_jax_with_retry(deadline)
         import jax
 
         two_dev = len(devs) >= 2
