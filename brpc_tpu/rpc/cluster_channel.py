@@ -64,6 +64,16 @@ class ClusterChannel(Channel):
         if ep is None:
             raise ConnectionError("no server available")
         cntl.tried_servers.append(ep)
+        # a backup attempt can lose the race with the primary response:
+        # if the completion sweep already ran (it records how many tried
+        # entries it accounted for), nobody will ever return THIS
+        # selection's inflight slot — return it here and abort the
+        # attempt instead of leaking it (starves la-weighted servers)
+        swept = getattr(cntl, "_lb_swept_n", None)
+        if swept is not None and len(cntl.tried_servers) > swept:
+            self._lb.abandon(ep)
+            raise ConnectionError("call already completed "
+                                  "(late backup/retry attempt dropped)")
         if self._on_call_complete not in cntl._complete_hooks:
             cntl._complete_hooks.append(self._on_call_complete)
         return self._socket_for(ep)
@@ -100,9 +110,15 @@ class ClusterChannel(Channel):
             fed.append(ep)
 
     def _on_call_complete(self, cntl: Controller):
-        if not cntl.tried_servers:
+        # record how many tried entries THIS sweep accounts for, FIRST:
+        # a concurrent late backup attempt that appends after this point
+        # sees the marker and returns its own slot (_pick_socket)
+        n = len(cntl.tried_servers)
+        cntl._lb_swept_n = n
+        if n == 0:
             return
-        ep = cntl.tried_servers[-1]
+        tried = cntl.tried_servers[:n]
+        ep = tried[-1]
         failed = cntl.failed() and cntl.error_code != berr.ERPCTIMEDOUT
         self._lb.feedback(ep, cntl.latency_us(), cntl.failed())
         self._breakers.on_call(ep, failed)
@@ -114,7 +130,7 @@ class ClusterChannel(Channel):
         # feedbacks (attempt failures + the final one above).
         fed = list(getattr(cntl, "_lb_fed", ()))
         fed.append(ep)
-        for s in cntl.tried_servers:
+        for s in tried:
             if s in fed:
                 fed.remove(s)
             else:
