@@ -307,6 +307,12 @@ class HttpProtocol(Protocol):
                 "write_queue": len(getattr(s, "_write_q", []) or []),
                 "preferred_protocol": s.preferred_protocol,
             })
+            # device-lane introspection for ici:// conns (the page the
+            # RDMA build exposes per-endpoint window state on)
+            conn = s.conn
+            if hasattr(conn, "lane_kind"):
+                rows[-1]["lane_kind"] = conn.lane_kind
+                rows[-1]["outstanding_batches"] = conn.outstanding_batches
         return json.dumps(rows, indent=1).encode()
 
     def _fibers(self, server) -> bytes:

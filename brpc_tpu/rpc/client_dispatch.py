@@ -13,6 +13,10 @@ def process_response(proto, msg: RpcMessage, socket) -> None:
     cntl = take_call(cid)
     if cntl is None:
         return  # stale: the call already completed (timeout/backup winner)
+    # record the WINNER for LB/breaker attribution: with a backup request
+    # in flight, the last-selected server is not necessarily the one
+    # whose response completed the call
+    cntl.responded_server = socket.remote_endpoint
     try:
         _fill_response(cntl, msg, socket)
     except Exception as e:

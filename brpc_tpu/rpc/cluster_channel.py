@@ -63,17 +63,18 @@ class ClusterChannel(Channel):
             ep = self._lb.select_server(None, request_key=key)
         if ep is None:
             raise ConnectionError("no server available")
-        cntl.tried_servers.append(ep)
         # a backup attempt can lose the race with the primary response:
-        # if the completion sweep already ran (it records how many tried
-        # entries it accounted for), nobody will ever return THIS
-        # selection's inflight slot — return it here and abort the
-        # attempt instead of leaking it (starves la-weighted servers)
-        swept = getattr(cntl, "_lb_swept_n", None)
-        if swept is not None and len(cntl.tried_servers) > swept:
-            self._lb.abandon(ep)
-            raise ConnectionError("call already completed "
-                                  "(late backup/retry attempt dropped)")
+        # once the completion sweep has run (it records, under the same
+        # lock, how many tried entries it accounted for), nobody will
+        # ever return THIS selection's inflight slot — return it here
+        # and abort the attempt instead of leaking it (which would
+        # starve la-weighted servers)
+        with cntl._lb_lock:
+            if cntl._lb_swept_n is not None:
+                self._lb.abandon(ep)
+                raise ConnectionError("call already completed "
+                                      "(late backup/retry attempt dropped)")
+            cntl.tried_servers.append(ep)
         if self._on_call_complete not in cntl._complete_hooks:
             cntl._complete_hooks.append(self._on_call_complete)
         return self._socket_for(ep)
@@ -110,15 +111,22 @@ class ClusterChannel(Channel):
             fed.append(ep)
 
     def _on_call_complete(self, cntl: Controller):
-        # record how many tried entries THIS sweep accounts for, FIRST:
-        # a concurrent late backup attempt that appends after this point
-        # sees the marker and returns its own slot (_pick_socket)
-        n = len(cntl.tried_servers)
-        cntl._lb_swept_n = n
-        if n == 0:
+        # the marker and the tried snapshot are taken under the same
+        # lock _pick_socket appends under: a late backup attempt either
+        # lands before this (and is swept here) or sees the marker and
+        # returns its own slot — no in-between
+        with cntl._lb_lock:
+            cntl._lb_swept_n = len(cntl.tried_servers)
+            tried = list(cntl.tried_servers)
+        if not tried:
             return
-        tried = cntl.tried_servers[:n]
-        ep = tried[-1]
+        # attribute the final observation to the server whose RESPONSE
+        # completed the call (with a backup in flight, the last-selected
+        # server is often the losing one); timeouts/failures have no
+        # responder and fall back to the last attempt
+        ep = cntl.responded_server
+        if ep is None or ep not in tried:
+            ep = tried[-1]
         failed = cntl.failed() and cntl.error_code != berr.ERPCTIMEDOUT
         self._lb.feedback(ep, cntl.latency_us(), cntl.failed())
         self._breakers.on_call(ep, failed)

@@ -77,6 +77,15 @@ class Controller:
         # completion hooks (LB feedback / circuit breaker / client spans)
         self.tried_servers: list = []
         self._complete_hooks: list = []
+        # which server's response actually completed the call (set by
+        # process_response; None on timeout/failure) — with backup
+        # requests, tried_servers[-1] is NOT necessarily the winner
+        self.responded_server = None
+        # guards the tried/selection handshake between a late backup
+        # attempt and the completion sweep (cluster_channel)
+        self._lb_lock = threading.Lock()
+        self._lb_swept_n: Optional[int] = None
+        self._lb_fed: list = []
         # ---- client call internals (set by Channel.call)
         self._service_name: str = ""
         self._method_name: str = ""
@@ -126,6 +135,24 @@ class Controller:
 
     # ---------------------------------------------------- client completion
     def _register_call(self) -> int:
+        # per-CALL client state must reset on controller reuse: a stale
+        # one-shot done event would make join() return before the new
+        # response arrives (with the previous call's payload), stale
+        # tried/attempt bookkeeping would exclude healthy servers or
+        # trip the cluster channel's late-attempt guard, and a stale
+        # retry counter would shrink the new call's retry budget
+        self._done_event = FiberEvent()
+        self.reset_error()
+        self.current_try = 0
+        self.end_us = 0
+        self.response_payload = None
+        self.response_attachment = IOBuf()
+        self.response_device_arrays = []
+        self.tried_servers.clear()
+        self.responded_server = None
+        self._lb_swept_n = None
+        self._lb_fed = []
+        self.used_backup = False
         self.correlation_id = _call_pool.insert(self)
         return self.correlation_id
 

@@ -432,3 +432,25 @@ class TestBackupRequestLaIntegration:
             for server, _ in servers:
                 server.stop()
                 server.join(2)
+
+    def test_controller_reuse_across_cluster_calls(self):
+        """A reused Controller must not trip the late-attempt guard or
+        leak exclusions from the previous call (per-call state resets in
+        _register_call)."""
+        servers = [start_server(f"r{i}") for i in range(2)]
+        try:
+            urls = ",".join(str(ep) for _, ep in servers)
+            ch = ClusterChannel(f"list://{urls}", "la")
+            cntl = Controller()
+            for i in range(5):
+                c = ch.call_sync("EchoService", "Echo",
+                                 f"reuse-{i}".encode(), cntl=cntl)
+                assert not c.failed(), (i, c.error_text)
+                assert c.response_payload.to_bytes().endswith(
+                    f":reuse-{i}".encode())
+                assert len(c.tried_servers) >= 1
+            ch.close()
+        finally:
+            for server, _ in servers:
+                server.stop()
+                server.join(2)
