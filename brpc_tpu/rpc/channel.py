@@ -173,6 +173,7 @@ class Channel:
         cntl.join() (thread) / await cntl.join_async() (fiber), or pass
         ``done`` for callback style — the async CallMethod triple."""
         cntl = cntl or Controller()
+        cntl._reset_for_call()
         cntl.start_us = time.monotonic_ns() // 1000
         if cntl.timeout_ms is None:
             cntl.timeout_ms = self.options.timeout_ms
@@ -355,22 +356,27 @@ class Channel:
                 # cross-match lane batches on the receiver
                 with sock.lane_lock:
                     sock.write_device_payload(lane)
-                    sock.write(wire, on_done=lambda err:
-                               self._on_write_done(cntl, err))
+                    sock.write(wire, on_done=lambda err, s=sock:
+                               self._on_write_done(cntl, err, s))
             else:
-                sock.write(wire, on_done=lambda err:
-                           self._on_write_done(cntl, err))
+                sock.write(wire, on_done=lambda err, s=sock:
+                           self._on_write_done(cntl, err, s))
         except (BlockingIOError, ConnectionError, OSError) as e:
             # lane backpressure / dead conn must fail the controller (or
             # retry), never escape to the caller with the call leaked
-            self._maybe_retry(cntl, berr.EFAILEDSOCKET, str(e))
+            self._maybe_retry(cntl, berr.EFAILEDSOCKET, str(e),
+                              failed_ep=sock.remote_endpoint)
 
-    def _on_write_done(self, cntl: Controller, err: Optional[BaseException]):
+    def _on_write_done(self, cntl: Controller, err: Optional[BaseException],
+                       sock=None):
         if err is None:
             return
-        self._maybe_retry(cntl, berr.EFAILEDSOCKET, str(err))
+        self._maybe_retry(cntl, berr.EFAILEDSOCKET, str(err),
+                          failed_ep=sock.remote_endpoint
+                          if sock is not None else None)
 
-    def _maybe_retry(self, cntl: Controller, code: int, text: str) -> None:
+    def _maybe_retry(self, cntl: Controller, code: int, text: str,
+                     failed_ep=None) -> None:
         """Retry on transport errors while the call is still live
         (OnVersionedRPCReturned's error branch, controller.cpp:634)."""
         if address_call(cntl.correlation_id) is not cntl:
@@ -379,16 +385,20 @@ class Channel:
             cntl.current_try += 1
             # report the failed attempt before moving on (the final
             # attempt is reported by the completion hook instead)
-            self._on_attempt_failed(cntl, code, text)
+            self._on_attempt_failed(cntl, code, text, failed_ep)
             self._issue_rpc(cntl)
             return
         if take_call(cntl.correlation_id) is cntl:
             cntl.set_failed(code, text)
             cntl._complete()
 
-    def _on_attempt_failed(self, cntl: Controller, code: int, text: str) -> None:
+    def _on_attempt_failed(self, cntl: Controller, code: int, text: str,
+                           failed_ep=None) -> None:
         """Per-attempt failure hook for cluster channels (LB feedback +
-        circuit breaker on intermediate retries)."""
+        circuit breaker on intermediate retries). ``failed_ep`` names the
+        attempt's endpoint when the failure path knows it — with a
+        concurrent backup selection, tried_servers[-1] may already be a
+        DIFFERENT server."""
 
     def _on_timeout(self, cntl: Controller) -> None:
         if take_call(cntl.correlation_id) is cntl:

@@ -98,17 +98,24 @@ class ClusterChannel(Channel):
         self._health.mark_dead(ep)
 
     # ------------------------------------------------------------ feedback
-    def _on_attempt_failed(self, cntl: Controller, code: int, text: str):
+    def _on_attempt_failed(self, cntl: Controller, code: int, text: str,
+                           failed_ep=None):
         """Intermediate retry attempts: the failed server must hear about
-        it (else it never isolates while retries keep saving the call)."""
-        if cntl.tried_servers:
-            ep = cntl.tried_servers[-1]
-            self._lb.feedback(ep, cntl.latency_us(), True)
-            self._breakers.on_call(ep, failed=True)
-            fed = getattr(cntl, "_lb_fed", None)
-            if fed is None:
-                fed = cntl._lb_fed = []
-            fed.append(ep)
+        it (else it never isolates while retries keep saving the call).
+        Attribution prefers the endpoint the failure path captured — a
+        concurrent backup selection can make tried_servers[-1] a
+        different (healthy) server."""
+        with cntl._lb_lock:
+            tried = cntl.tried_servers
+            if failed_ep is not None and failed_ep in tried:
+                ep = failed_ep
+            elif tried:
+                ep = tried[-1]
+            else:
+                return
+            cntl._lb_fed.append(ep)
+        self._lb.feedback(ep, cntl.latency_us(), True)
+        self._breakers.on_call(ep, failed=True)
 
     def _on_call_complete(self, cntl: Controller):
         # the marker and the tried snapshot are taken under the same
@@ -118,6 +125,7 @@ class ClusterChannel(Channel):
         with cntl._lb_lock:
             cntl._lb_swept_n = len(cntl.tried_servers)
             tried = list(cntl.tried_servers)
+            fed_snapshot = list(cntl._lb_fed)
         if not tried:
             return
         # attribute the final observation to the server whose RESPONSE
@@ -136,7 +144,7 @@ class ClusterChannel(Channel):
         # inflight-tracking LB would depress that server's weight
         # forever. Multiset difference: tried selections minus delivered
         # feedbacks (attempt failures + the final one above).
-        fed = list(getattr(cntl, "_lb_fed", ()))
+        fed = fed_snapshot
         fed.append(ep)
         for s in tried:
             if s in fed:

@@ -134,13 +134,18 @@ class Controller:
         return max(0, self.end_us - self.start_us)
 
     # ---------------------------------------------------- client completion
-    def _register_call(self) -> int:
-        # per-CALL client state must reset on controller reuse: a stale
-        # one-shot done event would make join() return before the new
-        # response arrives (with the previous call's payload), stale
-        # tried/attempt bookkeeping would exclude healthy servers or
-        # trip the cluster channel's late-attempt guard, and a stale
-        # retry counter would shrink the new call's retry budget
+    def _reset_for_call(self) -> None:
+        """Per-CALL client state must reset on controller reuse (called
+        at the top of Channel.call): a stale one-shot done event would
+        make join() return before the new response arrives (with the
+        previous call's payload), stale tried/attempt bookkeeping would
+        exclude healthy servers or trip the cluster channel's
+        late-attempt guard, a stale retry counter would shrink the new
+        retry budget, and stale completion hooks (pooled-connection
+        returns) would re-run and double-insert sockets into the pool.
+        LB bookkeeping resets under _lb_lock — a still-in-flight backup
+        attempt from the PREVIOUS call must not interleave with the
+        reset and leak its selection."""
         self._done_event = FiberEvent()
         self.reset_error()
         self.current_try = 0
@@ -148,11 +153,15 @@ class Controller:
         self.response_payload = None
         self.response_attachment = IOBuf()
         self.response_device_arrays = []
-        self.tried_servers.clear()
         self.responded_server = None
-        self._lb_swept_n = None
-        self._lb_fed = []
         self.used_backup = False
+        self._complete_hooks.clear()
+        with self._lb_lock:
+            self.tried_servers.clear()
+            self._lb_swept_n = None
+            self._lb_fed = []
+
+    def _register_call(self) -> int:
         self.correlation_id = _call_pool.insert(self)
         return self.correlation_id
 
