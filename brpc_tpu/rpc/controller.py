@@ -73,6 +73,7 @@ class Controller:
         self.start_us: int = 0
         self.end_us: int = 0
         self.used_backup: bool = False
+        self.stream = None           # Stream piggybacked on this call
         # cluster bookkeeping: endpoints tried (for retry-elsewhere) and
         # completion hooks (LB feedback / circuit breaker / client spans)
         self.tried_servers: list = []
@@ -155,6 +156,8 @@ class Controller:
         self.response_device_arrays = []
         self.responded_server = None
         self.used_backup = False
+        self.stream = None        # a previous call's stream must not
+        #                           resurface on the new call's response
         self._complete_hooks.clear()
         with self._lb_lock:
             self.tried_servers.clear()
