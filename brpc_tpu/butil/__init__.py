@@ -3,6 +3,10 @@
 TPU-native re-design of the reference's ``src/butil`` (see SURVEY.md §2.1).
 """
 
+from brpc_tpu.butil.malloc_tune import tune_malloc
+
+tune_malloc()  # keep large payload buffers heap-recycled (see module doc)
+
 from brpc_tpu.butil.iobuf import Block, BlockRef, IOBuf, IOPortal, DeviceBlock
 from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
 from brpc_tpu.butil.resource_pool import ResourcePool, VersionedId

@@ -28,10 +28,14 @@ _MAX_CACHED_BLOCKS_PER_THREAD = 64
 _APPEND_ZEROCOPY_MIN = 16384
 
 
-# large read blocks (adaptive drain hint) are recycled too, with a
-# smaller per-thread cap — 8 x 256KB = 2MB of cached read buffers max
+# large read blocks (adaptive drain hint) are recycled too — 64 x 256KB
+# = 16MB of cached read buffers per reading thread; sized so a full
+# window of 1MB-payload messages in flight (each spanning ~4 big blocks)
+# stays inside the cache, because a cache miss is a fresh large
+# allocation whose page-fault cost dominates the recv syscall itself
+# (see malloc_tune.py for the measurement)
 _BIG_BLOCK_SIZE = 262144
-_MAX_CACHED_BIG_BLOCKS_PER_THREAD = 8
+_MAX_CACHED_BIG_BLOCKS_PER_THREAD = 64
 
 
 class _ThreadBlockCache(threading.local):

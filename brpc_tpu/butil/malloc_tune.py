@@ -1,0 +1,54 @@
+"""glibc malloc tuning for the large-payload hot path.
+
+The reference links tcmalloc for exactly this reason (its build scripts
+default to gperftools; docs/cn/benchmark.md runs with it): glibc serves
+every allocation over M_MMAP_THRESHOLD (128KB default) with a fresh
+mmap and returns it with munmap on free, so a steady stream of 256KB
+read blocks / 1MB payload joins pays kernel page-fault + zeroing cost
+per call instead of reusing warm heap pages. Measured on this machine:
+1MB alloc/free churn is ~3ms per cycle with the default threshold and
+~40µs once large blocks stay on the heap — a 75x difference that
+dominates RPC throughput at >=256KB payloads.
+
+We cannot link tcmalloc here, but glibc exposes the same lever at
+runtime: raise M_MMAP_THRESHOLD (and M_TRIM_THRESHOLD, so the freed
+tail is not immediately returned) via mallopt(3) through ctypes. This
+is process-global and idempotent; non-glibc platforms silently skip.
+
+Applied at `import brpc_tpu.butil` — deliberately, mirroring the
+reference, whose tcmalloc link retunes the whole process the same way
+the moment the library is loaded. The visible cost for an embedder:
+freed blocks up to 32MB stay on the heap (higher steady RSS) instead
+of returning to the kernel per free. Memory-sensitive embedders can
+set BRPC_TPU_NO_MALLOPT=1 before import to keep glibc defaults.
+"""
+
+from __future__ import annotations
+
+import os
+
+_M_TRIM_THRESHOLD = -1
+_M_MMAP_THRESHOLD = -3
+
+_applied = False
+
+
+def tune_malloc(mmap_threshold: int = 32 << 20,
+                trim_threshold: int = 32 << 20) -> bool:
+    """Raise glibc's mmap/trim thresholds so large payload buffers are
+    recycled on the heap. Returns True if applied."""
+    global _applied
+    if _applied:
+        return True
+    if os.environ.get("BRPC_TPU_NO_MALLOPT"):
+        return False
+    try:
+        import ctypes
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        ok = bool(libc.mallopt(_M_MMAP_THRESHOLD, mmap_threshold))
+        ok = bool(libc.mallopt(_M_TRIM_THRESHOLD, trim_threshold)) and ok
+        _applied = ok
+        return ok
+    except Exception:
+        return False

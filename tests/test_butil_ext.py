@@ -278,3 +278,42 @@ class TestButilLogging:
             blog.set_vmodule("")
             blog.set_log_sink(old)
         assert captured == ["visible"]
+
+
+class TestMallocTune:
+    """malloc_tune: the glibc large-alloc recycling lever (tcmalloc's
+    role in the reference's benchmark builds)."""
+
+    def test_applied_and_idempotent(self):
+        from brpc_tpu.butil import malloc_tune
+
+        # butil's import already applied it on glibc; calling again must
+        # be a no-op success (and never raise anywhere)
+        first = malloc_tune.tune_malloc()
+        again = malloc_tune.tune_malloc()
+        assert first == again
+
+    def test_large_churn_is_heap_recycled(self):
+        """After tuning, 1MB alloc/free cycles must not pay a fresh
+        mmap + page-fault each round trip. Generous bound: untuned this
+        machine measures ~3ms/cycle; tuned ~40us. Best-of-3 so a loaded
+        runner doesn't flake a single noisy sample."""
+        import time
+
+        import pytest
+
+        from brpc_tpu.butil.malloc_tune import tune_malloc
+
+        if not tune_malloc():
+            pytest.skip("mallopt unavailable (non-glibc platform)")
+        for _ in range(50):  # warm the freed chunk
+            bytearray(1 << 20)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            n = 100
+            for _ in range(n):
+                bytearray(1 << 20)
+            best = min(best, (time.perf_counter() - t0) / n)
+        assert best < 0.002, f"1MB churn {best * 1e6:.0f}us/cycle — " \
+            "large allocations are not being recycled"

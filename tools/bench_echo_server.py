@@ -1,0 +1,42 @@
+"""Echo server for bench.py's TCP-loopback headline, run as a separate
+process so client and server each have their own interpreter (GIL) —
+the reference benchmarks the same shape: a standalone echo server
+driven by a standalone client (docs/cn/benchmark.md env 单机1,
+example/echo_c++/server.cpp).
+
+Prints "PORT <n>" on stdout once listening; exits when the parent dies
+(same watchdog as tests/ici_echo_server.py — a stray server must never
+outlive its bench run on a shared-chip harness). TCP-only: never
+touches jax device state.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    from brpc_tpu.rpc import Server, ServerOptions, Service
+
+    server = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("Bench")
+
+    @svc.method()
+    def Echo(cntl, request):
+        # request is the decoded bytes payload; returning it as-is is
+        # zero-copy (serialize_payload passes bytes through)
+        return request
+
+    server.add_service(svc)
+    ep = server.start("tcp://127.0.0.1:0")
+    print(f"PORT {ep.port}", flush=True)
+    from spawn_util import parent_death_watchdog_loop
+    parent_death_watchdog_loop()
+
+
+if __name__ == "__main__":
+    main()

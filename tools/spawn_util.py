@@ -1,0 +1,69 @@
+"""Shared helper for spawning a subprocess server that announces its
+port with a "PORT <n>" stdout line.
+
+Used by bench.py (TCP echo server) and tools/ici_smoke.py (ici echo
+server); tests/ici_echo_server.py follows the same announce/watchdog
+protocol. The parse is deliberately careful: stdout is read
+NON-BLOCKING so a wedged child (e.g. backend bring-up hanging mid-line)
+can't stall the caller past its deadline, and only COMPLETE lines are
+parsed so a mid-line read never yields a truncated "PORT 87" as a real
+port.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Optional, Tuple
+
+
+def spawn_port_server(argv, wall_s: float, env: Optional[dict] = None,
+                      stderr=subprocess.DEVNULL,
+                      ) -> Tuple[Optional[subprocess.Popen], Optional[int]]:
+    """Spawn ``argv`` and wait up to ``wall_s`` for its "PORT <n>" line.
+
+    Returns (proc, port); (None, None) if the child died or never
+    announced within the deadline (the child is killed in that case).
+    Never raises.
+    """
+    try:
+        proc = subprocess.Popen([sys.executable] + list(argv),
+                                stdout=subprocess.PIPE, stderr=stderr,
+                                env=env)
+    except Exception:
+        return None, None
+    try:
+        os.set_blocking(proc.stdout.fileno(), False)
+        pending = b""
+        deadline = time.monotonic() + wall_s
+        while time.monotonic() < deadline:
+            chunk = proc.stdout.read()
+            if chunk:
+                pending += chunk
+                complete, _, pending = pending.rpartition(b"\n")
+                for ln in complete.decode("utf-8", "replace").splitlines():
+                    if ln.startswith("PORT "):
+                        return proc, int(ln.split()[1])
+            if proc.poll() is not None:
+                return None, None
+            time.sleep(0.05)
+    except Exception:
+        pass
+    try:
+        proc.kill()
+    except Exception:
+        pass
+    return None, None
+
+
+def parent_death_watchdog_loop() -> None:
+    """Server-side half of the protocol: block forever, exiting when the
+    parent dies so a stray server never outlives its driver on a
+    shared-chip harness."""
+    parent = os.getppid()
+    while True:
+        time.sleep(1)
+        if os.getppid() != parent:
+            os._exit(0)
