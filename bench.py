@@ -8,10 +8,13 @@ at runtime):
 
 1. **Headline — tpu_std echo over TCP loopback, 1MB payloads.** The
    framework's own data path (framing, IOBuf, socket write queue,
-   fiber scheduler) over the kernel loopback — the direct analog of
-   the reference's single-client big-payload benchmark environment, so
-   ``vs_baseline`` against 2.3 GB/s is apples-to-apples. Small-payload
-   (4B) p50/p99 is captured too (the reference's latency CDF shape).
+   fiber scheduler) over the kernel loopback, server in its own
+   process, payload riding the attachment zero-copy — the direct
+   analog of the reference's single-client big-payload benchmark
+   environment (standalone server, pooled connections, attachment as
+   the byte carrier like rdma_performance), so ``vs_baseline`` against
+   2.3 GB/s is apples-to-apples. Small-payload (4B) p50/p99 is
+   captured too (the reference's latency CDF shape).
 
 2. **Device lane — ici:// with REAL byte movement.** Per call the
    request is H2D-staged and the response materialized D2H
@@ -129,7 +132,14 @@ def spawn_tcp_server(deadline):
 
 
 def make_runner(ch, deadline, np):
-    """Pipelined batch runner over `ch`; returns wall seconds."""
+    """Pipelined batch runner over `ch`; returns wall seconds.
+
+    Host payloads ride the ATTACHMENT (zero-copy in and out of the
+    framing on both sides), the reference's large-payload benchmark
+    shape — rdma_performance moves its bytes in
+    cntl.request_attachment, not the serialized pb."""
+    from brpc_tpu.butil.iobuf import IOBuf
+    from brpc_tpu.rpc import Controller
 
     def run_batch(iters: int, inflight: int, rec, payload: bytes = b"",
                   device_buf=None, threads: int = 1) -> float:
@@ -155,7 +165,7 @@ def make_runner(ch, deadline, np):
                         out = np.asarray(cntl.response_device_arrays[0])
                         if out.nbytes != expect:
                             raise RuntimeError("payload size mismatch")
-                    elif len(cntl.response_payload or b"") != expect:
+                    elif cntl.response_attachment.size != expect:
                         raise RuntimeError("payload size mismatch")
                     if rec is not None:
                         rec.record((time.perf_counter_ns() - t_start_ns)
@@ -178,7 +188,13 @@ def make_runner(ch, deadline, np):
                     per_sem.acquire()
                     if errors:
                         break
-                    ch.call("Bench", "Echo", payload,
+                    cntl = None
+                    if device_buf is None and payload:
+                        cntl = Controller()
+                        att = IOBuf()
+                        att.append(payload)  # zero-copy wrap (>=16KB)
+                        cntl.request_attachment = att
+                    ch.call("Bench", "Echo", b"", cntl=cntl,
                             done=make_done(time.perf_counter_ns(), per_sem),
                             **kwargs)
                     issued += 1
@@ -246,10 +262,13 @@ def main() -> None:
         @svc.method()
         def Echo(cntl, request):
             # device payloads were *moved* to this server's recv device
-            # by the lane (H2D stage or D2D copy), not handed off; byte
-            # payloads echo through the full framing path
+            # by the lane (H2D stage or D2D copy), not handed off; host
+            # payloads ride the attachment and echo back zero-copy
+            # (the reference's rdma_performance shape)
             if cntl.request_device_arrays:
                 cntl.response_device_arrays = cntl.request_device_arrays
+            if cntl.request_attachment.size:
+                cntl.response_attachment = cntl.request_attachment
             return bytes(request)
 
         server.add_service(svc)

@@ -27,8 +27,12 @@ def main() -> None:
 
     @svc.method()
     def Echo(cntl, request):
-        # request is the decoded bytes payload; returning it as-is is
-        # zero-copy (serialize_payload passes bytes through)
+        # attachment blocks flow back out unjoined (zero-copy, the
+        # reference's rdma_performance echo shape: payload rides the
+        # attachment, example/rdma_performance/client.cpp); the byte
+        # payload echoes through serialize_payload's pass-through
+        if cntl.request_attachment.size:
+            cntl.response_attachment = cntl.request_attachment
         return request
 
     server.add_service(svc)

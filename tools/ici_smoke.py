@@ -63,11 +63,9 @@ def serve() -> None:
     server.add_service(svc)
     ep = server.start("ici://127.0.0.1:0#device=0")
     print(f"PORT {ep.port}", flush=True)
-    parent = os.getppid()
-    while True:
-        time.sleep(1)
-        if os.getppid() != parent:   # parent died: don't orphan the chip
-            os._exit(0)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from spawn_util import parent_death_watchdog_loop
+    parent_death_watchdog_loop()  # parent died: don't orphan the chip
 
 
 RPC_TIMEOUT_MS = float(os.environ.get("BRPC_TPU_SMOKE_TIMEOUT_MS", "45000"))
@@ -164,30 +162,48 @@ def _run_pass(env_extra: dict, wall_s: float) -> dict:
     """Run one --single evidence pass in a subprocess, wall-capped so a
     wedged backend (the single-client tunnel deadlock) still yields a
     structured record instead of hanging the tool."""
+    import tempfile
+
     env = dict(os.environ)
+    # the caller's module-level CPU knob must not leak into the REAL
+    # pass — it would force JAX_PLATFORMS=cpu and record a 'real
+    # backend' that never touched the chip
+    env.pop("BRPC_TPU_SMOKE_CPU", None)
     env.update(env_extra)
+    errf = tempfile.NamedTemporaryFile("w+", suffix=".log", delete=False)
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--single"],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env)
+        stdout=subprocess.PIPE, stderr=errf, env=env)
     try:
-        out, _ = proc.communicate(timeout=wall_s)
-    except subprocess.TimeoutExpired:
-        proc.kill()
         try:
-            proc.wait(10)
+            out, _ = proc.communicate(timeout=wall_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.wait(10)
+            except Exception:
+                pass
+            return {"ok": False, "error": f"wall-capped after {wall_s:.0f}s "
+                    "(pass killed; backend wedged or single-client tunnel "
+                    "deadlock)", "stage": "killed"}
+        for line in out.decode("utf-8", "replace").splitlines():
+            if line.startswith("EVIDENCE "):
+                try:
+                    return json.loads(line[len("EVIDENCE "):])
+                except Exception:
+                    break
+        errf.seek(0)
+        tail = errf.read()[-1500:]
+        return {"ok": False, "stage": "no-output",
+                "error": f"pass exited rc={proc.returncode} without "
+                         f"evidence" + (f"; stderr tail: {tail}"
+                                        if tail else "")}
+    finally:
+        try:
+            errf.close()
+            os.unlink(errf.name)
         except Exception:
             pass
-        return {"ok": False, "error": f"wall-capped after {wall_s:.0f}s "
-                "(pass killed; backend wedged or single-client tunnel "
-                "deadlock)", "stage": "killed"}
-    for line in out.decode("utf-8", "replace").splitlines():
-        if line.startswith("EVIDENCE "):
-            try:
-                return json.loads(line[len("EVIDENCE "):])
-            except Exception:
-                break
-    return {"ok": False, "stage": "no-output",
-            "error": f"pass exited rc={proc.returncode} without evidence"}
 
 
 def orchestrate() -> None:

@@ -53,6 +53,7 @@ def spawn_port_server(argv, wall_s: float, env: Optional[dict] = None,
         pass
     try:
         proc.kill()
+        proc.wait(10)  # reap: no zombie for the rest of the caller's run
     except Exception:
         pass
     return None, None
