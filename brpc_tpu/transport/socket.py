@@ -95,11 +95,16 @@ class Socket:
                     pass
             return False
         nwrites.add(1)
-        # fast path for never-blocking conns (mem/tpu pipes): write in the
-        # caller's context instead of bouncing through a keep_write fiber —
-        # two fiber wakeups saved per RPC roundtrip. The _writing flag is
-        # claimed exactly like keep_write does, so FIFO order holds against
-        # concurrent writers (losers enqueue; we drain them after).
+        # fast path: first write attempt in the caller's context instead
+        # of bouncing through a keep_write fiber — two fiber wakeups
+        # saved per RPC roundtrip. Opt-in invariant (inline_write_ok):
+        # the conn's write() raises BlockingIOError on EAGAIN (which
+        # cut_into_writer absorbs, leaving the remainder in `buf`), so
+        # a partial/blocked write lands in the handoff branch below —
+        # never in the except arm. mem/tpu pipes never block; TCP relies
+        # on the handoff. The _writing flag is claimed exactly like
+        # keep_write does, so FIFO order holds against concurrent
+        # writers (losers enqueue; we drain them after).
         if getattr(self.conn, "inline_write_ok", False):
             with self._write_flag_lock:
                 fast = not self._writing and not self._write_q

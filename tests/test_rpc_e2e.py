@@ -458,3 +458,59 @@ class TestConnectionTypes:
             ch.close()
             server.stop()
             server.join(2)
+
+    def test_single_conn_concurrent_large_attachments_inline_write(self):
+        """Inline TCP writes (TcpConn.inline_write_ok) must preserve
+        frame integrity and FIFO handoff under concurrency: many large
+        attachment echoes share ONE connection, so first-attempt inline
+        sends interleave with keep_write fibers draining partial-write
+        leftovers (socket.cpp:1960-2050's write-once-then-KeepWrite)."""
+        from brpc_tpu.butil.iobuf import IOBuf
+
+        server = make_echo_server()
+        ep = server.start("tcp://127.0.0.1:0")
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(connection_type="single",
+                                    timeout_ms=20000))
+        n = 24
+        size = 256 * 1024
+        done = threading.Event()
+        left = [n]
+        lock = threading.Lock()
+        errors = []
+
+        def mk(i):
+            def _d(cntl):
+                try:
+                    if cntl.failed():
+                        raise RuntimeError(cntl.error_text)
+                    got = cntl.response_attachment.to_bytes()
+                    # full-buffer compare: a mid-frame splice of two
+                    # equal-sized frames would keep lengths and edge
+                    # bytes consistent — only the whole body catches it
+                    if got != bytes([i % 251]) * size:
+                        raise RuntimeError(
+                            f"frame corrupted (len {len(got)})")
+                except BaseException as e:
+                    errors.append(e)
+                finally:
+                    with lock:
+                        left[0] -= 1
+                        if left[0] == 0:
+                            done.set()
+            return _d
+
+        try:
+            for i in range(n):
+                cntl = Controller()
+                att = IOBuf()
+                att.append(bytes([i % 251]) * size)
+                cntl.request_attachment = att
+                ch.call("EchoService", "EchoAttachment", b"", cntl=cntl,
+                        done=mk(i))
+            assert done.wait(30), "echoes did not complete"
+            assert not errors, errors[0]
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
