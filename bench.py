@@ -131,6 +131,81 @@ def spawn_tcp_server(deadline):
         wall_s=min(30.0, max(5.0, deadline.remaining())))
 
 
+_RAW_ECHO_SRC = r"""
+import socket, sys
+s = socket.socket(); s.bind(("127.0.0.1", 0)); s.listen(1)
+print(f"PORT {s.getsockname()[1]}", flush=True)
+c, _ = s.accept()
+c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+buf = bytearray(1 << 20); mv = memoryview(buf)
+while True:
+    n = c.recv_into(mv)
+    if not n: break
+    c.sendall(mv[:n])
+"""
+
+
+def measure_raw_loopback(window_s: float = 2.5) -> float:
+    """Machine calibration: a bare two-process socket echo (no
+    framework) in the same shape as the headline, so the result can
+    report how close the framework runs to this box's kernel loopback
+    ceiling. Returns GB/s (echoed payload bytes x2 / wall, the same
+    accounting as the headline) or 0.0 on any failure."""
+    import subprocess
+
+    proc = None
+    c = None
+    gbps = 0.0
+    try:
+        proc = subprocess.Popen([sys.executable, "-c", _RAW_ECHO_SRC],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL)
+        port = int(proc.stdout.readline().split()[1])
+        import socket as pysock
+
+        c = pysock.create_connection(("127.0.0.1", port))
+        c.setsockopt(pysock.IPPROTO_TCP, pysock.TCP_NODELAY, 1)
+        # a dead child mid-window would leave sendall blocked forever on
+        # full buffers; a timeout turns that into an exception
+        c.settimeout(window_s + 5.0)
+        payload = b"r" * (1 << 20)
+        got = [0]
+        stop = [False]
+
+        def drain():
+            buf = bytearray(1 << 20)
+            mv = memoryview(buf)
+            while not stop[0]:
+                n = c.recv_into(mv)
+                if not n:
+                    return
+                got[0] += n
+
+        th = threading.Thread(target=drain, daemon=True)
+        th.start()
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < window_s:
+            c.sendall(payload)
+        dt = time.perf_counter() - t0
+        stop[0] = True
+        gbps = got[0] * 2 / dt / 1e9
+    except Exception:
+        pass
+    finally:
+        try:
+            if c is not None:
+                c.close()
+        except Exception:
+            pass
+        try:
+            if proc is not None:
+                proc.terminate()
+                proc.wait(5)
+        except Exception:
+            pass
+    return gbps
+
+
 def make_runner(ch, deadline, np):
     """Pipelined batch runner over `ch`; returns wall seconds.
 
@@ -310,9 +385,17 @@ def main() -> None:
                 break
             dt = run(iters, 16, rec, payload=payload, threads=2)
             gbps = max(gbps, iters * (1 << 20) * 2 / 1e9 / dt)
+        # machine calibration: the same echo shape with bare sockets —
+        # reported so vs_baseline has context (the reference's 2.3 GB/s
+        # was multi-core + 10GbE; this box's kernel loopback is the
+        # actual ceiling here). Skipped when the budget is spent.
+        raw = (measure_raw_loopback(min(2.5, deadline.remaining() * 0.1))
+               if deadline.remaining() > 5.0 else 0.0)
         result.update({
             "value": round(gbps, 3),
             "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+            "loopback_raw_GBps": round(raw, 3),
+            "efficiency_vs_raw": round(gbps / raw, 3) if raw else None,
             "avg_us": round(rec.latency(), 1),
             "p50_us": round(rec.latency_percentile(0.5), 1),
             "p99_us": round(rec.latency_percentile(0.99), 1),

@@ -28,14 +28,28 @@ _MAX_CACHED_BLOCKS_PER_THREAD = 64
 _APPEND_ZEROCOPY_MIN = 16384
 
 
-# large read blocks (adaptive drain hint) are recycled too — 64 x 256KB
-# = 16MB of cached read buffers per reading thread; sized so a full
-# window of 1MB-payload messages in flight (each spanning ~4 big blocks)
-# stays inside the cache, because a cache miss is a fresh large
-# allocation whose page-fault cost dominates the recv syscall itself
-# (see malloc_tune.py for the measurement)
-_BIG_BLOCK_SIZE = 262144
-_MAX_CACHED_BIG_BLOCKS_PER_THREAD = 64
+# large read blocks (adaptive drain hint) are recycled too, with a
+# byte-budgeted per-thread cache (16MB default); sized so a full
+# window of 1MB-payload messages in flight stays inside the cache,
+# because a cache miss is a fresh large allocation whose page-fault
+# cost dominates the recv syscall itself (see malloc_tune.py for the
+# measurement). Block size tunable: bigger blocks mean fewer recv
+# syscalls per bulk transfer but coarser recycling granularity.
+import os as _os
+
+
+def _big_block_size_from_env() -> int:
+    try:
+        v = int(_os.environ.get("BRPC_TPU_BIG_BLOCK", 262144))
+    except ValueError:
+        return 262144
+    # clamp instead of crash/disable: below 64KB the "big" tier stops
+    # paying for itself; above 8MB recycling granularity is useless
+    return min(max(v, 65536), 8 << 20)
+
+
+_BIG_BLOCK_SIZE = _big_block_size_from_env()
+_MAX_CACHED_BIG_BLOCKS_PER_THREAD = max(1, (16 << 20) // _BIG_BLOCK_SIZE)
 
 
 class _ThreadBlockCache(threading.local):
