@@ -27,14 +27,20 @@ def _crc_init() -> None:
 _crc_init()
 
 
-def crc32c(data: bytes, init: int = 0) -> int:
-    v = native.crc32c(data, init)
-    if v is not None:
-        return v
+def crc32c_py(data: bytes, init: int = 0) -> int:
+    """Pure-Python path, exposed so bench.py can report the native
+    speedup factor (and tests can check bit-identity)."""
     crc = init ^ 0xFFFFFFFF
     for b in data:
         crc = _crc_table[(crc ^ b) & 0xFF] ^ (crc >> 8)
     return crc ^ 0xFFFFFFFF
+
+
+def crc32c(data: bytes, init: int = 0) -> int:
+    v = native.crc32c(data, init)
+    if v is not None:
+        return v
+    return crc32c_py(data, init)
 
 
 def _rotl64(x: int, r: int) -> int:
@@ -55,6 +61,11 @@ def murmur3_x64_128(data: bytes, seed: int = 0) -> int:
     v = native.murmur3_x64_128(data, seed)
     if v is not None:
         return v
+    return murmur3_x64_128_py(data, seed)
+
+
+def murmur3_x64_128_py(data: bytes, seed: int = 0) -> int:
+    """Pure-Python path (see crc32c_py for why it stays exposed)."""
     M = 0xFFFFFFFFFFFFFFFF
     c1, c2 = 0x87C37B91114253D5, 0x4CF5AD432745937F
     h1 = h2 = seed

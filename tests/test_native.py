@@ -401,3 +401,23 @@ class TestBatchParseWired:
         finally:
             set_flag("tpu_std_batch_parse", False)
             TpuStdProtocol.batch_parse = orig_bp
+
+
+def test_python_fallbacks_bit_identical_to_native():
+    """The exposed _py paths (bench.py's native-delta baseline) must
+    stay bit-identical to the native implementations."""
+    import os
+
+    from brpc_tpu import native
+    from brpc_tpu.butil.hash import (crc32c_py, murmur3_x64_128,
+                                     murmur3_x64_128_py)
+
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+    # sizes chosen so murmur's tail length mod 16 covers 0, the 1..8
+    # k1-only branch, and the 9..15 k1+k2 branch
+    for size in (4096, 4097, 4104, 4109, 4111):
+        data = os.urandom(size)
+        assert native.crc32c(data, 0) == crc32c_py(data, 0), size
+        assert murmur3_x64_128(data, 7) == murmur3_x64_128_py(data, 7), size
