@@ -93,6 +93,15 @@ def _declare(lib: ctypes.CDLL) -> None:
     L.bt_trpc_probe.restype = ctypes.c_int
     L.bt_trpc_probe.argtypes = [ctypes.c_char_p, c_size,
                                 ctypes.POINTER(c_u32), ctypes.POINTER(c_u32)]
+    # snappy
+    L.bt_snappy_max_compressed.restype = c_size
+    L.bt_snappy_max_compressed.argtypes = [c_size]
+    L.bt_snappy_compress.restype = c_size
+    L.bt_snappy_compress.argtypes = [ctypes.c_char_p, c_size,
+                                     ctypes.c_char_p, c_size]
+    L.bt_snappy_decompress.restype = ctypes.c_int64
+    L.bt_snappy_decompress.argtypes = [ctypes.c_char_p, c_size,
+                                       ctypes.c_char_p, c_size]
     # wsq
     L.bt_wsq_create.restype = ctypes.c_void_p
     L.bt_wsq_create.argtypes = [c_size]
@@ -201,3 +210,37 @@ def trpc_scan(data, max_frames: int = 256):
         raise ValueError("not a TRPC stream")
     frames = [(int(out[2 * i]), int(out[2 * i + 1])) for i in range(n)]
     return frames, int(consumed.value), int(need.value)
+
+
+def snappy_compress(data: bytes) -> Optional[bytes]:
+    L = lib()
+    if L is None:
+        return None
+    data = bytes(data)
+    cap = int(L.bt_snappy_max_compressed(len(data)))
+    dst = ctypes.create_string_buffer(cap)
+    n = int(L.bt_snappy_compress(data, len(data), dst, cap))
+    if n == 0 and data:
+        return None
+    return dst.raw[:n]
+
+
+def snappy_decompress(data: bytes) -> Optional[bytes]:
+    """None when the native lib is absent; raises ValueError on corrupt
+    input (mirrors snappy_codec.SnappyError)."""
+    L = lib()
+    if L is None:
+        return None
+    data = bytes(data)
+    want = int(L.bt_snappy_decompress(data, len(data), None, 0))
+    # the preamble is attacker-controlled (up to 2^35-1): cap it against
+    # the format's maximum expansion (a copy2 turns 3 input bytes into
+    # 64 output bytes, <22x) BEFORE allocating, or a 5-byte bomb
+    # requests a 32GB buffer
+    if want < 0 or want > 32 + 22 * len(data):
+        raise ValueError("corrupt snappy stream")
+    dst = ctypes.create_string_buffer(max(want, 1))
+    n = int(L.bt_snappy_decompress(data, len(data), dst, want))
+    if n < 0:
+        raise ValueError("corrupt snappy stream")
+    return dst.raw[:n]

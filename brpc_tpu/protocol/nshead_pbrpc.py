@@ -95,13 +95,19 @@ def nova_adaptor(service):
             socket.set_failed(ConnectionError(
                 f"nova: no method at index {msg.reserved}"))
             return None
+        body = msg.body
         if msg.version & NOVA_SNAPPY_COMPRESS_FLAG:
-            # no snappy codec in this image (rpc/compress.py note); nova
-            # compression is rejected loudly rather than mis-decoded
-            socket.set_failed(ConnectionError(
-                "nova: snappy-compressed request but no snappy codec"))
-            return None
-        r, _cntl, err = await _invoke(methods[msg.reserved], msg.body,
+            # nova_pbrpc_protocol.cpp: request body is raw snappy.
+            # ANY decode failure must drop the connection — an
+            # unanswered FIFO slot hands the next reply to this waiter
+            from brpc_tpu.butil import snappy_codec
+            try:
+                body = snappy_codec.decompress_auto(bytes(body))
+            except Exception as e:  # noqa: BLE001 - see comment above
+                socket.set_failed(ConnectionError(
+                    f"nova: corrupt snappy body: {e}"))
+                return None
+        r, _cntl, err = await _invoke(methods[msg.reserved], body,
                                       socket)
         if err is not None:
             # nova can not send feedback on failure: close the conn
@@ -120,11 +126,25 @@ class NovaClient(NsheadClient):
     Matching is by connection order (pipelined FIFO), the same
     single-conn-forbidden model as the reference."""
 
-    def call_method(self, method_index: int, request, log_id: int = 0):
+    def call_method(self, method_index: int, request, log_id: int = 0,
+                    snappy: bool = False):
         body = _serialize_reply(request)
-        reply = self.call(NsheadMessage(body, log_id=log_id,
+        version = 0
+        if snappy:
+            # nova_pbrpc_protocol.cpp: raw-snappy body, flagged in the
+            # nshead version field
+            from brpc_tpu.butil import snappy_codec
+            body = snappy_codec.compress_auto(body)
+            version = NOVA_SNAPPY_COMPRESS_FLAG
+        reply = self.call(NsheadMessage(body, version=version,
+                                        log_id=log_id,
                                         reserved=method_index))
-        return reply.body
+        rbody = reply.body
+        if reply.version & NOVA_SNAPPY_COMPRESS_FLAG:
+            # symmetric: a server may flag its response compressed
+            from brpc_tpu.butil import snappy_codec
+            rbody = snappy_codec.decompress_auto(bytes(rbody))
+        return rbody
 
 
 # ---------------------------------------------------------- public_pbrpc

@@ -2,9 +2,9 @@
 snappy_compress.cpp). Payload compression by numeric type carried in
 RpcMeta.compress_type; both sides look the codec up here.
 
-Builtin: 0=none, 1=gzip, 2=zlib. (The reference's snappy slot is served by
-zlib here — snappy has no stdlib codec; a C++ one can plug in via
-register_compressor.)"""
+Builtin: 0=none, 1=gzip, 2=zlib, 3=snappy (butil/snappy_codec — native
+C++ with a bit-identical pure-Python fallback, like the reference's
+vendored snappy). More codecs plug in via register_compressor."""
 
 from __future__ import annotations
 
@@ -12,13 +12,18 @@ import gzip
 import zlib
 from typing import Callable, Dict, Optional, Tuple
 
+from brpc_tpu.butil import snappy_codec
+
 COMPRESS_NONE = 0
 COMPRESS_GZIP = 1
 COMPRESS_ZLIB = 2
+COMPRESS_SNAPPY = 3
 
 _codecs: Dict[int, Tuple[Callable[[bytes], bytes], Callable[[bytes], bytes], str]] = {
     COMPRESS_GZIP: (lambda b: gzip.compress(b, 6), gzip.decompress, "gzip"),
     COMPRESS_ZLIB: (zlib.compress, zlib.decompress, "zlib"),
+    COMPRESS_SNAPPY: (snappy_codec.compress_auto, snappy_codec.decompress_auto,
+                      "snappy"),
 }
 
 

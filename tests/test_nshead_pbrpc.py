@@ -156,3 +156,37 @@ class TestUbrpc:
         finally:
             server.stop()
             server.join(2)
+
+
+class TestNovaSnappy:
+    def test_snappy_flagged_request_decodes(self):
+        server, ep = start_server(nova_adaptor)
+        try:
+            cl = NovaClient(f"tcp://{ep.host}:{ep.port}")
+            req = echo_pb2.EchoRequest(message="compressed nova")
+            body = cl.call_method(0, req, snappy=True)
+            res = echo_pb2.EchoResponse()
+            res.ParseFromString(body)
+            assert res.message == "re: compressed nova"
+            cl.close()
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_corrupt_snappy_body_drops_connection(self):
+        import pytest as _pytest
+
+        server, ep = start_server(nova_adaptor)
+        try:
+            cl = NovaClient(f"tcp://{ep.host}:{ep.port}", timeout_s=2.0)
+            from brpc_tpu.protocol.nshead import NsheadMessage
+            from brpc_tpu.protocol.nshead_pbrpc import \
+                NOVA_SNAPPY_COMPRESS_FLAG
+            with _pytest.raises(Exception):
+                cl.call(NsheadMessage(b"\x0a\x01\x00\x00\x00",
+                                      version=NOVA_SNAPPY_COMPRESS_FLAG,
+                                      reserved=0))
+            cl.close()
+        finally:
+            server.stop()
+            server.join(2)

@@ -1,0 +1,146 @@
+"""Snappy codec: roundtrips, native/Python bit-identity, corrupt-input
+rejection, and the wire compressor slot (the reference's
+policy/snappy_compress.cpp role)."""
+
+import os
+import random
+
+import pytest
+
+from brpc_tpu.butil import snappy_codec as sc
+
+
+def corpus():
+    random.seed(20260730)
+    cases = [
+        b"", b"a", b"ab", b"abc", b"abcd", b"abcde",
+        b"aaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaa",
+        b"x" * 100000,                       # offset-1 overlap runs
+        bytes(range(256)) * 40,              # periodic, offset 256
+        os.urandom(10000),                   # incompressible
+        (b"the quick brown fox " * 997),     # text-ish
+    ]
+    for _ in range(60):
+        n = random.randrange(0, 9000)
+        alphabet = b"abcdefgh\x00\xff"
+        base = bytes(random.choices(alphabet, k=max(1, n // 11))) if n else b""
+        cases.append((base * 16)[:n])
+    return cases
+
+
+class TestPythonCodec:
+    def test_roundtrip_corpus(self):
+        for d in corpus():
+            c = sc.compress(d)
+            assert sc.decompress(c) == d, len(d)
+            assert len(c) <= sc.max_compressed_length(len(d))
+
+    def test_compresses_redundancy(self):
+        d = b"compressible pattern " * 3000
+        assert len(sc.compress(d)) < len(d) // 10
+
+    @pytest.mark.parametrize("bad", [
+        b"",                                  # no preamble
+        b"\x80\x80\x80\x80\x80\x80",          # runaway varint
+        b"\x05\xf0",                          # literal longer than input
+        b"\x0a\x01\x00\x00\x00",              # copy before any output
+        bytes([8, 97, 97, 97]) + bytes([0x01 | (0 << 2) | (7 << 5), 0xFF]),
+                                              # copy offset beyond written
+        b"\x0a" + b"\x00" + b"ab",            # output shorter than preamble
+    ])
+    def test_corrupt_inputs_raise(self, bad):
+        with pytest.raises(sc.SnappyError):
+            sc.decompress(bad)
+
+
+class TestNativeTwin:
+    def test_bit_identical_compress_and_decompress(self):
+        from brpc_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        for d in corpus():
+            cn = native.snappy_compress(d)
+            cp = sc.compress(d)
+            assert cn == cp, f"compressed bytes diverge at len {len(d)}"
+            assert native.snappy_decompress(cp) == d
+
+    def test_native_rejects_corrupt(self):
+        from brpc_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        with pytest.raises(ValueError):
+            native.snappy_decompress(b"\x0a\x01\x00\x00\x00")
+
+    def test_cross_decode(self):
+        """Python-compressed decodes natively and vice versa (wire
+        compatibility between mixed deployments)."""
+        from brpc_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        d = (b"mixed deployment payload " * 400) + os.urandom(500)
+        assert native.snappy_decompress(sc.compress(d)) == d
+        assert sc.decompress(native.snappy_compress(d)) == d
+
+
+class TestWireSlot:
+    def test_registry_roundtrip(self):
+        from brpc_tpu.rpc.compress import (COMPRESS_SNAPPY, compress,
+                                           decompress)
+
+        d = b"registry payload " * 1000
+        c = compress(d, COMPRESS_SNAPPY)
+        assert len(c) < len(d)
+        assert decompress(c, COMPRESS_SNAPPY) == d
+
+    def test_rpc_e2e_snappy(self):
+        from brpc_tpu.rpc import (Channel, Controller, Server,
+                                  ServerOptions, Service)
+        from brpc_tpu.rpc.compress import COMPRESS_SNAPPY
+
+        server = Server(ServerOptions(enable_builtin_services=False))
+        svc = Service("Z")
+
+        @svc.method()
+        def Echo(cntl, request):
+            return request
+
+        server.add_service(svc)
+        ep = server.start("mem://snappy-e2e")
+        try:
+            ch = Channel(str(ep))
+            cntl = Controller()
+            cntl.compress_type = COMPRESS_SNAPPY
+            payload = b"S" * 120_000
+            cntl = ch.call_sync("Z", "Echo", payload, cntl=cntl)
+            assert not cntl.failed(), cntl.error_text
+            assert cntl.response_payload.to_bytes() == payload
+            ch.close()
+        finally:
+            server.stop()
+            server.join(2)
+
+
+class TestPreambleBomb:
+    """A tiny input claiming a huge decompressed size must be rejected
+    before any allocation (remote memory-exhaustion guard)."""
+
+    BOMB = b"\xff\xff\xff\xff\x7f"   # preamble says 2^35-1 bytes
+
+    def test_python_rejects(self):
+        with pytest.raises(sc.SnappyError):
+            sc.decompress(self.BOMB)
+
+    def test_native_rejects_without_allocating(self):
+        from brpc_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        with pytest.raises(ValueError):
+            native.snappy_decompress(self.BOMB)
+
+    def test_auto_rejects(self):
+        with pytest.raises(sc.SnappyError):
+            sc.decompress_auto(self.BOMB)
