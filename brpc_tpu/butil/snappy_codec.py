@@ -89,7 +89,17 @@ def _emit_copy_chunk(out: bytearray, offset: int, length: int) -> None:
         out += offset.to_bytes(4, "little")
 
 
+_FRAGMENT = 1 << 16
+
+
 def compress(data) -> bytes:
+    """Input is compressed in independent 64KB fragments (matches never
+    cross a fragment boundary), like real snappy: offsets stay < 65536,
+    copy4 is never emitted, and that is what PROVES the
+    max_compressed_length bound — long-range length-4 matches would
+    otherwise emit 5-byte copy4 elements and EXPAND adversarial input
+    past the bound (a heap overflow in the native twin, which sizes its
+    destination by the bound)."""
     data = bytes(data)
     n = len(data)
     out = bytearray()
@@ -100,32 +110,36 @@ def compress(data) -> bytes:
         _emit_literal(out, data, 0, n)
         return bytes(out)
 
-    table = [0] * (1 << _HASH_BITS)   # position+1; 0 = empty
     shift = 32 - _HASH_BITS
     mask = 0xFFFFFFFF
-    lit_start = 0
-    pos = 0
-    limit = n - _MIN_MATCH
-    while pos <= limit:
-        cur = int.from_bytes(data[pos:pos + 4], "little")
-        h = ((cur * _HASH_MUL) & mask) >> shift
-        cand = table[h] - 1
-        table[h] = pos + 1
-        if cand >= 0 and \
-                data[cand:cand + 4] == data[pos:pos + 4]:
-            # extend the match
-            m = pos + 4
-            c = cand + 4
-            while m < n and data[m] == data[c]:
-                m += 1
-                c += 1
-            _emit_literal(out, data, lit_start, pos)
-            _emit_copy(out, pos - cand, m - pos)
-            pos = m
-            lit_start = m
-        else:
-            pos += 1
-    _emit_literal(out, data, lit_start, n)
+    base = 0
+    while base < n:
+        frag_end = min(base + _FRAGMENT, n)
+        table = [0] * (1 << _HASH_BITS)   # position+1 (absolute); 0 = empty
+        lit_start = base
+        pos = base
+        limit = frag_end - _MIN_MATCH
+        while pos <= limit:
+            cur = int.from_bytes(data[pos:pos + 4], "little")
+            h = ((cur * _HASH_MUL) & mask) >> shift
+            cand = table[h] - 1
+            table[h] = pos + 1
+            if cand >= 0 and \
+                    data[cand:cand + 4] == data[pos:pos + 4]:
+                # extend the match (within the fragment only)
+                m = pos + 4
+                c = cand + 4
+                while m < frag_end and data[m] == data[c]:
+                    m += 1
+                    c += 1
+                _emit_literal(out, data, lit_start, pos)
+                _emit_copy(out, pos - cand, m - pos)
+                pos = m
+                lit_start = m
+            else:
+                pos += 1
+        _emit_literal(out, data, lit_start, frag_end)
+        base = frag_end
     return bytes(out)
 
 

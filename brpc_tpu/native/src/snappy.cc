@@ -98,9 +98,15 @@ extern "C" {
 // worst-case output bound, mirrors snappy_codec.max_compressed_length
 size_t bt_snappy_max_compressed(size_t n) { return 32 + n + n / 6; }
 
-// returns compressed size, or 0 if dst_cap is too small
+// returns compressed size, or 0 if dst_cap is too small.
+// Input is compressed in independent 64KB fragments (matches never
+// cross a fragment), like real snappy: offsets stay < 65536, copy4 is
+// never emitted, and that is what PROVES the max_compressed bound —
+// long-range length-4 matches would otherwise emit 5-byte copy4
+// elements and overflow a bound-sized destination.
 size_t bt_snappy_compress(const uint8_t* src, size_t n, uint8_t* dst,
                             size_t dst_cap) {
+  constexpr size_t kFragment = 1u << 16;
   if (dst_cap < bt_snappy_max_compressed(n)) return 0;
   uint8_t* d = emit_varint(dst, n);
   if (n == 0) return static_cast<size_t>(d - dst);
@@ -110,32 +116,40 @@ size_t bt_snappy_compress(const uint8_t* src, size_t n, uint8_t* dst,
   }
   // position+1; 0 = empty. Static would break concurrent callers, so a
   // per-call table on the heap; 16K entries x4B = 64KB.
-  uint32_t* table = new uint32_t[1u << kHashBits]();
+  uint32_t* table = new uint32_t[1u << kHashBits];
   const int shift = 32 - kHashBits;
-  size_t lit_start = 0;
-  size_t pos = 0;
-  const size_t limit = n - kMinMatch;
-  while (pos <= limit) {
-    const uint32_t cur = load32(src + pos);
-    const uint32_t h = (cur * kHashMul) >> shift;
-    const int64_t cand = static_cast<int64_t>(table[h]) - 1;
-    table[h] = static_cast<uint32_t>(pos + 1);
-    if (cand >= 0 && load32(src + cand) == cur) {
-      size_t m = pos + 4;
-      size_t c = static_cast<size_t>(cand) + 4;
-      while (m < n && src[m] == src[c]) {
-        ++m;
-        ++c;
+  size_t base = 0;
+  while (base < n) {
+    const size_t frag_end = base + kFragment < n ? base + kFragment : n;
+    std::memset(table, 0, sizeof(uint32_t) << kHashBits);
+    size_t lit_start = base;
+    size_t pos = base;
+    if (frag_end >= base + kMinMatch) {
+      const size_t limit = frag_end - kMinMatch;
+      while (pos <= limit) {
+        const uint32_t cur = load32(src + pos);
+        const uint32_t h = (cur * kHashMul) >> shift;
+        const int64_t cand = static_cast<int64_t>(table[h]) - 1;
+        table[h] = static_cast<uint32_t>(pos + 1);
+        if (cand >= 0 && load32(src + cand) == cur) {
+          size_t m = pos + 4;
+          size_t c = static_cast<size_t>(cand) + 4;
+          while (m < frag_end && src[m] == src[c]) {
+            ++m;
+            ++c;
+          }
+          d = emit_literal(d, src, lit_start, pos);
+          d = emit_copy(d, pos - static_cast<size_t>(cand), m - pos);
+          pos = m;
+          lit_start = m;
+        } else {
+          ++pos;
+        }
       }
-      d = emit_literal(d, src, lit_start, pos);
-      d = emit_copy(d, pos - static_cast<size_t>(cand), m - pos);
-      pos = m;
-      lit_start = m;
-    } else {
-      ++pos;
     }
+    d = emit_literal(d, src, lit_start, frag_end);
+    base = frag_end;
   }
-  d = emit_literal(d, src, lit_start, n);
   delete[] table;
   return static_cast<size_t>(d - dst);
 }

@@ -126,8 +126,12 @@ class NsheadProtocol(Protocol):
         if reply is None:
             return
         if isinstance(reply, (bytes, bytearray, memoryview)):
-            reply = NsheadMessage(bytes(reply), msg.id, msg.version,
-                                  msg.log_id)
+            # raw-bytes replies do NOT inherit the request's version:
+            # version bits are adaptor-specific flags (e.g. nova's
+            # snappy bit) and echoing them would mark this uncompressed
+            # body as compressed at the peer — adaptors that need header
+            # control return a full NsheadMessage instead
+            reply = NsheadMessage(bytes(reply), msg.id, 0, msg.log_id)
         out = IOBuf()
         out.append(reply.pack())
         socket.write(out)

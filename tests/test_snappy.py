@@ -144,3 +144,59 @@ class TestPreambleBomb:
     def test_auto_rejects(self):
         with pytest.raises(sc.SnappyError):
             sc.decompress_auto(self.BOMB)
+
+
+class TestExpansionBound:
+    """max_compressed_length must be a TRUE bound: long-distance
+    length-4 matches would emit expanding copy4 elements and overflow
+    the native encoder's bound-sized destination — fragmenting
+    compression at 64KB (like real snappy) is what prevents it."""
+
+    def test_adversarial_long_distance_matches_stay_in_bound(self):
+        import struct
+
+        period_grams = 16500            # 66000-byte cycle > 64KB
+        cycle = b"".join(struct.pack("<I", 0x10000000 + i)
+                         for i in range(period_grams))
+        data = cycle * 5
+        c = sc.compress(data)
+        assert len(c) <= sc.max_compressed_length(len(data))
+        assert sc.decompress(c) == data
+
+    def test_twins_identical_across_fragment_boundaries(self):
+        from brpc_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        base = os.urandom(97)
+        for size in (65535, 65536, 65537, 131071, 131073):
+            d = (base * (size // 97 + 1))[:size]
+            assert native.snappy_compress(d) == sc.compress(d), size
+
+    def test_encoder_never_emits_copy4(self):
+        """Offsets stay under 64K by construction; scan the element
+        stream of a multi-fragment compress for kind-3 tags."""
+        d = (b"fragmented payload block " * 8000)[:180000]
+        c = sc.compress(d)
+        i = 0
+        # skip preamble varint
+        while c[i] & 0x80:
+            i += 1
+        i += 1
+        while i < len(c):
+            tag = c[i]
+            i += 1
+            kind = tag & 3
+            if kind == 0:
+                rem = tag >> 2
+                if rem >= 60:
+                    extra = rem - 59
+                    rem = int.from_bytes(c[i:i + extra], "little")
+                    i += extra
+                i += rem + 1
+            elif kind == 1:
+                i += 1
+            elif kind == 2:
+                i += 2
+            else:
+                raise AssertionError("encoder emitted a copy4 element")
