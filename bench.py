@@ -238,6 +238,14 @@ def measure_native_delta() -> dict:
             rate(bh.murmur3_x64_128, data, 5), 1)
         out["murmur3_python_MBps"] = round(
             rate(bh.murmur3_x64_128_py, small, 3), 1)
+        from brpc_tpu import native
+        from brpc_tpu.butil import snappy_codec as sz
+
+        comp = b"compressible wire payload " * 40330  # ~1MB
+        out["snappy_native_MBps"] = round(
+            rate(native.snappy_compress, comp, 5), 1)
+        out["snappy_python_MBps"] = round(
+            rate(sz.compress, comp[:65536], 3), 1)
         out["available"] = True
     except Exception as e:  # noqa: BLE001 - diagnostics only
         out["error"] = f"{type(e).__name__}: {e}"[:200]

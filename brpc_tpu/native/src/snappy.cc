@@ -129,22 +129,30 @@ size_t bt_snappy_compress(const uint8_t* src, size_t n, uint8_t* dst,
       while (pos <= limit) {
         const uint32_t cur = load32(src + pos);
         const uint32_t h = (cur * kHashMul) >> shift;
-        const int64_t cand = static_cast<int64_t>(table[h]) - 1;
-        table[h] = static_cast<uint32_t>(pos + 1);
-        if (cand >= 0 && load32(src + cand) == cur) {
-          size_t m = pos + 4;
-          size_t c = static_cast<size_t>(cand) + 4;
-          while (m < frag_end && src[m] == src[c]) {
-            ++m;
-            ++c;
+        // FRAGMENT-RELATIVE position+1 in the table: always <= 65536,
+        // so it can never truncate in uint32 — storing absolute
+        // positions would wrap past 4GiB inputs and fabricate
+        // out-of-fragment candidates, re-opening the copy4/bound hole
+        // the fragmenting exists to close
+        const uint32_t stored = table[h];
+        table[h] = static_cast<uint32_t>(pos - base + 1);
+        if (stored != 0) {
+          const size_t cand = base + stored - 1;
+          if (load32(src + cand) == cur) {
+            size_t m = pos + 4;
+            size_t c = cand + 4;
+            while (m < frag_end && src[m] == src[c]) {
+              ++m;
+              ++c;
+            }
+            d = emit_literal(d, src, lit_start, pos);
+            d = emit_copy(d, pos - cand, m - pos);
+            pos = m;
+            lit_start = m;
+            continue;
           }
-          d = emit_literal(d, src, lit_start, pos);
-          d = emit_copy(d, pos - static_cast<size_t>(cand), m - pos);
-          pos = m;
-          lit_start = m;
-        } else {
-          ++pos;
         }
+        ++pos;
       }
     }
     d = emit_literal(d, src, lit_start, frag_end);

@@ -207,6 +207,19 @@ class HttpProtocol(Protocol):
         if path == "/":
             return 200, "text/html", self._index(server)
         if path == "/health":
+            reporter = getattr(server.options, "health_reporter", None)
+            if reporter is not None:
+                # health_reporter.h: the app decides what healthy means
+                try:
+                    r = reporter(server)
+                except Exception as e:
+                    return 500, "text/plain", f"health reporter: {e}".encode()
+                if isinstance(r, tuple):
+                    status, ctype, body = r
+                    body = body if isinstance(body, bytes) else str(body).encode()
+                    return status, ctype, body
+                return 200, "text/plain", (
+                    r if isinstance(r, bytes) else str(r).encode())
             return 200, "text/plain", b"OK"
         if path == "/status":
             return 200, "application/json", self._status(server)

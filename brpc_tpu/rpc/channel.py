@@ -47,6 +47,15 @@ class ChannelOptions:
     # (socket_map.h:147): channels to the same (endpoint, protocol) reuse
     # one Socket
     share_connections: bool = True
+    # pluggable retry decision (retry_policy.h): RetryPolicy instance or
+    # callable(Controller)->bool; None = default (transport/availability
+    # errors retry, semantic errors don't). Consulted for every failed
+    # attempt while tries remain — including server-returned errors.
+    retry_policy: Optional[Any] = None
+    # naming-service filter (naming_service_filter.h): callable
+    # (EndPoint)->bool; servers it rejects never reach the load
+    # balancer. Cluster channels only.
+    ns_filter: Optional[Any] = None
 
 
 
@@ -216,6 +225,7 @@ class Channel:
             hook = lambda c, s=span: finish_span(s, c)  # noqa: E731
             hook._span_hook = True
             cntl._complete_hooks.append(hook)
+        cntl._owner_channel = self  # response-path retry needs the channel
         cntl._register_call()
         self._issue_rpc(cntl)
         # deadline timer: final — no retry after it fires (HandleTimeout)
@@ -375,22 +385,64 @@ class Channel:
                           failed_ep=sock.remote_endpoint
                           if sock is not None else None)
 
+    def _retry_policy(self):
+        from brpc_tpu.rpc.retry_policy import resolve
+        return resolve(self.options.retry_policy)
+
     def _maybe_retry(self, cntl: Controller, code: int, text: str,
                      failed_ep=None) -> None:
-        """Retry on transport errors while the call is still live
-        (OnVersionedRPCReturned's error branch, controller.cpp:634)."""
+        """Retry on transport failures while the call is still live
+        (OnVersionedRPCReturned's error branch, controller.cpp:634);
+        the retry policy decides whether this error class retries."""
         if address_call(cntl.correlation_id) is not cntl:
             return  # already completed (response/timeout won)
-        if cntl.current_try < cntl.max_retry:
+        if cntl.current_try < cntl.max_retry and \
+                self._policy_allows(cntl, code, text):
             cntl.current_try += 1
             # report the failed attempt before moving on (the final
             # attempt is reported by the completion hook instead)
             self._on_attempt_failed(cntl, code, text, failed_ep)
             self._issue_rpc(cntl)
             return
-        if take_call(cntl.correlation_id) is cntl:
+        with cntl._arb_lock:
+            taken = take_call(cntl.correlation_id) is cntl
+        if taken:
             cntl.set_failed(code, text)
             cntl._complete()
+
+    def _policy_allows(self, cntl: Controller, code: int, text: str) -> bool:
+        """Consult the retry policy with the failure visible on the
+        controller (retry_policy.h's DoRetry contract), restoring the
+        controller's error state for the re-issue on a yes."""
+        prev = (cntl.error_code, cntl.error_text)
+        cntl.error_code, cntl.error_text = code, text
+        try:
+            return bool(self._retry_policy().do_retry(cntl))
+        except Exception:
+            return False  # a broken policy must not loop retries
+        finally:
+            cntl.error_code, cntl.error_text = prev
+
+    def _retry_taken_call(self, cntl: Controller, code: int, text: str,
+                          failed_ep=None) -> bool:
+        """Server-returned error on a call the caller has already WON
+        via take_call: if policy + budget allow, re-register the
+        controller under a FRESH correlation id (the analog of the
+        reference's versioned-id bump — stale responses to the old id
+        simply find no call) and re-issue. Returns True when the retry
+        was launched; False means the caller completes the controller.
+
+        Must be called with cntl._arb_lock held by the caller along
+        with its take_call, so the deadline timer can't interleave:
+        a timer firing during the id swap blocks on the lock, then
+        finds the NEW id and completes the call with ERPCTIMEDOUT."""
+        if cntl.current_try >= cntl.max_retry or \
+                not self._policy_allows(cntl, code, text):
+            return False
+        cntl.current_try += 1
+        self._on_attempt_failed(cntl, code, text, failed_ep)
+        cntl._register_call()
+        return True
 
     def _on_attempt_failed(self, cntl: Controller, code: int, text: str,
                            failed_ep=None) -> None:
@@ -401,7 +453,13 @@ class Channel:
         DIFFERENT server."""
 
     def _on_timeout(self, cntl: Controller) -> None:
-        if take_call(cntl.correlation_id) is cntl:
+        # under the arbitration lock: a response-error retry swapping
+        # the correlation id must not interleave with this take — the
+        # timer blocked here resumes against the NEW id and still ends
+        # the call (the deadline is final across retries)
+        with cntl._arb_lock:
+            taken = take_call(cntl.correlation_id) is cntl
+        if taken:
             cntl.set_failed(berr.ERPCTIMEDOUT,
                             f"deadline {cntl.timeout_ms}ms exceeded")
             cntl._complete()

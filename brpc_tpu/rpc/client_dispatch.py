@@ -5,14 +5,41 @@ from __future__ import annotations
 
 from brpc_tpu.protocol.tpu_std import RpcMessage, unpack_inline_device_arrays
 from brpc_tpu.rpc import errno_codes as berr
-from brpc_tpu.rpc.controller import take_call
+from brpc_tpu.rpc.controller import address_call, take_call
 
 
 def process_response(proto, msg: RpcMessage, socket) -> None:
     cid = msg.meta.correlation_id
-    cntl = take_call(cid)
+    # take FIRST: exactly one response/timer wins the call; stale or
+    # concurrent losers never touch the controller (the versioned-id
+    # arbitration of OnVersionedRPCReturned, controller.cpp:575)
+    cntl = address_call(cid)
     if cntl is None:
         return  # stale: the call already completed (timeout/backup winner)
+    is_error = (msg.meta.HasField("response")
+                and msg.meta.response.error_code != 0)
+    if is_error:
+        code = msg.meta.response.error_code
+        text = msg.meta.response.error_text
+        channel = getattr(cntl, "_owner_channel", None)
+        if channel is not None:
+            with cntl._arb_lock:
+                if take_call(cid) is not cntl:
+                    return  # lost to a concurrent winner
+                retrying = channel._retry_taken_call(
+                    cntl, code, text, socket.remote_endpoint)
+            if retrying:
+                # re-registered under a fresh correlation id; issue the
+                # new attempt outside the lock (connects can block)
+                channel._issue_rpc(cntl)
+                return
+            cntl.responded_server = socket.remote_endpoint
+            cntl.set_failed(code, text)
+            cntl._complete()
+            return
+    with cntl._arb_lock:
+        if take_call(cid) is not cntl:
+            return  # raced with timeout/backup completion
     # record the WINNER for LB/breaker attribution: with a backup request
     # in flight, the last-selected server is not necessarily the one
     # whose response completed the call

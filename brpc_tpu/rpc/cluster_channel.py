@@ -44,6 +44,11 @@ class ClusterChannel(Channel):
 
     # ------------------------------------------------------------- naming
     def _on_servers(self, servers):
+        ns_filter = getattr(self.options, "ns_filter", None)
+        if ns_filter is not None:
+            # naming_service_filter.h Accept(): rejected servers never
+            # reach the LB (filtered at list-reset, not at pick time)
+            servers = [ep for ep in servers if ns_filter(ep)]
         self._servers = servers
         self._lb.reset_servers(servers)
         self._health.retain(servers)

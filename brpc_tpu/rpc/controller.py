@@ -85,6 +85,11 @@ class Controller:
         # guards the tried/selection handshake between a late backup
         # attempt and the completion sweep (cluster_channel)
         self._lb_lock = threading.Lock()
+        # serializes the take-and-complete / take-and-retry decisions
+        # (the reference gets this from the bthread_id lock, id.h:46):
+        # a response-error retry swaps the correlation id under this
+        # lock, so the deadline timer can never interleave with the swap
+        self._arb_lock = threading.RLock()
         self._lb_swept_n: Optional[int] = None
         self._lb_fed: list = []
         # ---- client call internals (set by Channel.call)

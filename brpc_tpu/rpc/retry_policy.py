@@ -1,0 +1,61 @@
+"""Pluggable retry decision (brpc/retry_policy.h).
+
+The reference consults a RetryPolicy in OnVersionedRPCReturned
+(controller.cpp:634) for every failed attempt — transport failures AND
+server-returned errors — so apps can widen (retry an app-specific
+status) or narrow (never retry writes) the default. Default policy
+mirrors RpcRetryPolicy::DoRetry (retry_policy.cpp:25): retry errors
+that plausibly mean "another server / another moment would succeed",
+never client-fatal ones (bad request, auth, deadline).
+"""
+
+from __future__ import annotations
+
+from brpc_tpu.rpc import errno_codes as berr
+
+
+class RetryPolicy:
+    """Subclass and override do_retry; return True to retry the attempt
+    (the controller carries error_code/error_text of the failure)."""
+
+    def do_retry(self, cntl) -> bool:
+        raise NotImplementedError
+
+
+class RpcRetryPolicy(RetryPolicy):
+    """Default: transport/availability errors retry, semantic errors
+    don't."""
+
+    RETRYABLE = frozenset({
+        berr.EFAILEDSOCKET,   # connection broke mid-call
+        berr.ECLOSE,          # peer closed
+        berr.ELOGOFF,         # server stopping: another replica may serve
+        berr.ELIMIT,          # concurrency limiter rejected: retry elsewhere
+        berr.EOVERCROWDED,    # write buffers full
+    })
+
+    def do_retry(self, cntl) -> bool:
+        return cntl.error_code in self.RETRYABLE
+
+
+_default: RetryPolicy | None = None
+
+
+def default_retry_policy() -> RetryPolicy:
+    global _default
+    if _default is None:
+        _default = RpcRetryPolicy()
+    return _default
+
+
+def resolve(policy) -> RetryPolicy:
+    """Accept a RetryPolicy, a bare callable, or None (default)."""
+    if policy is None:
+        return default_retry_policy()
+    if isinstance(policy, RetryPolicy):
+        return policy
+    if callable(policy):
+        wrapped = RetryPolicy()
+        wrapped.do_retry = lambda cntl: bool(policy(cntl))  # type: ignore
+        return wrapped
+    raise TypeError(f"not a retry policy: {policy!r}")

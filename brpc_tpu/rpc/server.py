@@ -66,7 +66,8 @@ class ServerOptions:
                  mongo_service_adaptor=None, rtmp_service=None,
                  session_local_data_factory=None,
                  session_local_data_reset=None,
-                 usercode_in_pthread: bool = False):
+                 usercode_in_pthread: bool = False,
+                 health_reporter=None):
         self.num_workers = num_workers
         self.max_concurrency = max_concurrency
         self.auth_token = auth_token
@@ -96,6 +97,10 @@ class ServerOptions:
         # run blocking sync handlers on a reserve pthread pool
         # (usercode_in_pthread + usercode_backup_pool in the reference)
         self.usercode_in_pthread = usercode_in_pthread
+        # custom /health responder (brpc/health_reporter.h): callable
+        # (server) -> bytes|str|(status:int, content_type:str, body) —
+        # lets apps gate readiness on their own state
+        self.health_reporter = health_reporter
 
 
 class Server:

@@ -1,0 +1,171 @@
+"""RetryPolicy / NamingServiceFilter / HealthReporter — the pluggable
+decision hooks (retry_policy.h, naming_service_filter.h,
+health_reporter.h)."""
+
+import threading
+import urllib.request
+
+from brpc_tpu.rpc import (Channel, ChannelOptions, Controller, Server,
+                          ServerOptions, Service)
+from brpc_tpu.rpc import errno_codes as berr
+from brpc_tpu.rpc.retry_policy import RpcRetryPolicy, default_retry_policy
+
+
+def _flaky_server(name, fail_first_n, code=berr.ELIMIT):
+    """Echo server whose handler fails the first N calls with `code`."""
+    server = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("F")
+    calls = {"n": 0}
+
+    @svc.method()
+    def Echo(cntl, request):
+        calls["n"] += 1
+        if calls["n"] <= fail_first_n:
+            cntl.set_failed(code, "induced")
+            return b""
+        return request
+
+    server.add_service(svc)
+    ep = server.start(f"mem://{name}")
+    return server, ep, calls
+
+
+class TestDefaultPolicy:
+    def test_retryable_set(self):
+        p = default_retry_policy()
+        c = Controller()
+        for code, want in ((berr.ELIMIT, True), (berr.ELOGOFF, True),
+                           (berr.EFAILEDSOCKET, True),
+                           (berr.EREQUEST, False), (berr.ERPCAUTH, False),
+                           (berr.EINTERNAL, False), (0, False)):
+            c.error_code = code
+            assert p.do_retry(c) is want, code
+
+    def test_server_error_retried_until_success(self):
+        server, ep, calls = _flaky_server("rp1", fail_first_n=2)
+        try:
+            ch = Channel(str(ep), ChannelOptions(timeout_ms=5000,
+                                                 max_retry=3))
+            cntl = ch.call_sync("F", "Echo", b"payload")
+            assert not cntl.failed(), cntl.error_text
+            assert cntl.response_payload.to_bytes() == b"payload"
+            assert calls["n"] == 3  # 2 failures + 1 success
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_non_retryable_server_error_fails_immediately(self):
+        server, ep, calls = _flaky_server("rp2", fail_first_n=5,
+                                          code=berr.EREQUEST)
+        try:
+            ch = Channel(str(ep), ChannelOptions(timeout_ms=5000,
+                                                 max_retry=3))
+            cntl = ch.call_sync("F", "Echo", b"x")
+            assert cntl.failed() and cntl.error_code == berr.EREQUEST
+            assert calls["n"] == 1  # no retries for semantic errors
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_exhausted_retries_surface_the_error(self):
+        server, ep, calls = _flaky_server("rp3", fail_first_n=50)
+        try:
+            ch = Channel(str(ep), ChannelOptions(timeout_ms=5000,
+                                                 max_retry=2))
+            cntl = ch.call_sync("F", "Echo", b"x")
+            assert cntl.failed() and cntl.error_code == berr.ELIMIT
+            assert calls["n"] == 3  # initial + 2 retries
+        finally:
+            server.stop()
+            server.join(2)
+
+
+class TestCustomPolicy:
+    def test_callable_policy_widens_retries(self):
+        server, ep, calls = _flaky_server("rp4", fail_first_n=1,
+                                          code=berr.EINTERNAL)
+        try:
+            ch = Channel(str(ep), ChannelOptions(
+                timeout_ms=5000, max_retry=3,
+                retry_policy=lambda c: c.error_code == berr.EINTERNAL))
+            cntl = ch.call_sync("F", "Echo", b"w")
+            assert not cntl.failed(), cntl.error_text
+            assert calls["n"] == 2
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_policy_object_narrows_retries(self):
+        class NeverRetry(RpcRetryPolicy):
+            def do_retry(self, cntl):
+                return False
+
+        server, ep, calls = _flaky_server("rp5", fail_first_n=1)
+        try:
+            ch = Channel(str(ep), ChannelOptions(timeout_ms=5000,
+                                                 max_retry=3,
+                                                 retry_policy=NeverRetry()))
+            cntl = ch.call_sync("F", "Echo", b"x")
+            assert cntl.failed() and cntl.error_code == berr.ELIMIT
+            assert calls["n"] == 1
+        finally:
+            server.stop()
+            server.join(2)
+
+
+class TestNamingServiceFilter:
+    def test_rejected_servers_never_picked(self):
+        from brpc_tpu.rpc.cluster_channel import ClusterChannel
+
+        good = Server(ServerOptions(enable_builtin_services=False))
+        bad = Server(ServerOptions(enable_builtin_services=False))
+        for s, tag in ((good, b"good"), (bad, b"bad")):
+            svc = Service("N")
+
+            @svc.method()
+            def Who(cntl, request, tag=tag):
+                return tag
+
+            s.add_service(svc)
+        ep_good = good.start("tcp://127.0.0.1:0")
+        ep_bad = bad.start("tcp://127.0.0.1:0")
+        try:
+            ch = ClusterChannel(
+                f"list://127.0.0.1:{ep_good.port},127.0.0.1:{ep_bad.port}",
+                "rr",
+                ChannelOptions(timeout_ms=5000,
+                               ns_filter=lambda ep: ep.port == ep_good.port))
+            seen = set()
+            for _ in range(6):
+                cntl = ch.call_sync("N", "Who", b"")
+                assert not cntl.failed(), cntl.error_text
+                seen.add(bytes(cntl.response_payload.to_bytes()))
+            assert seen == {b"good"}
+        finally:
+            good.stop(); good.join(2)
+            bad.stop(); bad.join(2)
+
+
+class TestHealthReporter:
+    def test_custom_reporter_controls_health_page(self):
+        state = {"ready": False}
+
+        def reporter(server):
+            return (200, "text/plain", b"ready") if state["ready"] \
+                else (503, "text/plain", b"warming up")
+
+        server = Server(ServerOptions(health_reporter=reporter))
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            url = f"http://127.0.0.1:{ep.port}/health"
+            try:
+                urllib.request.urlopen(url, timeout=5)
+                assert False, "expected 503"
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+            state["ready"] = True
+            body = urllib.request.urlopen(url, timeout=5).read()
+            assert body == b"ready"
+        finally:
+            server.stop()
+            server.join(2)

@@ -187,8 +187,12 @@ class TestErrors:
         ep = server.start(f"mem://limit-{next(_name_seq)}")
         try:
             # separate channels = separate sockets, so requests genuinely
-            # overlap (one socket serializes staggered in-place processing)
-            chs = [Channel(str(ep), ChannelOptions(timeout_ms=2000))
+            # overlap (one socket serializes staggered in-place
+            # processing). max_retry=0: the default RetryPolicy retries
+            # ELIMIT (as the reference does) and would mask the
+            # rejection this test asserts on
+            chs = [Channel(str(ep), ChannelOptions(timeout_ms=2000,
+                                                   max_retry=0))
                    for _ in range(3)]
             cntls = [ch.call("EchoService", "Slow", b"x") for ch in chs]
             [c.join(5) for c in cntls]
