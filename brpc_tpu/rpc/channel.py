@@ -386,8 +386,14 @@ class Channel:
                           if sock is not None else None)
 
     def _retry_policy(self):
-        from brpc_tpu.rpc.retry_policy import resolve
-        return resolve(self.options.retry_policy)
+        # resolved once: the policy is fixed at channel construction and
+        # this sits on the per-failure hot path
+        cached = getattr(self, "_retry_policy_cached", None)
+        if cached is None:
+            from brpc_tpu.rpc.retry_policy import resolve
+            cached = self._retry_policy_cached = resolve(
+                self.options.retry_policy)
+        return cached
 
     def _maybe_retry(self, cntl: Controller, code: int, text: str,
                      failed_ep=None) -> None:
@@ -411,20 +417,20 @@ class Channel:
             cntl._complete()
 
     def _policy_allows(self, cntl: Controller, code: int, text: str) -> bool:
-        """Consult the retry policy with the failure visible on the
-        controller (retry_policy.h's DoRetry contract), restoring the
-        controller's error state for the re-issue on a yes."""
-        prev = (cntl.error_code, cntl.error_text)
-        cntl.error_code, cntl.error_text = code, text
+        """Consult the retry policy with the failure visible through a
+        READ-ONLY view (retry_policy.h's DoRetry contract takes a const
+        Controller*): the real controller is never mutated, so this can
+        run without cntl._arb_lock — mutating error_code in place here
+        raced a concurrent timeout completion and could restore a
+        completed call's error state to OK (a silent false success)."""
+        view = _PolicyView(cntl, code, text)
         try:
-            return bool(self._retry_policy().do_retry(cntl))
+            return bool(self._retry_policy().do_retry(view))
         except Exception:
             return False  # a broken policy must not loop retries
-        finally:
-            cntl.error_code, cntl.error_text = prev
 
     def _retry_taken_call(self, cntl: Controller, code: int, text: str,
-                          failed_ep=None) -> bool:
+                          failed_ep=None, allow: Optional[bool] = None) -> bool:
         """Server-returned error on a call the caller has already WON
         via take_call: if policy + budget allow, re-register the
         controller under a FRESH correlation id (the analog of the
@@ -433,11 +439,14 @@ class Channel:
         was launched; False means the caller completes the controller.
 
         Must be called with cntl._arb_lock held by the caller along
-        with its take_call, so the deadline timer can't interleave:
-        a timer firing during the id swap blocks on the lock, then
-        finds the NEW id and completes the call with ERPCTIMEDOUT."""
-        if cntl.current_try >= cntl.max_retry or \
-                not self._policy_allows(cntl, code, text):
+        with its take_call, so the deadline timer can't interleave: a
+        timer firing during the id swap blocks on the lock, then finds
+        the NEW id and completes the call with ERPCTIMEDOUT. Pass the
+        policy verdict via ``allow`` (computed BEFORE the lock) so user
+        policy code never runs on the timer thread's critical path."""
+        if allow is None:
+            allow = self._policy_allows(cntl, code, text)
+        if cntl.current_try >= cntl.max_retry or not allow:
             return False
         cntl.current_try += 1
         self._on_attempt_failed(cntl, code, text, failed_ep)
@@ -471,6 +480,29 @@ class Channel:
             return
         cntl.used_backup = True
         self._issue_rpc(cntl)
+
+
+class _PolicyView:
+    """Read-only controller facade handed to RetryPolicy.do_retry: the
+    attempt's error is visible, every other attribute proxies to the
+    real controller, and writes are rejected — so policies cannot race
+    the completion paths."""
+
+    __slots__ = ("_cntl", "error_code", "error_text")
+
+    def __init__(self, cntl, code: int, text: str):
+        object.__setattr__(self, "_cntl", cntl)
+        object.__setattr__(self, "error_code", code)
+        object.__setattr__(self, "error_text", text)
+
+    def failed(self) -> bool:
+        return self.error_code != 0
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_cntl"), name)
+
+    def __setattr__(self, name, value):
+        raise AttributeError("retry policies see a read-only controller")
 
 
 def _copy_buf(buf: IOBuf) -> IOBuf:

@@ -23,11 +23,15 @@ def process_response(proto, msg: RpcMessage, socket) -> None:
         text = msg.meta.response.error_text
         channel = getattr(cntl, "_owner_channel", None)
         if channel is not None:
+            # policy consult BEFORE the lock: user policy code must not
+            # run while the process-wide timer thread can block on
+            # cntl._arb_lock in _on_timeout
+            allow = channel._policy_allows(cntl, code, text)
             with cntl._arb_lock:
                 if take_call(cid) is not cntl:
                     return  # lost to a concurrent winner
                 retrying = channel._retry_taken_call(
-                    cntl, code, text, socket.remote_endpoint)
+                    cntl, code, text, socket.remote_endpoint, allow=allow)
             if retrying:
                 # re-registered under a fresh correlation id; issue the
                 # new attempt outside the lock (connects can block)

@@ -137,6 +137,131 @@ class RemoteFileNamingService(NamingService):
             await sleep(self.interval_s)
 
 
+def _http_get(hostport: str, path: str, timeout: float = 3.0):
+    """GET host:port/path -> (status, body bytes) or (0, b"") on any
+    transport-level failure — including http.client.HTTPException
+    (BadStatusLine / IncompleteRead on a registry restarting
+    mid-response), which must not kill the polling fiber. Shared by the
+    registry-polling naming services."""
+    import http.client
+
+    host, _, port = hostport.partition(":")
+    try:
+        conn = http.client.HTTPConnection(host, int(port or 80),
+                                          timeout=timeout)
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        conn.close()
+        return resp.status, body
+    except (OSError, ValueError, http.client.HTTPException):
+        return 0, b""
+
+
+class _RegistryNamingService(NamingService):
+    """Shared loop for HTTP-registry pollers (consul/nacos/discovery —
+    the reference's policy/*_naming_service.cpp family): GET the
+    registry path, parse to endpoints, push on change; transport
+    failures and malformed payloads keep the last good list. Subclasses
+    supply ``path(name)`` and ``parse(body, name) -> eps | None``."""
+
+    interval_s = 2.0
+
+    def path(self, name: str) -> str:
+        raise NotImplementedError
+
+    def parse(self, body: bytes, name: str):
+        raise NotImplementedError
+
+    async def run(self, param, actions, stop_event):
+        hostport, _, name = param.partition("/")
+        last = None
+        while not stop_event.is_set():
+            status, body = _http_get(hostport, self.path(name))
+            if status == 200:
+                try:
+                    eps = self.parse(body, name)
+                except (ValueError, TypeError, KeyError):
+                    eps = None   # malformed payload: keep last good list
+                if eps is not None and eps != last:
+                    last = eps
+                    actions.reset_servers(eps)
+            await sleep(self.interval_s)
+
+
+class ConsulNamingService(_RegistryNamingService):
+    """consul://agent-host:port/service-name — polls the Consul health
+    API (policy/consul_naming_service.cpp): only passing instances are
+    listed; Service.Address falls back to Node.Address when empty."""
+
+    def path(self, name):
+        from urllib.parse import quote
+        return f"/v1/health/service/{quote(name)}?stale&passing"
+
+    def parse(self, body, name):
+        import json as _json
+        eps = []
+        for entry in _json.loads(body):
+            svc = entry.get("Service", {})
+            addr = svc.get("Address") or \
+                entry.get("Node", {}).get("Address")
+            port = svc.get("Port")
+            if addr and port:
+                eps.append(EndPoint("tcp", addr, int(port)))
+        return eps
+
+
+class NacosNamingService(_RegistryNamingService):
+    """nacos://server-host:port/serviceName — polls the Nacos instance
+    list (policy/nacos_naming_service.cpp): healthy+enabled instances
+    only; weight rides the endpoint extras for weighted LBs."""
+
+    def path(self, name):
+        from urllib.parse import quote
+        return f"/nacos/v1/ns/instance/list?serviceName={quote(name)}"
+
+    def parse(self, body, name):
+        import json as _json
+        eps = []
+        for h in _json.loads(body).get("hosts", []):
+            if not (h.get("healthy", True) and h.get("enabled", True)):
+                continue
+            ep = EndPoint("tcp", h["ip"], int(h["port"]))
+            w = h.get("weight")
+            if w is not None:
+                ep = ep.with_extras(weight=w)
+            eps.append(ep)
+        return eps
+
+
+class DiscoveryNamingService(_RegistryNamingService):
+    """discovery://server-host:port/appid — polls a bilibili-discovery
+    registry (policy/discovery_naming_service.cpp): instances carry
+    scheme-prefixed addrs; status==1 (UP) only."""
+
+    def path(self, name):
+        from urllib.parse import quote
+        return f"/discovery/fetchs?appid={quote(name)}&status=1"
+
+    def parse(self, body, name):
+        import json as _json
+        doc = _json.loads(body)
+        if doc.get("code", 0) != 0:
+            return None
+        eps = []
+        app = doc.get("data", {}).get(name, {})
+        for inst in app.get("instances", []):
+            if inst.get("status", 1) != 1:
+                continue
+            for addr in inst.get("addrs", []):
+                _, _, hp = addr.partition("://")
+                host, _, port = hp.partition(":")
+                if host and port:
+                    eps.append(EndPoint("tcp", host, int(port)))
+                    break   # one addr per instance
+        return eps
+
+
 _registry: Dict[str, NamingService] = {}
 
 
@@ -152,6 +277,9 @@ def get_naming_service(scheme: str) -> NamingService:
             "dns": DnsNamingService(),
             "mesh": MeshNamingService(),
             "remotefile": RemoteFileNamingService(),
+            "consul": ConsulNamingService(),
+            "nacos": NacosNamingService(),
+            "discovery": DiscoveryNamingService(),
         })
     ns = _registry.get(scheme)
     if ns is None:

@@ -1,0 +1,161 @@
+"""Registry-backed naming services — consul / nacos / discovery
+(policy/consul_naming_service.cpp, nacos_naming_service.cpp,
+discovery_naming_service.cpp) — against in-process fake registries,
+the reference's mocked-NamingServiceActions strategy (SURVEY.md §4)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from brpc_tpu.rpc.naming import NamingServiceThread
+
+
+class _FakeRegistry:
+    """One HTTP server serving whatever JSON the test loads per path."""
+
+    def __init__(self):
+        registry = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                doc = registry.responses.get(path)
+                if doc is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.responses = {}
+        self.httpd = HTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _wait_servers(nt, want, timeout=8.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = {(ep.host, ep.port) for ep in nt.servers()}
+        if got == want:
+            return got
+        time.sleep(0.05)
+    return {(ep.host, ep.port) for ep in nt.servers()}
+
+
+class TestConsul:
+    def test_passing_instances_listed_with_node_fallback(self):
+        reg = _FakeRegistry()
+        reg.responses["/v1/health/service/echo"] = [
+            {"Service": {"Address": "10.0.0.1", "Port": 8001}},
+            # empty Service.Address -> Node.Address fallback
+            {"Service": {"Address": "", "Port": 8002},
+             "Node": {"Address": "10.0.0.2"}},
+        ]
+        nt = NamingServiceThread(f"consul://127.0.0.1:{reg.port}/echo")
+        try:
+            assert nt.wait_first_update(5.0)
+            got = _wait_servers(nt, {("10.0.0.1", 8001), ("10.0.0.2", 8002)})
+            assert got == {("10.0.0.1", 8001), ("10.0.0.2", 8002)}
+            # registry update propagates on the next poll
+            reg.responses["/v1/health/service/echo"] = [
+                {"Service": {"Address": "10.0.0.3", "Port": 8003}},
+            ]
+            got = _wait_servers(nt, {("10.0.0.3", 8003)})
+            assert got == {("10.0.0.3", 8003)}
+        finally:
+            nt.stop()
+            reg.close()
+
+
+class TestNacos:
+    def test_only_healthy_enabled_hosts_with_weight(self):
+        reg = _FakeRegistry()
+        reg.responses["/nacos/v1/ns/instance/list"] = {
+            "hosts": [
+                {"ip": "10.1.0.1", "port": 9001, "healthy": True,
+                 "enabled": True, "weight": 3.0},
+                {"ip": "10.1.0.2", "port": 9002, "healthy": False,
+                 "enabled": True},
+                {"ip": "10.1.0.3", "port": 9003, "healthy": True,
+                 "enabled": False},
+            ]
+        }
+        nt = NamingServiceThread(f"nacos://127.0.0.1:{reg.port}/svc")
+        try:
+            assert nt.wait_first_update(5.0)
+            got = _wait_servers(nt, {("10.1.0.1", 9001)})
+            assert got == {("10.1.0.1", 9001)}
+            eps = nt.servers()
+            assert eps[0].extra("weight") == "3.0"
+        finally:
+            nt.stop()
+            reg.close()
+
+
+class TestDiscovery:
+    def test_up_instances_first_addr(self):
+        reg = _FakeRegistry()
+        reg.responses["/discovery/fetchs"] = {
+            "code": 0,
+            "data": {"my.app": {"instances": [
+                {"addrs": ["grpc://10.2.0.1:7001", "http://10.2.0.1:7101"],
+                 "status": 1},
+                {"addrs": ["grpc://10.2.0.2:7002"], "status": 3},  # down
+            ]}},
+        }
+        nt = NamingServiceThread(f"discovery://127.0.0.1:{reg.port}/my.app")
+        try:
+            assert nt.wait_first_update(5.0)
+            got = _wait_servers(nt, {("10.2.0.1", 7001)})
+            assert got == {("10.2.0.1", 7001)}
+        finally:
+            nt.stop()
+            reg.close()
+
+
+class TestEndToEnd:
+    def test_cluster_channel_over_consul(self):
+        """Full slice: a real echo server registered in a fake consul,
+        resolved and called through a ClusterChannel."""
+        from brpc_tpu.rpc import (Channel, ChannelOptions, Server,
+                                  ServerOptions, Service)
+        from brpc_tpu.rpc.cluster_channel import ClusterChannel
+
+        server = Server(ServerOptions(enable_builtin_services=False))
+        svc = Service("E")
+
+        @svc.method()
+        def Echo(cntl, request):
+            return request
+
+        server.add_service(svc)
+        ep = server.start("tcp://127.0.0.1:0")
+        reg = _FakeRegistry()
+        reg.responses["/v1/health/service/echo"] = [
+            {"Service": {"Address": "127.0.0.1", "Port": ep.port}},
+        ]
+        try:
+            ch = ClusterChannel(f"consul://127.0.0.1:{reg.port}/echo", "rr",
+                                ChannelOptions(timeout_ms=5000))
+            cntl = ch.call_sync("E", "Echo", b"via-consul")
+            assert not cntl.failed(), cntl.error_text
+            assert cntl.response_payload.to_bytes() == b"via-consul"
+        finally:
+            server.stop()
+            server.join(2)
+            reg.close()
