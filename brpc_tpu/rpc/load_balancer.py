@@ -111,6 +111,37 @@ class WeightedRoundRobinLB(_SnapshotLB):
             return servers[self._idx]
 
 
+class WeightedRandomLB(_SnapshotLB):
+    """wr — weight-proportional random pick
+    (policy/weighted_randomized_load_balancer.cpp); weight from
+    endpoint extra 'w' (default 1), matching wrr's convention."""
+
+    name = "wr"
+
+    def __init__(self):
+        super().__init__()
+        # (server, weight) pairs published as ONE tuple so a reset can
+        # never mispair weights with a concurrently-read server list
+        self._weighted: Tuple[Tuple[EndPoint, int], ...] = ()
+
+    def _on_reset(self, snapshot):
+        self._weighted = tuple(
+            (s, max(1, int(s.extra("w", "1") or "1"))) for s in snapshot)
+
+    def select_server(self, exclude=None, request_key=None):
+        pool = [(s, w) for s, w in self._weighted
+                if not exclude or s not in exclude]
+        if not pool:
+            return None
+        total = sum(w for _, w in pool)
+        pick = fast_rand_less_than(total)
+        for s, w in pool:
+            pick -= w
+            if pick < 0:
+                return s
+        return pool[-1][0]
+
+
 class ConsistentHashLB(_SnapshotLB):
     """c_murmurhash-style ketama ring (policy/hasher.cpp) — 100 virtual
     nodes per server; request_key picks the ring position."""
@@ -312,6 +343,7 @@ _factories = {
     "wrr": WeightedRoundRobinLB,
     "c_hash": ConsistentHashLB,
     "c_murmurhash": MurmurHashLB,
+    "wr": WeightedRandomLB,
     "la": LocalityAwareLB,
 }
 

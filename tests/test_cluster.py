@@ -454,3 +454,33 @@ class TestBackupRequestLaIntegration:
             for server, _ in servers:
                 server.stop()
                 server.join(2)
+
+
+class TestWeightedRandom:
+    def test_weight_proportional_distribution(self):
+        from brpc_tpu.butil.endpoint import str2endpoint
+        from brpc_tpu.rpc.load_balancer import new_load_balancer
+
+        lb = new_load_balancer("wr")
+        heavy = str2endpoint("tcp://10.0.0.1:1#w=9")
+        light = str2endpoint("tcp://10.0.0.2:1#w=1")
+        lb.reset_servers([heavy, light])
+        picks = {heavy: 0, light: 0}
+        for _ in range(2000):
+            picks[lb.select_server()] += 1
+        # 9:1 weights — loose bounds, this must not flake
+        assert picks[heavy] > picks[light] * 4
+        assert picks[light] > 50
+
+    def test_exclusion(self):
+        from brpc_tpu.butil.endpoint import str2endpoint
+        from brpc_tpu.rpc.load_balancer import new_load_balancer
+
+        lb = new_load_balancer("wr")
+        a = str2endpoint("tcp://10.0.0.1:1#w=5")
+        b = str2endpoint("tcp://10.0.0.2:1")
+        lb.reset_servers([a, b])
+        for _ in range(50):
+            assert lb.select_server(exclude={a}) == b
+        lb.reset_servers([])
+        assert lb.select_server() is None

@@ -1,0 +1,179 @@
+"""Sustained concurrency hammers for the fiber runtime — the
+reference's bthread stress style (test/bthread_butex_unittest.cpp,
+bthread_mutex_unittest.cpp multi-thread loops, timer_thread_unittest):
+many pthreads x many fibers pounding one primitive, asserting exact
+invariants afterwards. Runtimes kept to a few seconds total."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.fiber import (
+    Butex, ExecutionQueue, FiberMutex, TaskControl, TimerThread, yield_now,
+)
+
+
+@pytest.fixture()
+def ctrl():
+    c = TaskControl(concurrency=6, name="stress")
+    yield c
+    c.stop_and_join()
+
+
+class TestMutexHammer:
+    def test_fibers_and_pthreads_share_one_mutex(self, ctrl):
+        """Mixed fiber + pthread holders; the count must come out exact
+        (mutex.cpp's cross-domain locking contract)."""
+        m = FiberMutex()
+        counter = {"v": 0}
+        N_FIBERS, N_THREADS, ITERS = 8, 3, 300
+
+        async def fiber_worker():
+            for _ in range(ITERS):
+                async with m:
+                    v = counter["v"]
+                    await yield_now()
+                    counter["v"] = v + 1
+
+        def pthread_worker():
+            for _ in range(ITERS):
+                m.lock_pthread()
+                try:
+                    v = counter["v"]
+                    time.sleep(0)  # encourage preemption inside the CS
+                    counter["v"] = v + 1
+                finally:
+                    m.unlock()
+
+        fs = [ctrl.spawn(fiber_worker) for _ in range(N_FIBERS)]
+        ts = [threading.Thread(target=pthread_worker)
+              for _ in range(N_THREADS)]
+        [t.start() for t in ts]
+        assert all(f.join(60) for f in fs)
+        [t.join(60) for t in ts]
+        for f in fs:
+            f.value()  # surfaces in-fiber exceptions
+        assert counter["v"] == (N_FIBERS + N_THREADS) * ITERS
+
+
+class TestButexWakeStorm:
+    def test_no_lost_wakeups_under_storm(self, ctrl):
+        """Waves of fiber waiters vs a storm of waker threads doing
+        bump+wake_all; every waiter must eventually release (the no-
+        lost-wakeup property butex.cpp's versioned waiters provide)."""
+        b = Butex(0)
+        released = {"n": 0}
+        lock = threading.Lock()
+        N_WAITERS = 60
+
+        async def waiter():
+            seen = b.value
+            r = await b.wait(expected=seen, timeout_s=10)
+            assert r in ("ok", "value_changed")
+            with lock:
+                released["n"] += 1
+
+        fs = [ctrl.spawn(waiter) for _ in range(N_WAITERS)]
+        stop = threading.Event()
+
+        def storm():
+            while not stop.is_set():
+                b.fetch_add(1)
+                b.wake_all()
+                time.sleep(0.001)
+
+        ts = [threading.Thread(target=storm) for _ in range(3)]
+        [t.start() for t in ts]
+        ok = all(f.join(30) for f in fs)
+        stop.set()
+        [t.join(5) for t in ts]
+        assert ok, f"waiters stuck: released {released['n']}/{N_WAITERS}"
+        assert released["n"] == N_WAITERS
+
+
+class TestExecutionQueueFlood:
+    def test_flood_from_many_threads_keeps_per_producer_fifo(self, ctrl):
+        seen = []
+        q = ExecutionQueue(lambda ts: seen.extend(ts), control=ctrl)
+        N_PRODUCERS, N_ITEMS = 6, 1500
+
+        def producer(tag):
+            for i in range(N_ITEMS):
+                assert q.execute((tag, i))
+
+        ts = [threading.Thread(target=producer, args=(t,))
+              for t in range(N_PRODUCERS)]
+        [t.start() for t in ts]
+        [t.join(60) for t in ts]
+        assert q.join(30)
+        assert len(seen) == N_PRODUCERS * N_ITEMS
+        for tag in range(N_PRODUCERS):
+            mine = [i for (t, i) in seen if t == tag]
+            assert mine == list(range(N_ITEMS))
+
+
+class TestTimerStorm:
+    def test_many_timers_fire_cancelled_never_do(self):
+        """500 timers at random small delays; half cancelled before
+        their deadline must never fire, the rest must all fire
+        (timer_thread.cpp's hashed-bucket schedule/unschedule)."""
+        tt = TimerThread(name="stress_timer")
+        fired = set()
+        lock = threading.Lock()
+        rng = random.Random(42)
+        try:
+            ids = []
+            for i in range(500):
+                delay = 0.3 + rng.random() * 0.5
+
+                def cb(i=i):
+                    with lock:
+                        fired.add(i)
+
+                ids.append((i, tt.schedule_after(delay, cb)))
+            cancelled = set()
+            for i, tid in ids[::2]:
+                tt.unschedule(tid)   # cancel before the earliest deadline
+                cancelled.add(i)
+            deadline = time.time() + 4
+            expected = {i for i, _ in ids} - cancelled
+            while time.time() < deadline:
+                with lock:
+                    if fired >= expected:
+                        break
+                time.sleep(0.02)
+            with lock:
+                assert fired == expected, (
+                    f"missing {len(expected - fired)}, "
+                    f"cancelled-but-fired {len(fired & cancelled)}")
+        finally:
+            tt.stop()
+
+
+class TestSpawnChurn:
+    def test_thousands_of_short_fibers_from_many_threads(self, ctrl):
+        done = {"n": 0}
+        lock = threading.Lock()
+        N_THREADS, N_FIBERS = 4, 800
+
+        async def tiny():
+            await yield_now()
+            with lock:
+                done["n"] += 1
+
+        def spawner():
+            for _ in range(N_FIBERS):
+                ctrl.spawn(tiny)
+
+        ts = [threading.Thread(target=spawner) for _ in range(N_THREADS)]
+        [t.start() for t in ts]
+        [t.join(30) for t in ts]
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with lock:
+                if done["n"] == N_THREADS * N_FIBERS:
+                    break
+            time.sleep(0.02)
+        assert done["n"] == N_THREADS * N_FIBERS
