@@ -44,6 +44,9 @@ OP_VERSION = 0x0B
 OP_APPEND = 0x0E
 OP_PREPEND = 0x0F
 OP_TOUCH = 0x1C
+OP_SASL_LIST_MECHS = 0x20
+OP_SASL_AUTH = 0x21
+OP_SASL_STEP = 0x22
 
 # status codes
 STATUS_OK = 0x0000
@@ -53,6 +56,8 @@ STATUS_VALUE_TOO_LARGE = 0x0003
 STATUS_INVALID_ARGUMENTS = 0x0004
 STATUS_ITEM_NOT_STORED = 0x0005
 STATUS_NON_NUMERIC = 0x0006
+STATUS_AUTH_ERROR = 0x0020
+STATUS_AUTH_CONTINUE = 0x0021
 
 _MAX_BODY = 64 << 20
 
@@ -158,10 +163,46 @@ class MemcacheClient(PipelinedClient):
     user_data_key = "memcache_client"
 
     def __init__(self, address: str | EndPoint, timeout_s: float = 5.0,
-                 control: Optional[TaskControl] = None):
+                 control: Optional[TaskControl] = None,
+                 username: Optional[str] = None,
+                 password: Optional[str] = None):
+        """username/password enable SASL PLAIN authentication on every
+        fresh connection (the couchbase_authenticator.cpp role: the
+        reference authenticates memcache/couchbase connections with a
+        SASL PLAIN token before user commands)."""
         super().__init__(address, ensure_registered(), timeout_s=timeout_s,
                          control=control)
+        if password is not None and username is None:
+            # a silently-dropped password would leave the connection
+            # unauthenticated with no hint why commands fail
+            raise ValueError("memcache SASL: password given without "
+                             "username")
         self._opaque = itertools.count(1)
+        self._username = username
+        self._password = password or ""
+        self._sasl_opaque: Optional[int] = None
+
+    # ----------------------------------------------------------- sasl auth
+    def _hello_commands(self):
+        if self._username is None:
+            return []
+        token = b"\x00" + self._username.encode() + \
+            b"\x00" + self._password.encode()
+        self._sasl_opaque = next(self._opaque)
+        return [pack_request(OP_SASL_AUTH, b"PLAIN", token,
+                             opaque=self._sasl_opaque)]
+
+    def _check_hello_reply(self, reply) -> None:
+        # strict: the hello reply must BE the SASL reply (same desync
+        # tripwire as _call) — a stray frame here must not be mistaken
+        # for a successful authentication
+        if reply.opcode != OP_SASL_AUTH or reply.opaque != self._sasl_opaque:
+            raise MemcacheError(-1, "sasl reply desync "
+                                f"(opcode 0x{reply.opcode:02x})")
+        if reply.status != STATUS_OK:
+            raise MemcacheError(
+                reply.status,
+                reply.value.decode("latin1", "replace") or "auth failure")
 
     # ------------------------------------------------------------ helpers
     def _call(self, opcode: int, key: bytes = b"", value: bytes = b"",

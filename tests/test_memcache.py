@@ -62,7 +62,21 @@ class _Handler(socketserver.BaseRequestHandler):
 
     def _dispatch(self, store, opcode, extras, key, value, opaque, cas):
         with store.lock:
-            if opcode == mc.OP_GET:
+            want = getattr(self.server, "sasl_plain", None)
+            if opcode == mc.OP_SASL_AUTH:
+                # PLAIN: \0user\0pass against the server's expectation
+                if key != b"PLAIN" or (want is not None and value != want):
+                    self._reply(opcode, opaque, mc.STATUS_AUTH_ERROR,
+                                value=b"Auth failure")
+                else:
+                    self.authed = True
+                    self._reply(opcode, opaque, value=b"Authenticated")
+            elif want is not None and not getattr(self, "authed", False):
+                # auth-gated server: a client that skipped/broke the
+                # handshake must not be served
+                self._reply(opcode, opaque, mc.STATUS_AUTH_ERROR,
+                            value=b"Unauthenticated")
+            elif opcode == mc.OP_GET:
                 if key not in store.data:
                     self._reply(opcode, opaque, mc.STATUS_KEY_NOT_FOUND)
                     return
@@ -253,3 +267,59 @@ def test_concurrent_shared_client(client):
     for t in threads:
         t.join(30)
     assert not errs
+
+
+# ------------------------------------------------------------- sasl auth
+
+class TestSaslAuth:
+    """SASL PLAIN on connect — the couchbase_authenticator.cpp role."""
+
+    def _server(self, sasl_plain):
+        server = _MockMemcached()
+        server.sasl_plain = sasl_plain
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        return server
+
+    def test_good_credentials_then_commands_work(self):
+        server = self._server(b"\x00bucket\x00sekrit")
+        host, port = server.server_address
+        c = mc.MemcacheClient(f"tcp://{host}:{port}",
+                              username="bucket", password="sekrit")
+        try:
+            c.set("k", "v")
+            assert c.get("k").value == b"v"
+        finally:
+            c.close()
+            server.shutdown()
+            server.server_close()
+
+    def test_bad_credentials_fail_the_connection(self):
+        server = self._server(b"\x00bucket\x00sekrit")
+        host, port = server.server_address
+        c = mc.MemcacheClient(f"tcp://{host}:{port}",
+                              username="bucket", password="wrong")
+        try:
+            with pytest.raises(mc.MemcacheError) as ei:
+                c.set("k", "v")
+            assert ei.value.status == mc.STATUS_AUTH_ERROR
+        finally:
+            c.close()
+            server.shutdown()
+            server.server_close()
+
+    def test_no_credentials_still_plain(self):
+        server = self._server(None)
+        host, port = server.server_address
+        c = mc.MemcacheClient(f"tcp://{host}:{port}")
+        try:
+            c.set("k2", "v2")
+            assert c.get("k2").value == b"v2"
+        finally:
+            c.close()
+            server.shutdown()
+            server.server_close()
+
+    def test_password_without_username_rejected(self):
+        with pytest.raises(ValueError):
+            mc.MemcacheClient("tcp://127.0.0.1:1", password="lonely")
