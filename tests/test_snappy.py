@@ -200,3 +200,57 @@ class TestExpansionBound:
                 i += 2
             else:
                 raise AssertionError("encoder emitted a copy4 element")
+
+
+class TestDecoderFuzz:
+    """Arbitrary bytes at both decoders must raise SnappyError/ValueError
+    only — never crash, hang, or allocate absurdly (the wire decompressor
+    faces attacker-controlled input)."""
+
+    def test_random_bytes_never_crash(self):
+        import random
+
+        from brpc_tpu import native
+
+        rng = random.Random(0x5A49)
+        native_up = native.available()
+        for trial in range(400):
+            n = rng.randrange(0, 200)
+            data = bytes(rng.randrange(256) for _ in range(n))
+            try:
+                out = sc.decompress(data)
+            except sc.SnappyError:
+                out = None
+            if native_up:
+                try:
+                    nout = native.snappy_decompress(data)
+                except ValueError:
+                    nout = None
+                # both decoders must agree: same bytes or both reject
+                assert nout == out, (trial, data.hex())
+
+    def test_mutated_valid_streams(self):
+        """Bit-flip corruption of valid streams: decode must either
+        reject or produce SOMETHING without crashing; decoders agree."""
+        import random
+
+        from brpc_tpu import native
+
+        rng = random.Random(0xC0DE)
+        base = sc.compress(b"valid snappy stream content " * 30)
+        native_up = native.available()
+        for _ in range(300):
+            data = bytearray(base)
+            for _ in range(rng.randrange(1, 4)):
+                data[rng.randrange(len(data))] ^= 1 << rng.randrange(8)
+            data = bytes(data)
+            try:
+                out = sc.decompress(data)
+            except sc.SnappyError:
+                out = None
+            if native_up:
+                try:
+                    nout = native.snappy_decompress(data)
+                except ValueError:
+                    nout = None
+                assert nout == out
