@@ -41,14 +41,19 @@ _wake_rec_lock = threading.Lock()
 
 def _wake_recorder():
     """LatencyRecorder for wake-to-run latency, exposed lazily as
-    fiber_wake_* (the import is deferred to dodge the bvar->fiber
-    circular import at module load)."""
+    fiber_wake (the import is deferred to dodge the bvar->fiber
+    circular import at module load). Re-exposes if the registry was
+    cleared (bvar's unexpose_all test helper) — this recorder is a
+    process-global singleton, so a dropped exposure would otherwise be
+    permanent."""
     global _wake_rec
     if _wake_rec is None:
         with _wake_rec_lock:
             if _wake_rec is None:
                 from brpc_tpu.bvar.latency_recorder import LatencyRecorder
                 _wake_rec = LatencyRecorder().expose("fiber_wake")
+    if getattr(_wake_rec, "_name", None) != "fiber_wake":
+        _wake_rec.expose("fiber_wake")
     return _wake_rec
 
 FIBER_STATE_READY = 0
@@ -203,6 +208,7 @@ class TaskGroup:
         self.bound_rq: Deque[Fiber] = deque()   # group-pinned fibers (fork's _bound_rq)
         self.nsteals = 0
         self.nswitches = 0
+        self.nwakes = 0
 
     # owner-side pop order: bound first (pinned work can't run elsewhere),
     # then local LIFO for cache locality, then remote FIFO
@@ -375,12 +381,18 @@ class TaskControl:
         fiber.state = FIBER_STATE_RUNNING
         ready_ns = fiber._ready_ns
         group.nswitches += 1
-        if ready_ns and (group.nswitches & 0xF) == 0:
+        if ready_ns:
             # wake-to-run latency: schedule() -> this step (the p99 the
             # event-driven wake path is accountable for; /vars
-            # fiber_wake — sampled 1-in-16, record() costs ~3µs)
-            _wake_recorder().record(
-                (time.perf_counter_ns() - ready_ns) / 1e3)
+            # fiber_wake). Sampled 1-in-16 WAKES per group — counting
+            # wakes, not switches, so the sample can't systematically
+            # miss (a switch-indexed sample only fired when the 16th
+            # switch happened to be a wake), and the FIRST wake records
+            # so the recorder is visible as soon as any fiber ran.
+            group.nwakes += 1
+            if (group.nwakes & 0xF) == 1:
+                _wake_recorder().record(
+                    (time.perf_counter_ns() - ready_ns) / 1e3)
         fiber._ready_ns = 0
         try:
             token = fiber.coro.send(fiber._resume_value)
