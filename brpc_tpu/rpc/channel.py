@@ -353,6 +353,11 @@ class Channel:
         stream = getattr(cntl, "stream", None)
         if stream is not None:
             meta.stream_settings.stream_id = stream.id
+            # plain assignment, NOT bind_socket: the stream is not
+            # established yet — subscribing to this attempt's failure
+            # would let a failed first attempt permanently close a
+            # stream whose retried setup succeeds (failure semantics
+            # attach in client_dispatch once the response arrives)
             stream.socket = sock
         use_lane = (bool(cntl.request_device_arrays)
                     and sock.conn.supports_device_lane)

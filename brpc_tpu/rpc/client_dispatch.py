@@ -78,7 +78,7 @@ def _fill_response(cntl, msg: RpcMessage, socket) -> None:
         stream = getattr(cntl, "stream", None)
         if stream is not None and msg.meta.HasField("stream_settings"):
             stream.peer_id = msg.meta.stream_settings.stream_id
-            stream.socket = socket
+            stream.bind_socket(socket)
             stream._on_established()
         if msg.meta.device_payloads:
             inline = unpack_inline_device_arrays(msg)

@@ -56,6 +56,7 @@ class Stream:
         self._grant_lock = threading.Lock()
         self._recv_q = ExecutionQueue(self._deliver, name=f"stream_{self.id}")
         self._close_cbs: List[Callable] = []
+        self._close_lock = threading.Lock()
         from brpc_tpu.fiber.sync import FiberEvent
         self._established = FiberEvent()
 
@@ -86,11 +87,14 @@ class Stream:
             if self.closed or self.remote_closed:
                 return False
         while True:
+            # closed check BEFORE acquiring: closure bumps the credit
+            # word with a sentinel (so parks short-circuit) — acquiring
+            # first would "spend" sentinel credits on a dead stream
+            if self.closed or self.remote_closed:
+                return False
             v = self._credits.value
             if v > 0 and self._credits.compare_exchange(v, v - 1):
                 break
-            if self.closed or self.remote_closed:
-                return False
             r = await self._credits.wait(expected=0, timeout_s=timeout_s)
             if r == WAIT_TIMEOUT:
                 return False
@@ -141,9 +145,7 @@ class Stream:
             self._credits.fetch_add(ss.credits)
             self._credits.wake_all()
         if ss.close:
-            self.remote_closed = True
-            self._credits.wake_all()
-            self._recv_q.execute(("close", None))
+            self._remote_close_once()
             return
         if ss.frame_seq:  # DATA frame (possibly empty payload)
             self._recv_q.execute(("frame", msg))
@@ -175,17 +177,77 @@ class Stream:
             if grant and not self.closed:
                 self._send_frame(b"", None, credits=grant, data=False)
 
+    # ------------------------------------------------------ socket binding
+    def bind_socket(self, sock) -> None:
+        """Attach the ESTABLISHED stream's transport socket and
+        subscribe to its failure: a peer dying mid-stream must CLOSE
+        the stream (fire on_close, wake blocked writers) — the
+        reference fails the stream when its connection breaks
+        (stream.cpp on the socket's SetFailed path). Only called once
+        the stream is established on this socket (server accept /
+        client response) — binding on SEND attempts would let a failed
+        first attempt kill a stream whose retried setup then succeeds.
+        Idempotent per socket; a previous socket's subscription is
+        dropped so a long-lived multiplexed socket doesn't accumulate
+        dead streams."""
+        # track the SUBSCRIBED socket separately from self.socket: the
+        # send path plain-assigns self.socket before establishment, so
+        # comparing against it would skip the subscription entirely
+        prev = getattr(self, "_subscribed_sock", None)
+        if prev is sock:
+            self.socket = sock
+            return
+        if prev is not None:
+            try:
+                prev.off_failed(self._on_socket_failed)
+            except AttributeError:
+                pass
+        self.socket = sock
+        self._subscribed_sock = sock
+        sock.on_failed(self._on_socket_failed)
+
+    def _on_socket_failed(self, sock) -> None:
+        if sock is not self.socket:
+            return  # a previous attempt's socket: the stream moved on
+        self._remote_close_once()
+
+    def _remote_close_once(self) -> None:
+        """Exactly-once remote-closure path shared by the peer's close
+        frame and socket failure (they race on shutdown: close frame
+        then connection drop is the normal sequence — on_close must not
+        double-fire)."""
+        with self._close_lock:
+            if self.closed or self.remote_closed:
+                return
+            self.remote_closed = True
+        # a nonzero sentinel makes every credit park short-circuit
+        # (butex value_changed), so a writer racing this close cannot
+        # sleep out its full timeout on a dead stream
+        self._credits.fetch_add(1 << 20)
+        self._credits.wake_all()
+        self._established.set()        # unblock pre-establish waiters
+        self._recv_q.execute(("close", None))   # fire on_close callbacks
+
     # ---------------------------------------------------------------- close
     def close(self) -> None:
-        if self.closed:
-            return
-        self.closed = True
+        with self._close_lock:
+            if self.closed:
+                return
+            self.closed = True
         if self.socket is not None and self.peer_id and not self.remote_closed:
             try:
                 self._send_frame(b"", None, close=True, data=False)
             except Exception:
                 pass
+        if self.socket is not None:
+            # drop the failure subscription: a long-lived multiplexed
+            # socket must not keep dead streams reachable
+            try:
+                self.socket.off_failed(self._on_socket_failed)
+            except AttributeError:
+                pass
         _stream_pool.remove(self.id)
+        self._credits.fetch_add(1 << 20)   # short-circuit pending parks
         self._credits.wake_all()
 
     def on_close(self, cb: Callable) -> None:
@@ -216,7 +278,7 @@ def stream_accept(cntl, options: Optional[StreamOptions] = None) -> Optional[Str
         return None
     s = Stream(options)
     s.peer_id = peer_id
-    s.socket = cntl._server_socket
+    s.bind_socket(cntl._server_socket)
     s._on_established()
     cntl._accepted_stream = s
     return s

@@ -309,6 +309,14 @@ class Socket:
         else:
             self._on_failed_cbs.append(cb)
 
+    def off_failed(self, cb: Callable[["Socket"], None]) -> None:
+        """Unsubscribe a failure callback (no-op if absent): long-lived
+        multiplexed sockets must not accumulate dead subscribers."""
+        try:
+            self._on_failed_cbs.remove(cb)
+        except ValueError:
+            pass
+
 
 def create_client_socket(ep: EndPoint, on_input: Optional[Callable] = None,
                          control: Optional[TaskControl] = None) -> Socket:

@@ -195,3 +195,46 @@ class TestStreaming:
             assert cntl.response_payload.to_bytes() == b"no-stream"
         finally:
             server.stop(); server.join(2)
+
+    def test_peer_death_closes_stream(self):
+        """Server's connection dropping mid-stream must fire the
+        client's on_close and fail writes promptly — not strand readers
+        forever or leave writers to their own timeouts (the reference
+        fails streams on the socket's SetFailed path)."""
+        received = []
+        server = Server(ServerOptions(enable_builtin_services=False))
+        svc = Service("StreamService")
+
+        @svc.method()
+        def Open(cntl, request):
+            st = stream_accept(cntl, StreamOptions(
+                on_received=lambda s, m: received.append(m)))
+            assert st is not None
+            return b"accepted"
+
+        server.add_service(svc)
+        ep = server.start("tcp://127.0.0.1:0")
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(timeout_ms=5000))
+        closed = threading.Event()
+        try:
+            cntl = ch.call_sync("StreamService", "Open", b"",
+                                stream_options=StreamOptions())
+            assert not cntl.failed(), cntl.error_text
+            stream = cntl.stream
+            stream.on_close(lambda s: closed.set())
+            assert stream.write_nowait(b"frame-1")
+            # abrupt peer death: drop every server-side connection
+            for s in server.connections():
+                s.set_failed(ConnectionError("chaos: server died"))
+            assert closed.wait(5), "client never observed stream closure"
+            assert stream.remote_closed
+
+            # writers fail fast now (no 10s credit-timeout stall)
+            t0 = time.monotonic()
+            assert stream.write_nowait(b"after-death") is False
+            assert time.monotonic() - t0 < 1.0
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
