@@ -263,3 +263,59 @@ def test_huffman_padding_must_be_eos_prefix():
     # correct all-ones padding decodes fine
     byte_ok = (code << (8 - length)) | ((1 << (8 - length)) - 1)
     assert hpack.huffman_decode(bytes([byte_ok])) == b"0"
+
+
+class TestHpackFuzz:
+    """Directed decoder fuzz: arbitrary and bit-flipped header blocks
+    must raise HpackError only — never crash, hang, or blow the dynamic
+    table (attacker-controlled input on every h2 connection)."""
+
+    def test_random_blocks_never_crash(self):
+        import random
+
+        from brpc_tpu.protocol import hpack
+
+        rng = random.Random(0x4850)
+        for _ in range(500):
+            n = rng.randrange(0, 120)
+            block = bytes(rng.randrange(256) for _ in range(n))
+            dec = hpack.HpackDecoder()
+            try:
+                dec.decode(block)
+            except hpack.HpackError:
+                pass
+
+    def test_mutated_valid_blocks(self):
+        import random
+
+        from brpc_tpu.protocol import hpack
+
+        rng = random.Random(0x4851)
+        enc = hpack.HpackEncoder()
+        base = enc.encode([(":method", "POST"), (":path", "/svc/M"),
+                           ("content-type", "application/grpc"),
+                           ("x-custom-header", "value-with-data")])
+        for _ in range(400):
+            block = bytearray(base)
+            for _ in range(rng.randrange(1, 4)):
+                block[rng.randrange(len(block))] ^= 1 << rng.randrange(8)
+            dec = hpack.HpackDecoder()
+            try:
+                dec.decode(bytes(block))
+            except hpack.HpackError:
+                pass
+
+    def test_huge_table_resize_rejected_or_bounded(self):
+        """A header block demanding an enormous dynamic table must not
+        allocate it."""
+        from brpc_tpu.protocol import hpack
+
+        # dynamic table size update: 001xxxxx prefix, huge integer
+        block = bytes([0x3F, 0xFF, 0xFF, 0xFF, 0xFF, 0x7F])
+        dec = hpack.HpackDecoder()
+        try:
+            dec.decode(block)
+        except hpack.HpackError:
+            return
+        # accepted: the table capacity must still be bounded
+        assert getattr(dec, "max_table_size", 0) < (64 << 20)
