@@ -22,6 +22,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 def main() -> None:
     from brpc_tpu.rpc import Server, ServerOptions, Service
 
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+
     server = Server(ServerOptions(enable_builtin_services=False))
     svc = Service("Bench")
 
@@ -36,7 +38,7 @@ def main() -> None:
         return request
 
     server.add_service(svc)
-    ep = server.start("tcp://127.0.0.1:0")
+    ep = server.start(f"tcp://127.0.0.1:{port}")
     print(f"PORT {ep.port}", flush=True)
     from spawn_util import parent_death_watchdog_loop
     parent_death_watchdog_loop()
