@@ -1,0 +1,200 @@
+"""Framework-native HTTP client (policy/http_rpc_protocol.cpp client
+side + progressive_reader.h): buffered and progressive bodies over
+keep-alive connections, all body framings, failure semantics."""
+
+import socketserver
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.protocol.http_client import HttpClient, HttpClientError
+from brpc_tpu.rpc import Channel, Server, ServerOptions, Service
+
+def make_http_server():
+    """A real framework server: builtin pages + one service."""
+    server = Server(ServerOptions())
+    svc = Service("EchoService")
+
+    @svc.method()
+    def Echo(cntl, request):
+        return request
+
+    @svc.method()
+    def Stream(cntl, request):
+        pa = cntl.create_progressive_attachment("text/plain")
+
+        def feed():
+            for i in range(4):
+                pa.write(f"part-{i};".encode())
+                time.sleep(0.01)
+            pa.close()
+
+        threading.Thread(target=feed, daemon=True).start()
+        return None
+
+    server.add_service(svc)
+    ep = server.start("tcp://127.0.0.1:0")
+    return server, ep
+
+
+class TestBuffered:
+    def test_get_builtin_pages_with_keepalive(self):
+        server, ep = make_http_server()
+        cl = HttpClient(f"tcp://127.0.0.1:{ep.port}")
+        try:
+            status, headers, body = cl.get("/health")
+            assert status == 200 and body == b"OK"
+            # second call reuses the same connection (keep-alive)
+            sock1 = cl._socket
+            status, _, body = cl.get("/status")
+            assert status == 200 and b"running" in body
+            assert cl._socket is sock1
+        finally:
+            cl.close()
+            server.stop()
+            server.join(2)
+
+    def test_post_json_to_service(self):
+        server, ep = make_http_server()
+        cl = HttpClient(f"tcp://127.0.0.1:{ep.port}")
+        try:
+            status, _, body = cl.post("/EchoService/Echo", b"payload-bytes",
+                                      content_type="application/octet-stream")
+            assert status == 200
+            assert b"payload-bytes" in body
+        finally:
+            cl.close()
+            server.stop()
+            server.join(2)
+
+
+class TestProgressive:
+    def test_chunked_body_streams_to_callback(self):
+        server, ep = make_http_server()
+        cl = HttpClient(f"tcp://127.0.0.1:{ep.port}")
+        chunks = []
+        try:
+            status, headers, body = cl.get(
+                "/EchoService/Stream", on_chunk=chunks.append)
+            assert status == 200
+            assert body == b""          # streamed, not buffered
+            assert b"".join(chunks) == b"part-0;part-1;part-2;part-3;"
+            # progressive means MULTIPLE deliveries, not one buffered blob
+            assert len(chunks) >= 2
+            # connection still usable after a chunked response
+            status, _, body = cl.get("/health")
+            assert status == 200 and body == b"OK"
+        finally:
+            cl.close()
+            server.stop()
+            server.join(2)
+
+
+class _RawHttpServer(socketserver.ThreadingTCPServer):
+    """Hand-rolled responses for framings the framework server never
+    emits (close-delimited bodies, HTTP/1.0)."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, response_bytes: bytes):
+        outer = self
+
+        class H(socketserver.BaseRequestHandler):
+            def handle(self):
+                self.request.recv(65536)   # the request
+                self.request.sendall(outer.response_bytes)
+                self.request.close()       # close-delimited end
+
+        super().__init__(("127.0.0.1", 0), H)
+        self.response_bytes = response_bytes
+
+
+class TestCloseDelimited:
+    def test_head_request_with_content_length_does_not_stall(self):
+        raw = (b"HTTP/1.1 200 OK\r\n"
+               b"Content-Type: text/plain\r\n"
+               b"Content-Length: 12345\r\n"
+               b"\r\n")   # HEAD: entity headers, NO body (RFC 9110)
+        srv = _RawHttpServer(raw)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        cl = HttpClient(f"tcp://127.0.0.1:{srv.server_address[1]}",
+                        timeout_s=3.0)
+        try:
+            t0 = time.monotonic()
+            status, headers, body = cl.request("HEAD", "/x")
+            assert status == 200 and body == b""
+            assert headers.get("content-length") == "12345"
+            assert time.monotonic() - t0 < 2.0  # no timeout stall
+        finally:
+            cl.close()
+            srv.shutdown()
+            srv.server_close()
+
+    def test_negative_content_length_rejected(self):
+        raw = (b"HTTP/1.1 200 OK\r\n"
+               b"Content-Length: -1\r\n"
+               b"\r\n"
+               b"sneaky body")
+        srv = _RawHttpServer(raw)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        cl = HttpClient(f"tcp://127.0.0.1:{srv.server_address[1]}",
+                        timeout_s=3.0)
+        try:
+            with pytest.raises(HttpClientError):
+                cl.request("GET", "/x")
+        finally:
+            cl.close()
+            srv.shutdown()
+            srv.server_close()
+
+    def test_body_ends_at_eof(self):
+        raw = (b"HTTP/1.1 200 OK\r\n"
+               b"Content-Type: text/plain\r\n"
+               b"\r\n"
+               b"body-until-close")
+        srv = _RawHttpServer(raw)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        cl = HttpClient(f"tcp://127.0.0.1:{srv.server_address[1]}")
+        try:
+            status, headers, body = cl.request("GET", "/")
+            assert status == 200
+            assert body == b"body-until-close"
+        finally:
+            cl.close()
+            srv.shutdown()
+            srv.server_close()
+
+
+class TestFailures:
+    def test_server_death_mid_request_raises(self):
+        server, ep = make_http_server()
+        cl = HttpClient(f"tcp://127.0.0.1:{ep.port}", timeout_s=5.0)
+        try:
+            assert cl.get("/health")[0] == 200
+            server.stop()
+            server.join(2)
+            with pytest.raises(HttpClientError):
+                cl.get("/health")
+        finally:
+            cl.close()
+
+    def test_timeout_drops_connection(self):
+        # a server that never answers
+        import socket as pysock
+
+        ls = pysock.socket()
+        ls.bind(("127.0.0.1", 0))
+        ls.listen(1)
+        cl = HttpClient(f"tcp://127.0.0.1:{ls.getsockname()[1]}",
+                        timeout_s=0.5)
+        try:
+            with pytest.raises(HttpClientError):
+                cl.get("/never")
+        finally:
+            cl.close()
+            ls.close()
