@@ -22,22 +22,39 @@ from brpc_tpu.fiber.sync import CountdownEvent
 
 
 def fetch(url: str, timeout_s: float):
+    """One GET through the framework's OWN http client (the reference's
+    parallel_http drives brpc channels, not a third-party stack);
+    clients are cached per host for keep-alive across URLs."""
+    from brpc_tpu.protocol.http_client import HttpClient
+
     parsed = urllib.parse.urlsplit(url if "://" in url else "http://" + url)
     t0 = time.monotonic()
+    # a small per-host pool: one keep-alive connection would serialize
+    # same-host fetches (HTTP/1.1 FIFO), defeating the tool's point
+    slot = _rr_counter.__next__() % _POOL_PER_HOST
+    key = (parsed.hostname, parsed.port or 80, slot)
     try:
-        conn = http.client.HTTPConnection(parsed.hostname,
-                                          parsed.port or 80,
-                                          timeout=timeout_s)
+        with _clients_lock:
+            cl = _clients.get(key)
+            if cl is None:
+                cl = _clients[key] = HttpClient(
+                    f"tcp://{key[0]}:{key[1]}", timeout_s=timeout_s)
         path = parsed.path or "/"
         if parsed.query:
             path += "?" + parsed.query
-        conn.request("GET", path)
-        resp = conn.getresponse()
-        body = resp.read()
-        conn.close()
-        return resp.status, len(body), (time.monotonic() - t0) * 1e3, None
+        status, _headers, body = cl.get(path, timeout_s=timeout_s)
+        return status, len(body), (time.monotonic() - t0) * 1e3, None
     except Exception as e:
         return 0, 0, (time.monotonic() - t0) * 1e3, e
+
+
+_clients: dict = {}
+import itertools as _itertools  # noqa: E402
+import threading as _threading  # noqa: E402
+
+_clients_lock = _threading.Lock()
+_rr_counter = _itertools.count()
+_POOL_PER_HOST = 4
 
 
 def main(argv=None) -> None:
