@@ -1,0 +1,60 @@
+"""http_progressive: a server feeding an unbounded chunked download and
+a framework HttpClient consuming it progressively — the
+progressive_attachment + progressive_reader pair
+(example/http_c++'s progressive modes in the reference).
+
+Usage: main.py [total_mb]
+"""
+
+import sys
+import threading
+import time
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+
+from brpc_tpu.protocol.http_client import HttpClient
+from brpc_tpu.rpc import Server, ServerOptions, Service
+
+
+def main(total_mb: int = 4) -> None:
+    server = Server(ServerOptions())
+    svc = Service("FileService")
+    chunk = b"\xab" * 65536
+
+    @svc.method()
+    def Download(cntl, request):
+        pa = cntl.create_progressive_attachment("application/octet-stream")
+
+        def feed():
+            for _ in range(total_mb * 16):   # 16 x 64KB per MB
+                if not pa.write(chunk):
+                    return                   # client went away
+            pa.close()
+
+        threading.Thread(target=feed, daemon=True).start()
+
+    server.add_service(svc)
+    ep = server.start("tcp://127.0.0.1:0")
+
+    cl = HttpClient(f"tcp://127.0.0.1:{ep.port}")
+    got = [0]
+    parts = [0]
+    t0 = time.monotonic()
+
+    def on_chunk(data: bytes) -> None:
+        got[0] += len(data)
+        parts[0] += 1
+
+    status, headers, _ = cl.get("/FileService/Download", on_chunk=on_chunk,
+                                timeout_s=60)
+    dt = time.monotonic() - t0
+    print(f"status={status} received={got[0] / 1e6:.1f}MB in "
+          f"{parts[0]} parts, {got[0] / dt / 1e6:.0f} MB/s")
+    assert status == 200 and got[0] == total_mb << 20
+    cl.close()
+    server.stop()
+    server.join(2)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 4)
