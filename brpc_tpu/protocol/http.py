@@ -253,6 +253,20 @@ class HttpProtocol(Protocol):
                 spans = global_collector.recent(n)
             return 200, "application/json", json.dumps(
                 [s.to_dict() for s in spans]).encode()
+        if path == "/list":
+            # service/method enumeration with message types
+            # (builtin/list_service.cpp)
+            out = {}
+            for name, s in server.services().items():
+                out[name] = {
+                    m.name: {
+                        "request_type": (m.request_class.__name__
+                                         if m.request_class else "bytes"),
+                        "response_type": (m.response_class.__name__
+                                          if m.response_class else "bytes"),
+                    } for m in s.methods.values()
+                }
+            return 200, "application/json", json.dumps(out).encode()
         if path == "/version":
             import jax
             from brpc_tpu import __version__

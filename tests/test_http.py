@@ -379,3 +379,34 @@ def test_tools_rpc_press_drives_server(server):
     import re
     m = re.search(r"ok[=:\s]+(\d+)", out.lower())
     assert m and int(m.group(1)) > 0, out
+
+
+def test_list_page_enumerates_services():
+    """/list (builtin/list_service.cpp): services -> methods with
+    message type names."""
+    import json as _json
+
+    from tests.proto import echo_pb2
+
+    server = Server(ServerOptions())
+    svc = Service("ListDemo")
+
+    @svc.method()
+    def Raw(cntl, request):
+        return request
+
+    svc.register_method("Typed", lambda c, r: echo_pb2.EchoResponse(),
+                        request_class=echo_pb2.EchoRequest,
+                        response_class=echo_pb2.EchoResponse)
+    server.add_service(svc)
+    ep = server.start(f"tcp://127.0.0.1:0")
+    try:
+        status, body = http_get(ep, "/list")
+        assert status == 200
+        d = _json.loads(body)
+        assert d["ListDemo"]["Raw"]["request_type"] == "bytes"
+        assert d["ListDemo"]["Typed"]["request_type"] == "EchoRequest"
+        assert d["ListDemo"]["Typed"]["response_type"] == "EchoResponse"
+    finally:
+        server.stop()
+        server.join(2)
