@@ -336,16 +336,7 @@ class HttpClient:
             p.done.set()
 
     # ---------------------------------------------------------------- api
-    def request(self, method: str, path: str,
-                headers: Optional[Dict[str, str]] = None,
-                body: bytes = b"",
-                on_chunk: Optional[Callable[[bytes], None]] = None,
-                timeout_s: Optional[float] = None,
-                ) -> Tuple[int, Dict[str, str], bytes]:
-        """Returns (status, headers, body); with on_chunk, body parts go
-        to the callback (the progressive_reader.h role) and the returned
-        body is empty. Raises HttpClientError on transport failure or
-        timeout."""
+    def _issue(self, method, path, headers, body, on_chunk):
         try:
             sock = self._get_socket()
         except OSError as e:
@@ -371,16 +362,19 @@ class HttpClient:
                                                deque())
             expect.append(method.upper() == "HEAD")
             sock.write(buf)
-        if not p.done.wait_pthread(timeout_s or self._timeout_s):
-            with self._lock:
-                try:
-                    self._pending.remove(p)
-                except ValueError:
-                    pass
-            # the connection is now desynced (a late response would be
-            # matched to the wrong call): drop it
-            sock.set_failed(TimeoutError("http response timed out"))
-            raise HttpClientError("http response timed out")
+        return p
+
+    def _on_wait_timeout(self, p: "_Pending") -> None:
+        with self._lock:
+            try:
+                self._pending.remove(p)
+            except ValueError:
+                pass
+        # the connection is now desynced (a late response would be
+        # matched to the wrong call): drop it
+        p.sock.set_failed(TimeoutError("http response timed out"))
+
+    def _finish(self, p: "_Pending", on_chunk):
         if p.error is not None:
             raise HttpClientError(str(p.error))
         body_out = bytes(p.body)
@@ -396,6 +390,39 @@ class HttpClient:
             except Exception:
                 pass   # deliver raw when decoding fails
         return p.status, p.headers, body_out
+
+    def request(self, method: str, path: str,
+                headers: Optional[Dict[str, str]] = None,
+                body: bytes = b"",
+                on_chunk: Optional[Callable[[bytes], None]] = None,
+                timeout_s: Optional[float] = None,
+                ) -> Tuple[int, Dict[str, str], bytes]:
+        """Returns (status, headers, body); with on_chunk, body parts go
+        to the callback (the progressive_reader.h role) and the returned
+        body is empty. Raises HttpClientError on transport failure or
+        timeout. BLOCKS the calling thread — from inside a fiber use
+        request_async, or every scheduler worker can end up parked here
+        while the fibers that would answer them can't run."""
+        p = self._issue(method, path, headers, body, on_chunk)
+        if not p.done.wait_pthread(timeout_s or self._timeout_s):
+            self._on_wait_timeout(p)
+            raise HttpClientError("http response timed out")
+        return self._finish(p, on_chunk)
+
+    async def request_async(self, method: str, path: str,
+                            headers: Optional[Dict[str, str]] = None,
+                            body: bytes = b"",
+                            on_chunk: Optional[Callable[[bytes],
+                                                        None]] = None,
+                            timeout_s: Optional[float] = None,
+                            ) -> Tuple[int, Dict[str, str], bytes]:
+        """Fiber-friendly request(): awaits the completion instead of
+        parking the worker thread."""
+        p = self._issue(method, path, headers, body, on_chunk)
+        if not await p.done.wait(timeout_s or self._timeout_s):
+            self._on_wait_timeout(p)
+            raise HttpClientError("http response timed out")
+        return self._finish(p, on_chunk)
 
     def get(self, path: str, **kw):
         return self.request("GET", path, **kw)

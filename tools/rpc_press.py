@@ -25,28 +25,72 @@ def main(argv=None) -> None:
     ap.add_argument("--payload-size", type=int, default=64)
     ap.add_argument("--fibers", type=int, default=16)
     ap.add_argument("--timeout-ms", type=float, default=2000)
+    ap.add_argument("--protocol", choices=["tpu_std", "http"],
+                    default="tpu_std",
+                    help="http presses POST /<service>/<method> through "
+                         "the framework HttpClient (one keep-alive "
+                         "connection per fiber)")
     args = ap.parse_args(argv)
 
-    ch = Channel(args.address, ChannelOptions(timeout_ms=args.timeout_ms))
     payload = b"x" * args.payload_size
     lat = LatencyRecorder()
     stop_at = time.monotonic() + args.duration
     stats = {"ok": 0, "fail": 0}
     interval = (args.fibers / args.qps) if args.qps > 0 else 0.0
 
+    # per-protocol issue function; ONE shared loop owns timing, stats,
+    # and pacing so the variants cannot diverge
+    if args.protocol == "http":
+        from brpc_tpu.protocol.http_client import HttpClient, HttpClientError
+
+        path = f"/{args.service}/{args.method}"
+
+        def make_once():
+            # own client per fiber: HTTP/1.1 keep-alive is FIFO per
+            # connection, so sharing one would serialize the press.
+            # request_async keeps the worker THREAD free (a blocking
+            # request here would park every scheduler worker).
+            cl = HttpClient(args.address, timeout_s=args.timeout_ms / 1e3)
+
+            async def once() -> bool:
+                try:
+                    status, _, _ = await cl.request_async("POST", path,
+                                                          body=payload)
+                    return status == 200
+                except HttpClientError:
+                    return False
+
+            once.close = cl.close
+            return once
+    else:
+        ch = Channel(args.address,
+                     ChannelOptions(timeout_ms=args.timeout_ms))
+
+        def make_once():
+            async def once() -> bool:
+                cntl = await ch.call_async(args.service, args.method,
+                                           payload)
+                return not cntl.failed()
+
+            once.close = lambda: None
+            return once
+
     async def worker():
-        while time.monotonic() < stop_at:
-            t0 = time.perf_counter_ns()
-            cntl = await ch.call_async(args.service, args.method, payload)
-            if cntl.failed():
-                stats["fail"] += 1
-            else:
-                stats["ok"] += 1
-                lat.record((time.perf_counter_ns() - t0) / 1e3)
-            if interval:
-                spent = (time.perf_counter_ns() - t0) / 1e9
-                if spent < interval:
-                    await fiber.sleep(interval - spent)
+        once = make_once()
+        try:
+            while time.monotonic() < stop_at:
+                t0 = time.perf_counter_ns()
+                if await once():
+                    stats["ok"] += 1
+                    lat.record((time.perf_counter_ns() - t0) / 1e3)
+                else:
+                    stats["fail"] += 1
+                if interval:
+                    spent = (time.perf_counter_ns() - t0) / 1e9
+                    if spent < interval:
+                        await fiber.sleep(interval - spent)
+        finally:
+            once.close()
 
     fibers = [fiber.spawn(worker) for _ in range(args.fibers)]
     last_ok = 0
