@@ -690,11 +690,13 @@ class H2ServerProtocol(Protocol):
 # ----------------------------------------------------------------- client
 
 class GrpcCall:
-    """One in-flight unary call (completion signalled via butex so both
-    fibers and plain threads can wait)."""
+    """One in-flight unary call; completion is a FiberEvent so plain
+    threads block (wait) and fibers await (wait_async) without parking
+    their worker thread."""
 
     def __init__(self):
-        self._event = threading.Event()
+        from brpc_tpu.fiber.sync import FiberEvent
+        self._event = FiberEvent()
         self.status: int = GRPC_INTERNAL
         self.message: str = ""
         self.response: bytes = b""
@@ -727,7 +729,10 @@ class GrpcCall:
         self._event.set()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
-        return self._event.wait(timeout)
+        return self._event.wait_pthread(timeout)
+
+    async def wait_async(self, timeout: Optional[float] = None) -> bool:
+        return await self._event.wait(timeout)
 
     def ok(self) -> bool:
         return self.status == GRPC_OK
@@ -756,14 +761,28 @@ class GrpcChannel:
         with self._lock:
             if self._session is not None and not self._socket.failed:
                 return self._session
-            from brpc_tpu.transport.socket import create_client_socket
-            sock = create_client_socket(
-                self._endpoint, on_input=self._on_input,
-                control=self._control)
-            self._socket = sock
-            self._session = H2Session(sock, is_server=False)
-            self._session.send_preface_and_settings()
-            session = self._session
+        # connect OUTSIDE the lock: a blocking connect (SYN timeout,
+        # slow accept) held under _lock would park every other caller's
+        # worker thread on the lock — the scheduler-wide stall
+        # call_async exists to prevent. Losers of the resulting race
+        # discard their socket (connect_dedup's publish-under-lock
+        # discipline).
+        from brpc_tpu.transport.socket import create_client_socket
+        sock = create_client_socket(
+            self._endpoint, on_input=self._on_input,
+            control=self._control)
+        loser = None
+        with self._lock:
+            if self._session is not None and not self._socket.failed:
+                session, loser = self._session, sock
+            else:
+                self._socket = sock
+                self._session = H2Session(sock, is_server=False)
+                self._session.send_preface_and_settings()
+                session = self._session
+        if loser is not None:
+            loser.set_failed(ConnectionError("duplicate connect"))
+            return session
         # outside the lock: on_failed fires the callback synchronously if
         # the socket is already dead, and _fail_pending takes _lock
         sock.on_failed(self._fail_pending)
@@ -808,7 +827,29 @@ class GrpcChannel:
     def call(self, method_path: str, request, timeout: Optional[float] = 5.0,
              metadata: Optional[List[Tuple[str, str]]] = None,
              response_class=None) -> GrpcCall:
-        """Unary call. `method_path` is "/package.Service/Method"."""
+        """Unary call. `method_path` is "/package.Service/Method".
+        BLOCKS the calling thread; fibers use call_async."""
+        call, session, stream, wait_s = self._start(method_path, request,
+                                                    timeout, metadata)
+        if not call.wait(wait_s):
+            self._expire(call, session, stream)
+        return self._finish(call, response_class)
+
+    async def call_async(self, method_path: str, request,
+                         timeout: Optional[float] = 5.0,
+                         metadata: Optional[List[Tuple[str, str]]] = None,
+                         response_class=None) -> GrpcCall:
+        """Fiber-friendly unary call: awaits completion instead of
+        parking the worker thread. (Connection ESTABLISHMENT still uses
+        a blocking connect — only the first call on a channel pays it,
+        and never while holding the channel lock.)"""
+        call, session, stream, wait_s = self._start(method_path, request,
+                                                    timeout, metadata)
+        if not await call.wait_async(wait_s):
+            self._expire(call, session, stream)
+        return self._finish(call, response_class)
+
+    def _start(self, method_path, request, timeout, metadata):
         if hasattr(request, "SerializeToString"):
             payload = request.SerializeToString()
         else:
@@ -841,15 +882,21 @@ class GrpcChannel:
         session.send_headers(stream, headers)
         session.send_data(stream, pack_grpc_message(payload),
                           end_stream=True)
-        # timeout=None waits indefinitely (like gRPC with no deadline);
-        # either way the call is resolved before returning
-        if not call.wait(timeout + 1.0 if timeout is not None else None):
-            call.status = GRPC_DEADLINE_EXCEEDED
-            call.message = "deadline exceeded"
-            call._event.set()
-            with self._lock:
-                self._pending.discard(call)
-            session.send_rst(stream.id, CANCEL)
+        # one place owns the grace policy: a second past the grpc
+        # deadline for the server's own DEADLINE_EXCEEDED to arrive
+        wait_s = timeout + 1.0 if timeout is not None else None
+        return call, session, stream, wait_s
+
+    def _expire(self, call, session, stream) -> None:
+        call.status = GRPC_DEADLINE_EXCEEDED
+        call.message = "deadline exceeded"
+        call._event.set()
+        with self._lock:
+            self._pending.discard(call)
+        session.send_rst(stream.id, CANCEL)
+
+    @staticmethod
+    def _finish(call, response_class):
         if response_class is not None and call.ok():
             resp = response_class()
             resp.ParseFromString(call.response)

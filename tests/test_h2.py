@@ -319,3 +319,41 @@ class TestHpackFuzz:
             return
         # accepted: the table capacity must still be bounded
         assert getattr(dec, "max_table_size", 0) < (64 << 20)
+
+
+def test_grpc_call_async_from_fibers():
+    """call_async must complete many concurrent calls from fibers
+    WITHOUT parking worker threads (GrpcCall's FiberEvent contract) —
+    more in-flight calls than scheduler workers proves no livelock."""
+    from brpc_tpu import fiber
+    from brpc_tpu.fiber.sync import CountdownEvent
+
+    server = _make_server()
+    ep = server.start("tcp://127.0.0.1:0")
+    try:
+        ch = GrpcChannel(f"{ep.host}:{ep.port}")
+        # MORE in-flight calls than scheduler workers, whatever the
+        # host's core count — or the livelock this guards against
+        # would hide on many-core machines
+        N = fiber.global_control().concurrency + 8
+        done = CountdownEvent(N)
+        failures = []
+
+        async def one(i):
+            try:
+                call = await ch.call_async("/EchoService/RawEcho",
+                                           f"m{i}".encode(), timeout=10)
+                if not call.ok() or call.response != f"m{i}".encode():
+                    failures.append((i, call.status, call.message))
+            except Exception as e:  # noqa: BLE001
+                failures.append((i, -1, str(e)))
+            finally:
+                done.signal()
+
+        for i in range(N):
+            fiber.spawn(one, i)
+        assert done.wait_pthread(30), "fiber calls never completed"
+        assert not failures, failures[:3]
+        ch.close()
+    finally:
+        server.stop()
