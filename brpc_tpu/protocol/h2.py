@@ -30,6 +30,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.fiber.sync import FiberEvent
 from brpc_tpu.protocol.hpack import HpackDecoder, HpackEncoder, HpackError
 from brpc_tpu.protocol.registry import (
     PARSE_NOT_ENOUGH_DATA, PARSE_OK, PARSE_TRY_OTHERS, Protocol,
@@ -695,7 +696,6 @@ class GrpcCall:
     their worker thread."""
 
     def __init__(self):
-        from brpc_tpu.fiber.sync import FiberEvent
         self._event = FiberEvent()
         self.status: int = GRPC_INTERNAL
         self.message: str = ""
@@ -758,34 +758,38 @@ class GrpcChannel:
         self._pending: set = set()
 
     def _connect(self) -> H2Session:
-        with self._lock:
-            if self._session is not None and not self._socket.failed:
-                return self._session
-        # connect OUTSIDE the lock: a blocking connect (SYN timeout,
-        # slow accept) held under _lock would park every other caller's
-        # worker thread on the lock — the scheduler-wide stall
-        # call_async exists to prevent. Losers of the resulting race
-        # discard their socket (connect_dedup's publish-under-lock
-        # discipline).
+        # connect_dedup (rpc/channel.py): connect OUTSIDE the lock —
+        # a blocking connect (SYN timeout, slow accept) held under
+        # _lock would park every other caller's worker thread on the
+        # lock — publish under it, exactly one winner, losers discarded
+        # with the closed-concurrently recheck.
+        from brpc_tpu.rpc.channel import connect_dedup
         from brpc_tpu.transport.socket import create_client_socket
-        sock = create_client_socket(
-            self._endpoint, on_input=self._on_input,
-            control=self._control)
-        loser = None
+
+        def make():
+            return create_client_socket(self._endpoint,
+                                        on_input=self._on_input,
+                                        control=self._control)
+
+        published = []
+
+        def publish(sock):
+            self._socket = sock
+            self._session = H2Session(sock, is_server=False)
+            self._session.send_preface_and_settings()
+            published.append(sock)
+
+        sock = connect_dedup(self._lock, lambda: self._socket,
+                             publish, make)
         with self._lock:
-            if self._session is not None and not self._socket.failed:
-                session, loser = self._session, sock
-            else:
-                self._socket = sock
-                self._session = H2Session(sock, is_server=False)
-                self._session.send_preface_and_settings()
-                session = self._session
-        if loser is not None:
-            loser.set_failed(ConnectionError("duplicate connect"))
-            return session
-        # outside the lock: on_failed fires the callback synchronously if
-        # the socket is already dead, and _fail_pending takes _lock
-        sock.on_failed(self._fail_pending)
+            session = self._session
+        if published and published[0] is sock:
+            # ONLY the publisher registers — every _connect() call runs
+            # this tail, and re-registering on the long-lived winner
+            # socket would grow its callback list per RPC. Outside the
+            # lock: on_failed fires synchronously if the socket is
+            # already dead, and _fail_pending takes _lock.
+            sock.on_failed(self._fail_pending)
         return session
 
     def _fail_pending(self, socket) -> None:

@@ -211,6 +211,23 @@ class MemcacheClient(PipelinedClient):
         wire = pack_request(opcode, key, value, extras, opaque, cas)
         batch = self._start(wire, 1)
         resp: Response = self._wait(batch, f"memcache op 0x{opcode:02x}")[0]
+        return self._check_reply(resp, opaque, opcode, batch)
+
+    async def _call_async(self, opcode: int, key: bytes = b"",
+                          value: bytes = b"", extras: bytes = b"",
+                          cas: int = 0) -> Response:
+        """Fiber-friendly _call: awaits the reply instead of parking the
+        worker thread (same contract as redis execute_async / thrift
+        call_async)."""
+        opaque = next(self._opaque)
+        wire = pack_request(opcode, key, value, extras, opaque, cas)
+        batch = self._start(wire, 1)
+        resp: Response = (await self._wait_async(
+            batch, f"memcache op 0x{opcode:02x}"))[0]
+        return self._check_reply(resp, opaque, opcode, batch)
+
+    def _check_reply(self, resp: Response, opaque: int, opcode: int,
+                     batch) -> Response:
         if resp.opaque != opaque or resp.opcode != opcode:
             # FIFO desync: fail the connection, nothing after this can match
             if batch.socket is not None:
@@ -235,7 +252,13 @@ class MemcacheClient(PipelinedClient):
 
     # ---------------------------------------------------------------- api
     def get(self, key) -> Optional[GetResult]:
-        resp = self._call(OP_GET, self._key(key))
+        return self._get_result(self._call(OP_GET, self._key(key)))
+
+    async def get_async(self, key) -> Optional[GetResult]:
+        return self._get_result(await self._call_async(OP_GET,
+                                                       self._key(key)))
+
+    def _get_result(self, resp: Response) -> Optional[GetResult]:
         if resp.status == STATUS_KEY_NOT_FOUND:
             return None
         if resp.status != STATUS_OK:
@@ -251,6 +274,20 @@ class MemcacheClient(PipelinedClient):
         if resp.status != STATUS_OK:
             self._raise(resp)
         return resp.cas
+
+    async def _store_async(self, opcode: int, key, value, flags: int,
+                           exptime: int, cas: int) -> int:
+        extras = struct.pack(">II", flags, exptime)
+        resp = await self._call_async(opcode, self._key(key),
+                                      self._val(value), extras, cas)
+        if resp.status != STATUS_OK:
+            self._raise(resp)
+        return resp.cas
+
+    async def set_async(self, key, value, flags: int = 0, exptime: int = 0,
+                        cas: int = 0) -> int:
+        return await self._store_async(OP_SET, key, value, flags, exptime,
+                                       cas)
 
     def set(self, key, value, flags: int = 0, exptime: int = 0,
             cas: int = 0) -> int:

@@ -323,3 +323,40 @@ class TestSaslAuth:
     def test_password_without_username_rejected(self):
         with pytest.raises(ValueError):
             mc.MemcacheClient("tcp://127.0.0.1:1", password="lonely")
+
+
+class TestAsyncApi:
+    def test_get_set_async_from_fibers(self):
+        """set_async/get_async await the reply without parking worker
+        threads: more in-flight ops than scheduler workers."""
+        from brpc_tpu import fiber
+        from brpc_tpu.fiber.sync import CountdownEvent
+
+        server = _MockMemcached()
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        host, port = server.server_address
+        c = mc.MemcacheClient(f"tcp://{host}:{port}")
+        n = fiber.global_control().concurrency + 8
+        done = CountdownEvent(n)
+        failures = []
+        try:
+            async def one(i):
+                try:
+                    await c.set_async(f"k{i}", f"v{i}")
+                    got = await c.get_async(f"k{i}")
+                    if got is None or got.value != f"v{i}".encode():
+                        failures.append(i)
+                except Exception as e:  # noqa: BLE001
+                    failures.append((i, str(e)))
+                finally:
+                    done.signal()
+
+            for i in range(n):
+                fiber.spawn(one, i)
+            assert done.wait_pthread(30), "async ops never completed"
+            assert not failures, failures[:3]
+        finally:
+            c.close()
+            server.shutdown()
+            server.server_close()
