@@ -456,6 +456,19 @@ def main() -> None:
         run(100, 1, rec, payload=b"ping")
         result["small_rpc_p50_us"] = round(rec.latency_percentile(0.5), 1)
         result["small_rpc_p99_us"] = round(rec.latency_percentile(0.99), 1)
+        # scheduler wake-to-run latency sampled across the run (the
+        # event-driven wake path's accountability number, /vars
+        # fiber_wake). Under this SATURATING load it is queueing-bound
+        # — the quiet-path figure (~33us cross-thread on a 1-core box)
+        # lives in docs/performance.md; the under-load key name keeps
+        # the two from being conflated.
+        from brpc_tpu.bvar.variable import dump_exposed
+        fw = dict(dump_exposed()).get("fiber_wake")
+        if fw:
+            result["fiber_wake_under_load_p50_us"] = round(
+                fw["latency_p50_us"], 1)
+            result["fiber_wake_under_load_p99_us"] = round(
+                fw["latency_p99_us"], 1)
         _progress({"progress": "tcp_small",
                    "p50_us": result["small_rpc_p50_us"],
                    "p99_us": result["small_rpc_p99_us"]})
