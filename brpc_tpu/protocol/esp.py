@@ -170,7 +170,7 @@ class EspClient:
             slot[1] = msg
             slot[0].set()
 
-    def call(self, to: int, body: bytes, flags: int = 0) -> EspMessage:
+    def _issue(self, to: int, body: bytes, flags: int):
         socket = self._get_socket()
         with self._lock:
             msg_id = self._next_id
@@ -183,13 +183,30 @@ class EspClient:
         out.append(msg.pack())
         if not socket.write(out):
             self._on_socket_failed(socket)
-        if not slot[0].wait_pthread(self._timeout_s):
+        return msg_id, slot
+
+    def _settle(self, msg_id: int, slot, completed: bool) -> EspMessage:
+        if not completed:
             with self._lock:
                 self._pending.pop(msg_id, None)
             raise TimeoutError("esp call timed out")
         if isinstance(slot[1], BaseException):
             raise slot[1]
         return slot[1]
+
+    def call(self, to: int, body: bytes, flags: int = 0) -> EspMessage:
+        """BLOCKS the calling thread; fibers use call_async."""
+        msg_id, slot = self._issue(to, body, flags)
+        return self._settle(msg_id, slot,
+                            slot[0].wait_pthread(self._timeout_s))
+
+    async def call_async(self, to: int, body: bytes,
+                         flags: int = 0) -> EspMessage:
+        """Fiber-friendly call: awaits the reply instead of parking
+        the worker thread."""
+        msg_id, slot = self._issue(to, body, flags)
+        return self._settle(msg_id, slot,
+                            await slot[0].wait(self._timeout_s))
 
     def close(self):
         with self._lock:

@@ -258,3 +258,39 @@ def test_mongo_no_adaptor():
         s.close()
         server.stop()
         server.join(2)
+
+
+def test_esp_call_async_from_fibers():
+    """call_async awaits the reply without parking worker threads —
+    more in-flight calls than scheduler workers."""
+    from brpc_tpu import fiber
+    from brpc_tpu.fiber.sync import CountdownEvent
+
+    def handler(sock, msg):
+        return b"re-" + msg.body
+
+    server = Server(ServerOptions(esp_service=handler))
+    ep = server.start(f"mem://espasync-{next(_name_seq)}")
+    c = esp.EspClient(ep, stargate_id=3, timeout_s=15)
+    n = fiber.global_control().concurrency + 8
+    done = CountdownEvent(n)
+    bad = []
+    try:
+        async def one(i):
+            try:
+                r = await c.call_async(to=1, body=f"q{i}".encode())
+                if r.body != f"re-q{i}".encode():
+                    bad.append(i)
+            except Exception as e:  # noqa: BLE001
+                bad.append((i, str(e)))
+            finally:
+                done.signal()
+
+        for i in range(n):
+            fiber.spawn(one, i)
+        assert done.wait_pthread(30), "async esp calls never completed"
+        assert not bad, bad[:3]
+    finally:
+        c.close()
+        server.stop()
+        server.join(2)
