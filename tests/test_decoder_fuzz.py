@@ -127,3 +127,69 @@ class TestEndpointFuzz:
 
 # struct.error alias used in the except clauses above
 from struct import error as struct_error  # noqa: E402
+
+
+class TestRespFuzz:
+    # the parser's controlled outcomes: a value, _NeedMore (valid
+    # prefix), or _BadWire (never RESP) — anything else is a bug
+    @staticmethod
+    def _controlled():
+        from brpc_tpu.protocol.redis import _BadWire, _NeedMore
+        return (_BadWire, _NeedMore, ValueError, KeyError, IndexError,
+                struct_error)
+
+    def test_random_bytes(self):
+        from brpc_tpu.protocol.redis import parse_value
+
+        rng = random.Random(0x4E59)
+        for _ in range(500):
+            data = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(0, 80)))
+            try:
+                parse_value(data, 0, inline_ok=True)
+            except self._controlled():
+                pass
+
+    def test_mutated_valid_replies(self):
+        from brpc_tpu.protocol.redis import encode_reply, parse_value
+
+        rng = random.Random(0x4E5A)
+        base = encode_reply([b"nested", [1, 2, b"x" * 40], None, "simple"])
+        for data in _mutations(rng, base, 300):
+            try:
+                parse_value(data, 0)
+            except self._controlled():
+                pass
+
+    def test_length_bomb_is_need_more_without_allocation(self):
+        """$<huge>\\r\\n with a short body is an incomplete value —
+        the parser must wait for bytes, not allocate the claim."""
+        from brpc_tpu.protocol.redis import _NeedMore, parse_value
+
+        with pytest.raises(_NeedMore):
+            parse_value(b"$2147483647\r\nhi", 0)
+
+
+class TestFlvFuzz:
+    def test_random_and_mutated(self):
+        from brpc_tpu.protocol import flv
+
+        rng = random.Random(0xF1F0)
+        base = flv.file_header() + flv.pack_tag(
+            flv.FlvTag(8, 0, b"audio-bytes")) + flv.pack_tag(
+            flv.FlvTag(9, 40, b"video-bytes" * 8))
+        for data in _mutations(rng, base, 250):
+            try:
+                flv.parse_header(data)
+                list(flv.iter_tags(data))
+            except (flv.FlvError, ValueError, KeyError, IndexError,
+                    struct_error):
+                pass
+        for _ in range(250):
+            data = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(0, 100)))
+            try:
+                list(flv.iter_tags(data, pos=0))
+            except (flv.FlvError, ValueError, KeyError, IndexError,
+                    struct_error):
+                pass
