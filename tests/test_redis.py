@@ -243,3 +243,31 @@ def test_deep_nesting_fails_connection_not_process():
     # "*1\r\n" * big: unbounded recursion must be _BadWire, not a crash
     with pytest.raises(r._BadWire, match="nesting"):
         r.parse_value(b"*1\r\n" * 200, 0)
+
+
+def test_execute_async_from_fibers(redis_server):
+    """execute_async awaits replies without parking worker threads —
+    more in-flight commands than scheduler workers."""
+    from brpc_tpu import fiber
+    from brpc_tpu.fiber.sync import CountdownEvent
+
+    c = redis_server
+    n = fiber.global_control().concurrency + 8
+    done = CountdownEvent(n)
+    bad = []
+
+    async def one(i):
+        try:
+            if await c.execute_async("SET", f"ak{i}", f"av{i}") != "OK":
+                bad.append(i)
+            elif await c.execute_async("GET", f"ak{i}") != f"av{i}".encode():
+                bad.append(i)
+        except Exception as e:  # noqa: BLE001
+            bad.append((i, str(e)))
+        finally:
+            done.signal()
+
+    for i in range(n):
+        fiber.spawn(one, i)
+    assert done.wait_pthread(30), "async redis commands never completed"
+    assert not bad, bad[:3]

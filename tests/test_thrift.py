@@ -196,3 +196,30 @@ def test_no_thrift_service():
         c.close()
         server.stop()
         server.join(2)
+
+
+def test_call_async_from_fibers(client):
+    """call_async awaits replies without parking worker threads — more
+    in-flight calls than scheduler workers."""
+    from brpc_tpu import fiber
+    from brpc_tpu.fiber.sync import CountdownEvent
+
+    n = fiber.global_control().concurrency + 8
+    done = CountdownEvent(n)
+    bad = []
+
+    async def one(i):
+        try:
+            out = await client.call_async(
+                "Add", {1: th.TVal(th.T_I64, i), 2: th.TVal(th.T_I64, 100)})
+            if out[0].value != i + 100:
+                bad.append(i)
+        except Exception as e:  # noqa: BLE001
+            bad.append((i, str(e)))
+        finally:
+            done.signal()
+
+    for i in range(n):
+        fiber.spawn(one, i)
+    assert done.wait_pthread(30), "async thrift calls never completed"
+    assert not bad, bad[:3]
