@@ -12,6 +12,7 @@ path handle.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Callable, List, Optional
@@ -101,6 +102,37 @@ class Controller:
         self._response_sender: Optional[Callable] = None
         self._progressive = None    # ProgressiveAttachment (http chunked)
         self._session_local = None  # borrowed from the server's data pool
+        self._session_kv: Optional[dict] = None   # kvmap.h SessionKV
+
+    def session_kv(self) -> dict:
+        """Lazily-created per-call key/value annotations (kvmap.h +
+        Controller::SessionKV): whatever the app records here is flushed
+        to the log in one line when the call completes, so everything
+        about one session lands greppable together. Flushing CLEARS the
+        map, so on a reused controller any annotation added after the
+        previous completion belongs to the NEXT call."""
+        if self._session_kv is None:
+            self._session_kv = {}
+        return self._session_kv
+
+    def flush_session_kv(self) -> None:
+        """Log-and-clear (FlushSessionKV, controller.cpp:160: flushed at
+        controller destruction; ours flushes at call completion). Never
+        raises: a kv value whose __str__ explodes must not abort the
+        completion path it runs on (join() would hang)."""
+        kv = self._session_kv
+        if not kv:
+            return
+        self._session_kv = None
+        try:
+            pairs = " ".join(f"{k}={v}" for k, v in kv.items())
+            logging.getLogger("brpc_tpu.session").info(
+                "Session ends. %s @%s.%s log_id=%d", pairs,
+                self._service_name or "?", self._method_name or "?",
+                self.log_id)
+        except Exception:
+            logging.getLogger("brpc_tpu.session").exception(
+                "session_kv flush failed")
 
     def create_progressive_attachment(
             self, content_type: str = "application/octet-stream"):
@@ -191,6 +223,7 @@ class Controller:
                 hook(self)
             except Exception:
                 pass
+        self.flush_session_kv()
         cb = self._done_cb
         self._done_event.set()
         if cb is not None:

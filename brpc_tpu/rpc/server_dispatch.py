@@ -111,6 +111,7 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
         cntl.set_failed(berr.EREQUEST, f"cannot parse request: {e}")
         _send_error(proto, socket, cid, berr.EREQUEST, f"cannot parse request: {e}")
         finish_span(span, cntl)  # malformed traffic must show in /rpcz
+        cntl.flush_session_kv()
         return
 
     # interceptor gate (interceptor.h Accept): runs with the decoded
@@ -131,6 +132,10 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
             cntl.set_failed(code, reason)
             _send_error(proto, socket, cid, code, reason)
             finish_span(span, cntl)
+            # rejected sessions are the ones operators grep for most:
+            # interceptor annotations must still flush (the reference
+            # flushes at controller destruction, covering every outcome)
+            cntl.flush_session_kv()
             return
 
     pool = getattr(server, "session_local_pool", None)
@@ -160,6 +165,7 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     server.on_request_end(method_key, latency_us, failed=cntl.failed())
     _send_response(proto, socket, cid, cntl, response)
     finish_span(span, cntl)
+    cntl.flush_session_kv()   # kvmap.h: one greppable line per session
 
 
 def _send_response(proto, socket, cid: int, cntl: Controller,

@@ -518,3 +518,77 @@ class TestConnectionTypes:
             ch.close()
             server.stop()
             server.join(2)
+
+    def test_session_kv_flushed_on_completion(self, mem_server):
+        """kvmap.h SessionKV: per-call annotations land in ONE log line
+        when the call ends, both sides."""
+        import logging
+
+        server, ep = mem_server
+        svc = server.services()["EchoService"]
+
+        def Annotated(cntl, request):
+            cntl.session_kv()["user"] = "u1"
+            cntl.session_kv()["items"] = 3
+            return request
+
+        svc.register_method("Annotated", Annotated)
+        records = []
+
+        class Cap(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        h = Cap()
+        logging.getLogger("brpc_tpu.session").addHandler(h)
+        logging.getLogger("brpc_tpu.session").setLevel(logging.INFO)
+        try:
+            ch = Channel(str(ep))
+            cntl = Controller()
+            cntl.session_kv()["attempt_tag"] = "client-side"
+            cntl = ch.call_sync("EchoService", "Annotated", b"x", cntl=cntl)
+            assert not cntl.failed(), cntl.error_text
+            server_lines = [r for r in records if "user=u1" in r]
+            client_lines = [r for r in records if "attempt_tag" in r]
+            assert server_lines and "items=3" in server_lines[0]
+            assert "Annotated" in server_lines[0]
+            assert client_lines
+            # flushed means CLEARED: a second call must not re-log
+            n = len(records)
+            ch.call_sync("EchoService", "Echo", b"y")
+            assert len(records) == n
+        finally:
+            logging.getLogger("brpc_tpu.session").removeHandler(h)
+
+    def test_session_kv_flushed_on_interceptor_reject(self):
+        """Rejected sessions still flush their annotations."""
+        import logging
+
+        from brpc_tpu.rpc.auth import InterceptorError
+
+        def interceptor(cntl):
+            cntl.session_kv()["rejected_user"] = "u9"
+            raise InterceptorError(berr.EPERM, "not allowed")
+
+        server = make_echo_server(interceptor=interceptor)
+        ep = server.start(f"mem://kvrej-{next(_name_seq)}")
+        records = []
+
+        class Cap(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        h = Cap()
+        lg = logging.getLogger("brpc_tpu.session")
+        lg.addHandler(h)
+        old_level = lg.level
+        lg.setLevel(logging.INFO)
+        try:
+            cntl = Channel(str(ep)).call_sync("EchoService", "Echo", b"x")
+            assert cntl.error_code == berr.EPERM
+            assert any("rejected_user=u9" in r for r in records)
+        finally:
+            lg.removeHandler(h)
+            lg.setLevel(old_level)
+            server.stop()
+            server.join(2)
