@@ -314,13 +314,13 @@ class Channel:
                 # emptied pool — nothing would ever close that socket again
                 s.set_failed(ConnectionError("channel closed"))
 
-            cntl._complete_hooks.append(_return)
+            cntl._add_complete_hook(_return)
             return sock
         if ctype == "short":
             sock = create_client_socket(
                 self._endpoint, on_input=self._messenger.on_new_messages,
                 control=self._control)
-            cntl._complete_hooks.append(
+            cntl._add_complete_hook(
                 lambda c, s=sock: s.failed or s.set_failed(
                     ConnectionError("short connection done")))
             return sock

@@ -81,7 +81,7 @@ class ClusterChannel(Channel):
                                       "(late backup/retry attempt dropped)")
             cntl.tried_servers.append(ep)
         if self._on_call_complete not in cntl._complete_hooks:
-            cntl._complete_hooks.append(self._on_call_complete)
+            cntl._add_complete_hook(self._on_call_complete)
         return self._socket_for(ep)
 
     def _socket_for(self, ep: EndPoint) -> Socket:
@@ -140,6 +140,17 @@ class ClusterChannel(Channel):
         ep = cntl.responded_server
         if ep is None or ep not in tried:
             ep = tried[-1]
+        if cntl.error_code == berr.ECANCELED:
+            # cancellation is client-local: no server failed, and the
+            # truncated latency is meaningless — abandon every selection
+            # (returns inflight slots without polluting stats) instead
+            # of feeding the LB/breaker a bogus observation
+            for s in tried:
+                if s in fed_snapshot:
+                    fed_snapshot.remove(s)
+                else:
+                    self._lb.abandon(s)
+            return
         failed = cntl.failed() and cntl.error_code != berr.ERPCTIMEDOUT
         self._lb.feedback(ep, cntl.latency_us(), cntl.failed())
         self._breakers.on_call(ep, failed)

@@ -103,6 +103,7 @@ class Controller:
         self._progressive = None    # ProgressiveAttachment (http chunked)
         self._session_local = None  # borrowed from the server's data pool
         self._session_kv: Optional[dict] = None   # kvmap.h SessionKV
+        self._completed = False    # set under _arb_lock by _complete
 
     def session_kv(self) -> dict:
         """Lazily-created per-call key/value annotations (kvmap.h +
@@ -187,6 +188,8 @@ class Controller:
         self._done_event = FiberEvent()
         self.reset_error()
         self.current_try = 0
+        with self._arb_lock:
+            self._completed = False
         self.end_us = 0
         self.response_payload = None
         self.response_attachment = IOBuf()
@@ -205,7 +208,24 @@ class Controller:
         self.correlation_id = _call_pool.insert(self)
         return self.correlation_id
 
+    def _add_complete_hook(self, hook) -> None:
+        """Completion-aware registration: a hook added AFTER the call
+        completed (start_cancel can finish the call while _issue_rpc is
+        still registering pooled-socket return hooks) runs immediately
+        instead of silently never running — which would leak the pooled
+        connection."""
+        with self._arb_lock:
+            if not self._completed:
+                self._complete_hooks.append(hook)
+                return
+        try:
+            hook(self)
+        except Exception:
+            pass
+
     def _complete(self) -> None:
+        with self._arb_lock:
+            self._completed = True
         self.end_us = time.monotonic_ns() // 1000
         from brpc_tpu.fiber.timer import global_timer
         for tid in self._timer_ids:
@@ -223,11 +243,32 @@ class Controller:
                 hook(self)
             except Exception:
                 pass
-        self.flush_session_kv()
         cb = self._done_cb
         self._done_event.set()
         if cb is not None:
             cb(self)
+        # after the done callback, so annotations recorded there land in
+        # THIS call's line (the reference flushes at destruction, which
+        # is also after done runs)
+        self.flush_session_kv()
+
+    def start_cancel(self) -> None:
+        """Cancel an in-flight client call (Controller::StartCancel):
+        completes NOW with ECANCELED; the late response finds no call
+        and is dropped by the versioned-id arbitration. No-op if the
+        call already finished or was never issued. Like the reference,
+        cancellation is client-local — the server may still execute
+        the handler."""
+        if self.correlation_id == 0:
+            # never registered (fresh/server-side/combo-parent
+            # controller): taking id 0 would consume the reserved
+            # slot-0 sentinel (see _call_pool setup)
+            return
+        with self._arb_lock:
+            taken = take_call(self.correlation_id) is self
+        if taken:
+            self.set_failed(berr.ECANCELED, "canceled by caller")
+            self._complete()
 
     def join(self, timeout_s: Optional[float] = None) -> bool:
         """Block the calling thread until the call finishes."""

@@ -163,9 +163,13 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
 
     latency_us = (time.monotonic_ns() - t0) / 1e3
     server.on_request_end(method_key, latency_us, failed=cntl.failed())
-    _send_response(proto, socket, cid, cntl, response)
-    finish_span(span, cntl)
-    cntl.flush_session_kv()   # kvmap.h: one greppable line per session
+    try:
+        _send_response(proto, socket, cid, cntl, response)
+        finish_span(span, cntl)
+    finally:
+        # kvmap.h: one greppable line per session — even when the
+        # response write throws (peer already gone)
+        cntl.flush_session_kv()
 
 
 def _send_response(proto, socket, cid: int, cntl: Controller,

@@ -540,8 +540,10 @@ class TestConnectionTypes:
                 records.append(record.getMessage())
 
         h = Cap()
-        logging.getLogger("brpc_tpu.session").addHandler(h)
-        logging.getLogger("brpc_tpu.session").setLevel(logging.INFO)
+        lg = logging.getLogger("brpc_tpu.session")
+        lg.addHandler(h)
+        old_level = lg.level
+        lg.setLevel(logging.INFO)
         try:
             ch = Channel(str(ep))
             cntl = Controller()
@@ -558,7 +560,8 @@ class TestConnectionTypes:
             ch.call_sync("EchoService", "Echo", b"y")
             assert len(records) == n
         finally:
-            logging.getLogger("brpc_tpu.session").removeHandler(h)
+            lg.removeHandler(h)
+            lg.setLevel(old_level)
 
     def test_session_kv_flushed_on_interceptor_reject(self):
         """Rejected sessions still flush their annotations."""
@@ -592,3 +595,27 @@ class TestConnectionTypes:
             lg.setLevel(old_level)
             server.stop()
             server.join(2)
+
+    def test_start_cancel(self, mem_server):
+        """StartCancel: the call completes NOW with ECANCELED; the late
+        response is dropped; double-cancel and cancel-after-completion
+        are no-ops."""
+        server, ep = mem_server
+        ch = Channel(str(ep), ChannelOptions(timeout_ms=5000))
+        cntl = ch.call("EchoService", "Slow", b"x")   # server sleeps 0.3s
+        t0 = time.monotonic()
+        cntl.start_cancel()
+        assert cntl.join(2)
+        assert time.monotonic() - t0 < 0.25, "cancel did not complete NOW"
+        assert cntl.error_code == berr.ECANCELED
+        cntl.start_cancel()   # idempotent
+        assert cntl.error_code == berr.ECANCELED
+        time.sleep(0.4)       # late response arrives, must be dropped
+        assert cntl.error_code == berr.ECANCELED
+        # the channel stays healthy
+        ok = ch.call_sync("EchoService", "Echo", b"after-cancel")
+        assert not ok.failed() and \
+            ok.response_payload.to_bytes() == b"after-cancel"
+        # cancel after completion: no-op
+        ok.start_cancel()
+        assert not ok.failed()
