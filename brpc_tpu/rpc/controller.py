@@ -103,6 +103,7 @@ class Controller:
         self._progressive = None    # ProgressiveAttachment (http chunked)
         self._session_local = None  # borrowed from the server's data pool
         self._session_kv: Optional[dict] = None   # kvmap.h SessionKV
+        self._cancel_subs: list = []   # (socket, cb) notify_on_cancel subs
         self._completed = False    # set under _arb_lock by _complete
 
     def session_kv(self) -> dict:
@@ -269,6 +270,44 @@ class Controller:
         if taken:
             self.set_failed(berr.ECANCELED, "canceled by caller")
             self._complete()
+
+    # ------------------------------------------------- server-side cancel
+    def is_canceled(self) -> bool:
+        """Server side (Controller::IsCanceled): True once the client's
+        connection is gone — a long handler should stop wasting work on
+        a response nobody will read.
+
+        Detection requires the connection's input fiber to be free to
+        observe the EOF: run long handlers with
+        ``ServerOptions(usercode_in_pthread=True)`` (the reference gets
+        the same decoupling from its dedicated event-dispatcher
+        bthreads). An in-place handler monopolizes the input fiber, so
+        the EOF is only drained after it returns."""
+        s = self._server_socket
+        return bool(s is not None and s.failed)
+
+    def notify_on_cancel(self, callback: Callable[[], None]) -> None:
+        """Server side (Controller::NotifyOnCancel): run ``callback``
+        when the client's connection dies; immediately if it already
+        has. At most once per request — the subscription is dropped
+        when the request completes, so keep-alive connections serving
+        many requests don't accumulate stale notifications."""
+        s = self._server_socket
+        if s is None:
+            return
+        wrapped = lambda _sock: callback()   # noqa: E731
+        self._cancel_subs.append((s, wrapped))
+        s.on_failed(wrapped)
+
+    def _drop_cancel_subs(self) -> None:
+        """Called when the server request completes: a finished
+        request must not hear about later connection deaths."""
+        subs, self._cancel_subs = self._cancel_subs, []
+        for s, cb in subs:
+            try:
+                s.off_failed(cb)
+            except AttributeError:
+                pass
 
     def join(self, timeout_s: Optional[float] = None) -> bool:
         """Block the calling thread until the call finishes."""

@@ -170,6 +170,8 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
         # kvmap.h: one greppable line per session — even when the
         # response write throws (peer already gone)
         cntl.flush_session_kv()
+        cntl._drop_cancel_subs()   # finished requests must not hear
+        #                            about later connection deaths
 
 
 def _send_response(proto, socket, cid: int, cntl: Controller,

@@ -70,6 +70,7 @@ class Socket:
         # matched to messages by FIFO order)
         self.lane_lock = threading.Lock()
         self._on_failed_cbs: list = []
+        self._failed_cb_lock = threading.Lock()   # failed-flag/append race
         self.id: SocketId = _socket_pool.insert(self)
         conn.start_events(self._on_readable_event, self._on_writable_event)
 
@@ -213,8 +214,40 @@ class Socket:
         with self._nevent_lock:
             self._nevent += 1
             if self._nevent > 1:
-                return
-        self._control.spawn(self._process_input, name="socket_input")
+                busy = True
+            else:
+                busy = False
+        if not busy:
+            self._control.spawn(self._process_input, name="socket_input")
+            return
+        # the input fiber is busy — possibly SUSPENDED awaiting a long
+        # handler, in which case it cannot drain this event for a
+        # while. A dead peer must still become visible NOW
+        # (Controller::IsCanceled / NotifyOnCancel): cheap non-consuming
+        # EOF probe from the dispatcher (the reference's event
+        # dispatcher detects the hangup independently of message
+        # processing for the same reason)
+        peek = getattr(self.conn, "peek_closed", None)
+        if peek is not None:
+            try:
+                if peek():
+                    # NOT inline: set_failed runs user notify_on_cancel
+                    # callbacks — a blocking one must not stall the
+                    # process-wide dispatcher thread (the reference runs
+                    # NotifyOnCancel in a fresh bthread)
+                    self._control.spawn(
+                        lambda: self.set_failed(
+                            ConnectionResetError("peer closed")))
+                else:
+                    # data (not FIN) arrived while the input fiber is
+                    # busy: with one-shot arming this event consumed the
+                    # read interest — re-arm, or a later FIN during the
+                    # same handler produces no event at all
+                    resume = getattr(self.conn, "resume_read_events", None)
+                    if resume is not None:
+                        resume()
+            except Exception:
+                pass
 
     async def _process_input(self):
         while True:
@@ -286,10 +319,12 @@ class Socket:
     def set_failed(self, reason: Optional[BaseException] = None) -> None:
         """Version-bump the id (outstanding SocketIds go stale), close the
         conn, fire failure callbacks (SetFailed, socket.cpp)."""
-        if self.failed:
-            return
-        self.failed = True
-        self.fail_reason = reason or ConnectionError("socket set_failed")
+        with self._failed_cb_lock:
+            if self.failed:
+                return
+            self.failed = True
+            self.fail_reason = reason or ConnectionError("socket set_failed")
+            cbs = list(self._on_failed_cbs)
         _socket_pool.remove(self.id)
         try:
             self.conn.close()
@@ -297,25 +332,30 @@ class Socket:
             pass
         self._writable_butex.fetch_add(1)
         self._writable_butex.wake_all()
-        for cb in list(self._on_failed_cbs):
+        for cb in cbs:
             try:
                 cb(self)
             except Exception:
                 pass
 
     def on_failed(self, cb: Callable[["Socket"], None]) -> None:
-        if self.failed:
-            cb(self)
-        else:
-            self._on_failed_cbs.append(cb)
+        # flag-check and append under one lock: a registration racing
+        # set_failed's snapshot would otherwise be lost forever
+        # (notify_on_cancel waiters would never fire)
+        with self._failed_cb_lock:
+            if not self.failed:
+                self._on_failed_cbs.append(cb)
+                return
+        cb(self)
 
     def off_failed(self, cb: Callable[["Socket"], None]) -> None:
         """Unsubscribe a failure callback (no-op if absent): long-lived
         multiplexed sockets must not accumulate dead subscribers."""
-        try:
-            self._on_failed_cbs.remove(cb)
-        except ValueError:
-            pass
+        with self._failed_cb_lock:
+            try:
+                self._on_failed_cbs.remove(cb)
+            except ValueError:
+                pass
 
 
 def create_client_socket(ep: EndPoint, on_input: Optional[Callable] = None,

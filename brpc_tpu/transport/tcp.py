@@ -59,6 +59,17 @@ class TcpConn(Conn):
                 raise BlockingIOError from e
             raise
 
+    def peek_closed(self) -> bool:
+        """Non-consuming liveness probe (MSG_PEEK): True only when the
+        peer's FIN has arrived AND no data remains to deliver — pending
+        bytes keep the connection alive until a drain sees them."""
+        try:
+            return self._sock.recv(1, pysocket.MSG_PEEK) == b""
+        except (BlockingIOError, InterruptedError):
+            return False
+        except OSError:
+            return True
+
     def close(self) -> None:
         if self._closed:
             return

@@ -619,3 +619,76 @@ class TestConnectionTypes:
         # cancel after completion: no-op
         ok.start_cancel()
         assert not ok.failed()
+
+    def test_server_side_cancel_detection(self):
+        """IsCanceled/NotifyOnCancel: a handler learns the client's
+        connection died and can stop early."""
+        # usercode_in_pthread: the handler must not monopolize the
+        # input fiber or the EOF is only drained after it returns
+        server = Server(ServerOptions(enable_builtin_services=False,
+                                      usercode_in_pthread=True))
+        svc = Service("CxlService")
+        observed = {"canceled_at": None, "notified": threading.Event()}
+        started = threading.Event()
+
+        @svc.method()
+        def LongWork(cntl, request):
+            cntl.notify_on_cancel(observed["notified"].set)
+            started.set()
+            for i in range(100):
+                if cntl.is_canceled():
+                    observed["canceled_at"] = i
+                    return b""
+                time.sleep(0.02)
+            return b"finished"
+
+        server.add_service(svc)
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                         ChannelOptions(timeout_ms=10000, max_retry=0))
+            cntl = ch.call("CxlService", "LongWork", b"x")
+            assert started.wait(5)
+            ch.close()   # client walks away; server conn dies
+            assert observed["notified"].wait(5), \
+                "notify_on_cancel never fired"
+            deadline = time.time() + 5
+            while observed["canceled_at"] is None and time.time() < deadline:
+                time.sleep(0.05)
+            assert observed["canceled_at"] is not None, \
+                "handler never saw is_canceled()"
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_notify_on_cancel_unsubscribes_at_completion(self):
+        """A finished request's cancel subscription is dropped: closing
+        the connection later must not fire stale notifications, and the
+        socket's callback list must not grow per request."""
+        server = Server(ServerOptions(enable_builtin_services=False))
+        svc = Service("NSub")
+        fired = []
+
+        @svc.method()
+        def Quick(cntl, request):
+            cntl.notify_on_cancel(lambda: fired.append(1))
+            return b"done"
+
+        server.add_service(svc)
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                         ChannelOptions(timeout_ms=5000))
+            for _ in range(20):
+                assert not ch.call_sync("NSub", "Quick", b"x").failed()
+            conns = [s for s in server.connections() if not s.failed]
+            assert conns
+            # subscriptions were dropped as each request completed
+            assert all(len(s._on_failed_cbs) <= 2 for s in conns), \
+                [len(s._on_failed_cbs) for s in conns]
+            ch.close()
+            time.sleep(0.3)
+            assert not fired, "stale cancel notification fired"
+        finally:
+            server.stop()
+            server.join(2)
