@@ -62,6 +62,7 @@ class Socket:
         self._writable_butex = Butex(0)
         self._nevent = 0                          # edge-trigger input counter
         self._nevent_lock = threading.Lock()
+        self._busy_rearmed = False   # one probe re-arm per busy period
         self._read_hint = 8192                    # adaptive read-block size
         self.preferred_protocol = -1              # InputMessenger cache
         self.user_data: dict = {}                 # per-conn session state
@@ -238,11 +239,17 @@ class Socket:
                     self._control.spawn(
                         lambda: self.set_failed(
                             ConnectionResetError("peer closed")))
-                else:
+                elif not self._busy_rearmed:
                     # data (not FIN) arrived while the input fiber is
                     # busy: with one-shot arming this event consumed the
-                    # read interest — re-arm, or a later FIN during the
-                    # same handler produces no event at all
+                    # read interest — re-arm so a later FIN during the
+                    # same handler still produces an event. ONCE per
+                    # busy period (flag cleared when the input fiber
+                    # drains to idle): unconditional re-arm with data
+                    # pending would storm the dispatcher (event -> peek
+                    # -> re-arm -> immediate event ...), and the input
+                    # loop re-drains pending data anyway via _nevent
+                    self._busy_rearmed = True
                     resume = getattr(self.conn, "resume_read_events", None)
                     if resume is not None:
                         resume()
@@ -272,6 +279,7 @@ class Socket:
                 self._nevent -= pending
                 if self._nevent > 0:
                     continue
+                self._busy_rearmed = False   # busy period over
                 return
 
     def _drain_readable(self) -> int:
