@@ -49,6 +49,15 @@ def tune_malloc(mmap_threshold: int = 32 << 20,
         ok = bool(libc.mallopt(_M_MMAP_THRESHOLD, mmap_threshold))
         ok = bool(libc.mallopt(_M_TRIM_THRESHOLD, trim_threshold)) and ok
         _applied = ok
+        if ok:
+            # one-time discoverability for embedders wondering why RSS
+            # rose: this retunes glibc malloc process-wide
+            import logging
+            logging.getLogger("brpc_tpu").debug(
+                "mallopt: M_MMAP_THRESHOLD=%dMB M_TRIM_THRESHOLD=%dMB "
+                "(freed large blocks stay on heap; set "
+                "BRPC_TPU_NO_MALLOPT=1 before import to opt out)",
+                mmap_threshold >> 20, trim_threshold >> 20)
         return ok
     except Exception:
         return False

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import itertools
 import struct
+import threading
 from typing import List, NamedTuple, Optional, Tuple
 
 from brpc_tpu.butil.endpoint import EndPoint
@@ -180,7 +181,12 @@ class MemcacheClient(PipelinedClient):
         self._opaque = itertools.count(1)
         self._username = username
         self._password = password or ""
-        self._sasl_opaque: Optional[int] = None
+        # thread-local: _hello_commands and _check_hello_reply both run
+        # on the connecting thread inside one _get_socket call, while a
+        # concurrent connect on another thread generates its own opaque
+        # — instance state here would let one connection's check compare
+        # against the other's opaque
+        self._sasl_expect = threading.local()
 
     # ----------------------------------------------------------- sasl auth
     def _hello_commands(self):
@@ -188,15 +194,16 @@ class MemcacheClient(PipelinedClient):
             return []
         token = b"\x00" + self._username.encode() + \
             b"\x00" + self._password.encode()
-        self._sasl_opaque = next(self._opaque)
+        self._sasl_expect.opaque = next(self._opaque)
         return [pack_request(OP_SASL_AUTH, b"PLAIN", token,
-                             opaque=self._sasl_opaque)]
+                             opaque=self._sasl_expect.opaque)]
 
     def _check_hello_reply(self, reply) -> None:
         # strict: the hello reply must BE the SASL reply (same desync
         # tripwire as _call) — a stray frame here must not be mistaken
         # for a successful authentication
-        if reply.opcode != OP_SASL_AUTH or reply.opaque != self._sasl_opaque:
+        expected = getattr(self._sasl_expect, "opaque", None)
+        if reply.opcode != OP_SASL_AUTH or reply.opaque != expected:
             raise MemcacheError(-1, "sasl reply desync "
                                 f"(opcode 0x{reply.opcode:02x})")
         if reply.status != STATUS_OK:

@@ -18,6 +18,21 @@ from brpc_tpu.butil.endpoint import EndPoint
 from brpc_tpu.butil.fast_rand import fast_rand_less_than
 
 
+def _ep_weight(s: EndPoint) -> int:
+    """Endpoint extra 'w' as an int weight >= 1; tolerant of float
+    strings from naming sources and of malformed/inf values (a bad
+    weight must never take down a naming-reset path)."""
+    try:
+        w = float(s.extra("w", "1") or "1")
+    except (TypeError, ValueError):
+        return 1
+    if w != w or w in (float("inf"), float("-inf")):
+        return 1
+    # capped: wrr expands to [server] * weight, so an absurd value must
+    # degrade to a bounded list, not an OOM
+    return min(10000, max(1, int(w)))
+
+
 class LoadBalancer:
     def reset_servers(self, servers: Sequence[EndPoint]) -> None:
         raise NotImplementedError
@@ -96,8 +111,7 @@ class WeightedRoundRobinLB(_SnapshotLB):
     def _on_reset(self, snapshot):
         out: List[EndPoint] = []
         for s in snapshot:
-            w = int(s.extra("w", "1") or "1")
-            out.extend([s] * max(1, w))
+            out.extend([s] * _ep_weight(s))
         self._expanded = tuple(out)
 
     def select_server(self, exclude=None, request_key=None):
@@ -125,8 +139,7 @@ class WeightedRandomLB(_SnapshotLB):
         self._weighted: Tuple[Tuple[EndPoint, int], ...] = ()
 
     def _on_reset(self, snapshot):
-        self._weighted = tuple(
-            (s, max(1, int(s.extra("w", "1") or "1"))) for s in snapshot)
+        self._weighted = tuple((s, _ep_weight(s)) for s in snapshot)
 
     def select_server(self, exclude=None, request_key=None):
         pool = [(s, w) for s, w in self._weighted

@@ -229,7 +229,23 @@ class NacosNamingService(_RegistryNamingService):
             ep = EndPoint("tcp", h["ip"], int(h["port"]))
             w = h.get("weight")
             if w is not None:
-                ep = ep.with_extras(weight=w)
+                # Nacos weights are floats; the weighted LBs read extra
+                # 'w' as an int (load_balancer.py wrr/wr convention).
+                # weight<=0 means "drained" in Nacos — skip the host
+                # like unhealthy/disabled ones. Malformed/inf weights
+                # fall back to 1 rather than killing the polling loop.
+                try:
+                    wf = float(w)
+                except (TypeError, ValueError):
+                    wf = 1.0
+                if wf != wf:       # NaN: int(nan) would raise and
+                    wf = 1.0       # freeze the whole poll result
+                if wf <= 0:
+                    continue
+                # cap: wrr materializes weight copies per server, so an
+                # absurd registry value must not OOM the reset path
+                ep = ep.with_extras(
+                    w=1 if wf == float("inf") else min(10000, max(1, int(wf))))
             eps.append(ep)
         return eps
 

@@ -239,11 +239,15 @@ class Stream:
                 self._send_frame(b"", None, close=True, data=False)
             except Exception:
                 pass
-        if self.socket is not None:
-            # drop the failure subscription: a long-lived multiplexed
-            # socket must not keep dead streams reachable
+        # drop the failure subscription: a long-lived multiplexed socket
+        # must not keep dead streams reachable. The subscription lives
+        # on _subscribed_sock, which can lag self.socket when the send
+        # path plain-assigned a newer socket after binding.
+        sub = getattr(self, "_subscribed_sock", None)
+        if sub is not None:
+            self._subscribed_sock = None
             try:
-                self.socket.off_failed(self._on_socket_failed)
+                sub.off_failed(self._on_socket_failed)
             except AttributeError:
                 pass
         _stream_pool.remove(self.id)

@@ -101,7 +101,15 @@ class TestNacos:
             got = _wait_servers(nt, {("10.1.0.1", 9001)})
             assert got == {("10.1.0.1", 9001)}
             eps = nt.servers()
-            assert eps[0].extra("weight") == "3.0"
+            # weight lands under 'w' — the key the weighted LBs read
+            # (load_balancer.py wrr/wr) — int-coerced from Nacos floats
+            assert eps[0].extra("w") == "3"
+            from brpc_tpu.rpc.load_balancer import WeightedRoundRobinLB
+            lb = WeightedRoundRobinLB()
+            lb.reset_servers(eps)
+            picks = [lb.select_server() for _ in range(6)]
+            assert all(p.host == "10.1.0.1" for p in picks)
+            assert len(lb._expanded) == 3  # weight actually expanded
         finally:
             nt.stop()
             reg.close()
