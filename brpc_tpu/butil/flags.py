@@ -77,7 +77,10 @@ define_flag("max_body_size", 64 * 1024 * 1024,
             validator=lambda v: v > 0)
 define_flag("graceful_quit_on_sigterm", True,
             "drain in-flight requests before exiting on SIGTERM")
-define_flag("rpcz_enabled", True, "collect per-RPC spans for /rpcz")
+define_flag("rpcz_enabled", False,
+            "collect per-RPC spans for /rpcz (off by default like the "
+            "reference's rpcz — enable at runtime via /flags; span "
+            "creation + trace propagation cost sits on every call)")
 define_flag("rpcz_max_spans", 1024, "span ring-buffer capacity",
             validator=lambda v: v >= 16)
 define_flag("tpu_std_batch_parse", False,

@@ -102,7 +102,7 @@ class Fiber:
         self.bound_group: Optional[int] = None
         self.locals: dict = {}
         self.name = name
-        self._done_event = threading.Event()
+        self._done_event = None    # lazily created on first join()
         self._joiner_butex = None  # lazily created Butex for fiber joiners
         self._resume_value: Any = None
         self._key_destructors: List[Callable] = []
@@ -115,7 +115,18 @@ class Fiber:
     def join(self, timeout: Optional[float] = None) -> bool:
         """Block the calling *thread* until the fiber finishes. Safe from
         non-fiber threads; inside a fiber prefer ``await fiber.join_async()``."""
-        return self._done_event.wait(timeout)
+        if self.state == FIBER_STATE_DONE:
+            return True
+        # the done Event is lazy (most fibers are never thread-joined):
+        # create under the lock and re-check, so a _finish racing this
+        # join either sees the event or already published DONE
+        with _joiner_init_lock:
+            if self.state == FIBER_STATE_DONE:
+                return True
+            ev = self._done_event
+            if ev is None:
+                ev = self._done_event = threading.Event()
+        return ev.wait(timeout)
 
     def join_async(self) -> SchedAwaitable:
         """Awaitable join for use inside another fiber."""
@@ -150,7 +161,14 @@ class Fiber:
         self.state = FIBER_STATE_DONE
         if self._joiner_butex is not None:
             self._joiner_butex.set_and_wake_all(1)
-        self._done_event.set()
+        # pair with join()'s lazy creation: after DONE is published, any
+        # event a joiner managed to install must still be set
+        ev = self._done_event
+        if ev is None:
+            with _joiner_init_lock:
+                ev = self._done_event
+        if ev is not None:
+            ev.set()
         self.control.nfibers.add(-1)
         if exc is not None and not isinstance(exc, SystemExit):
             self.control.on_fiber_error(self, exc)
@@ -163,6 +181,7 @@ class _WorkerTLS(threading.local):
     def __init__(self):
         self.group: Optional["TaskGroup"] = None
         self.current: Optional[Fiber] = None
+        self.inline_depth: int = 0
 
 
 _tls = _WorkerTLS()
@@ -310,6 +329,42 @@ class TaskControl:
         # caller requeued" can't preempt a Python frame, and `urgent` adds
         # nothing beyond the LIFO push; it is accepted for API parity only
         self.schedule(fiber, None)
+        return fiber
+
+    def run_inline(self, fn: Callable | Any, *args, name: str = "",
+                   max_depth: int = 8, **kwargs) -> Fiber:
+        """Step a new fiber on the CALLING thread until it completes or
+        first suspends — the reference's process-in-place discipline
+        (input_messenger.cpp:183 runs the last message in the receiving
+        context) generalized: a handler chain that never blocks pays
+        zero fiber wakes and zero cross-thread handoffs. On the first
+        real suspension the remainder parks exactly like a spawned
+        fiber (the awaitable registers it for a normal wake).
+
+        ``max_depth`` bounds same-thread nesting (an inline handler
+        whose write triggers the peer's inline processing recurses on
+        this stack); past the cap we fall back to spawn."""
+        depth = _tls.inline_depth
+        if depth >= max_depth:
+            return self.spawn(fn, *args, name=name, **kwargs)
+        if inspect.iscoroutine(fn):
+            coro = fn
+        elif inspect.iscoroutinefunction(fn):
+            coro = fn(*args, **kwargs)
+        else:
+            return self.spawn(fn, *args, name=name, **kwargs)
+        fiber = Fiber(coro, self, name=name)
+        self.nfibers.add(1)
+        self.nfibers_created.add(1)
+        if not self._started:
+            # a suspension hands the continuation to the workers
+            self.start()
+        group = _tls.group or self.groups[0]
+        _tls.inline_depth = depth + 1
+        try:
+            self._step(group, fiber)
+        finally:
+            _tls.inline_depth = depth
         return fiber
 
     def schedule(self, fiber: Fiber, resume_value: Any, to_tail: bool = False) -> None:

@@ -37,9 +37,16 @@ class TimerThread:
             tid = next(self._seq)
             box = [fn]
             self._boxes[tid] = box
+            # wake the timer thread only when this deadline BEATS the
+            # current front: its ongoing sleep already covers any later
+            # deadline, and an unconditional notify costs a thread wake
+            # per armed RPC deadline (nearest-deadline discipline,
+            # timer_thread.cpp)
+            wake = not self._heap or deadline < self._heap[0][0]
             heapq.heappush(self._heap, (deadline, tid, box))
             self._ensure_thread()
-            self._cond.notify()
+            if wake:
+                self._cond.notify()
         return tid
 
     def schedule_after(self, delay_s: float, fn: Callable[[], None]) -> int:

@@ -20,17 +20,28 @@ CXXFLAGS = ["-O2", "-g", "-std=c++17", "-fPIC", "-shared", "-pthread",
             "-Wall", "-Wextra", "-fno-exceptions"]
 
 
+# fastcore.cc is a CPython extension module (needs Python headers,
+# exports PyInit__brpc_fastcore) — built separately from the C-ABI lib
+FASTCORE_SRCS = ("fastcore.cc", "respool.cc", "queues.cc")
+FASTCORE_PATH = os.path.join(_DIR, "_brpc_fastcore.so")
+
+
 def sources() -> list:
     return sorted(
-        os.path.join(SRC_DIR, f) for f in os.listdir(SRC_DIR) if f.endswith(".cc")
+        os.path.join(SRC_DIR, f) for f in os.listdir(SRC_DIR)
+        if f.endswith(".cc") and f != "fastcore.cc"
     )
 
 
-def needs_build() -> bool:
-    if not os.path.exists(LIB_PATH):
+def _stale(out_path: str, srcs) -> bool:
+    if not os.path.exists(out_path):
         return True
-    lib_mtime = os.path.getmtime(LIB_PATH)
-    return any(os.path.getmtime(s) > lib_mtime for s in sources())
+    mtime = os.path.getmtime(out_path)
+    return any(os.path.getmtime(s) > mtime for s in srcs)
+
+
+def needs_build() -> bool:
+    return _stale(LIB_PATH, sources())
 
 
 def build(force: bool = False) -> str:
@@ -45,6 +56,22 @@ def build(force: bool = False) -> str:
     return LIB_PATH
 
 
+def build_fastcore(force: bool = False) -> str:
+    """Compile the _brpc_fastcore CPython extension if stale."""
+    import sysconfig
+    srcs = [os.path.join(SRC_DIR, f) for f in FASTCORE_SRCS]
+    if not force and not _stale(FASTCORE_PATH, srcs):
+        return FASTCORE_PATH
+    include = sysconfig.get_paths()["include"]
+    cmd = [CXX, *CXXFLAGS, f"-I{include}", "-o", FASTCORE_PATH, *srcs]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"fastcore build failed:\n$ {' '.join(cmd)}\n{proc.stderr}")
+    return FASTCORE_PATH
+
+
 if __name__ == "__main__":
     path = build(force="--force" in sys.argv)
     print(path)
+    print(build_fastcore(force="--force" in sys.argv))

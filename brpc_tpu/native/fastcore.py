@@ -1,0 +1,45 @@
+"""Loader for the _brpc_fastcore CPython extension.
+
+``get()`` returns the extension module or None (no compiler, build
+failure, or BRPC_TPU_NO_NATIVE set) — every consumer keeps a pure-Python
+fallback, mirroring how the ctypes library is loaded
+(brpc_tpu/native/__init__.py). The extension puts the native cores on
+the per-call hot path: see src/fastcore.cc for what maps where.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import threading
+
+_lock = threading.Lock()
+_mod = None
+_tried = False
+
+
+def get():
+    global _mod, _tried
+    if _mod is not None or _tried:
+        return _mod
+    with _lock:
+        if _mod is not None or _tried:
+            return _mod
+        _tried = True
+        if os.environ.get("BRPC_TPU_NO_NATIVE"):
+            return None
+        try:
+            from brpc_tpu.native.build import build_fastcore
+            path = build_fastcore()
+            spec = importlib.util.spec_from_file_location(
+                "_brpc_fastcore", path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _mod = mod
+        except Exception:
+            _mod = None
+    return _mod
+
+
+def available() -> bool:
+    return get() is not None

@@ -115,6 +115,10 @@ struct MpscNode {
 // the rest of the queue and free the node under the producer).
 MpscNode* const kUnlinked = reinterpret_cast<MpscNode*>(1);
 
+// "A writer is active" head sentinel for the _w retire protocol below —
+// declared here so destroy/drain treat it as an end-of-chain marker.
+MpscNode* const kWriting = reinterpret_cast<MpscNode*>(2);
+
 MpscNode* resolve_next(MpscNode* n) {
   MpscNode* nx = n->next.load(std::memory_order_acquire);
   while (nx == kUnlinked) {
@@ -140,7 +144,11 @@ bt_mpsc* bt_mpsc_create() { return new bt_mpsc(); }
 void bt_mpsc_destroy(bt_mpsc* q) {
   if (q == nullptr) return;
   MpscNode* n = q->head.exchange(nullptr, std::memory_order_acquire);
-  while (n) { MpscNode* nx = resolve_next(n); delete n; n = nx; }
+  while (n != nullptr && n != kWriting) {
+    MpscNode* nx = resolve_next(n);
+    delete n;
+    n = nx;
+  }
   n = q->pending;
   while (n) {
     MpscNode* nx = n->next.load(std::memory_order_relaxed);
@@ -189,6 +197,65 @@ size_t bt_mpsc_drain(bt_mpsc* q, uint64_t* out, size_t max) {
 
 uint64_t bt_mpsc_pushed(bt_mpsc* q) {
   return q->pushed.load(std::memory_order_relaxed);
+}
+
+uint64_t bt_mpsc_drained(bt_mpsc* q) {
+  return q->drained.load(std::memory_order_relaxed);
+}
+
+}  // extern "C"
+
+// ---- writer-retire protocol (socket.cpp IsWriteComplete) -------------
+//
+// The plain drain above retires implicitly by exchanging the head to
+// nullptr, which lets a producer claim writership while the old writer
+// still holds FIFO leftovers in `pending` — fine for queues with an
+// external writer lock, wrong as THE arbitration. The _w family keeps a
+// kWriting sentinel in the head while a writer is active: producers who
+// exchange against it do NOT claim; the writer retires only by CASing
+// kWriting back to nullptr once both its FIFO and the head are empty —
+// exactly the reference's CAS-on-_write_head retire.
+
+extern "C" {
+
+// Drain up to max items while KEEPING writership (head left at kWriting
+// when emptied). Single consumer (the current writer) only.
+size_t bt_mpsc_drain_w(bt_mpsc* q, uint64_t* out, size_t max) {
+  size_t n = 0;
+  while (n < max) {
+    if (q->pending == nullptr) {
+      MpscNode* grabbed = q->head.exchange(kWriting, std::memory_order_acq_rel);
+      if (grabbed == nullptr || grabbed == kWriting) break;
+      MpscNode* rev = nullptr;
+      while (grabbed != nullptr && grabbed != kWriting) {
+        MpscNode* nx = resolve_next(grabbed);
+        grabbed->next.store(rev, std::memory_order_relaxed);
+        rev = grabbed;
+        grabbed = nx;
+      }
+      q->pending = rev;
+      if (q->pending == nullptr) break;
+    }
+    MpscNode* node = q->pending;
+    q->pending = node->next.load(std::memory_order_relaxed);
+    out[n++] = node->value;
+    delete node;
+  }
+  q->drained.fetch_add(n, std::memory_order_relaxed);
+  return n;
+}
+
+// Attempt to release writership. True = retired (queue confirmed empty);
+// false = new items arrived, caller must keep draining.
+bool bt_mpsc_try_retire(bt_mpsc* q) {
+  if (q->pending != nullptr) return false;
+  MpscNode* expect = kWriting;
+  if (q->head.compare_exchange_strong(expect, nullptr,
+                                      std::memory_order_acq_rel))
+    return true;
+  // expect now holds the observed head: real nodes mean new work; a
+  // nullptr means we were never the writer (idempotent retire)
+  return expect == nullptr;
 }
 
 }  // extern "C"

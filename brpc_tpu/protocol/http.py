@@ -331,7 +331,8 @@ class HttpProtocol(Protocol):
                 "local": str(s.local_endpoint) if s.local_endpoint else None,
                 "failed": s.failed,
                 "fail_reason": str(getattr(s, "fail_reason", "") or ""),
-                "write_queue": len(getattr(s, "_write_q", []) or []),
+                "write_queue": (s._wq.depth()
+                                if getattr(s, "_wq", None) is not None else 0),
                 "preferred_protocol": s.preferred_protocol,
             })
             # device-lane introspection for ici:// conns (the page the

@@ -20,7 +20,8 @@ from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.fiber import TaskControl, global_control
 from brpc_tpu.fiber.timer import global_timer
 from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
-from brpc_tpu.protocol.tpu_std import pack_message, serialize_payload
+from brpc_tpu.protocol.tpu_std import (pack_message, pack_small_frame,
+                                       serialize_payload)
 from brpc_tpu.rpc import errno_codes as berr
 from brpc_tpu.rpc.controller import Controller, address_call, take_call
 from brpc_tpu.transport.input_messenger import InputMessenger
@@ -96,6 +97,11 @@ class Channel:
         self._map_key = None                 # global SocketMap lease key
         self._endpoint: Optional[EndPoint] = None
         self._framer_cache = None
+        # (service, method, timeout_ms, auth_token) -> serialized RpcMeta
+        # prefix (everything but correlation_id/attachment_size); the
+        # small-call fast path appends those as hand-encoded varint
+        # fields per call instead of building a pb object
+        self._meta_prefix_cache: dict = {}
         # pooled-connection_type freelist (socket.h connection pooling)
         self._conn_pool: List[Socket] = []
         self._pool_lock = threading.Lock()
@@ -198,7 +204,10 @@ class Channel:
                 cntl.auth_token = self.options.auth_token
         if request_device_arrays:
             cntl.request_device_arrays = list(request_device_arrays)
-        cntl.response_msg = response_class() if response_class is not None else None
+        if response_class is not None:
+            cntl.response_msg = response_class()
+        elif cntl.response_msg is not None:
+            cntl.response_msg = None
         cntl._service_name = service_name
         cntl._method_name = method_name
         cntl._request_bytes = serialize_payload(request)
@@ -226,17 +235,36 @@ class Channel:
             hook._span_hook = True
             cntl._complete_hooks.append(hook)
         cntl._owner_channel = self  # response-path retry needs the channel
-        cntl._register_call()
+        try:
+            cntl._register_call()
+        except OverflowError as e:
+            # bounded correlation-id space (native respool): complete
+            # the call with ELIMIT instead of crashing the caller —
+            # in-flight backpressure, matching concurrency-limiter
+            # semantics
+            cntl.set_failed(berr.ELIMIT, str(e))
+            cntl._complete()
+            return cntl
         self._issue_rpc(cntl)
-        # deadline timer: final — no retry after it fires (HandleTimeout)
-        if cntl.timeout_ms is not None:
+        # deadline timer: final — no retry after it fires (HandleTimeout).
+        # With inline input processing the response may have completed
+        # DURING _issue_rpc: arming then would pin the controller in the
+        # timer heap for the full timeout (the leak unschedule exists to
+        # prevent), so check first — and re-check after arming, because a
+        # completion on another thread can interleave with the arm.
+        if cntl.timeout_ms is not None and not cntl._completed:
             tid = global_timer().schedule_after(
                 cntl.timeout_ms / 1e3, lambda: self._on_timeout(cntl))
             cntl._timer_ids.append(tid)
-        if cntl.backup_request_ms is not None and cntl.backup_request_ms > 0:
+            if cntl._completed:
+                global_timer().unschedule(tid)
+        if cntl.backup_request_ms is not None and cntl.backup_request_ms > 0 \
+                and not cntl._completed:
             tid = global_timer().schedule_after(
                 cntl.backup_request_ms / 1e3, lambda: self._on_backup_timer(cntl))
             cntl._timer_ids.append(tid)
+            if cntl._completed:
+                global_timer().unschedule(tid)
         return cntl
 
     def call_sync(self, service_name: str, method_name: str, request: Any = b"",
@@ -336,6 +364,42 @@ class Channel:
             return
         cntl.remote_side = sock.remote_endpoint
         cntl.local_side = sock.local_endpoint
+        # small-call fast path: the default protocol with none of the
+        # optional sections (compress/trace/stream/device arrays) frames
+        # from a cached meta prefix into ONE bytes object and sends it
+        # straight from this context — no pb object, no IOBuf
+        if (self._framer_cache is pack_message or
+                (self._framer_cache is None
+                 and self.options.protocol in ("", "tpu_std"))) \
+                and not cntl.compress_type and not cntl.trace_id \
+                and cntl.stream is None \
+                and not cntl.__dict__.get("request_device_arrays") \
+                and cntl.log_id == 0:
+            key = (cntl._service_name, cntl._method_name, cntl.timeout_ms,
+                   cntl.auth_token)
+            prefix = self._meta_prefix_cache.get(key)
+            if prefix is None:
+                m = pb.RpcMeta()
+                m.request.service_name = cntl._service_name
+                m.request.method_name = cntl._method_name
+                if cntl.timeout_ms is not None:
+                    m.request.timeout_ms = int(cntl.timeout_ms)
+                if cntl.auth_token:
+                    m.request.auth_token = cntl.auth_token
+                prefix = m.SerializeToString()
+                if len(self._meta_prefix_cache) < 4096:
+                    self._meta_prefix_cache[key] = prefix
+            att = cntl.__dict__.get("request_attachment")
+            wire = pack_small_frame(prefix, cntl.correlation_id,
+                                    cntl._request_bytes,
+                                    att.to_bytes() if att else b"")
+            try:
+                sock.write_small(wire, on_done=lambda err, s=sock:
+                                 self._on_write_done(cntl, err, s))
+            except (BlockingIOError, ConnectionError, OSError) as e:
+                self._maybe_retry(cntl, berr.EFAILEDSOCKET, str(e),
+                                  failed_ep=sock.remote_endpoint)
+            return
         meta = pb.RpcMeta()
         meta.request.service_name = cntl._service_name
         meta.request.method_name = cntl._method_name

@@ -24,87 +24,146 @@ from brpc_tpu.fiber.sync import FiberEvent
 from brpc_tpu.rpc import errno_codes as berr
 
 # global correlation-id pool: id -> client Controller (the reference's
-# bthread_id space, id.h:46)
-_call_pool: ResourcePool = ResourcePool()
-# reserve slot 0 forever: correlation id 0 must stay invalid, because
-# proto3 serializes 0 as an absent field (a frame with no/zero
-# correlation_id must never address a live call)
-_call_pool.insert(None)
+# bthread_id space, id.h:46). Native when available: fastcore's Pool is
+# respool.cc (versioned slots, odd-version-live) holding the Controller
+# objects — ids are never 0 by construction there. Resolved on FIRST
+# USE, not import: fastcore.get() may compile the extension, and module
+# import must stay cheap.
+_call_pool = None
+_call_pool_lock = threading.Lock()
+
+
+def _pool():
+    p = _call_pool
+    if p is None:
+        p = _make_pool()
+    return p
+
+
+def _make_pool():
+    # locked: two first-RPC threads must agree on ONE pool — a call
+    # registered in a discarded duplicate would never hear its response
+    global _call_pool
+    with _call_pool_lock:
+        if _call_pool is None:
+            from brpc_tpu.native import fastcore as _fastcore
+            fc = _fastcore.get()
+            if fc is not None:
+                _call_pool = fc.Pool(1 << 17)
+            else:
+                p = ResourcePool()
+                # reserve slot 0 forever: correlation id 0 must stay
+                # invalid, because proto3 serializes 0 as an absent
+                # field (a frame with no/zero correlation_id must never
+                # address a live call)
+                p.insert(None)
+                _call_pool = p
+        return _call_pool
 
 
 def address_call(correlation_id: int):
-    return _call_pool.address(correlation_id)
+    return _pool().address(correlation_id)
 
 
 def take_call(correlation_id: int):
     """Remove-and-return: the first finisher wins; stale responses and
     fired timers lose the race here (OnVersionedRPCReturned's version
     check, controller.cpp:575)."""
-    return _call_pool.remove(correlation_id)
+    return _pool().remove(correlation_id)
+
+
+_lazy_create_lock = threading.Lock()
+_MISSING = object()
 
 
 class Controller:
+    """Scalar fields live as CLASS defaults (an instance attribute
+    appears only when written) and mutable members are created lazily on
+    first touch — a Controller is built per call on BOTH sides of every
+    RPC, and the reference keeps the equivalent cheap by pooling
+    (resource_pool.h:14-47); in Python the analogous lever is not
+    allocating the ~15 sub-objects a call never uses."""
+
+    # ---- shared scalars
+    error_code: int = berr.OK
+    error_text: str = ""
+    log_id: int = 0
+    remote_side: Optional[EndPoint] = None
+    local_side: Optional[EndPoint] = None
+    auth_token: str = ""
+    auth_context = None        # server side: verified peer identity
+    compress_type: int = 0
+    trace_id: int = 0
+    span_id: int = 0
+    # ---- client side scalars
+    timeout_ms: Optional[float] = None
+    max_retry: Optional[int] = None   # None = inherit channel option
+    backup_request_ms: Optional[float] = None
+    correlation_id: int = 0
+    response_payload: Optional[IOBuf] = None
+    response_msg: Any = None
+    _done_cb: Optional[Callable] = None
+    current_try: int = 0
+    start_us: int = 0
+    end_us: int = 0
+    used_backup: bool = False
+    stream = None              # Stream piggybacked on this call
+    # which server's response actually completed the call (set by
+    # process_response; None on timeout/failure) — with backup
+    # requests, tried_servers[-1] is NOT necessarily the winner
+    responded_server = None
+    _lb_swept_n: Optional[int] = None
+    _owner_channel = None
+    # ---- client call internals (set by Channel.call)
+    _service_name: str = ""
+    _method_name: str = ""
+    _request_bytes: bytes = b""
+    # ---- server side scalars
+    _server_socket = None
+    _response_sender: Optional[Callable] = None
+    _progressive = None        # ProgressiveAttachment (http chunked)
+    _session_local = None      # borrowed from the server's data pool
+    _session_kv: Optional[dict] = None    # kvmap.h SessionKV
+    _completed = False         # set under _arb_lock by _complete
+
+    # mutable members, created on first touch. _lb_lock guards the
+    # tried/selection handshake between a late backup attempt and the
+    # completion sweep; _arb_lock serializes take-and-complete /
+    # take-and-retry (the reference gets this from the bthread_id lock,
+    # id.h:46) — a response-error retry swaps the correlation id under
+    # it so the deadline timer can never interleave with the swap.
+    _LAZY = {
+        "request_attachment": IOBuf,
+        "response_attachment": IOBuf,
+        "request_device_arrays": list,
+        "response_device_arrays": list,
+        "_done_event": FiberEvent,
+        "_timer_ids": list,
+        "tried_servers": list,      # endpoints tried (retry-elsewhere)
+        "_complete_hooks": list,    # LB feedback / breaker / client spans
+        "_lb_fed": list,
+        "_cancel_subs": list,       # (socket, cb) notify_on_cancel subs
+        "_lb_lock": threading.Lock,
+        "_arb_lock": threading.RLock,
+    }
+
     def __init__(self):
-        # ---- shared
-        self.error_code: int = berr.OK
-        self.error_text: str = ""
-        self.log_id: int = 0
-        self.request_attachment = IOBuf()
-        self.response_attachment = IOBuf()
-        self.request_device_arrays: List = []
-        self.response_device_arrays: List = []
-        self.remote_side: Optional[EndPoint] = None
-        self.local_side: Optional[EndPoint] = None
-        self.auth_token: str = ""
-        self.auth_context = None   # server side: verified peer identity
-        self.compress_type: int = 0
-        self.trace_id: int = 0
-        self.span_id: int = 0
-        # ---- client side
-        self.timeout_ms: Optional[float] = None
-        self.max_retry: Optional[int] = None  # None = inherit channel option
-        self.backup_request_ms: Optional[float] = None
-        self.correlation_id: int = 0
-        self.response_payload: Optional[IOBuf] = None
-        self.response_msg: Any = None
-        self._done_event = FiberEvent()
-        self._done_cb: Optional[Callable[["Controller"], None]] = None
-        self._timer_ids: List[int] = []
-        self.current_try: int = 0
-        self.start_us: int = 0
-        self.end_us: int = 0
-        self.used_backup: bool = False
-        self.stream = None           # Stream piggybacked on this call
-        # cluster bookkeeping: endpoints tried (for retry-elsewhere) and
-        # completion hooks (LB feedback / circuit breaker / client spans)
-        self.tried_servers: list = []
-        self._complete_hooks: list = []
-        # which server's response actually completed the call (set by
-        # process_response; None on timeout/failure) — with backup
-        # requests, tried_servers[-1] is NOT necessarily the winner
-        self.responded_server = None
-        # guards the tried/selection handshake between a late backup
-        # attempt and the completion sweep (cluster_channel)
-        self._lb_lock = threading.Lock()
-        # serializes the take-and-complete / take-and-retry decisions
-        # (the reference gets this from the bthread_id lock, id.h:46):
-        # a response-error retry swaps the correlation id under this
-        # lock, so the deadline timer can never interleave with the swap
-        self._arb_lock = threading.RLock()
-        self._lb_swept_n: Optional[int] = None
-        self._lb_fed: list = []
-        # ---- client call internals (set by Channel.call)
-        self._service_name: str = ""
-        self._method_name: str = ""
-        self._request_bytes: bytes = b""
-        # ---- server side
-        self._server_socket = None
-        self._response_sender: Optional[Callable] = None
-        self._progressive = None    # ProgressiveAttachment (http chunked)
-        self._session_local = None  # borrowed from the server's data pool
-        self._session_kv: Optional[dict] = None   # kvmap.h SessionKV
-        self._cancel_subs: list = []   # (socket, cb) notify_on_cancel subs
-        self._completed = False    # set under _arb_lock by _complete
+        pass
+
+    def __getattr__(self, name):
+        factory = Controller._LAZY.get(name)
+        if factory is None:
+            raise AttributeError(
+                f"{type(self).__name__!r} object has no attribute {name!r}")
+        # one global creation lock: two threads lazily materializing the
+        # SAME lock field must agree on one object or arbitration breaks
+        with _lazy_create_lock:
+            d = self.__dict__
+            v = d.get(name, _MISSING)
+            if v is _MISSING:
+                v = factory()
+                d[name] = v
+        return v
 
     def session_kv(self) -> dict:
         """Lazily-created per-call key/value annotations (kvmap.h +
@@ -191,22 +250,37 @@ class Controller:
         self.current_try = 0
         with self._arb_lock:
             self._completed = False
-        self.end_us = 0
-        self.response_payload = None
-        self.response_attachment = IOBuf()
-        self.response_device_arrays = []
-        self.responded_server = None
-        self.used_backup = False
-        self.stream = None        # a previous call's stream must not
+        # __dict__ peeks: a FRESH controller (the common case) has no
+        # instance state to reset — clearing class-default fields would
+        # only materialize them
+        d = self.__dict__
+        d.pop("end_us", None)
+        d.pop("response_payload", None)
+        d.pop("response_attachment", None)
+        d.pop("response_device_arrays", None)
+        d.pop("responded_server", None)
+        d.pop("used_backup", None)
+        d.pop("stream", None)     # a previous call's stream must not
         #                           resurface on the new call's response
-        self._complete_hooks.clear()
-        with self._lb_lock:
-            self.tried_servers.clear()
-            self._lb_swept_n = None
-            self._lb_fed = []
+        hooks = d.get("_complete_hooks")
+        if hooks:
+            hooks.clear()
+        if d.get("tried_servers") or d.get("_lb_fed") \
+                or d.get("_lb_swept_n") is not None:
+            with self._lb_lock:
+                self.tried_servers.clear()
+                self._lb_swept_n = None
+                self._lb_fed = []
 
     def _register_call(self) -> int:
-        self.correlation_id = _call_pool.insert(self)
+        try:
+            self.correlation_id = _pool().insert(self)
+        except RuntimeError:
+            # native pool exhausted (131072 live in-flight calls): fail
+            # THIS call with a limit error instead of crashing the call
+            # path — bounded-id backpressure, not unbounded growth
+            raise OverflowError("correlation-id pool exhausted "
+                                "(too many in-flight calls)") from None
         return self.correlation_id
 
     def _add_complete_hook(self, hook) -> None:
@@ -228,10 +302,16 @@ class Controller:
         with self._arb_lock:
             self._completed = True
         self.end_us = time.monotonic_ns() // 1000
-        from brpc_tpu.fiber.timer import global_timer
-        for tid in self._timer_ids:
-            global_timer().unschedule(tid)
-        self._timer_ids.clear()
+        # __dict__ peeks: lazily-created members that were never touched
+        # need no completion work — don't materialize them just to find
+        # them empty (this runs once per call)
+        d = self.__dict__
+        tids = d.get("_timer_ids")
+        if tids:
+            from brpc_tpu.fiber.timer import global_timer
+            for tid in tids:
+                global_timer().unschedule(tid)
+            tids.clear()
         if self.failed():
             # a stream piggybacked on a failed call must not leak in the
             # global stream pool (timeout/socket-failure completion paths
@@ -239,7 +319,7 @@ class Controller:
             stream = getattr(self, "stream", None)
             if stream is not None:
                 stream.close()
-        for hook in self._complete_hooks:
+        for hook in d.get("_complete_hooks", ()):
             try:
                 hook(self)
             except Exception:
@@ -302,7 +382,10 @@ class Controller:
     def _drop_cancel_subs(self) -> None:
         """Called when the server request completes: a finished
         request must not hear about later connection deaths."""
-        subs, self._cancel_subs = self._cancel_subs, []
+        subs = self.__dict__.get("_cancel_subs")
+        if not subs:
+            return
+        self._cancel_subs = []
         for s, cb in subs:
             try:
                 s.off_failed(cb)

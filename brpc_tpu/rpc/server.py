@@ -136,6 +136,9 @@ class Server:
         if service.name in self._services:
             raise ValueError(f"service {service.name!r} already added")
         self._services[service.name] = service
+        for m in service.methods.values():
+            # precomputed /status key: an f-string per request adds up
+            m.full_name = f"{service.name}.{m.name}"
 
     def find_method(self, service_name: str, method_name: str) -> Optional[Method]:
         svc = self._services.get(service_name)

@@ -11,12 +11,45 @@ import inspect
 import time
 from typing import Optional
 
+from brpc_tpu.butil.flags import flag
 from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.fiber.scheduler import SchedAwaitable, current_group
 from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
 from brpc_tpu.protocol.tpu_std import (
-    RpcMessage, pack_message, serialize_payload, unpack_inline_device_arrays)
+    RpcMessage, TpuStdProtocol, pack_message, pack_small_frame,
+    serialize_payload, unpack_inline_device_arrays)
 from brpc_tpu.rpc import errno_codes as berr
 from brpc_tpu.rpc.controller import Controller
+
+
+_UNSET = object()
+_dumper = None   # lazily bound brpc_tpu.rpc.rpc_dump.global_dumper
+
+
+class _NullSpan:
+    """Span stand-in when rpcz is off: field writes are absorbed so the
+    dispatch path stays branch-free (the reference skips span creation
+    the same way when rpcz is disabled, span.cpp:149)."""
+
+    __slots__ = ()
+
+    def __setattr__(self, name, value):
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def _null_finish_span(span, cntl) -> None:
+    pass
+
+
+class _HopToWorker(SchedAwaitable):
+    """Move the current fiber from an inline (non-worker) context onto
+    a fiber worker before running potentially-blocking user code."""
+
+    def _register(self, fiber):
+        fiber.control.schedule(fiber, None)
 
 
 async def process_request(proto, msg: RpcMessage, socket) -> None:
@@ -30,9 +63,15 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     # auth precedes lookup: unauthenticated peers must not be able to
     # enumerate the service/method namespace from distinct error codes.
     # verify once per connection, cache the AuthContext on the socket
-    # (authenticator.h: only the first message carries/verifies auth)
+    # (authenticator.h: only the first message carries/verifies auth).
+    # The resolved Authenticator is cached on the server — per-request
+    # resolution sat on the hot path for no benefit (the reference
+    # resolves once at Server::Start)
     from brpc_tpu.rpc.auth import AuthError, resolve_server_auth
-    auth = resolve_server_auth(server.options)
+    auth = getattr(server, "_resolved_auth_cache", _UNSET)
+    if auth is _UNSET:
+        auth = resolve_server_auth(server.options)
+        server._resolved_auth_cache = auth
     auth_ctx = socket.user_data.get("auth_context")
     if auth is not None and auth_ctx is None:
         try:
@@ -57,7 +96,8 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
         _send_error(proto, socket, cid, berr.ELIMIT, "max_concurrency reached")
         return
 
-    method_key = f"{req_meta.service_name}.{req_meta.method_name}"
+    method_key = method.full_name or \
+        f"{req_meta.service_name}.{req_meta.method_name}"
     t0 = time.monotonic_ns()
     cntl = Controller()
     cntl.trace_id = meta.trace_id
@@ -70,9 +110,14 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     cntl._service_name = req_meta.service_name
     cntl._method_name = req_meta.method_name
     cntl._server_socket = socket
-    from brpc_tpu.rpc.span import finish_span, start_server_span
-    span = start_server_span(cntl, req_meta.service_name, req_meta.method_name)
-    span.request_size = msg.payload.size + msg.attachment.size
+    if flag("rpcz_enabled"):
+        from brpc_tpu.rpc.span import finish_span, start_server_span
+        span = start_server_span(cntl, req_meta.service_name,
+                                 req_meta.method_name)
+        span.request_size = msg.payload.size + msg.attachment.size
+    else:
+        span = _NULL_SPAN
+        finish_span = _null_finish_span
     if meta.HasField("stream_settings") and meta.stream_settings.stream_id:
         cntl._peer_stream_id = meta.stream_settings.stream_id
     cntl.request_attachment = msg.attachment
@@ -95,10 +140,12 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
         # Observability must never fail serving: a broken rpc_dump_dir
         # (perms, disk full) is swallowed here, not turned into EREQUEST.
         try:
-            from brpc_tpu.rpc.rpc_dump import global_dumper
-            global_dumper.maybe_dump(req_meta.service_name,
-                                     req_meta.method_name,
-                                     payload_bytes, req_meta.log_id)
+            global _dumper
+            if _dumper is None:
+                from brpc_tpu.rpc.rpc_dump import global_dumper as _dumper
+            _dumper.maybe_dump(req_meta.service_name,
+                               req_meta.method_name,
+                               payload_bytes, req_meta.log_id)
         except Exception:
             pass
         if method.request_class is not None:
@@ -143,8 +190,20 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
         cntl._session_local = pool.borrow()
     response = None
     try:
+        if not method.is_coroutine and current_group() is None and \
+                not getattr(server.options, "usercode_in_pthread", False):
+            # this request is being processed INLINE on a non-worker
+            # thread (the event-raising context — socket_inline_process).
+            # A sync handler may block, and blocking the caller/dispatcher
+            # thread would hijack async call() and stall every other
+            # connection — hop to a fiber worker first (the reference
+            # never runs user code on the event thread either; its
+            # in-place processing happens inside a worker bthread).
+            # Async handlers stay inline: suspension converts them to a
+            # normal fiber at their first real await.
+            await _HopToWorker()
         if getattr(server.options, "usercode_in_pthread", False) and \
-                not inspect.iscoroutinefunction(method.handler):
+                not method.is_coroutine:
             # blocking user code runs on the backup pthread pool; this
             # fiber (and its worker) stays free to pump IO
             from brpc_tpu.rpc.usercode import run_usercode
@@ -176,6 +235,25 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
 
 def _send_response(proto, socket, cid: int, cntl: Controller,
                    response) -> None:
+    # small-call fast path: a successful tpu_std-framed response with no
+    # stream/device/progressive sections needs only correlation_id (+
+    # attachment_size) in its meta — hand-encoded varints over a single
+    # bytes frame, no pb object, no IOBuf
+    if (not cntl.failed() and cntl.compress_type == 0
+            and getattr(cntl, "_accepted_stream", None) is None
+            and not cntl.__dict__.get("response_device_arrays")
+            and type(proto).frame is TpuStdProtocol.frame):
+        try:
+            payload = serialize_payload(response)
+        except TypeError as e:
+            cntl.set_failed(berr.EINTERNAL, str(e))
+        else:
+            att = cntl.__dict__.get("response_attachment")
+            wire = pack_small_frame(b"", cid, payload,
+                                    att.to_bytes() if att else b"",
+                                    magic=proto.MAGIC)
+            socket.write_small(wire)
+            return
     meta = pb.RpcMeta()
     meta.correlation_id = cid
     meta.response.error_code = cntl.error_code

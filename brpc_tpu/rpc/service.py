@@ -21,6 +21,10 @@ class Method:
     handler: Callable
     request_class: Optional[type] = None
     response_class: Optional[type] = None
+    # precomputed at registration: per-request inspect.iscoroutinefunction
+    # is measurable on the dispatch hot path
+    is_coroutine: bool = False
+    full_name: str = ""   # "Service.Method", set by Server.add_service
 
 
 class Service:
@@ -31,7 +35,9 @@ class Service:
     def register_method(self, name: str, handler: Callable,
                         request_class: Optional[type] = None,
                         response_class: Optional[type] = None) -> None:
-        self.methods[name] = Method(name, handler, request_class, response_class)
+        self.methods[name] = Method(
+            name, handler, request_class, response_class,
+            is_coroutine=inspect.iscoroutinefunction(handler))
 
     def method(self, name: Optional[str] = None, request_class=None,
                response_class=None):

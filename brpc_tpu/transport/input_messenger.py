@@ -32,8 +32,34 @@ class InputMessenger:
 
     async def on_new_messages(self, socket: Socket):
         """The socket's input callback: parse-loop the portal, dispatch."""
-        msgs = []  # (protocol, msg)
         protocols = self.protocols()
+        # single-message fast path: a connection already claimed by a
+        # protocol, one complete frame waiting (the overwhelmingly common
+        # non-pipelined case) — parse and process directly, skipping the
+        # candidate-ordering machinery below (the reference's
+        # preferred_index + process-in-place discipline,
+        # input_messenger.cpp:219,183)
+        idx = socket.preferred_protocol
+        if 0 <= idx < len(protocols):
+            proto = protocols[idx]
+            status, msg = proto.parse(socket.input_portal, socket)
+            if status == PARSE_OK and not socket.input_portal:
+                if not proto.process_inline(msg, socket):
+                    r = proto.process(msg, socket)
+                    if r is not None and hasattr(r, "__await__"):
+                        await r
+                return
+            if status == PARSE_NOT_ENOUGH_DATA:
+                return
+            if status == PARSE_OK:
+                # more bytes follow: hand the parsed message to the
+                # general loop's dispatch rules (pipelined burst)
+                msgs = [] if proto.process_inline(msg, socket) \
+                    else [(proto, msg)]
+            else:
+                msgs = []
+        else:
+            msgs = []
         while socket.input_portal:
             idx = socket.preferred_protocol
             if 0 <= idx < len(protocols):

@@ -34,6 +34,11 @@ class MemConn(Conn):
     # mem pipes never block the writer (bounded only by _MAX_BUFFER):
     # Socket.write may run inline in the caller's context
     inline_write_ok = True
+    # read_into gathers EVERY pending chunk, so a short read proves the
+    # pipe is empty — Socket._drain_readable stops without a
+    # BlockingIOError round trip (and every write notifies, so nothing
+    # arriving after the short read is ever missed)
+    drain_all_reads = True
 
     def __init__(self, rx: _MemPipe, tx: _MemPipe, local: EndPoint, remote: EndPoint):
         self._rx = rx
@@ -62,23 +67,35 @@ class MemConn(Conn):
 
     def read_into(self, mv: memoryview) -> int:
         with self._rx.lock:
-            if not self._rx.chunks:
+            chunks = self._rx.chunks
+            if not chunks:
                 if self._rx.closed:
                     return 0
                 raise BlockingIOError
-            chunk = self._rx.chunks[0]
-            n = min(len(chunk), len(mv))
-            mv[:n] = chunk[:n]
-            if n == len(chunk):
-                self._rx.chunks.popleft()
-            else:
-                self._rx.chunks[0] = chunk[n:]
+            # gather every chunk that fits (drain_all_reads contract):
+            # one call empties the pipe instead of one chunk per call
+            n = 0
+            space = len(mv)
+            while chunks and n < space:
+                chunk = chunks[0]
+                take = min(len(chunk), space - n)
+                mv[n:n + take] = chunk[:take]
+                if take == len(chunk):
+                    chunks.popleft()
+                else:
+                    chunks[0] = chunk[take:]
+                n += take
             self._rx.size -= n
             was_full = self._rx.size + n >= _MAX_BUFFER > self._rx.size
         peer = self.peer
         if was_full and peer is not None:
             peer._notify_writable()
         return n
+
+    def pending_bytes(self) -> int:
+        """Unread byte count (drain_all_reads contract; GIL-atomic int
+        read, no lock)."""
+        return self._rx.size
 
     def write_device_payload(self, arrays) -> bool:
         """Zero-copy: hand device arrays to the peer by reference."""

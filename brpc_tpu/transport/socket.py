@@ -25,6 +25,7 @@ from collections import deque
 from typing import Callable, Optional
 
 from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.butil.flags import define_flag, flag
 from brpc_tpu.butil.iobuf import (DEFAULT_BLOCK_SIZE, IOBuf, IOPortal,
                                   _BIG_BLOCK_SIZE)
 from brpc_tpu.butil.resource_pool import INVALID_ID, ResourcePool, VersionedId
@@ -33,7 +34,83 @@ from brpc_tpu.fiber import TaskControl, global_control
 from brpc_tpu.fiber.butex import Butex
 from brpc_tpu.transport.base import Conn, get_transport
 
-_socket_pool: ResourcePool = ResourcePool()
+define_flag("socket_inline_process", True,
+            "process socket input inline on the event-raising thread "
+            "until the handler first suspends (process-in-place, "
+            "input_messenger.cpp:183); handlers that await park as "
+            "normal fibers. Off = always spawn a fiber per busy period")
+
+
+class _PyMpsc:
+    """Fallback for fastcore's Mpsc (queues.cc writer-retire MPSC) with
+    the same contract: push() returns True when the caller became the
+    writer; the writer drains FIFO and releases via try_retire(), which
+    refuses while items remain (socket.cpp StartWrite/IsWriteComplete)."""
+
+    __slots__ = ("_q", "_lock", "_writing")
+
+    def __init__(self):
+        self._q = deque()
+        self._lock = threading.Lock()
+        self._writing = False
+
+    def push(self, item) -> bool:
+        with self._lock:
+            self._q.append(item)
+            if self._writing:
+                return False
+            self._writing = True
+            return True
+
+    def drain_one(self):
+        with self._lock:
+            return self._q.popleft() if self._q else None
+
+    def try_retire(self) -> bool:
+        with self._lock:
+            if self._q:
+                return False
+            self._writing = False
+            return True
+
+    def depth(self) -> int:
+        return len(self._q)
+
+
+
+
+# socket versioned-ref pool (socket.cpp:776-800): native respool.cc
+# slots when available. Resolved on first use — fastcore.get() may
+# compile the extension, and import must stay cheap.
+_socket_pool = None
+_socket_pool_lock = threading.Lock()
+
+
+def _pool():
+    p = _socket_pool
+    if p is None:
+        p = _make_pool()
+    return p
+
+
+def _make_pool():
+    # locked: concurrent first-socket threads must agree on ONE pool
+    # (a Socket registered in a discarded duplicate would be
+    # unaddressable and set_failed would remove from the wrong pool)
+    global _socket_pool
+    with _socket_pool_lock:
+        if _socket_pool is None:
+            from brpc_tpu.native import fastcore as _fastcore
+            fc = _fastcore.get()
+            _socket_pool = fc.Pool(1 << 16) if fc is not None \
+                else ResourcePool()
+        return _socket_pool
+
+
+def _new_mpsc():
+    from brpc_tpu.native import fastcore as _fastcore
+    fc = _fastcore.get()
+    return fc.Mpsc() if fc is not None else _PyMpsc()
 
 nwrites = Adder()
 nreads = Adder()
@@ -42,7 +119,7 @@ SocketId = VersionedId
 
 
 def address_socket(sid: SocketId) -> Optional["Socket"]:
-    return _socket_pool.address(sid)
+    return _pool().address(sid)
 
 
 class Socket:
@@ -56,9 +133,12 @@ class Socket:
         self.input_portal = IOPortal()
         self.failed = False
         self.fail_reason: Optional[BaseException] = None
-        self._write_q: deque = deque()           # (IOBuf, done_cb|None)
-        self._write_flag_lock = threading.Lock()
-        self._writing = False
+        # wait-free MPSC write queue with writer-retire arbitration
+        # (native queues.cc via fastcore when available): items are
+        # (bytes|IOBuf, done_cb|None); the producer whose push claims
+        # writership drains — socket.cpp:1924-2005's _write_head protocol
+        self._wq = _new_mpsc()
+        self._handoff = None      # mid-frame leftover owned by keep_write
         self._writable_butex = Butex(0)
         self._nevent = 0                          # edge-trigger input counter
         self._nevent_lock = threading.Lock()
@@ -72,7 +152,24 @@ class Socket:
         self.lane_lock = threading.Lock()
         self._on_failed_cbs: list = []
         self._failed_cb_lock = threading.Lock()   # failed-flag/append race
-        self.id: SocketId = _socket_pool.insert(self)
+        # captured once: /flags mutation applies to new sockets (a dict
+        # lookup per readable event is measurable on the inline path)
+        self._inline_process = flag("socket_inline_process")
+        self._inline_write = getattr(conn, "inline_write_ok", False)
+        self._drain_all_reads = getattr(conn, "drain_all_reads", False)
+        try:
+            self.id: SocketId = _pool().insert(self)
+        except RuntimeError:
+            # bounded native pool (65536 live sockets): surface as a
+            # connection error the RPC paths already handle — and close
+            # the conn NOW (start_events never runs, so nothing else
+            # will), or every rejected connect leaks an fd exactly when
+            # the process is resource-exhausted
+            try:
+                conn.close()
+            except Exception:
+                pass
+            raise ConnectionError("socket pool exhausted") from None
         conn.start_events(self._on_readable_event, self._on_writable_event)
 
     # ----------------------------------------------------------- identity
@@ -85,10 +182,25 @@ class Socket:
         return self.conn.local_endpoint
 
     # -------------------------------------------------------------- write
-    def write(self, buf: IOBuf, on_done: Optional[Callable] = None) -> bool:
-        """Enqueue and return immediately; ordering is FIFO per socket.
-        On an already-failed socket the done callback still fires (with the
-        failure) so callers' retry paths run — never a silent drop."""
+    def write(self, data, on_done: Optional[Callable] = None) -> bool:
+        """Enqueue an IOBuf or a ready-made bytes frame and return
+        immediately; ordering is FIFO per socket. Bytes frames skip the
+        IOBuf machinery unless the conn blocks mid-frame (the reference's
+        write-once-in-place, socket.cpp:1960). On an already-failed
+        socket the done callback still fires (with the failure) so
+        callers' retry paths run — never a silent drop."""
+        return self._submit(data, on_done)
+
+    # bytes and IOBufs share one path; the old two-name split survives as
+    # an alias so fast-path call sites read as what they are
+    write_small = write
+
+    def _submit(self, data, on_done) -> bool:
+        """One write path for bytes and IOBufs: push onto the MPSC queue;
+        the producer whose push CLAIMS writership sends — inline in this
+        context when the conn allows it (write-once-then-KeepWrite,
+        socket.cpp:1924-2050), via a keep_write fiber otherwise. FIFO
+        holds because the queue is the only ordering authority."""
         if self.failed:
             if on_done is not None:
                 try:
@@ -97,49 +209,66 @@ class Socket:
                     pass
             return False
         nwrites.add(1)
-        # fast path: first write attempt in the caller's context instead
-        # of bouncing through a keep_write fiber — two fiber wakeups
-        # saved per RPC roundtrip. Opt-in invariant (inline_write_ok):
-        # the conn's write() raises BlockingIOError on EAGAIN (which
-        # cut_into_writer absorbs, leaving the remainder in `buf`), so
-        # a partial/blocked write lands in the handoff branch below —
-        # never in the except arm. mem/tpu pipes never block; TCP relies
-        # on the handoff. The _writing flag is claimed exactly like
-        # keep_write does, so FIFO order holds against concurrent
-        # writers (losers enqueue; we drain them after).
-        if getattr(self.conn, "inline_write_ok", False):
-            with self._write_flag_lock:
-                fast = not self._writing and not self._write_q
-                if fast:
-                    self._writing = True
-            if fast:
-                err: Optional[BaseException] = None
-                try:
-                    buf.cut_into_writer(self.conn.write)
-                except (BrokenPipeError, ConnectionError, OSError) as e:
-                    err = e
-                if err is None and not buf:
-                    with self._write_flag_lock:
-                        self._writing = False
-                        more = bool(self._write_q)
-                    if on_done is not None:
-                        try:
-                            on_done(None)
-                        except Exception:
-                            pass
-                    if more:
-                        self._maybe_start_keep_write()
-                    return True
-                # leftover or error: hand off to the slow path with the
-                # flag still held — _keep_write owns it from here
-                self._write_q.appendleft((buf, on_done))
-                if err is not None:
-                    self.set_failed(err)
-                self._control.spawn(self._keep_write, name="keep_write")
-                return err is None
-        self._write_q.append((buf, on_done))
-        self._maybe_start_keep_write()
+        if not self._wq.push((data, on_done)):
+            return True          # the active writer drains it in order
+        if self._inline_write:
+            return self._drain_writes_inline()
+        self._control.spawn(self._keep_write, name="keep_write")
         return True
+
+    def _write_data_once(self, data):
+        """Single pass over one item; returns (err, leftover_iobuf|None).
+        BlockingIOError is absorbed into a leftover (never an error)."""
+        try:
+            if isinstance(data, IOBuf):
+                data.cut_into_writer(self.conn.write)
+                return None, (data if data else None)
+            mv = memoryview(data)
+            while mv:
+                try:
+                    n = self.conn.write(mv)
+                except BlockingIOError:
+                    break
+                if n is None or n <= 0:
+                    break
+                mv = mv[n:]
+            if mv:
+                buf = IOBuf()
+                buf.append(bytes(mv))
+                return None, buf
+            return None, None
+        except (BrokenPipeError, ConnectionError, OSError) as e:
+            return e, None
+
+    def _drain_writes_inline(self) -> bool:
+        """Writer loop in the submitting context (claimed via push)."""
+        ok = True
+        while True:
+            item = self._wq.drain_one()
+            if item is None:
+                if self._wq.try_retire():
+                    return ok
+                continue          # a racing push landed: keep draining
+            data, cb = item
+            err: Optional[BaseException] = None
+            if self.failed:
+                err = self.fail_reason
+            else:
+                err, leftover = self._write_data_once(data)
+                if err is None and leftover is not None:
+                    # blocked mid-frame: the keep_write fiber inherits
+                    # writership AND the partial frame
+                    self._handoff = (leftover, cb)
+                    self._control.spawn(self._keep_write, name="keep_write")
+                    return ok
+            if err is not None:
+                ok = False
+                self.set_failed(err)
+            if cb is not None:
+                try:
+                    cb(err)
+                except Exception:
+                    pass
 
     def write_device_payload(self, arrays) -> bool:
         """Out-of-band device lane (mem/tpu transports); host transports
@@ -147,62 +276,61 @@ class Socket:
         r = self.conn.write_device_payload(arrays)
         return bool(r)
 
-    def _maybe_start_keep_write(self):
-        with self._write_flag_lock:
-            if self._writing or not self._write_q:
-                return
-            self._writing = True
-        self._control.spawn(self._keep_write, name="keep_write")
+    async def _write_buf_blocking(self, buf: IOBuf) -> Optional[BaseException]:
+        while buf and not self.failed:
+            try:
+                buf.cut_into_writer(self.conn.write)
+            except (BrokenPipeError, ConnectionError, OSError) as e:
+                return e
+            if buf:
+                # blocked: arm one-shot writable event, park on butex
+                seq = self._writable_butex.value
+                self.conn.request_writable_event()
+                await self._writable_butex.wait(expected=seq, timeout_s=1.0)
+        if buf and self.failed:
+            return self.fail_reason   # failed mid-write: not a success
+        return None
 
     async def _keep_write(self):
-        while True:
-            try:
-                item = self._write_q.popleft()
-            except IndexError:
-                item = None
-            if item is None:
-                with self._write_flag_lock:
-                    if not self._write_q:
-                        self._writing = False
-                        return
-                continue
-            buf, on_done = item
-            err: Optional[BaseException] = None
-            while buf and not self.failed:
-                try:
-                    buf.cut_into_writer(self.conn.write)
-                except (BrokenPipeError, ConnectionError, OSError) as e:
-                    err = e
-                    break
-                if buf:
-                    # blocked: arm one-shot writable event, park on butex
-                    seq = self._writable_butex.value
-                    self.conn.request_writable_event()
-                    await self._writable_butex.wait(expected=seq, timeout_s=1.0)
-            if err is None and buf and self.failed:
-                err = self.fail_reason  # failed mid-write: not a success
+        """Background writer (owns writership until retire): finishes a
+        handed-off partial frame, then drains the queue, parking on the
+        writable butex when the conn blocks (KeepWrite bthread,
+        socket.cpp:2066-2160). On failure every remaining item's callback
+        fires with the reason — never a silent drop."""
+        handoff, self._handoff = self._handoff, None
+        if handoff is not None:
+            buf, cb = handoff
+            err = await self._write_buf_blocking(buf)
             if err is not None:
                 self.set_failed(err)
-            if on_done is not None:
+            if cb is not None:
                 try:
-                    on_done(err)
+                    cb(err)
                 except Exception:
                     pass
+        while True:
+            item = self._wq.drain_one()
+            if item is None:
+                if self._wq.try_retire():
+                    return
+                continue
+            data, cb = item
+            err: Optional[BaseException] = None
             if self.failed:
-                # drain remaining writes with failure callbacks
-                while True:
-                    try:
-                        _, cb = self._write_q.popleft()
-                    except IndexError:
-                        break
-                    if cb is not None:
-                        try:
-                            cb(self.fail_reason)
-                        except Exception:
-                            pass
-                with self._write_flag_lock:
-                    self._writing = False
-                return
+                err = self.fail_reason
+            else:
+                if not isinstance(data, IOBuf):
+                    b = IOBuf()
+                    b.append(data)
+                    data = b
+                err = await self._write_buf_blocking(data)
+                if err is not None:
+                    self.set_failed(err)
+            if cb is not None:
+                try:
+                    cb(err)
+                except Exception:
+                    pass
 
     def _on_writable_event(self):
         self._writable_butex.fetch_add(1)
@@ -219,7 +347,13 @@ class Socket:
             else:
                 busy = False
         if not busy:
-            self._control.spawn(self._process_input, name="socket_input")
+            if self._inline_process:
+                # zero-wake fast path: drain + parse + dispatch on THIS
+                # thread; a handler that suspends continues as a fiber
+                self._control.run_inline(self._process_input(),
+                                         name="socket_input")
+            else:
+                self._control.spawn(self._process_input, name="socket_input")
             return
         # the input fiber is busy — possibly SUSPENDED awaiting a long
         # handler, in which case it cannot drain this event for a
@@ -317,6 +451,13 @@ class Socket:
                 self._read_hint = DEFAULT_BLOCK_SIZE
             total += n
             nreads.add(n)
+            if self._drain_all_reads and self.conn.pending_bytes() == 0:
+                # exact emptiness probe (a short read is NOT proof —
+                # the read may have landed in a small tail-block gap):
+                # stop without paying a raise/catch of BlockingIOError
+                # per message. Safe only because such conns notify on
+                # every write, so a refill re-triggers _process_input.
+                break
         return total
 
     def take_device_payload(self):
@@ -333,7 +474,7 @@ class Socket:
             self.failed = True
             self.fail_reason = reason or ConnectionError("socket set_failed")
             cbs = list(self._on_failed_cbs)
-        _socket_pool.remove(self.id)
+        _pool().remove(self.id)
         try:
             self.conn.close()
         except Exception:

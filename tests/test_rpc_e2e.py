@@ -550,6 +550,15 @@ class TestConnectionTypes:
             cntl.session_kv()["attempt_tag"] = "client-side"
             cntl = ch.call_sync("EchoService", "Annotated", b"x", cntl=cntl)
             assert not cntl.failed(), cntl.error_text
+            # the client can complete BEFORE the server's flush runs
+            # (inline processing nests the client completion inside the
+            # server's response write; the reference likewise flushes at
+            # controller destruction with no cross-side ordering) — wait
+            # for the server line instead of assuming scheduling delay
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline and \
+                    not any("user=u1" in r for r in records):
+                time.sleep(0.01)
             server_lines = [r for r in records if "user=u1" in r]
             client_lines = [r for r in records if "attempt_tag" in r]
             assert server_lines and "items=3" in server_lines[0]
