@@ -253,7 +253,7 @@ class TpuStdProtocol(Protocol):
                 f"frame attachment_size {att_size} exceeds body"))
             return PARSE_NOT_ENOUGH_DATA, None
         payload = portal.cut(body_size - meta_size - att_size)
-        attachment = portal.cut(att_size)
+        attachment = portal.cut(att_size) if att_size else IOBuf()
         device_arrays: List = []
         if meta.device_payloads and any(not dp.inline_bytes
                                         for dp in meta.device_payloads):

@@ -18,6 +18,7 @@ from typing import Any, Callable, List, Optional
 from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
 from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.fiber import TaskControl, global_control
+from brpc_tpu.fiber.sync import FiberEvent as _FiberEvent
 from brpc_tpu.fiber.timer import global_timer
 from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
 from brpc_tpu.protocol.tpu_std import (pack_message, pack_small_frame,
@@ -188,7 +189,12 @@ class Channel:
         cntl.join() (thread) / await cntl.join_async() (fiber), or pass
         ``done`` for callback style — the async CallMethod triple."""
         cntl = cntl or Controller()
-        cntl._reset_for_call()
+        if "_done_event" in cntl.__dict__:
+            cntl._reset_for_call()   # reused controller: full reset
+        else:
+            # fresh controller: nothing to reset — just arm completion
+            cntl.__dict__["_done_event"] = _FiberEvent()
+            cntl.__dict__["_completed"] = False
         cntl.start_us = time.monotonic_ns() // 1000
         if cntl.timeout_ms is None:
             cntl.timeout_ms = self.options.timeout_ms

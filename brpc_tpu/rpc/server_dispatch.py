@@ -100,16 +100,23 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
         f"{req_meta.service_name}.{req_meta.method_name}"
     t0 = time.monotonic_ns()
     cntl = Controller()
-    cntl.trace_id = meta.trace_id
-    cntl.span_id = meta.span_id
-    cntl.log_id = req_meta.log_id
-    cntl.remote_side = socket.remote_endpoint
-    cntl.local_side = socket.local_endpoint
-    cntl.auth_token = req_meta.auth_token
-    cntl.auth_context = auth_ctx
-    cntl._service_name = req_meta.service_name
-    cntl._method_name = req_meta.method_name
-    cntl._server_socket = socket
+    d = cntl.__dict__
+    # zero/empty proto3 defaults match the Controller's class defaults:
+    # write only what's actually set (instance-dict writes add up here)
+    if meta.trace_id:
+        d["trace_id"] = meta.trace_id
+        d["span_id"] = meta.span_id
+    if req_meta.log_id:
+        d["log_id"] = req_meta.log_id
+    d["remote_side"] = socket.remote_endpoint
+    d["local_side"] = socket.local_endpoint
+    if req_meta.auth_token:
+        d["auth_token"] = req_meta.auth_token
+    if auth_ctx is not None:
+        d["auth_context"] = auth_ctx
+    d["_service_name"] = req_meta.service_name
+    d["_method_name"] = req_meta.method_name
+    d["_server_socket"] = socket
     if flag("rpcz_enabled"):
         from brpc_tpu.rpc.span import finish_span, start_server_span
         span = start_server_span(cntl, req_meta.service_name,
@@ -118,8 +125,9 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     else:
         span = _NULL_SPAN
         finish_span = _null_finish_span
-    if meta.HasField("stream_settings") and meta.stream_settings.stream_id:
-        cntl._peer_stream_id = meta.stream_settings.stream_id
+    peer_stream = meta.stream_settings.stream_id   # absent -> 0
+    if peer_stream:
+        cntl._peer_stream_id = peer_stream
     cntl.request_attachment = msg.attachment
     if meta.device_payloads:
         inline = unpack_inline_device_arrays(msg)

@@ -38,6 +38,11 @@ class EventDispatcher:
             self._thread.start()
 
     def _wakeup(self):
+        # registry changes made FROM the dispatcher thread (inline
+        # processing re-arming reads mid-event) need no pipe write: the
+        # loop re-enters select() right after the callback returns
+        if threading.current_thread() is self._thread:
+            return
         try:
             self._wakeup_w.send(b"x")
         except (BlockingIOError, OSError):
@@ -61,6 +66,25 @@ class EventDispatcher:
             except KeyError:
                 self._selector.modify(fd, selectors.EVENT_READ, fd)
             self._ensure_thread()
+        self._wakeup()
+
+    def pause_read(self, fd: int) -> None:
+        """Drop read interest until resume_read (level-triggered
+        consumers use this for busy periods, so pending data doesn't
+        spin the select loop while a handler is parked)."""
+        with self._lock:
+            h = self._handlers.get(fd)
+            if h is None or not (h[2] & selectors.EVENT_READ):
+                return
+            h[2] &= ~selectors.EVENT_READ
+            mask = h[2] | (selectors.EVENT_WRITE if h[1] else 0)
+            try:
+                if mask:
+                    self._selector.modify(fd, mask, fd)
+                else:
+                    self._selector.unregister(fd)
+            except (KeyError, ValueError, OSError):
+                pass
         self._wakeup()
 
     def resume_read(self, fd: int) -> None:

@@ -32,6 +32,18 @@ class InputMessenger:
 
     async def on_new_messages(self, socket: Socket):
         """The socket's input callback: parse-loop the portal, dispatch."""
+        r = self.on_new_messages_sync(socket)
+        if r is not None:
+            await r
+
+    def on_new_messages_sync(self, socket: Socket):
+        """Sync twin of on_new_messages: parses and dispatches entirely
+        on the calling context; returns a pending coroutine only when
+        the LAST message's processing is async (the caller decides how
+        to run it — Socket's sync input path run_inlines it, the async
+        wrapper above awaits it). A fully-sync cycle (the client
+        response path, pure stream frames) touches no coroutine or
+        fiber machinery at all."""
         protocols = self.protocols()
         # single-message fast path: a connection already claimed by a
         # protocol, one complete frame waiting (the overwhelmingly common
@@ -47,10 +59,10 @@ class InputMessenger:
                 if not proto.process_inline(msg, socket):
                     r = proto.process(msg, socket)
                     if r is not None and hasattr(r, "__await__"):
-                        await r
-                return
+                        return r
+                return None
             if status == PARSE_NOT_ENOUGH_DATA:
-                return
+                return None
             if status == PARSE_OK:
                 # more bytes follow: hand the parsed message to the
                 # general loop's dispatch rules (pipelined burst)
@@ -112,7 +124,7 @@ class InputMessenger:
                 socket.set_failed(ValueError("unparsable input"))
             break
         if not msgs:
-            return
+            return None
         # earlier messages -> fresh fibers; last one processed in place
         for proto, msg in msgs[:-1]:
             self._control.spawn(proto.process, msg, socket,
@@ -120,7 +132,8 @@ class InputMessenger:
         proto, msg = msgs[-1]
         r = proto.process(msg, socket)
         if hasattr(r, "__await__"):
-            await r
+            return r
+        return None
 
 
 def process_in_parse_order(socket: Socket, key: str, item,

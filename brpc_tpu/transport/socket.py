@@ -130,6 +130,16 @@ class Socket:
         self.conn = conn
         self._control = control or global_control()
         self._on_input = on_input
+        # sync twin of the input callback (InputMessenger's
+        # on_new_messages_sync): lets the whole drain+parse+dispatch
+        # cycle run without coroutine/fiber machinery when nothing
+        # suspends — the client response path in particular
+        self._on_input_sync = None
+        if on_input is not None and \
+                getattr(on_input, "__name__", "") == "on_new_messages":
+            self._on_input_sync = getattr(
+                getattr(on_input, "__self__", None),
+                "on_new_messages_sync", None)
         self.input_portal = IOPortal()
         self.failed = False
         self.fail_reason: Optional[BaseException] = None
@@ -143,6 +153,7 @@ class Socket:
         self._nevent = 0                          # edge-trigger input counter
         self._nevent_lock = threading.Lock()
         self._busy_rearmed = False   # one probe re-arm per busy period
+        self._busy_paused = False    # level-trigger: read interest paused
         self._read_hint = 8192                    # adaptive read-block size
         self.preferred_protocol = -1              # InputMessenger cache
         self.user_data: dict = {}                 # per-conn session state
@@ -157,6 +168,7 @@ class Socket:
         self._inline_process = flag("socket_inline_process")
         self._inline_write = getattr(conn, "inline_write_ok", False)
         self._drain_all_reads = getattr(conn, "drain_all_reads", False)
+        self._level_triggered = getattr(conn, "level_triggered", False)
         try:
             self.id: SocketId = _pool().insert(self)
         except RuntimeError:
@@ -348,10 +360,15 @@ class Socket:
                 busy = False
         if not busy:
             if self._inline_process:
-                # zero-wake fast path: drain + parse + dispatch on THIS
-                # thread; a handler that suspends continues as a fiber
-                self._control.run_inline(self._process_input(),
-                                         name="socket_input")
+                if self._on_input_sync is not None:
+                    # fully-sync fast path: no coroutine, no Fiber —
+                    # escalates itself if a message's processing awaits
+                    self._process_input_entry()
+                else:
+                    # zero-wake fast path: drain + parse + dispatch on
+                    # THIS thread; suspension continues as a fiber
+                    self._control.run_inline(self._process_input(),
+                                             name="socket_input")
             else:
                 self._control.spawn(self._process_input, name="socket_input")
             return
@@ -373,16 +390,28 @@ class Socket:
                     self._control.spawn(
                         lambda: self.set_failed(
                             ConnectionResetError("peer closed")))
+                elif self._level_triggered:
+                    # data (not FIN) pending while the input context is
+                    # busy: a LEVEL-triggered fd would re-fire this
+                    # event in a hot loop — pause read interest for the
+                    # rest of the busy period (the input loop re-drains
+                    # via _nevent, and the busy-period end resumes).
+                    # This is the only read-interest syscall pair left
+                    # on the TCP path: the idle/inline common case pays
+                    # none (vs one-shot's disarm+rearm per message)
+                    with self._nevent_lock:
+                        pause = not self._busy_paused
+                        if pause:
+                            self._busy_paused = True
+                    if pause:
+                        self.conn.pause_read_events()
                 elif not self._busy_rearmed:
-                    # data (not FIN) arrived while the input fiber is
-                    # busy: with one-shot arming this event consumed the
-                    # read interest — re-arm so a later FIN during the
-                    # same handler still produces an event. ONCE per
-                    # busy period (flag cleared when the input fiber
-                    # drains to idle): unconditional re-arm with data
-                    # pending would storm the dispatcher (event -> peek
-                    # -> re-arm -> immediate event ...), and the input
-                    # loop re-drains pending data anyway via _nevent
+                    # one-shot conns (ssl): this event consumed the read
+                    # interest — re-arm so a later FIN during the same
+                    # handler still produces an event. ONCE per busy
+                    # period: unconditional re-arm with data pending
+                    # would storm the dispatcher, and the input loop
+                    # re-drains pending data anyway via _nevent
                     self._busy_rearmed = True
                     resume = getattr(self.conn, "resume_read_events", None)
                     if resume is not None:
@@ -390,30 +419,81 @@ class Socket:
             except Exception:
                 pass
 
+    def _input_error(self, e: BaseException) -> None:
+        # an escaping parse/process error must not wedge the socket (a
+        # dead processing context would leave _nevent elevated and no
+        # future event would restart it): drop the conn
+        import logging
+        logging.getLogger("brpc_tpu.transport").exception(
+            "input processing failed; dropping connection")
+        self.set_failed(e if isinstance(e, Exception)
+                        else ConnectionError(str(e)))
+
+    def _finish_input_cycle(self, pending: int) -> bool:
+        """Settle one drain+dispatch cycle; True = more events arrived
+        (caller loops)."""
+        with self._nevent_lock:
+            self._nevent -= pending
+            if self._nevent > 0:
+                return True
+            self._busy_rearmed = False   # busy period over
+            resume = self._busy_paused
+            self._busy_paused = False
+        if resume and not self.failed:
+            # read interest was paused during the busy period
+            # (level-triggered conns): re-arm; pending bytes fire the
+            # event again immediately
+            try:
+                self.conn.resume_read_events()
+            except Exception:
+                pass
+        return False
+
+    def _process_input_entry(self) -> None:
+        """Sync processing loop (no coroutine, no Fiber); when a
+        message's processing turns out to be async, the remainder of
+        the cycle escalates to a fiber via run_inline."""
+        while True:
+            with self._nevent_lock:
+                pending = self._nevent
+            self._drain_readable()
+            if self.input_portal or self.failed:
+                r = None
+                try:
+                    r = self._on_input_sync(self)
+                except BaseException as e:
+                    self._input_error(e)
+                if r is not None:
+                    self._control.run_inline(
+                        self._input_async_tail(r, pending),
+                        name="socket_input")
+                    return
+            if not self._finish_input_cycle(pending):
+                return
+
+    async def _input_async_tail(self, r, pending: int):
+        """Finish an escalated cycle: await the pending processing, then
+        continue the event loop in async mode."""
+        try:
+            await r
+        except BaseException as e:
+            self._input_error(e)
+        if self._finish_input_cycle(pending):
+            await self._process_input()
+
     async def _process_input(self):
         while True:
             with self._nevent_lock:
                 pending = self._nevent
-            progressed = self._drain_readable()
+            self._drain_readable()
             if self._on_input is not None and (self.input_portal or self.failed):
                 try:
                     r = self._on_input(self)
                     if hasattr(r, "__await__"):
                         await r
                 except BaseException as e:
-                    # an escaping parse/process error must not wedge the
-                    # socket (the fiber dying would leave _nevent elevated
-                    # and no future event would respawn us): drop the conn
-                    import logging
-                    logging.getLogger("brpc_tpu.transport").exception(
-                        "input processing failed; dropping connection")
-                    self.set_failed(e if isinstance(e, Exception)
-                                    else ConnectionError(str(e)))
-            with self._nevent_lock:
-                self._nevent -= pending
-                if self._nevent > 0:
-                    continue
-                self._busy_rearmed = False   # busy period over
+                    self._input_error(e)
+            if not self._finish_input_cycle(pending):
                 return
 
     def _drain_readable(self) -> int:
@@ -457,6 +537,14 @@ class Socket:
                 # stop without paying a raise/catch of BlockingIOError
                 # per message. Safe only because such conns notify on
                 # every write, so a refill re-triggers _process_input.
+                break
+            if self._level_triggered and n < 4096:
+                # short read on a level-triggered fd: almost certainly
+                # drained — skip the EAGAIN recv round trip. 4096 is
+                # below every buffer this loop offers (fresh blocks are
+                # >=8KB; tail gaps <4KB are never offered), so a short
+                # read really was short. If the kernel does hold more,
+                # the level trigger fires again — no stall possible.
                 break
         return total
 

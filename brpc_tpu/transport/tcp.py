@@ -59,6 +59,13 @@ class TcpConn(Conn):
                 raise BlockingIOError from e
             raise
 
+    # level-triggered events (see start_events): a short read implies the
+    # kernel buffer is (almost certainly) empty, and if not, the level
+    # trigger fires again — Socket._drain_readable may stop early
+    # without the EAGAIN recv round trip. Pause/resume move the
+    # read-interest syscalls from per-message to per-busy-period.
+    level_triggered = True
+
     def peek_closed(self) -> bool:
         """Non-consuming liveness probe (MSG_PEEK): True only when the
         peer's FIN has arrived AND no data remains to deliver — pending
@@ -82,11 +89,18 @@ class TcpConn(Conn):
 
     def start_events(self, on_readable, on_writable) -> None:
         self._on_writable = on_writable
-        # one-shot read arming (edge-trigger style): the consumer's
-        # drain loop re-arms via resume_read_events() on EAGAIN, so the
-        # dispatcher doesn't spin while a fiber works through a transfer
+        # LEVEL-triggered: with inline processing the drain runs on the
+        # dispatcher thread itself, so by the time the callback returns
+        # the kernel buffer is empty and the level trigger is silent —
+        # zero read-interest syscalls on the common path. The consumer
+        # pauses read interest explicitly for the rare busy period
+        # (handler suspended with data still arriving), which is where
+        # one-shot arming paid a disarm+rearm syscall PER MESSAGE.
         global_dispatcher().add_consumer(self._sock.fileno(), on_readable,
-                                         oneshot_read=True)
+                                         oneshot_read=False)
+
+    def pause_read_events(self) -> None:
+        global_dispatcher().pause_read(self._sock.fileno())
 
     def resume_read_events(self) -> None:
         global_dispatcher().resume_read(self._sock.fileno())
