@@ -105,6 +105,7 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     # write only what's actually set (instance-dict writes add up here)
     if meta.trace_id:
         d["trace_id"] = meta.trace_id
+    if meta.span_id:
         d["span_id"] = meta.span_id
     if req_meta.log_id:
         d["log_id"] = req_meta.log_id

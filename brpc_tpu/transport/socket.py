@@ -396,15 +396,20 @@ class Socket:
                     # event in a hot loop — pause read interest for the
                     # rest of the busy period (the input loop re-drains
                     # via _nevent, and the busy-period end resumes).
-                    # This is the only read-interest syscall pair left
-                    # on the TCP path: the idle/inline common case pays
-                    # none (vs one-shot's disarm+rearm per message)
+                    # Flag AND fd state change under ONE _nevent_lock
+                    # hold, and only while a processing pass is still
+                    # owed (_nevent > 0): otherwise this pause could
+                    # race the busy period ending and leave the fd
+                    # deaf forever (no one left to resume). The
+                    # matching resume in _finish_input_cycle also runs
+                    # under the lock, so flag and fd state never
+                    # disagree. This is the only read-interest syscall
+                    # pair left on the TCP path; the idle/inline common
+                    # case pays none
                     with self._nevent_lock:
-                        pause = not self._busy_paused
-                        if pause:
+                        if self._nevent > 0 and not self._busy_paused:
                             self._busy_paused = True
-                    if pause:
-                        self.conn.pause_read_events()
+                            self.conn.pause_read_events()
                 elif not self._busy_rearmed:
                     # one-shot conns (ssl): this event consumed the read
                     # interest — re-arm so a later FIN during the same
@@ -437,16 +442,16 @@ class Socket:
             if self._nevent > 0:
                 return True
             self._busy_rearmed = False   # busy period over
-            resume = self._busy_paused
-            self._busy_paused = False
-        if resume and not self.failed:
-            # read interest was paused during the busy period
-            # (level-triggered conns): re-arm; pending bytes fire the
-            # event again immediately
-            try:
-                self.conn.resume_read_events()
-            except Exception:
-                pass
+            if self._busy_paused:
+                # paired with the pause in _on_readable_event: both run
+                # under the lock so the paused flag always matches the
+                # fd's read-interest state
+                self._busy_paused = False
+                if not self.failed:
+                    try:
+                        self.conn.resume_read_events()
+                    except Exception:
+                        pass
         return False
 
     def _process_input_entry(self) -> None:
@@ -511,11 +516,15 @@ class Socket:
                 n = self.input_portal.append_from_reader(
                     self.conn.read_into, hint=hint)
             except BlockingIOError:
-                # drained: with one-shot read arming, the dispatcher
-                # won't fire again until we re-arm
-                resume = getattr(self.conn, "resume_read_events", None)
-                if resume is not None:
-                    resume()
+                # drained. One-shot conns re-arm here (the event consumed
+                # their read interest). Level-triggered conns must NOT:
+                # their arming is owned by the pause/resume busy protocol
+                # — an EAGAIN rearm mid-pause would defeat the pause and
+                # let the fd re-fire hot for the rest of the busy period
+                if not self._level_triggered:
+                    resume = getattr(self.conn, "resume_read_events", None)
+                    if resume is not None:
+                        resume()
                 break
             except (ConnectionError, OSError) as e:
                 self.set_failed(e)

@@ -701,3 +701,49 @@ class TestConnectionTypes:
         finally:
             server.stop()
             server.join(2)
+
+
+class TestLevelTriggeredBusyPause:
+    def test_requests_arriving_during_parked_handler(self):
+        """Level-triggered TCP + pause-on-busy (socket.py): requests
+        landing while an async handler is parked must neither spin the
+        dispatcher nor leave the connection deaf after the busy period
+        (the pause/resume pairing runs under the nevent lock)."""
+        from brpc_tpu.fiber.timer import sleep as fiber_sleep
+        from brpc_tpu.rpc import Server, Service
+
+        server = Server()
+        svc = Service("EchoService")
+
+        @svc.method()
+        async def SlowEcho(cntl, request):
+            await fiber_sleep(0.15)
+            return request
+
+        @svc.method()
+        async def Echo(cntl, request):
+            return request
+
+        server.add_service(svc)
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                         ChannelOptions(timeout_ms=4000))
+            # first request parks its handler; the next two arrive on
+            # the SAME multiplexed connection during the busy period
+            c1 = ch.call("EchoService", "SlowEcho", b"a")
+            time.sleep(0.03)
+            c2 = ch.call("EchoService", "SlowEcho", b"b")
+            c3 = ch.call("EchoService", "SlowEcho", b"c")
+            for c, want in ((c1, b"a"), (c2, b"b"), (c3, b"c")):
+                assert c.join(6)
+                assert not c.failed(), c.error_text
+                assert c.response_payload.to_bytes() == want
+            # the connection must still be live AFTER the busy period
+            # (a lost resume would leave the fd deaf and time this out)
+            c4 = ch.call_sync("EchoService", "Echo", b"after-busy")
+            assert not c4.failed(), c4.error_text
+            assert c4.response_payload.to_bytes() == b"after-busy"
+        finally:
+            server.stop()
+            server.join(2)
