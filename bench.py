@@ -253,96 +253,91 @@ def measure_native_delta() -> dict:
 
 
 def make_runner(ch, deadline, np):
-    """Pipelined batch runner over `ch`; returns wall seconds.
+    """Callback-driven pipelined runner over `ch`; returns wall seconds.
 
     Host payloads ride the ATTACHMENT (zero-copy in and out of the
     framing on both sides), the reference's large-payload benchmark
     shape — rdma_performance moves its bytes in
-    cntl.request_attachment, not the serialized pb."""
+    cntl.request_attachment, not the serialized pb. The next call is
+    issued FROM the completion callback (the reference's async client
+    loop): the whole client side runs on the event thread with no
+    issue-thread/semaphore GIL ping-pong — measured worth ~20% on a
+    single-core box. ``threads`` is accepted for signature compatibility
+    and ignored (issue threads only added GIL contention here)."""
     from brpc_tpu.butil.iobuf import IOBuf
     from brpc_tpu.rpc import Controller
 
     def run_batch(iters: int, inflight: int, rec, payload: bytes = b"",
                   device_buf=None, threads: int = 1) -> float:
-        sem = threading.Semaphore(inflight)
         done_evt = threading.Event()
         errors: list = []
         remaining = [iters]
+        to_issue = [iters]
         lock = threading.Lock()
         expect = device_buf.nbytes if device_buf is not None else len(payload)
-
-        def settle(n: int) -> None:
-            with lock:
-                remaining[0] -= n
-                if remaining[0] <= 0:
-                    done_evt.set()
-
-        def make_done(t_start_ns, per_sem):
-            def _done(cntl):
-                try:
-                    if cntl.failed():
-                        raise RuntimeError(cntl.error_text)
-                    if device_buf is not None:
-                        out = np.asarray(cntl.response_device_arrays[0])
-                        if out.nbytes != expect:
-                            raise RuntimeError("payload size mismatch")
-                    elif cntl.response_attachment.size != expect:
-                        raise RuntimeError("payload size mismatch")
-                    if rec is not None:
-                        rec.record((time.perf_counter_ns() - t_start_ns)
-                                   / 1e3)
-                except BaseException as e:
-                    errors.append(e)
-                finally:
-                    per_sem.release()
-                    settle(1)
-            return _done
 
         kwargs = {}
         if device_buf is not None:
             kwargs["request_device_arrays"] = [device_buf]
 
-        def issue_loop(n: int, per_sem) -> None:
-            issued = 0
-            try:
-                for _ in range(n):
-                    per_sem.acquire()
-                    if errors:
-                        break
-                    cntl = None
-                    if device_buf is None and payload:
-                        cntl = Controller()
-                        att = IOBuf()
-                        att.append(payload)  # zero-copy wrap (>=16KB)
-                        cntl.request_attachment = att
-                    ch.call("Bench", "Echo", b"", cntl=cntl,
-                            done=make_done(time.perf_counter_ns(), per_sem),
-                            **kwargs)
-                    issued += 1
-            except BaseException as e:  # noqa: BLE001 - a sync failure in
-                # a daemon issuing thread must surface as the batch error,
-                # not as a 20s timeout with the real cause swallowed
-                errors.append(e)
-            finally:
-                if issued < n:
-                    settle(n - issued)  # unblock done_evt waiters
+        def issue_one() -> None:
+            cntl = None
+            if device_buf is None and payload:
+                cntl = Controller()
+                att = IOBuf()
+                att.append(payload)  # zero-copy wrap (>=16KB)
+                cntl.request_attachment = att
+            t_start = time.perf_counter_ns()
+            ch.call("Bench", "Echo", b"", cntl=cntl,
+                    done=lambda c, t=t_start: _done(c, t), **kwargs)
 
+        def _done(cntl, t_start_ns) -> None:
+            try:
+                if cntl.failed():
+                    raise RuntimeError(cntl.error_text)
+                if device_buf is not None:
+                    out = np.asarray(cntl.response_device_arrays[0])
+                    if out.nbytes != expect:
+                        raise RuntimeError("payload size mismatch")
+                elif cntl.response_attachment.size != expect:
+                    raise RuntimeError("payload size mismatch")
+                if rec is not None:
+                    rec.record((time.perf_counter_ns() - t_start_ns) / 1e3)
+            except BaseException as e:
+                errors.append(e)
+            with lock:
+                remaining[0] -= 1
+                if errors and to_issue[0]:
+                    # stop reissuing AND settle the unissued share, or
+                    # done_evt never fires and a timeout masks the error
+                    remaining[0] -= to_issue[0]
+                    to_issue[0] = 0
+                fin = remaining[0] <= 0
+                reissue = to_issue[0] > 0 and not errors
+                if reissue:
+                    to_issue[0] -= 1
+            if fin:
+                done_evt.set()
+            elif reissue:
+                try:
+                    issue_one()
+                except BaseException as e:  # noqa: BLE001 - surface, don't hang
+                    errors.append(e)
+                    with lock:
+                        n = remaining[0]
+                        remaining[0] = 0
+                    done_evt.set()
+
+        window = min(inflight, iters)
+        with lock:
+            to_issue[0] = iters - window
         t0 = time.perf_counter()
-        if threads <= 1:
-            issue_loop(iters, sem)
-        else:
-            # one issuing thread per slice (the reference's
-            # multi_threaded_echo_c++ client shape); each slice gets its
-            # own inflight window
-            per = max(1, inflight // threads)
-            counts = [iters // threads] * threads
-            counts[0] += iters - sum(counts)
-            ths = [threading.Thread(
-                target=issue_loop,
-                args=(c, threading.Semaphore(per)), daemon=True)
-                for c in counts]
-            for th in ths:
-                th.start()
+        try:
+            for _ in range(window):
+                issue_one()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+            done_evt.set()
         wait_s = max(20.0, deadline.remaining() + 20.0)
         if not done_evt.wait(wait_s):
             raise RuntimeError(f"bench batch timed out after {wait_s:.0f}s "
@@ -363,16 +358,24 @@ def main() -> None:
 
     from brpc_tpu import native
 
+    from brpc_tpu.native import fastcore
+
     result: dict = {
         "metric": "echo_rpc_1mb_bandwidth_tcp_loopback",
         "value": 0.0, "unit": "GB/s", "vs_baseline": 0.0,
         "partial": False, "device_lane": {},
-        # which C++ core pieces are load-bearing (hash + c_murmurhash LB
-        # always; the frame scanner is flag-gated — measured at parity
-        # with the per-frame path, see protocol/tpu_std.py batch_parse)
+        # which C++ core pieces are load-bearing on the per-call hot
+        # path (src/fastcore.cc binds them via the CPython C API; the
+        # ctypes lane covers bulk codecs)
         "native": {"available": native.available(),
-                   "wired": ["crc32c", "murmur3 (c_murmurhash LB)",
-                             "trpc_scan (flag tpu_std_batch_parse)"],
+                   "fastcore": fastcore.available(),
+                   "wired": [
+                       "pack_frame (tpu_std request+response framing)",
+                       "parse_head (tpu_std frame probe)",
+                       "respool.cc Pool (correlation ids + socket ids)",
+                       "queues.cc Mpsc writer-retire (socket write queue)",
+                       "crc32c", "murmur3 (c_murmurhash LB)",
+                       "trpc_scan (flag tpu_std_batch_parse)"],
                    "delta": measure_native_delta()},
     }
     deadline = Deadline(WALL_BUDGET_S)

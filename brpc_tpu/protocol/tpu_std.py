@@ -44,6 +44,12 @@ _HDR = struct.Struct(">4sII")
 _TAG_CORRELATION_ID = 0x20   # field 4, wire type 0
 _TAG_ATTACHMENT_SIZE = 0x28  # field 5, wire type 0
 
+# frames at/under this total size take the single-bytes fast path on BOTH
+# wire ends (channel request pack / server response pack); bigger frames
+# stay zero-copy IOBuf chains — the fast path's attachment flatten +
+# one-allocation assembly would COPY them
+SMALL_FRAME_MAX = 32768
+
 
 def _varint(n: int) -> bytes:
     out = b""

@@ -21,8 +21,8 @@ from brpc_tpu.fiber import TaskControl, global_control
 from brpc_tpu.fiber.sync import FiberEvent as _FiberEvent
 from brpc_tpu.fiber.timer import global_timer
 from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
-from brpc_tpu.protocol.tpu_std import (pack_message, pack_small_frame,
-                                       serialize_payload)
+from brpc_tpu.protocol.tpu_std import (SMALL_FRAME_MAX, pack_message,
+                                       pack_small_frame, serialize_payload)
 from brpc_tpu.rpc import errno_codes as berr
 from brpc_tpu.rpc.controller import Controller, address_call, take_call
 from brpc_tpu.transport.input_messenger import InputMessenger
@@ -374,13 +374,16 @@ class Channel:
         # optional sections (compress/trace/stream/device arrays) frames
         # from a cached meta prefix into ONE bytes object and sends it
         # straight from this context — no pb object, no IOBuf
+        att = cntl.__dict__.get("request_attachment")
         if (self._framer_cache is pack_message or
                 (self._framer_cache is None
                  and self.options.protocol in ("", "tpu_std"))) \
                 and not cntl.compress_type and not cntl.trace_id \
                 and cntl.stream is None \
                 and not cntl.__dict__.get("request_device_arrays") \
-                and cntl.log_id == 0:
+                and cntl.log_id == 0 \
+                and len(cntl._request_bytes) + (att.size if att else 0) \
+                <= SMALL_FRAME_MAX:
             key = (cntl._service_name, cntl._method_name, cntl.timeout_ms,
                    cntl.auth_token)
             prefix = self._meta_prefix_cache.get(key)
@@ -395,7 +398,6 @@ class Channel:
                 prefix = m.SerializeToString()
                 if len(self._meta_prefix_cache) < 4096:
                     self._meta_prefix_cache[key] = prefix
-            att = cntl.__dict__.get("request_attachment")
             wire = pack_small_frame(prefix, cntl.correlation_id,
                                     cntl._request_bytes,
                                     att.to_bytes() if att else b"")

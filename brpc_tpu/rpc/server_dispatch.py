@@ -16,8 +16,8 @@ from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.fiber.scheduler import SchedAwaitable, current_group
 from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
 from brpc_tpu.protocol.tpu_std import (
-    RpcMessage, TpuStdProtocol, pack_message, pack_small_frame,
-    serialize_payload, unpack_inline_device_arrays)
+    SMALL_FRAME_MAX, RpcMessage, TpuStdProtocol, pack_message,
+    pack_small_frame, serialize_payload, unpack_inline_device_arrays)
 from brpc_tpu.rpc import errno_codes as berr
 from brpc_tpu.rpc.controller import Controller
 
@@ -248,6 +248,7 @@ def _send_response(proto, socket, cid: int, cntl: Controller,
     # stream/device/progressive sections needs only correlation_id (+
     # attachment_size) in its meta — hand-encoded varints over a single
     # bytes frame, no pb object, no IOBuf
+    att = cntl.__dict__.get("response_attachment")
     if (not cntl.failed() and cntl.compress_type == 0
             and getattr(cntl, "_accepted_stream", None) is None
             and not cntl.__dict__.get("response_device_arrays")
@@ -257,12 +258,13 @@ def _send_response(proto, socket, cid: int, cntl: Controller,
         except TypeError as e:
             cntl.set_failed(berr.EINTERNAL, str(e))
         else:
-            att = cntl.__dict__.get("response_attachment")
-            wire = pack_small_frame(b"", cid, payload,
-                                    att.to_bytes() if att else b"",
-                                    magic=proto.MAGIC)
-            socket.write_small(wire)
-            return
+            if len(payload) + (att.size if att else 0) <= SMALL_FRAME_MAX:
+                wire = pack_small_frame(b"", cid, payload,
+                                        att.to_bytes() if att else b"",
+                                        magic=proto.MAGIC)
+                socket.write_small(wire)
+                return
+            # big response: stay zero-copy (IOBuf chain) below
     meta = pb.RpcMeta()
     meta.correlation_id = cid
     meta.response.error_code = cntl.error_code

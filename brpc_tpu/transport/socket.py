@@ -148,7 +148,13 @@ class Socket:
         # (bytes|IOBuf, done_cb|None); the producer whose push claims
         # writership drains — socket.cpp:1924-2005's _write_head protocol
         self._wq = _new_mpsc()
-        self._handoff = None      # mid-frame leftover owned by keep_write
+        # mid-frame leftover of a parked writer: (IOBuf, cb). INVARIANT:
+        # non-None exactly while writership is parked awaiting a
+        # writable event; consuming it (under _handoff_lock) IS taking
+        # writership. Both the writable-event continuation and
+        # set_failed's cleanup race for it — exactly one wins.
+        self._handoff = None
+        self._handoff_lock = threading.Lock()
         self._writable_butex = Butex(0)
         self._nevent = 0                          # edge-trigger input counter
         self._nevent_lock = threading.Lock()
@@ -169,6 +175,8 @@ class Socket:
         self._inline_write = getattr(conn, "inline_write_ok", False)
         self._drain_all_reads = getattr(conn, "drain_all_reads", False)
         self._level_triggered = getattr(conn, "level_triggered", False)
+        self._writev = getattr(conn, "writev", None)
+        self._readv = getattr(conn, "read_into_v", None)
         try:
             self.id: SocketId = _pool().insert(self)
         except RuntimeError:
@@ -233,7 +241,7 @@ class Socket:
         BlockingIOError is absorbed into a leftover (never an error)."""
         try:
             if isinstance(data, IOBuf):
-                data.cut_into_writer(self.conn.write)
+                self._cut_buf(data)
                 return None, (data if data else None)
             mv = memoryview(data)
             while mv:
@@ -252,26 +260,52 @@ class Socket:
         except (BrokenPipeError, ConnectionError, OSError) as e:
             return e, None
 
-    def _drain_writes_inline(self) -> bool:
-        """Writer loop in the submitting context (claimed via push)."""
+    def _drain_writes_inline(self, first_item=None) -> bool:
+        """Writer loop in the claiming context (push claim, a writable-
+        event continuation, or set_failed's cleanup). On EAGAIN the
+        partial frame parks in _handoff with writership attached and a
+        one-shot writable event re-enters this loop ON THE DISPATCHER —
+        no fiber, no worker wake per blocked write (the reference pays a
+        bthread park/wake here, which is ~1us for it and ~50us for us)."""
         ok = True
+        item = first_item
         while True:
-            item = self._wq.drain_one()
+            if item is None:
+                item = self._wq.drain_one()
             if item is None:
                 if self._wq.try_retire():
                     return ok
                 continue          # a racing push landed: keep draining
             data, cb = item
+            item = None
             err: Optional[BaseException] = None
             if self.failed:
                 err = self.fail_reason
             else:
                 err, leftover = self._write_data_once(data)
                 if err is None and leftover is not None:
-                    # blocked mid-frame: the keep_write fiber inherits
-                    # writership AND the partial frame
-                    self._handoff = (leftover, cb)
-                    self._control.spawn(self._keep_write, name="keep_write")
+                    # blocked mid-frame: park writership on the writable
+                    # event (continuation takes it via _take_handoff)
+                    with self._handoff_lock:
+                        self._handoff = (leftover, cb)
+                    try:
+                        self.conn.request_writable_event()
+                    except Exception as e:
+                        took = self._take_handoff()
+                        self.set_failed(e if isinstance(e, Exception)
+                                        else ConnectionError(str(e)))
+                        if took is None:
+                            # a concurrent set_failed already claimed the
+                            # handoff AND writership: draining here too
+                            # would put two consumers on the queue
+                            return False
+                        if took[1] is not None:
+                            try:
+                                took[1](self.fail_reason)
+                            except Exception:
+                                pass
+                        ok = False
+                        continue
                     return ok
             if err is not None:
                 ok = False
@@ -282,16 +316,31 @@ class Socket:
                 except Exception:
                     pass
 
+    def _take_handoff(self):
+        with self._handoff_lock:
+            item, self._handoff = self._handoff, None
+        return item
+
     def write_device_payload(self, arrays) -> bool:
         """Out-of-band device lane (mem/tpu transports); host transports
         must serialize instead."""
         r = self.conn.write_device_payload(arrays)
         return bool(r)
 
+    def _cut_buf(self, buf: IOBuf) -> None:
+        """Write as much of the chain as the conn accepts: gather-write
+        (one sendmsg per iovec batch) when available and worthwhile,
+        per-ref writes otherwise. BlockingIOError is absorbed, leaving
+        the remainder in ``buf``."""
+        if self._writev is not None and buf.backing_block_count > 1:
+            buf.cut_into_gather_writer(self._writev)
+        else:
+            buf.cut_into_writer(self.conn.write)
+
     async def _write_buf_blocking(self, buf: IOBuf) -> Optional[BaseException]:
         while buf and not self.failed:
             try:
-                buf.cut_into_writer(self.conn.write)
+                self._cut_buf(buf)
             except (BrokenPipeError, ConnectionError, OSError) as e:
                 return e
             if buf:
@@ -309,7 +358,7 @@ class Socket:
         writable butex when the conn blocks (KeepWrite bthread,
         socket.cpp:2066-2160). On failure every remaining item's callback
         fires with the reason — never a silent drop."""
-        handoff, self._handoff = self._handoff, None
+        handoff = self._take_handoff()
         if handoff is not None:
             buf, cb = handoff
             err = await self._write_buf_blocking(buf)
@@ -347,6 +396,12 @@ class Socket:
     def _on_writable_event(self):
         self._writable_butex.fetch_add(1)
         self._writable_butex.wake_all()
+        if self._inline_write:
+            item = self._take_handoff()
+            if item is not None:
+                # we now hold writership: resume the parked frame and
+                # whatever queued behind it, right here
+                self._drain_writes_inline(first_item=item)
 
     # -------------------------------------------------------------- input
     def _on_readable_event(self):
@@ -513,8 +568,13 @@ class Socket:
         while not self.failed:
             hint = self._read_hint
             try:
-                n = self.input_portal.append_from_reader(
-                    self.conn.read_into, hint=hint)
+                if self._readv is not None and hint >= _BIG_BLOCK_SIZE:
+                    # bulk mode: scatter-read a whole burst per syscall
+                    n = self.input_portal.append_from_reader_v(
+                        self._readv, hint=hint, nbufs=4)
+                else:
+                    n = self.input_portal.append_from_reader(
+                        self.conn.read_into, hint=hint)
             except BlockingIOError:
                 # drained. One-shot conns re-arm here (the event consumed
                 # their read interest). Level-triggered conns must NOT:
@@ -578,6 +638,13 @@ class Socket:
             pass
         self._writable_butex.fetch_add(1)
         self._writable_butex.wake_all()
+        # a writer parked on a writable event will never be woken by the
+        # closed conn: claim its handoff (the take IS the writership
+        # transfer — the event continuation that loses the race no-ops)
+        # and fail-drain it plus everything queued behind it
+        item = self._take_handoff()
+        if item is not None:
+            self._drain_writes_inline(first_item=item)
         for cb in cbs:
             try:
                 cb(self)

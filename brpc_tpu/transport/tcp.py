@@ -34,6 +34,14 @@ class TcpConn(Conn):
             sock.setsockopt(pysocket.IPPROTO_TCP, pysocket.TCP_NODELAY, 1)
         except OSError:
             pass
+        try:
+            # bulk-transfer buffers: default rmem/wmem mean ~64-128KB per
+            # recv wakeup on a 1MB payload — each extra chunk costs a
+            # syscall plus block bookkeeping on the drain path
+            sock.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_RCVBUF, 1 << 20)
+            sock.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_SNDBUF, 1 << 20)
+        except OSError:
+            pass
         self._sock = sock
         self._local = local
         self._remote = remote
@@ -42,6 +50,32 @@ class TcpConn(Conn):
     def write(self, mv: memoryview) -> int:
         try:
             return self._sock.send(mv)
+        except BlockingIOError:
+            raise
+        except OSError as e:
+            if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                raise BlockingIOError from e
+            raise
+
+    def writev(self, views) -> int:
+        """Gather-send (sendmsg): one syscall for a whole ref chain —
+        a chunked 1MB response is ~6 scattered blocks, and per-block
+        send() syscalls were the server's dominant cost
+        (iobuf.h:177 prepare_iovecs / writev discipline)."""
+        try:
+            return self._sock.sendmsg(views)
+        except BlockingIOError:
+            raise
+        except OSError as e:
+            if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                raise BlockingIOError from e
+            raise
+
+    def read_into_v(self, views) -> int:
+        """Scatter-read (recvmsg_into): fill several blocks per syscall
+        when a burst is pending (iobuf.h:469's readv-into-many-blocks)."""
+        try:
+            return self._sock.recvmsg_into(views)[0]
         except BlockingIOError:
             raise
         except OSError as e:

@@ -2,7 +2,7 @@ import numpy as np
 import pytest
 
 from brpc_tpu.butil.iobuf import (
-    DEFAULT_BLOCK_SIZE, Block, DeviceBlock, IOBuf, IOPortal, _tls_cache,
+    DEFAULT_BLOCK_SIZE, Block, DeviceBlock, IOBuf, IOPortal, _free_blocks,
 )
 
 
@@ -149,15 +149,17 @@ def test_ioportal_append_from_reader():
     assert portal.to_bytes() == b"streamed-data" * 100
 
 
-def test_block_recycling_returns_buffer_to_tls_cache():
+def test_block_recycling_returns_buffer_to_free_list():
+    # process-global freelist: blocks freed on ANY thread are reusable
+    # by every other (the cross-thread server read/free pattern)
     import gc
-    _tls_cache.free.clear()
+    _free_blocks.clear()
     buf = IOBuf()
     buf.append(b"q" * DEFAULT_BLOCK_SIZE)
     del buf
     gc.collect()
-    assert len(_tls_cache.free) == 1
+    assert len(_free_blocks) == 1
     # a fresh block reuses the cached bytearray
-    reused = _tls_cache.free[0]
+    reused = _free_blocks[0]
     blk = Block()
     assert blk.data is reused

@@ -28,7 +28,7 @@ def main() -> None:
     svc = Service("Bench")
 
     @svc.method()
-    def Echo(cntl, request):
+    async def Echo(cntl, request):
         # attachment blocks flow back out unjoined (zero-copy, the
         # reference's rdma_performance echo shape: payload rides the
         # attachment, example/rdma_performance/client.cpp); the byte
