@@ -84,6 +84,9 @@ def _init_jax_with_retry(deadline: "Deadline"):
         def attempt():
             try:
                 import jax
+
+                from brpc_tpu.butil.jax_env import apply_jax_platforms_env
+                apply_jax_platforms_env()   # env choice beats plugin override
                 box["devs"] = jax.devices()
             except Exception as e:  # noqa: BLE001 - retried bring-up
                 box["err"] = f"{type(e).__name__}: {e}"[:300]
@@ -512,10 +515,15 @@ def main() -> None:
         # OS timeslicing of the load threads, not framework queueing —
         # the round-3 convoy (p50 ~1ms under load) is what this guards.
         try:
-            result.update(measure_wake_under_load(ch))
-            _progress({"progress": "fiber_wake",
-                       "p50_us": result["fiber_wake_under_load_p50_us"],
-                       "p99_us": result["fiber_wake_under_load_p99_us"]})
+            wake = measure_wake_under_load(ch)
+            if wake:
+                result.update(wake)
+                _progress({"progress": "fiber_wake",
+                           "p50_us": wake["fiber_wake_under_load_p50_us"],
+                           "p99_us": wake["fiber_wake_under_load_p99_us"]})
+            else:
+                result["fiber_wake_error"] = \
+                    "probe produced zero samples (core saturated)"
         except Exception as e:  # noqa: BLE001 - diagnostics only
             result["fiber_wake_error"] = f"{type(e).__name__}: {e}"[:200]
         _progress({"progress": "tcp_small",

@@ -1,0 +1,29 @@
+"""Re-assert the operator's JAX_PLATFORMS choice.
+
+The axon device plugin's sitecustomize calls ``register()``, which sets
+``jax_platforms`` PROGRAMMATICALLY — and a config value beats the env
+var. The practical symptom: ``JAX_PLATFORMS=cpu python anything.py``
+still initializes the tunneled device backend, and ``jax.devices()``
+hangs for minutes when the tunnel is wedged (tests dodge this in
+conftest.py with the same config.update; every non-pytest entry point
+needs it too — examples, tools, bench).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_jax_platforms_env() -> None:
+    """If JAX_PLATFORMS is set in the env, make it effective even after
+    a plugin overrode the config. No-op (and jax-import-free) when the
+    env var is absent."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", want)
+    except Exception:
+        pass

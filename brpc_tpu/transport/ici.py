@@ -139,6 +139,9 @@ def _get_transfer_server():
         try:
             import jax
             from jax.experimental import transfer
+
+            from brpc_tpu.butil.jax_env import apply_jax_platforms_env
+            apply_jax_platforms_env()   # env choice beats plugin override
             client = jax.devices()[0].client
             # explicit socket transport addresses: the default local bulk
             # transport only moves bytes within one process (aborts on a
@@ -216,11 +219,53 @@ class _LazyAdder:
 # peer pulled but had not yet acknowledged is counted too.
 _unpulled_registrations = _LazyAdder("ici_unpulled_registrations")
 
+# the HBM those leaked registrations pin, and the circuit breaker that
+# BOUNDS it: once the cumulative leaked estimate crosses the cap, new
+# connections stop using the pull lane (degrading to the host-staged
+# lane, which pins nothing) — a long-lived server cycling through dying
+# peers trades bandwidth for a bounded footprint instead of leaking HBM
+# without limit (block_pool.cpp:271-340 freelist hygiene, adapted to an
+# API with no cancel). /vars ici_unpulled_bytes tracks the estimate.
+_unpulled_bytes = _LazyAdder("ici_unpulled_bytes")
+_leaked_pull_bytes = [0]
+_LEAK_CAP_BYTES = int(os.environ.get(
+    "BRPC_TPU_ICI_PULL_LEAK_CAP", 256 << 20))
+
+
+_leak_breaker_logged = [False]
+
+
+def _pull_lane_allowed() -> bool:
+    if _leaked_pull_bytes[0] < _LEAK_CAP_BYTES:
+        return True
+    if not _leak_breaker_logged[0]:
+        # once, on the open->tripped transition (this runs per batch)
+        _leak_breaker_logged[0] = True
+        logger.warning(
+            "ici: leaked pull registrations estimated at ~%d MB "
+            "(cap %d MB, an UPPER BOUND — pulled-but-unacked batches "
+            "count too) — new lane batches use the host-staged path. "
+            "Raise BRPC_TPU_ICI_PULL_LEAK_CAP to re-enable.",
+            _leaked_pull_bytes[0] >> 20, _LEAK_CAP_BYTES >> 20)
+    return False
+
+
 # same-process exchange entries from closed connections are reclaimed on
 # a grace timer, not immediately: close() flushes queued descriptor
 # frames, so the peer may legitimately still take them — an instant pop
-# would turn that take into an error
-_RECLAIM_GRACE_S = 30.0
+# would turn that take into an error. Tunable so soak tests can cycle
+# quickly (flag ici_reclaim_grace_s).
+from brpc_tpu.butil.flags import define_flag as _define_flag, flag as _flag
+
+_define_flag("ici_reclaim_grace_s", 30.0,
+             "seconds a closed connection's same-process exchange "
+             "entries linger before reclaim (peer may still take them)")
+
+
+def _reclaim_grace_s() -> float:
+    return float(_flag("ici_reclaim_grace_s"))
+
+
 _reclaim_queue: Deque[Tuple[float, int]] = deque()
 
 
@@ -320,7 +365,7 @@ class IciConn(Conn):
         # byte budget: footprints of un-ACKed batches, FIFO (the peer
         # consumes lane batches in order), so bytes-in-flight is
         # derivable from the cumulative ack count
-        self._inflight_footprints: Deque[int] = deque()
+        self._inflight_footprints: Deque[Tuple[int, bool]] = deque()
         self._inflight_bytes = 0
         # uids this connection registered for peer pull; reclaimed (or at
         # least counted) on close/failure
@@ -384,7 +429,7 @@ class IciConn(Conn):
         FIFO footprints (bytes-in-flight accounting)."""
         with self._fc_lock:
             while self._peer_acked < ack and self._inflight_footprints:
-                self._inflight_bytes -= self._inflight_footprints.popleft()
+                self._inflight_bytes -= self._inflight_footprints.popleft()[0]
                 self._peer_acked += 1
             self._peer_acked = max(self._peer_acked, ack)
 
@@ -435,9 +480,11 @@ class IciConn(Conn):
                 _local_exchange[uid] = list(arrays)
             self._issued_uids.append(uid)
             frame = self._frame(F_DESCRIPTOR, _encode_descriptor(uid, arrays))
+            is_pull = False
         else:
             srv = _get_transfer_server()
-            if srv is not None and info.get("can_pull"):
+            if srv is not None and info.get("can_pull") \
+                    and _pull_lane_allowed():
                 uid = _next_uuid()
                 srv.await_pull(uid, list(arrays))
                 self._issued_uids.append(uid)
@@ -445,11 +492,13 @@ class IciConn(Conn):
                     self._pull_registered += 1
                 frame = self._frame(F_DESCRIPTOR,
                                     _encode_descriptor(uid, arrays))
+                is_pull = True
             else:
                 # degraded lane: host-staged numpy over the control stream
                 frame = self._frame(F_STAGED, _encode_device_batch(arrays))
+                is_pull = False
         with self._fc_lock:
-            self._inflight_footprints.append(footprint)
+            self._inflight_footprints.append((footprint, is_pull))
             self._inflight_bytes += footprint
             self._sent += 1
         _sweep_reclaim()
@@ -717,7 +766,8 @@ class IciConn(Conn):
         # bound: pulled-but-unacked ones are included) at
         # /vars ici_unpulled_registrations instead of pinning silently.
         import time as _time
-        deadline = _time.monotonic() + _RECLAIM_GRACE_S
+        grace = _reclaim_grace_s()
+        deadline = _time.monotonic() + grace
         queued = False
         with _local_lock:
             for uid in self._issued_uids:
@@ -731,15 +781,22 @@ class IciConn(Conn):
             # queued entries would pin device arrays until exit)
             try:
                 from brpc_tpu.fiber.timer import global_timer
-                global_timer().schedule_after(_RECLAIM_GRACE_S + 0.5,
+                global_timer().schedule_after(grace + 0.5,
                                               _sweep_reclaim)
             except Exception:
                 pass
         with self._fc_lock:
-            outstanding = min(self._sent - self._peer_acked,
-                              self._pull_registered)
+            # every entry still in the deque is un-ACKed; only PULL-lane
+            # batches pin peer-side registrations (staged/local bytes
+            # attributed here would falsely trip the breaker)
+            outstanding = sum(1 for _, p in self._inflight_footprints if p)
+            leaked_bytes = sum(fp for fp, p in self._inflight_footprints
+                               if p)
         if outstanding > 0 and (self.peer_info or {}).get("proc") != _PROC_UUID:
             _unpulled_registrations.add(outstanding)
+            _unpulled_bytes.add(leaked_bytes)
+            with _local_lock:   # closes race from two threads' +=
+                _leaked_pull_bytes[0] += leaked_bytes
         _sweep_reclaim()
         # drop any inbound descriptors never taken (their uids live in
         # the PEER's registry; our pool never reserved for them)

@@ -29,6 +29,9 @@ from brpc_tpu.transport.mem import MemConn, _MemPipe, _MemListener
 
 def _device_for(ordinal: Optional[int]):
     import jax
+
+    from brpc_tpu.butil.jax_env import apply_jax_platforms_env
+    apply_jax_platforms_env()   # env choice beats the plugin's override
     devs = jax.devices()
     if ordinal is None or ordinal >= len(devs):
         return devs[0]

@@ -7,14 +7,13 @@ Runs on a virtual CPU mesh anywhere:
         python examples/long_context/main.py
 """
 
-import os
 import sys
 
 sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
 
-if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
-    import jax
-    jax.config.update("jax_platforms", "cpu")
+from brpc_tpu.butil.jax_env import apply_jax_platforms_env
+
+apply_jax_platforms_env()  # env choice beats the axon plugin's override
 
 
 def main(seq: int = 2048) -> None:

@@ -12,6 +12,10 @@ import time
 
 sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
 
+from brpc_tpu.butil.jax_env import apply_jax_platforms_env
+
+apply_jax_platforms_env()  # env choice beats the axon plugin's override
+
 import numpy as np
 
 

@@ -16,6 +16,10 @@ import time
 
 sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
 
+from brpc_tpu.butil.jax_env import apply_jax_platforms_env
+
+apply_jax_platforms_env()  # env choice beats the axon plugin's override
+
 
 def main(iters: int = 30, address: str = "") -> None:
     import jax

@@ -434,7 +434,7 @@ class TestLaneLifecycle:
         # still takeable within the grace window
         assert all(u in ici._local_exchange for u in uids)
         # after the grace deadline the sweep reclaims
-        ici._sweep_reclaim(now=time.monotonic() + ici._RECLAIM_GRACE_S + 1)
+        ici._sweep_reclaim(now=time.monotonic() + ici._reclaim_grace_s() + 1)
         assert all(u not in ici._local_exchange for u in uids)
 
     def test_staged_lane_reserves_pool(self):
@@ -558,3 +558,63 @@ class TestFraming:
         hdr = ici._HDR.pack(ici.F_BYTES, 12345, 4)
         ftype, ack, length = ici._HDR.unpack(hdr)
         assert (ftype, ack, length) == (0, 12345, 4)
+
+
+class TestLaneLifecycleSoak:
+    def test_connect_transfer_close_cycles_return_to_baseline(self):
+        """Verdict r4 task: cycle connect/transfer/close many times and
+        assert the same-process exchange and the recv pool return to
+        baseline — a long-lived server must not accumulate pinned
+        entries from dead connections (block_pool.cpp:271-340 freelist
+        hygiene). Grace shortened via the ici_reclaim_grace_s flag so
+        expired entries reclaim within the test's patience."""
+        import jax.numpy as jnp
+        from brpc_tpu.butil.flags import flag, set_flag
+
+        old_grace = flag("ici_reclaim_grace_s")
+        set_flag("ici_reclaim_grace_s", 0.2)
+        server = make_echo_server()
+        ep = server.start(f"ici://127.0.0.1:0#device=0")
+        try:
+            arr = jnp.arange(256, dtype=jnp.float32)
+            for i in range(60):
+                ch = Channel(f"ici://127.0.0.1:{ep.port}")
+                cntl = ch.call_sync("EchoService", "EchoDevice", b"",
+                                    request_device_arrays=[arr])
+                assert not cntl.failed(), f"cycle {i}: {cntl.error_text}"
+                ch.close()
+            # wait past the grace, then force a sweep: every closed
+            # connection's exchange entries must be gone
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                ici._sweep_reclaim()
+                with ici._local_lock:
+                    n = len(ici._local_exchange)
+                if n == 0:
+                    break
+                time.sleep(0.1)
+            with ici._local_lock:
+                leftover = len(ici._local_exchange)
+            assert leftover == 0, \
+                f"{leftover} exchange entries pinned after 60 cycles"
+            assert not ici._reclaim_queue, \
+                f"reclaim queue not drained: {len(ici._reclaim_queue)}" 
+        finally:
+            set_flag("ici_reclaim_grace_s", old_grace)
+            server.stop()
+            server.join(2)
+
+    def test_pull_leak_circuit_breaker(self):
+        """Once the leaked-pull estimate crosses the cap, new batches
+        must refuse the pull lane (bounded HBM footprint; the transfer
+        API has no cancel so degradation is the only bound)."""
+        old = ici._leaked_pull_bytes[0]
+        old_logged = ici._leak_breaker_logged[0]
+        try:
+            ici._leaked_pull_bytes[0] = ici._LEAK_CAP_BYTES + 1
+            assert ici._pull_lane_allowed() is False
+            ici._leaked_pull_bytes[0] = 0
+            assert ici._pull_lane_allowed() is True
+        finally:
+            ici._leaked_pull_bytes[0] = old
+            ici._leak_breaker_logged[0] = old_logged

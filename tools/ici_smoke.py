@@ -39,13 +39,14 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 if os.environ.get("BRPC_TPU_SMOKE_CPU"):
-    # dry-run mode without the chip: same trick as tests/conftest.py —
-    # the site register() presets the real backend, env vars lose, so
-    # force the platform back through jax.config before any backend init
+    # dry-run mode without the chip: route through the shared helper —
+    # the site register() presets the real backend and env vars lose,
+    # so the platform must be forced back through jax.config
     os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
 
-    jax.config.update("jax_platforms", "cpu")
+from brpc_tpu.butil.jax_env import apply_jax_platforms_env
+
+apply_jax_platforms_env()  # env choice beats the axon plugin's override
 
 
 def serve() -> None:
