@@ -450,6 +450,36 @@ def main() -> None:
     ici_server = None
     server_proc = None
 
+    # ---------------- phase 0: preflight + device probe FIRST
+    # (three rounds of device-lane evidence died to stray processes
+    # wedging the single-client tunnel — kill repo leftovers, NAME any
+    # other plugin holder in the artifact, and take the one shot at the
+    # backend while the wall budget is still fresh)
+    base = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(base, "tools"))
+    try:
+        from preflight import run_preflight
+        result["preflight"] = run_preflight()
+        _progress({"progress": "preflight", **result["preflight"]})
+    except Exception as e:  # noqa: BLE001 - evidence, not control flow
+        result["preflight"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    devs = None
+    lane: dict = result["device_lane"]
+    try:
+        # the probe gets AT MOST 40% of the wall budget: a wedged tunnel
+        # hanging through every retry must still leave the TCP headline
+        # (and the final JSON) room to land — the round-2 lesson, kept
+        # even with the probe moved first
+        probe_deadline = Deadline(min(deadline.remaining() * 0.4, 40.0))
+        devs = _init_jax_with_retry(probe_deadline)
+    except BaseException as e:  # noqa: BLE001 - salvage: TCP still runs
+        lane["error"] = f"{type(e).__name__}: {e}"[:500]
+        lane["preflight_plugin_holders"] = \
+            result["preflight"].get("plugin_holders", [])
+        result["partial"] = True
+        _progress({"progress": "error", "phase": "device_probe",
+                   "error": lane["error"]})
+
     # ---------------- phase 1: TCP loopback headline (framework path)
     try:
         server_proc, port = spawn_tcp_server(deadline)
@@ -529,6 +559,33 @@ def main() -> None:
         _progress({"progress": "tcp_small",
                    "p50_us": result["small_rpc_p50_us"],
                    "p99_us": result["small_rpc_p99_us"]})
+        # the 4B-4MB TCP sweep (the reference's qps-vs-request-size
+        # curves, docs/cn/benchmark.md:92-156) — adaptive iteration
+        # counts, one stderr line per point, skipped points reported
+        result["tcp_sweep"] = {}
+        sweep_sizes = [4, 64, 1024, 16384, 262144, 1 << 20, 4 << 20]
+        sweep_budget = deadline.remaining() * 0.5
+        for idx, size in enumerate(sweep_sizes):
+            if deadline.remaining() < 6.0:
+                result["tcp_sweep"][str(size)] = {"skipped": "wall budget"}
+                result["partial"] = True
+                _progress({"progress": "tcp_sweep_skip", "size": size})
+                continue
+            pay = b"s" * size
+            rec = LatencyRecorder()
+            warm_dt = run(4, 8, None, payload=pay)
+            point_budget = max(1.0, sweep_budget / len(sweep_sizes))
+            it = int(clamp(point_budget / max(warm_dt / 4, 1e-9), 8, 600))
+            dt = run(it, 8, rec, payload=pay)
+            pt = {
+                "qps": round(it / dt, 1),
+                "GBps": round(it * size * 2 / dt / 1e9, 4),
+                "p50_us": round(rec.latency_percentile(0.5), 1),
+                "p99_us": round(rec.latency_percentile(0.99), 1),
+                "iters": it,
+            }
+            result["tcp_sweep"][str(size)] = pt
+            _progress({"progress": "tcp_sweep_point", "size": size, **pt})
         ch.close()
     except BaseException as e:  # noqa: BLE001 - salvage partial data
         result["partial"] = True
@@ -537,9 +594,10 @@ def main() -> None:
                    "error": result["error"]})
 
     # ---------------- phase 2: device lane over ici:// (real movement)
-    lane: dict = result["device_lane"]
     try:
-        devs = _init_jax_with_retry(deadline)
+        if devs is None:
+            raise RuntimeError(
+                lane.get("error", "device probe failed in phase 0"))
         import jax
 
         two_dev = len(devs) >= 2

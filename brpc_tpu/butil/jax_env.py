@@ -26,4 +26,10 @@ def apply_jax_platforms_env() -> None:
     try:
         jax.config.update("jax_platforms", want)
     except Exception:
-        pass
+        # a silent failure here resurrects the exact multi-minute hang
+        # this module exists to prevent — leave a breadcrumb
+        import logging
+        logging.getLogger("brpc_tpu").warning(
+            "could not re-assert JAX_PLATFORMS=%s over the plugin's "
+            "programmatic override; device init may target the wrong "
+            "backend", want, exc_info=True)

@@ -1,0 +1,53 @@
+"""Pidfile convention for long-running servers (.pids/ next to the
+package root, overridable via BRPC_TPU_PID_DIR).
+
+Load-bearing for the bench preflight's stray reaping on the shared-chip
+harness: each file records BOTH the pid and the process's cmdline, so
+the reaper can tell a still-running stray from a recycled pid without
+guessing from path substrings.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+PID_DIR = os.environ.get(
+    "BRPC_TPU_PID_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), ".pids"))
+
+
+def self_cmdline() -> str:
+    # whitespace-normalized: the pidfile stores the cmdline on ONE line
+    # and `python -c` scripts embed newlines — both sides of the
+    # preflight comparison use this same normalization
+    try:
+        with open(f"/proc/{os.getpid()}/cmdline", "rb") as f:
+            raw = f.read().replace(b"\0", b" ").decode("utf-8", "replace")
+        return " ".join(raw.split())
+    except OSError:
+        return ""
+
+
+def write_pidfile(name: str) -> Optional[str]:
+    """Record this process (pid + cmdline); returns the path for the
+    caller to remove on clean exit, or None on failure."""
+    try:
+        os.makedirs(PID_DIR, exist_ok=True)
+        safe = "".join(c if c.isalnum() or c in "-_." else "-"
+                       for c in str(name))[:80]
+        path = os.path.join(PID_DIR, f"{safe}.{os.getpid()}.pid")
+        with open(path, "w") as f:
+            f.write(f"{os.getpid()}\n{self_cmdline()}\n")
+        return path
+    except OSError:
+        return None
+
+
+def remove_pidfile(path: Optional[str]) -> None:
+    if path:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass

@@ -225,11 +225,32 @@ class Server:
         self._stopped_event.set()
 
     def run_until_asked_to_quit(self) -> None:
+        """Block until SIGINT/SIGTERM, then drain and stop.
+
+        Long-running example/tool servers get two safeguards for free
+        (this harness shares ONE device tunnel — an orphaned jax-capable
+        process wedges it for every later client, which cost the bench
+        its device capture twice): a parent-death watchdog (orphaned →
+        exit) and a pidfile under .pids/ so the bench preflight can
+        reap leftovers. Opt out with BRPC_TPU_NO_PARENT_WATCHDOG=1
+        (daemons intentionally outliving their launcher)."""
+        import os
         import signal
         ev = threading.Event()
         for sig in (signal.SIGINT, signal.SIGTERM):
             signal.signal(sig, lambda *_: ev.set())
-        ev.wait()
+        pidfile = None
+        watchdog = not os.environ.get("BRPC_TPU_NO_PARENT_WATCHDOG")
+        from brpc_tpu.butil.pidfile import remove_pidfile, write_pidfile
+        pidfile = write_pidfile(f"server-{self._endpoint}")
+        parent = os.getppid()
+        try:
+            while not ev.is_set():
+                ev.wait(1.0)
+                if watchdog and os.getppid() != parent:
+                    break     # orphaned: parent died without SIGTERM
+        finally:
+            remove_pidfile(pidfile)
         self.stop()
         self.join()
 

@@ -19,3 +19,63 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# ---------------------------------------------------------- orphan guard
+# The harness shares ONE device tunnel across sessions; a test that
+# leaks a child process (an example server, a smoke subprocess) can
+# wedge jax.devices() for every later client — this cost two rounds of
+# device-lane bench evidence. Fail the SUITE if it exits with live
+# children it did not start with.
+import pytest  # noqa: E402
+
+
+def _live_children():
+    """(pid, cmdline) of our direct live children, zombies excluded
+    (a reaped-later zombie is not a leak)."""
+    me = os.getpid()
+    out = []
+    try:
+        pids = [int(p) for p in os.listdir("/proc") if p.isdigit()]
+    except OSError:
+        return out
+    for pid in pids:
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                stat = f.read()
+            rest = stat.rsplit(")", 1)[1].split()
+            state, ppid = rest[0], int(rest[1])
+            if ppid != me or state == "Z":
+                continue
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(
+                    "utf-8", "replace").strip()
+            out.append((pid, cmd[:160]))
+        except (OSError, ValueError, IndexError):
+            continue
+    return out
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _orphan_guard():
+    import time as _t
+    before = {pid for pid, _ in _live_children()}
+    yield
+    # children watchdog/terminate themselves asynchronously: grant a
+    # short grace before calling anything a leak
+    deadline = _t.monotonic() + 5.0
+    leaked = []
+    while _t.monotonic() < deadline:
+        leaked = [c for c in _live_children() if c[0] not in before]
+        if not leaked:
+            return
+        _t.sleep(0.25)
+    # kill them so THIS failure doesn't wedge the next session's tunnel,
+    # then fail loudly with names
+    import signal as _sig
+    for pid, _ in leaked:
+        try:
+            os.kill(pid, _sig.SIGKILL)
+        except OSError:
+            pass
+    pytest.fail(f"test suite leaked child processes: {leaked}",
+                pytrace=False)
