@@ -18,16 +18,21 @@ PID_DIR = os.environ.get(
         os.path.abspath(__file__)))), ".pids"))
 
 
-def self_cmdline() -> str:
-    # whitespace-normalized: the pidfile stores the cmdline on ONE line
-    # and `python -c` scripts embed newlines — both sides of the
-    # preflight comparison use this same normalization
+def cmdline(pid: Optional[int] = None) -> str:
+    """Whitespace-normalized /proc cmdline (the pidfile stores it on ONE
+    line and `python -c` scripts embed newlines); the preflight reap
+    decision compares these strings for equality, so EVERY reader must
+    use this one normalization."""
     try:
-        with open(f"/proc/{os.getpid()}/cmdline", "rb") as f:
+        with open(f"/proc/{pid or os.getpid()}/cmdline", "rb") as f:
             raw = f.read().replace(b"\0", b" ").decode("utf-8", "replace")
         return " ".join(raw.split())
     except OSError:
         return ""
+
+
+def self_cmdline() -> str:
+    return cmdline()
 
 
 def write_pidfile(name: str) -> Optional[str]:

@@ -3,14 +3,18 @@ commands, and a live publish/play relay server (compact re-design of the
 reference's media stack: rtmp.{h,cpp} 2885 LoC — RtmpClient rtmp.h:723,
 RtmpStreamBase rtmp.h:518 — and policy/rtmp_protocol.cpp 3677 LoC).
 
-Covered: C0C1C2/S0S1S2 plain handshake; chunk basic/message headers
-fmt0-3 with extended timestamps and SET_CHUNK_SIZE on both directions;
-control messages (ack window, peer bw, user control); AMF0 command
-messages (connect, createStream, publish, play, deleteStream, onStatus,
-_result); audio/video/data relay with sequence-header + metadata caching
-for late-joining players. Out of scope (reference features intentionally
-not carried): AMF3, aggregate messages, complex handshake digests, HLS/
-FLV remux (see flv.py for the FLV side)."""
+Covered: C0C1C2/S0S1S2 handshake in BOTH flavors — the plain echo and
+the digest ("complex") handshake stock encoders perform, schemes 0 and
+1, with keyed S2/C2 acks (see the digest-handshake section below);
+chunk basic/message headers fmt0-3 with extended timestamps and
+SET_CHUNK_SIZE on both directions; control messages (ack window, peer
+bw, user control); AMF0 command messages (connect, createStream,
+publish, play, deleteStream, onStatus, _result) plus the AMF3 command
+envelope (type 17, with amf.py's AMF3 read side for objectEncoding-3
+peers); aggregate messages (type 22) split into their sub-messages with
+rebased timestamps; audio/video/data relay with sequence-header +
+metadata caching for late-joining players. Out of scope: HLS remux
+(see flv.py for the FLV side) and RTMPE/RTMPS encryption."""
 
 from __future__ import annotations
 
@@ -49,12 +53,77 @@ MSG_WINDOW_ACK_SIZE = 5
 MSG_SET_PEER_BW = 6
 MSG_AUDIO = 8
 MSG_VIDEO = 9
+MSG_DATA_AMF3 = 15
+MSG_COMMAND_AMF3 = 17
 MSG_DATA_AMF0 = 18
 MSG_COMMAND_AMF0 = 20
+MSG_AGGREGATE = 22
 
 _CONTROL_CSID = 2
 _COMMAND_CSID = 3
 _MEDIA_CSID = 6
+
+
+# ------------------------------------------------------ digest handshake
+# The "complex" handshake stock encoders perform (the reference's
+# handshake schemes in policy/rtmp_protocol.cpp; the key material and
+# HMAC layout are public normative constants from the Flash ecosystem,
+# same family as nginx-rtmp/librtmp/ffmpeg): C1/S1 carry an
+# HMAC-SHA256 digest embedded at an offset derived from 4 offset bytes,
+# in one of two schemes (offset block right after the version word, or
+# after a 764-byte key block); C2/S2 are random blocks whose last 32
+# bytes are keyed on the peer's digest. A C1 with a zero version word
+# is the plain echo handshake.
+_FP_KEY = b"Genuine Adobe Flash Player 001"          # client partial (30)
+_FMS_KEY = b"Genuine Adobe Flash Media Server 001"   # server partial (36)
+_KEY_TAIL = bytes((0xF0, 0xEE, 0xC2, 0x4A, 0x80, 0x68, 0xBE, 0xE8,
+                   0x2E, 0x00, 0xD0, 0xD1, 0x02, 0x9E, 0x7E, 0x57,
+                   0x6E, 0xEC, 0x5D, 0x2D, 0x29, 0x80, 0x6F, 0xAB,
+                   0x93, 0xB8, 0xE6, 0x36, 0xCF, 0xEB, 0x31, 0xAE))
+
+
+def _hs_digest_pos(buf: bytes, scheme: int) -> int:
+    base = 8 if scheme == 0 else 772
+    return base + 4 + sum(buf[base:base + 4]) % 728
+
+
+def _hs_make_digest(buf: bytes, pos: int, key: bytes) -> bytes:
+    import hashlib
+    import hmac as _hmac
+    return _hmac.new(key, buf[:pos] + buf[pos + 32:],
+                     hashlib.sha256).digest()
+
+
+def _hs_find_digest(block: bytes, key: bytes):
+    """(scheme, digest) when the 1536-byte block carries a valid digest
+    under ``key``; None for the plain handshake."""
+    for scheme in (0, 1):
+        pos = _hs_digest_pos(block, scheme)
+        if pos + 32 <= len(block) and \
+                block[pos:pos + 32] == _hs_make_digest(block, pos, key):
+            return scheme, block[pos:pos + 32]
+    return None
+
+
+def _hs_build_block(key: bytes, scheme: int, version: bytes) -> Tuple[bytes, bytes]:
+    """A 1536-byte C1/S1 with an embedded digest; returns (block, digest)."""
+    buf = bytearray(os.urandom(HANDSHAKE_SIZE))
+    buf[0:4] = b"\x00\x00\x00\x00"
+    buf[4:8] = version
+    pos = _hs_digest_pos(buf, scheme)
+    dig = _hs_make_digest(bytes(buf), pos, key)
+    buf[pos:pos + 32] = dig
+    return bytes(buf), dig
+
+
+def _hs_ack_block(peer_digest: bytes, full_key: bytes) -> bytes:
+    """A C2/S2 for the digest handshake: random + HMAC keyed on the
+    peer's digest under the full (partial+tail) key."""
+    import hashlib
+    import hmac as _hmac
+    rand = os.urandom(HANDSHAKE_SIZE - 32)
+    tmp = _hmac.new(full_key, peer_digest, hashlib.sha256).digest()
+    return rand + _hmac.new(tmp, rand, hashlib.sha256).digest()
 
 
 class RtmpMessage:
@@ -230,6 +299,35 @@ def _parse_one_chunk(state: _ConnState, data: bytes, pos: int
 
 # ---------------------------------------------------------------- commands
 
+def _split_aggregate(msg: RtmpMessage) -> List[RtmpMessage]:
+    """Sub-messages of a type-22 aggregate, timestamps rebased onto the
+    aggregate's own timestamp (first sub's stamp is the base)."""
+    out: List[RtmpMessage] = []
+    data = msg.payload
+    pos = 0
+    base_ts: Optional[int] = None
+    while pos + 11 <= len(data):
+        sub_type = data[pos]
+        size = int.from_bytes(data[pos + 1:pos + 4], "big")
+        # FLV-style timestamp: 3 bytes + 1 extension byte (high bits)
+        ts = int.from_bytes(data[pos + 4:pos + 7], "big") | \
+            (data[pos + 7] << 24)
+        body_start = pos + 11
+        body_end = body_start + size
+        if body_end > len(data):
+            raise RtmpError("aggregate sub-message overruns payload")
+        if base_ts is None:
+            base_ts = ts
+        # clamp: a hostile/malformed aggregate with a sub-tag OLDER than
+        # the first would rebase negative and wrap to a far-future u32
+        # timestamp in the chunk writer
+        out.append(RtmpMessage(sub_type,
+                               max(0, msg.timestamp + (ts - base_ts)),
+                               msg.stream_id, data[body_start:body_end]))
+        pos = body_end + 4      # skip the back-pointer
+    return out
+
+
 def command_message(name: str, transaction_id: float, *vals,
                     stream_id: int = 0) -> RtmpMessage:
     return RtmpMessage(MSG_COMMAND_AMF0, 0, stream_id,
@@ -321,8 +419,9 @@ class RtmpService:
         # holding the lock across them is cheap)
         with self._lock:
             if s.metadata is not None:
-                _write_msg(socket, RtmpMessage(MSG_DATA_AMF0, 0, stream_id,
-                                               s.metadata), _MEDIA_CSID)
+                meta_type, meta_payload = s.metadata
+                _write_msg(socket, RtmpMessage(meta_type, 0, stream_id,
+                                               meta_payload), _MEDIA_CSID)
             for seq in (s.avc_seq, s.aac_seq):
                 if seq is not None:
                     _write_msg(socket, RtmpMessage(seq.msg_type, 0,
@@ -353,8 +452,11 @@ class RtmpService:
         with self._lock:
             if s.publisher is None or s.publisher[0] is not from_socket:
                 return
-            if msg.msg_type == MSG_DATA_AMF0:
-                s.metadata = msg.payload
+            if msg.msg_type in (MSG_DATA_AMF0, MSG_DATA_AMF3):
+                # cache either encoding's onMetaData for late joiners —
+                # WITH its type, so the replay keeps the envelope the
+                # payload was encoded for
+                s.metadata = (msg.msg_type, msg.payload)
             elif msg.msg_type == MSG_VIDEO and len(msg.payload) >= 2 and \
                     (msg.payload[0] & 0x0F) == 7 and msg.payload[1] == 0:
                 s.avc_seq = msg           # AVC sequence header (codec cfg)
@@ -409,9 +511,16 @@ class RtmpProtocol(Protocol):
                 if data[0] != RTMP_VERSION:
                     raise RtmpError(f"bad server version {data[0]}")
                 portal.pop_front(need)
-                # C2 = echo of S1
+                s1 = data[1:1 + HANDSHAKE_SIZE]
+                c2 = s1   # plain handshake: C2 echoes S1
+                if socket.user_data.get("rtmp_c1_digest") is not None:
+                    server = _hs_find_digest(s1, _FMS_KEY)
+                    if server is not None:
+                        # digest server: keyed C2 (a plain server that
+                        # echoed our C1 gets the echo path above)
+                        c2 = _hs_ack_block(server[1], _FP_KEY + _KEY_TAIL)
                 out = IOBuf()
-                out.append(data[1:1 + HANDSHAKE_SIZE])
+                out.append(c2)
                 socket.write(out)
                 state.phase = _ConnState.PHASE_READY
                 return PARSE_OK, ("rtmp_handshake_done",)
@@ -424,9 +533,23 @@ class RtmpProtocol(Protocol):
                 raise RtmpError(f"bad client version {data[0]}")
             portal.pop_front(need)
             c1 = data[1:]
-            s1 = struct.pack(">II", 0, 0) + os.urandom(HANDSHAKE_SIZE - 8)
+            found = None
+            if c1[4:8] != b"\x00\x00\x00\x00":
+                # nonzero version word: a stock encoder offering the
+                # digest handshake — a bad digest falls back to plain
+                # echo rather than refusing the connection
+                found = _hs_find_digest(c1, _FP_KEY)
+            if found is not None:
+                scheme, client_digest = found
+                s1, _ = _hs_build_block(_FMS_KEY, scheme,
+                                        bytes((3, 5, 1, 1)))
+                s2 = _hs_ack_block(client_digest, _FMS_KEY + _KEY_TAIL)
+            else:
+                s1 = struct.pack(">II", 0, 0) + \
+                    os.urandom(HANDSHAKE_SIZE - 8)
+                s2 = c1                             # plain: echo C1
             out = IOBuf()
-            out.append(bytes([RTMP_VERSION]) + s1 + c1)   # S0 S1 S2(=echo C1)
+            out.append(bytes([RTMP_VERSION]) + s1 + s2)   # S0 S1 S2
             socket.write(out)
             state.phase = _ConnState.PHASE_ACK
             # PARSE_OK (not NOT_ENOUGH_DATA) so the messenger records rtmp
@@ -467,6 +590,14 @@ class RtmpProtocol(Protocol):
             if msg.msg_type in (MSG_ACK, MSG_WINDOW_ACK_SIZE,
                                 MSG_SET_PEER_BW, MSG_USER_CONTROL):
                 continue       # bookkeeping only; no app dispatch
+            if msg.msg_type == MSG_AGGREGATE:
+                # split into its sub-messages (the reference handles
+                # type 22 the same way): each sub carries an 11-byte
+                # FLV-shaped tag header + body + 4-byte back-pointer;
+                # the first sub's timestamp is the base the aggregate's
+                # own timestamp replaces, deltas are preserved
+                msgs.extend(_split_aggregate(msg))
+                continue
             msgs.append(msg)
         if pos:
             portal.pop_front(pos)
@@ -506,7 +637,17 @@ class RtmpProtocol(Protocol):
             socket.on_failed(service.drop_socket)
         if msg.msg_type == MSG_COMMAND_AMF0:
             await self._serve_command(msg, socket, service, state, server)
-        elif msg.msg_type in (MSG_AUDIO, MSG_VIDEO, MSG_DATA_AMF0):
+        elif msg.msg_type == MSG_COMMAND_AMF3:
+            # AMF3 command envelope: one leading format byte (0x00),
+            # then AMF0 values which may themselves switch to AMF3 via
+            # the 0x11 avmplus marker — amf.decode_value handles both
+            body = msg.payload[1:] if msg.payload[:1] == b"\x00" \
+                else msg.payload
+            inner = RtmpMessage(MSG_COMMAND_AMF0, msg.timestamp,
+                                msg.stream_id, body)
+            await self._serve_command(inner, socket, service, state, server)
+        elif msg.msg_type in (MSG_AUDIO, MSG_VIDEO, MSG_DATA_AMF0,
+                              MSG_DATA_AMF3):
             name = socket.user_data.get("rtmp_pub_name")
             if name:
                 service.relay(name, msg, socket)
@@ -637,9 +778,13 @@ class RtmpClient:
                 # server would eat them as C2 bytes
                 self._handshake_done = FiberEvent()
                 self._handshake_socket = sock
-                # C0 + C1
-                c1 = struct.pack(">II", int(time.time()) & 0x7FFFFFFF, 0) + \
-                    os.urandom(HANDSHAKE_SIZE - 8)
+                # C0 + C1 — digest handshake by default (the shape stock
+                # encoders send; our server and plain-echo servers both
+                # accept it, since a server that doesn't validate
+                # digests just echoes C1 back)
+                c1, c1_digest = _hs_build_block(_FP_KEY, 0,
+                                                bytes((127, 101, 0, 1)))
+                sock.user_data["rtmp_c1_digest"] = c1_digest
                 out = IOBuf()
                 out.append(bytes([RTMP_VERSION]) + c1)
                 sock.write(out)

@@ -46,10 +46,8 @@ def _live_children():
             state, ppid = rest[0], int(rest[1])
             if ppid != me or state == "Z":
                 continue
-            with open(f"/proc/{pid}/cmdline", "rb") as f:
-                cmd = f.read().replace(b"\0", b" ").decode(
-                    "utf-8", "replace").strip()
-            out.append((pid, cmd[:160]))
+            from brpc_tpu.butil.pidfile import cmdline as _cmdline
+            out.append((pid, _cmdline(pid)[:160]))
         except (OSError, ValueError, IndexError):
             continue
     return out

@@ -303,3 +303,171 @@ def test_rtmp_client_reconnect_after_failure(rtmp_server):
         c.publish(c.create_stream(), "after-reconnect")
     finally:
         c.close()
+
+
+# ------------------------------------------- digest handshake + AMF3 + agg
+
+def test_digest_handshake_primitives():
+    """Scheme round trip: a C1 built like a stock encoder's (nonzero
+    version word, HMAC-SHA256 digest at the scheme offset) validates;
+    a bit flip anywhere invalidates it; both schemes resolve."""
+    for scheme in (0, 1):
+        c1, dig = rtmp._hs_build_block(rtmp._FP_KEY, scheme,
+                                       bytes((127, 101, 0, 1)))
+        found = rtmp._hs_find_digest(c1, rtmp._FP_KEY)
+        assert found is not None and found[0] == scheme
+        assert found[1] == dig
+        flipped = bytearray(c1)
+        flipped[100] ^= 0xFF
+        assert rtmp._hs_find_digest(bytes(flipped), rtmp._FP_KEY) is None
+
+
+def test_digest_handshake_server_golden():
+    """Drive the SERVER side with ffmpeg-shaped bytes: C0+C1 with an
+    embedded client digest -> the S1 must carry a valid FMS digest and
+    the S2's trailing 32 bytes must be the HMAC keyed on OUR digest
+    (the check a stock encoder performs before streaming)."""
+    import hashlib
+    import hmac as hmac_mod
+
+    svc = rtmp.RtmpService()
+    server = Server(ServerOptions(rtmp_service=svc))
+    ep = server.start(f"tcp://127.0.0.1:0")
+    try:
+        import socket as pysock
+        c = pysock.create_connection((ep.host, ep.port), timeout=10)
+        c1, my_digest = rtmp._hs_build_block(rtmp._FP_KEY, 1,
+                                             bytes((127, 101, 0, 1)))
+        c.sendall(bytes([rtmp.RTMP_VERSION]) + c1)
+        buf = b""
+        deadline = time.monotonic() + 10
+        while len(buf) < 1 + 2 * rtmp.HANDSHAKE_SIZE and \
+                time.monotonic() < deadline:
+            chunk = c.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+        assert len(buf) >= 1 + 2 * rtmp.HANDSHAKE_SIZE
+        assert buf[0] == rtmp.RTMP_VERSION
+        s1 = buf[1:1 + rtmp.HANDSHAKE_SIZE]
+        s2 = buf[1 + rtmp.HANDSHAKE_SIZE:1 + 2 * rtmp.HANDSHAKE_SIZE]
+        # S1 carries a valid server digest in OUR scheme
+        found = rtmp._hs_find_digest(s1, rtmp._FMS_KEY)
+        assert found is not None and found[0] == 1
+        # S2 trailing HMAC keyed on the client digest (what ffmpeg checks)
+        tmp = hmac_mod.new(rtmp._FMS_KEY + rtmp._KEY_TAIL, my_digest,
+                           hashlib.sha256).digest()
+        want = hmac_mod.new(tmp, s2[:-32], hashlib.sha256).digest()
+        assert s2[-32:] == want
+        c.close()
+    finally:
+        server.stop()
+        server.join(2)
+
+
+def test_digest_handshake_e2e_publish_play(rtmp_server):
+    """The full client (which now sends a digest C1 like stock
+    encoders) against the digest server: publish/play still relays."""
+    svc, ep = rtmp_server
+    pub = rtmp.RtmpClient(ep, app="live")
+    sub = rtmp.RtmpClient(ep, app="live")
+    got = []
+    done = threading.Event()
+    try:
+        pub.connect()
+        sid = pub.create_stream()
+        name = f"digest-{next(_name_seq)}"
+        assert pub.publish(sid, name)["code"] == "NetStream.Publish.Start"
+        sub.connect()
+        psid = sub.create_stream()
+
+        def on_media(msg):
+            got.append(msg)
+            done.set()
+
+        sub.play(psid, name, on_media=on_media)
+        pub.send_video(sid, 0, b"\x17\x01keyframe")
+        assert done.wait(10), "no media relayed over digest handshake"
+        assert got[0].payload == b"\x17\x01keyframe"
+    finally:
+        pub.close()
+        sub.close()
+
+
+def test_aggregate_message_split(rtmp_server):
+    """OBS/FMS-shaped aggregate (type 22): sub-tag headers + back
+    pointers; the relay must deliver the split audio+video messages
+    with rebased timestamps."""
+    svc, ep = rtmp_server
+    pub = rtmp.RtmpClient(ep, app="live")
+    sub = rtmp.RtmpClient(ep, app="live")
+    got = []
+    done = threading.Event()
+
+    def sub_msg(t, ts, body):
+        hdr = bytes([t]) + len(body).to_bytes(3, "big") + \
+            ts.to_bytes(3, "big") + bytes([ts >> 24]) + b"\x00\x00\x00"
+        return hdr + body + (11 + len(body)).to_bytes(4, "big")
+
+    try:
+        pub.connect()
+        sid = pub.create_stream()
+        name = f"agg-{next(_name_seq)}"
+        assert pub.publish(sid, name)["code"] == "NetStream.Publish.Start"
+        sub.connect()
+        psid = sub.create_stream()
+
+        def on_media(msg):
+            got.append(msg)
+            if len(got) >= 2:
+                done.set()
+
+        sub.play(psid, name, on_media=on_media)
+        payload = sub_msg(rtmp.MSG_AUDIO, 1000, b"\xaf\x01aud") + \
+            sub_msg(rtmp.MSG_VIDEO, 1021, b"\x27\x01vid")
+        pub._send_media(rtmp.MSG_AGGREGATE, sid, 5000, payload)
+        assert done.wait(10), f"aggregate not split/relayed: {got}"
+        kinds = {(m.msg_type, m.payload, m.timestamp) for m in got}
+        assert (rtmp.MSG_AUDIO, b"\xaf\x01aud", 5000) in kinds
+        assert (rtmp.MSG_VIDEO, b"\x27\x01vid", 5021) in kinds
+    finally:
+        pub.close()
+        sub.close()
+
+
+def test_amf3_command_envelope(rtmp_server):
+    """A type-17 command (leading 0x00 + AMF0 body, the envelope stock
+    objectEncoding-3 peers send) must drive the same command path."""
+    svc, ep = rtmp_server
+    import socket as pysock
+    c = pysock.create_connection((ep.host, ep.port), timeout=10)
+    try:
+        c1, _ = rtmp._hs_build_block(rtmp._FP_KEY, 0, bytes((127, 101, 0, 1)))
+        c.sendall(bytes([rtmp.RTMP_VERSION]) + c1)
+        buf = b""
+        deadline = time.monotonic() + 10
+        while len(buf) < 1 + 2 * rtmp.HANDSHAKE_SIZE and \
+                time.monotonic() < deadline:
+            chunk = c.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+        assert len(buf) >= 1 + 2 * rtmp.HANDSHAKE_SIZE
+        c.sendall(buf[1:1 + rtmp.HANDSHAKE_SIZE])   # C2 (echo is accepted)
+        connect_amf0 = amf.encode_values(
+            "connect", 1.0, {"app": "live", "objectEncoding": 3.0})
+        msg = rtmp.RtmpMessage(rtmp.MSG_COMMAND_AMF3, 0, 0,
+                               b"\x00" + connect_amf0)
+        c.sendall(rtmp.pack_chunks(msg, 3))
+        # expect chunked control + _result traffic back
+        c.settimeout(10)
+        got = b""
+        deadline = time.monotonic() + 10
+        while b"_result" not in got and time.monotonic() < deadline:
+            chunk = c.recv(65536)
+            if not chunk:
+                break
+            got += chunk
+        assert b"_result" in got and b"NetConnection.Connect.Success" in got
+    finally:
+        c.close()

@@ -28,8 +28,8 @@ from typing import List, Optional
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
-from brpc_tpu.butil.pidfile import (PID_DIR, remove_pidfile,  # noqa: E402,F401
-                                    write_pidfile)
+from brpc_tpu.butil.pidfile import (PID_DIR, cmdline,  # noqa: E402,F401
+                                    remove_pidfile, write_pidfile)
 
 # the loaded PJRT plugin .so — not bare "axon"/"pjrt", which match the
 # sitecustomize's pure-python module paths mapped into EVERY interpreter.
@@ -73,15 +73,7 @@ def _established_loopback_ports(pid: int) -> List[int]:
     return ports
 
 
-def _cmdline(pid: int) -> str:
-    # same whitespace normalization as pidfile.self_cmdline — the reap
-    # decision compares the two strings for equality
-    try:
-        with open(f"/proc/{pid}/cmdline", "rb") as f:
-            raw = f.read().replace(b"\0", b" ").decode("utf-8", "replace")
-        return " ".join(raw.split())
-    except OSError:
-        return ""
+_cmdline = cmdline   # single normalization authority: pidfile.cmdline
 
 
 def scan_plugin_holders() -> List[dict]:
@@ -137,9 +129,14 @@ def kill_stale_repo_servers(grace_s: float = 2.0) -> List[dict]:
                 victims.append((pid, path))
                 actions.append({"pid": pid, "pidfile": name,
                                 "cmdline": live_cmd[:200], "signal": "TERM"})
-                continue   # unlink after confirming death below
-            except OSError:
-                pass
+            except OSError as e:
+                # kill failed (EPERM?) on a LIVE matching stray: keep
+                # the pidfile — the evidence must survive for the next
+                # preflight/operator
+                actions.append({"pid": pid, "pidfile": name,
+                                "cmdline": live_cmd[:200],
+                                "error": f"{type(e).__name__}: {e}"[:120]})
+            continue   # never unlink a live match here
         try:
             os.unlink(path)   # dead or recycled pid: stale record
         except OSError:
