@@ -147,6 +147,91 @@ while True:
     c.sendall(mv[:n])
 """
 
+# message-shaped calibration: 4-byte length framing, server ASSEMBLES
+# the whole message before echoing — the memory/backpressure behavior an
+# RPC framework is obliged to have (the stream blast above echoes each
+# chunk while it is still cache-hot and never holds a message boundary;
+# measured ~2.3 GB/s stream vs ~1.5 GB/s message on this box, so the
+# stream figure is not an achievable bound for any RPC system here)
+_RAW_MSG_ECHO_SRC = r"""
+import socket, sys
+s = socket.socket(); s.bind(("127.0.0.1", 0)); s.listen(1)
+print(f"PORT {s.getsockname()[1]}", flush=True)
+c, _ = s.accept()
+c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+buf = bytearray()
+mv = memoryview(bytearray(1 << 20))
+while True:
+    n = c.recv_into(mv)
+    if not n: break
+    buf += mv[:n]
+    while len(buf) >= 4:
+        ln = int.from_bytes(buf[:4], "big")
+        if len(buf) < 4 + ln: break
+        c.sendall(buf[:4 + ln])
+        del buf[:4 + ln]
+"""
+
+
+def measure_raw_msg_loopback(n_msgs: int = 120) -> float:
+    """The message-echo machine ceiling (see _RAW_MSG_ECHO_SRC):
+    1MB length-prefixed frames, window of 8 in flight. GB/s or 0.0."""
+    import subprocess
+
+    proc = None
+    c = None
+    gbps = 0.0
+    try:
+        proc = subprocess.Popen([sys.executable, "-c", _RAW_MSG_ECHO_SRC],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL)
+        port = int(proc.stdout.readline().split()[1])
+        import socket as pysock
+
+        c = pysock.create_connection(("127.0.0.1", port))
+        c.setsockopt(pysock.IPPROTO_TCP, pysock.TCP_NODELAY, 1)
+        c.settimeout(30.0)
+        frame = (1 << 20).to_bytes(4, "big") + b"m" * (1 << 20)
+        got = [0]
+
+        def drain():
+            b = bytearray(1 << 20)
+            m = memoryview(b)
+            while got[0] < n_msgs * len(frame):
+                n = c.recv_into(m)
+                if not n:
+                    return
+                got[0] += n
+
+        th = threading.Thread(target=drain, daemon=True)
+        th.start()
+        t0 = time.perf_counter()
+        for i in range(n_msgs):
+            c.sendall(frame)
+            while got[0] < (i - 8) * len(frame):
+                time.sleep(0.0003)
+        deadline = time.perf_counter() + 20
+        while got[0] < n_msgs * len(frame) and time.perf_counter() < deadline:
+            time.sleep(0.001)
+        dt = time.perf_counter() - t0
+        if got[0] >= n_msgs * len(frame):
+            gbps = n_msgs * (1 << 20) * 2 / dt / 1e9
+    except Exception:
+        pass
+    finally:
+        try:
+            if c is not None:
+                c.close()
+        except Exception:
+            pass
+        try:
+            if proc is not None:
+                proc.terminate()
+                proc.wait(5)
+        except Exception:
+            pass
+    return gbps
+
 
 def measure_raw_loopback(window_s: float = 2.5) -> float:
     """Machine calibration: a bare two-process socket echo (no
@@ -512,17 +597,27 @@ def main() -> None:
                 break
             dt = run(iters, 16, rec, payload=payload, threads=2)
             gbps = max(gbps, iters * (1 << 20) * 2 / 1e9 / dt)
-        # machine calibration: the same echo shape with bare sockets —
-        # reported so vs_baseline has context (the reference's 2.3 GB/s
-        # was multi-core + 10GbE; this box's kernel loopback is the
-        # actual ceiling here). Skipped when the budget is spent.
-        raw = (measure_raw_loopback(min(2.5, deadline.remaining() * 0.1))
-               if deadline.remaining() > 5.0 else 0.0)
+        # machine calibrations, both reported so vs_baseline has context
+        # (the reference's 2.3 GB/s was multi-core + 10GbE with NIC
+        # offload; this box's kernel loopback is the real ceiling):
+        #   stream — boundary-less chunk echo (the old calibration; an
+        #            upper bound NO message-framed system can reach here,
+        #            since each chunk echoes while cache-hot)
+        #   msg    — length-framed assemble-then-echo, the same
+        #            obligation an RPC framework has; efficiency_vs_raw
+        #            is measured against THIS like-for-like ceiling
+        raw_stream = (measure_raw_loopback(min(2.5, deadline.remaining() * 0.1))
+                      if deadline.remaining() > 5.0 else 0.0)
+        raw_msg = (measure_raw_msg_loopback()
+                   if deadline.remaining() > 5.0 else 0.0)
         result.update({
             "value": round(gbps, 3),
             "vs_baseline": round(gbps / BASELINE_GBPS, 3),
-            "loopback_raw_GBps": round(raw, 3),
-            "efficiency_vs_raw": round(gbps / raw, 3) if raw else None,
+            "loopback_raw_stream_GBps": round(raw_stream, 3),
+            "loopback_raw_msg_GBps": round(raw_msg, 3),
+            "efficiency_vs_raw": round(gbps / raw_msg, 3) if raw_msg else None,
+            "efficiency_vs_stream_raw": round(gbps / raw_stream, 3)
+            if raw_stream else None,
             "avg_us": round(rec.latency(), 1),
             "p50_us": round(rec.latency_percentile(0.5), 1),
             "p99_us": round(rec.latency_percentile(0.99), 1),
