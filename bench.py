@@ -631,18 +631,35 @@ def main() -> None:
         # client; the pooled channel would add per-call pool bookkeeping
         # that isn't part of that shape)
         lat_ch = Channel(f"tcp://127.0.0.1:{port}",
-                         ChannelOptions(timeout_ms=120000))
+                         ChannelOptions(timeout_ms=5000))
         for _ in range(50):                      # warm the connection
+            if deadline.remaining() < 8.0:
+                break
             lat_ch.call_sync("Bench", "Echo", b"ping")
         rec = LatencyRecorder()
+        failures = 0
+        samples = 0
         for _ in range(300):
+            if deadline.remaining() < 5.0:
+                break
             t0 = time.perf_counter_ns()
             cl = lat_ch.call_sync("Bench", "Echo", b"ping")
-            if not cl.failed():
+            if cl.failed():
+                failures += 1
+                if failures >= 10:
+                    break            # dead server: don't grind the budget
+            else:
+                samples += 1
                 rec.record((time.perf_counter_ns() - t0) / 1e3)
         lat_ch.close()
-        result["small_rpc_p50_us"] = round(rec.latency_percentile(0.5), 1)
-        result["small_rpc_p99_us"] = round(rec.latency_percentile(0.99), 1)
+        if samples:
+            result["small_rpc_p50_us"] = round(rec.latency_percentile(0.5), 1)
+            result["small_rpc_p99_us"] = round(rec.latency_percentile(0.99), 1)
+        else:
+            # an empty recorder would report a record-looking 0.0
+            result["partial"] = True
+            result["small_rpc_error"] = \
+                f"no successful latency samples ({failures} failures)"
         # scheduler wake-to-run latency under load — the regression gate
         # for the wake path. Since the inline-processing rework the RPC
         # data path itself needs ~zero wakes, so this is a DEDICATED

@@ -374,3 +374,29 @@ class TestWakePath:
         while p._active_waiters and time.monotonic() < deadline:
             time.sleep(0.01)
         assert p._active_waiters == 0  # waiter released after firing
+
+
+def test_timer_wake_suppression_keeps_earliest_deadline():
+    """schedule_at only notifies the timer thread when the new deadline
+    beats the heap front — a LATER deadline must not delay an earlier
+    one, and an EARLIER one must still preempt the thread's sleep."""
+    from brpc_tpu.fiber.timer import TimerThread
+
+    t = TimerThread(name="test_suppress")
+    try:
+        fired = []
+        # arm a far deadline first (the thread sleeps toward it), then
+        # an early one that must preempt the ongoing sleep
+        t.schedule_after(5.0, lambda: fired.append("late"))
+        time.sleep(0.05)
+        t0 = time.monotonic()
+        t.schedule_after(0.05, lambda: fired.append(time.monotonic() - t0))
+        deadline = time.monotonic() + 2
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fired and isinstance(fired[0], float)
+        # well below the run loop's 1.0s wait backstop: a broken notify
+        # would still fire at ~0.95s off the capped poll and must FAIL
+        assert fired[0] < 0.5, f"early timer delayed {fired[0]:.2f}s"
+    finally:
+        t.stop()

@@ -421,3 +421,150 @@ def test_python_fallbacks_bit_identical_to_native():
         data = os.urandom(size)
         assert native.crc32c(data, 0) == crc32c_py(data, 0), size
         assert murmur3_x64_128(data, 7) == murmur3_x64_128_py(data, 7), size
+
+
+# ------------------------------------------------------------ fastcore
+# The CPython extension that puts the native cores on the per-call hot
+# path (src/fastcore.cc): frame pack/probe, respool-backed object pools,
+# the MPSC writer-retire queue. Skipped wholesale when the extension is
+# unavailable (no compiler) — the Python twins are covered elsewhere.
+
+import pytest as _pytest
+
+from brpc_tpu.native import fastcore as _fastcore
+
+_fc = _fastcore.get()
+needs_fastcore = _pytest.mark.skipif(_fc is None,
+                                     reason="fastcore unavailable")
+
+
+@needs_fastcore
+def test_fastcore_pack_frame_matches_python_twin():
+    from brpc_tpu.protocol.tpu_std import MAGIC, _py_pack_small_frame
+    for cid in (1, 127, 128, 1 << 21, 1 << 33, (1 << 63) + 5):
+        for att in (b"", b"A", b"ATT" * 100):
+            for payload in (b"", b"p", b"x" * 5000):
+                assert _fc.pack_frame(MAGIC, b"PREFIX", cid, payload,
+                                      att) == \
+                    _py_pack_small_frame(b"PREFIX", cid, payload, att)
+
+
+@needs_fastcore
+def test_fastcore_pack_frame_rejects_u32_overflow():
+    # the wire header carries u32 sizes; a silent wrap would desync the
+    # connection (the Python twin raises struct.error the same way).
+    # An anonymous mmap gives a >4GB-total input without touching pages.
+    with _pytest.raises(OverflowError):
+        import mmap
+        m = mmap.mmap(-1, (1 << 32) - 20)
+        try:
+            _fc.pack_frame(b"TRPC", b"", 1, m, m)
+        finally:
+            m.close()
+
+
+@needs_fastcore
+def test_fastcore_parse_head_adversarial_header():
+    # regression: meta_size near UINT32_MAX once wrapped the u32 bounds
+    # check and read ~4GB past the buffer (hard segfault, found by
+    # review + reproduced before the 64-bit compare fixed it)
+    import struct
+    evil = b"TRPC" + struct.pack(">II", 0xFFFFFFFF, 0xFFFFFFFF)
+    r = _fc.parse_head(evil, b"TRPC")
+    assert r == (0xFFFFFFFF, 0xFFFFFFFF, None)
+    # sane frames still parse with contiguous meta
+    from brpc_tpu.protocol.tpu_std import pack_small_frame
+    w = pack_small_frame(b"PFX", 42, b"xyz")
+    body, meta_size, meta = _fc.parse_head(w, b"TRPC")
+    assert body == len(w) - 12 and meta == w[12:12 + meta_size]
+    assert _fc.parse_head(b"XXXXYYYYZZZZ", b"TRPC") == -1
+    assert _fc.parse_head(b"TR", b"TRPC") is None   # short matching prefix
+    assert _fc.parse_head(b"XX", b"TRPC") == -1     # short mismatch
+
+
+@needs_fastcore
+def test_fastcore_pool_refcounts_and_versioning():
+    import sys as _sys
+    p = _fc.Pool(64)
+    obj = object()
+    rc0 = _sys.getrefcount(obj)
+    i = p.insert(obj)
+    assert i != 0
+    assert p.address(i) is obj
+    assert len(p) == 1
+    assert p.remove(i) is obj
+    assert p.address(i) is None and p.remove(i) is None
+    assert len(p) == 0
+    assert _sys.getrefcount(obj) == rc0
+    # versioning: a recycled slot invalidates the old id
+    i1 = p.insert(obj)
+    p.remove(i1)
+    i2 = p.insert(obj)
+    assert i1 != i2 and p.address(i1) is None and p.address(i2) is obj
+    p.remove(i2)
+
+
+@needs_fastcore
+def test_fastcore_pool_exhaustion_raises():
+    p = _fc.Pool(4)
+    ids = [p.insert(object()) for _ in range(4)]
+    with _pytest.raises(RuntimeError):
+        p.insert(object())
+    for i in ids:
+        p.remove(i)
+    assert p.insert(object()) != 0   # slots recycled
+
+
+@needs_fastcore
+def test_fastcore_mpsc_writer_retire_contract():
+    q = _fc.Mpsc()
+    assert q.push("a") is True       # claimed writership
+    assert q.push("b") is False
+    assert q.drain_one() == "a"
+    assert q.try_retire() is False   # 'b' still queued
+    assert q.drain_one() == "b"
+    assert q.drain_one() is None
+    assert q.try_retire() is True
+    assert q.push("c") is True       # re-claim after retire
+    assert q.drain_one() == "c" and q.try_retire() is True
+
+
+@needs_fastcore
+def test_fastcore_mpsc_concurrent_fifo_per_producer():
+    """N producers racing; exactly one claims at any time, the consumer
+    drains everything, and each producer's own items stay in order."""
+    import threading as _threading
+
+    q = _fc.Mpsc()
+    N, PER = 4, 500
+    drained = []
+    lock = _threading.Lock()
+
+    def drain_all():
+        while True:
+            it = q.drain_one()
+            if it is None:
+                if q.try_retire():
+                    return
+                continue
+            drained.append(it)
+
+    def producer(k):
+        for i in range(PER):
+            if q.push((k, i)):
+                with lock:      # serialize competing claimants' drains
+                    drain_all()
+
+    ths = [_threading.Thread(target=producer, args=(k,)) for k in range(N)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    with lock:
+        if q.push(("fin", 0)):
+            drain_all()
+    items = [d for d in drained if d[0] != "fin"]
+    assert len(items) == N * PER
+    for k in range(N):
+        seq = [i for kk, i in items if kk == k]
+        assert seq == sorted(seq), f"producer {k} reordered"
