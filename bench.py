@@ -681,8 +681,10 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 - diagnostics only
             result["fiber_wake_error"] = f"{type(e).__name__}: {e}"[:200]
         _progress({"progress": "tcp_small",
-                   "p50_us": result["small_rpc_p50_us"],
-                   "p99_us": result["small_rpc_p99_us"]})
+                   "p50_us": result.get("small_rpc_p50_us"),
+                   "p99_us": result.get("small_rpc_p99_us"),
+                   **({"error": result["small_rpc_error"]}
+                      if "small_rpc_error" in result else {})})
         # the 4B-4MB TCP sweep (the reference's qps-vs-request-size
         # curves, docs/cn/benchmark.md:92-156) — adaptive iteration
         # counts, one stderr line per point, skipped points reported

@@ -13,8 +13,8 @@ PING/GOAWAY/RST_STREAM — carrying two request families:
     so every builtin observability page is h2-reachable.
 
 Server side registers as a Protocol (preface-sniffing parse); client
-side is GrpcChannel, which drives the same H2Session over a client
-socket. Frame processing is serialized on the socket's input fiber
+side is GrpcChannel (gRPC) and Http2Client (plain HTTP request()),
+both driving the same H2Session over a client socket. Frame processing is serialized on the socket's input fiber
 (process_inline), so recv-side state needs no lock; the send side is
 guarded by a per-session lock because handler fibers write responses
 concurrently.
@@ -899,6 +899,7 @@ class GrpcChannel:
             self._pending.discard(call)
         session.send_rst(stream.id, CANCEL)
 
+
     @staticmethod
     def _finish(call, response_class):
         if response_class is not None and call.ok():
@@ -918,6 +919,92 @@ class GrpcChannel:
             session.send_goaway()
         if socket is not None and not socket.failed:
             socket.set_failed(ConnectionError("channel closed"))
+
+
+class HttpResponse:
+    """Plain-HTTP-over-h2 response: status/headers/body."""
+
+    __slots__ = ("status", "headers", "body")
+
+    def __init__(self, status: int, headers: List[Tuple[str, str]],
+                 body: bytes):
+        self.status = status
+        self.headers = headers
+        self.body = body
+
+    def header(self, name: str, default: str = "") -> str:
+        for k, v in self.headers:
+            if k.lower() == name.lower():
+                return v
+        return default
+
+
+class Http2Client(GrpcChannel):
+    """Plain HTTP over h2 on the same session machinery GrpcChannel
+    drives (the client half of the reference's h2 support beyond gRPC,
+    policy/http2_rpc_protocol.cpp): request() multiplexes ordinary
+    GET/POST streams — builtin observability pages, RESTful services —
+    over one h2 connection."""
+
+    def request(self, method: str, path: str, body: bytes = b"",
+                headers: Optional[List[Tuple[str, str]]] = None,
+                timeout: Optional[float] = 10.0) -> HttpResponse:
+        """Blocking plain-HTTP exchange; raises H2Error on transport
+        failure or timeout."""
+        session = self._connect()
+        call = GrpcCall()            # reused as a generic completion slot
+        stream = session.new_stream()
+        with self._lock:
+            call._socket = session.socket
+            self._pending.add(call)
+
+        def _done(stream_):
+            # a peer RST_STREAM completes the stream with synthetic
+            # grpc-status trailers (feed_frame's reset path) — that is
+            # a transport failure, not a response
+            rst = None
+            for k, v in stream_.trailers:
+                if k == "grpc-status" and v not in ("0", ""):
+                    rst = v
+                    break
+            if rst is not None:
+                # headers-before-reset would otherwise surface as a
+                # 200 with a silently truncated body
+                call.status = GRPC_UNAVAILABLE
+                call.message = f"stream reset (grpc-status {rst})"
+            else:
+                call.headers = stream_.headers
+                call.response = bytes(stream_.data)
+                call.status = GRPC_OK
+            call._event.set()
+            with self._lock:
+                self._pending.discard(call)
+
+        stream.on_complete = _done
+        hdrs = [
+            (":method", method.upper()), (":scheme", "http"),
+            (":path", path),
+            (":authority", f"{self._endpoint.host}:{self._endpoint.port}"),
+        ]
+        for kv in headers or []:
+            hdrs.append(kv)
+        session.send_headers(stream, hdrs, end_stream=not body)
+        if body:
+            session.send_data(stream, body, end_stream=True)
+        if not call.wait(timeout):
+            with self._lock:
+                self._pending.discard(call)
+            session.send_rst(stream.id, CANCEL)
+            raise H2Error(CANCEL, f"h2 request timed out after {timeout}s")
+        if call.status != GRPC_OK:
+            raise H2Error(INTERNAL_ERROR, call.message or "request failed")
+        resp = HttpResponse(0, call.headers, call.response)
+        try:
+            resp.status = int(resp.header(":status", "0") or 0)
+        except ValueError as e:
+            raise H2Error(PROTOCOL_ERROR, f"malformed :status: {e}") from None
+        return resp
+
 
 
 _instance: Optional[H2ServerProtocol] = None
