@@ -471,3 +471,85 @@ def test_amf3_command_envelope(rtmp_server):
         assert b"_result" in got and b"NetConnection.Connect.Success" in got
     finally:
         c.close()
+
+
+def test_digest_client_against_plain_echo_server():
+    """A digest-C1 client must interop with a server speaking only the
+    PLAIN handshake (it just echoes C1 as S2 and sends a zero-version
+    S1): connect + createStream must succeed."""
+    import socket as pysock
+    import threading as _threading
+
+    import os as _os
+
+    srv = pysock.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    state = {}
+
+    def plain_server():
+        c, _ = srv.accept()
+        c.settimeout(10)
+        buf = b""
+        while len(buf) < 1 + rtmp.HANDSHAKE_SIZE:
+            chunk = c.recv(65536)
+            if not chunk:
+                return
+            buf += chunk
+        c1 = buf[1:1 + rtmp.HANDSHAKE_SIZE]
+        s1 = struct.pack(">II", 0, 0) + _os.urandom(rtmp.HANDSHAKE_SIZE - 8)
+        state["s1"] = s1
+        c.sendall(bytes([rtmp.RTMP_VERSION]) + s1 + c1)   # plain echo
+        # read C2 then the connect command; answer _result
+        data = b""
+        while len(data) < rtmp.HANDSHAKE_SIZE:
+            chunk = c.recv(65536)
+            if not chunk:
+                return
+            data += chunk
+        state["c2"] = data[:rtmp.HANDSHAKE_SIZE]
+        rest = data[rtmp.HANDSHAKE_SIZE:]
+        st = rtmp._ConnState(is_client=False)
+        st.phase = rtmp._ConnState.PHASE_READY
+        deadline = time.monotonic() + 10
+        got_connect = False
+        while not got_connect and time.monotonic() < deadline:
+            if rest:
+                pos = 0
+                while True:
+                    got = rtmp._parse_one_chunk(st, rest, pos)
+                    if got is None:
+                        break
+                    msg, pos = got
+                    if msg is not None and \
+                            msg.msg_type == rtmp.MSG_COMMAND_AMF0:
+                        vals = amf.decode_all(msg.payload)
+                        if vals and vals[0] == "connect":
+                            got_connect = True
+                            reply = rtmp.command_message(
+                                "_result", vals[1],
+                                {"fmsVer": "PLAIN/1,0"},
+                                {"level": "status",
+                                 "code": "NetConnection.Connect.Success"})
+                            c.sendall(rtmp.pack_chunks(reply, 3))
+                rest = rest[pos:]
+            if not got_connect:
+                rest += c.recv(65536)
+        state["ok"] = got_connect
+
+    th = _threading.Thread(target=plain_server, daemon=True)
+    th.start()
+    c = rtmp.RtmpClient(f"tcp://127.0.0.1:{port}", app="live")
+    try:
+        info = c.connect()
+        assert info["code"] == "NetConnection.Connect.Success"
+        th.join(10)
+        assert state.get("ok")
+        # the client must have sent a plain-echo C2 (= S1) since the
+        # plain server's S1 carries no FMS digest — a regressed fallback
+        # sending a keyed digest C2 must FAIL here
+        assert state.get("c2") == state.get("s1")
+    finally:
+        c.close()
+        srv.close()
