@@ -74,6 +74,13 @@ _MEDIA_CSID = 6
 # after a 764-byte key block); C2/S2 are random blocks whose last 32
 # bytes are keyed on the peer's digest. A C1 with a zero version word
 # is the plain echo handshake.
+#
+# Degradation is graceful in BOTH directions by construction: a digest
+# the server can't validate (unknown scheme, or key-constant drift)
+# falls back to the plain echo with a zero-version S1, which stock
+# encoders accept as a simple-handshake server; a client whose S1 shows
+# no server digest echoes S1 as plain C2. So a wrong key constant
+# degrades to the plain handshake instead of breaking connections.
 _FP_KEY = b"Genuine Adobe Flash Player 001"          # client partial (30)
 _FMS_KEY = b"Genuine Adobe Flash Media Server 001"   # server partial (36)
 _KEY_TAIL = bytes((0xF0, 0xEE, 0xC2, 0x4A, 0x80, 0x68, 0xBE, 0xE8,
