@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional
 
 from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+from brpc_tpu.butil.flags import flag as _flag
 from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.fiber import TaskControl, global_control
 from brpc_tpu.fiber.sync import FiberEvent as _FiberEvent
@@ -226,8 +227,7 @@ class Channel:
             # stream setup piggybacks on this RPC (StreamCreate)
             from brpc_tpu.rpc.stream import Stream
             cntl.stream = Stream(stream_options)
-        from brpc_tpu.butil.flags import flag
-        if flag("rpcz_enabled"):
+        if _flag("rpcz_enabled"):
             from brpc_tpu.rpc.span import finish_span, start_client_span
             span = start_client_span(cntl, service_name, method_name)
             span.request_size = len(cntl._request_bytes)

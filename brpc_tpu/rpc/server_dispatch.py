@@ -67,13 +67,14 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     # The resolved Authenticator is cached on the server — per-request
     # resolution sat on the hot path for no benefit (the reference
     # resolves once at Server::Start)
-    from brpc_tpu.rpc.auth import AuthError, resolve_server_auth
     auth = getattr(server, "_resolved_auth_cache", _UNSET)
     if auth is _UNSET:
+        from brpc_tpu.rpc.auth import resolve_server_auth
         auth = resolve_server_auth(server.options)
         server._resolved_auth_cache = auth
     auth_ctx = socket.user_data.get("auth_context")
     if auth is not None and auth_ctx is None:
+        from brpc_tpu.rpc.auth import AuthError
         try:
             auth_ctx = auth.verify_credential(req_meta.auth_token,
                                               socket.remote_endpoint)
