@@ -37,6 +37,15 @@ def main() -> None:
             cntl.response_attachment = cntl.request_attachment
         return request
 
+    @svc.method()
+    async def Slow(cntl, request):
+        # the 1%-long-tail request of the reference's latency-CDF
+        # benchmark (docs/cn/benchmark.md:126-199): a deliberately slow
+        # handler that must not drag the other 99% down
+        from brpc_tpu.fiber.timer import sleep as fiber_sleep
+        await fiber_sleep(0.05)
+        return request
+
     server.add_service(svc)
     ep = server.start(f"tcp://127.0.0.1:{port}")
     print(f"PORT {ep.port}", flush=True)
