@@ -35,3 +35,51 @@ def test_parallel_allreduce_example(capsys):
 
 def test_long_context_example():
     _load("long_context").main(seq=256)
+
+
+def test_auth_example():
+    _load("auth").main()
+
+
+def test_backup_request_example():
+    _load("backup_request").main()
+
+
+def test_streaming_echo_example():
+    _load("streaming_echo").main(n_frames=5)
+
+
+def _run_serving_example(name, monkeypatch, **kw):
+    """Examples that end in run_until_asked_to_quit(): stub the serve
+    loop so the rot guard exercises their full setup + self-drive and
+    returns (their own clients already ran by that point)."""
+    from brpc_tpu.rpc.server import Server
+
+    stopped = []
+
+    def fake_serve(self):
+        self.stop()
+        self.join(2)
+        stopped.append(True)
+
+    monkeypatch.setattr(Server, "run_until_asked_to_quit", fake_serve)
+    _load(name).main(**kw)
+    assert stopped
+
+
+def test_redis_kv_example(monkeypatch, capsys):
+    _run_serving_example("redis_kv", monkeypatch,
+                         addr="tcp://127.0.0.1:0")
+    out = capsys.readouterr().out
+    assert "GET greeting       -> b'hello'" in out or "hello" in out
+
+
+def test_thrift_echo_example(monkeypatch, capsys):
+    _run_serving_example("thrift_echo", monkeypatch,
+                         addr="tcp://127.0.0.1:0")
+    assert b"hello thrift".decode() in capsys.readouterr().out
+
+
+def test_rtmp_relay_example(capsys):
+    _load("rtmp_relay").main(addr="tcp://127.0.0.1:0")
+    assert "player received" in capsys.readouterr().out
