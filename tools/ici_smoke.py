@@ -214,14 +214,30 @@ def orchestrate() -> None:
     hidden."""
     real_wall = float(os.environ.get("BRPC_TPU_SMOKE_REAL_WALL_S", "240"))
     cpu_wall = float(os.environ.get("BRPC_TPU_SMOKE_CPU_WALL_S", "240"))
-    real = _run_pass({}, real_wall)
+    if os.environ.get("BRPC_TPU_SMOKE_SKIP_REAL"):
+        # refresh the CPU proof WITHOUT touching the tunnel (it admits
+        # one client; a builder-session probe could wedge the driver's
+        # bench window — the exact hazard rounds 1-3 paid for)
+        real = {"ok": False, "skipped": True,
+                "reason": "BRPC_TPU_SMOKE_SKIP_REAL set (single-client "
+                          "tunnel left untouched for the bench)"}
+    else:
+        real = _run_pass({}, real_wall)
     cpu = _run_pass({"BRPC_TPU_SMOKE_CPU": "1"}, cpu_wall)
     evidence = {
         "ok": bool(cpu.get("ok")),
         "real_backend": real,
         "cpu_dryrun": cpu,
     }
-    if not real.get("ok"):
+    if real.get("skipped"):
+        evidence["diagnosis"] = (
+            "real-backend pass deliberately skipped (" +
+            str(real.get("reason", "")) + "); the cross-process pull "
+            "lane is " + ("PROVEN on the CPU fabric this run "
+                          "(cpu_dryrun)." if cpu.get("ok")
+                          else "NOT proven this run — see "
+                               "cpu_dryrun.error."))
+    elif not real.get("ok"):
         err = f"{real.get('stage', '?')}: {real.get('error', '?')}"
         # the single-client-tunnel constraint manifests as hangs (pass
         # killed at the wall cap, a never-appearing PORT line, or an
