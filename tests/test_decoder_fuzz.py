@@ -193,3 +193,77 @@ class TestFlvFuzz:
             except (flv.FlvError, ValueError, KeyError, IndexError,
                     struct_error):
                 pass
+
+
+class TestAmf3Fuzz:
+    """The AMF3 read side (round 4): random and mutated inputs must
+    raise AmfError-family exceptions, never crash or hang (reference
+    tables + U29 + traits are the risky parts)."""
+
+    def test_random_bytes(self):
+        rng = random.Random(0xA3F2)
+        for _ in range(500):
+            data = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(0, 80)))
+            try:
+                amf.decode_all_amf3(data)
+            except (amf.AmfError, ValueError, KeyError, IndexError,
+                    struct_error, RecursionError):
+                pass
+
+    def test_mutated_valid_amf3(self):
+        rng = random.Random(0xA3F3)
+        # dynamic object + array + string refs (hand-assembled)
+        base = (b"\x0a\x0b\x01\x03a\x04\x07\x05b\x06\x05xy\x01"
+                b"\x09\x05\x01\x04\x01\x06\x00")
+        for data in _mutations(rng, base, 400):
+            try:
+                amf.decode_all_amf3(data)
+            except (amf.AmfError, ValueError, KeyError, IndexError,
+                    struct_error, RecursionError):
+                pass
+
+    def test_reference_bombs_rejected(self):
+        # out-of-range string/object/traits references must raise, not
+        # index arbitrary memory or loop
+        for evil in (b"\x06\x7e",            # string ref 63, empty table
+                     b"\x0a\x04",            # object ref 1, empty table
+                     b"\x0a\x05\x01",        # traits ref w/ empty table
+                     b"\x09\x02",            # array ref, empty table
+                     b"\x0c\x04"):           # bytearray ref, empty table
+            with pytest.raises(amf.AmfError):
+                amf.decode_amf3(evil)
+
+    def test_avmplus_switch_garbage(self):
+        rng = random.Random(0xA3F4)
+        for _ in range(300):
+            data = b"\x11" + bytes(rng.randrange(256)
+                                   for _ in range(rng.randrange(0, 40)))
+            try:
+                amf.decode_value(data)
+            except (amf.AmfError, ValueError, KeyError, IndexError,
+                    struct_error, RecursionError):
+                pass
+
+
+class TestAggregateFuzz:
+    def test_random_aggregate_payloads(self):
+        from brpc_tpu.protocol import rtmp
+        rng = random.Random(0xA66E)
+        for _ in range(400):
+            payload = bytes(rng.randrange(256)
+                            for _ in range(rng.randrange(0, 120)))
+            msg = rtmp.RtmpMessage(rtmp.MSG_AGGREGATE, 1000, 1, payload)
+            try:
+                subs = rtmp._split_aggregate(msg)
+                for m in subs:
+                    assert m.timestamp >= 0     # clamped, never negative
+            except rtmp.RtmpError:
+                pass
+
+    def test_overrunning_sub_message_rejected(self):
+        from brpc_tpu.protocol import rtmp
+        hdr = bytes([8]) + (1 << 20).to_bytes(3, "big") + b"\0\0\0\0\0\0\0"
+        msg = rtmp.RtmpMessage(rtmp.MSG_AGGREGATE, 0, 1, hdr + b"short")
+        with pytest.raises(rtmp.RtmpError):
+            rtmp._split_aggregate(msg)
