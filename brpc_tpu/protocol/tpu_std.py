@@ -242,6 +242,16 @@ class TpuStdProtocol(Protocol):
                 return PARSE_TRY_OTHERS, None
             if meta_size > body_size:
                 return PARSE_TRY_OTHERS, None
+        if body_size > 16 << 20:
+            # only rare giant frames pay the flag lookup; a body_size
+            # beyond max_body_size would otherwise buffer unbounded
+            # toward a u32 claim that may never arrive (the reference
+            # checks the same limit in ParseRpcMessage)
+            from brpc_tpu.butil.flags import flag as _flagf
+            if body_size > _flagf("max_body_size"):
+                socket.set_failed(ConnectionError(
+                    f"frame body {body_size} exceeds max_body_size"))
+                return PARSE_NOT_ENOUGH_DATA, None
         if portal.size < HEADER_SIZE + body_size:
             return PARSE_NOT_ENOUGH_DATA, None
         meta = pb.RpcMeta()

@@ -161,3 +161,40 @@ def test_rapid_connect_disconnect(kitchen_sink_server):
     for t in threads:
         t.join(20)
     _assert_still_serving(ep)
+
+
+def test_tpu_std_rejects_body_beyond_max_body_size():
+    """A header claiming a near-4GB body must fail the connection
+    immediately (ParseRpcMessage's max_body_size check) instead of
+    buffering toward a claim that may never arrive."""
+    import struct as _struct
+
+    from brpc_tpu.butil.iobuf import IOPortal
+    from brpc_tpu.protocol.registry import PARSE_NOT_ENOUGH_DATA
+    from brpc_tpu.protocol.tpu_std import ensure_registered
+
+    class _Sock:
+        failed = False
+        preferred_protocol = -1
+        user_data: dict = {}
+
+        def set_failed(self, e):
+            self.failed = True
+            self.reason = e
+
+        def take_device_payload(self):
+            return None
+
+    proto = ensure_registered()
+    portal = IOPortal()
+    portal.append(b"TRPC" + _struct.pack(">II", 0xFFFFFF00, 16))
+    sock = _Sock()
+    status, msg = proto.parse(portal, sock)
+    assert status == PARSE_NOT_ENOUGH_DATA and msg is None
+    assert sock.failed and "max_body_size" in str(sock.reason)
+    # a merely-large-but-legal frame is NOT rejected
+    portal2 = IOPortal()
+    portal2.append(b"TRPC" + _struct.pack(">II", 20 << 20, 16))
+    sock2 = _Sock()
+    status, _ = proto.parse(portal2, sock2)
+    assert status == PARSE_NOT_ENOUGH_DATA and not sock2.failed
