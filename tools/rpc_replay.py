@@ -16,7 +16,12 @@ from brpc_tpu.rpc.rpc_dump import load_dump
 
 
 def main(argv=None) -> None:
-    ap = argparse.ArgumentParser(description="replay rpc_dump samples")
+    ap = argparse.ArgumentParser(
+        description="replay rpc_dump samples. CAUTION: if the target "
+        "server is still dumping into the SAME file being replayed, "
+        "every replayed request is re-sampled and re-read — a "
+        "self-amplifying loop bounded only by the sampling budget. "
+        "Disable rpc_dump_dir (or replay a copied file) first.")
     ap.add_argument("dump_file")
     ap.add_argument("address")
     ap.add_argument("--qps", type=float, default=0, help="0 = as fast as possible")
