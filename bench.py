@@ -639,6 +639,7 @@ def main() -> None:
         rec = LatencyRecorder()
         failures = 0
         samples = 0
+        best_us = None
         for _ in range(300):
             if deadline.remaining() < 5.0:
                 break
@@ -650,11 +651,18 @@ def main() -> None:
                     break            # dead server: don't grind the budget
             else:
                 samples += 1
-                rec.record((time.perf_counter_ns() - t0) / 1e3)
+                us = (time.perf_counter_ns() - t0) / 1e3
+                rec.record(us)
+                if best_us is None or us < best_us:
+                    best_us = us
         lat_ch.close()
         if samples:
             result["small_rpc_p50_us"] = round(rec.latency_percentile(0.5), 1)
             result["small_rpc_p99_us"] = round(rec.latency_percentile(0.99), 1)
+            # noise-robust floor: one bad scheduling draw on a shared
+            # box inflates percentiles; the min is the machine-honest
+            # "what the path costs" figure
+            result["small_rpc_min_us"] = round(best_us, 1)
         else:
             # an empty recorder would report a record-looking 0.0
             result["partial"] = True
