@@ -33,8 +33,10 @@ Harness-proofing (every lesson from the round-2 rc=1 capture):
     completes, so a timeout still leaves parseable data;
   * the whole run fits a WALL BUDGET (default 100s, env
     BRPC_TPU_BENCH_BUDGET_S): iteration counts derive from measured
-    per-call cost, the headline runs FIRST, and points that don't fit
-    are reported as skipped instead of hanging;
+    per-call cost; the preflight + device PROBE run first but capped at
+    40% of the budget so a wedged tunnel can't starve the TCP phases,
+    and points that don't fit are reported as skipped instead of
+    hanging;
   * a failure after the headline still prints the final JSON with
     whatever was captured (partial=true).
 
