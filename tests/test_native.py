@@ -568,3 +568,61 @@ def test_fastcore_mpsc_concurrent_fifo_per_producer():
     for k in range(N):
         seq = [i for kk, i in items if kk == k]
         assert seq == sorted(seq), f"producer {k} reordered"
+
+
+def test_fast_and_slow_framing_semantic_parity():
+    """The small-call fast path (cached prefix + hand-encoded varints)
+    and the general pack_message path must produce frames that PARSE to
+    identical metas and bodies — the wire invariant everything else
+    rests on."""
+    from brpc_tpu.butil.iobuf import IOBuf, IOPortal
+    from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
+    from brpc_tpu.protocol.tpu_std import (ensure_registered, pack_message,
+                                           pack_small_frame)
+
+    class _Sock:
+        failed = False
+        preferred_protocol = -1
+        user_data: dict = {}
+
+        def set_failed(self, e):
+            self.failed = True
+
+        def take_device_payload(self):
+            return None
+
+    proto = ensure_registered()
+    for cid, payload, att in ((7, b"body", b""),
+                              ((1 << 40) + 3, b"", b"ATTACH" * 10),
+                              (1, b"x" * 3000, b"y" * 500)):
+        m = pb.RpcMeta()
+        m.request.service_name = "Svc"
+        m.request.method_name = "M"
+        m.request.timeout_ms = 1000
+        m.correlation_id = cid
+        att_buf = IOBuf()
+        att_buf.append(att)
+        slow_wire, _ = pack_message(m, payload, attachment=att_buf)
+
+        prefix_m = pb.RpcMeta()
+        prefix_m.request.service_name = "Svc"
+        prefix_m.request.method_name = "M"
+        prefix_m.request.timeout_ms = 1000
+        fast_wire = pack_small_frame(prefix_m.SerializeToString(), cid,
+                                     payload, att)
+
+        parsed = []
+        for wire in (slow_wire.to_bytes() if hasattr(slow_wire, "to_bytes")
+                     else slow_wire, fast_wire):
+            portal = IOPortal()
+            portal.append(bytes(wire))
+            status, msg = proto.parse(portal, _Sock())
+            assert status == "ok", status
+            parsed.append(msg)
+        a, b = parsed
+        assert a.meta.correlation_id == b.meta.correlation_id == cid
+        assert a.meta.request.service_name == b.meta.request.service_name
+        assert a.meta.request.timeout_ms == b.meta.request.timeout_ms
+        assert a.meta.attachment_size == b.meta.attachment_size
+        assert a.payload.to_bytes() == b.payload.to_bytes() == payload
+        assert a.attachment.to_bytes() == b.attachment.to_bytes() == att
