@@ -747,3 +747,41 @@ class TestLevelTriggeredBusyPause:
         finally:
             server.stop()
             server.join(2)
+
+
+class TestControllerNotPinned:
+    def test_inline_completed_call_is_collectable_immediately(self):
+        """Inline completion can finish a call DURING _issue_rpc; the
+        deadline timer must then never be armed (or be unscheduled), or
+        every completed controller stays pinned in the timer heap for
+        the full timeout — the leak class unschedule exists to stop."""
+        import gc
+        import weakref
+
+        from brpc_tpu.rpc import Channel, ChannelOptions, Server, Service
+
+        server = Server()
+        svc = Service("EchoService")
+
+        @svc.method()
+        async def Echo(cntl, request):
+            return request
+
+        server.add_service(svc)
+        ep = server.start(f"mem://pin-{next(_name_seq)}")
+        try:
+            ch = Channel(str(ep), ChannelOptions(timeout_ms=30000))
+            refs = []
+            for _ in range(5):
+                c = ch.call_sync("EchoService", "Echo", b"x")
+                assert not c.failed()
+                refs.append(weakref.ref(c))
+                del c
+            gc.collect()
+            alive = sum(1 for r in refs if r() is not None)
+            assert alive == 0, (f"{alive}/5 completed controllers still "
+                                "pinned (timer heap holds them for the "
+                                "30s deadline)")
+        finally:
+            server.stop()
+            server.join(2)
