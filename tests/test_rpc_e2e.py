@@ -770,7 +770,40 @@ class TestControllerNotPinned:
         server.add_service(svc)
         ep = server.start(f"mem://pin-{next(_name_seq)}")
         try:
-            ch = Channel(str(ep), ChannelOptions(timeout_ms=30000))
+            self._assert_collectable(str(ep))
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_tcp_completed_call_unpinned_after_unschedule(self):
+        """Same guard over TCP, where the deadline timer IS armed: the
+        completion-path unschedule must drop the timer's closure so the
+        controller doesn't live out the 30s deadline in the heap."""
+        from brpc_tpu.rpc import Server, Service
+
+        server = Server()
+        svc = Service("EchoService")
+
+        @svc.method()
+        async def Echo(cntl, request):
+            return request
+
+        server.add_service(svc)
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            self._assert_collectable(f"tcp://{ep.host}:{ep.port}")
+        finally:
+            server.stop()
+            server.join(2)
+
+    def _assert_collectable(self, addr):
+        import gc
+        import weakref
+
+        from brpc_tpu.rpc import Channel, ChannelOptions
+
+        if True:
+            ch = Channel(addr, ChannelOptions(timeout_ms=30000))
             refs = []
             for _ in range(5):
                 c = ch.call_sync("EchoService", "Echo", b"x")
@@ -782,6 +815,3 @@ class TestControllerNotPinned:
             assert alive == 0, (f"{alive}/5 completed controllers still "
                                 "pinned (timer heap holds them for the "
                                 "30s deadline)")
-        finally:
-            server.stop()
-            server.join(2)
