@@ -1,0 +1,93 @@
+"""tools/device_probe.py — the dedicated device-lane probe.
+
+Four rounds of bench artifacts ended with an unattributed "backend
+never came up"; the probe exists so a hang produces evidence (python
+stacks, per-thread kernel wchan, relay socket state, timeline). These
+tests exercise the forensic path with a self-test hang — no tunnel,
+no jax in the child before the hang point — and the /proc readers
+against our own live process.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import device_probe  # noqa: E402
+
+
+def test_task_wchans_reads_own_threads():
+    evt = threading.Event()
+    th = threading.Thread(target=evt.wait, daemon=True)
+    th.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:   # wait until the thread parks
+            tasks = device_probe._task_wchans(os.getpid())
+            if any("futex" in t["wchan"] for t in tasks):
+                break
+            time.sleep(0.05)
+        assert len(tasks) >= 2          # main + waiter at least
+        assert all({"tid", "comm", "state", "wchan"} <= set(t) for t in tasks)
+        # the waiter thread is parked in futex — its wchan must say so
+        wchans = " ".join(t["wchan"] for t in tasks)
+        assert "futex" in wchans
+    finally:
+        evt.set()
+        th.join(5)
+
+
+def test_relay_sockets_parser_survives_own_pid():
+    # we hold no relay sockets; the parser must return [] not crash
+    assert device_probe._relay_sockets(os.getpid()) == []
+
+
+def test_snapshot_shape():
+    snap = device_probe._snapshot(os.getpid(), time.monotonic())
+    assert "tasks" in snap and "relay_sockets" in snap
+    assert snap["elapsed_s"] <= 0.5
+
+
+def test_hang_produces_forensic_report(tmp_path, monkeypatch):
+    """The flagship path: a child that wedges in a C call (sleep) must
+    yield a report naming the python frame and the kernel syscall."""
+    monkeypatch.setenv("BRPC_TPU_PROBE_SELFTEST_HANG", "1")
+    out = str(tmp_path / "probe.json")
+    t0 = time.monotonic()
+    lane = device_probe.run_probe(budget_s=6.0, out_path=out)
+    assert time.monotonic() - t0 < 30.0   # hang bounded by budget + dump
+    assert "hung" in lane["error"]
+    hang = lane["hang"]
+    # the exact blocking python frame is named
+    assert "_child_main" in hang["python_stacks"]
+    # the kernel-side syscall is named per thread
+    tasks = hang["final_snapshot"]["tasks"]
+    assert tasks and any("nanosleep" in t["wchan"] or t["wchan"] != "0"
+                         for t in tasks)
+    assert hang["last_phase"].get("phase") == "selftest_hang"
+    # the incremental artifact landed on disk and parses
+    with open(out) as f:
+        doc = json.load(f)
+    assert "error" in doc and "hang" in doc
+    # relay precheck ran (reachability of the tunnel endpoint)
+    assert "reachable" in lane["probe"]["relay_precheck"]
+
+
+def test_probe_child_dead_is_reported(monkeypatch):
+    """A child that dies before producing a result must be reported
+    with rc + stderr tail, not hang the parent."""
+    real_popen = device_probe.subprocess.Popen
+
+    def bad_popen(argv, **kw):
+        return real_popen([sys.executable, "-c",
+                           "import sys; sys.stderr.write('boom'); "
+                           "sys.exit(3)"], **kw)
+
+    monkeypatch.setattr(device_probe.subprocess, "Popen", bad_popen)
+    lane = device_probe.run_probe(budget_s=5.0, out_path=None)
+    assert "rc=3" in lane["error"] and "boom" in lane["error"]
