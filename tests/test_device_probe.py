@@ -78,6 +78,28 @@ def test_hang_produces_forensic_report(tmp_path, monkeypatch):
     assert "reachable" in lane["probe"]["relay_precheck"]
 
 
+def test_attribution_names_external_plugin_hang():
+    """The round-5 real capture's pattern: blocked in PJRT client
+    creation, sleeping in a retry loop, no relay socket held, relay
+    reachable — must be attributed EXTERNAL with the evidence named."""
+    hang = {
+        "python_stacks": 'File ".../jaxlib/xla_client.py", line 161 '
+                         "in make_c_api_client",
+        "final_snapshot": {
+            "tasks": [{"wchan": "hrtimer_nanosleep"},
+                      {"wchan": "ep_poll"}],
+            "relay_sockets": [],
+        },
+        "relay_precheck": {"reachable": True, "connect_ms": 2.3},
+    }
+    a = device_probe._attribute_hang(hang)
+    assert a.startswith("EXTERNAL") and "hrtimer_nanosleep" in a
+    # without the plugin frame, a repo frame is attributed to the repo
+    hang["python_stacks"] = 'File ".../brpc_tpu/transport/ici.py", ' \
+                            "line 1 in pull"
+    assert device_probe._attribute_hang(hang).startswith("REPO")
+
+
 def test_probe_child_dead_is_reported(monkeypatch):
     """A child that dies before producing a result must be reported
     with rc + stderr tail, not hang the parent."""
