@@ -177,6 +177,229 @@ PyObject* fc_parse_head(PyObject*, PyObject* args) {
   return r;
 }
 
+// -------------------------------------------------------- scan_frames --
+// The per-call loop's native core: one call over the drained input
+// window scans every complete tpu_std frame AND decodes the RpcMeta
+// subset the dispatch path needs — the moral equivalent of the
+// reference's in-place last-message processing, where frame cut, meta
+// decode and dispatch routing are C++ end to end
+// (input_messenger.cpp:219-331 + baidu_rpc_protocol.cpp:95,314).
+//
+// scan_frames(view, magic, max_body, max_frames)
+//   -> (consumed_bytes, [frame, ...])
+// frame (fast request):  (0, cid, service, method, log_id,
+//                         payload_off, payload_len, att_off, att_len)
+// frame (fast response): (1, cid, error_code, error_text|None,
+//                         payload_off, payload_len, att_off, att_len)
+// The scan STOPS (without consuming) at the first frame that is
+// incomplete, oversized, non-matching, or carries slow-path features
+// (compression, streams, device payloads, auth, rpcz propagation,
+// unknown fields) — the Python classic path handles those from the
+// stop offset with full protobuf semantics.
+
+struct MetaScan {
+  uint64_t cid = 0;
+  uint64_t att = 0;
+  uint64_t log_id = 0;
+  int kind = -1;  // 0 request, 1 response
+  const char* svc = nullptr; size_t svc_len = 0;
+  const char* mth = nullptr; size_t mth_len = 0;
+  int32_t err_code = 0;
+  const char* err = nullptr; size_t err_len = 0;
+};
+
+inline bool read_varint(const unsigned char*& p, const unsigned char* end,
+                        uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (p < end && shift < 64) {
+    unsigned char b = *p++;
+    v |= uint64_t(b & 0x7F) << shift;
+    if (!(b & 0x80)) { *out = v; return true; }
+    shift += 7;
+  }
+  return false;
+}
+
+// returns false => slow path (unknown/truncated/feature-bearing)
+inline bool walk_request_meta(const unsigned char* p,
+                              const unsigned char* end, MetaScan* m) {
+  while (p < end) {
+    uint64_t key, len;
+    if (!read_varint(p, end, &key)) return false;
+    switch (key) {
+      case (1u << 3) | 2:  // service_name
+        if (!read_varint(p, end, &len) || uint64_t(end - p) < len)
+          return false;
+        m->svc = reinterpret_cast<const char*>(p); m->svc_len = len;
+        p += len;
+        break;
+      case (2u << 3) | 2:  // method_name
+        if (!read_varint(p, end, &len) || uint64_t(end - p) < len)
+          return false;
+        m->mth = reinterpret_cast<const char*>(p); m->mth_len = len;
+        p += len;
+        break;
+      case (3u << 3) | 0:  // log_id
+        if (!read_varint(p, end, &m->log_id)) return false;
+        break;
+      case (4u << 3) | 0: {  // timeout_ms (server side ignores)
+        uint64_t ignored;
+        if (!read_varint(p, end, &ignored)) return false;
+        break;
+      }
+      default:
+        return false;  // auth_token or unknown: slow path
+    }
+  }
+  return true;
+}
+
+inline bool walk_response_meta(const unsigned char* p,
+                               const unsigned char* end, MetaScan* m) {
+  while (p < end) {
+    uint64_t key, len;
+    if (!read_varint(p, end, &key)) return false;
+    switch (key) {
+      case (1u << 3) | 0: {  // error_code (int32 as varint)
+        uint64_t v;
+        if (!read_varint(p, end, &v)) return false;
+        m->err_code = static_cast<int32_t>(v);
+        break;
+      }
+      case (2u << 3) | 2:  // error_text
+        if (!read_varint(p, end, &len) || uint64_t(end - p) < len)
+          return false;
+        m->err = reinterpret_cast<const char*>(p); m->err_len = len;
+        p += len;
+        break;
+      default:
+        return false;
+    }
+  }
+  return true;
+}
+
+inline bool walk_meta(const unsigned char* p, const unsigned char* end,
+                      MetaScan* m) {
+  while (p < end) {
+    uint64_t key, len;
+    if (!read_varint(p, end, &key)) return false;
+    switch (key) {
+      case (1u << 3) | 2:  // request submessage
+        if (m->kind != -1) return false;
+        if (!read_varint(p, end, &len) || uint64_t(end - p) < len)
+          return false;
+        if (!walk_request_meta(p, p + len, m)) return false;
+        m->kind = 0;
+        p += len;
+        break;
+      case (2u << 3) | 2:  // response submessage
+        if (m->kind != -1) return false;
+        if (!read_varint(p, end, &len) || uint64_t(end - p) < len)
+          return false;
+        if (!walk_response_meta(p, p + len, m)) return false;
+        m->kind = 1;
+        p += len;
+        break;
+      case (3u << 3) | 0: {  // compress_type: nonzero = slow
+        uint64_t v;
+        if (!read_varint(p, end, &v)) return false;
+        if (v != 0) return false;
+        break;
+      }
+      case (4u << 3) | 0:
+        if (!read_varint(p, end, &m->cid)) return false;
+        break;
+      case (5u << 3) | 0:
+        if (!read_varint(p, end, &m->att)) return false;
+        break;
+      default:
+        // stream_settings / device_payloads / trace ids / unknown
+        return false;
+    }
+  }
+  if (m->kind == -1) {
+    // bare meta (cid + attachment only): the server's small-response
+    // framing — a success response. A cid-less bare meta is a stream
+    // frame or garbage: slow path decides.
+    if (m->cid == 0) return false;
+    m->kind = 1;
+  }
+  return true;
+}
+
+PyObject* fc_scan_frames(PyObject*, PyObject* args) {
+  Py_buffer view, magic;
+  Py_ssize_t max_body = 32768;
+  Py_ssize_t max_frames = 128;
+  if (!PyArg_ParseTuple(args, "y*y*|nn", &view, &magic, &max_body,
+                        &max_frames))
+    return nullptr;
+  const unsigned char* d = static_cast<const unsigned char*>(view.buf);
+  Py_ssize_t off = 0;
+  PyObject* frames = PyList_New(0);
+  if (frames == nullptr || magic.len != 4) {
+    PyBuffer_Release(&view); PyBuffer_Release(&magic);
+    if (frames != nullptr) {
+      Py_DECREF(frames);
+      PyErr_SetString(PyExc_ValueError, "magic must be 4 bytes");
+    }
+    return nullptr;
+  }
+  bool fail = false;
+  while (off + 12 <= view.len && PyList_GET_SIZE(frames) < max_frames) {
+    const unsigned char* h = d + off;
+    if (memcmp(h, magic.buf, 4) != 0) break;
+    uint32_t body = load_be32(h + 4);
+    uint32_t meta_size = load_be32(h + 8);
+    if (meta_size > body || Py_ssize_t(body) > max_body) break;
+    Py_ssize_t total = 12 + Py_ssize_t(body);
+    if (off + total > view.len) break;
+    MetaScan m;
+    if (!walk_meta(h + 12, h + 12 + meta_size, &m)) break;
+    if (m.att > body - meta_size) break;  // lying size: classic path fails it
+    Py_ssize_t p_off = off + 12 + meta_size;
+    Py_ssize_t p_len = Py_ssize_t(body - meta_size - m.att);
+    Py_ssize_t a_off = p_off + p_len;
+    Py_ssize_t a_len = Py_ssize_t(m.att);
+    PyObject* rec;
+    if (m.kind == 0) {
+      // log_id is int64 on the wire: negatives arrive as 10-byte
+      // varints and must round-trip signed ("L"), not as 2^64-x
+      rec = Py_BuildValue(
+          "iKs#s#Lnnnn", 0, (unsigned long long)m.cid,
+          m.svc ? m.svc : "", (Py_ssize_t)m.svc_len,
+          m.mth ? m.mth : "", (Py_ssize_t)m.mth_len,
+          (long long)(int64_t)m.log_id, p_off, p_len, a_off, a_len);
+    } else {
+      PyObject* err_text;
+      if (m.err != nullptr) {
+        err_text = PyUnicode_DecodeUTF8(m.err, m.err_len, "replace");
+        if (err_text == nullptr) { fail = true; break; }
+      } else {
+        err_text = Py_NewRef(Py_None);
+      }
+      rec = Py_BuildValue(
+          "iKiNnnnn", 1, (unsigned long long)m.cid, (int)m.err_code,
+          err_text, p_off, p_len, a_off, a_len);
+    }
+    if (rec == nullptr || PyList_Append(frames, rec) < 0) {
+      Py_XDECREF(rec);
+      fail = true;
+      break;
+    }
+    Py_DECREF(rec);
+    off += total;
+  }
+  PyBuffer_Release(&view); PyBuffer_Release(&magic);
+  if (fail) {
+    Py_DECREF(frames);
+    return nullptr;
+  }
+  return Py_BuildValue("nN", off, frames);
+}
+
 // --------------------------------------------------------------- Pool --
 struct PoolObject {
   PyObject_HEAD
@@ -333,6 +556,10 @@ PyMethodDef module_methods[] = {
      "pack_frame(magic, meta_prefix, cid, payload, attachment) -> bytes"},
     {"parse_head", fc_parse_head, METH_VARARGS,
      "parse_head(view, magic) -> None | -1 | (body, meta_size, meta|None)"},
+    {"scan_frames", fc_scan_frames, METH_VARARGS,
+     "scan_frames(view, magic, max_body=32768, max_frames=128) -> "
+     "(consumed, frames): cut + meta-decode every complete small fast "
+     "frame in one native pass"},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -200,6 +200,7 @@ def unpack_inline_device_arrays(msg: RpcMessage) -> List:
 class TpuStdProtocol(Protocol):
     name = "tpu_std"
     MAGIC = MAGIC          # subclass variants (hulu/sofa pbrpc) re-magic it
+    _scan_fn = False       # scan_frames resolved on first turbo_scan
 
     def frame(self, meta, payload, attachment=None, device_arrays=None,
               device_lane=False):
@@ -358,6 +359,76 @@ class TpuStdProtocol(Protocol):
             return None
         portal.pop_front(processed)
         return msgs
+
+    # --------------------------------------------------------- turbo lane
+    def turbo_scan(self, portal, socket):
+        """The native per-call loop's front half: ONE C call
+        (fastcore.cc scan_frames) cuts every complete small fast frame
+        out of the portal's contiguous head AND decodes the RpcMeta
+        subset dispatch needs — replacing the per-message
+        peek/parse_head/upb/cut span (the reference's compiled
+        ProcessNewMessage + ParseRpcMessage loop,
+        input_messenger.cpp:219-331). Returns dispatch records or None
+        (fall back to the classic path). Payload/attachment bytes are
+        sliced out before the portal pops, so read blocks recycle
+        safely."""
+        if type(self) is not TpuStdProtocol:
+            return None      # re-magic'd variants keep classic semantics
+        scan = self._scan_fn
+        if scan is False:
+            fc = _fc
+            if fc is False:
+                fc = _resolve_fc()
+            # None when the extension is missing or prebuilt-stale
+            scan = self._scan_fn = getattr(fc, "scan_frames", None)
+        if scan is None:
+            return None
+        win = portal.first_host_view()
+        if win is None or len(win) < HEADER_SIZE:
+            return None
+        consumed, frames = scan(win, MAGIC, SMALL_FRAME_MAX, 128)
+        if not frames:
+            return None
+        recs = []
+        for f in frames:
+            if f[0] == 1:
+                _, cid, ec, et, po, pl, ao, al = f
+                recs.append((1, cid, ec, et, bytes(win[po:po + pl]),
+                             bytes(win[ao:ao + al]) if al else b""))
+            else:
+                _, cid, svc, mth, lid, po, pl, ao, al = f
+                recs.append((0, cid, svc, mth, lid,
+                             bytes(win[po:po + pl]),
+                             bytes(win[ao:ao + al]) if al else b""))
+        portal.pop_front(consumed)
+        return recs
+
+    def turbo_dispatch(self, recs, socket):
+        """Dispatch turbo_scan records in parse order; returns an
+        optional pending coroutine (a classic-path fallback tail) under
+        the same contract as process()."""
+        from brpc_tpu.rpc.client_dispatch import process_response_fast
+        from brpc_tpu.rpc.server_dispatch import process_request_fast
+        server = socket.user_data.get("server")
+        pending = []
+        last = len(recs) - 1
+        for i, rec in enumerate(recs):
+            if rec[0] == 1:
+                process_response_fast(rec[1], rec[2], rec[3], rec[4],
+                                      rec[5], socket)
+            else:
+                r = process_request_fast(self, socket, server, rec[1],
+                                         rec[2], rec[3], rec[4], rec[5],
+                                         rec[6], is_last=(i == last))
+                if r is not None:
+                    pending.append(r)
+        if not pending:
+            return None
+        # same discipline as the classic loop: earlier fallbacks get
+        # fresh fibers, the last runs in place
+        for c in pending[:-1]:
+            socket._control.spawn(c, name="process_tpu_std")
+        return pending[-1]
 
     # -------------------------------------------------------------- process
     def process(self, msg: RpcMessage, socket):

@@ -19,7 +19,6 @@ from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
 from brpc_tpu.butil.flags import flag as _flag
 from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.fiber import TaskControl, global_control
-from brpc_tpu.fiber.sync import FiberEvent as _FiberEvent
 from brpc_tpu.fiber.timer import global_timer
 from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
 from brpc_tpu.protocol.tpu_std import (SMALL_FRAME_MAX, pack_message,
@@ -190,11 +189,12 @@ class Channel:
         cntl.join() (thread) / await cntl.join_async() (fiber), or pass
         ``done`` for callback style — the async CallMethod triple."""
         cntl = cntl or Controller()
-        if "_done_event" in cntl.__dict__:
+        if "_completed" in cntl.__dict__:
             cntl._reset_for_call()   # reused controller: full reset
         else:
             # fresh controller: nothing to reset — just arm completion
-            cntl.__dict__["_done_event"] = _FiberEvent()
+            # (the done event itself is lazy: created by the first
+            # joiner that arrives before completion)
             cntl.__dict__["_completed"] = False
         cntl.start_us = time.monotonic_ns() // 1000
         if cntl.timeout_ms is None:

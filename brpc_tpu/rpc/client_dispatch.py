@@ -3,9 +3,47 @@ policy/baidu_rpc_protocol.cpp:565 -> OnVersionedRPCReturned)."""
 
 from __future__ import annotations
 
+from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.protocol.tpu_std import RpcMessage, unpack_inline_device_arrays
 from brpc_tpu.rpc import errno_codes as berr
 from brpc_tpu.rpc.controller import address_call, take_call
+
+
+def process_response_fast(cid: int, err_code: int, err_text, payload: bytes,
+                          att: bytes, socket) -> None:
+    """Complete a call from scan_frames response fields — no RpcMeta
+    object, no portal cuts. The scanner guarantees no compression, no
+    stream settings, no device payloads; the error path (retry/policy
+    interplay) reuses the classic flow via a synthesized message."""
+    cntl = address_call(cid)
+    if cntl is None:
+        return  # stale: the call already completed (timeout/backup winner)
+    if err_code:
+        from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
+        meta = pb.RpcMeta()
+        meta.correlation_id = cid
+        meta.response.error_code = err_code
+        meta.response.error_text = err_text or ""
+        process_response(None, RpcMessage(meta, IOBuf(), IOBuf()), socket)
+        return
+    with cntl._arb_lock:
+        if take_call(cid) is not cntl:
+            return  # raced with timeout/backup completion
+    cntl.responded_server = socket.remote_endpoint
+    try:
+        p = IOBuf()
+        if payload:
+            p.append(payload)
+        cntl.response_payload = p
+        if cntl.response_msg is not None:
+            cntl.response_msg.ParseFromString(payload)
+        if att:
+            ab = IOBuf()
+            ab.append(att)
+            cntl.__dict__["response_attachment"] = ab
+    except Exception as e:
+        cntl.set_failed(berr.ERESPONSE, f"bad response: {e}")
+    cntl._complete()
 
 
 def process_response(proto, msg: RpcMessage, socket) -> None:

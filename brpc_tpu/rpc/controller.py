@@ -245,11 +245,14 @@ class Controller:
         LB bookkeeping resets under _lb_lock — a still-in-flight backup
         attempt from the PREVIOUS call must not interleave with the
         reset and leak its selection."""
-        self._done_event = FiberEvent()
         self.reset_error()
         self.current_try = 0
         with self._arb_lock:
             self._completed = False
+            self.__dict__.pop("_finalized", None)
+            # fresh lazy event next call: a stale one-shot event would
+            # make join() return with the previous call's payload
+            self.__dict__.pop("_done_event", None)
         # __dict__ peeks: a FRESH controller (the common case) has no
         # instance state to reset — clearing class-default fields would
         # only materialize them
@@ -299,13 +302,13 @@ class Controller:
             pass
 
     def _complete(self) -> None:
+        d = self.__dict__
         with self._arb_lock:
             self._completed = True
         self.end_us = time.monotonic_ns() // 1000
         # __dict__ peeks: lazily-created members that were never touched
         # need no completion work — don't materialize them just to find
         # them empty (this runs once per call)
-        d = self.__dict__
         tids = d.get("_timer_ids")
         if tids:
             from brpc_tpu.fiber.timer import global_timer
@@ -325,7 +328,17 @@ class Controller:
             except Exception:
                 pass
         cb = self._done_cb
-        self._done_event.set()
+        # joiners may only observe completion AFTER end_us, timer
+        # cancellation and the completion hooks above — _finalized (not
+        # _completed, which arbitration publishes first) gates the
+        # lazy-event fast path, and the done event is read under the
+        # same lock join() creates it under, so a joiner either sees
+        # _finalized or its fresh event is seen here
+        with self._arb_lock:
+            d["_finalized"] = True
+            ev = d.get("_done_event")
+        if ev is not None:
+            ev.set()
         if cb is not None:
             cb(self)
         # after the done callback, so annotations recorded there land in
@@ -392,9 +405,26 @@ class Controller:
             except AttributeError:
                 pass
 
+    def _join_event(self):
+        """Finalized -> None (nothing to wait for); else the lazily
+        created done event, under the lock _complete reads it under.
+        Gates on _finalized, not _completed: between the two, _complete
+        is still cancelling timers and running completion hooks, and a
+        joiner returning that early would read a stale end_us / race
+        the LB feedback."""
+        d = self.__dict__
+        if d.get("_finalized"):
+            return None
+        with self._arb_lock:
+            if d.get("_finalized"):
+                return None
+            return self._done_event   # lazy-created via _LAZY
+
     def join(self, timeout_s: Optional[float] = None) -> bool:
         """Block the calling thread until the call finishes."""
-        return self._done_event.wait_pthread(timeout_s)
+        ev = self._join_event()
+        return True if ev is None else ev.wait_pthread(timeout_s)
 
     async def join_async(self, timeout_s: Optional[float] = None) -> bool:
-        return await self._done_event.wait(timeout_s)
+        ev = self._join_event()
+        return True if ev is None else await ev.wait(timeout_s)

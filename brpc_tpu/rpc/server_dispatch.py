@@ -243,6 +243,145 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
         #                            about later connection deaths
 
 
+def _synth_request_msg(cid: int, service: str, method_name: str,
+                       log_id: int, payload: bytes,
+                       att: bytes) -> RpcMessage:
+    """Rebuild a classic RpcMessage from scan_frames fields (the rare
+    turbo->classic fallback: unknown method, configured auth, rpcz on)."""
+    meta = pb.RpcMeta()
+    meta.correlation_id = cid
+    meta.request.service_name = service
+    meta.request.method_name = method_name
+    if log_id:
+        meta.request.log_id = log_id
+    meta.attachment_size = len(att)
+    p = IOBuf()
+    if payload:
+        p.append(payload)
+    a = IOBuf()
+    if att:
+        a.append(att)
+    return RpcMessage(meta, p, a)
+
+
+def _server_turbo_ok(server) -> bool:
+    """Feature gate for the turbo request path, resolved once: servers
+    with auth / interceptor / session pools / pthread usercode need the
+    classic path's full semantics."""
+    ok = server.__dict__.get("_turbo_ok")
+    if ok is None:
+        from brpc_tpu.rpc.auth import resolve_server_auth
+        o = server.options
+        ok = (resolve_server_auth(o) is None
+              and getattr(o, "interceptor", None) is None
+              and getattr(server, "session_local_pool", None) is None
+              and not getattr(o, "usercode_in_pthread", False))
+        server._turbo_ok = ok
+    return ok
+
+
+async def _drive_fast(proto, socket, server, method, method_key: str,
+                      cid: int, service: str, method_name: str,
+                      log_id: int, payload: bytes, att: bytes) -> None:
+    """The turbo request body: Controller setup, handler, response —
+    the classic process_request minus every branch the scan_frames
+    eligibility rules already guarantee can't apply (no auth, no
+    interceptor, no compression, no streams, no device payloads, rpcz
+    off). Driven by ONE coro.send(None) from process_request_fast, so
+    a synchronously-completing handler touches no Fiber at all."""
+    t0 = time.monotonic_ns()
+    cntl = Controller()
+    d = cntl.__dict__
+    if log_id:
+        d["log_id"] = log_id
+    d["remote_side"] = socket.remote_endpoint
+    d["local_side"] = socket.local_endpoint
+    d["_service_name"] = service
+    d["_method_name"] = method_name
+    d["_server_socket"] = socket
+    if att:
+        ab = IOBuf()
+        ab.append(att)
+        d["request_attachment"] = ab
+    request: object = payload
+    if method.request_class is not None:
+        try:
+            request = method.request_class()
+            request.ParseFromString(payload)
+        except Exception as e:
+            server.on_request_end(method_key, 0, failed=True)
+            _send_error(proto, socket, cid, berr.EREQUEST,
+                        f"cannot parse request: {e}")
+            return
+    response = None
+    try:
+        if not method.is_coroutine and current_group() is None:
+            # blocking user code must not run on the event thread
+            # (same rule as the classic path)
+            await _HopToWorker()
+        r = method.handler(cntl, request)
+        if inspect.isawaitable(r):
+            r = await r
+        response = r
+    except Exception as e:
+        cntl.set_failed(berr.EINTERNAL, f"{type(e).__name__}: {e}")
+    server.on_request_end(method_key, (time.monotonic_ns() - t0) / 1e3,
+                          failed=cntl.failed())
+    try:
+        # _send_response's own small-frame fast path covers the
+        # plain-bytes success shape; one sender, one eligibility ladder
+        _send_response(proto, socket, cid, cntl, response)
+    finally:
+        cntl.flush_session_kv()
+        cntl._drop_cancel_subs()
+
+
+def process_request_fast(proto, socket, server, cid: int, service: str,
+                         method_name: str, log_id: int, payload: bytes,
+                         att: bytes, is_last: bool = True):
+    """Dispatch one scan_frames request record. Returns None when fully
+    handled (inline completion or adopted continuation), or a classic
+    process_request coroutine for the caller to run (fallback cases).
+
+    This is the Python half of the native per-call loop: scan_frames
+    already cut the frame and decoded the meta in C; what remains here
+    is the method lookup, the handler, and the (native) response pack —
+    the reference runs the same span compiled
+    (baidu_rpc_protocol.cpp:314 ProcessRpcRequest)."""
+    if server is None or not _server_turbo_ok(server) or \
+            flag("rpcz_enabled") or flag("rpc_dump_dir"):
+        return process_request(
+            proto, _synth_request_msg(cid, service, method_name, log_id,
+                                      payload, att), socket)
+    method = server.find_method(service, method_name)
+    if method is None:
+        has_svc = service in server.services()
+        _send_error(proto, socket, cid,
+                    berr.ENOMETHOD if has_svc else berr.ENOSERVICE,
+                    f"unknown {service}.{method_name}")
+        return None
+    if not server.on_request_start():
+        _send_error(proto, socket, cid, berr.ELIMIT,
+                    "max_concurrency reached")
+        return None
+    method_key = method.full_name or f"{service}.{method_name}"
+    coro = _drive_fast(proto, socket, server, method, method_key, cid,
+                       service, method_name, log_id, payload, att)
+    if not method.is_coroutine and not is_last:
+        # the classic loop's fan-out discipline (QueueMessage,
+        # input_messenger.cpp:183): a blocking handler for a non-last
+        # burst message gets a fresh fiber, so it can't serialize the
+        # burst behind it (async handlers stay inline — suspension is
+        # their fan-out)
+        socket._control.spawn(coro, name="turbo_req")
+    else:
+        # run_inline gives the first leg full fiber context
+        # (_tls.current for fiber-locals) and owns the depth cap /
+        # suspension parking
+        socket._control.run_inline(coro, name="turbo_req")
+    return None
+
+
 def _send_response(proto, socket, cid: int, cntl: Controller,
                    response) -> None:
     # small-call fast path: a successful tpu_std-framed response with no

@@ -45,13 +45,47 @@ class InputMessenger:
         response path, pure stream frames) touches no coroutine or
         fiber machinery at all."""
         protocols = self.protocols()
+        idx = socket.preferred_protocol
+        if 0 <= idx < len(protocols):
+            proto = protocols[idx]
+            # turbo lane: one native call cuts + meta-decodes the whole
+            # pending burst of small tpu_std frames, and the records
+            # dispatch through the slim fast paths (the native per-call
+            # loop; scan_frames in fastcore.cc)
+            ts = getattr(proto, "turbo_scan", None)
+            if ts is not None:
+                # scan the WHOLE portal before dispatching (the classic
+                # loop's discipline — dispatch decisions like "earlier
+                # messages get fresh fibers" need the full burst view);
+                # the loop matters on chunk-handoff transports (mem://)
+                # where each frame sits in its own block and one scan
+                # only sees the head block
+                all_recs = None
+                portal = socket.input_portal
+                while True:
+                    recs = ts(portal, socket)
+                    if not recs:
+                        break
+                    if all_recs is None:
+                        all_recs = recs
+                    else:
+                        all_recs.extend(recs)
+                    if not portal:
+                        break    # fully consumed: skip the empty rescan
+                if all_recs:
+                    tail = proto.turbo_dispatch(all_recs, socket)
+                    if not socket.input_portal:
+                        return tail
+                    if tail is not None:
+                        # leftover (slow) bytes still need the classic
+                        # loop below; the fallback tail becomes a fiber
+                        self._control.spawn(tail, name="process_tpu_std")
         # single-message fast path: a connection already claimed by a
         # protocol, one complete frame waiting (the overwhelmingly common
         # non-pipelined case) — parse and process directly, skipping the
         # candidate-ordering machinery below (the reference's
         # preferred_index + process-in-place discipline,
         # input_messenger.cpp:219,183)
-        idx = socket.preferred_protocol
         if 0 <= idx < len(protocols):
             proto = protocols[idx]
             status, msg = proto.parse(socket.input_portal, socket)

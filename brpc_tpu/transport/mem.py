@@ -97,6 +97,24 @@ class MemConn(Conn):
         read, no lock)."""
         return self._rx.size
 
+    def read_chunks(self):
+        """Zero-copy drain: pop every pending chunk as the exact bytes
+        objects the writer enqueued (each one a complete write, usually
+        one frame) — the socket wraps them as user-data blocks instead
+        of copying through read_into. Returns (chunks, eof)."""
+        with self._rx.lock:
+            if not self._rx.chunks:
+                return (), self._rx.closed
+            chunks = tuple(self._rx.chunks)
+            self._rx.chunks.clear()
+            freed = self._rx.size
+            self._rx.size = 0
+            was_full = freed >= _MAX_BUFFER
+        peer = self.peer
+        if was_full and peer is not None:
+            peer._notify_writable()
+        return chunks, False
+
     def write_device_payload(self, arrays) -> bool:
         """Zero-copy: hand device arrays to the peer by reference."""
         with self._tx.lock:

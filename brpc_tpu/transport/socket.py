@@ -177,6 +177,7 @@ class Socket:
         self._level_triggered = getattr(conn, "level_triggered", False)
         self._writev = getattr(conn, "writev", None)
         self._readv = getattr(conn, "read_into_v", None)
+        self._read_chunks = getattr(conn, "read_chunks", None)
         try:
             self.id: SocketId = _pool().insert(self)
         except RuntimeError:
@@ -564,6 +565,23 @@ class Socket:
         small reads shrink it back so idle connections don't hold large
         buffers — the readv-into-many-blocks effect of
         iobuf.h:469 without the iovec."""
+        rc = self._read_chunks
+        if rc is not None:
+            # zero-copy handoff (mem://): the writer's bytes objects
+            # become user-data blocks directly — no read_into copy, no
+            # block management
+            chunks, eof = rc()
+            if eof:
+                self.set_failed(ConnectionResetError("peer closed"))
+                return 0
+            total = 0
+            portal = self.input_portal
+            for c in chunks:
+                portal.append_user_data(c)
+                total += len(c)
+            if total:
+                nreads.add(total)
+            return total
         total = 0
         while not self.failed:
             hint = self._read_hint
