@@ -26,6 +26,16 @@ class LatencyRecorder(Variable):
         self._percentile.add(latency_us)
         self._count.add(1)
 
+    def record_batch(self, avg_latency_us: float, n: int):
+        """Account ``n`` calls served as one batch (the native serving
+        loop measures the batch, not each call): the average lands in
+        sum/count exactly, and contributes one percentile sample —
+        reservoir percentiles are sampled estimates either way."""
+        self._latency.record(avg_latency_us, n)
+        self._max_latency.update(avg_latency_us)
+        self._percentile.add(avg_latency_us)
+        self._count.add(n)
+
     __lshift__ = lambda self, v: (self.record(v), self)[1]
 
     def latency(self) -> float:

@@ -19,6 +19,7 @@ class TimerThread:
         self._heap: list = []          # (deadline, tid, [fn]) — fn boxed so
         #                                unschedule can drop it eagerly
         self._boxes: Dict[int, list] = {}
+        self._ndead = 0                # cancelled entries still heaped
         self._seq = itertools.count()
         self._thread: Optional[threading.Thread] = None
         self._stop = False
@@ -62,6 +63,18 @@ class TimerThread:
             box = self._boxes.pop(tid, None)
             if box is not None:
                 box[0] = None
+                self._ndead += 1
+                # compact when dead entries dominate: without this, a
+                # sync RPC stream arming+cancelling a 5s deadline per
+                # call leaves thousands of dead fronts that expire
+                # together later, and the timer thread's pop-storm
+                # preempts the serving path it was protecting (measured
+                # as p50 degrading run-over-run on one core)
+                if self._ndead > 64 and self._ndead * 2 > len(self._heap):
+                    self._heap = [e for e in self._heap
+                                  if e[2][0] is not None]
+                    heapq.heapify(self._heap)
+                    self._ndead = 0
 
     def _run(self) -> None:
         while not self._stop:
@@ -71,7 +84,10 @@ class TimerThread:
                     deadline, tid, box = heapq.heappop(self._heap)
                     self._boxes.pop(tid, None)
                     fn = box[0]
-                    if fn is not None:
+                    if fn is None:
+                        if self._ndead > 0:
+                            self._ndead -= 1
+                    else:
                         self._cond.release()
                         try:
                             fn()

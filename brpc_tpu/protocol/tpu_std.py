@@ -17,6 +17,7 @@ transports they are inlined into the attachment (inline_bytes=true).
 from __future__ import annotations
 
 import struct
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -201,6 +202,7 @@ class TpuStdProtocol(Protocol):
     name = "tpu_std"
     MAGIC = MAGIC          # subclass variants (hulu/sofa pbrpc) re-magic it
     _scan_fn = False       # scan_frames resolved on first turbo_scan
+    _serve_fn = False      # serve_scan resolved on first native_serve
 
     def frame(self, meta, payload, attachment=None, device_arrays=None,
               device_lane=False):
@@ -403,6 +405,47 @@ class TpuStdProtocol(Protocol):
         portal.pop_front(consumed)
         return recs
 
+    def native_serve(self, portal, socket) -> bool:
+        """Serve the front run of small echo-class requests entirely in
+        C (fastcore serve_scan): one native call parses, dispatches and
+        prebuilds the response frames; one socket write sends them.
+        Applies only to a server's ``native="echo"`` method under the
+        same eligibility gates as the turbo lane. Returns True when a
+        batch was served (caller loops)."""
+        server = socket.user_data.get("server")
+        if server is None:
+            return False
+        tgt = server._native_echo
+        if tgt is None or type(self) is not TpuStdProtocol:
+            return False
+        serve = self._serve_fn
+        if serve is False:
+            fcm = _fc if _fc is not False else _resolve_fc()
+            serve = self._serve_fn = getattr(fcm, "serve_scan", None)
+        if serve is None:
+            return False     # extension missing or prebuilt-stale
+        global _turbo_ok, _flag
+        if _turbo_ok is None:
+            from brpc_tpu.butil.flags import flag as _flag
+            from brpc_tpu.rpc.server_dispatch import \
+                _server_turbo_ok as _turbo_ok
+        if not _turbo_ok(server) or _flag("rpcz_enabled") \
+                or _flag("rpc_dump_dir"):
+            return False
+        win = portal.first_host_view()
+        if win is None or len(win) < HEADER_SIZE:
+            return False
+        t0 = time.monotonic_ns()
+        consumed, out, n = serve(win, MAGIC, tgt[0], tgt[1],
+                                 SMALL_FRAME_MAX)
+        if not n:
+            return False
+        portal.pop_front(consumed)
+        socket.write_small(out)
+        server.account_native_batch(tgt[2], n,
+                                    (time.monotonic_ns() - t0) / 1e3)
+        return True
+
     def turbo_dispatch(self, recs, socket):
         """Dispatch turbo_scan records in parse order; returns an
         optional pending coroutine (a classic-path fallback tail) under
@@ -453,6 +496,9 @@ class TpuStdProtocol(Protocol):
             return True
         return False
 
+
+_turbo_ok = None    # lazily bound server_dispatch._server_turbo_ok
+_flag = None        # lazily bound butil.flags.flag
 
 _instance: Optional[TpuStdProtocol] = None
 

@@ -370,6 +370,7 @@ class Channel:
             return
         cntl.remote_side = sock.remote_endpoint
         cntl.local_side = sock.local_endpoint
+        cntl._issue_socket = sock    # sync-pluck lane (Controller.join)
         # small-call fast path: the default protocol with none of the
         # optional sections (compress/trace/stream/device arrays) frames
         # from a cached meta prefix into ONE bytes object and sends it

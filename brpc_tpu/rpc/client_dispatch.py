@@ -9,6 +9,22 @@ from brpc_tpu.rpc import errno_codes as berr
 from brpc_tpu.rpc.controller import address_call, take_call
 
 
+class PayloadBytes(bytes):
+    """bytes carrying the read surface response consumers use
+    (``to_bytes``/``size``) — the fast response path hands payloads over
+    without IOBuf/Block machinery; every documented read works
+    identically (it IS bytes)."""
+
+    __slots__ = ()
+
+    def to_bytes(self) -> bytes:
+        return self
+
+    @property
+    def size(self) -> int:
+        return len(self)
+
+
 def process_response_fast(cid: int, err_code: int, err_text, payload: bytes,
                           att: bytes, socket) -> None:
     """Complete a call from scan_frames response fields — no RpcMeta
@@ -31,10 +47,7 @@ def process_response_fast(cid: int, err_code: int, err_text, payload: bytes,
             return  # raced with timeout/backup completion
     cntl.responded_server = socket.remote_endpoint
     try:
-        p = IOBuf()
-        if payload:
-            p.append(payload)
-        cntl.response_payload = p
+        cntl.response_payload = PayloadBytes(payload)
         if cntl.response_msg is not None:
             cntl.response_msg.ParseFromString(payload)
         if att:

@@ -125,6 +125,8 @@ class Controller:
     _session_local = None      # borrowed from the server's data pool
     _session_kv: Optional[dict] = None    # kvmap.h SessionKV
     _completed = False         # set under _arb_lock by _complete
+    _finalized = False         # _complete ran end-to-end (joiners gate)
+    _issue_socket = None       # socket of the current attempt (pluck lane)
 
     # mutable members, created on first touch. _lb_lock guards the
     # tried/selection handshake between a late backup attempt and the
@@ -250,6 +252,7 @@ class Controller:
         with self._arb_lock:
             self._completed = False
             self.__dict__.pop("_finalized", None)
+            self.__dict__.pop("_issue_socket", None)
             # fresh lazy event next call: a stale one-shot event would
             # make join() return with the previous call's payload
             self.__dict__.pop("_done_event", None)
@@ -327,6 +330,9 @@ class Controller:
                 hook(self)
             except Exception:
                 pass
+        # a completed call must not pin its socket (conn + portal read
+        # blocks) for the controller's lifetime
+        d.pop("_issue_socket", None)
         cb = self._done_cb
         # joiners may only observe completion AFTER end_us, timer
         # cancellation and the completion hooks above — _finalized (not
@@ -421,7 +427,28 @@ class Controller:
             return self._done_event   # lazy-created via _LAZY
 
     def join(self, timeout_s: Optional[float] = None) -> bool:
-        """Block the calling thread until the call finishes."""
+        """Block the calling thread until the call finishes.
+
+        Non-worker threads first try the sync-pluck lane: the joiner
+        adopts the issuing socket's input and processes its own
+        response in place (Socket.pluck_until) — zero cross-thread
+        wakes. Fiber workers and pluck-incapable transports fall to
+        the event wait."""
+        if self._finalized:
+            return True
+        sock = self._issue_socket
+        if sock is not None and not sock.failed:
+            from brpc_tpu.fiber.scheduler import current_group
+            if current_group() is None:
+                deadline = time.monotonic() + (
+                    timeout_s if timeout_s is not None else 86400.0)
+                try:
+                    if sock.pluck_until(lambda: self._finalized, deadline):
+                        return True
+                except Exception:
+                    pass   # pluck is an optimization, never a failure
+                if timeout_s is not None:
+                    timeout_s = max(0.0, deadline - time.monotonic())
         ev = self._join_event()
         return True if ev is None else ev.wait_pthread(timeout_s)
 

@@ -124,6 +124,7 @@ class Server:
         self._running = False
         self._stopped_event = threading.Event()
         self.method_status: Dict[str, LatencyRecorder] = {}
+        self._native_echo = None        # (svc_bytes, mth_bytes, key)
         self.concurrency = 0            # in-flight requests
         self._concurrency_lock = threading.Lock()
         self.nprocessed = 0
@@ -139,6 +140,12 @@ class Server:
         for m in service.methods.values():
             # precomputed /status key: an f-string per request adds up
             m.full_name = f"{service.name}.{m.name}"
+            if m.native_kind == "echo" and self._native_echo is None:
+                # ONE native echo target per server (the C serving loop
+                # matches a single (service, method) pair); additional
+                # echo-marked methods serve through the normal paths
+                self._native_echo = (service.name.encode(),
+                                     m.name.encode(), m.full_name)
 
     def find_method(self, service_name: str, method_name: str) -> Optional[Method]:
         svc = self._services.get(service_name)
@@ -262,6 +269,18 @@ class Server:
                 return False
             self.concurrency += 1
         return True
+
+    def account_native_batch(self, method_key: str, n: int,
+                             total_us: float) -> None:
+        """Stats for a batch the C serving loop handled (serve_scan):
+        native methods never block, so they bypass the concurrency
+        gate; processed counts and /status latency still land."""
+        with self._concurrency_lock:
+            self.nprocessed += n
+        lr = self.method_status.get(method_key)
+        if lr is None:
+            lr = self.method_status.setdefault(method_key, LatencyRecorder())
+        lr.record_batch(total_us / n, n)
 
     def on_request_end(self, method_key: str, latency_us: float, failed: bool):
         with self._concurrency_lock:

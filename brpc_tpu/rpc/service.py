@@ -25,6 +25,15 @@ class Method:
     # is measurable on the dispatch hot path
     is_coroutine: bool = False
     full_name: str = ""   # "Service.Method", set by Server.add_service
+    # native fast-serve kind. "echo" declares reflection semantics
+    # (response payload = request payload, attachment reflected), which
+    # lets the server serve small frames for this method entirely in C
+    # (fastcore serve_scan — request parse, dispatch and response pack
+    # never cross the interpreter, like the reference's compiled
+    # handlers inside in-place processing). The Python handler remains
+    # the implementation for big frames and slow-featured requests, and
+    # MUST have the same semantics.
+    native_kind: Optional[str] = None
 
 
 class Service:
@@ -34,17 +43,24 @@ class Service:
 
     def register_method(self, name: str, handler: Callable,
                         request_class: Optional[type] = None,
-                        response_class: Optional[type] = None) -> None:
+                        response_class: Optional[type] = None,
+                        native: Optional[str] = None) -> None:
+        if native is not None and native != "echo":
+            raise ValueError(f"unknown native method kind {native!r}")
         self.methods[name] = Method(
             name, handler, request_class, response_class,
-            is_coroutine=inspect.iscoroutinefunction(handler))
+            is_coroutine=inspect.iscoroutinefunction(handler),
+            native_kind=native)
 
     def method(self, name: Optional[str] = None, request_class=None,
-               response_class=None):
-        """Decorator: ``@svc.method()`` over ``def Echo(cntl, req): ...``"""
+               response_class=None, native: Optional[str] = None):
+        """Decorator: ``@svc.method()`` over ``def Echo(cntl, req): ...``
+
+        ``native="echo"`` additionally declares the method as a
+        reflection echo the server may serve natively (see Method)."""
         def deco(fn):
             self.register_method(name or fn.__name__, fn, request_class,
-                                 response_class)
+                                 response_class, native=native)
             return fn
         return deco
 

@@ -29,6 +29,13 @@ class EventDispatcher:
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._name = name
+        # epoll interest changes take effect while another thread sits
+        # in epoll_wait — pause/resume need no wakeup-pipe kick there
+        # (one write + one dispatcher wake per call otherwise; the
+        # pluck lane pays that pair per sync RPC). Select/poll-backed
+        # selectors snapshot their fd set per call and DO need the kick.
+        self._rearm_needs_wakeup = not isinstance(
+            self._selector, getattr(selectors, "EpollSelector", ()))
 
     def _ensure_thread(self):
         if self._thread is None or not self._thread.is_alive():
@@ -85,7 +92,8 @@ class EventDispatcher:
                     self._selector.unregister(fd)
             except (KeyError, ValueError, OSError):
                 pass
-        self._wakeup()
+        if self._rearm_needs_wakeup:
+            self._wakeup()
 
     def resume_read(self, fd: int) -> None:
         """Re-arm read interest after a one-shot read fire (safe to call
@@ -103,7 +111,8 @@ class EventDispatcher:
                     self._selector.register(fd, mask, fd)
                 except (KeyError, ValueError, OSError):
                     return
-        self._wakeup()
+        if self._rearm_needs_wakeup:
+            self._wakeup()
 
     def request_writable(self, fd: int, on_writable: Callable[[], None]) -> None:
         """One-shot write-readiness callback (the epollout dance the

@@ -62,7 +62,14 @@ class InputMessenger:
                 # only sees the head block
                 all_recs = None
                 portal = socket.input_portal
+                nserve = getattr(proto, "native_serve", None)
                 while True:
+                    # echo-class front runs serve entirely in C (one
+                    # scan+pack call, one write)
+                    if nserve is not None and nserve(portal, socket):
+                        if not portal:
+                            break
+                        continue
                     recs = ts(portal, socket)
                     if not recs:
                         break

@@ -21,6 +21,7 @@ runtime:
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Callable, Optional
 
@@ -158,6 +159,7 @@ class Socket:
         self._writable_butex = Butex(0)
         self._nevent = 0                          # edge-trigger input counter
         self._nevent_lock = threading.Lock()
+        self._plucking = False       # a sync joiner owns input processing
         self._busy_rearmed = False   # one probe re-arm per busy period
         self._busy_paused = False    # level-trigger: read interest paused
         self._read_hint = 8192                    # adaptive read-block size
@@ -244,7 +246,19 @@ class Socket:
             if isinstance(data, IOBuf):
                 self._cut_buf(data)
                 return None, (data if data else None)
-            mv = memoryview(data)
+            # whole-frame send first: the common small frame leaves in
+            # one syscall with no memoryview/loop machinery
+            try:
+                n = self.conn.write(data) or 0
+            except BlockingIOError:
+                # fully blocked: don't pay a second guaranteed-EAGAIN
+                # send — park the whole frame
+                buf = IOBuf()
+                buf.append(bytes(data))
+                return None, buf
+            if n == len(data):
+                return None, None
+            mv = memoryview(data)[n:]
             while mv:
                 try:
                     n = self.conn.write(mv)
@@ -410,10 +424,9 @@ class Socket:
         0->1 transition starts a processing fiber."""
         with self._nevent_lock:
             self._nevent += 1
-            if self._nevent > 1:
-                busy = True
-            else:
-                busy = False
+            # a plucking joiner owns the input: events defer to it
+            # exactly like a busy processing pass
+            busy = self._nevent > 1 or self._plucking
         if not busy:
             if self._inline_process:
                 if self._on_input_sync is not None:
@@ -498,10 +511,13 @@ class Socket:
             if self._nevent > 0:
                 return True
             self._busy_rearmed = False   # busy period over
-            if self._busy_paused:
+            if self._busy_paused and not self._plucking:
                 # paired with the pause in _on_readable_event: both run
                 # under the lock so the paused flag always matches the
-                # fd's read-interest state
+                # fd's read-interest state. While a plucker owns the fd
+                # the pause STAYS (resuming here would reinstate the
+                # per-message dispatcher wakes the claim-time pause
+                # removed); the pluck exit path restores read interest.
                 self._busy_paused = False
                 if not self.failed:
                     try:
@@ -509,6 +525,102 @@ class Socket:
                     except Exception:
                         pass
         return False
+
+    def pluck_until(self, pred, deadline_s: float) -> bool:
+        """Sync-pluck lane: a joining (non-worker) thread adopts this
+        socket's input processing until ``pred()`` or the deadline — the
+        caller waiting for its response drives the connection itself,
+        paying zero cross-thread wakes and no dispatcher round trip per
+        message (the pthread analog of the reference's in-place bthread
+        processing; gRPC core's completion-queue pluck is the same
+        idea). Claims the socket only when no processing pass is in
+        flight; for the duration, dispatcher events defer to the
+        plucker (``_plucking`` reads as busy), and leftovers are
+        settled through the normal machinery on exit. Returns pred()."""
+        pfd = getattr(self.conn, "pluck_fd", None)
+        if pfd is None or self._on_input_sync is None or self.failed:
+            return pred()
+        try:
+            fd = pfd()
+        except OSError:
+            return pred()
+        with self._nevent_lock:
+            if self._nevent > 0 or self._plucking:
+                return pred()   # processing in flight: use the event path
+            self._plucking = True
+            # park the dispatcher for the duration: without this every
+            # response fires a level-triggered event whose busy-path
+            # probe (MSG_PEEK + pause dance) runs per message on the
+            # dispatcher thread while the plucker owns the data
+            if self._level_triggered and not self._busy_paused:
+                self._busy_paused = True
+                try:
+                    self.conn.pause_read_events()
+                except Exception:
+                    self._busy_paused = False
+        import select
+        poller = select.poll()
+        poller.register(fd, select.POLLIN | select.POLLHUP | select.POLLERR)
+        escalated = False
+        try:
+            while not pred() and not self.failed:
+                remaining = deadline_s - time.monotonic()
+                if remaining <= 0:
+                    break
+                # short slices: pred() can flip without fd traffic
+                # (timeout timer, another thread completing the call)
+                if not poller.poll(min(remaining, 0.2) * 1000):
+                    continue
+                with self._nevent_lock:
+                    pending = self._nevent
+                self._drain_readable()
+                if self.input_portal or self.failed:
+                    r = None
+                    try:
+                        r = self._on_input_sync(self)
+                    except BaseException as e:
+                        self._input_error(e)
+                    if r is not None:
+                        # a message's processing suspended (not a
+                        # response shape): hand the cycle — including
+                        # the pending-event accounting — back to the
+                        # normal machinery and stop plucking. The extra
+                        # _nevent keeps the busy invariant (>=1 through
+                        # the handoff): with pending==0 a dispatcher
+                        # event in this window would otherwise start a
+                        # CONCURRENT processing pass against the same
+                        # portal as the escalated tail
+                        escalated = True
+                        with self._nevent_lock:
+                            self._nevent += 1
+                            self._plucking = False
+                        self._control.run_inline(
+                            self._input_async_tail(r, pending + 1),
+                            name="socket_input")
+                        break
+                if pending:
+                    self._finish_input_cycle(pending)
+        finally:
+            if not escalated:
+                with self._nevent_lock:
+                    self._plucking = False
+                    leftover = self._nevent > 0
+                    if self._busy_paused and not leftover:
+                        # no settle pass will run _finish_input_cycle:
+                        # restore read interest here (same lock as the
+                        # pause, so flag and fd state never disagree)
+                        self._busy_paused = False
+                        if not self.failed:
+                            try:
+                                self.conn.resume_read_events()
+                            except Exception:
+                                pass
+                if leftover and not self.failed:
+                    # deferred events we didn't settle: one normal pass
+                    # balances the accounting and the pause/resume
+                    # protocol (its finish cycle restores read interest)
+                    self._process_input_entry()
+        return pred()
 
     def _process_input_entry(self) -> None:
         """Sync processing loop (no coroutine, no Fiber); when a

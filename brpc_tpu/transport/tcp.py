@@ -100,6 +100,13 @@ class TcpConn(Conn):
     # read-interest syscalls from per-message to per-busy-period.
     level_triggered = True
 
+    def pluck_fd(self) -> int:
+        """fd for the sync-pluck lane (Socket.pluck_until): a joining
+        thread may poll+drain this conn directly. Only plain TCP offers
+        it — SSL buffers decrypted bytes above the fd (a poll would
+        miss them) and mem/ici have no fd."""
+        return self._sock.fileno()
+
     def peek_closed(self) -> bool:
         """Non-consuming liveness probe (MSG_PEEK): True only when the
         peer's FIN has arrived AND no data remains to deliver — pending

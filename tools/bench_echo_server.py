@@ -27,12 +27,15 @@ def main() -> None:
     server = Server(ServerOptions(enable_builtin_services=False))
     svc = Service("Bench")
 
-    @svc.method()
+    @svc.method(native="echo")
     async def Echo(cntl, request):
         # attachment blocks flow back out unjoined (zero-copy, the
         # reference's rdma_performance echo shape: payload rides the
         # attachment, example/rdma_performance/client.cpp); the byte
-        # payload echoes through serialize_payload's pass-through
+        # payload echoes through serialize_payload's pass-through.
+        # native="echo": small frames serve through the C loop
+        # (serve_scan) with these exact reflection semantics — this
+        # handler covers big frames and slow-featured requests
         if cntl.request_attachment.size:
             cntl.response_attachment = cntl.request_attachment
         return request
