@@ -146,6 +146,19 @@ def _serve(handler_kind="async"):
     return server, name
 
 
+@pytest.fixture(autouse=True)
+def _native_lane_flags():
+    """The turbo/native lanes gate on process-wide flags another test
+    may have flipped (rpcz, rpc_dump): pin them off, restore after."""
+    from brpc_tpu.butil.flags import flag, set_flag
+    saved = {n: flag(n) for n in ("rpcz_enabled", "rpc_dump_dir")}
+    set_flag("rpcz_enabled", False)
+    set_flag("rpc_dump_dir", "")
+    yield
+    for n, v in saved.items():
+        set_flag(n, v)
+
+
 class TestTurboDispatch:
     def test_echo_and_attachment_via_turbo(self):
         server, name = _serve()
