@@ -8,6 +8,7 @@ the /flags builtin page lists and mutates them.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -34,6 +35,17 @@ def define_flag(name: str, default: Any, help_: str = "",
         if name in _flags:
             raise ValueError(f"flag {name!r} already defined")
         _flags[name] = _Flag(name, default, help_, validator)
+    # environment override at definition (the reference gets this from
+    # gflags' --flag=... argv; subprocess tooling needs the env form):
+    # BRPC_TPU_FLAG_<NAME>=value, parsed with set_flag's type rules
+    env = os.environ.get(f"BRPC_TPU_FLAG_{name.upper()}")
+    if env is not None and not set_flag(name, env):
+        # a silently-dropped override would leave the operator running
+        # defaults while believing the env applied
+        import logging
+        logging.getLogger("brpc_tpu.flags").warning(
+            "env override BRPC_TPU_FLAG_%s=%r rejected (bad value or "
+            "validator); keeping default %r", name.upper(), env, default)
 
 
 def flag(name: str) -> Any:
@@ -83,6 +95,10 @@ define_flag("rpcz_enabled", False,
             "creation + trace propagation cost sits on every call)")
 define_flag("rpcz_max_spans", 1024, "span ring-buffer capacity",
             validator=lambda v: v >= 16)
+define_flag("tpu_std_cut_through", True,
+            "stream large native-echo frames through the server without "
+            "assembly (response header leaves when the request meta "
+            "parses; body forwards as it arrives)")
 define_flag("tpu_std_batch_parse", False,
             "cut pipelined tpu_std bursts with the native frame scanner "
             "(measured ~parity with the per-frame path under CPython; "

@@ -256,6 +256,8 @@ class TpuStdProtocol(Protocol):
                     f"frame body {body_size} exceeds max_body_size"))
                 return PARSE_NOT_ENOUGH_DATA, None
         if portal.size < HEADER_SIZE + body_size:
+            # let the input loop skip re-probing until the frame is here
+            socket.input_need = HEADER_SIZE + body_size
             return PARSE_NOT_ENOUGH_DATA, None
         meta = pb.RpcMeta()
         if meta_bytes is not None:
@@ -446,6 +448,119 @@ class TpuStdProtocol(Protocol):
                                     (time.monotonic_ns() - t0) / 1e3)
         return True
 
+    # ------------------------------------------------------- cut-through
+    def try_cut_through(self, portal, socket) -> bool:
+        """Large-frame echo serving without assembly: when the portal's
+        front is a (possibly partial) LARGE request frame addressed to
+        the server's ``native="echo"`` method, the response header+meta
+        go out as soon as the request meta parses, and the body forwards
+        chunk-by-chunk as it arrives — zero-copy ref moves, every block
+        still cache-hot when it leaves (the store-and-forward assembly
+        an RPC server normally pays is what separates the raw
+        stream-echo ceiling from the raw message-echo ceiling on this
+        box). Classic cut-through switching; the reference's RDMA path
+        gets the same effect from SGEs posted per block
+        (rdma_endpoint.h:82 CutFromIOBufList).
+
+        Frame-safety gate: only while NO other response can interleave
+        (pending_responses == 0, no streams bound, write path idle
+        frame-wise is guaranteed because responses and this forward all
+        run in the input context). Returns True when cut-through mode
+        was entered (state lives on the socket; the input loop forwards
+        until drained)."""
+        server = socket.user_data.get("server")
+        if server is None:
+            return False
+        tgt = server._native_echo
+        if tgt is None or type(self) is not TpuStdProtocol:
+            return False
+        if socket.pending_responses != 0 or \
+                socket.user_data.get("has_streams"):
+            return False
+        global _turbo_ok, _flag
+        if _turbo_ok is None:
+            from brpc_tpu.butil.flags import flag as _flag
+            from brpc_tpu.rpc.server_dispatch import \
+                _server_turbo_ok as _turbo_ok
+        if not _turbo_ok(server) or _flag("rpcz_enabled") \
+                or _flag("rpc_dump_dir") \
+                or not _flag("tpu_std_cut_through"):
+            return False
+        if portal.size < HEADER_SIZE:
+            return False
+        magic, body_size, meta_size = _HDR.unpack(
+            portal.peek_bytes(HEADER_SIZE))
+        if magic != MAGIC or meta_size > body_size:
+            return False
+        if body_size <= SMALL_FRAME_MAX:
+            return False         # small frames: serve_scan territory
+        if body_size > 16 << 20:
+            from brpc_tpu.butil.flags import flag as _f
+            if body_size > _f("max_body_size"):
+                return False     # classic path rejects it
+        if portal.size < HEADER_SIZE + meta_size:
+            return False         # wait for the full meta
+        meta = pb.RpcMeta()
+        try:
+            meta.ParseFromString(
+                portal.peek_bytes(HEADER_SIZE + meta_size)[HEADER_SIZE:])
+        except Exception:
+            return False
+        req = meta.request
+        if not meta.HasField("request") or meta.HasField("response") \
+                or meta.HasField("stream_settings") or meta.device_payloads \
+                or meta.compress_type or meta.trace_id \
+                or req.auth_token \
+                or req.service_name.encode() != tgt[0] \
+                or req.method_name.encode() != tgt[1]:
+            return False
+        att = meta.attachment_size
+        pa_len = body_size - meta_size           # payload + attachment
+        if att < 0 or att > pa_len:
+            return False         # lying size: classic path fails it
+        # response header+meta: fully determined by the request meta
+        resp_meta = (_TAG_CORRELATION_ID.to_bytes()
+                     + _varint(meta.correlation_id))
+        if att:
+            resp_meta += _TAG_ATTACHMENT_SIZE.to_bytes() + _varint(att)
+        portal.pop_front(HEADER_SIZE + meta_size)
+        state = {"remaining": pa_len, "key": tgt[2],
+                 "t0": time.monotonic_ns(), "server": server}
+        socket.user_data["_cut_forward"] = state
+        # header + already-arrived body leave in ONE write (a separate
+        # header write is its own packet under TCP_NODELAY — an extra
+        # syscall here and an extra wakeup on the client)
+        head = _HDR.pack(MAGIC, len(resp_meta) + pa_len,
+                         len(resp_meta)) + resp_meta
+        self.cut_forward(portal, socket, state, prefix=head)
+        return True
+
+    def cut_forward(self, portal, socket, state, prefix=b"") -> bool:
+        """Forward arrived body bytes out the response; True when the
+        frame completed (mode exits)."""
+        n = state["remaining"]
+        if portal.size < n:
+            n = portal.size
+        if n or prefix:
+            if n:
+                out = portal.cut(n)              # zero-copy ref move
+                if prefix:
+                    head = IOBuf()
+                    head.append(prefix)
+                    head.append_buf(out)
+                    out = head
+            else:
+                out = prefix
+            socket.write(out)
+            state["remaining"] -= n
+        if state["remaining"] == 0:
+            socket.user_data["_cut_forward"] = None
+            state["server"].account_native_batch(
+                state["key"], 1,
+                (time.monotonic_ns() - state["t0"]) / 1e3)
+            return True
+        return False
+
     def turbo_dispatch(self, recs, socket):
         """Dispatch turbo_scan records in parse order; returns an
         optional pending coroutine (a classic-path fallback tail) under
@@ -468,9 +583,12 @@ class TpuStdProtocol(Protocol):
         if not pending:
             return None
         # same discipline as the classic loop: earlier fallbacks get
-        # fresh fibers, the last runs in place
+        # fresh fibers (under a pending_responses claim, so the
+        # cut-through gate sees them before the fiber starts), the
+        # last runs in place
+        from brpc_tpu.transport.input_messenger import counted_spawn
         for c in pending[:-1]:
-            socket._control.spawn(c, name="process_tpu_std")
+            counted_spawn(socket._control, socket, c, "process_tpu_std")
         return pending[-1]
 
     # -------------------------------------------------------------- process

@@ -21,8 +21,15 @@ from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.fiber import TaskControl, global_control
 from brpc_tpu.fiber.timer import global_timer
 from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
-from brpc_tpu.protocol.tpu_std import (SMALL_FRAME_MAX, pack_message,
-                                       pack_small_frame, serialize_payload)
+from brpc_tpu.protocol.tpu_std import (_HDR as _TPU_HDR, MAGIC as _TPU_MAGIC,
+                                       SMALL_FRAME_MAX,
+                                       _TAG_ATTACHMENT_SIZE,
+                                       _TAG_CORRELATION_ID, _varint,
+                                       pack_message, pack_small_frame,
+                                       serialize_payload)
+
+_TAG_CORRELATION_ID_B = _TAG_CORRELATION_ID.to_bytes()
+_TAG_ATTACHMENT_SIZE_B = _TAG_ATTACHMENT_SIZE.to_bytes()
 from brpc_tpu.rpc import errno_codes as berr
 from brpc_tpu.rpc.controller import Controller, address_call, take_call
 from brpc_tpu.transport.input_messenger import InputMessenger
@@ -382,9 +389,7 @@ class Channel:
                 and not cntl.compress_type and not cntl.trace_id \
                 and cntl.stream is None \
                 and not cntl.__dict__.get("request_device_arrays") \
-                and cntl.log_id == 0 \
-                and len(cntl._request_bytes) + (att.size if att else 0) \
-                <= SMALL_FRAME_MAX:
+                and cntl.log_id == 0:
             key = (cntl._service_name, cntl._method_name, cntl.timeout_ms,
                    cntl.auth_token)
             prefix = self._meta_prefix_cache.get(key)
@@ -399,12 +404,29 @@ class Channel:
                 prefix = m.SerializeToString()
                 if len(self._meta_prefix_cache) < 4096:
                     self._meta_prefix_cache[key] = prefix
-            wire = pack_small_frame(prefix, cntl.correlation_id,
-                                    cntl._request_bytes,
-                                    att.to_bytes() if att else b"")
+            att_size = att.size if att else 0
+            if len(cntl._request_bytes) + att_size <= SMALL_FRAME_MAX:
+                # one-allocation C pack, single bytes frame
+                wire = pack_small_frame(prefix, cntl.correlation_id,
+                                        cntl._request_bytes,
+                                        att.to_bytes() if att else b"")
+            else:
+                # large attachment: same cached-prefix meta (no pb build
+                # per call), attachment rides as zero-copy refs behind
+                # one contiguous header+meta+payload block
+                meta_bytes = (prefix + _TAG_CORRELATION_ID_B
+                              + _varint(cntl.correlation_id))
+                if att_size:
+                    meta_bytes += _TAG_ATTACHMENT_SIZE_B + _varint(att_size)
+                body = len(meta_bytes) + len(cntl._request_bytes) + att_size
+                wire = IOBuf()
+                wire.append(_TPU_HDR.pack(_TPU_MAGIC, body, len(meta_bytes))
+                            + meta_bytes + cntl._request_bytes)
+                if att_size:
+                    wire.append_buf(att)
             try:
-                sock.write_small(wire, on_done=lambda err, s=sock:
-                                 self._on_write_done(cntl, err, s))
+                sock.write(wire, on_done=lambda err, s=sock:
+                           self._on_write_done(cntl, err, s))
             except (BlockingIOError, ConnectionError, OSError) as e:
                 self._maybe_retry(cntl, berr.EFAILEDSOCKET, str(e),
                                   failed_ep=sock.remote_endpoint)

@@ -56,6 +56,8 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     server = socket.user_data.get("server")
     meta = msg.meta
     cid = meta.correlation_id
+    with socket.pending_lock:
+        socket.pending_responses += 1   # settled by _send_response
     if server is None:
         _send_error(proto, socket, cid, berr.EINTERNAL, "no server bound to socket")
         return
@@ -353,6 +355,8 @@ def process_request_fast(proto, socket, server, cid: int, service: str,
         return process_request(
             proto, _synth_request_msg(cid, service, method_name, log_id,
                                       payload, att), socket)
+    with socket.pending_lock:
+        socket.pending_responses += 1   # settled by _send_response
     method = server.find_method(service, method_name)
     if method is None:
         has_svc = service in server.services()
@@ -384,6 +388,20 @@ def process_request_fast(proto, socket, server, cid: int, service: str,
 
 def _send_response(proto, socket, cid: int, cntl: Controller,
                    response) -> None:
+    try:
+        _send_response_inner(proto, socket, cid, cntl, response)
+    finally:
+        # the dispatch entry's pending_responses claim settles here —
+        # EVERY dispatched request sends exactly one response through
+        # this choke point (errors included), and the cut-through gate
+        # reads the counter
+        with socket.pending_lock:
+            if socket.pending_responses > 0:
+                socket.pending_responses -= 1
+
+
+def _send_response_inner(proto, socket, cid: int, cntl: Controller,
+                         response) -> None:
     # small-call fast path: a successful tpu_std-framed response with no
     # stream/device/progressive sections needs only correlation_id (+
     # attachment_size) in its meta — hand-encoded varints over a single

@@ -194,6 +194,9 @@ class Stream:
         # send path plain-assigns self.socket before establishment, so
         # comparing against it would skip the subscription entirely
         prev = getattr(self, "_subscribed_sock", None)
+        # streams write frames independently of the response path: the
+        # cut-through serving gate must know this socket can interleave
+        sock.user_data["has_streams"] = True
         if prev is sock:
             self.socket = sock
             return

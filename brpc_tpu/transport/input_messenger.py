@@ -21,6 +21,30 @@ from brpc_tpu.protocol.registry import PARSE_OK, PARSE_NOT_ENOUGH_DATA, PARSE_TR
 from brpc_tpu.transport.socket import Socket
 
 
+async def _counted_dispatch(socket, work):
+    """Run a queued message's processing with the socket's
+    pending_responses claimed for its WHOLE lifetime — a spawned
+    request that hasn't started yet must already be visible to the
+    cut-through gate, or its response could interleave mid-stream."""
+    try:
+        r = work() if callable(work) else work
+        if hasattr(r, "__await__"):
+            await r
+    finally:
+        with socket.pending_lock:
+            if socket.pending_responses > 0:
+                socket.pending_responses -= 1
+
+
+def counted_spawn(control, socket, work, name: str) -> None:
+    """Spawn queued-message processing under a pending_responses claim
+    (claimed HERE, at queue time, not at coroutine start). ``work`` is
+    a zero-arg callable or an awaitable."""
+    with socket.pending_lock:
+        socket.pending_responses += 1
+    control.spawn(_counted_dispatch(socket, work), name=name)
+
+
 class InputMessenger:
     def __init__(self, protocols: Optional[List] = None,
                  control: Optional[TaskControl] = None):
@@ -45,6 +69,15 @@ class InputMessenger:
         response path, pure stream frames) touches no coroutine or
         fiber machinery at all."""
         protocols = self.protocols()
+        # mid-frame short-circuit: the previous cycle's parse told us
+        # how many bytes the frame needs — until they're here, nothing
+        # below can make progress (input_messenger.cpp keeps the same
+        # cut-size memo between reads)
+        need = socket.input_need
+        if need:
+            if socket.input_portal.size < need:
+                return None
+            socket.input_need = 0
         idx = socket.preferred_protocol
         if 0 <= idx < len(protocols):
             proto = protocols[idx]
@@ -54,6 +87,13 @@ class InputMessenger:
             # loop; scan_frames in fastcore.cc)
             ts = getattr(proto, "turbo_scan", None)
             if ts is not None:
+                portal = socket.input_portal
+                # a large-frame echo in flight: forward the newly
+                # arrived body bytes first (cut-through serving)
+                cut = socket.user_data.get("_cut_forward")
+                if cut is not None:
+                    if not proto.cut_forward(portal, socket, cut):
+                        return None          # mid-frame: await more bytes
                 # scan the WHOLE portal before dispatching (the classic
                 # loop's discipline — dispatch decisions like "earlier
                 # messages get fresh fibers" need the full burst view);
@@ -61,13 +101,23 @@ class InputMessenger:
                 # where each frame sits in its own block and one scan
                 # only sees the head block
                 all_recs = None
-                portal = socket.input_portal
                 nserve = getattr(proto, "native_serve", None)
+                ncut = getattr(proto, "try_cut_through", None)
+                mid_frame = False
                 while True:
                     # echo-class front runs serve entirely in C (one
                     # scan+pack call, one write)
                     if nserve is not None and nserve(portal, socket):
                         if not portal:
+                            break
+                        continue
+                    # large echo frames stream through without assembly
+                    # — only when no undispatched requests sit ahead
+                    # (their responses must leave first)
+                    if ncut is not None and all_recs is None and \
+                            ncut(portal, socket):
+                        if socket.user_data.get("_cut_forward") is not None:
+                            mid_frame = True
                             break
                         continue
                     recs = ts(portal, socket)
@@ -79,6 +129,8 @@ class InputMessenger:
                         all_recs.extend(recs)
                     if not portal:
                         break    # fully consumed: skip the empty rescan
+                if mid_frame:
+                    return None
                 if all_recs:
                     tail = proto.turbo_dispatch(all_recs, socket)
                     if not socket.input_portal:
@@ -86,7 +138,8 @@ class InputMessenger:
                     if tail is not None:
                         # leftover (slow) bytes still need the classic
                         # loop below; the fallback tail becomes a fiber
-                        self._control.spawn(tail, name="process_tpu_std")
+                        counted_spawn(self._control, socket, tail,
+                                      "process_tpu_std")
         # single-message fast path: a connection already claimed by a
         # protocol, one complete frame waiting (the overwhelmingly common
         # non-pipelined case) — parse and process directly, skipping the
@@ -167,9 +220,12 @@ class InputMessenger:
         if not msgs:
             return None
         # earlier messages -> fresh fibers; last one processed in place
+        # (queued under a pending_responses claim so the cut-through
+        # gate sees them before their fibers start)
         for proto, msg in msgs[:-1]:
-            self._control.spawn(proto.process, msg, socket,
-                                name=f"process_{proto.name}")
+            counted_spawn(self._control, socket,
+                          (lambda p=proto, m=msg: p.process(m, socket)),
+                          name=f"process_{proto.name}")
         proto, msg = msgs[-1]
         r = proto.process(msg, socket)
         if hasattr(r, "__await__"):

@@ -160,10 +160,19 @@ class Socket:
         self._nevent = 0                          # edge-trigger input counter
         self._nevent_lock = threading.Lock()
         self._plucking = False       # a sync joiner owns input processing
+        # dispatched requests whose response hasn't been written yet —
+        # the cut-through gate: streaming a response in pieces is only
+        # frame-safe while no other response can interleave
+        self.pending_responses = 0
+        self.pending_lock = threading.Lock()
         self._busy_rearmed = False   # one probe re-arm per busy period
         self._busy_paused = False    # level-trigger: read interest paused
         self._read_hint = 8192                    # adaptive read-block size
         self.preferred_protocol = -1              # InputMessenger cache
+        # protocol hint: total portal bytes needed before the next parse
+        # can succeed (a 1MB frame arrives in ~5 drain cycles; without
+        # this each cycle re-probes header/meta just to learn "not yet")
+        self.input_need = 0
         self.user_data: dict = {}                 # per-conn session state
         # pairs a device-lane batch with its wire frame: concurrent
         # device-payload writers must not interleave (lane batches are
