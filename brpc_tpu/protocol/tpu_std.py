@@ -475,7 +475,7 @@ class TpuStdProtocol(Protocol):
         if tgt is None or type(self) is not TpuStdProtocol:
             return False
         if socket.pending_responses != 0 or \
-                socket.user_data.get("has_streams"):
+                socket.user_data.get("bound_streams"):
             return False
         global _turbo_ok, _flag
         if _turbo_ok is None:

@@ -52,12 +52,45 @@ class _HopToWorker(SchedAwaitable):
         fiber.control.schedule(fiber, None)
 
 
+def _track_pending(socket) -> bool:
+    """Whether this socket maintains the pending_responses gate at all:
+    only sockets serving a native-echo-capable server can ever enter
+    cut-through, so everyone else skips the per-request lock pair."""
+    t = socket.__dict__.get("_tracks_pending")
+    if t is None:
+        server = socket.user_data.get("server")
+        t = server is not None and server._native_echo is not None
+        socket._tracks_pending = t
+    return t
+
+
+def _settle_pending(socket) -> None:
+    with socket.pending_lock:
+        if socket.pending_responses > 0:
+            socket.pending_responses -= 1
+
+
 async def process_request(proto, msg: RpcMessage, socket) -> None:
+    if not _track_pending(socket):
+        await _process_request_inner(proto, msg, socket)
+        return
+    with socket.pending_lock:
+        socket.pending_responses += 1   # settled by _send_response
+    try:
+        await _process_request_inner(proto, msg, socket)
+    except BaseException:
+        # _send_response settles on every normal path; an escaping
+        # exception means no response was sent for this claim — a
+        # leaked claim would disable cut-through on this connection
+        # forever
+        _settle_pending(socket)
+        raise
+
+
+async def _process_request_inner(proto, msg: RpcMessage, socket) -> None:
     server = socket.user_data.get("server")
     meta = msg.meta
     cid = meta.correlation_id
-    with socket.pending_lock:
-        socket.pending_responses += 1   # settled by _send_response
     if server is None:
         _send_error(proto, socket, cid, berr.EINTERNAL, "no server bound to socket")
         return
@@ -291,6 +324,21 @@ async def _drive_fast(proto, socket, server, method, method_key: str,
     interceptor, no compression, no streams, no device payloads, rpcz
     off). Driven by ONE coro.send(None) from process_request_fast, so
     a synchronously-completing handler touches no Fiber at all."""
+    try:
+        await _drive_fast_inner(proto, socket, server, method, method_key,
+                                cid, service, method_name, log_id, payload,
+                                att)
+    except BaseException:
+        # the dispatch claim must not leak on an escaping exception
+        # (see process_request's twin guard)
+        if _track_pending(socket):
+            _settle_pending(socket)
+        raise
+
+
+async def _drive_fast_inner(proto, socket, server, method, method_key: str,
+                            cid: int, service: str, method_name: str,
+                            log_id: int, payload: bytes, att: bytes) -> None:
     t0 = time.monotonic_ns()
     cntl = Controller()
     d = cntl.__dict__
@@ -355,8 +403,10 @@ def process_request_fast(proto, socket, server, cid: int, service: str,
         return process_request(
             proto, _synth_request_msg(cid, service, method_name, log_id,
                                       payload, att), socket)
-    with socket.pending_lock:
-        socket.pending_responses += 1   # settled by _send_response
+    track = _track_pending(socket)
+    if track:
+        with socket.pending_lock:
+            socket.pending_responses += 1   # settled by _send_response
     method = server.find_method(service, method_name)
     if method is None:
         has_svc = service in server.services()
@@ -388,6 +438,9 @@ def process_request_fast(proto, socket, server, cid: int, service: str,
 
 def _send_response(proto, socket, cid: int, cntl: Controller,
                    response) -> None:
+    if not _track_pending(socket):
+        _send_response_inner(proto, socket, cid, cntl, response)
+        return
     try:
         _send_response_inner(proto, socket, cid, cntl, response)
     finally:
@@ -395,9 +448,7 @@ def _send_response(proto, socket, cid: int, cntl: Controller,
         # EVERY dispatched request sends exactly one response through
         # this choke point (errors included), and the cut-through gate
         # reads the counter
-        with socket.pending_lock:
-            if socket.pending_responses > 0:
-                socket.pending_responses -= 1
+        _settle_pending(socket)
 
 
 def _send_response_inner(proto, socket, cid: int, cntl: Controller,

@@ -35,6 +35,13 @@ DEFAULT_CREDITS = 64
 CREDIT_BATCH = 16  # grant credits back every K delivered frames
 
 
+def _release_stream_slot(sock) -> None:
+    """Undo one bind_socket count (stream closed or rebound away)."""
+    n = sock.user_data.get("bound_streams", 0)
+    if n > 0:
+        sock.user_data["bound_streams"] = n - 1
+
+
 class StreamOptions:
     def __init__(self, on_received: Optional[Callable] = None,
                  initial_credits: int = DEFAULT_CREDITS):
@@ -195,8 +202,14 @@ class Stream:
         # comparing against it would skip the subscription entirely
         prev = getattr(self, "_subscribed_sock", None)
         # streams write frames independently of the response path: the
-        # cut-through serving gate must know this socket can interleave
-        sock.user_data["has_streams"] = True
+        # cut-through serving gate must know this socket can interleave.
+        # Counted per bound stream and released on close/unbind, so a
+        # connection that once carried a stream isn't degraded forever.
+        if prev is not sock:
+            sock.user_data["bound_streams"] = \
+                sock.user_data.get("bound_streams", 0) + 1
+            if prev is not None:
+                _release_stream_slot(prev)
         if prev is sock:
             self.socket = sock
             return
@@ -249,6 +262,7 @@ class Stream:
         sub = getattr(self, "_subscribed_sock", None)
         if sub is not None:
             self._subscribed_sock = None
+            _release_stream_slot(sub)
             try:
                 sub.off_failed(self._on_socket_failed)
             except AttributeError:

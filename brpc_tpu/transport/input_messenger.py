@@ -39,7 +39,12 @@ async def _counted_dispatch(socket, work):
 def counted_spawn(control, socket, work, name: str) -> None:
     """Spawn queued-message processing under a pending_responses claim
     (claimed HERE, at queue time, not at coroutine start). ``work`` is
-    a zero-arg callable or an awaitable."""
+    a zero-arg callable or an awaitable. Sockets that can never enter
+    cut-through (no native-echo server) skip the claim entirely."""
+    from brpc_tpu.rpc.server_dispatch import _track_pending
+    if not _track_pending(socket):
+        control.spawn(work, name=name)   # spawn runs callables/awaitables
+        return
     with socket.pending_lock:
         socket.pending_responses += 1
     control.spawn(_counted_dispatch(socket, work), name=name)
