@@ -12,6 +12,7 @@ from __future__ import annotations
 import sys
 import threading
 import time
+import zlib
 from collections import Counter
 from typing import Dict, List, Tuple
 
@@ -62,6 +63,66 @@ def render_text(leaves: Counter, nsamples: int, top: int = 40) -> str:
     for fn, n in leaves.most_common(top):
         lines.append(f"{n:6d} {100.0 * n / nsamples:4.1f}%  {fn}\n")
     return "".join(lines)
+
+
+def render_flamegraph_svg(folded: Counter, width: int = 1200,
+                          row_h: int = 16) -> str:
+    """Self-contained SVG flamegraph from folded stacks (the reference
+    embeds flamegraph rendering behind /hotspots via pprof_perl.cpp;
+    this is the same icicle layout generated directly — hover a frame
+    for its full name and sample share)."""
+    root: dict = {"n": "all", "v": 0, "c": {}}
+    for stack, count in folded.items():
+        root["v"] += count
+        node = root
+        for frame in stack.split(";"):
+            nxt = node["c"].get(frame)
+            if nxt is None:
+                nxt = node["c"][frame] = {"n": frame, "v": 0, "c": {}}
+            node = nxt
+            node["v"] += count
+    total = root["v"] or 1
+
+    rects: List[str] = []
+    max_depth = [0]
+
+    def color(name: str) -> str:
+        h = zlib.crc32(name.encode()) & 0xFFFF
+        return f"hsl({20 + h % 40},{70 + h % 25}%,{55 + (h >> 8) % 12}%)"
+
+    def esc(s: str) -> str:
+        return (s.replace("&", "&amp;").replace("<", "&lt;")
+                .replace(">", "&gt;").replace('"', "&quot;"))
+
+    def emit(node, x: float, depth: int):
+        w = node["v"] / total * width
+        if w < 0.5:
+            return
+        max_depth[0] = max(max_depth[0], depth)
+        y = depth * row_h
+        pct = node["v"] / total * 100
+        label = esc(node["n"])
+        # truncate the RAW name, then escape: slicing escaped text can
+        # cut an XML entity in half and invalidate the whole SVG
+        short = esc(node["n"][:int(w / 7)])
+        rects.append(
+            f'<g><title>{label} ({node["v"]} samples, {pct:.1f}%)</title>'
+            f'<rect x="{x:.1f}" y="{y}" width="{w:.1f}" height="{row_h - 1}"'
+            f' fill="{color(node["n"])}" rx="1"/>'
+            + (f'<text x="{x + 2:.1f}" y="{y + row_h - 4}" '
+               f'font-size="11" font-family="monospace">'
+               f'{short}</text>' if w > 28 else "")
+            + "</g>")
+        cx = x
+        for child in sorted(node["c"].values(), key=lambda c: -c["v"]):
+            emit(child, cx, depth + 1)
+            cx += child["v"] / total * width
+
+    emit(root, 0.0, 0)
+    height = (max_depth[0] + 1) * row_h + 4
+    return (f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+            f'height="{height}" font-family="sans-serif">'
+            + "".join(rects) + "</svg>")
 
 
 def render_folded(folded: Counter) -> str:

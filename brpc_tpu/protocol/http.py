@@ -278,6 +278,9 @@ class HttpProtocol(Protocol):
         if path == "/sockets":
             return 200, "application/json", self._sockets(server)
         if path == "/fibers" or path == "/bthreads":
+            if _query_flag(req, "stacks"):
+                from brpc_tpu.fiber.stacks import dump_fiber_stacks
+                return 200, "text/plain", dump_fiber_stacks().encode()
             return 200, "application/json", self._fibers(server)
         if path == "/threads":
             return 200, "text/plain", _thread_stacks()
@@ -360,8 +363,8 @@ class HttpProtocol(Protocol):
         import threading
 
         from brpc_tpu.builtin.profiler import (
-            growth_profile, heap_profile, heap_stop, render_folded,
-            render_text, sample_cpu)
+            growth_profile, heap_profile, heap_stop, render_flamegraph_svg,
+            render_folded, render_text, sample_cpu)
         from brpc_tpu.fiber.sync import FiberEvent
         ptype = req.query.get("type", "cpu")
         if ptype in ("heap", "growth"):
@@ -401,8 +404,12 @@ class HttpProtocol(Protocol):
         if "v" not in result:
             return 503, "text/plain", b"profile did not complete"
         leaves, folded, n = result["v"]
-        if req.query.get("format") == "folded":
+        fmt = req.query.get("format")
+        if fmt == "folded":
             return 200, "text/plain", render_folded(folded).encode()
+        if fmt in ("svg", "flamegraph"):
+            return (200, "image/svg+xml",
+                    render_flamegraph_svg(folded).encode())
         return 200, "text/plain", render_text(leaves, n).encode()
 
     def _vlog(self, req: HttpRequest):

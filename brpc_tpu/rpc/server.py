@@ -169,6 +169,11 @@ class Server:
                 expose_default_variables)
             add_builtin_services(self)
             expose_default_variables()   # process_* vars (idempotent)
+            # best-effort: SIGUSR2 -> fiber stacks on stderr, so
+            # tools/fiber_stacks.py <pid> works like the reference's
+            # gdb_bthread_stack.py (no-op off the main thread)
+            from brpc_tpu.fiber.stacks import enable_stack_dump_signal
+            enable_stack_dump_signal()
         transport = get_transport(ep.scheme)
         self._listener = transport.listen(ep, self._on_new_conn)
         self._endpoint = self._listener.endpoint

@@ -70,24 +70,17 @@ def _settle_pending(socket) -> None:
             socket.pending_responses -= 1
 
 
+# Claim ownership (the cut-through gate's correctness contract): each
+# +1 on socket.pending_responses has exactly ONE owner with a
+# try/finally settle —
+#   * counted_spawn's wrapper (queue-time claim for every spawned
+#     message, held until its coroutine completes), and
+#   * process_request_fast's claim for turbo-driven requests, settled
+#     by _drive_fast's finally (a suspended turbo handler lets the
+#     input loop continue scanning, so the claim must outlive it).
+# In-place classic processing needs NO claim: _input_async_tail awaits
+# it before the input cycle continues, so nothing can interleave.
 async def process_request(proto, msg: RpcMessage, socket) -> None:
-    if not _track_pending(socket):
-        await _process_request_inner(proto, msg, socket)
-        return
-    with socket.pending_lock:
-        socket.pending_responses += 1   # settled by _send_response
-    try:
-        await _process_request_inner(proto, msg, socket)
-    except BaseException:
-        # _send_response settles on every normal path; an escaping
-        # exception means no response was sent for this claim — a
-        # leaked claim would disable cut-through on this connection
-        # forever
-        _settle_pending(socket)
-        raise
-
-
-async def _process_request_inner(proto, msg: RpcMessage, socket) -> None:
     server = socket.user_data.get("server")
     meta = msg.meta
     cid = meta.correlation_id
@@ -324,16 +317,19 @@ async def _drive_fast(proto, socket, server, method, method_key: str,
     interceptor, no compression, no streams, no device payloads, rpcz
     off). Driven by ONE coro.send(None) from process_request_fast, so
     a synchronously-completing handler touches no Fiber at all."""
+    if not _track_pending(socket):
+        await _drive_fast_inner(proto, socket, server, method, method_key,
+                                cid, service, method_name, log_id, payload,
+                                att)
+        return
     try:
         await _drive_fast_inner(proto, socket, server, method, method_key,
                                 cid, service, method_name, log_id, payload,
                                 att)
-    except BaseException:
-        # the dispatch claim must not leak on an escaping exception
-        # (see process_request's twin guard)
-        if _track_pending(socket):
-            _settle_pending(socket)
-        raise
+    finally:
+        # THE single settle of process_request_fast's claim — exactly
+        # once, on success and on every escape path alike
+        _settle_pending(socket)
 
 
 async def _drive_fast_inner(proto, socket, server, method, method_key: str,
@@ -403,12 +399,10 @@ def process_request_fast(proto, socket, server, cid: int, service: str,
         return process_request(
             proto, _synth_request_msg(cid, service, method_name, log_id,
                                       payload, att), socket)
-    track = _track_pending(socket)
-    if track:
-        with socket.pending_lock:
-            socket.pending_responses += 1   # settled by _send_response
     method = server.find_method(service, method_name)
     if method is None:
+        # error responses here run synchronously in the input context:
+        # nothing can interleave, no claim needed
         has_svc = service in server.services()
         _send_error(proto, socket, cid,
                     berr.ENOMETHOD if has_svc else berr.ENOSERVICE,
@@ -419,6 +413,11 @@ def process_request_fast(proto, socket, server, cid: int, service: str,
                     "max_concurrency reached")
         return None
     method_key = method.full_name or f"{service}.{method_name}"
+    if _track_pending(socket):
+        # claimed HERE (before the handler can suspend and let the
+        # input loop continue); _drive_fast's finally settles it
+        with socket.pending_lock:
+            socket.pending_responses += 1
     coro = _drive_fast(proto, socket, server, method, method_key, cid,
                        service, method_name, log_id, payload, att)
     if not method.is_coroutine and not is_last:
@@ -438,21 +437,6 @@ def process_request_fast(proto, socket, server, cid: int, service: str,
 
 def _send_response(proto, socket, cid: int, cntl: Controller,
                    response) -> None:
-    if not _track_pending(socket):
-        _send_response_inner(proto, socket, cid, cntl, response)
-        return
-    try:
-        _send_response_inner(proto, socket, cid, cntl, response)
-    finally:
-        # the dispatch entry's pending_responses claim settles here —
-        # EVERY dispatched request sends exactly one response through
-        # this choke point (errors included), and the cut-through gate
-        # reads the counter
-        _settle_pending(socket)
-
-
-def _send_response_inner(proto, socket, cid: int, cntl: Controller,
-                         response) -> None:
     # small-call fast path: a successful tpu_std-framed response with no
     # stream/device/progressive sections needs only correlation_id (+
     # attachment_size) in its meta — hand-encoded varints over a single

@@ -208,8 +208,10 @@ class Stream:
         if prev is not sock:
             sock.user_data["bound_streams"] = \
                 sock.user_data.get("bound_streams", 0) + 1
-            if prev is not None:
+            if prev is not None and \
+                    not getattr(self, "_slot_released", False):
                 _release_stream_slot(prev)
+            self._slot_released = False   # the new sock holds a slot
         if prev is sock:
             self.socket = sock
             return
@@ -236,6 +238,15 @@ class Stream:
             if self.closed or self.remote_closed:
                 return
             self.remote_closed = True
+        # a remotely-closed stream interleaves no further frames: give
+        # back the cut-through slot now — close() releases via the
+        # _subscribed_sock pop, which this leaves intact for the
+        # failure-subscription cleanup (release and unsubscribe are
+        # separate concerns; the pop below guards double release)
+        sub = getattr(self, "_subscribed_sock", None)
+        if sub is not None and not getattr(self, "_slot_released", False):
+            self._slot_released = True
+            _release_stream_slot(sub)
         # a nonzero sentinel makes every credit park short-circuit
         # (butex value_changed), so a writer racing this close cannot
         # sleep out its full timeout on a dead stream
@@ -262,7 +273,9 @@ class Stream:
         sub = getattr(self, "_subscribed_sock", None)
         if sub is not None:
             self._subscribed_sock = None
-            _release_stream_slot(sub)
+            if not getattr(self, "_slot_released", False):
+                self._slot_released = True
+                _release_stream_slot(sub)
             try:
                 sub.off_failed(self._on_socket_failed)
             except AttributeError:

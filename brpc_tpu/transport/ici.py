@@ -220,34 +220,75 @@ class _LazyAdder:
 _unpulled_registrations = _LazyAdder("ici_unpulled_registrations")
 
 # the HBM those leaked registrations pin, and the circuit breaker that
-# BOUNDS it: once the cumulative leaked estimate crosses the cap, new
-# connections stop using the pull lane (degrading to the host-staged
-# lane, which pins nothing) — a long-lived server cycling through dying
-# peers trades bandwidth for a bounded footprint instead of leaking HBM
-# without limit (block_pool.cpp:271-340 freelist hygiene, adapted to an
-# API with no cancel). /vars ici_unpulled_bytes tracks the estimate.
+# BOUNDS it — attributed PER PEER EPOCH so one flapping peer degrades
+# only itself (block_pool.cpp:271-340 freelist hygiene, adapted to an
+# API with no cancel). The epoch is the peer's per-process uuid from
+# the hello: a restarted peer arrives under a fresh epoch with a zero
+# count, so the breaker recovers on reconnect. The GLOBAL cap stays —
+# the leaked registrations of dead epochs remain pinned (the transfer
+# API has no cancel), so the process-wide bound cannot honestly decay;
+# past it every peer degrades to the host-staged lane.
+# /vars ici_unpulled_bytes tracks the global estimate.
 _unpulled_bytes = _LazyAdder("ici_unpulled_bytes")
-_leaked_pull_bytes = [0]
+_leaked_pull_bytes = [0]                    # global, all epochs
+_leaked_by_epoch: Dict[str, int] = {}       # peer proc uuid -> bytes
 _LEAK_CAP_BYTES = int(os.environ.get(
-    "BRPC_TPU_ICI_PULL_LEAK_CAP", 256 << 20))
+    "BRPC_TPU_ICI_PULL_LEAK_CAP", 256 << 20))          # per peer epoch
+# process-wide hard bound. When an operator set PULL_LEAK_CAP as a
+# strict HBM bound (its pre-per-epoch meaning) and no global cap, that
+# value stays the global bound too — per-epoch attribution must not
+# silently multiply a configured footprint limit.
+_LEAK_GLOBAL_CAP_BYTES = int(
+    os.environ.get("BRPC_TPU_ICI_PULL_LEAK_GLOBAL_CAP")
+    or os.environ.get("BRPC_TPU_ICI_PULL_LEAK_CAP")
+    or (1 << 30))
+_epoch_trips_logged: set = set()
 
 
 _leak_breaker_logged = [False]
 
 
-def _pull_lane_allowed() -> bool:
-    if _leaked_pull_bytes[0] < _LEAK_CAP_BYTES:
-        return True
-    if not _leak_breaker_logged[0]:
-        # once, on the open->tripped transition (this runs per batch)
-        _leak_breaker_logged[0] = True
-        logger.warning(
-            "ici: leaked pull registrations estimated at ~%d MB "
-            "(cap %d MB, an UPPER BOUND — pulled-but-unacked batches "
-            "count too) — new lane batches use the host-staged path. "
-            "Raise BRPC_TPU_ICI_PULL_LEAK_CAP to re-enable.",
-            _leaked_pull_bytes[0] >> 20, _LEAK_CAP_BYTES >> 20)
-    return False
+def _note_leaked(peer_epoch: Optional[str], nbytes: int) -> None:
+    """Attribute un-pulled registration bytes to the peer epoch that
+    abandoned them (called under _local_lock by close paths)."""
+    _leaked_pull_bytes[0] += nbytes
+    if peer_epoch:
+        _leaked_by_epoch[peer_epoch] = \
+            _leaked_by_epoch.get(peer_epoch, 0) + nbytes
+        if len(_leaked_by_epoch) > 4096:    # bound dead-epoch bookkeeping
+            # keep the heaviest offenders; the global counter still
+            # carries every byte
+            for k in sorted(_leaked_by_epoch,
+                            key=_leaked_by_epoch.get)[:2048]:
+                del _leaked_by_epoch[k]
+
+
+def _pull_lane_allowed(peer_epoch: Optional[str] = None) -> bool:
+    if _leaked_pull_bytes[0] >= _LEAK_GLOBAL_CAP_BYTES:
+        if not _leak_breaker_logged[0]:
+            # once, on the open->tripped transition (runs per batch)
+            _leak_breaker_logged[0] = True
+            logger.warning(
+                "ici: leaked pull registrations estimated at ~%d MB "
+                "process-wide (global cap %d MB, an UPPER BOUND — "
+                "pulled-but-unacked batches count too) — ALL lane "
+                "batches use the host-staged path. Raise "
+                "BRPC_TPU_ICI_PULL_LEAK_GLOBAL_CAP to re-enable.",
+                _leaked_pull_bytes[0] >> 20, _LEAK_GLOBAL_CAP_BYTES >> 20)
+        return False
+    if peer_epoch and \
+            _leaked_by_epoch.get(peer_epoch, 0) >= _LEAK_CAP_BYTES:
+        if peer_epoch not in _epoch_trips_logged:
+            _epoch_trips_logged.add(peer_epoch)
+            logger.warning(
+                "ici: peer epoch %s abandoned ~%d MB of pull "
+                "registrations (per-epoch cap %d MB) — its lane "
+                "batches degrade to the host-staged path until it "
+                "reconnects under a fresh epoch",
+                peer_epoch[:16], _leaked_by_epoch[peer_epoch] >> 20,
+                _LEAK_CAP_BYTES >> 20)
+        return False    # this epoch's own abandonment record gates it
+    return True
 
 
 # same-process exchange entries from closed connections are reclaimed on
@@ -484,7 +525,7 @@ class IciConn(Conn):
         else:
             srv = _get_transfer_server()
             if srv is not None and info.get("can_pull") \
-                    and _pull_lane_allowed():
+                    and _pull_lane_allowed(info.get("proc")):
                 uid = _next_uuid()
                 srv.await_pull(uid, list(arrays))
                 self._issued_uids.append(uid)
@@ -792,11 +833,12 @@ class IciConn(Conn):
             outstanding = sum(1 for _, p in self._inflight_footprints if p)
             leaked_bytes = sum(fp for fp, p in self._inflight_footprints
                                if p)
-        if outstanding > 0 and (self.peer_info or {}).get("proc") != _PROC_UUID:
+        peer_epoch = (self.peer_info or {}).get("proc")
+        if outstanding > 0 and peer_epoch != _PROC_UUID:
             _unpulled_registrations.add(outstanding)
             _unpulled_bytes.add(leaked_bytes)
             with _local_lock:   # closes race from two threads' +=
-                _leaked_pull_bytes[0] += leaked_bytes
+                _note_leaked(peer_epoch, leaked_bytes)
         _sweep_reclaim()
         # drop any inbound descriptors never taken (their uids live in
         # the PEER's registry; our pool never reserved for them)

@@ -1,0 +1,104 @@
+"""Flamegraph rendering (/hotspots) + fiber stack inspection — the
+reference's pprof/flamegraph embedding (builtin/pprof_perl.cpp) and
+tools/gdb_bthread_stack.py analogs."""
+
+import time
+from collections import Counter
+
+from brpc_tpu import fiber
+from brpc_tpu.builtin.profiler import render_flamegraph_svg
+from brpc_tpu.fiber.stacks import dump_fiber_stacks, live_fibers
+
+
+class TestFlamegraph:
+    def test_svg_structure(self):
+        folded = Counter({
+            "main;serve;parse": 30,
+            "main;serve;handler": 60,
+            "main;idle": 10,
+        })
+        svg = render_flamegraph_svg(folded)
+        assert svg.startswith("<svg") and svg.endswith("</svg>")
+        assert svg.count("<rect") >= 6      # root + 5 distinct frames
+        assert "handler" in svg and "parse" in svg
+        # widths proportional: handler (60%) wider than parse (30%)
+        import re
+        def width_of(name):
+            m = re.search(rf'<title>{name} \((\d+) samples', svg)
+            return int(m.group(1))
+        assert width_of("handler") == 60 and width_of("parse") == 30
+
+    def test_escapes_markup(self):
+        svg = render_flamegraph_svg(Counter({"<mod>;fn&x": 5}))
+        assert "<mod>" not in svg and "&lt;mod&gt;" in svg
+
+    def test_empty(self):
+        svg = render_flamegraph_svg(Counter())
+        assert svg.startswith("<svg")
+
+    def test_http_endpoint_formats(self):
+        from brpc_tpu.rpc import Channel, Server, ServerOptions
+
+        server = Server(ServerOptions(enable_builtin_services=True))
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            import urllib.request
+            url = f"http://127.0.0.1:{ep.port}/hotspots" \
+                  f"?seconds=0.2&format=svg"
+            with urllib.request.urlopen(url, timeout=15) as r:
+                assert r.headers["Content-Type"].startswith("image/svg")
+                body = r.read().decode()
+            assert body.startswith("<svg")
+        finally:
+            server.stop()
+            server.join(2)
+
+
+class TestFiberStacks:
+    def test_suspended_fiber_stack_named(self):
+        evt = fiber.FiberEvent()
+
+        async def parked_worker():
+            await evt.wait()
+
+        f = fiber.spawn(parked_worker, name="parked_worker")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            report = dump_fiber_stacks()
+            if "parked_worker" in report and "await evt.wait()" in report:
+                break
+            time.sleep(0.02)
+        assert "parked_worker" in report
+        assert "await evt.wait()" in report    # the exact parked line
+        evt.set()
+        assert f.join(5)
+
+    def test_live_fibers_excludes_done(self):
+        async def quick():
+            return 1
+
+        f = fiber.spawn(quick, name="quick_done")
+        assert f.join(5)
+        assert all(x is not f for x in live_fibers())
+
+    def test_signal_dump_tool_path(self, capfd):
+        import os
+        import signal as sig
+
+        from brpc_tpu.fiber.stacks import enable_stack_dump_signal
+        if not enable_stack_dump_signal():
+            import pytest
+            pytest.skip("not on the main thread")
+        evt = fiber.FiberEvent()
+
+        async def sleeper():
+            await evt.wait()
+
+        f = fiber.spawn(sleeper, name="sig_sleeper")
+        time.sleep(0.1)
+        os.kill(os.getpid(), sig.SIGUSR2)
+        time.sleep(0.2)
+        err = capfd.readouterr().err
+        assert "live fibers" in err and "sig_sleeper" in err
+        evt.set()
+        assert f.join(5)

@@ -605,16 +605,53 @@ class TestLaneLifecycleSoak:
             server.join(2)
 
     def test_pull_leak_circuit_breaker(self):
-        """Once the leaked-pull estimate crosses the cap, new batches
-        must refuse the pull lane (bounded HBM footprint; the transfer
-        API has no cancel so degradation is the only bound)."""
+        """Global cap: once the process-wide leaked-pull estimate
+        crosses it, EVERY peer refuses the pull lane (bounded HBM
+        footprint; the transfer API has no cancel so degradation is
+        the only bound)."""
         old = ici._leaked_pull_bytes[0]
         old_logged = ici._leak_breaker_logged[0]
         try:
-            ici._leaked_pull_bytes[0] = ici._LEAK_CAP_BYTES + 1
+            ici._leaked_pull_bytes[0] = ici._LEAK_GLOBAL_CAP_BYTES + 1
+            assert ici._pull_lane_allowed("any-peer") is False
             assert ici._pull_lane_allowed() is False
             ici._leaked_pull_bytes[0] = 0
-            assert ici._pull_lane_allowed() is True
+            assert ici._pull_lane_allowed("any-peer") is True
         finally:
             ici._leaked_pull_bytes[0] = old
             ici._leak_breaker_logged[0] = old_logged
+
+    def test_pull_leak_breaker_per_peer_epoch(self):
+        """The round-4 ratchet fix: one flapping peer crossing the
+        per-epoch cap degrades ONLY itself — a second peer keeps the
+        pull lane, and the flapper's restart (fresh epoch uuid in its
+        hello) recovers it. The global counter keeps every byte (dead
+        epochs' registrations stay pinned; no honest decay exists)."""
+        old_global = ici._leaked_pull_bytes[0]
+        saved = dict(ici._leaked_by_epoch)
+        try:
+            ici._leaked_pull_bytes[0] = 0
+            ici._leaked_by_epoch.clear()
+            flapper, healthy = "epoch-A1", "epoch-B"
+            # flap peer A past its per-epoch cap in three closes
+            with ici._local_lock:
+                for _ in range(3):
+                    ici._note_leaked(flapper,
+                                     ici._LEAK_CAP_BYTES // 2 + 1)
+            assert ici._pull_lane_allowed(flapper) is False
+            # the healthy peer is untouched
+            assert ici._pull_lane_allowed(healthy) is True
+            # peer A restarts: its new process uuid is a new epoch with
+            # a clean record — the breaker recovers on reconnect
+            assert ici._pull_lane_allowed("epoch-A2") is True
+            # the global estimate still carries the dead epoch's bytes
+            assert ici._leaked_pull_bytes[0] >= ici._LEAK_CAP_BYTES
+            # per-epoch bookkeeping stays bounded
+            with ici._local_lock:
+                for i in range(5000):
+                    ici._note_leaked(f"ep-{i}", 1)
+            assert len(ici._leaked_by_epoch) <= 4096
+        finally:
+            ici._leaked_pull_bytes[0] = old_global
+            ici._leaked_by_epoch.clear()
+            ici._leaked_by_epoch.update(saved)

@@ -1,0 +1,59 @@
+"""Fiber stack inspector for a RUNNING brpc_tpu process — the analog of
+the reference's tools/gdb_bthread_stack.py (which attaches gdb and
+walks TaskMeta contexts).
+
+Two attachment modes:
+
+  python tools/fiber_stacks.py http://HOST:PORT
+      fetches /fibers?stacks=1 from the target's builtin service and
+      prints the report (works cross-machine).
+
+  python tools/fiber_stacks.py PID
+      sends SIGUSR2; the target prints its fiber stacks to ITS stderr
+      (the handler is installed by Server.start — best effort: a
+      server started off the main thread can't install it).
+
+No debugger needed either way: a suspended fiber's continuation hangs
+off its coroutine's frame chain, recoverable from Python itself
+(brpc_tpu/fiber/stacks.py).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print(__doc__)
+        return 2
+    target = sys.argv[1]
+    if target.isdigit():
+        pid = int(target)
+        try:
+            os.kill(pid, signal.SIGUSR2)
+        except ProcessLookupError:
+            print(f"no such process: {pid}", file=sys.stderr)
+            return 1
+        except PermissionError:
+            print(f"not permitted to signal {pid}", file=sys.stderr)
+            return 1
+        print(f"SIGUSR2 sent to {pid}: fiber stacks go to ITS stderr "
+              f"(handler installed by Server.start; if nothing appears "
+              f"the target has no handler — use the http:// mode)")
+        return 0
+    if target.startswith("http://"):
+        from urllib.request import urlopen
+        url = target.rstrip("/") + "/fibers?stacks=1"
+        with urlopen(url, timeout=10) as r:
+            sys.stdout.write(r.read().decode("utf-8", "replace"))
+        return 0
+    print(f"target must be a PID or http://host:port, got {target!r}",
+          file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
