@@ -568,8 +568,11 @@ class Socket:
                 except Exception:
                     self._busy_paused = False
         import select
-        poller = select.poll()
-        poller.register(fd, select.POLLIN | select.POLLHUP | select.POLLERR)
+        poller = self.__dict__.get("_pluck_poller")
+        if poller is None:
+            poller = self._pluck_poller = select.poll()
+            poller.register(fd,
+                            select.POLLIN | select.POLLHUP | select.POLLERR)
         escalated = False
         try:
             while not pred() and not self.failed:

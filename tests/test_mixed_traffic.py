@@ -7,6 +7,7 @@ every payload must come back intact — the test that would catch a
 frame interleaved into a half-streamed response (the pending-claims
 gate's whole job)."""
 
+import struct
 import threading
 import time
 
@@ -47,54 +48,61 @@ def test_mixed_small_large_slow_on_one_connection(scheme):
     ep = server.start(name)
     try:
         ch = Channel(str(ep), ChannelOptions(timeout_ms=30000))
-        big = bytes(range(256)) * 1024          # 256KB, position-coded
         errors = []
+        done_count = [0]
         lock = threading.Lock()
         pending = []
 
-        def check_big(c):
-            with lock:
-                if c.failed():
-                    errors.append(c.error_text)
-                elif c.response_attachment.to_bytes() != big:
-                    errors.append("big payload corrupted")
+        def _nonperiodic(tag: int, n_words: int) -> bytes:
+            # genuinely position-coded AND per-call unique: any
+            # aligned-chunk swap, repeat, or cross-response mixup
+            # compares unequal
+            return b"".join(struct.pack("<II", tag, i)
+                            for i in range(n_words))
 
-        def check_small(i):
+        def check(expect_attachment=None, expect_payload=None):
             def _cb(c):
                 with lock:
                     if c.failed():
                         errors.append(c.error_text)
-                    elif c.response_payload.to_bytes() != b"s%d" % i:
-                        errors.append(f"small {i} corrupted")
-            return _cb
-
-        def check_slow(i):
-            def _cb(c):
-                with lock:
-                    if c.failed():
-                        errors.append(c.error_text)
-                    elif c.response_payload.to_bytes() != b"slow:t%d" % i:
-                        errors.append(f"slow {i} corrupted")
+                    elif expect_attachment is not None and \
+                            c.response_attachment.to_bytes() \
+                            != expect_attachment:
+                        errors.append("big payload corrupted")
+                    elif expect_payload is not None and \
+                            c.response_payload.to_bytes() != expect_payload:
+                        errors.append(f"payload corrupted: "
+                                      f"{expect_payload[:16]!r}")
+                    done_count[0] += 1
             return _cb
 
         # interleave: large echo (cut-through eligible), small echoes
         # (native serve), and slow handlers (async responses landing
         # out of band) — all pipelined on ONE multiplexed socket
         for round_ in range(6):
+            big = _nonperiodic(round_, 32768)     # 256KB, unique per call
             cntl = Controller()
             att = IOBuf()
             att.append(big)
             cntl.request_attachment = att
             pending.append(ch.call("Mix", "Echo", b"", cntl=cntl,
-                                   done=check_big))
+                                   done=check(expect_attachment=big)))
             for i in range(4):
                 k = round_ * 10 + i
-                pending.append(ch.call("Mix", "Echo", b"s%d" % k,
-                                       done=check_small(k)))
-            pending.append(ch.call("Mix", "SlowTag", b"t%d" % round_,
-                                   done=check_slow(round_)))
+                pending.append(ch.call(
+                    "Mix", "Echo", b"s%d" % k,
+                    done=check(expect_payload=b"s%d" % k)))
+            pending.append(ch.call(
+                "Mix", "SlowTag", b"t%d" % round_,
+                done=check(expect_payload=b"slow:t%d" % round_)))
         for c in pending:
             assert c.join(30), "call never completed"
+        # join() can return before the LAST done callback finishes
+        # (the event fires before the callback): wait for all counts
+        deadline = time.monotonic() + 10
+        while done_count[0] < len(pending) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert done_count[0] == len(pending)
         assert not errors, errors[:4]
         ch.close()
     finally:
@@ -108,13 +116,17 @@ def test_many_connections_large_echo_integrity():
     server = _mixed_server()
     ep = server.start("tcp://127.0.0.1:0")
     try:
-        big = bytes(range(256)) * 2048          # 512KB
         errors = []
 
-        def client(n):
+        def client(cid, n):
             ch = Channel(str(ep), ChannelOptions(timeout_ms=30000))
             try:
-                for _ in range(n):
+                for k in range(n):
+                    # unique per client AND per call: a chunk from one
+                    # in-flight response landing in another compares
+                    # unequal at any aligned offset
+                    big = b"".join(struct.pack("<III", cid, k, i)
+                                   for i in range(43691))   # ~512KB
                     cntl = Controller()
                     att = IOBuf()
                     att.append(big)
@@ -127,7 +139,8 @@ def test_many_connections_large_echo_integrity():
             finally:
                 ch.close()
 
-        ths = [threading.Thread(target=client, args=(6,)) for _ in range(3)]
+        ths = [threading.Thread(target=client, args=(cid, 6))
+               for cid in range(3)]
         for t in ths:
             t.start()
         for t in ths:
