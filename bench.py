@@ -475,6 +475,12 @@ def main() -> None:
     except BaseException as e:  # noqa: BLE001 - salvage: TCP still runs
         lane = {"error": f"probe driver failed: {type(e).__name__}: {e}"[:400]}
     result["device_lane"] = lane
+    if "lane_error" in lane:
+        # healthy bring-up, failed sweep: keep the bring-up evidence
+        # but the run is partial like every other failure path
+        result["partial"] = True
+        _progress({"progress": "error", "phase": "device_lane",
+                   "error": lane["lane_error"]})
     if "error" in lane:
         lane["preflight_plugin_holders"] = \
             result["preflight"].get("plugin_holders", [])

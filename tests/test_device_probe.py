@@ -100,6 +100,20 @@ def test_attribution_names_external_plugin_hang():
     assert device_probe._attribute_hang(hang).startswith("REPO")
 
 
+def test_lane_failure_keeps_bringup_evidence(tmp_path, monkeypatch):
+    """A sweep failure after a healthy bring-up must report partial
+    results (bringup + lane_error), not discard the evidence."""
+    monkeypatch.setenv("BRPC_TPU_PROBE_PLATFORM", "cpu")
+    monkeypatch.setenv("BRPC_TPU_PROBE_SELFTEST_LANE_FAIL", "1")
+    lane = device_probe.run_probe(budget_s=60.0,
+                                  out_path=str(tmp_path / "p.json"))
+    assert lane.get("bringup", {}).get("platform") == "cpu", lane
+    assert "selftest lane failure" in lane.get("lane_error", ""), lane
+    assert "_child_lane" in lane.get("lane_error_traceback", ""), \
+        "traceback must localize the lane failure"
+    assert "error" not in lane    # bring-up itself succeeded
+
+
 def test_probe_child_dead_is_reported(monkeypatch):
     """A child that dies before producing a result must be reported
     with rc + stderr tail, not hang the parent."""

@@ -496,6 +496,31 @@ def _child_main() -> None:
     _child_note({"phase": "backend_up", **result["bringup"],
                  "t": round(time.monotonic() - t_start, 1)})
 
+    try:
+        _child_lane(result, devs, budget_s, t_start)
+    except BaseException as e:  # noqa: BLE001 - partial evidence > none
+        # a lane failure must not discard the bring-up evidence the
+        # probe exists to capture — and must stay localizable, so the
+        # traceback rides along (the old crash path got it for free
+        # via the parent's stderr capture)
+        import traceback
+        result["lane_error"] = f"{type(e).__name__}: {e}"[:400]
+        result["lane_error_traceback"] = traceback.format_exc()[-1500:]
+        _child_note({"phase": "lane_error", "error": result["lane_error"]})
+    print("RESULT " + json.dumps(result), flush=True)
+    # PjRt/tunnel teardown from live threads can abort the interpreter;
+    # everything is flushed, skip teardown (bench.py's own convention)
+    os._exit(0)
+
+
+def _child_lane(result: dict, devs, budget_s: float,
+                t_start: float) -> None:
+    """Link floors + the ici:// echo sweep (runs only after a healthy
+    bring-up; any failure here is reported as lane_error next to the
+    bring-up data)."""
+    if os.environ.get("BRPC_TPU_PROBE_SELFTEST_LANE_FAIL"):
+        raise RuntimeError("selftest lane failure")
+    import jax
     import numpy as np
 
     # link floors: what one H2D / D2H crossing costs on this fabric —
@@ -619,10 +644,6 @@ def _child_main() -> None:
         _child_note({"phase": "sweep_point", "size": sz, **pt})
 
     ch.close()
-    print("RESULT " + json.dumps(result), flush=True)
-    # PjRt/tunnel teardown from live threads can abort the interpreter;
-    # everything is flushed, skip teardown (bench.py's own convention)
-    os._exit(0)
 
 
 def main() -> None:
