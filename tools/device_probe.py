@@ -317,16 +317,23 @@ def run_probe(budget_s: float = 150.0, out_path: Optional[str] = None,
 
     # the child budgets ITSELF to finish within budget_s; the parent's
     # clock gets grace on top so a legitimate near-budget run is never
-    # killed mid-final-batch and mislabeled as a hang
+    # killed mid-final-batch and mislabeled as a hang. Bring-up gets a
+    # SHORTER leash: a healthy backend arrives in ~0.1s and a wedge's
+    # signature is fully formed within seconds (stable stacks, no relay
+    # dials) — burning the whole sweep budget on a diagnosed hang would
+    # just delay the rest of the bench behind it.
     parent_deadline_s = budget_s + min(20.0, max(3.0, budget_s * 0.15))
+    bringup_deadline_s = min(parent_deadline_s, max(20.0, budget_s * 0.5))
     hung = False
     while True:
         drain()
         if result_line is not None or child.poll() is not None:
             break
         now = time.monotonic()
-        if now - t0 > parent_deadline_s:
+        limit = parent_deadline_s if backend_seen[0] else bringup_deadline_s
+        if now - t0 > limit:
             hung = True
+            tripped_limit = limit
             break
         # relay dials can be transient (a claim retry connects, times
         # out, closes): sample at the loop rate and record TRANSITIONS,
@@ -383,7 +390,7 @@ def run_probe(budget_s: float = 150.0, out_path: Optional[str] = None,
                                               "selftest_hang")
                  else f"device lane (after {ph})")
         lane["error"] = (
-            f"{stage} hung > {parent_deadline_s:.0f}s "
+            f"{stage} hung > {tripped_limit:.0f}s "
             f"(last phase: {ph})")
         lane["hang"] = {
             "last_phase": last_phase,
