@@ -502,6 +502,58 @@ def main() -> None:
             port = tcp_ep.port
         result["server_process"] = ("subprocess" if server_proc is not None
                                     else "in-process")
+        # small-payload latency FIRST, on a quiet box (the reference
+        # measures its latency CDFs in dedicated runs; sampling after
+        # the 1MB blast would measure a cache-hot-box tax instead of
+        # the path). One multiplexed connection, sequential sync echoes
+        # — echo_c++'s client shape.
+        lat_ch = Channel(f"tcp://127.0.0.1:{port}",
+                         ChannelOptions(timeout_ms=5000))
+        for _ in range(200):                     # warm the connection
+            if deadline.remaining() < 8.0:
+                break
+            lat_ch.call_sync("Bench", "Echo", b"ping")
+        rec = LatencyRecorder()
+        failures = 0
+        samples = 0
+        best_us = None
+        # >=5k samples (round-4 verdict: 600 made the tail a
+        # scheduling-noise lottery); the budget guard still caps a
+        # pathologically slow path
+        for _ in range(5000):
+            if deadline.remaining() < 45.0:
+                break
+            t0 = time.perf_counter_ns()
+            cl = lat_ch.call_sync("Bench", "Echo", b"ping")
+            if cl.failed():
+                failures += 1
+                if failures >= 10:
+                    break            # dead server: don't grind the budget
+            else:
+                samples += 1
+                us = (time.perf_counter_ns() - t0) / 1e3
+                rec.record(us)
+                if best_us is None or us < best_us:
+                    best_us = us
+        lat_ch.close()
+        if samples:
+            result["small_rpc_samples"] = samples
+            result["small_rpc_p50_us"] = round(rec.latency_percentile(0.5), 1)
+            result["small_rpc_p99_us"] = round(rec.latency_percentile(0.99), 1)
+            # noise-robust floor: one bad scheduling draw on a shared
+            # box inflates percentiles; the min is the machine-honest
+            # "what the path costs" figure
+            result["small_rpc_min_us"] = round(best_us, 1)
+        else:
+            # an empty recorder would report a record-looking 0.0
+            result["partial"] = True
+            result["small_rpc_error"] = \
+                f"no successful latency samples ({failures} failures)"
+        _progress({"progress": "tcp_small",
+                   "p50_us": result.get("small_rpc_p50_us"),
+                   "p99_us": result.get("small_rpc_p99_us"),
+                   **({"error": result["small_rpc_error"]}
+                      if "small_rpc_error" in result else {})})
         # pooled connections: the reference's headline shape
         # (multi-connection pooled client, docs/cn/benchmark.md:104).
         # Inflight 6: measured sweet spot on a 1-core box — deeper
@@ -555,52 +607,6 @@ def main() -> None:
         _progress({"progress": "tcp_headline", "iters": iters,
                    "GBps": result["value"],
                    "p99_us": result["p99_us"]})
-        # small-payload latency (the reference's latency-CDF shape: one
-        # multiplexed connection, sequential sync echoes — echo_c++'s
-        # client; the pooled channel would add per-call pool bookkeeping
-        # that isn't part of that shape)
-        lat_ch = Channel(f"tcp://127.0.0.1:{port}",
-                         ChannelOptions(timeout_ms=5000))
-        for _ in range(200):                     # warm the connection
-            if deadline.remaining() < 8.0:
-                break
-            lat_ch.call_sync("Bench", "Echo", b"ping")
-        rec = LatencyRecorder()
-        failures = 0
-        samples = 0
-        best_us = None
-        # >=5k samples (round-4 verdict: 600 made the tail a
-        # scheduling-noise lottery); the budget guard still caps a
-        # pathologically slow path
-        for _ in range(5000):
-            if deadline.remaining() < 5.0:
-                break
-            t0 = time.perf_counter_ns()
-            cl = lat_ch.call_sync("Bench", "Echo", b"ping")
-            if cl.failed():
-                failures += 1
-                if failures >= 10:
-                    break            # dead server: don't grind the budget
-            else:
-                samples += 1
-                us = (time.perf_counter_ns() - t0) / 1e3
-                rec.record(us)
-                if best_us is None or us < best_us:
-                    best_us = us
-        lat_ch.close()
-        if samples:
-            result["small_rpc_samples"] = samples
-            result["small_rpc_p50_us"] = round(rec.latency_percentile(0.5), 1)
-            result["small_rpc_p99_us"] = round(rec.latency_percentile(0.99), 1)
-            # noise-robust floor: one bad scheduling draw on a shared
-            # box inflates percentiles; the min is the machine-honest
-            # "what the path costs" figure
-            result["small_rpc_min_us"] = round(best_us, 1)
-        else:
-            # an empty recorder would report a record-looking 0.0
-            result["partial"] = True
-            result["small_rpc_error"] = \
-                f"no successful latency samples ({failures} failures)"
         # long-tail CDF (the reference's famous latency benchmark,
         # docs/cn/benchmark.md:126-199): 1-in-100 calls hit a 50ms
         # handler on a SEPARATE connection while the normal stream runs
@@ -678,11 +684,6 @@ def main() -> None:
                     "probe produced zero samples (core saturated)"
         except Exception as e:  # noqa: BLE001 - diagnostics only
             result["fiber_wake_error"] = f"{type(e).__name__}: {e}"[:200]
-        _progress({"progress": "tcp_small",
-                   "p50_us": result.get("small_rpc_p50_us"),
-                   "p99_us": result.get("small_rpc_p99_us"),
-                   **({"error": result["small_rpc_error"]}
-                      if "small_rpc_error" in result else {})})
         # the 4B-4MB TCP sweep (the reference's qps-vs-request-size
         # curves, docs/cn/benchmark.md:92-156) — adaptive iteration
         # counts, one stderr line per point, skipped points reported
