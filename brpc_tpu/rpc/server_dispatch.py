@@ -260,6 +260,11 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
 
     latency_us = (time.monotonic_ns() - t0) / 1e3
     server.on_request_end(method_key, latency_us, failed=cntl.failed())
+    # drop cancel subscriptions BEFORE the response leaves: the peer may
+    # read the response and close faster than this context runs its
+    # post-write cleanup, and a finished request must not hear about
+    # that close (notify_on_cancel exists to stop RUNNING work)
+    cntl._drop_cancel_subs()
     try:
         _send_response(proto, socket, cid, cntl, response)
         finish_span(span, cntl)
@@ -267,8 +272,6 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
         # kvmap.h: one greppable line per session — even when the
         # response write throws (peer already gone)
         cntl.flush_session_kv()
-        cntl._drop_cancel_subs()   # finished requests must not hear
-        #                            about later connection deaths
 
 
 def _synth_request_msg(cid: int, service: str, method_name: str,
@@ -373,13 +376,15 @@ async def _drive_fast_inner(proto, socket, server, method, method_key: str,
         cntl.set_failed(berr.EINTERNAL, f"{type(e).__name__}: {e}")
     server.on_request_end(method_key, (time.monotonic_ns() - t0) / 1e3,
                           failed=cntl.failed())
+    # before the send: see process_request's twin comment (the peer can
+    # close faster than post-write cleanup runs)
+    cntl._drop_cancel_subs()
     try:
         # _send_response's own small-frame fast path covers the
         # plain-bytes success shape; one sender, one eligibility ladder
         _send_response(proto, socket, cid, cntl, response)
     finally:
         cntl.flush_session_kv()
-        cntl._drop_cancel_subs()
 
 
 def process_request_fast(proto, socket, server, cid: int, service: str,
