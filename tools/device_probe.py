@@ -323,7 +323,10 @@ def run_probe(budget_s: float = 150.0, out_path: Optional[str] = None,
     # dials) — burning the whole sweep budget on a diagnosed hang would
     # just delay the rest of the bench behind it.
     parent_deadline_s = budget_s + min(20.0, max(3.0, budget_s * 0.15))
-    bringup_deadline_s = min(parent_deadline_s, max(20.0, budget_s * 0.5))
+    # 45s at the default budget: the r4 bench's probe window, known to
+    # fit the driver's outer clock, and a wedge's stacks are static
+    # long before it
+    bringup_deadline_s = min(parent_deadline_s, max(20.0, budget_s * 0.3))
     hung = False
     while True:
         drain()
