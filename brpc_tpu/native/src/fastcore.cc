@@ -384,12 +384,23 @@ PyObject* fc_scan_frames(PyObject*, PyObject* args) {
     Py_ssize_t a_len = Py_ssize_t(m.att);
     PyObject* rec;
     if (m.kind == 0) {
+      // service/method are proto3 strings: decode STRICTLY, but a
+      // peer sending invalid UTF-8 must stop the scan (slow path —
+      // the classic protobuf parser renders the verdict), not raise
+      // out of the scanner mid-drain
+      PyObject* svc_s = PyUnicode_DecodeUTF8(
+          m.svc ? m.svc : "", (Py_ssize_t)m.svc_len, nullptr);
+      PyObject* mth_s = svc_s == nullptr ? nullptr : PyUnicode_DecodeUTF8(
+          m.mth ? m.mth : "", (Py_ssize_t)m.mth_len, nullptr);
+      if (mth_s == nullptr) {
+        Py_XDECREF(svc_s);
+        PyErr_Clear();
+        break;
+      }
       // log_id is int64 on the wire: negatives arrive as 10-byte
       // varints and must round-trip signed ("L"), not as 2^64-x
       rec = Py_BuildValue(
-          "iKs#s#Lnnnn", 0, (unsigned long long)m.cid,
-          m.svc ? m.svc : "", (Py_ssize_t)m.svc_len,
-          m.mth ? m.mth : "", (Py_ssize_t)m.mth_len,
+          "iKNNLnnnn", 0, (unsigned long long)m.cid, svc_s, mth_s,
           (long long)(int64_t)m.log_id, p_off, p_len, a_off, a_len);
     } else {
       PyObject* err_text;
