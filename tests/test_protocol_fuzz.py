@@ -198,3 +198,72 @@ def test_tpu_std_rejects_body_beyond_max_body_size():
     sock2 = _Sock()
     status, _ = proto.parse(portal2, sock2)
     assert status == PARSE_NOT_ENOUGH_DATA and not sock2.failed
+
+
+@pytest.fixture(scope="module")
+def native_echo_server():
+    """A server whose Echo is native='echo': garbage and mutated frames
+    must never crash the C serving lanes (serve_scan / scan_frames /
+    cut-through) or wedge the connection for later legit clients."""
+    svc = Service("NEcho")
+
+    @svc.method(native="echo")
+    async def Echo(cntl, request):
+        if cntl.request_attachment.size:
+            cntl.response_attachment = cntl.request_attachment
+        return bytes(request)
+
+    server = Server(ServerOptions(enable_builtin_services=False))
+    server.add_service(svc)
+    ep = server.start("tcp://127.0.0.1:0")
+    yield server, ep
+    server.stop()
+    server.join(2)
+
+
+def _necho_ok(ep):
+    ch = Channel(f"tcp://{ep.host}:{ep.port}")
+    try:
+        cntl = ch.call_sync("NEcho", "Echo", b"alive?")
+        assert not cntl.failed(), cntl.error_text
+        assert cntl.response_payload.to_bytes() == b"alive?"
+    finally:
+        ch.close()
+
+
+def test_native_lanes_survive_garbage(native_echo_server):
+    server, ep = native_echo_server
+    _necho_ok(ep)                  # claim the protocol via a real call
+    for size in (1, 12, 64, 4096, 65536):
+        _send_raw(ep, _seed.randbytes(size))
+    # TRPC-magic garbage aims straight at the C scanners
+    for size in (0, 8, 100, 8192):
+        _send_raw(ep, b"TRPC" + _seed.randbytes(size))
+    _necho_ok(ep)
+
+
+def test_native_lanes_survive_mutated_frames(native_echo_server):
+    """Valid small and LARGE (cut-through-sized) frames with random
+    byte flips, interleaved with genuine calls on the same port."""
+    from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
+
+    server, ep = native_echo_server
+
+    def frame(att_size):
+        m = pb.RpcMeta()
+        m.request.service_name = "NEcho"
+        m.request.method_name = "Echo"
+        m.correlation_id = 77
+        m.attachment_size = att_size
+        mb = m.SerializeToString()
+        att = _seed.randbytes(att_size)
+        return struct.pack(">4sII", b"TRPC", len(mb) + len(att),
+                           len(mb)) + mb + att
+    for att_size in (4, 2048, 65536):        # last one: cut-through-sized
+        f = frame(att_size)
+        for _ in range(12):
+            b = bytearray(f)
+            for _ in range(_seed.randrange(1, 8)):
+                b[_seed.randrange(len(b))] = _seed.randrange(256)
+            _send_raw(ep, bytes(b), read_timeout=0.05)
+        _necho_ok(ep)
