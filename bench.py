@@ -162,11 +162,14 @@ def measure_raw_msg_loopback(n_msgs: int = 120) -> float:
         def drain():
             b = bytearray(1 << 20)
             m = memoryview(b)
-            while got[0] < n_msgs * len(frame):
-                n = c.recv_into(m)
-                if not n:
-                    return
-                got[0] += n
+            try:
+                while got[0] < n_msgs * len(frame):
+                    n = c.recv_into(m)
+                    if not n:
+                        return
+                    got[0] += n
+            except OSError:
+                return  # main thread closed the socket under us: done
 
         th = threading.Thread(target=drain, daemon=True)
         th.start()
@@ -228,11 +231,14 @@ def measure_raw_loopback(window_s: float = 2.5) -> float:
         def drain():
             buf = bytearray(1 << 20)
             mv = memoryview(buf)
-            while not stop[0]:
-                n = c.recv_into(mv)
-                if not n:
-                    return
-                got[0] += n
+            try:
+                while not stop[0]:
+                    n = c.recv_into(mv)
+                    if not n:
+                        return
+                    got[0] += n
+            except OSError:
+                return  # main thread closed the socket under us: done
 
         th = threading.Thread(target=drain, daemon=True)
         th.start()

@@ -22,14 +22,16 @@ CXXFLAGS = ["-O2", "-g", "-std=c++17", "-fPIC", "-shared", "-pthread",
 
 # fastcore.cc is a CPython extension module (needs Python headers,
 # exports PyInit__brpc_fastcore) — built separately from the C-ABI lib
-FASTCORE_SRCS = ("fastcore.cc", "respool.cc", "queues.cc")
+FASTCORE_SRCS = ("fastcore.cc", "respool.cc", "queues.cc", "httpparse.cc")
 FASTCORE_PATH = os.path.join(_DIR, "_brpc_fastcore.so")
 
 
 def sources() -> list:
+    # fastcore.cc + httpparse.cc need Python headers: they belong to the
+    # extension module build only
     return sorted(
         os.path.join(SRC_DIR, f) for f in os.listdir(SRC_DIR)
-        if f.endswith(".cc") and f != "fastcore.cc"
+        if f.endswith(".cc") and f not in ("fastcore.cc", "httpparse.cc")
     )
 
 

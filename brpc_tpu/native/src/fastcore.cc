@@ -47,6 +47,10 @@ uint64_t bt_mpsc_pushed(bt_mpsc*);
 uint64_t bt_mpsc_drained(bt_mpsc*);
 }
 
+// httpparse.cc — native HTTP/1.x head parsing (request + response)
+PyObject* fc_http_parse_request(PyObject*, PyObject*);
+PyObject* fc_http_parse_resp_head(PyObject*, PyObject*);
+
 namespace {
 
 // ------------------------------------------------------------- varint --
@@ -681,6 +685,14 @@ PyMethodDef module_methods[] = {
      "serve_scan(view, magic, service, method, max_body=32768) -> "
      "(consumed, out_bytes, n): echo-serve matching request frames "
      "entirely in C (responses prebuilt into out_bytes)"},
+    {"http_parse_request", fc_http_parse_request, METH_VARARGS,
+     "http_parse_request(view, max_header, max_body) -> None | -1 | -2 "
+     "| (header_len, method, target, content_length, keep_alive, "
+     "headers): native HTTP/1.x request head parse (httpparse.cc)"},
+    {"http_parse_resp_head", fc_http_parse_resp_head, METH_VARARGS,
+     "http_parse_resp_head(view, max_header) -> None | -1 | -2 | "
+     "(header_len, status, headers): native HTTP/1.x response head "
+     "parse (httpparse.cc)"},
     {nullptr, nullptr, 0, nullptr},
 };
 

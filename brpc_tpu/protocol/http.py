@@ -35,6 +35,23 @@ _METHODS = (b"GET ", b"POST ", b"PUT ", b"DELETE ", b"HEAD ", b"OPTIONS ",
 _MAX_HEADER = 64 * 1024
 
 
+_FC = False          # unresolved sentinel (None is a valid answer)
+
+
+def _fastcore():
+    """The extension, or None — also None for a stale prebuilt .so that
+    predates the http symbols (the loader's fallback contract must hold
+    per-symbol, not just per-module). Memoized: the answer cannot
+    change within a process."""
+    global _FC
+    if _FC is False:
+        from brpc_tpu.native import fastcore
+        m = fastcore.get()
+        _FC = m if m is not None and hasattr(m, "http_parse_request") \
+            else None
+    return _FC
+
+
 class HttpRequest:
     __slots__ = ("method", "path", "query", "headers", "body", "keep_alive")
 
@@ -99,37 +116,61 @@ class HttpProtocol(Protocol):
                    else head.startswith(m) for m in _METHODS):
             return PARSE_TRY_OTHERS, None
         raw = portal.peek_bytes(min(portal.size, _MAX_HEADER))
-        sep = raw.find(b"\r\n\r\n")
-        if sep < 0:
-            if portal.size >= _MAX_HEADER:
-                return PARSE_TRY_OTHERS, None  # header flood: drop conn
+        # fast lane: one native pass for head-find + start line + header
+        # dict (httpparse.cc — the reference's C http_parser role,
+        # details/http_parser.cpp). DEFER (-2) means "only CPython
+        # semantics can judge these bytes": fall to the classic parser,
+        # so the lanes cannot diverge (differential fuzz:
+        # tests/test_http_native.py).
+        parsed = None
+        ext = _fastcore()
+        if ext is not None:
+            r = ext.http_parse_request(raw, _MAX_HEADER,
+                                       flag("max_body_size"))
+            if r is None:
+                return PARSE_NOT_ENOUGH_DATA, None
+            if isinstance(r, tuple):
+                parsed = r
+            elif r == -1:
+                return PARSE_TRY_OTHERS, None
+            # r == -2: defer to the classic lane below
+        if parsed is None:
+            sep = raw.find(b"\r\n\r\n")
+            if sep < 0:
+                if portal.size >= _MAX_HEADER:
+                    return PARSE_TRY_OTHERS, None  # header flood: drop conn
+                return PARSE_NOT_ENOUGH_DATA, None
+            header_bytes = raw[:sep]
+            lines = header_bytes.split(b"\r\n")
+            try:
+                method, target, _version = \
+                    lines[0].decode("latin1").split(" ", 2)
+            except ValueError:
+                return PARSE_TRY_OTHERS, None
+            headers = {}
+            for line in lines[1:]:
+                k, _, v = line.decode("latin1").partition(":")
+                headers[k.strip().lower()] = v.strip()
+            try:
+                body_len = int(headers.get("content-length", "0") or "0")
+            except ValueError:
+                return PARSE_TRY_OTHERS, None  # malformed: drop connection
+            if body_len < 0 or body_len > flag("max_body_size"):
+                return PARSE_TRY_OTHERS, None
+            keep_alive = \
+                headers.get("connection", "keep-alive").lower() != "close"
+            parsed = (sep + 4, method.upper(), target, body_len,
+                      keep_alive, headers)
+        # shared tail: both lanes produced the same normalized head
+        header_len, method, target, body_len, keep_alive, headers = parsed
+        if portal.size < header_len + body_len:
             return PARSE_NOT_ENOUGH_DATA, None
-        header_bytes = raw[:sep]
-        lines = header_bytes.split(b"\r\n")
-        try:
-            method, target, _version = lines[0].decode("latin1").split(" ", 2)
-        except ValueError:
-            return PARSE_TRY_OTHERS, None
-        headers = {}
-        for line in lines[1:]:
-            k, _, v = line.decode("latin1").partition(":")
-            headers[k.strip().lower()] = v.strip()
-        try:
-            body_len = int(headers.get("content-length", "0") or "0")
-        except ValueError:
-            return PARSE_TRY_OTHERS, None  # malformed: drop the connection
-        if body_len < 0 or body_len > flag("max_body_size"):
-            return PARSE_TRY_OTHERS, None
-        total = sep + 4 + body_len
-        if portal.size < total:
-            return PARSE_NOT_ENOUGH_DATA, None
-        portal.pop_front(sep + 4)
+        portal.pop_front(header_len)
         body = portal.cut(body_len).to_bytes()
-        parsed = urllib.parse.urlsplit(target)
-        query = dict(urllib.parse.parse_qsl(parsed.query))
-        keep_alive = headers.get("connection", "keep-alive").lower() != "close"
-        return PARSE_OK, HttpRequest(method.upper(), parsed.path, query,
-                                     headers, body, keep_alive)
+        split = urllib.parse.urlsplit(target)
+        query = dict(urllib.parse.parse_qsl(split.query))
+        return PARSE_OK, HttpRequest(method, split.path, query, headers,
+                                     body, bool(keep_alive))
 
     # -------------------------------------------------------------- process
     def process_inline(self, req: HttpRequest, socket) -> bool:

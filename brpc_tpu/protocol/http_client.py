@@ -45,6 +45,21 @@ _MAX_HEADER = 64 * 1024
 _MAX_CHUNK_LINE = 128
 
 
+_FC = False          # unresolved sentinel (None is a valid answer)
+
+
+def _fastcore():
+    """The extension, or None — also None for a stale prebuilt .so that
+    predates the http symbols (same memoized seam as protocol/http.py)."""
+    global _FC
+    if _FC is False:
+        from brpc_tpu.native import fastcore
+        m = fastcore.get()
+        _FC = m if m is not None and hasattr(m, "http_parse_resp_head") \
+            else None
+    return _FC
+
+
 class HttpClientError(ConnectionError):
     pass
 
@@ -86,22 +101,39 @@ class HttpResponseProtocol(Protocol):
                     not head.startswith(b"HTTP/1."):
                 return PARSE_TRY_OTHERS, None
             raw = portal.peek_bytes(min(portal.size, _MAX_HEADER))
-            sep = raw.find(b"\r\n\r\n")
-            if sep < 0:
-                if portal.size >= _MAX_HEADER:
+            # fast lane: native head parse (httpparse.cc); DEFER (-2)
+            # falls to the classic loop below so semantics are CPython's
+            # on anything exotic (tests/test_http_native.py fuzzes both)
+            parsed = None
+            ext = _fastcore()
+            if ext is not None:
+                r = ext.http_parse_resp_head(raw, _MAX_HEADER)
+                if r is None:
+                    return PARSE_NOT_ENOUGH_DATA, None
+                if isinstance(r, tuple):
+                    parsed = r
+                elif r == -1:
                     return PARSE_TRY_OTHERS, None
-                return PARSE_NOT_ENOUGH_DATA, None
-            lines = raw[:sep].split(b"\r\n")
-            try:
-                _version, code, *_ = lines[0].decode("latin1").split(" ", 2)
-                st.status = int(code)
-            except ValueError:
-                return PARSE_TRY_OTHERS, None
-            st.headers = {}
-            for line in lines[1:]:
-                k, _, v = line.decode("latin1").partition(":")
-                st.headers[k.strip().lower()] = v.strip()
-            portal.pop_front(sep + 4)
+            if parsed is None:
+                sep = raw.find(b"\r\n\r\n")
+                if sep < 0:
+                    if portal.size >= _MAX_HEADER:
+                        return PARSE_TRY_OTHERS, None
+                    return PARSE_NOT_ENOUGH_DATA, None
+                lines = raw[:sep].split(b"\r\n")
+                try:
+                    _version, code, *_ = \
+                        lines[0].decode("latin1").split(" ", 2)
+                    status = int(code)
+                except ValueError:
+                    return PARSE_TRY_OTHERS, None
+                headers = {}
+                for line in lines[1:]:
+                    k, _, v = line.decode("latin1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                parsed = (sep + 4, status, headers)
+            header_len, st.status, st.headers = parsed
+            portal.pop_front(header_len)
             # bodiless by RFC 9110 §6.4.1: HEAD responses (whatever
             # their entity headers claim), 1xx, 204, 304 — waiting for
             # the advertised body would stall until timeout

@@ -1,0 +1,309 @@
+"""Differential tests: native HTTP head parse (httpparse.cc) vs the
+classic Python lanes.
+
+The native parser's contract is exact parity-or-DEFER: for any byte
+string it must either return precisely what the Python parser would, or
+return DEFER (-2) so the wrapper falls back to the classic path. These
+tests drive BOTH lanes (native on / native off) over golden cases and a
+seeded fuzz corpus and require identical end results — parse status,
+parsed fields, and portal consumption. Mirrors the reference's reliance
+on a battle-tested C parser (details/http_parser.cpp) while keeping the
+Python semantics authoritative.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from brpc_tpu.butil.iobuf import IOPortal
+from brpc_tpu.native import fastcore
+from brpc_tpu.protocol import http as http_mod
+from brpc_tpu.protocol import http_client as http_client_mod
+from brpc_tpu.protocol.http import HttpProtocol, HttpRequest
+from brpc_tpu.protocol.http_client import HttpResponseProtocol
+from brpc_tpu.protocol.registry import (
+    PARSE_NOT_ENOUGH_DATA, PARSE_OK, PARSE_TRY_OTHERS)
+
+pytestmark = pytest.mark.skipif(
+    fastcore.get() is None or
+    not hasattr(fastcore.get(), "http_parse_request"),
+    reason="fastcore extension unavailable")
+
+
+class _Sock:
+    def __init__(self):
+        self.failed = False
+        self.preferred_protocol = -1
+        self.user_data = {}
+
+    def set_failed(self, e):
+        self.failed = True
+        self.reason = e
+
+
+def _snap_request(msg):
+    if isinstance(msg, HttpRequest):
+        return (msg.method, msg.path, sorted(msg.query.items()),
+                sorted(msg.headers.items()), msg.body, msg.keep_alive)
+    return msg
+
+
+_REAL_FC_HTTP = http_mod._fastcore
+_REAL_FC_CLIENT = http_client_mod._fastcore
+
+
+def _parse_request_lane(data: bytes, native: bool, monkeypatch):
+    proto = HttpProtocol()
+    portal = IOPortal()
+    portal.append(data)
+    sock = _Sock()
+    monkeypatch.setattr(http_mod, "_fastcore",
+                        _REAL_FC_HTTP if native else (lambda: None))
+    status, msg = proto.parse(portal, sock)
+    return status, _snap_request(msg), portal.size, sock.failed
+
+
+def _assert_request_parity(data: bytes, monkeypatch):
+    a = _parse_request_lane(data, True, monkeypatch)
+    b = _parse_request_lane(data, False, monkeypatch)
+    assert a == b, f"lane divergence on {data[:120]!r}: {a} vs {b}"
+    return a
+
+
+GOLDEN_REQUESTS = [
+    b"GET / HTTP/1.1\r\n\r\n",
+    b"GET /vars?x=1&y=b HTTP/1.1\r\nHost: h\r\n\r\n",
+    b"POST /Svc/M HTTP/1.1\r\nContent-Length: 5\r\n\r\nhello",
+    b"POST /Svc/M HTTP/1.1\r\nContent-Length: 5\r\n\r\nhel",       # short
+    b"GET / HTTP/1.1\r\nConnection: close\r\n\r\n",
+    b"GET / HTTP/1.1\r\nConnection: CLOSE\r\n\r\n",
+    b"GET / HTTP/1.1\r\nConnection: Keep-Alive\r\n\r\n",
+    b"GET / HTTP/1.0\r\n\r\n",                       # version ignored
+    b"GET  /double-space HTTP/1.1\r\n\r\n",          # empty target token
+    b"GET /\r\n\r\n",                                # no version: 2 tokens
+    b"GET\r\n\r\n",                                  # 1 token
+    b"OPTIONS * HTTP/1.1\r\n\r\n",
+    b"OPTIO",                                        # method prefix only
+    b"PATCH",                                        # prefix, no space yet
+    b"DELETE /x HTTP/1.1\r\nX: 1\r\nX: 2\r\n\r\n",   # dup: last wins
+    b"GET /x HTTP/1.1\r\n  Key  :  padded  \r\n\r\n",
+    b"GET /x HTTP/1.1\r\nNoColonLine\r\n\r\n",
+    b"GET /x HTTP/1.1\r\n: empty-key\r\n\r\n",
+    b"GET /x HTTP/1.1\r\nA:\r\n\r\n",                # empty value
+    b"GET /x HTTP/1.1\r\nContent-Length:\r\n\r\n",   # empty -> 0
+    b"GET /x HTTP/1.1\r\nContent-Length: 0007\r\n\r\nwhatever",
+    b"GET /x HTTP/1.1\r\nContent-Length: +5\r\n\r\nhello",   # defer: int()
+    b"GET /x HTTP/1.1\r\nContent-Length: 5_\r\n\r\nhello",
+    b"GET /x HTTP/1.1\r\nContent-Length: 1_0\r\n\r\nhellohello",
+    b"GET /x HTTP/1.1\r\nContent-Length: -3\r\n\r\n",
+    b"GET /x HTTP/1.1\r\nContent-Length: abc\r\n\r\n",
+    b"GET /x HTTP/1.1\r\nContent-Length: 99999999999999999999\r\n\r\n",
+    b"GET /x HTTP/1.1\r\nContent-Length: \xa07\r\n\r\n1234567",  # NBSP
+    b"GET /x HTTP/1.1\r\nK\xc3\xa9y: v\r\n\r\n",     # non-ASCII key: defer
+    b"GET /x HTTP/1.1\r\nKey: v\xff\xfe\r\n\r\n",    # non-ASCII value: ok
+    b"GET /x HTTP/1.1\r\nlone\rcr: v\r\n\r\n",       # lone \r inside line
+    b"GET /x HTTP/1.1\r\nA: b\r",                    # truncated mid-sep
+    b"GET /x HTTP/1.1\r\nA: b\r\n\r",                # 3 of 4 sep bytes
+    b"PRPC\x00\x00\x00\x10",                         # other protocol
+    b"get / HTTP/1.1\r\n\r\n",                       # lowercase: not ours
+    b"",
+    b"G",
+    b"GET /x HTTP/1.1\r\nHost: h\r\n\r\nGET /y HTTP/1.1\r\n\r\n",  # pipeline
+    b"HEAD /h HTTP/1.1\r\nCONTENT-LENGTH: 2\r\n\r\nok",  # case-folded key
+    # a lone trailing \r as the last header-block byte must stay in-line
+    b"GET /x HTTP/1.1\r\nA: b\r\r\n\r\n",
+]
+
+
+def test_request_parity_golden(monkeypatch):
+    for data in GOLDEN_REQUESTS:
+        _assert_request_parity(data, monkeypatch)
+
+
+def test_request_header_flood_parity(monkeypatch):
+    data = b"GET /x HTTP/1.1\r\n" + b"A: " + b"b" * 70000 + b"\r\n\r\n"
+    a = _assert_request_parity(data, monkeypatch)
+    assert a[0] == PARSE_TRY_OTHERS
+
+
+def test_native_lane_actually_taken():
+    """Guard against a silent always-defer: plain requests must parse in
+    C (tuple), and the documented defer cases must return -2."""
+    ext = fastcore.get()
+    r = ext.http_parse_request(
+        b"GET /x HTTP/1.1\r\nHost: h\r\n\r\n", 65536, 1 << 20)
+    assert isinstance(r, tuple)
+    assert r[1] == "GET" and r[4] == 1
+    assert ext.http_parse_request(
+        b"GET /x HTTP/1.1\r\nContent-Length: +5\r\n\r\n",
+        65536, 1 << 20) == -2
+    assert ext.http_parse_request(
+        b"GET /x HTTP/1.1\r\nK\xc3\xa9y: v\r\n\r\n", 65536, 1 << 20) == -2
+    r = ext.http_parse_resp_head(b"HTTP/1.1 200 OK\r\nA: b\r\n\r\n", 65536)
+    assert isinstance(r, tuple) and r[1] == 200
+
+
+_METHOD_POOL = ["GET", "POST", "PUT", "DELETE", "HEAD", "OPTIONS", "PATCH",
+                "GIT", "get", "G ET", ""]
+_KEY_POOL = ["Host", "Content-Length", "Connection", "X-Custom",
+             "content-length", "CONNECTION", "Transfer-Encoding",
+             "  Padded ", "No\rColon", "K\xe9y", "", ":"]
+_VAL_POOL = ["h", "close", "CLOSE", "keep-alive", "0", "5", "007", "+5",
+             "5_0", "-3", "abc", " 7 ", "\xa07", "chunked", "v\xfe", "",
+             "99999999999999999999", "1" * 30]
+
+
+def _random_request(rng: random.Random) -> bytes:
+    if rng.random() < 0.08:
+        # pure garbage
+        return bytes(rng.randrange(256) for _ in range(rng.randrange(64)))
+    method = rng.choice(_METHOD_POOL)
+    target = rng.choice(["/", "/a/b?q=1", "", "/sp ace", "*", "/x#frag"])
+    version = rng.choice(["HTTP/1.1", "HTTP/1.0", "", "hTTp", "HTTP/1.1 x"])
+    line = method + " " + target + (" " + version if version else
+                                    ("" if rng.random() < 0.5 else " "))
+    if rng.random() < 0.1:
+        line = method + target        # missing spaces entirely
+    parts = [line]
+    for _ in range(rng.randrange(6)):
+        k = rng.choice(_KEY_POOL)
+        v = rng.choice(_VAL_POOL)
+        sep = rng.choice([": ", ":", " : ", ""])
+        parts.append(k + sep + v)
+    data = ("\r\n".join(parts) + "\r\n\r\n").encode("latin1")
+    body_len = rng.randrange(12)
+    data += bytes(ord("b") for _ in range(body_len))
+    if rng.random() < 0.2:
+        data = data[:rng.randrange(len(data) + 1)]   # truncate
+    if rng.random() < 0.05:
+        pos = rng.randrange(len(data) + 1)
+        data = data[:pos] + bytes([rng.randrange(256)]) + data[pos:]
+    return data
+
+
+def test_request_parity_fuzz(monkeypatch):
+    rng = random.Random(0xB1FF)
+    kinds = set()
+    for _ in range(2500):
+        data = _random_request(rng)
+        a = _assert_request_parity(data, monkeypatch)
+        kinds.add(a[0])
+    # the corpus must exercise every outcome class
+    assert kinds == {PARSE_OK, PARSE_TRY_OTHERS, PARSE_NOT_ENOUGH_DATA}
+
+
+# ---------------------------------------------------------------- responses
+
+
+def _drive_response_lane(data: bytes, native: bool, monkeypatch):
+    monkeypatch.setattr(http_client_mod, "_fastcore",
+                        _REAL_FC_CLIENT if native else (lambda: None))
+    proto = HttpResponseProtocol()
+    portal = IOPortal()
+    portal.append(data)
+    sock = _Sock()
+    events = []
+    statuses = []
+    for _ in range(30):
+        status, msgs = proto.parse(portal, sock)
+        statuses.append(status)
+        if status != PARSE_OK:
+            break
+        events.extend(msgs)
+    st = sock.user_data.get("http_resp_state")
+    st_snap = (st.phase, st.status, sorted(st.headers.items()), st.mode,
+               st.remaining) if st is not None else None
+    return statuses, events, portal.size, st_snap
+
+
+def _assert_response_parity(data: bytes, monkeypatch):
+    a = _drive_response_lane(data, True, monkeypatch)
+    b = _drive_response_lane(data, False, monkeypatch)
+    assert a == b, f"resp lane divergence on {data[:120]!r}:\n{a}\nvs\n{b}"
+    return a
+
+
+GOLDEN_RESPONSES = [
+    b"HTTP/1.1 200 OK\r\nContent-Length: 5\r\n\r\nhello",
+    b"HTTP/1.1 200 OK\r\nContent-Length: 0\r\n\r\n",
+    b"HTTP/1.1 204 No Content\r\n\r\n",
+    b"HTTP/1.1 304 Not Modified\r\nContent-Length: 9\r\n\r\n",
+    b"HTTP/1.1 100 Continue\r\n\r\nHTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok",
+    b"HTTP/1.1 200 OK\r\nTransfer-Encoding: chunked\r\n\r\n3\r\nabc\r\n0\r\n\r\n",
+    b"HTTP/1.1 200 OK\r\n\r\nclose-delimited-body",
+    b"HTTP/1.1 200\r\nContent-Length: 2\r\n\r\nok",     # no reason phrase
+    b"HTTP/1.1 abc OK\r\n\r\n",                         # bad status
+    b"HTTP/1.1 2_0 OK\r\n\r\n",                         # int() underscore
+    b"HTTP/1.1 +200 OK\r\n\r\n",                        # int() sign: defer
+    b"HTTP/1.1 -1 OK\r\nContent-Length: 2\r\n\r\nok",   # negative status
+    b"HTTP/1.1  200 OK\r\n\r\n",                        # double space
+    b"HTTP/1.1\r\n\r\n",                                # no space at all
+    b"HTTP/2 200\r\n\r\n",                              # not 1.x
+    b"HTTP/1.",                                         # prefix only
+    b"junk",
+    b"",
+    b"HTTP/1.1 200 OK\r\nContent-Length: abc\r\n\r\n",  # classic: TRY_OTHERS
+    b"HTTP/1.1 200 OK\r\nContent-Length: -5\r\n\r\n",
+    b"HTTP/1.1 200 OK\r\nA: b\r",                       # truncated
+]
+
+
+def test_response_parity_golden(monkeypatch):
+    for data in GOLDEN_RESPONSES:
+        _assert_response_parity(data, monkeypatch)
+
+
+def _random_response(rng: random.Random) -> bytes:
+    if rng.random() < 0.08:
+        return bytes(rng.randrange(256) for _ in range(rng.randrange(48)))
+    version = rng.choice(["HTTP/1.1", "HTTP/1.0", "HTTP/1.", "HTTP/2", ""])
+    code = rng.choice(["200", "204", "304", "100", "404", "500", "007",
+                       "abc", "+1", "2_0", "-8", "", "99999999999"])
+    reason = rng.choice(["OK", "", "Not Found", "O K"])
+    line = " ".join(x for x in (version, code, reason) if x) \
+        if rng.random() < 0.8 else version + code
+    parts = [line]
+    for _ in range(rng.randrange(5)):
+        k = rng.choice(_KEY_POOL)
+        v = rng.choice(_VAL_POOL)
+        parts.append(k + rng.choice([": ", ":"]) + v)
+    data = ("\r\n".join(parts) + "\r\n\r\n").encode("latin1")
+    data += bytes(ord("x") for _ in range(rng.randrange(16)))
+    if rng.random() < 0.2:
+        data = data[:rng.randrange(len(data) + 1)]
+    return data
+
+
+def test_response_parity_fuzz(monkeypatch):
+    rng = random.Random(0x5EED)
+    for _ in range(2500):
+        _assert_response_parity(_random_response(rng), monkeypatch)
+
+
+def test_http_server_still_serves_with_native_lane():
+    """End-to-end: the builtin pages parse through the native lane (it
+    is on by default) and real responses come back."""
+    from brpc_tpu.protocol.http_client import HttpClient
+    from brpc_tpu.rpc import Server, ServerOptions, Service
+
+    svc = Service("T")
+
+    @svc.method()
+    def Echo(cntl, request):
+        return bytes(request)
+
+    server = Server(ServerOptions())
+    server.add_service(svc)
+    ep = server.start("tcp://127.0.0.1:0")
+    try:
+        client = HttpClient(f"127.0.0.1:{ep.port}")
+        status, headers, body = client.request("GET", "/health")
+        assert status == 200
+        status, headers, body = client.request(
+            "POST", "/T/Echo", body=b"roundtrip")
+        assert status == 200 and b"roundtrip" in body
+        client.close()
+    finally:
+        server.stop()
