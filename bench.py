@@ -429,6 +429,12 @@ def main() -> None:
                    "wired": [
                        "pack_frame (tpu_std request+response framing)",
                        "parse_head (tpu_std frame probe)",
+                       "scan_frames (per-call loop: frame cut + meta "
+                       "decode in one C pass)",
+                       "serve_scan (echo-class methods served "
+                       "end-to-end in C)",
+                       "http_parse_request / http_parse_resp_head "
+                       "(HTTP/1.x head parse, httpparse.cc)",
                        "respool.cc Pool (correlation ids + socket ids)",
                        "queues.cc Mpsc writer-retire (socket write queue)",
                        "crc32c", "murmur3 (c_murmurhash LB)",
