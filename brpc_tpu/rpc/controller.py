@@ -252,7 +252,7 @@ class Controller:
         with self._arb_lock:
             self._completed = False
             self.__dict__.pop("_finalized", None)
-            self.__dict__.pop("_issue_socket", None)
+            self._set_issue_socket(None)
             # fresh lazy event next call: a stale one-shot event would
             # make join() return with the previous call's payload
             self.__dict__.pop("_done_event", None)
@@ -261,6 +261,8 @@ class Controller:
         # only materialize them
         d = self.__dict__
         d.pop("end_us", None)
+        d.pop("_pending_deadline", None)   # stale lazy deadline would
+        #                                    clamp the new call's pluck
         d.pop("response_payload", None)
         d.pop("response_attachment", None)
         d.pop("response_device_arrays", None)
@@ -277,6 +279,44 @@ class Controller:
                 self.tried_servers.clear()
                 self._lb_swept_n = None
                 self._lb_fed = []
+
+    def _set_issue_socket(self, sock) -> None:
+        """Balanced per-socket in-flight accounting around every
+        _issue_socket assignment (issue, retry/backup re-issue, reset,
+        completion): socket.client_inflight counts calls issued and not
+        yet completed on the socket, which gates the lazy-deadline
+        pluck (join). The old->new swap runs under _arb_lock — a backup
+        re-issue on the timer thread racing completion on the IO thread
+        must not both read the same 'old' (double-decrement + leaked
+        increment would skew the gate permanently); each thread then
+        applies its own counter deltas, which commute."""
+        d = self.__dict__
+        with self._arb_lock:
+            old = d.get("_issue_socket")
+            if old is sock:
+                return
+            if sock is None:
+                d.pop("_issue_socket", None)
+            else:
+                d["_issue_socket"] = sock
+        lazy_to_arm = None
+        if old is not None:
+            with old.pending_lock:
+                old.client_inflight -= 1
+        if sock is not None:
+            with sock.pending_lock:
+                sock.client_inflight += 1
+                if sock.client_inflight > 1:
+                    # a lazy-deadline plucker owns this socket's input:
+                    # OUR (possibly huge) response will run through its
+                    # processing pass, during which its deadline cannot
+                    # preempt — give it the real timer it skipped. The
+                    # pending_lock orders this against the plucker's own
+                    # register-or-arm decision in join(), so one side
+                    # always arms.
+                    lazy_to_arm = sock._lazy_plucker
+        if lazy_to_arm is not None and lazy_to_arm is not self:
+            lazy_to_arm._arm_lazy_deadline()
 
     def _register_call(self) -> int:
         try:
@@ -332,7 +372,7 @@ class Controller:
                 pass
         # a completed call must not pin its socket (conn + portal read
         # blocks) for the controller's lifetime
-        d.pop("_issue_socket", None)
+        self._set_issue_socket(None)
         cb = self._done_cb
         # joiners may only observe completion AFTER end_us, timer
         # cancellation and the completion hooks above — _finalized (not
@@ -437,21 +477,74 @@ class Controller:
         if self._finalized:
             return True
         sock = self._issue_socket
+        pend = self.__dict__.get("_pending_deadline")
         if sock is not None and not sock.failed:
             from brpc_tpu.fiber.scheduler import current_group
             if current_group() is None:
                 deadline = time.monotonic() + (
                     timeout_s if timeout_s is not None else 86400.0)
+                if pend is not None:
+                    # multiplex gate, bilateral with _set_issue_socket:
+                    # under the same lock, either we see other calls in
+                    # flight (keep the real timer), or we register as
+                    # the socket's lazy plucker so a later issuer arms
+                    # our timer for us — no window where a big foreign
+                    # response can stall the deadline with no timer
+                    with sock.pending_lock:
+                        if sock.client_inflight > 1:
+                            pend = None
+                        else:
+                            sock._lazy_plucker = self
+                    if pend is None:
+                        self._arm_lazy_deadline()
+                # lazy deadline (call_sync): the plucker IS the timer —
+                # clamp the pluck to the RPC deadline and fire the final
+                # timeout path ourselves if it passes (same thread-safe
+                # take the timer thread would do)
+                pluck_deadline = deadline if pend is None \
+                    else min(deadline, pend[1])
                 try:
-                    if sock.pluck_until(lambda: self._finalized, deadline):
+                    if sock.pluck_until(lambda: self._finalized,
+                                        pluck_deadline):
                         return True
                 except Exception:
                     pass   # pluck is an optimization, never a failure
+                finally:
+                    if pend is not None:
+                        with sock.pending_lock:
+                            if sock._lazy_plucker is self:
+                                sock._lazy_plucker = None
+                if pend is not None and not self._finalized and \
+                        time.monotonic() >= pend[1]:
+                    try:
+                        pend[0]._on_timeout(self)
+                    except Exception:
+                        pass
+                    if self._finalized:
+                        return True
                 if timeout_s is not None:
                     timeout_s = max(0.0, deadline - time.monotonic())
+        # leaving the pluck lane (escalation, failed socket, fiber
+        # caller, claim contention): the deadline needs a real timer
+        self._arm_lazy_deadline()
         ev = self._join_event()
         return True if ev is None else ev.wait_pthread(timeout_s)
 
+    def _arm_lazy_deadline(self) -> None:
+        """Convert a pending (lazily-enforced) deadline into a real
+        timer — called whenever the call leaves the sync-pluck lane, so
+        deadline semantics are identical to the eager path from here."""
+        pend = self.__dict__.pop("_pending_deadline", None)
+        if pend is None or self._finalized:
+            return
+        ch, dl = pend
+        from brpc_tpu.fiber.timer import global_timer
+        tid = global_timer().schedule_at(dl, lambda: ch._on_timeout(self))
+        self._timer_ids.append(tid)
+        if self._completed:      # completion interleaved with the arm
+            global_timer().unschedule(tid)
+
     async def join_async(self, timeout_s: Optional[float] = None) -> bool:
+        self._arm_lazy_deadline()   # fiber joiner cannot pluck-enforce
         ev = self._join_event()
         return True if ev is None else await ev.wait(timeout_s)

@@ -165,6 +165,15 @@ class Socket:
         # frame-safe while no other response can interleave
         self.pending_responses = 0
         self.pending_lock = threading.Lock()
+        # client-side calls currently issued on this socket (balanced by
+        # Controller._set_issue_socket) — the sync-pluck lazy-deadline
+        # gate: with >1 in flight, another call's big response could
+        # stall a plucker past its deadline, so those joiners keep the
+        # real timer; _lazy_plucker is the controller currently plucking
+        # WITH a lazy deadline, armed by a later issuer (both under
+        # pending_lock)
+        self.client_inflight = 0
+        self._lazy_plucker = None
         self._busy_rearmed = False   # one probe re-arm per busy period
         self._busy_paused = False    # level-trigger: read interest paused
         self._read_hint = 8192                    # adaptive read-block size

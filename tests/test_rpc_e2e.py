@@ -815,3 +815,177 @@ class TestControllerNotPinned:
             assert alive == 0, (f"{alive}/5 completed controllers still "
                                 "pinned (timer heap holds them for the "
                                 "30s deadline)")
+
+
+class TestLazyDeadline:
+    """call_sync's sync-pluck lane enforces the RPC deadline itself
+    (channel.py _lazy_deadline): the common completed-in-time call never
+    touches the timer heap, and the plucker fires the final timeout at
+    timeout_ms — not at the join budget (timeout + 5s)."""
+
+    def test_pluck_lane_fires_deadline_on_time(self):
+        server = make_echo_server()
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                         ChannelOptions(timeout_ms=100, max_retry=0))
+            t0 = time.monotonic()
+            cntl = ch.call_sync("EchoService", "Slow", b"x")  # 0.3s handler
+            dt = time.monotonic() - t0
+            assert cntl.error_code == berr.ERPCTIMEDOUT, cntl.error_text
+            # fired by the plucker at ~100ms: before the handler's 0.3s
+            # response and far before the 5.1s join budget
+            assert dt < 0.28, f"deadline fired late: {dt*1e3:.0f}ms"
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_no_timer_heap_touch_on_fast_path(self):
+        """A completed-in-time sync call must arm nothing: the timer
+        heap sequence is unchanged across the call."""
+        from brpc_tpu.fiber.timer import global_timer
+        server = make_echo_server()
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                         ChannelOptions(timeout_ms=5000))
+            ch.call_sync("EchoService", "Echo", b"warm")
+            t = global_timer()
+            before = len(t._boxes) + getattr(t, "_ndead", 0)
+            for _ in range(20):
+                cntl = ch.call_sync("EchoService", "Echo", b"ping")
+                assert not cntl.failed(), cntl.error_text
+            after = len(t._boxes) + getattr(t, "_ndead", 0)
+            assert after == before, (
+                f"fast-path sync calls touched the timer heap "
+                f"({before} -> {after})")
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_reused_controller_clears_stale_pending_deadline(self):
+        server = make_echo_server()
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                         ChannelOptions(timeout_ms=100, max_retry=0))
+            cntl = ch.call_sync("EchoService", "Slow", b"x")
+            assert cntl.error_code == berr.ERPCTIMEDOUT
+            # let the 0.3s handler drain: the reused call must not queue
+            # behind it on the worker (that would be a real timeout)
+            time.sleep(0.35)
+            # reuse the SAME controller (timeout_ms=100 sticks — channel
+            # fill-in semantics): its pending deadline from the timed-out
+            # call is EXPIRED; if reuse failed to clear it, the fast echo
+            # below would be killed instantly at join instead of getting
+            # a fresh 100ms window
+            cntl2 = ch.call_sync("EchoService", "Echo", b"y", cntl=cntl)
+            assert not cntl2.failed(), cntl2.error_text
+            assert cntl2.response_payload.to_bytes() == b"y"
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_multiplexed_socket_keeps_real_timer(self, monkeypatch):
+        """With another call in flight on the same (multiplexed) socket,
+        a sync joiner must convert its lazy deadline into a real timer:
+        the other call's response can stall the plucker's processing
+        pass, during which a lazy deadline cannot preempt."""
+        import threading
+
+        from brpc_tpu.rpc.controller import Controller
+
+        armed = []
+        orig = Controller._arm_lazy_deadline
+
+        def spy(self):
+            if "_pending_deadline" in self.__dict__:
+                armed.append(self)
+            orig(self)
+
+        monkeypatch.setattr(Controller, "_arm_lazy_deadline", spy)
+        server = make_echo_server()
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                         ChannelOptions(timeout_ms=5000))
+            ch.call_sync("EchoService", "Echo", b"warm")
+            done_ev = threading.Event()
+            ch.call("EchoService", "Slow", b"b",
+                    done=lambda c: done_ev.set())     # in flight: 0.3s
+            a = ch.call_sync("EchoService", "Echo", b"a")
+            assert not a.failed(), a.error_text
+            assert any(c is a for c in armed), (
+                "sync joiner on a shared socket kept the lazy deadline")
+            assert done_ev.wait(5)
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_inflight_accounting_balances(self):
+        """socket.client_inflight returns to 0 after sync, async, and
+        timed-out calls (the lazy-deadline gate depends on it)."""
+        server = make_echo_server()
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                         ChannelOptions(timeout_ms=100, max_retry=0))
+            for _ in range(3):
+                ch.call_sync("EchoService", "Echo", b"x")
+            ch.call_sync("EchoService", "Slow", b"x")      # times out
+            import time as _t
+            _t.sleep(0.35)                                  # drain Slow
+            sock = ch._get_socket()
+            assert sock.client_inflight == 0, sock.client_inflight
+        finally:
+            server.stop()
+            server.join(2)
+
+    def test_issuer_arms_inflight_lazy_plucker(self, monkeypatch):
+        """The gate is bilateral: a call issued WHILE a lazy-deadline
+        plucker owns the socket must arm that plucker's real timer (the
+        new call's response could stall the plucker's processing pass
+        past its deadline)."""
+        import threading
+
+        from brpc_tpu.rpc.controller import Controller
+
+        armed = []
+        orig = Controller._arm_lazy_deadline
+
+        def spy(self):
+            if "_pending_deadline" in self.__dict__:
+                armed.append(self)
+            orig(self)
+
+        monkeypatch.setattr(Controller, "_arm_lazy_deadline", spy)
+        server = make_echo_server()
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                         ChannelOptions(timeout_ms=5000))
+            ch.call_sync("EchoService", "Echo", b"warm")
+            holder = {}
+
+            def runner():
+                holder["a"] = ch.call_sync("EchoService", "Slow", b"x")
+
+            t = threading.Thread(target=runner)
+            t.start()
+            time.sleep(0.1)           # A is plucking (registered) now
+            done = threading.Event()
+            ch.call("EchoService", "Echo", b"b",
+                    done=lambda c: done.set())
+            t.join(5)
+            assert done.wait(5)
+            a = holder.get("a")
+            assert a is not None and not a.failed(), getattr(
+                a, "error_text", "no controller")
+            assert any(c is a for c in armed), (
+                "issuer did not arm the in-flight lazy plucker's timer")
+            sock = ch._get_socket()
+            assert sock.client_inflight == 0
+            assert sock._lazy_plucker is None
+        finally:
+            server.stop()
+            server.join(2)
