@@ -24,7 +24,13 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <errno.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <time.h>
+
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 
 // ---- C cores (compiled into this module; see respool.cc / queues.cc)
@@ -451,29 +457,26 @@ PyObject* fc_scan_frames(PyObject*, PyObject* args) {
 // oversized, slow-featured, or addressed elsewhere — those take the
 // normal dispatch paths.
 
-PyObject* fc_serve_scan(PyObject*, PyObject* args) {
-  Py_buffer view, magic, svc, mth;
-  Py_ssize_t max_body = 32768;
-  if (!PyArg_ParseTuple(args, "y*y*y*y*|n", &view, &magic, &svc, &mth,
-                        &max_body))
-    return nullptr;
-  const unsigned char* d = static_cast<const unsigned char*>(view.buf);
+// Shared echo-serve core (serve_scan over a portal view, serve_drain
+// over the thread-local recv buffer): scan the front run of eligible
+// request frames in [d, d+len) and prebuild their response frames —
+// two passes (measure, then write into one exact-size bytes object).
+// Returns the response bytes (possibly empty) or nullptr on allocation
+// failure; *off_out = consumed bytes, *n_out = frames served. ONE copy
+// of the eligibility ladder and the response meta layout, so the two
+// entry points cannot diverge.
+PyObject* serve_core(const unsigned char* d, Py_ssize_t len,
+                     const void* magic, const Py_buffer& svc,
+                     const Py_buffer& mth, Py_ssize_t max_body,
+                     Py_ssize_t* off_out, Py_ssize_t* n_out) {
   Py_ssize_t off = 0;
   Py_ssize_t n_served = 0;
-  // first pass: measure eligible frames + total response size
   Py_ssize_t out_size = 0;
   struct Item { Py_ssize_t off; MetaScan m; };
   Item items[128];
-  if (magic.len != 4) {
-    PyBuffer_Release(&view); PyBuffer_Release(&magic);
-    PyBuffer_Release(&svc); PyBuffer_Release(&mth);
-    PyErr_SetString(PyExc_ValueError, "magic must be 4 bytes");
-    return nullptr;
-  }
   while (n_served < 128) {
     MetaScan m;
-    Py_ssize_t total = cut_fast_frame(d, off, view.len, magic.buf,
-                                      max_body, &m);
+    Py_ssize_t total = cut_fast_frame(d, off, len, magic, max_body, &m);
     if (total < 0) break;
     if (m.kind != 0) break;
     if (m.svc_len != size_t(svc.len) || m.mth_len != size_t(mth.len) ||
@@ -490,11 +493,7 @@ PyObject* fc_serve_scan(PyObject*, PyObject* args) {
     off += total;
   }
   PyObject* out = PyBytes_FromStringAndSize(nullptr, out_size);
-  if (out == nullptr) {
-    PyBuffer_Release(&view); PyBuffer_Release(&magic);
-    PyBuffer_Release(&svc); PyBuffer_Release(&mth);
-    return nullptr;
-  }
+  if (out == nullptr) return nullptr;
   char* w = PyBytes_AS_STRING(out);
   for (Py_ssize_t i = 0; i < n_served; ++i) {
     const MetaScan& m = items[i].m;
@@ -503,7 +502,7 @@ PyObject* fc_serve_scan(PyObject*, PyObject* args) {
     Py_ssize_t pa_len = Py_ssize_t(m.body - meta_size);  // payload + att
     size_t resp_meta = 1 + varint_len(m.cid) +
                        (m.att ? 1 + varint_len(m.att) : 0);
-    memcpy(w, magic.buf, 4);
+    memcpy(w, magic, 4);
     store_be32(w + 4, static_cast<uint32_t>(resp_meta + pa_len));
     store_be32(w + 8, static_cast<uint32_t>(resp_meta));
     w += 12;
@@ -516,9 +515,284 @@ PyObject* fc_serve_scan(PyObject*, PyObject* args) {
     memcpy(w, h + 12 + meta_size, pa_len);  // payload + attachment echo
     w += pa_len;
   }
+  *off_out = off;
+  *n_out = n_served;
+  return out;
+}
+
+PyObject* fc_serve_scan(PyObject*, PyObject* args) {
+  Py_buffer view, magic, svc, mth;
+  Py_ssize_t max_body = 32768;
+  if (!PyArg_ParseTuple(args, "y*y*y*y*|n", &view, &magic, &svc, &mth,
+                        &max_body))
+    return nullptr;
+  PyObject* r = nullptr;
+  if (magic.len != 4) {
+    PyErr_SetString(PyExc_ValueError, "magic must be 4 bytes");
+  } else {
+    Py_ssize_t off = 0, n_served = 0;
+    PyObject* out = serve_core(
+        static_cast<const unsigned char*>(view.buf), view.len, magic.buf,
+        svc, mth, max_body, &off, &n_served);
+    if (out != nullptr)
+      r = Py_BuildValue("nNn", off, out, n_served);
+  }
   PyBuffer_Release(&view); PyBuffer_Release(&magic);
   PyBuffer_Release(&svc); PyBuffer_Release(&mth);
-  return Py_BuildValue("nNn", off, out, n_served);
+  return r;
+}
+
+// ---------------------------------------------------------- fd loops --
+// Thread-local scratch for the native socket loops. Safe: only the
+// owning OS thread touches its buffer, and the GIL is released solely
+// around syscalls (the buffer is not shared across threads).
+struct TlBuf {
+  unsigned char* p = nullptr;
+  size_t cap = 0;
+  // reclaimed at thread exit — short-lived threads doing one sync RPC
+  // each must not leak a buffer per thread
+  ~TlBuf() { free(p); }
+};
+
+inline unsigned char* tl_reserve(TlBuf& b, size_t need) {
+  if (b.cap < need) {
+    size_t ncap = b.cap ? b.cap : 65536;
+    while (ncap < need) ncap <<= 1;
+    unsigned char* np = static_cast<unsigned char*>(realloc(b.p, ncap));
+    if (np == nullptr) return nullptr;
+    b.p = np;
+    b.cap = ncap;
+  }
+  return b.p;
+}
+
+thread_local TlBuf tl_pluck;
+thread_local TlBuf tl_serve;
+
+inline int64_t mono_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return int64_t(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+// --------------------------------------------------------- pluck_scan --
+// The client sync-pluck lane's native core: ONE call runs the whole
+// poll -> recv -> frame-scan receive loop for a sole-in-flight sync RPC
+// — the interpreter is crossed once per RPC instead of once per
+// poll/drain/parse/dispatch step (the reference's client runs this loop
+// compiled inside ProcessEvent/ProcessNewMessage,
+// input_messenger.cpp:219-331 + baidu_rpc_protocol.cpp:565).
+//
+// pluck_scan(fd, magic, cid, slice_ms, max_body, carry)
+//   -> (0, err_code, err_text|None, payload, attach, leftover, nread)
+//          the fast response frame for `cid` (leftover = bytes after it)
+//   -> (1, buffered, nread)   DEFER: anything only the classic path can
+//          judge (foreign cid, request frame, slow meta, oversized, bad
+//          magic) — buffered is every unconsumed byte, to re-inject
+//   -> (2, buffered, nread)   slice elapsed; pass buffered back as `carry`
+//   -> (3, errmsg, buffered, nread)   EOF or socket error
+// nread = bytes received from the fd by THIS call (excludes the carry)
+// — the caller feeds it to the read-traffic bvar the classic drain
+// maintains (nreads, socket.py)
+//
+// The caller owns eligibility (dispatcher paused, portal empty, sole
+// in-flight call) — this function only reads the fd and judges frames
+// with exactly the scan_frames meta walk (shared cut rules).
+PyObject* fc_pluck_scan(PyObject*, PyObject* args) {
+  int fd;
+  Py_buffer magic, carry;
+  unsigned long long cid;
+  long slice_ms;
+  Py_ssize_t max_body;
+  if (!PyArg_ParseTuple(args, "iy*Klny*", &fd, &magic, &cid, &slice_ms,
+                        &max_body, &carry))
+    return nullptr;
+  if (magic.len != 4) {
+    PyBuffer_Release(&magic); PyBuffer_Release(&carry);
+    PyErr_SetString(PyExc_ValueError, "magic must be 4 bytes");
+    return nullptr;
+  }
+  size_t need = size_t(12 + max_body) + 65536;
+  if (size_t(carry.len) + 65536 > need) need = size_t(carry.len) + 65536;
+  unsigned char* buf = tl_reserve(tl_pluck, need);
+  if (buf == nullptr) {
+    PyBuffer_Release(&magic); PyBuffer_Release(&carry);
+    return PyErr_NoMemory();
+  }
+  size_t cap = tl_pluck.cap;
+  size_t n = size_t(carry.len);
+  if (n) memcpy(buf, carry.buf, n);
+  const size_t base = n;  // nread = n - base (carry excluded)
+  const unsigned char mg[4] = {
+      static_cast<const unsigned char*>(magic.buf)[0],
+      static_cast<const unsigned char*>(magic.buf)[1],
+      static_cast<const unsigned char*>(magic.buf)[2],
+      static_cast<const unsigned char*>(magic.buf)[3]};
+  PyBuffer_Release(&magic); PyBuffer_Release(&carry);
+
+  int64_t deadline = mono_ms() + slice_ms;
+  for (;;) {
+    // ---- judge what we have
+    if (n >= 12) {
+      if (memcmp(buf, mg, 4) != 0)
+        return Py_BuildValue("iy#n", 1, (const char*)buf, (Py_ssize_t)n, (Py_ssize_t)(n - base));
+      uint32_t body = load_be32(buf + 4);
+      uint32_t meta_size = load_be32(buf + 8);
+      if (meta_size > body || Py_ssize_t(body) > max_body)
+        return Py_BuildValue("iy#n", 1, (const char*)buf, (Py_ssize_t)n, (Py_ssize_t)(n - base));
+      size_t total = 12 + size_t(body);
+      if (n >= total) {
+        MetaScan m;
+        if (!walk_meta(buf + 12, buf + 12 + meta_size, &m) ||
+            m.kind != 1 || m.cid != cid || m.att > body - meta_size)
+          return Py_BuildValue("iy#n", 1, (const char*)buf, (Py_ssize_t)n, (Py_ssize_t)(n - base));
+        size_t p_off = 12 + meta_size;
+        size_t p_len = size_t(body - meta_size - m.att);
+        PyObject* err_text;
+        if (m.err != nullptr) {
+          err_text = PyUnicode_DecodeUTF8(m.err, m.err_len, "replace");
+          if (err_text == nullptr) return nullptr;
+        } else {
+          err_text = Py_NewRef(Py_None);
+        }
+        return Py_BuildValue(
+            "iiNy#y#y#n", 0, (int)m.err_code, err_text,
+            (const char*)(buf + p_off), (Py_ssize_t)p_len,
+            (const char*)(buf + p_off + p_len), (Py_ssize_t)m.att,
+            (const char*)(buf + total), (Py_ssize_t)(n - total),
+            (Py_ssize_t)(n - base));
+      }
+    } else if (n > 0 &&
+               memcmp(buf, mg, n < 4 ? n : 4) != 0) {
+      // a prefix that already mismatches the magic is definitive
+      return Py_BuildValue("iy#n", 1, (const char*)buf, (Py_ssize_t)n, (Py_ssize_t)(n - base));
+    }
+    // ---- wait + read (GIL released around the syscalls)
+    int64_t remaining = deadline - mono_ms();
+    if (remaining <= 0)
+      return Py_BuildValue("iy#n", 2, (const char*)buf, (Py_ssize_t)n, (Py_ssize_t)(n - base));
+    int pr = 0;
+    ssize_t r = -2;  // -2 = recv not attempted
+    int err = 0;
+    Py_BEGIN_ALLOW_THREADS
+    struct pollfd pfd;
+    pfd.fd = fd;
+    pfd.events = POLLIN;
+    pfd.revents = 0;
+    pr = poll(&pfd, 1, int(remaining > 0x7FFFFFFF ? 0x7FFFFFFF : remaining));
+    if (pr > 0) {
+      r = recv(fd, buf + n, cap - n, 0);
+      if (r < 0) err = errno;
+    } else if (pr < 0) {
+      err = errno;
+    }
+    Py_END_ALLOW_THREADS
+    if (pr == 0)
+      return Py_BuildValue("iy#n", 2, (const char*)buf, (Py_ssize_t)n, (Py_ssize_t)(n - base));
+    if (pr < 0) {
+      if (err == EINTR) continue;
+      return Py_BuildValue("isy#n", 3, strerror(err), (const char*)buf,
+                           (Py_ssize_t)n, (Py_ssize_t)(n - base));
+    }
+    if (r == 0)
+      return Py_BuildValue("isy#n", 3, "connection closed by peer",
+                           (const char*)buf, (Py_ssize_t)n,
+                           (Py_ssize_t)(n - base));
+    if (r < 0) {
+      if (err == EINTR || err == EAGAIN || err == EWOULDBLOCK) continue;
+      return Py_BuildValue("isy#n", 3, strerror(err), (const char*)buf,
+                           (Py_ssize_t)n, (Py_ssize_t)(n - base));
+    }
+    n += size_t(r);
+    if (n == cap)  // no complete fast frame fits: classic path judges
+      return Py_BuildValue("iy#n", 1, (const char*)buf, (Py_ssize_t)n, (Py_ssize_t)(n - base));
+  }
+}
+
+// -------------------------------------------------------- serve_drain --
+// The server's native per-event loop: ONE call reads the readable fd
+// and echo-serves the front run of eligible frames — recv, frame cut,
+// meta walk, dispatch match and response build never cross the
+// interpreter (serve_scan already did everything after the portal; this
+// removes the recv -> IOBuf -> view -> pop round trip in front of it).
+// The caller still sends the returned response bytes through the
+// socket's write path, keeping MPSC write arbitration intact.
+//
+// serve_drain(fd, magic, service, method, max_body)
+//   -> (0, out_bytes, n_served, leftover, nread)  served n frames;
+//          leftover = unconsumed tail for the classic path (b"" clean)
+//   -> (1, leftover, nread)   nothing served (not eligible / partial /
+//          spurious event with no data)
+//   -> (2, errmsg, raw, nread)  EOF or socket error observed; raw =
+//          every byte read this pass (classic path re-judges, then the next
+//          classic drain re-observes the EOF/error state)
+PyObject* fc_serve_drain(PyObject*, PyObject* args) {
+  int fd;
+  Py_buffer magic, svc, mth;
+  Py_ssize_t max_body = 32768;
+  if (!PyArg_ParseTuple(args, "iy*y*y*|n", &fd, &magic, &svc, &mth,
+                        &max_body))
+    return nullptr;
+  if (magic.len != 4) {
+    PyBuffer_Release(&magic); PyBuffer_Release(&svc); PyBuffer_Release(&mth);
+    PyErr_SetString(PyExc_ValueError, "magic must be 4 bytes");
+    return nullptr;
+  }
+  size_t cap_want = 262144;
+  if (size_t(12 + max_body) + 4096 > cap_want)
+    cap_want = size_t(12 + max_body) + 4096;
+  unsigned char* buf = tl_reserve(tl_serve, cap_want);
+  if (buf == nullptr) {
+    PyBuffer_Release(&magic); PyBuffer_Release(&svc); PyBuffer_Release(&mth);
+    return PyErr_NoMemory();
+  }
+  size_t cap = tl_serve.cap;
+  size_t n = 0;
+  bool eof = false;
+  int err = 0;
+  Py_BEGIN_ALLOW_THREADS
+  for (;;) {
+    ssize_t r = recv(fd, buf + n, cap - n, 0);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      if (errno != EAGAIN && errno != EWOULDBLOCK) err = errno;
+      break;
+    }
+    if (r == 0) {
+      eof = true;
+      break;
+    }
+    n += size_t(r);
+    if (n == cap) break;          // full batch: serve it, event re-fires
+    if (size_t(r) < 65536) break; // short read: kernel (almost) drained
+  }
+  Py_END_ALLOW_THREADS
+  PyObject* result = nullptr;
+  if (eof || err) {
+    result = Py_BuildValue("isy#n", 2, eof ? "peer closed" : strerror(err),
+                           (const char*)buf, (Py_ssize_t)n, (Py_ssize_t)n);
+  } else if (n == 0) {
+    result = Py_BuildValue("iy#n", 1, "", (Py_ssize_t)0, (Py_ssize_t)0);
+  } else {
+    // scan + serve the front run (shared serve_core two-pass)
+    Py_ssize_t off = 0, n_served = 0;
+    PyObject* out = serve_core(buf, Py_ssize_t(n), magic.buf, svc, mth,
+                               max_body, &off, &n_served);
+    if (out != nullptr) {
+      if (n_served == 0) {
+        Py_DECREF(out);   // empty: nothing was eligible
+        result = Py_BuildValue("iy#n", 1, (const char*)buf, (Py_ssize_t)n,
+                               (Py_ssize_t)n);
+      } else {
+        result = Py_BuildValue("iNny#n", 0, out, n_served,
+                               (const char*)(buf + off),
+                               (Py_ssize_t)(Py_ssize_t(n) - off),
+                               (Py_ssize_t)n);
+      }
+    }
+  }
+  PyBuffer_Release(&magic); PyBuffer_Release(&svc); PyBuffer_Release(&mth);
+  return result;
 }
 
 // --------------------------------------------------------------- Pool --
@@ -685,6 +959,17 @@ PyMethodDef module_methods[] = {
      "serve_scan(view, magic, service, method, max_body=32768) -> "
      "(consumed, out_bytes, n): echo-serve matching request frames "
      "entirely in C (responses prebuilt into out_bytes)"},
+    {"pluck_scan", fc_pluck_scan, METH_VARARGS,
+     "pluck_scan(fd, magic, cid, slice_ms, max_body, carry) -> "
+     "(0, ec, et, payload, attach, leftover, nread) | (1, buffered, "
+     "nread) | (2, buffered, nread) | (3, errmsg, buffered, nread): "
+     "the sync-pluck receive loop (poll+recv+frame scan) in one "
+     "native call"},
+    {"serve_drain", fc_serve_drain, METH_VARARGS,
+     "serve_drain(fd, magic, service, method, max_body=32768) -> "
+     "(0, out, n, leftover, nread) | (1, leftover, nread) | "
+     "(2, errmsg, raw, nread): recv + echo-serve the readable fd's "
+     "front run in one native call"},
     {"http_parse_request", fc_http_parse_request, METH_VARARGS,
      "http_parse_request(view, max_header, max_body) -> None | -1 | -2 "
      "| (header_len, method, target, content_length, keep_alive, "

@@ -32,8 +32,43 @@ _TAG_CORRELATION_ID_B = _TAG_CORRELATION_ID.to_bytes()
 _TAG_ATTACHMENT_SIZE_B = _TAG_ATTACHMENT_SIZE.to_bytes()
 from brpc_tpu.rpc import errno_codes as berr
 from brpc_tpu.rpc.controller import Controller, address_call, take_call
+from brpc_tpu.transport import socket as _socket_mod
 from brpc_tpu.transport.input_messenger import InputMessenger
 from brpc_tpu.transport.socket import Socket, create_client_socket
+
+
+def _fail_inflight_calls(sock, calls) -> None:
+    """Socket-failure fan-out: every client call still issued on the
+    dead socket fails (or retries elsewhere) NOW instead of sitting out
+    its full deadline — the reference's SetFailed -> bthread_id_error
+    behavior (socket.cpp; OnVersionedRPCReturned sees EFAILEDSOCKET
+    immediately). Runs on a fiber (retries may reconnect, which blocks);
+    take_call arbitration on the SNAPSHOT correlation id makes racing
+    completions — and a controller recycled onto a brand-new call
+    before this fiber ran — a no-op."""
+    reason = str(sock.fail_reason or "socket failed")
+    for cntl, cid, seq in calls:
+        ch = getattr(cntl, "_owner_channel", None)
+        try:
+            if ch is not None:
+                ch._maybe_retry(cntl, berr.EFAILEDSOCKET,
+                                f"socket failed: {reason}",
+                                failed_ep=sock.remote_endpoint,
+                                expect_cid=cid, expect_seq=seq)
+                continue
+            with cntl._arb_lock:
+                if cntl.__dict__.get("_issue_seq") != seq:
+                    continue   # re-issued since the snapshot: stale
+                taken = take_call(cid) is cntl
+            if taken:
+                cntl.set_failed(berr.EFAILEDSOCKET,
+                                f"socket failed: {reason}")
+                cntl._complete()
+        except Exception:
+            pass   # one broken call must not strand the rest
+
+
+_socket_mod.inflight_failer = _fail_inflight_calls
 
 
 @dataclass
@@ -382,6 +417,19 @@ class Channel:
     def _issue_rpc(self, cntl: Controller) -> None:
         """Pick socket, pack, enqueue (Controller::IssueRPC,
         controller.cpp:1010)."""
+        # a retry may take a different framing branch than the first
+        # attempt: the native-pluck hint is per-issue state, and the
+        # new attempt gets a fresh failure-verdict latch. _issue_seq
+        # names THIS attempt — failure paths capture it so a verdict
+        # arriving after a re-issue (stale write callback, inflight
+        # failer fiber that lost the race) is recognizably stale and
+        # no-ops instead of judging the live attempt (the correlation
+        # id alone cannot tell attempts apart: transport retries keep
+        # it).
+        d = cntl.__dict__
+        d["_issue_seq"] = d.get("_issue_seq", 0) + 1
+        d.pop("_pluck_fast", None)
+        d.pop("_fail_handled", None)
         try:
             sock = self._pick_socket(cntl)
         except (ConnectionError, OSError, ValueError) as e:
@@ -422,6 +470,10 @@ class Channel:
                 wire = pack_small_frame(prefix, cntl.correlation_id,
                                         cntl._request_bytes,
                                         att.to_bytes() if att else b"")
+                # a sync joiner may run the native pluck loop for this
+                # call (Socket.pluck_until fast lane): the expected
+                # response is a small tpu_std frame
+                cntl.__dict__["_pluck_fast"] = (_TPU_MAGIC, SMALL_FRAME_MAX)
             else:
                 # large attachment: same cached-prefix meta (no pb build
                 # per call), attachment rides as zero-copy refs behind
@@ -437,8 +489,9 @@ class Channel:
                 if att_size:
                     wire.append_buf(att)
             try:
-                sock.write(wire, on_done=lambda err, s=sock:
-                           self._on_write_done(cntl, err, s))
+                sock.write(wire, on_done=lambda err, s=sock,
+                           q=d["_issue_seq"]:
+                           self._on_write_done(cntl, err, s, q))
             except (BlockingIOError, ConnectionError, OSError) as e:
                 self._maybe_retry(cntl, berr.EFAILEDSOCKET, str(e),
                                   failed_ep=sock.remote_endpoint)
@@ -478,11 +531,13 @@ class Channel:
                 # cross-match lane batches on the receiver
                 with sock.lane_lock:
                     sock.write_device_payload(lane)
-                    sock.write(wire, on_done=lambda err, s=sock:
-                               self._on_write_done(cntl, err, s))
+                    sock.write(wire, on_done=lambda err, s=sock,
+                               q=d["_issue_seq"]:
+                               self._on_write_done(cntl, err, s, q))
             else:
-                sock.write(wire, on_done=lambda err, s=sock:
-                           self._on_write_done(cntl, err, s))
+                sock.write(wire, on_done=lambda err, s=sock,
+                           q=d["_issue_seq"]:
+                           self._on_write_done(cntl, err, s, q))
         except (BlockingIOError, ConnectionError, OSError) as e:
             # lane backpressure / dead conn must fail the controller (or
             # retry), never escape to the caller with the call leaked
@@ -490,12 +545,13 @@ class Channel:
                               failed_ep=sock.remote_endpoint)
 
     def _on_write_done(self, cntl: Controller, err: Optional[BaseException],
-                       sock=None):
+                       sock=None, seq: Optional[int] = None):
         if err is None:
             return
         self._maybe_retry(cntl, berr.EFAILEDSOCKET, str(err),
                           failed_ep=sock.remote_endpoint
-                          if sock is not None else None)
+                          if sock is not None else None,
+                          expect_seq=seq)
 
     def _retry_policy(self):
         # resolved once: the policy is fixed at channel construction and
@@ -508,22 +564,50 @@ class Channel:
         return cached
 
     def _maybe_retry(self, cntl: Controller, code: int, text: str,
-                     failed_ep=None) -> None:
+                     failed_ep=None, expect_cid: Optional[int] = None,
+                     expect_seq: Optional[int] = None) -> None:
         """Retry on transport failures while the call is still live
         (OnVersionedRPCReturned's error branch, controller.cpp:634);
-        the retry policy decides whether this error class retries."""
-        if address_call(cntl.correlation_id) is not cntl:
-            return  # already completed (response/timeout won)
-        if cntl.current_try < cntl.max_retry and \
-                self._policy_allows(cntl, code, text):
-            cntl.current_try += 1
+        the retry policy decides whether this error class retries.
+
+        One verdict per attempt: a failing socket can surface through
+        TWO paths for the same call (the write's on_done error callback
+        and set_failed's inflight fan-out) — the _fail_handled latch,
+        check-and-set under the arbitration lock, lets exactly one of
+        them act (a double verdict would re-issue the same correlation
+        id twice or burn the retry budget and spuriously fail a live
+        retry). ``expect_cid`` pins the CALL being judged (a recycled
+        controller's new call must not be judged by a stale snapshot);
+        ``expect_seq`` pins the ATTEMPT — transport retries keep the
+        correlation id, so only the issue sequence can tell a verdict
+        for a dead attempt from one against its live successor."""
+        cid = cntl.correlation_id if expect_cid is None else expect_cid
+        if address_call(cid) is not cntl:
+            return  # already completed (response/timeout won) or recycled
+        # policy consult BEFORE the lock: user policy code must not run
+        # while the timer thread can block on cntl._arb_lock
+        allow = (cntl.current_try < cntl.max_retry
+                 and self._policy_allows(cntl, code, text))
+        with cntl._arb_lock:
+            if address_call(cid) is not cntl:
+                return
+            if expect_seq is not None and \
+                    cntl.__dict__.get("_issue_seq") != expect_seq:
+                return  # stale verdict: the call was already re-issued
+            if cntl.__dict__.get("_fail_handled"):
+                return  # another failure path already judged this attempt
+            cntl.__dict__["_fail_handled"] = True
+            taken = False
+            if allow:
+                cntl.current_try += 1
+            else:
+                taken = take_call(cid) is cntl
+        if allow:
             # report the failed attempt before moving on (the final
             # attempt is reported by the completion hook instead)
             self._on_attempt_failed(cntl, code, text, failed_ep)
             self._issue_rpc(cntl)
             return
-        with cntl._arb_lock:
-            taken = take_call(cntl.correlation_id) is cntl
         if taken:
             cntl.set_failed(code, text)
             cntl._complete()

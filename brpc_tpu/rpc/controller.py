@@ -31,6 +31,7 @@ from brpc_tpu.rpc import errno_codes as berr
 # import must stay cheap.
 _call_pool = None
 _call_pool_lock = threading.Lock()
+_prf = None   # lazily bound client_dispatch.process_response_fast
 
 
 def _pool():
@@ -263,6 +264,8 @@ class Controller:
         d.pop("end_us", None)
         d.pop("_pending_deadline", None)   # stale lazy deadline would
         #                                    clamp the new call's pluck
+        d.pop("_pluck_fast", None)         # per-issue native-pluck hint
+        d.pop("_fail_handled", None)       # per-attempt failure latch
         d.pop("response_payload", None)
         d.pop("response_attachment", None)
         d.pop("response_device_arrays", None)
@@ -303,9 +306,11 @@ class Controller:
         if old is not None:
             with old.pending_lock:
                 old.client_inflight -= 1
+                old.inflight_calls.discard(self)
         if sock is not None:
             with sock.pending_lock:
                 sock.client_inflight += 1
+                sock.inflight_calls.add(self)
                 if sock.client_inflight > 1:
                     # a lazy-deadline plucker owns this socket's input:
                     # OUR (possibly huge) response will run through its
@@ -315,6 +320,12 @@ class Controller:
                     # register-or-arm decision in join(), so one side
                     # always arms.
                     lazy_to_arm = sock._lazy_plucker
+            if sock.failed:
+                # registration raced set_failed's drain: the drain may
+                # have snapshotted before our add — re-trigger it (the
+                # drain is idempotent) so this call can't sit out the
+                # full deadline on a dead socket
+                sock._drain_inflight_calls()
         if lazy_to_arm is not None and lazy_to_arm is not self:
             lazy_to_arm._arm_lazy_deadline()
 
@@ -503,9 +514,21 @@ class Controller:
                 # take the timer thread would do)
                 pluck_deadline = deadline if pend is None \
                     else min(deadline, pend[1])
+                # native receive loop (fastcore pluck_scan): armed by the
+                # small-frame issue path; completes through the same
+                # process_response_fast the turbo dispatcher uses
+                fast = None
+                pf = self.__dict__.get("_pluck_fast")
+                if pf is not None:
+                    global _prf
+                    if _prf is None:
+                        from brpc_tpu.rpc.client_dispatch import \
+                            process_response_fast as _prf_mod
+                        _prf = _prf_mod
+                    fast = (pf[0], self.correlation_id, pf[1], _prf)
                 try:
                     if sock.pluck_until(lambda: self._finalized,
-                                        pluck_deadline):
+                                        pluck_deadline, fast=fast):
                         return True
                 except Exception:
                     pass   # pluck is an optimization, never a failure

@@ -125,6 +125,7 @@ class Server:
         self._stopped_event = threading.Event()
         self.method_status: Dict[str, LatencyRecorder] = {}
         self._native_echo = None        # (svc_bytes, mth_bytes, key)
+        self._fast_drain_hook = None    # lazy; False = unavailable
         self.concurrency = 0            # in-flight requests
         self._concurrency_lock = threading.Lock()
         self.nprocessed = 0
@@ -201,6 +202,16 @@ class Server:
         sock = Socket(conn, on_input=self._messenger.on_new_messages,
                       control=self._control)
         sock.user_data["server"] = self
+        if self._native_echo is not None:
+            # native per-event serving (fastcore serve_drain); the hook
+            # re-checks runtime gates (flags, cut-through state) per
+            # pass and self-disables on non-fd transports
+            fdr = self._fast_drain_hook
+            if fdr is None:    # resolve once; False = unavailable
+                from brpc_tpu.rpc.server_dispatch import make_fast_drain
+                fdr = self._fast_drain_hook = make_fast_drain(self) or False
+            if fdr is not False:
+                sock.fast_drain = fdr
         with self._conns_lock:
             self._conns.append(sock)
             # opportunistic sweep of dead conns

@@ -295,6 +295,81 @@ def _synth_request_msg(cid: int, service: str, method_name: str,
     return RpcMessage(meta, p, a)
 
 
+def make_fast_drain(server):
+    """Build the native per-event serving hook (Socket.fast_drain): ONE
+    fastcore serve_drain call reads the readable fd and echo-serves its
+    front run — recv, frame cut, meta walk, dispatch match and response
+    build never cross the interpreter (the reference's compiled drain +
+    in-place serve, socket.cpp:2402 DoRead + input_messenger.cpp:219 +
+    baidu_rpc_protocol.cpp:314). Anything the C pass can't judge is
+    re-injected into the portal for the classic machinery. Returns None
+    when the extension is unavailable."""
+    from brpc_tpu.native import fastcore as _fc_loader
+    fc = _fc_loader.get()
+    sd = getattr(fc, "serve_drain", None) if fc is not None else None
+    if sd is None:
+        return None
+    from brpc_tpu.protocol.tpu_std import MAGIC
+    from brpc_tpu.transport.socket import nreads as _nreads
+
+    def fast_drain(sock) -> bool:
+        tgt = server._native_echo
+        if tgt is None or not _server_turbo_ok(server) \
+                or flag("rpcz_enabled") or flag("rpc_dump_dir") \
+                or sock.input_portal or sock.input_need \
+                or sock.user_data.get("_cut_forward") is not None:
+            return False
+        pfd = getattr(sock.conn, "pluck_fd", None)
+        if pfd is None:
+            sock.fast_drain = None    # not a plain-fd transport: never
+            return False
+        try:
+            fd = pfd()
+        except OSError:
+            return False
+        t0 = time.monotonic_ns()
+        r = sd(fd, MAGIC, tgt[0], tgt[1], SMALL_FRAME_MAX)
+        tag = r[0]
+        nr = r[-1]                # bytes the C loop read this call
+        if nr:
+            _nreads.add(nr)       # classic _drain_readable's accounting
+        if tag == 0:
+            _, out, n, leftover, _nr = r
+            sock.write_small(out)
+            server.account_native_batch(
+                tgt[2], n, (time.monotonic_ns() - t0) / 1e3)
+            if leftover:
+                # non-echo tail (pipelined slow frame / partial): the
+                # classic pass judges it with full semantics
+                sock.input_portal.append_user_data(leftover)
+                return False
+            return True
+        if tag == 1:
+            leftover = r[1]
+            if leftover:
+                if not MAGIC.startswith(leftover[:4]):
+                    # the portal was empty, so these bytes sit at a
+                    # frame boundary — a magic mismatch means this
+                    # connection speaks another protocol (HTTP, redis,
+                    # ...): stop paying the native recv detour on its
+                    # every readable event
+                    sock.fast_drain = None
+                sock.input_portal.append_user_data(leftover)
+                return False
+            return True               # spurious wake: nothing arrived
+        # tag == 2: EOF/error. With buffered bytes the classic pass
+        # processes them first and its next drain re-observes the
+        # sticky EOF/error state; with none, fail now (the classic
+        # drain's "peer closed" verdict, Socket._drain_readable)
+        if r[2]:
+            sock.input_portal.append_user_data(r[2])
+            return False
+        sock.set_failed(ConnectionResetError(r[1]))
+        return True
+
+    return fast_drain
+
+
 def _server_turbo_ok(server) -> bool:
     """Feature gate for the turbo request path, resolved once: servers
     with auth / interceptor / session pools / pthread usercode need the

@@ -113,8 +113,28 @@ def _new_mpsc():
     fc = _fastcore.get()
     return fc.Mpsc() if fc is not None else _PyMpsc()
 
+
+# fastcore module for the per-call fd loops (pluck_scan); resolved on
+# first use for the same import-cost reason as the pools above
+_fc_mod = False
+
+
+def _fastcore():
+    global _fc_mod
+    if _fc_mod is False:
+        from brpc_tpu.native import fastcore as _fastcore_loader
+        _fc_mod = _fastcore_loader.get()
+    return _fc_mod
+
 nwrites = Adder()
 nreads = Adder()
+
+# Installed by the RPC layer (brpc_tpu.rpc.channel): callable
+# ``(socket, [controllers])`` that fails or re-issues the client calls
+# still in flight on a socket that just failed — the transport layer
+# defines the hook, the RPC layer provides the semantics (the
+# reference's SetFailed -> bthread_id_error fan-out, socket.cpp).
+inflight_failer = None
 
 SocketId = VersionedId
 
@@ -173,6 +193,7 @@ class Socket:
         # WITH a lazy deadline, armed by a later issuer (both under
         # pending_lock)
         self.client_inflight = 0
+        self.inflight_calls: set = set()   # their controllers (same lock)
         self._lazy_plucker = None
         self._busy_rearmed = False   # one probe re-arm per busy period
         self._busy_paused = False    # level-trigger: read interest paused
@@ -182,6 +203,11 @@ class Socket:
         # can succeed (a 1MB frame arrives in ~5 drain cycles; without
         # this each cycle re-probes header/meta just to learn "not yet")
         self.input_need = 0
+        # server native drain hook (fastcore serve_drain): a callable
+        # ``(socket) -> bool`` tried before the classic drain on the
+        # sync input path; True = the pass was handled natively.
+        # Installed by Server for eligible sockets, self-disabling.
+        self.fast_drain: Optional[Callable] = None
         self.user_data: dict = {}                 # per-conn session state
         # pairs a device-lane batch with its wire frame: concurrent
         # device-payload writers must not interleave (lane batches are
@@ -544,7 +570,38 @@ class Socket:
                         pass
         return False
 
-    def pluck_until(self, pred, deadline_s: float) -> bool:
+    def _pluck_process(self):
+        """One drain+process pass in the pluck context. Returns True
+        when the pass ESCALATED (a message's processing suspended — the
+        cycle, including pending-event accounting, was handed back to
+        the normal machinery and the caller must stop plucking)."""
+        with self._nevent_lock:
+            pending = self._nevent
+        self._drain_readable()
+        if self.input_portal or self.failed:
+            r = None
+            try:
+                r = self._on_input_sync(self)
+            except BaseException as e:
+                self._input_error(e)
+            if r is not None:
+                # The extra _nevent keeps the busy invariant (>=1
+                # through the handoff): with pending==0 a dispatcher
+                # event in this window would otherwise start a
+                # CONCURRENT processing pass against the same portal
+                # as the escalated tail
+                with self._nevent_lock:
+                    self._nevent += 1
+                    self._plucking = False
+                self._control.run_inline(
+                    self._input_async_tail(r, pending + 1),
+                    name="socket_input")
+                return True
+        if pending:
+            self._finish_input_cycle(pending)
+        return False
+
+    def pluck_until(self, pred, deadline_s: float, fast=None) -> bool:
         """Sync-pluck lane: a joining (non-worker) thread adopts this
         socket's input processing until ``pred()`` or the deadline — the
         caller waiting for its response drives the connection itself,
@@ -554,7 +611,16 @@ class Socket:
         idea). Claims the socket only when no processing pass is in
         flight; for the duration, dispatcher events defer to the
         plucker (``_plucking`` reads as busy), and leftovers are
-        settled through the normal machinery on exit. Returns pred()."""
+        settled through the normal machinery on exit. Returns pred().
+
+        ``fast=(magic, cid, max_body, on_resp)`` arms the native receive
+        loop
+        (fastcore pluck_scan): poll+recv+frame-scan run in ONE C call
+        per slice, and the sole expected response completes through
+        ``on_resp(cid, ec, et, payload, att, sock)``. Anything only the
+        classic path can judge (foreign frames, slow metas, pipelined
+        tails) is re-injected into the portal and processed through the
+        normal machinery — the lanes cannot diverge on semantics."""
         pfd = getattr(self.conn, "pluck_fd", None)
         if pfd is None or self._on_input_sync is None or self.failed:
             return pred()
@@ -576,13 +642,13 @@ class Socket:
                     self.conn.pause_read_events()
                 except Exception:
                     self._busy_paused = False
-        import select
-        poller = self.__dict__.get("_pluck_poller")
-        if poller is None:
-            poller = self._pluck_poller = select.poll()
-            poller.register(fd,
-                            select.POLLIN | select.POLLHUP | select.POLLERR)
+        scan = None
+        if fast is not None and not self.input_portal and not self.input_need:
+            fc = _fastcore()
+            scan = getattr(fc, "pluck_scan", None) if fc is not None else None
+        poller = None
         escalated = False
+        carry = b""
         try:
             while not pred() and not self.failed:
                 remaining = deadline_s - time.monotonic()
@@ -590,38 +656,72 @@ class Socket:
                     break
                 # short slices: pred() can flip without fd traffic
                 # (timeout timer, another thread completing the call)
+                if scan is not None:
+                    magic, cid, max_body, on_resp = fast
+                    r = scan(fd, magic, cid,
+                             int(min(remaining, 0.2) * 1000) + 1,
+                             max_body, carry)
+                    tag = r[0]
+                    nr = r[-1]            # bytes the C loop read this call
+                    if nr:
+                        nreads.add(nr)
+                    if tag == 2:          # slice elapsed: keep the carry
+                        carry = r[1]
+                        continue
+                    carry = b""
+                    if tag == 0:          # the response for cid
+                        _, ec, et, payload, att, leftover, _nr = r
+                        if leftover:
+                            self.input_portal.append_user_data(leftover)
+                        on_resp(cid, ec, et, payload, att, self)
+                        if not self.input_portal:
+                            continue      # pred() flips on the next check
+                        # pipelined tail behind our response: classic
+                        # machinery from here (retry may change cid)
+                        scan = None
+                        escalated = self._pluck_process()
+                        if escalated:
+                            break
+                        continue
+                    if tag == 1:          # defer: classic path judges
+                        if r[1]:
+                            self.input_portal.append_user_data(r[1])
+                        scan = None
+                        escalated = self._pluck_process()
+                        if escalated:
+                            break
+                        continue
+                    # tag == 3: EOF/socket error; complete frames that
+                    # arrived before it still get processed, exactly as
+                    # the classic drain would
+                    scan = None
+                    if r[2]:
+                        self.input_portal.append_user_data(r[2])
+                        escalated = self._pluck_process()
+                        if escalated:
+                            break
+                    if not self.failed and not pred():
+                        self.set_failed(ConnectionError(r[1]))
+                    continue
+                if poller is None:
+                    import select
+                    poller = self.__dict__.get("_pluck_poller")
+                    if poller is None:
+                        poller = self._pluck_poller = select.poll()
+                        poller.register(
+                            fd,
+                            select.POLLIN | select.POLLHUP | select.POLLERR)
                 if not poller.poll(min(remaining, 0.2) * 1000):
                     continue
-                with self._nevent_lock:
-                    pending = self._nevent
-                self._drain_readable()
-                if self.input_portal or self.failed:
-                    r = None
-                    try:
-                        r = self._on_input_sync(self)
-                    except BaseException as e:
-                        self._input_error(e)
-                    if r is not None:
-                        # a message's processing suspended (not a
-                        # response shape): hand the cycle — including
-                        # the pending-event accounting — back to the
-                        # normal machinery and stop plucking. The extra
-                        # _nevent keeps the busy invariant (>=1 through
-                        # the handoff): with pending==0 a dispatcher
-                        # event in this window would otherwise start a
-                        # CONCURRENT processing pass against the same
-                        # portal as the escalated tail
-                        escalated = True
-                        with self._nevent_lock:
-                            self._nevent += 1
-                            self._plucking = False
-                        self._control.run_inline(
-                            self._input_async_tail(r, pending + 1),
-                            name="socket_input")
-                        break
-                if pending:
-                    self._finish_input_cycle(pending)
+                escalated = self._pluck_process()
+                if escalated:
+                    break
         finally:
+            if carry:
+                # a partial frame read by the native loop: back into the
+                # portal — more bytes must arrive for it to complete, and
+                # their readable event restarts normal processing
+                self.input_portal.append_user_data(carry)
             if not escalated:
                 with self._nevent_lock:
                     self._plucking = False
@@ -650,6 +750,17 @@ class Socket:
         while True:
             with self._nevent_lock:
                 pending = self._nevent
+            fde = self.fast_drain
+            if fde is not None and not self.failed:
+                handled = False
+                try:
+                    handled = fde(self)
+                except BaseException as e:
+                    self._input_error(e)
+                if handled:
+                    if not self._finish_input_cycle(pending):
+                        return
+                    continue
             self._drain_readable()
             if self.input_portal or self.failed:
                 r = None
@@ -801,6 +912,32 @@ class Socket:
                 cb(self)
             except Exception:
                 pass
+        self._drain_inflight_calls()
+
+    def _drain_inflight_calls(self) -> None:
+        """Fail (or retry elsewhere) every client call still issued on
+        this socket — the reference errors all correlation ids bound to
+        a failed Socket immediately (SetFailed -> bthread_id_error, so
+        waiters never sit out the full RPC deadline on a dead
+        connection). The failer is installed by the RPC layer
+        (inflight_failer); it runs on a fiber because retries may
+        reconnect (blocking), which must not run on the event thread."""
+        failer = inflight_failer
+        if failer is None:
+            return
+        with self.pending_lock:
+            if not self.inflight_calls:
+                return
+            # correlation id AND issue sequence captured NOW: the failer
+            # fiber judges the attempt that was bound to THIS socket —
+            # a controller recycled onto a new call (cid changes) or
+            # re-issued by a faster failure path (seq changes; transport
+            # retries keep the cid) cannot be spuriously judged
+            calls = [(c, c.correlation_id, c.__dict__.get("_issue_seq"))
+                     for c in self.inflight_calls]
+            self.inflight_calls.clear()
+        self._control.spawn((lambda s=self, cs=calls: failer(s, cs)),
+                            name="inflight_fail")
 
     def on_failed(self, cb: Callable[["Socket"], None]) -> None:
         # flag-check and append under one lock: a registration racing

@@ -1,0 +1,378 @@
+"""The native fd loops (fastcore pluck_scan + serve_drain).
+
+Round-5 escalation of the per-call native loop: the client's sync-pluck
+receive (poll+recv+frame scan) and the server's per-event serve
+(recv+cut+match+response build) each run in ONE C call, crossing the
+interpreter once per RPC instead of once per step — the reference runs
+both compiled end to end (input_messenger.cpp:219-331 in-place
+processing, socket.cpp:2402 DoRead, baidu_rpc_protocol.cpp:314/565).
+These tests pin the C loops' judge-or-defer contract directly over
+socketpairs, and the integration semantics the lanes must preserve.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.native import fastcore
+from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
+from brpc_tpu.protocol.tpu_std import (MAGIC, SMALL_FRAME_MAX,
+                                       _py_pack_small_frame)
+from brpc_tpu.rpc import (Channel, ChannelOptions, Server, ServerOptions,
+                          Service)
+
+fc = fastcore.get()
+pytestmark = pytest.mark.skipif(
+    fc is None or not hasattr(fc, "pluck_scan"),
+    reason="fastcore fd loops unavailable")
+
+
+def _req_prefix(service="Bench", method="Echo"):
+    m = pb.RpcMeta()
+    m.request.service_name = service
+    m.request.method_name = method
+    return m.SerializeToString()
+
+
+def _req(cid, payload=b"ping", service="Bench", method="Echo", att=b""):
+    return _py_pack_small_frame(_req_prefix(service, method), cid, payload,
+                                att)
+
+
+def _resp(cid, payload=b"pong", att=b""):
+    return _py_pack_small_frame(b"", cid, payload, att)
+
+
+def _err_resp(cid, code, text):
+    m = pb.RpcMeta()
+    m.response.error_code = code
+    m.response.error_text = text
+    return _py_pack_small_frame(m.SerializeToString(), cid, b"")
+
+
+def _pair():
+    a, b = socket.socketpair()
+    a.setblocking(False)
+    return a, b
+
+
+class TestPluckScan:
+    def test_plain_response(self):
+        a, b = _pair()
+        b.sendall(_resp(7, b"hello"))
+        r = fc.pluck_scan(a.fileno(), MAGIC, 7, 200, SMALL_FRAME_MAX, b"")
+        assert r[:6] == (0, 0, None, b"hello", b"", b"")
+        assert r[6] == len(_resp(7, b"hello"))   # nread accounting
+        a.close(); b.close()
+
+    def test_attachment_and_leftover(self):
+        a, b = _pair()
+        b.sendall(_resp(8, b"x", b"ATT") + b"tail")
+        r = fc.pluck_scan(a.fileno(), MAGIC, 8, 200, SMALL_FRAME_MAX, b"")
+        assert r[0] == 0 and r[3] == b"x" and r[4] == b"ATT"
+        assert r[5] == b"tail"     # bytes after the frame come back raw
+        a.close(); b.close()
+
+    def test_error_response(self):
+        a, b = _pair()
+        b.sendall(_err_resp(9, 1004, "boom"))
+        r = fc.pluck_scan(a.fileno(), MAGIC, 9, 200, SMALL_FRAME_MAX, b"")
+        assert r[:3] == (0, 1004, "boom")
+        a.close(); b.close()
+
+    @pytest.mark.parametrize("frame_fn", [
+        lambda: _resp(11, b"y"),            # foreign correlation id
+        lambda: _req(12),                   # a request, not a response
+        lambda: b"GET / HTTP/1.1\r\nHo",    # not this protocol's bytes
+        lambda: _py_pack_small_frame(       # oversized body
+            b"", 12, b"z" * (SMALL_FRAME_MAX + 1)),
+    ])
+    def test_defers_hand_back_every_byte(self, frame_fn):
+        wire = frame_fn()
+        a, b = _pair()
+        b.sendall(wire)
+        r = fc.pluck_scan(a.fileno(), MAGIC, 12, 200, SMALL_FRAME_MAX, b"")
+        assert r[0] == 1 and r[1] == wire
+        a.close(); b.close()
+
+    def test_slow_meta_defers(self):
+        # a response carrying compress_type: only the classic path may
+        # judge it (decompression, policy)
+        m = pb.RpcMeta()
+        m.correlation_id = 13
+        m.compress_type = 1
+        mb = m.SerializeToString()
+        import struct
+        wire = struct.pack(">4sII", MAGIC, len(mb) + 2, len(mb)) + mb + b"zz"
+        a, b = _pair()
+        b.sendall(wire)
+        r = fc.pluck_scan(a.fileno(), MAGIC, 13, 200, SMALL_FRAME_MAX, b"")
+        assert r[0] == 1 and r[1] == wire
+        a.close(); b.close()
+
+    def test_partial_then_carry_resume(self):
+        wire = _resp(14, b"z" * 100)
+        a, b = _pair()
+        b.sendall(wire[:20])
+        r = fc.pluck_scan(a.fileno(), MAGIC, 14, 50, SMALL_FRAME_MAX, b"")
+        assert r[:2] == (2, wire[:20])     # slice elapsed, partial back
+        b.sendall(wire[20:])
+        r = fc.pluck_scan(a.fileno(), MAGIC, 14, 200, SMALL_FRAME_MAX, r[1])
+        assert r[0] == 0 and r[3] == b"z" * 100
+        a.close(); b.close()
+
+    def test_eof_reports_buffered_bytes(self):
+        wire = _resp(15, b"q")
+        a, b = _pair()
+        b.sendall(wire[:9])
+        b.close()
+        # partial frame then FIN: the loop must surface the error AND
+        # the bytes (the classic path decides what they were)
+        r = fc.pluck_scan(a.fileno(), MAGIC, 15, 200, SMALL_FRAME_MAX, b"")
+        assert r[0] == 3 and "closed" in r[1] and r[2] == wire[:9]
+        a.close()
+
+    def test_empty_slice_timeout(self):
+        a, b = _pair()
+        t0 = time.monotonic()
+        r = fc.pluck_scan(a.fileno(), MAGIC, 1, 60, SMALL_FRAME_MAX, b"")
+        assert r[:2] == (2, b"")
+        assert 0.04 <= time.monotonic() - t0 < 1.0
+        a.close(); b.close()
+
+
+class TestServeDrain:
+    def test_single_request_round_trip(self):
+        a, b = _pair()
+        b.sendall(_req(21, b"data"))
+        r = fc.serve_drain(a.fileno(), MAGIC, b"Bench", b"Echo",
+                           SMALL_FRAME_MAX)
+        assert r[0] == 0 and r[2] == 1 and r[3] == b""
+        # the produced bytes must BE the wire response for cid 21
+        rr = fc.pluck_scan(a.fileno(), MAGIC, 21, 0, SMALL_FRAME_MAX, r[1])
+        assert rr[0] == 0 and rr[3] == b"data"
+        a.close(); b.close()
+
+    def test_attachment_reflected(self):
+        a, b = _pair()
+        b.sendall(_req(22, b"p", att=b"ATTACH"))
+        r = fc.serve_drain(a.fileno(), MAGIC, b"Bench", b"Echo",
+                           SMALL_FRAME_MAX)
+        rr = fc.pluck_scan(a.fileno(), MAGIC, 22, 0, SMALL_FRAME_MAX, r[1])
+        assert rr[3] == b"p" and rr[4] == b"ATTACH"
+        a.close(); b.close()
+
+    def test_batch_with_partial_tail(self):
+        a, b = _pair()
+        partial = _req(34)[:10]
+        b.sendall(_req(31) + _req(32) + _req(33) + partial)
+        r = fc.serve_drain(a.fileno(), MAGIC, b"Bench", b"Echo",
+                           SMALL_FRAME_MAX)
+        assert r[0] == 0 and r[2] == 3 and r[3] == partial
+        a.close(); b.close()
+
+    def test_foreign_method_defers_every_byte(self):
+        wire = _req(41, service="Other", method="M")
+        a, b = _pair()
+        b.sendall(wire)
+        r = fc.serve_drain(a.fileno(), MAGIC, b"Bench", b"Echo",
+                           SMALL_FRAME_MAX)
+        assert r[0] == 1 and r[1] == wire
+        a.close(); b.close()
+
+    def test_spurious_event(self):
+        a, b = _pair()
+        r = fc.serve_drain(a.fileno(), MAGIC, b"Bench", b"Echo",
+                           SMALL_FRAME_MAX)
+        assert r[:2] == (1, b"")
+        a.close(); b.close()
+
+    def test_eof(self):
+        a, b = _pair()
+        b.close()
+        r = fc.serve_drain(a.fileno(), MAGIC, b"Bench", b"Echo",
+                           SMALL_FRAME_MAX)
+        assert r[0] == 2 and r[1] == "peer closed" and r[2] == b""
+        a.close()
+
+    def test_eof_behind_frames_still_serves_then_reports(self):
+        wire = _req(51)
+        a, b = _pair()
+        b.sendall(wire)
+        b.close()
+        r = fc.serve_drain(a.fileno(), MAGIC, b"Bench", b"Echo",
+                           SMALL_FRAME_MAX)
+        # the short read stops the recv loop before the FIN is observed:
+        # the arrived frame is still served (its response can go out)...
+        assert r[0] == 0 and r[2] == 1 and r[3] == b""
+        # ...and the next pass (the level trigger re-fires on EOF)
+        # reports the close
+        r = fc.serve_drain(a.fileno(), MAGIC, b"Bench", b"Echo",
+                           SMALL_FRAME_MAX)
+        assert r[0] == 2 and r[1] == "peer closed"
+        a.close()
+
+
+def _echo_server():
+    server = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("Bench")
+
+    @svc.method(native="echo")
+    def Echo(cntl, request):
+        return request
+
+    @svc.method()
+    def Upper(cntl, request):
+        data = request if isinstance(request, (bytes, bytearray)) \
+            else request.to_bytes()
+        return data.upper()
+
+    server.add_service(svc)
+    ep = server.start("tcp://127.0.0.1:0")
+    return server, ep
+
+
+class TestLanesEndToEnd:
+    def test_sync_echo_uses_native_lanes(self):
+        server, ep = _echo_server()
+        try:
+            ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                         ChannelOptions(timeout_ms=5000))
+            for i in range(50):
+                cl = ch.call_sync("Bench", "Echo", b"m%d" % i)
+                assert not cl.failed()
+                assert cl.response_payload.to_bytes() == b"m%d" % i
+            # the server side must actually have served through the
+            # native batch accounting (fast_drain or turbo lane); the
+            # last response is written BEFORE its accounting lands, so
+            # give the server thread a beat
+            deadline = time.monotonic() + 2.0
+            while server.method_status["Bench.Echo"].count() < 50 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert server.method_status["Bench.Echo"].count() >= 50
+            ch.close()
+        finally:
+            server.stop()
+
+    def test_mixed_native_and_classic_methods_interleave(self):
+        server, ep = _echo_server()
+        try:
+            ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                         ChannelOptions(timeout_ms=5000))
+            for i in range(20):
+                a = ch.call_sync("Bench", "Echo", b"low%d" % i)
+                b = ch.call_sync("Bench", "Upper", b"low%d" % i)
+                assert a.response_payload.to_bytes() == b"low%d" % i
+                assert b.response_payload.to_bytes() == b"LOW%d" % i
+            ch.close()
+        finally:
+            server.stop()
+
+    def test_large_response_defers_mid_pluck(self):
+        # response exceeds SMALL_FRAME_MAX: the native loop must defer
+        # to the classic path, which assembles it correctly
+        server, ep = _echo_server()
+        try:
+            ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                         ChannelOptions(timeout_ms=10000))
+            big = b"B" * (SMALL_FRAME_MAX * 3 + 17)
+            cl = ch.call_sync("Bench", "Echo", big)
+            assert not cl.failed()
+            assert cl.response_payload.to_bytes() == big
+            ch.close()
+        finally:
+            server.stop()
+
+    def test_handler_error_via_native_pluck(self):
+        server = Server(ServerOptions(enable_builtin_services=False))
+        svc = Service("Bench")
+
+        @svc.method()
+        def Fail(cntl, request):
+            cntl.set_failed(1007, "handler says no")
+
+        server.add_service(svc)
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                         ChannelOptions(timeout_ms=5000, max_retry=0))
+            cl = ch.call_sync("Bench", "Fail", b"x")
+            assert cl.failed() and cl.error_code == 1007
+            assert "handler says no" in cl.error_text
+            ch.close()
+        finally:
+            server.stop()
+
+    def test_timeout_through_native_loop(self):
+        server = Server(ServerOptions(enable_builtin_services=False))
+        svc = Service("Bench")
+        release = threading.Event()
+
+        @svc.method()
+        async def Slow(cntl, request):
+            from brpc_tpu.fiber.timer import sleep as fiber_sleep
+            await fiber_sleep(2.0)
+            return b"late"
+
+        server.add_service(svc)
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                         ChannelOptions(timeout_ms=150, max_retry=0))
+            t0 = time.monotonic()
+            cl = ch.call_sync("Bench", "Slow", b"x")
+            dt = time.monotonic() - t0
+            from brpc_tpu.rpc import errno_codes as berr
+            assert cl.failed() and cl.error_code == berr.ERPCTIMEDOUT
+            assert dt < 1.5        # the lazy deadline fired, not the join cap
+            release.set()
+            ch.close()
+        finally:
+            server.stop()
+
+    def test_peer_close_mid_pluck_fails_the_call(self):
+        # a server that reads the request and closes without answering:
+        # the native loop's EOF verdict must fail the call promptly
+        # (connection error or timeout-free fast failure), never hang
+        lis = socket.socket()
+        lis.bind(("127.0.0.1", 0))
+        lis.listen(1)
+        port = lis.getsockname()[1]
+
+        def evil():
+            c, _ = lis.accept()
+            c.recv(4096)
+            c.close()
+
+        t = threading.Thread(target=evil, daemon=True)
+        t.start()
+        ch = Channel(f"tcp://127.0.0.1:{port}",
+                     ChannelOptions(timeout_ms=3000, max_retry=0))
+        t0 = time.monotonic()
+        cl = ch.call_sync("Bench", "Echo", b"x")
+        assert cl.failed()
+        assert time.monotonic() - t0 < 2.5   # EOF verdict, not the timeout
+        ch.close()
+        lis.close()
+        t.join(2.0)
+
+    def test_pipelined_async_then_sync_share_the_connection(self):
+        server, ep = _echo_server()
+        try:
+            ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                         ChannelOptions(timeout_ms=5000))
+            # async calls in flight force the multiplex gate: the sync
+            # joiner must keep full semantics with responses for OTHER
+            # cids crossing its pluck
+            ctls = [ch.call("Bench", "Echo", b"a%d" % i) for i in range(8)]
+            cl = ch.call_sync("Bench", "Echo", b"sync")
+            assert cl.response_payload.to_bytes() == b"sync"
+            for i, c in enumerate(ctls):
+                assert c.join(5.0) and not c.failed()
+                assert c.response_payload.to_bytes() == b"a%d" % i
+            ch.close()
+        finally:
+            server.stop()
