@@ -71,6 +71,22 @@ def _fail_inflight_calls(sock, calls) -> None:
 _socket_mod.inflight_failer = _fail_inflight_calls
 
 
+_client_fdr = None   # lazily built; False = extension unavailable
+
+
+def client_fast_drain_hook(options):
+    """The client-side chunk fast lane for a channel's sockets (None
+    when inapplicable): only default-protocol (tpu_std) channels — the
+    lane scans MAGIC-framed responses."""
+    if options.protocol not in ("", "tpu_std"):
+        return None
+    global _client_fdr
+    if _client_fdr is None:
+        from brpc_tpu.rpc.client_dispatch import make_client_fast_drain
+        _client_fdr = make_client_fast_drain() or False
+    return _client_fdr or None
+
+
 @dataclass
 class ChannelOptions:
     protocol: str = "tpu_std"
@@ -159,9 +175,11 @@ class Channel:
     # ---------------------------------------------------------- connection
     def _get_socket(self) -> Socket:
         def _make():
-            return create_client_socket(
+            s = create_client_socket(
                 self._endpoint, on_input=self._messenger.on_new_messages,
                 control=self._control)
+            s.fast_drain = client_fast_drain_hook(self.options)
+            return s
 
         if (self.options.connection_type == "single"
                 and self.options.share_connections):
@@ -390,6 +408,7 @@ class Channel:
                 sock = create_client_socket(
                     self._endpoint, on_input=self._messenger.on_new_messages,
                     control=self._control)
+                sock.fast_drain = client_fast_drain_hook(self.options)
 
             def _return(c, s=sock):
                 if s.failed:
@@ -408,6 +427,7 @@ class Channel:
             sock = create_client_socket(
                 self._endpoint, on_input=self._messenger.on_new_messages,
                 control=self._control)
+            sock.fast_drain = client_fast_drain_hook(self.options)
             cntl._add_complete_hook(
                 lambda c, s=sock: s.failed or s.set_failed(
                     ConnectionError("short connection done")))

@@ -25,6 +25,59 @@ class PayloadBytes(bytes):
         return len(self)
 
 
+def make_client_fast_drain():
+    """Build the client-side chunk fast lane (Socket.fast_drain for
+    chunk-handoff transports like mem://): pull the writer's exact bytes
+    objects, scan_frames them in one C pass, and complete the response
+    records through process_response_fast — no portal wrap/view/pop, no
+    turbo-lane indirection. Anything that isn't a clean run of fast
+    responses re-injects into the portal for the classic machinery.
+    Returns None when the extension is unavailable."""
+    from brpc_tpu.native import fastcore as _fc_loader
+    fc = _fc_loader.get()
+    scan = getattr(fc, "scan_frames", None) if fc is not None else None
+    if scan is None:
+        return None
+    from brpc_tpu.protocol.tpu_std import MAGIC, SMALL_FRAME_MAX
+    from brpc_tpu.transport.socket import pull_chunks as _pull_chunks
+
+    def fast_drain(sock) -> bool:
+        if sock.input_portal or sock.input_need:
+            return False
+        data, handled = _pull_chunks(sock)   # self-disables on fd conns
+        if data is None:
+            return handled
+        consumed, frames = scan(data, MAGIC, SMALL_FRAME_MAX, 128)
+        if any(f[0] != 1 for f in frames):
+            # a request-shaped frame on a client socket: hand the WHOLE
+            # run to the classic machinery in parse order (scan records
+            # carry payload offsets, not frame starts, so a partial
+            # dispatch could not find its cut point)
+            sock.input_portal.append_user_data(data)
+            return False
+        for f in frames:
+            _, cid, ec, et, po, pl, ao, al = f
+            process_response_fast(cid, ec, et, data[po:po + pl],
+                                  data[ao:ao + al] if al else b"", sock)
+        if consumed == len(data):
+            if frames:
+                sock.__dict__["_fdrain_defer_streak"] = 0
+            return True
+        # tail the scanner stopped at (partial frame / slow meta): the
+        # classic path judges it from the stop offset — a connection
+        # whose responses are ALWAYS slow-shaped stops paying the lane
+        if not frames:
+            streak = sock.__dict__.get("_fdrain_defer_streak", 0) + 1
+            if streak >= 16:
+                sock.fast_drain = None
+            else:
+                sock._fdrain_defer_streak = streak
+        sock.input_portal.append_user_data(data[consumed:])
+        return False
+
+    return fast_drain
+
+
 def process_response_fast(cid: int, err_code: int, err_text, payload: bytes,
                           att: bytes, socket) -> None:
     """Complete a call from scan_frames response fields — no RpcMeta

@@ -90,6 +90,8 @@ class ClusterChannel(Channel):
         def _make():
             s = create_client_socket(ep, on_input=self._messenger.on_new_messages,
                                      control=self._control)
+            from brpc_tpu.rpc.channel import client_fast_drain_hook
+            s.fast_drain = client_fast_drain_hook(self.options)
             s.on_failed(lambda sock, ep=ep: self._on_socket_failed(ep))
             return s
 

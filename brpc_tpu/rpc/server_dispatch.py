@@ -8,6 +8,7 @@ be async (awaited in place) or sync.
 from __future__ import annotations
 
 import inspect
+import os
 import time
 from typing import Optional
 
@@ -307,10 +308,26 @@ def make_fast_drain(server):
     from brpc_tpu.native import fastcore as _fc_loader
     fc = _fc_loader.get()
     sd = getattr(fc, "serve_drain", None) if fc is not None else None
-    if sd is None:
+    ss = getattr(fc, "serve_scan", None) if fc is not None else None
+    if sd is None or ss is None:
         return None
     from brpc_tpu.protocol.tpu_std import MAGIC
     from brpc_tpu.transport.socket import nreads as _nreads
+    from brpc_tpu.transport.socket import pull_chunks as _pull_chunks
+
+    def _defer_streak(sock, served: bool) -> None:
+        """Disable the lane for a connection that keeps deferring: a
+        tpu_std client that never hits the native-echo method would
+        otherwise pay the recv-copy-reinject detour on every event,
+        forever. Any served frame resets the streak."""
+        if served:
+            sock.__dict__["_fdrain_defer_streak"] = 0
+            return
+        streak = sock.__dict__.get("_fdrain_defer_streak", 0) + 1
+        if streak >= 16:
+            sock.fast_drain = None
+        else:
+            sock._fdrain_defer_streak = streak
 
     def fast_drain(sock) -> bool:
         tgt = server._native_echo
@@ -320,51 +337,83 @@ def make_fast_drain(server):
                 or sock.user_data.get("_cut_forward") is not None:
             return False
         pfd = getattr(sock.conn, "pluck_fd", None)
-        if pfd is None:
-            sock.fast_drain = None    # not a plain-fd transport: never
-            return False
-        try:
-            fd = pfd()
-        except OSError:
-            return False
+        if pfd is not None:
+            # dup pins the kernel socket: a concurrent set_failed can
+            # close the conn's fd mid-recv and the OS could reuse the
+            # NUMBER for a new connection (see Socket.pluck_until)
+            try:
+                dfd = os.dup(pfd())
+            except OSError:
+                return False
+            t0 = time.monotonic_ns()
+            try:
+                r = sd(dfd, MAGIC, tgt[0], tgt[1], SMALL_FRAME_MAX)
+            finally:
+                try:
+                    os.close(dfd)
+                except OSError:
+                    pass
+            tag = r[0]
+            nr = r[-1]            # bytes the C loop read this call
+            if nr:
+                _nreads.add(nr)   # classic _drain_readable's accounting
+            if tag == 0:
+                _, out, n, leftover, _nr = r
+                sock.write_small(out)
+                server.account_native_batch(
+                    tgt[2], n, (time.monotonic_ns() - t0) / 1e3)
+                _defer_streak(sock, True)
+                if leftover:
+                    # non-echo tail (pipelined slow frame / partial):
+                    # the classic pass judges it with full semantics
+                    sock.input_portal.append_user_data(leftover)
+                    return False
+                return True
+            if tag == 1:
+                leftover = r[1]
+                if leftover:
+                    if not MAGIC.startswith(leftover[:4]):
+                        # the portal was empty, so these bytes sit at a
+                        # frame boundary — a magic mismatch means this
+                        # connection speaks another protocol (HTTP,
+                        # redis, ...): stop paying the native recv
+                        # detour on its every readable event
+                        sock.fast_drain = None
+                    else:
+                        _defer_streak(sock, False)
+                    sock.input_portal.append_user_data(leftover)
+                    return False
+                return True           # spurious wake: nothing arrived
+            # tag == 2: EOF/error. With buffered bytes the classic pass
+            # processes them first and its next drain re-observes the
+            # sticky EOF/error state; with none, fail now (the classic
+            # drain's "peer closed" verdict, Socket._drain_readable)
+            if r[2]:
+                sock.input_portal.append_user_data(r[2])
+                return False
+            sock.set_failed(ConnectionResetError(r[1]))
+            return True
+        # chunk-handoff transports (mem://): the writer's exact bytes
+        # objects are the stream — serve straight off them, skipping
+        # the portal wrap/view/pop round trip entirely
+        data, handled = _pull_chunks(sock)
+        if data is None:
+            return handled
         t0 = time.monotonic_ns()
-        r = sd(fd, MAGIC, tgt[0], tgt[1], SMALL_FRAME_MAX)
-        tag = r[0]
-        nr = r[-1]                # bytes the C loop read this call
-        if nr:
-            _nreads.add(nr)       # classic _drain_readable's accounting
-        if tag == 0:
-            _, out, n, leftover, _nr = r
+        consumed, out, n = ss(data, MAGIC, tgt[0], tgt[1], SMALL_FRAME_MAX)
+        if n:
             sock.write_small(out)
             server.account_native_batch(
                 tgt[2], n, (time.monotonic_ns() - t0) / 1e3)
-            if leftover:
-                # non-echo tail (pipelined slow frame / partial): the
-                # classic pass judges it with full semantics
-                sock.input_portal.append_user_data(leftover)
-                return False
-            return True
-        if tag == 1:
-            leftover = r[1]
-            if leftover:
-                if not MAGIC.startswith(leftover[:4]):
-                    # the portal was empty, so these bytes sit at a
-                    # frame boundary — a magic mismatch means this
-                    # connection speaks another protocol (HTTP, redis,
-                    # ...): stop paying the native recv detour on its
-                    # every readable event
-                    sock.fast_drain = None
-                sock.input_portal.append_user_data(leftover)
-                return False
-            return True               # spurious wake: nothing arrived
-        # tag == 2: EOF/error. With buffered bytes the classic pass
-        # processes them first and its next drain re-observes the
-        # sticky EOF/error state; with none, fail now (the classic
-        # drain's "peer closed" verdict, Socket._drain_readable)
-        if r[2]:
-            sock.input_portal.append_user_data(r[2])
+        if consumed < len(data):
+            rest = data[consumed:] if consumed else data
+            if not n and not MAGIC.startswith(rest[:4]):
+                sock.fast_drain = None    # another protocol: stop here
+            else:
+                _defer_streak(sock, bool(n))
+            sock.input_portal.append_user_data(rest)
             return False
-        sock.set_failed(ConnectionResetError(r[1]))
+        _defer_streak(sock, bool(n))
         return True
 
     return fast_drain

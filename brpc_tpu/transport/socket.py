@@ -20,6 +20,7 @@ runtime:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -135,6 +136,30 @@ nreads = Adder()
 # defines the hook, the RPC layer provides the semantics (the
 # reference's SetFailed -> bthread_id_error fan-out, socket.cpp).
 inflight_failer = None
+
+
+def pull_chunks(sock):
+    """Shared front half of the chunk-handoff fast lanes (mem://): pull
+    the writer's exact bytes objects off the conn, with the common
+    eligibility/eof/accounting protocol in ONE place so the client and
+    server lanes cannot diverge on it. Returns (data, handled):
+    data=None means no scanning to do — handled tells the hook what to
+    return (True: spurious wake or eof dealt with; False: not a chunk
+    conn, and the hook was self-disabled)."""
+    rc = getattr(sock.conn, "read_chunks", None)
+    if rc is None:
+        sock.fast_drain = None
+        return None, False
+    chunks, eof = rc()
+    if eof:
+        # the classic chunk drain's verdict (Socket._drain_readable)
+        sock.set_failed(ConnectionResetError("peer closed"))
+        return None, True
+    if not chunks:
+        return None, True
+    data = chunks[0] if len(chunks) == 1 else b"".join(chunks)
+    nreads.add(len(data))
+    return data, False
 
 SocketId = VersionedId
 
@@ -643,9 +668,22 @@ class Socket:
                 except Exception:
                     self._busy_paused = False
         scan = None
+        dup_fd = -1
         if fast is not None and not self.input_portal and not self.input_need:
             fc = _fastcore()
             scan = getattr(fc, "pluck_scan", None) if fc is not None else None
+            if scan is not None:
+                # pin the kernel socket for the native loop: a concurrent
+                # set_failed closes the conn's fd while the C call sits
+                # in poll/recv with the GIL released, and the OS could
+                # hand the fd NUMBER to a brand-new connection — whose
+                # bytes the loop would then consume. The dup holds this
+                # socket open for the loop's duration; after a close the
+                # loop sees clean EOF/reset, never a foreign stream.
+                try:
+                    dup_fd = os.dup(fd)
+                except OSError:
+                    scan = None
         poller = None
         escalated = False
         carry = b""
@@ -658,7 +696,7 @@ class Socket:
                 # (timeout timer, another thread completing the call)
                 if scan is not None:
                     magic, cid, max_body, on_resp = fast
-                    r = scan(fd, magic, cid,
+                    r = scan(dup_fd, magic, cid,
                              int(min(remaining, 0.2) * 1000) + 1,
                              max_body, carry)
                     tag = r[0]
@@ -717,6 +755,11 @@ class Socket:
                 if escalated:
                     break
         finally:
+            if dup_fd >= 0:
+                try:
+                    os.close(dup_fd)
+                except OSError:
+                    pass
             if carry:
                 # a partial frame read by the native loop: back into the
                 # portal — more bytes must arrive for it to complete, and

@@ -359,6 +359,74 @@ class TestLanesEndToEnd:
         lis.close()
         t.join(2.0)
 
+    def test_chunk_lanes_mem_echo_end_to_end(self):
+        # mem:// (chunk-handoff): both sides run the chunk fast lanes —
+        # server serve_scan straight off the writer's bytes, client
+        # scan_frames dispatch without the portal
+        server, _ = (None, None)
+        server = Server(ServerOptions(enable_builtin_services=False))
+        svc = Service("Bench")
+
+        @svc.method(native="echo")
+        def Echo(cntl, request):
+            return request
+
+        @svc.method()
+        def Upper(cntl, request):
+            data = request if isinstance(request, (bytes, bytearray)) \
+                else request.to_bytes()
+            return data.upper()
+
+        server.add_service(svc)
+        server.start("mem://fdlanes-chunk")
+        try:
+            ch = Channel("mem://fdlanes-chunk",
+                         ChannelOptions(timeout_ms=5000))
+            for i in range(50):
+                cl = ch.call_sync("Bench", "Echo", b"c%d" % i)
+                assert not cl.failed()
+                assert cl.response_payload.to_bytes() == b"c%d" % i
+            # classic-method interleave still exact
+            u = ch.call_sync("Bench", "Upper", b"abc")
+            assert u.response_payload.to_bytes() == b"ABC"
+            # large frames defer to the classic path mid-lane
+            big = b"L" * (SMALL_FRAME_MAX * 2 + 5)
+            cl = ch.call_sync("Bench", "Echo", big)
+            assert cl.response_payload.to_bytes() == big
+            # error responses flow through the fast response dispatch
+            e = ch.call_sync("Bench", "Nope", b"x")
+            assert e.failed()
+            ch.close()
+        finally:
+            server.stop()
+
+    def test_chunk_lane_pipelined_burst(self):
+        server = Server(ServerOptions(enable_builtin_services=False))
+        svc = Service("Bench")
+
+        @svc.method(native="echo")
+        def Echo(cntl, request):
+            return request
+
+        server.add_service(svc)
+        server.start("mem://fdlanes-burst")
+        try:
+            ch = Channel("mem://fdlanes-burst",
+                         ChannelOptions(timeout_ms=5000))
+            ctls = [ch.call("Bench", "Echo", b"b%d" % i) for i in range(32)]
+            for i, c in enumerate(ctls):
+                assert c.join(5.0) and not c.failed()
+                assert c.response_payload.to_bytes() == b"b%d" % i
+            ch.close()
+        finally:
+            server.stop()
+
+    def test_client_hook_not_installed_for_other_protocols(self):
+        from brpc_tpu.rpc.channel import client_fast_drain_hook
+        assert client_fast_drain_hook(ChannelOptions(
+            protocol="hulu_pbrpc")) is None
+        assert client_fast_drain_hook(ChannelOptions()) is not None
+
     def test_pipelined_async_then_sync_share_the_connection(self):
         server, ep = _echo_server()
         try:
