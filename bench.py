@@ -433,6 +433,10 @@ def main() -> None:
                        "decode in one C pass)",
                        "serve_scan (echo-class methods served "
                        "end-to-end in C)",
+                       "pluck_scan (client sync receive loop: poll + "
+                       "recv + frame scan in one C call per slice)",
+                       "serve_drain (server per-event loop: recv + cut "
+                       "+ match + response build in one C call)",
                        "http_parse_request / http_parse_resp_head "
                        "(HTTP/1.x head parse, httpparse.cc)",
                        "respool.cc Pool (correlation ids + socket ids)",
