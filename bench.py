@@ -572,9 +572,10 @@ def main() -> None:
                       if "small_rpc_error" in result else {})})
         # pooled connections: the reference's headline shape
         # (multi-connection pooled client, docs/cn/benchmark.md:104).
-        # Inflight 6: measured sweet spot on a 1-core box — deeper
-        # pipelines only grow the cache working set (16 x 2MB of
-        # in-flight payload blocks thrash what 6 keeps warm)
+        # Inflight 8: re-measured sweet spot with the round-5 lanes
+        # (matches the sweep's 16MB in-flight-bytes window; 1.81-1.86
+        # vs 1.70-1.81 at depth 6 across two tuning rounds) — deeper
+        # pipelines only grow the cache working set and regress
         ch = Channel(f"tcp://127.0.0.1:{port}",
                      ChannelOptions(timeout_ms=120000,
                                     connection_type="pooled"))
@@ -583,7 +584,7 @@ def main() -> None:
         # warm with the MEASUREMENT shape (pooled sockets get created
         # per inflight slot; a single-threaded warm leaves half the
         # pool cold and the first measured batch pays connection setup)
-        warm_dt = run(24, 6, None, payload=payload, threads=2)
+        warm_dt = run(24, 8, None, payload=payload, threads=2)
         per_call = warm_dt / 24
         tcp_budget = min(deadline.remaining() * 0.35, 30.0)
         iters = int(clamp(tcp_budget / 2 / max(per_call, 1e-9), 16, 400))
@@ -592,7 +593,7 @@ def main() -> None:
         for b in range(2):
             if b > 0 and deadline.remaining() < iters * per_call * 1.2:
                 break
-            dt = run(iters, 6, rec, payload=payload, threads=2)
+            dt = run(iters, 8, rec, payload=payload, threads=2)
             gbps = max(gbps, iters * (1 << 20) * 2 / 1e9 / dt)
         # machine calibrations, both reported so vs_baseline has context
         # (the reference's 2.3 GB/s was multi-core + 10GbE with NIC
