@@ -315,21 +315,32 @@ def measure_wake_under_load(ch, n: int = 200) -> dict:
     """Fiber spawn->first-step latency while RPC load saturates the
     core (the wake path's accountability number; round 3 measured
     p50 ~1ms / p99 ~25ms here because every call paid 3-5 wakes that
-    convoyed — the inline rework removed them from the data path)."""
+    convoyed — the inline rework removed them from the data path).
+
+    The LOAD RATE ships next to the percentiles: the probe's tail is
+    GIL/timeslice contention against the hammer threads, so a faster
+    RPC path makes the load heavier and the tail longer — comparing
+    percentiles across rounds without the load figure misreads a
+    faster data path as a slower wake path (round 5's lanes roughly
+    doubled the hammer throughput and the p99 moved with it)."""
     from brpc_tpu.fiber import global_control
 
     ctl = global_control()
     stop = [False]
+    calls = [0, 0]
 
-    def hammer():
+    def hammer(i):
         while not stop[0]:
             ch.call_sync("Bench", "Echo", b"w")
+            calls[i] += 1
 
-    ths = [threading.Thread(target=hammer, daemon=True) for _ in range(2)]
+    ths = [threading.Thread(target=hammer, args=(i,), daemon=True)
+           for i in range(2)]
     for t in ths:
         t.start()
     time.sleep(0.2)
     lat = []
+    t_load0 = time.perf_counter()
     try:
         for _ in range(n):
             t0 = time.perf_counter_ns()
@@ -343,6 +354,7 @@ def measure_wake_under_load(ch, n: int = 200) -> dict:
                 lat.append(box["dt"])
             time.sleep(0.002)
     finally:
+        load_dt = time.perf_counter() - t_load0
         stop[0] = True
     for t in ths:
         t.join(10)
@@ -352,6 +364,7 @@ def measure_wake_under_load(ch, n: int = 200) -> dict:
     return {
         "fiber_wake_under_load_p50_us": round(lat[len(lat) // 2], 1),
         "fiber_wake_under_load_p99_us": round(lat[int(len(lat) * 0.99)], 1),
+        "fiber_wake_load_qps": round(sum(calls) / max(load_dt, 1e-9), 1),
     }
 
 
