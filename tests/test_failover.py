@@ -1,0 +1,165 @@
+"""Socket-failure fan-out for in-flight calls (the reference's
+SetFailed -> bthread_id_error behavior, socket.cpp) and the one-verdict-
+per-attempt arbitration in Channel._maybe_retry: a failing socket can
+surface through two concurrent paths (the write's on_done error callback
+and set_failed's inflight failer fiber) — exactly one may act, and a
+verdict pinned to a dead attempt (stale issue seq) or a recycled
+controller (stale correlation id) must no-op."""
+
+import socket as pysock
+import threading
+import time
+
+from brpc_tpu.rpc import (Channel, ChannelOptions, Server, ServerOptions,
+                          Service)
+from brpc_tpu.rpc import errno_codes as berr
+from brpc_tpu.rpc.channel import _fail_inflight_calls
+from brpc_tpu.rpc.controller import Controller, address_call, take_call
+
+
+class _StubChannel(Channel):
+    """Channel whose _issue_rpc only does the attempt bookkeeping the
+    verdict logic depends on (seq bump + latch clear) and records the
+    re-issue — no sockets."""
+
+    def __init__(self):
+        super().__init__()  # no address: never connects
+        self.issues = []
+
+    def _issue_rpc(self, cntl):
+        d = cntl.__dict__
+        d["_issue_seq"] = d.get("_issue_seq", 0) + 1
+        d.pop("_fail_handled", None)
+        self.issues.append(cntl.correlation_id)
+
+
+def _inflight_cntl(ch, max_retry=1):
+    cntl = Controller()
+    cntl.__dict__["_completed"] = False
+    cntl.max_retry = max_retry
+    cntl.current_try = 0
+    cntl._owner_channel = ch
+    cntl._register_call()
+    cntl.__dict__["_issue_seq"] = 1
+    return cntl
+
+
+class TestVerdictArbitration:
+    def test_second_verdict_same_attempt_noops(self):
+        # both failure paths carry the SAME attempt's seq: the first
+        # retries (budget 1), the second must not burn the budget again
+        # or fail the freshly issued retry
+        ch = _StubChannel()
+        cntl = _inflight_cntl(ch, max_retry=1)
+        ch._maybe_retry(cntl, berr.EFAILEDSOCKET, "path A", expect_seq=1)
+        assert ch.issues == [cntl.correlation_id]
+        assert cntl.current_try == 1
+        ch._maybe_retry(cntl, berr.EFAILEDSOCKET, "path B", expect_seq=1)
+        assert ch.issues == [cntl.correlation_id]   # no double re-issue
+        assert not cntl._completed                  # retry not failed
+        assert take_call(cntl.correlation_id) is cntl  # cleanup
+
+    def test_verdict_for_live_attempt_still_acts(self):
+        # a verdict carrying the CURRENT attempt's seq acts normally
+        ch = _StubChannel()
+        cntl = _inflight_cntl(ch, max_retry=0)
+        ch._maybe_retry(cntl, berr.EFAILEDSOCKET, "real", expect_seq=1)
+        assert cntl._completed and cntl.failed()
+        assert cntl.error_code == berr.EFAILEDSOCKET
+
+    def test_stale_cid_noops_after_recycle(self):
+        # the failer snapshot named a call that completed and whose
+        # controller was recycled onto a NEW call: the old cid resolves
+        # to nothing, so the new call is untouched
+        ch = _StubChannel()
+        cntl = _inflight_cntl(ch, max_retry=0)
+        old_cid = cntl.correlation_id
+        assert take_call(old_cid) is cntl       # old call completes
+        cntl._register_call()                   # recycled: new cid
+        cntl.__dict__["_issue_seq"] = 2
+        ch._maybe_retry(cntl, berr.EFAILEDSOCKET, "stale",
+                        expect_cid=old_cid, expect_seq=1)
+        assert not cntl._completed
+        assert address_call(cntl.correlation_id) is cntl
+        assert take_call(cntl.correlation_id) is cntl  # cleanup
+
+    def test_failer_list_uses_snapshot_ids(self):
+        # _fail_inflight_calls with a stale (cid, seq) pair: no-op; with
+        # the live pair: completes the call
+        ch = _StubChannel()
+        stale = _inflight_cntl(ch, max_retry=0)
+        stale_cid = stale.correlation_id
+        assert take_call(stale_cid) is stale
+        stale._register_call()
+        stale.__dict__["_issue_seq"] = 5
+        live = _inflight_cntl(ch, max_retry=0)
+
+        class _Sock:
+            fail_reason = ConnectionError("dead")
+            remote_endpoint = None
+
+        _fail_inflight_calls(_Sock(), [
+            (stale, stale_cid, 1),                       # stale both ways
+            (live, live.correlation_id, 1),              # live
+        ])
+        assert not stale._completed
+        assert live._completed and live.error_code == berr.EFAILEDSOCKET
+        assert take_call(stale.correlation_id) is stale  # cleanup
+
+
+class TestFailoverEndToEnd:
+    def test_peer_close_fails_call_fast(self):
+        lis = pysock.socket()
+        lis.bind(("127.0.0.1", 0))
+        lis.listen(1)
+        port = lis.getsockname()[1]
+
+        def evil():
+            c, _ = lis.accept()
+            c.recv(4096)
+            c.close()
+
+        t = threading.Thread(target=evil, daemon=True)
+        t.start()
+        ch = Channel(f"tcp://127.0.0.1:{port}",
+                     ChannelOptions(timeout_ms=8000, max_retry=0))
+        t0 = time.monotonic()
+        cl = ch.call_sync("Bench", "Echo", b"x")
+        assert cl.failed() and cl.error_code == berr.EFAILEDSOCKET
+        assert time.monotonic() - t0 < 2.0   # not the 8s deadline
+        ch.close()
+        lis.close()
+        t.join(2.0)
+
+    def test_retry_reaches_a_healthy_server_after_close(self):
+        # first attempt lands on a connection the server kills; the
+        # inflight failover retries and the call SUCCEEDS on reconnect
+        server = Server(ServerOptions(enable_builtin_services=False))
+        svc = Service("Bench")
+        seen = []
+
+        @svc.method()
+        def Flaky(cntl, request):
+            seen.append(bytes(request) if isinstance(request, bytes)
+                        else request.to_bytes())
+            if len(seen) == 1:
+                # kill the connection instead of answering
+                cntl._server_socket.set_failed(
+                    ConnectionError("handler kills conn"))
+                return b""
+            return b"recovered"
+
+        server.add_service(svc)
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                         ChannelOptions(timeout_ms=8000, max_retry=2))
+            t0 = time.monotonic()
+            cl = ch.call_sync("Bench", "Flaky", b"try")
+            assert not cl.failed(), (cl.error_code, cl.error_text)
+            assert cl.response_payload.to_bytes() == b"recovered"
+            assert time.monotonic() - t0 < 4.0
+            assert len(seen) == 2
+            ch.close()
+        finally:
+            server.stop()
