@@ -312,6 +312,19 @@ class Channel:
             cntl.set_failed(berr.ELIMIT, str(e))
             cntl._complete()
             return cntl
+        if _lazy_deadline:
+            # sync caller on a plain thread: the issue path may claim
+            # the pluck lane BEFORE the send (pluck_preclaim), so the
+            # response can only complete on the joining thread — on a
+            # 1-core box the dispatcher otherwise wins the race to the
+            # response about half the time (cross-thread completion +
+            # event-wait join, the expensive shape). Set HERE, after
+            # every path that could return without issuing — a leaked
+            # flag would make a later done-callback call preclaim a
+            # lane no joiner ever consumes (a wedged socket).
+            from brpc_tpu.fiber.scheduler import current_group
+            if current_group() is None:
+                cntl.__dict__["_sync_fast"] = True
         self._issue_rpc(cntl)
         # deadline timer: final — no retry after it fires (HandleTimeout).
         # With inline input processing the response may have completed
@@ -450,6 +463,14 @@ class Channel:
         d["_issue_seq"] = d.get("_issue_seq", 0) + 1
         d.pop("_pluck_fast", None)
         d.pop("_fail_handled", None)
+        # a previous attempt's unconsumed pre-claim must not wedge its
+        # socket (reads paused, claim never handed to a plucker); the
+        # sync-fast hint is first-issue-only — a retry's joiner may
+        # already be plucking another socket
+        pre = d.pop("_pluck_preclaimed", None)
+        if pre is not None:
+            pre.pluck_release()
+        sync_fast = d.pop("_sync_fast", False)
         try:
             sock = self._pick_socket(cntl)
         except (ConnectionError, OSError, ValueError) as e:
@@ -494,6 +515,10 @@ class Channel:
                 # call (Socket.pluck_until fast lane): the expected
                 # response is a small tpu_std frame
                 cntl.__dict__["_pluck_fast"] = (_TPU_MAGIC, SMALL_FRAME_MAX)
+                # first-issue sync call: claim the lane pre-send so the
+                # dispatcher can never win the race to the response
+                if sync_fast and sock.pluck_preclaim():
+                    d["_pluck_preclaimed"] = sock
             else:
                 # large attachment: same cached-prefix meta (no pb build
                 # per call), attachment rides as zero-copy refs behind

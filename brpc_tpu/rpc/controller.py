@@ -266,6 +266,10 @@ class Controller:
         #                                    clamp the new call's pluck
         d.pop("_pluck_fast", None)         # per-issue native-pluck hint
         d.pop("_fail_handled", None)       # per-attempt failure latch
+        d.pop("_sync_fast", None)          # per-call pre-claim hint
+        pre = d.pop("_pluck_preclaimed", None)
+        if pre is not None:                # unconsumed pre-send claim
+            pre.pluck_release()
         d.pop("response_payload", None)
         d.pop("response_attachment", None)
         d.pop("response_device_arrays", None)
@@ -484,69 +488,93 @@ class Controller:
         adopts the issuing socket's input and processes its own
         response in place (Socket.pluck_until) — zero cross-thread
         wakes. Fiber workers and pluck-incapable transports fall to
-        the event wait."""
-        if self._finalized:
-            return True
-        sock = self._issue_socket
-        pend = self.__dict__.get("_pending_deadline")
-        if sock is not None and not sock.failed:
-            from brpc_tpu.fiber.scheduler import current_group
-            if current_group() is None:
-                deadline = time.monotonic() + (
-                    timeout_s if timeout_s is not None else 86400.0)
-                if pend is not None:
-                    # multiplex gate, bilateral with _set_issue_socket:
-                    # under the same lock, either we see other calls in
-                    # flight (keep the real timer), or we register as
-                    # the socket's lazy plucker so a later issuer arms
-                    # our timer for us — no window where a big foreign
-                    # response can stall the deadline with no timer
-                    with sock.pending_lock:
-                        if sock.client_inflight > 1:
-                            pend = None
-                        else:
-                            sock._lazy_plucker = self
-                    if pend is None:
-                        self._arm_lazy_deadline()
-                # lazy deadline (call_sync): the plucker IS the timer —
-                # clamp the pluck to the RPC deadline and fire the final
-                # timeout path ourselves if it passes (same thread-safe
-                # take the timer thread would do)
-                pluck_deadline = deadline if pend is None \
-                    else min(deadline, pend[1])
-                # native receive loop (fastcore pluck_scan): armed by the
-                # small-frame issue path; completes through the same
-                # process_response_fast the turbo dispatcher uses
-                fast = None
-                pf = self.__dict__.get("_pluck_fast")
-                if pf is not None:
-                    global _prf
-                    if _prf is None:
-                        from brpc_tpu.rpc.client_dispatch import \
-                            process_response_fast as _prf_mod
-                        _prf = _prf_mod
-                    fast = (pf[0], self.correlation_id, pf[1], _prf)
-                try:
-                    if sock.pluck_until(lambda: self._finalized,
-                                        pluck_deadline, fast=fast):
-                        return True
-                except Exception:
-                    pass   # pluck is an optimization, never a failure
-                finally:
+        the event wait. A pre-send claim taken by the issue path
+        (pluck_preclaim) is consumed here, or released on every path
+        that cannot pluck — an unconsumed claim would wedge the
+        socket (reads paused forever)."""
+        pre = self.__dict__.pop("_pluck_preclaimed", None)
+        try:
+            if self._finalized:
+                return True
+            sock = self._issue_socket
+            if pre is not None and pre is not sock:
+                # a retry moved the call off the preclaimed socket:
+                # release NOW — holding its lane (reads paused) while
+                # we pluck the new socket would starve every other
+                # call multiplexed on it for up to the deadline
+                pre.pluck_release()
+                pre = None
+            pend = self.__dict__.get("_pending_deadline")
+            if sock is not None and not sock.failed:
+                from brpc_tpu.fiber.scheduler import current_group
+                if current_group() is None:
+                    deadline = time.monotonic() + (
+                        timeout_s if timeout_s is not None else 86400.0)
                     if pend is not None:
+                        # multiplex gate, bilateral with
+                        # _set_issue_socket: under the same lock, either
+                        # we see other calls in flight (keep the real
+                        # timer), or we register as the socket's lazy
+                        # plucker so a later issuer arms our timer for
+                        # us — no window where a big foreign response
+                        # can stall the deadline with no timer
                         with sock.pending_lock:
-                            if sock._lazy_plucker is self:
-                                sock._lazy_plucker = None
-                if pend is not None and not self._finalized and \
-                        time.monotonic() >= pend[1]:
+                            if sock.client_inflight > 1:
+                                pend = None
+                            else:
+                                sock._lazy_plucker = self
+                        if pend is None:
+                            self._arm_lazy_deadline()
+                    # lazy deadline (call_sync): the plucker IS the
+                    # timer — clamp the pluck to the RPC deadline and
+                    # fire the final timeout path ourselves if it passes
+                    # (same thread-safe take the timer thread would do)
+                    pluck_deadline = deadline if pend is None \
+                        else min(deadline, pend[1])
+                    # native receive loop (fastcore pluck_scan): armed
+                    # by the small-frame issue path; completes through
+                    # the same process_response_fast the turbo
+                    # dispatcher uses
+                    fast = None
+                    pf = self.__dict__.get("_pluck_fast")
+                    if pf is not None:
+                        global _prf
+                        if _prf is None:
+                            from brpc_tpu.rpc.client_dispatch import \
+                                process_response_fast as _prf_mod
+                            _prf = _prf_mod
+                        fast = (pf[0], self.correlation_id, pf[1], _prf)
                     try:
-                        pend[0]._on_timeout(self)
+                        claimed = pre is sock
+                        if claimed:
+                            pre = None   # pluck_until settles the claim
+                        if sock.pluck_until(lambda: self._finalized,
+                                            pluck_deadline, fast=fast,
+                                            preclaimed=claimed):
+                            return True
                     except Exception:
-                        pass
-                    if self._finalized:
-                        return True
-                if timeout_s is not None:
-                    timeout_s = max(0.0, deadline - time.monotonic())
+                        pass   # pluck is an optimization, never a failure
+                    finally:
+                        if pend is not None:
+                            with sock.pending_lock:
+                                if sock._lazy_plucker is self:
+                                    sock._lazy_plucker = None
+                    if pend is not None and not self._finalized and \
+                            time.monotonic() >= pend[1]:
+                        try:
+                            pend[0]._on_timeout(self)
+                        except Exception:
+                            pass
+                        if self._finalized:
+                            return True
+                    if timeout_s is not None:
+                        timeout_s = max(0.0, deadline - time.monotonic())
+        finally:
+            if pre is not None:
+                try:
+                    pre.pluck_release()
+                except Exception:
+                    pass
         # leaving the pluck lane (escalation, failed socket, fiber
         # caller, claim contention): the deadline needs a real timer
         self._arm_lazy_deadline()

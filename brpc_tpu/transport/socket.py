@@ -626,7 +626,54 @@ class Socket:
             self._finish_input_cycle(pending)
         return False
 
-    def pluck_until(self, pred, deadline_s: float, fast=None) -> bool:
+    def pluck_preclaim(self) -> bool:
+        """Claim the sync-pluck lane BEFORE the request is sent: pausing
+        read interest pre-send closes the 1-core race where the kernel
+        runs the server and then the dispatcher before the issuing
+        thread resumes — the response would complete on the dispatcher
+        (cross-thread wake, event-wait join) on roughly a coin flip.
+        Returns True when claimed; the caller MUST hand the claim to
+        pluck_until(preclaimed=True) or release via pluck_release()."""
+        if getattr(self.conn, "pluck_fd", None) is None \
+                or self._on_input_sync is None or self.failed:
+            return False
+        with self._nevent_lock:
+            if self._nevent > 0 or self._plucking:
+                return False
+            self._plucking = True
+            if self._level_triggered and not self._busy_paused:
+                self._busy_paused = True
+                try:
+                    self.conn.pause_read_events()
+                except Exception:
+                    self._busy_paused = False
+        return True
+
+    def pluck_release(self) -> None:
+        """THE pluck-claim settle protocol, shared by pluck_until's exit
+        and every path that abandons a pluck_preclaim (retry moved the
+        call to another socket, the joiner never arrived). Pause flag
+        and fd read-interest change under the same lock as the claim,
+        so they can never disagree; deferred events we didn't settle
+        get one normal pass (its finish cycle restores read interest
+        and balances the _nevent accounting)."""
+        with self._nevent_lock:
+            if not self._plucking:
+                return
+            self._plucking = False
+            leftover = self._nevent > 0
+            if self._busy_paused and not leftover:
+                self._busy_paused = False
+                if not self.failed:
+                    try:
+                        self.conn.resume_read_events()
+                    except Exception:
+                        pass
+        if leftover and not self.failed:
+            self._process_input_entry()
+
+    def pluck_until(self, pred, deadline_s: float, fast=None,
+                    preclaimed: bool = False) -> bool:
         """Sync-pluck lane: a joining (non-worker) thread adopts this
         socket's input processing until ``pred()`` or the deadline — the
         caller waiting for its response drives the connection itself,
@@ -646,27 +693,20 @@ class Socket:
         classic path can judge (foreign frames, slow metas, pipelined
         tails) is re-injected into the portal and processed through the
         normal machinery — the lanes cannot diverge on semantics."""
+        # ONE claim protocol (pluck_preclaim) and ONE settle protocol
+        # (pluck_release) shared with the pre-send claim path — the
+        # lock-sensitive pause/resume dance must not exist twice
+        if not preclaimed and not self.pluck_preclaim():
+            return pred()   # can't pluck / processing in flight
         pfd = getattr(self.conn, "pluck_fd", None)
-        if pfd is None or self._on_input_sync is None or self.failed:
+        if pfd is None or self._on_input_sync is None:
+            self.pluck_release()
             return pred()
         try:
             fd = pfd()
         except OSError:
+            self.pluck_release()
             return pred()
-        with self._nevent_lock:
-            if self._nevent > 0 or self._plucking:
-                return pred()   # processing in flight: use the event path
-            self._plucking = True
-            # park the dispatcher for the duration: without this every
-            # response fires a level-triggered event whose busy-path
-            # probe (MSG_PEEK + pause dance) runs per message on the
-            # dispatcher thread while the plucker owns the data
-            if self._level_triggered and not self._busy_paused:
-                self._busy_paused = True
-                try:
-                    self.conn.pause_read_events()
-                except Exception:
-                    self._busy_paused = False
         scan = None
         dup_fd = -1
         if fast is not None and not self.input_portal and not self.input_need:
@@ -766,24 +806,9 @@ class Socket:
                 # their readable event restarts normal processing
                 self.input_portal.append_user_data(carry)
             if not escalated:
-                with self._nevent_lock:
-                    self._plucking = False
-                    leftover = self._nevent > 0
-                    if self._busy_paused and not leftover:
-                        # no settle pass will run _finish_input_cycle:
-                        # restore read interest here (same lock as the
-                        # pause, so flag and fd state never disagree)
-                        self._busy_paused = False
-                        if not self.failed:
-                            try:
-                                self.conn.resume_read_events()
-                            except Exception:
-                                pass
-                if leftover and not self.failed:
-                    # deferred events we didn't settle: one normal pass
-                    # balances the accounting and the pause/resume
-                    # protocol (its finish cycle restores read interest)
-                    self._process_input_entry()
+                # the shared settle (escalation already handed the
+                # claim + accounting to the normal machinery)
+                self.pluck_release()
         return pred()
 
     def _process_input_entry(self) -> None:

@@ -504,6 +504,46 @@ class TestLanesEndToEnd:
             protocol="hulu_pbrpc")) is None
         assert client_fast_drain_hook(ChannelOptions()) is not None
 
+    def test_timeout_releases_preclaim_and_socket_survives(self):
+        # the sync issue path claims the pluck lane PRE-send; a timed-out
+        # call must settle that claim (reads resumed) so the connection
+        # keeps working — and the late response is dropped as stale
+        server = Server(ServerOptions(enable_builtin_services=False))
+        svc = Service("Bench")
+
+        @svc.method()
+        async def Sometimes(cntl, request):
+            if bytes(request) == b"slow":
+                from brpc_tpu.fiber.timer import sleep as fiber_sleep
+                await fiber_sleep(0.6)
+            return b"ok:" + bytes(request)
+
+        server.add_service(svc)
+        ep = server.start("tcp://127.0.0.1:0")
+        try:
+            ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                         ChannelOptions(timeout_ms=150, max_retry=0))
+            cl = ch.call_sync("Bench", "Sometimes", b"slow")
+            from brpc_tpu.rpc import errno_codes as berr
+            assert cl.failed() and cl.error_code == berr.ERPCTIMEDOUT
+            # same channel, same socket: the lane must have been
+            # released; the late 'slow' response must not corrupt or
+            # complete this fresh call
+            ch2 = Channel(f"tcp://127.0.0.1:{ep.port}",
+                          ChannelOptions(timeout_ms=3000))
+            for _ in range(5):
+                cl = ch.call_sync("Bench", "Sometimes", b"fast")
+                if not cl.failed():
+                    break
+                time.sleep(0.2)   # late response may race the reuse
+            assert not cl.failed(), (cl.error_code, cl.error_text)
+            assert cl.response_payload.to_bytes() == b"ok:fast"
+            cl = ch2.call_sync("Bench", "Sometimes", b"fast")
+            assert cl.response_payload.to_bytes() == b"ok:fast"
+            ch.close(); ch2.close()
+        finally:
+            server.stop()
+
     def test_pipelined_async_then_sync_share_the_connection(self):
         server, ep = _echo_server()
         try:
