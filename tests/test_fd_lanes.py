@@ -220,6 +220,50 @@ class TestPluckScanFuzz:
                 a.close(); b.close()
 
 
+class TestServeDrainFuzz:
+    def test_differential_vs_serve_scan(self):
+        """serve_drain over a socketpair must produce byte-identical
+        responses and consume/leftover decisions to serve_scan over the
+        same bytes (they share serve_core — this pins the fd plumbing
+        around it: recv boundaries, leftover slicing, nread)."""
+        import random
+        rng = random.Random(0xD12A)
+        for trial in range(200):
+            frames = []
+            for _ in range(rng.randrange(1, 6)):
+                kind = rng.random()
+                cid = rng.randrange(1, 1 << 32)
+                if kind < 0.6:
+                    frames.append(_req(cid, rng.randbytes(
+                        rng.randrange(0, 300))))
+                elif kind < 0.8:
+                    frames.append(_req(cid, b"x", service="Other"))
+                else:
+                    frames.append(_resp(cid, b"r"))
+            blob = b"".join(frames)
+            cut = rng.randrange(0, len(blob) + 1) \
+                if rng.random() < 0.4 else len(blob)
+            wire = blob[:cut]
+            if not wire:
+                continue
+            want = fc.serve_scan(wire, MAGIC, b"Bench", b"Echo",
+                                 SMALL_FRAME_MAX)
+            a, b = _pair()
+            try:
+                b.sendall(wire)
+                r = fc.serve_drain(a.fileno(), MAGIC, b"Bench", b"Echo",
+                                   SMALL_FRAME_MAX)
+                consumed, out, n = want
+                if n:
+                    assert r[0] == 0 and r[1] == out and r[2] == n, trial
+                    assert r[3] == wire[consumed:], trial
+                else:
+                    assert r[0] == 1 and r[1] == wire, trial
+                assert r[-1] == len(wire), trial   # nread
+            finally:
+                a.close(); b.close()
+
+
 class TestServeDrain:
     def test_single_request_round_trip(self):
         a, b = _pair()
@@ -541,6 +585,39 @@ class TestLanesEndToEnd:
             cl = ch2.call_sync("Bench", "Sometimes", b"fast")
             assert cl.response_payload.to_bytes() == b"ok:fast"
             ch.close(); ch2.close()
+        finally:
+            server.stop()
+
+    def test_two_sync_threads_share_one_multiplexed_socket(self):
+        # two threads call_sync on the SAME shared channel: one wins the
+        # pre-send pluck claim, the other's response crosses the winner's
+        # native loop as a foreign cid (defer -> classic dispatch) or
+        # completes via the event path — results must stay exact
+        server, ep = _echo_server()
+        try:
+            ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                         ChannelOptions(timeout_ms=5000))
+            errs = []
+
+            def worker(tag):
+                try:
+                    for i in range(150):
+                        body = b"%s-%d" % (tag, i)
+                        cl = ch.call_sync("Bench", "Echo", body)
+                        assert not cl.failed(), (cl.error_code,
+                                                 cl.error_text)
+                        assert cl.response_payload.to_bytes() == body
+                except Exception as e:   # noqa: BLE001
+                    errs.append(e)
+
+            ts = [threading.Thread(target=worker, args=(t,))
+                  for t in (b"alpha", b"beta")]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(30)
+            assert not errs, errs
+            ch.close()
         finally:
             server.stop()
 
