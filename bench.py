@@ -818,6 +818,72 @@ def main() -> None:
             result["concurrency_sweep"]["inflight_1MB"][str(depth)] = pt
             _progress({"progress": "inflight_point", "depth": depth, **pt})
         ch.close()
+        # ------------- StreamingRPC one-way throughput (the reference's
+        # streaming_echo_c++ north-star config, BASELINE.md): stream
+        # 256KB frames through a credit-windowed Stream to the server's
+        # sink, which answers with one done-frame when every byte
+        # arrived — flow control live on the wire, not a socket blast
+        if deadline.remaining() > 10.0:
+            try:
+                from brpc_tpu import fiber as _fiber
+                from brpc_tpu.rpc.stream import StreamOptions
+                frame = b"\x5a" * (256 << 10)
+                n_frames = 256                    # 64MB one way
+                total = len(frame) * n_frames
+                done_evt = threading.Event()
+                got_box = {}
+
+                def on_done(stream, msg):
+                    got_box["reply"] = msg.payload.to_bytes()
+                    done_evt.set()
+
+                sch = Channel(f"tcp://127.0.0.1:{port}",
+                              ChannelOptions(timeout_ms=30000))
+                stream = None
+                try:
+                    scntl = sch.call_sync(
+                        "Bench", "StreamSink", str(total).encode(),
+                        stream_options=StreamOptions(on_received=on_done))
+                    stream = scntl.stream
+                    if scntl.failed() or stream is None:
+                        raise RuntimeError(
+                            f"stream open failed: {scntl.error_text}")
+                    t0 = time.perf_counter()
+
+                    async def producer():
+                        for _ in range(n_frames):
+                            if not await stream.write(frame):
+                                break
+
+                    f = _fiber.spawn(producer)
+                    f.join(min(60.0, deadline.remaining()))
+                    ok = done_evt.wait(min(20.0, deadline.remaining()))
+                    dt = time.perf_counter() - t0
+                    if ok:
+                        result["streaming_GBps"] = round(total / dt / 1e9,
+                                                         3)
+                        result["streaming_frames"] = n_frames
+                        _progress({"progress": "streaming",
+                                   "GBps": result["streaming_GBps"],
+                                   "reply": got_box.get(
+                                       "reply", b"").decode(
+                                       "ascii", "replace")})
+                    else:
+                        result["streaming_error"] = \
+                            f"done-frame not received (dt={dt:.1f}s)"
+                        result["partial"] = True
+                finally:
+                    # every exit tears down: a failed open must not
+                    # leak the pool-registered client Stream or the
+                    # channel for the rest of the run
+                    if stream is not None:
+                        stream.close()
+                    sch.close()
+            except Exception as e:  # noqa: BLE001 - diagnostics only
+                result["streaming_error"] = f"{type(e).__name__}: {e}"[:200]
+                result["partial"] = True
+                _progress({"progress": "error", "phase": "streaming",
+                           "error": result["streaming_error"]})
     except BaseException as e:  # noqa: BLE001 - salvage partial data
         result["partial"] = True
         result["error"] = f"{type(e).__name__}: {e}"[:500]

@@ -27,7 +27,10 @@ def main(n_frames: int = 20, address: str = "") -> None:
         def Open(cntl, request):
             def on_received(stream, msg):
                 stream.write_nowait(b"echo:" + msg.payload.to_bytes())
-            stream_accept(cntl, StreamOptions(on_received=on_received))
+            s = stream_accept(cntl, StreamOptions(on_received=on_received))
+            if s is not None:
+                # handler-owned stream: self-close on the client's close
+                s.on_close(lambda st: st.close())
             return b"accepted"
 
         server.add_service(svc)

@@ -238,3 +238,65 @@ class TestStreaming:
             ch.close()
             server.stop()
             server.join(2)
+
+
+class TestBenchStreamSink:
+    def test_tool_server_sink_counts_and_acks(self):
+        """The bench's streaming phase shape end to end: stream 2MB of
+        256KB frames at the spawned tool server's StreamSink, expect
+        exactly one done:<n> ack once every byte arrived (credit flow
+        control live on a real subprocess boundary)."""
+        import os
+        import sys
+        import threading
+        import time
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        from spawn_util import spawn_port_server
+
+        from brpc_tpu import fiber
+        from brpc_tpu.rpc import Channel, ChannelOptions
+        from brpc_tpu.rpc.stream import StreamOptions
+
+        base = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc, port = spawn_port_server(
+            [os.path.join(base, "tools", "bench_echo_server.py")],
+            wall_s=20.0)
+        assert port, "tool server spawn failed"
+        try:
+            frame = b"\x11" * (256 << 10)
+            total = len(frame) * 8
+            done = threading.Event()
+            box = {}
+
+            def on_done(stream, msg):
+                box["reply"] = msg.payload.to_bytes()
+                done.set()
+
+            ch = Channel(f"tcp://127.0.0.1:{port}",
+                         ChannelOptions(timeout_ms=10000))
+            cntl = ch.call_sync(
+                "Bench", "StreamSink", str(total).encode(),
+                stream_options=StreamOptions(on_received=on_done))
+            assert not cntl.failed(), (cntl.error_code, cntl.error_text)
+            stream = cntl.stream
+            assert stream is not None
+
+            async def producer():
+                for _ in range(8):
+                    assert await stream.write(frame)
+
+            f = fiber.spawn(producer)
+            assert f.join(10)
+            # join() returns True even when the coroutine died on an
+            # exception — surface a failed write as itself, not as a
+            # misleading ack timeout below
+            assert f.exception is None, f.exception
+            assert done.wait(10), "sink never acked"
+            assert box["reply"] == b"done:%d" % total
+            stream.close()
+            ch.close()
+        finally:
+            proc.terminate()

@@ -49,6 +49,32 @@ def main() -> None:
         await fiber_sleep(0.05)
         return request
 
+    # StreamingRPC sink for the bench's streaming phase (the reference's
+    # streaming_echo_c++ north-star config): the Open request carries the
+    # expected byte total; the sink counts stream frames and answers with
+    # ONE "done:<n>" frame when everything arrived — one-way throughput
+    # with credit flow control live on the wire
+    from brpc_tpu.rpc.stream import StreamOptions, stream_accept
+
+    @svc.method()
+    def StreamSink(cntl, request):
+        want = int(bytes(request) or b"0")
+        state = {"got": 0, "done": False}
+
+        def on_received(stream, msg):
+            state["got"] += msg.payload.size
+            if state["got"] >= want and not state["done"]:
+                state["done"] = True
+                stream.write_nowait(b"done:%d" % state["got"])
+
+        s = stream_accept(cntl, StreamOptions(on_received=on_received))
+        if s is not None:
+            # the accepted stream is handler-owned (the reference's
+            # StreamAccept contract): self-close on the client's close
+            # so repeated bench runs don't accumulate pool entries
+            s.on_close(lambda st: st.close())
+        return b"accepted"
+
     server.add_service(svc)
     ep = server.start(f"tcp://127.0.0.1:{port}")
     print(f"PORT {ep.port}", flush=True)
