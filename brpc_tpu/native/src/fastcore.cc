@@ -211,11 +211,15 @@ struct MetaScan {
   uint64_t cid = 0;
   uint64_t att = 0;
   uint64_t log_id = 0;
-  int kind = -1;  // 0 request, 1 response
+  int kind = -1;  // 0 request, 1 response, 2 stream frame
   const char* svc = nullptr; size_t svc_len = 0;
   const char* mth = nullptr; size_t mth_len = 0;
   int32_t err_code = 0;
   const char* err = nullptr; size_t err_len = 0;
+  uint64_t stream_id = 0;   // kind 2 (StreamSettings)
+  uint64_t frame_seq = 0;
+  uint64_t s_credits = 0;
+  bool s_close = false;
   uint32_t meta_size = 0;  // filled by cut_fast_frame
   uint32_t body = 0;
 };
@@ -292,6 +296,38 @@ inline bool walk_response_meta(const unsigned char* p,
   return true;
 }
 
+// StreamSettings submessage (tpu_rpc_meta.proto): stream_id=1,
+// need_feedback=2 (read, unused by the dispatch path), frame_seq=3,
+// credits=4, close=5 — the whole vocabulary of a live stream frame
+inline bool walk_stream_meta(const unsigned char* p,
+                             const unsigned char* end, MetaScan* m) {
+  while (p < end) {
+    uint64_t key, v;
+    if (!read_varint(p, end, &key)) return false;
+    switch (key) {
+      case (1u << 3) | 0:
+        if (!read_varint(p, end, &m->stream_id)) return false;
+        break;
+      case (2u << 3) | 0:  // need_feedback
+        if (!read_varint(p, end, &v)) return false;
+        break;
+      case (3u << 3) | 0:
+        if (!read_varint(p, end, &m->frame_seq)) return false;
+        break;
+      case (4u << 3) | 0:
+        if (!read_varint(p, end, &m->s_credits)) return false;
+        break;
+      case (5u << 3) | 0:
+        if (!read_varint(p, end, &v)) return false;
+        m->s_close = v != 0;
+        break;
+      default:
+        return false;
+    }
+  }
+  return m->stream_id != 0;  // frames to stream 0 are garbage: slow path
+}
+
 inline bool walk_meta(const unsigned char* p, const unsigned char* end,
                       MetaScan* m) {
   while (p < end) {
@@ -326,8 +362,18 @@ inline bool walk_meta(const unsigned char* p, const unsigned char* end,
       case (5u << 3) | 0:
         if (!read_varint(p, end, &m->att)) return false;
         break;
+      case (6u << 3) | 2:  // stream_settings: a live stream frame —
+        // but establishment (request + stream_settings) and anything
+        // response/cid-bearing keeps full classic semantics
+        if (m->kind != -1) return false;
+        if (!read_varint(p, end, &len) || uint64_t(end - p) < len)
+          return false;
+        if (!walk_stream_meta(p, p + len, m)) return false;
+        m->kind = 2;
+        p += len;
+        break;
       default:
-        // stream_settings / device_payloads / trace ids / unknown
+        // device_payloads / trace ids / unknown
         return false;
     }
   }
@@ -338,6 +384,8 @@ inline bool walk_meta(const unsigned char* p, const unsigned char* end,
     if (m->cid == 0) return false;
     m->kind = 1;
   }
+  if (m->kind == 2 && m->cid != 0)
+    return false;  // non-canonical field order hid a correlation id
   return true;
 }
 
@@ -393,7 +441,15 @@ PyObject* fc_scan_frames(PyObject*, PyObject* args) {
     Py_ssize_t a_off = p_off + p_len;
     Py_ssize_t a_len = Py_ssize_t(m.att);
     PyObject* rec;
-    if (m.kind == 0) {
+    if (m.kind == 2) {
+      // live stream frame: (2, stream_id, frame_seq, credits, close,
+      // payload_off, payload_len, att_off, att_len)
+      rec = Py_BuildValue(
+          "iKKKinnnn", 2, (unsigned long long)m.stream_id,
+          (unsigned long long)m.frame_seq,
+          (unsigned long long)m.s_credits, (int)(m.s_close ? 1 : 0),
+          p_off, p_len, a_off, a_len);
+    } else if (m.kind == 0) {
       // service/method are proto3 strings: decode STRICTLY, but a
       // peer sending invalid UTF-8 must stop the scan (slow path —
       // the classic protobuf parser renders the verdict), not raise

@@ -399,6 +399,11 @@ class TpuStdProtocol(Protocol):
                 _, cid, ec, et, po, pl, ao, al = f
                 recs.append((1, cid, ec, et, bytes(win[po:po + pl]),
                              bytes(win[ao:ao + al]) if al else b""))
+            elif f[0] == 2:
+                _, sid, seq, credits, sclose, po, pl, ao, al = f
+                recs.append((2, sid, seq, credits, sclose,
+                             bytes(win[po:po + pl]),
+                             bytes(win[ao:ao + al]) if al else b""))
             else:
                 _, cid, svc, mth, lid, po, pl, ao, al = f
                 recs.append((0, cid, svc, mth, lid,
@@ -567,6 +572,7 @@ class TpuStdProtocol(Protocol):
         the same contract as process()."""
         from brpc_tpu.rpc.client_dispatch import process_response_fast
         from brpc_tpu.rpc.server_dispatch import process_request_fast
+        from brpc_tpu.rpc.stream import process_stream_frame_fast
         server = socket.user_data.get("server")
         pending = []
         last = len(recs) - 1
@@ -574,6 +580,11 @@ class TpuStdProtocol(Protocol):
             if rec[0] == 1:
                 process_response_fast(rec[1], rec[2], rec[3], rec[4],
                                       rec[5], socket)
+            elif rec[0] == 2:
+                # stream frames are order-critical: dispatched here in
+                # parse order, like the classic process_inline path
+                process_stream_frame_fast(rec[1], rec[2], rec[3],
+                                          rec[4], rec[5], rec[6])
             else:
                 r = process_request_fast(self, socket, server, rec[1],
                                          rec[2], rec[3], rec[4], rec[5],

@@ -39,6 +39,7 @@ def make_client_fast_drain():
     if scan is None:
         return None
     from brpc_tpu.protocol.tpu_std import MAGIC, SMALL_FRAME_MAX
+    from brpc_tpu.rpc.stream import process_stream_frame_fast
     from brpc_tpu.transport.socket import pull_chunks as _pull_chunks
 
     def fast_drain(sock) -> bool:
@@ -48,7 +49,7 @@ def make_client_fast_drain():
         if data is None:
             return handled
         consumed, frames = scan(data, MAGIC, SMALL_FRAME_MAX, 128)
-        if any(f[0] != 1 for f in frames):
+        if any(f[0] == 0 for f in frames):
             # a request-shaped frame on a client socket: hand the WHOLE
             # run to the classic machinery in parse order (scan records
             # carry payload offsets, not frame starts, so a partial
@@ -56,6 +57,14 @@ def make_client_fast_drain():
             sock.input_portal.append_user_data(data)
             return False
         for f in frames:
+            if f[0] == 2:
+                # live stream frame: dispatched in parse order, like
+                # the turbo lane
+                _, sid, seq, credits, sclose, po, pl, ao, al = f
+                process_stream_frame_fast(
+                    sid, seq, credits, sclose, data[po:po + pl],
+                    data[ao:ao + al] if al else b"")
+                continue
             _, cid, ec, et, po, pl, ao, al = f
             process_response_fast(cid, ec, et, data[po:po + pl],
                                   data[ao:ao + al] if al else b"", sock)

@@ -26,7 +26,7 @@ from brpc_tpu.butil.resource_pool import ResourcePool
 from brpc_tpu.fiber import ExecutionQueue, global_control
 from brpc_tpu.fiber.butex import Butex, WAIT_TIMEOUT
 from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
-from brpc_tpu.protocol.tpu_std import pack_message
+from brpc_tpu.protocol.tpu_std import (_HDR, MAGIC, _varint, pack_message)
 
 _stream_pool: ResourcePool = ResourcePool()
 _stream_pool.insert(None)  # stream id 0 = invalid (proto3 zero default)
@@ -125,6 +125,40 @@ class Stream:
 
     def _send_frame(self, payload, device_arrays, close: bool = False,
                     credits: int = 0, data: bool = True) -> None:
+        if not device_arrays and \
+                isinstance(payload, (bytes, bytearray, memoryview)):
+            if not isinstance(payload, bytes):
+                # normalize ONCE: len(memoryview) counts elements, not
+                # bytes, for itemsize > 1 — sizing the header off it
+                # would desync the wire
+                payload = bytes(payload)
+            # fast pack: the meta is fully determined by four small
+            # fields — hand-encode it (bit-identical to the pb
+            # serializer: ascending field numbers, minimal varints;
+            # golden-pinned by tests) instead of building an RpcMeta
+            # per frame. stream_id=1, frame_seq=3, credits=4, close=5
+            # inside stream_settings (RpcMeta field 6); payload bytes
+            # ride zero-copy for big frames.
+            inner = b"\x08" + _varint(self.peer_id)
+            if data:
+                self._frame_seq += 1
+                inner += b"\x18" + _varint(self._frame_seq)
+            if credits:
+                inner += b"\x20" + _varint(credits)
+            if close:
+                inner += b"\x28\x01"
+            meta_bytes = b"\x32" + _varint(len(inner)) + inner
+            pl = len(payload)
+            hdr = _HDR.pack(MAGIC, len(meta_bytes) + pl,
+                            len(meta_bytes)) + meta_bytes
+            if pl <= 65536:
+                self.socket.write(hdr + payload)
+            else:
+                wire = IOBuf()
+                wire.append(hdr)
+                wire.append_user_data(payload)
+                self.socket.write(wire)
+            return
         meta = pb.RpcMeta()
         ss = meta.stream_settings
         ss.stream_id = self.peer_id
@@ -301,6 +335,68 @@ def process_stream_frame(msg, socket) -> None:
     if stream is None:
         return  # stream already closed; drop (reference drops too)
     stream._on_frame(msg)
+
+
+_payload_bytes = None   # client_dispatch.PayloadBytes, bound on first use
+
+
+class FastStreamMsg:
+    """The turbo lane's stream-frame message: payload/attachment are
+    plain bytes wearing the documented read surface (to_bytes/size via
+    PayloadBytes) — no RpcMeta object, no IOBuf. ``meta`` materializes
+    a pb view lazily for the rare consumer that wants it, carrying
+    EVERY StreamSettings field the frame had (the classic lane's
+    msg.meta does — the lanes must not observably diverge)."""
+
+    __slots__ = ("payload", "attachment", "device_arrays", "_ss")
+
+    def __init__(self, payload, attachment, sid: int, seq: int,
+                 credits: int = 0, close: int = 0):
+        global _payload_bytes
+        if _payload_bytes is None:
+            from brpc_tpu.rpc.client_dispatch import PayloadBytes
+            _payload_bytes = PayloadBytes
+        self.payload = _payload_bytes(payload)
+        ab = IOBuf()
+        if attachment:
+            ab.append(attachment)
+        self.attachment = ab
+        # frames carrying device payloads always take the classic path
+        # (the scanner defers them), so this lane's is empty by contract
+        self.device_arrays: list = []
+        self._ss = (sid, seq, credits, close)
+
+    @property
+    def meta(self):
+        m = pb.RpcMeta()
+        ss = m.stream_settings
+        ss.stream_id = self._ss[0]
+        if self._ss[1]:
+            ss.frame_seq = self._ss[1]
+        if self._ss[2]:
+            ss.credits = self._ss[2]
+        if self._ss[3]:
+            ss.close = True
+        return m
+
+
+def process_stream_frame_fast(sid: int, seq: int, credits: int, close: int,
+                              payload: bytes, att: bytes) -> None:
+    """Dispatch a scan_frames stream record (turbo lane): the inlined
+    twin of Stream._on_frame — keep their semantics in lockstep."""
+    stream = _stream_pool.address(sid)
+    if stream is None:
+        return  # stream already closed; drop (reference drops too)
+    if credits:
+        stream._credits.fetch_add(credits)
+        stream._credits.wake_all()
+    if close:
+        stream._remote_close_once()
+        return
+    if seq:  # DATA frame (possibly empty payload)
+        stream._recv_q.execute(("frame", FastStreamMsg(payload, att, sid,
+                                                       seq, credits,
+                                                       close)))
 
 
 # ------------------------------------------------------------- establishment

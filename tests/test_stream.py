@@ -300,3 +300,106 @@ class TestBenchStreamSink:
             ch.close()
         finally:
             proc.terminate()
+
+
+class TestNativeStreamLane:
+    def test_fast_pack_is_bit_identical_to_pb(self):
+        """_send_frame's hand-encoded meta must match the protobuf
+        serializer byte for byte — same ascending field order, same
+        minimal varints — for every frame shape it covers."""
+        from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
+        from brpc_tpu.protocol.tpu_std import _HDR, MAGIC, pack_message
+
+        class _Rec:
+            def __init__(self):
+                self.wires = []
+
+            def write(self, w):
+                self.wires.append(w if isinstance(w, bytes) else w.to_bytes())
+
+        import array
+        for kw, payload in [
+            (dict(data=True), b"body"),
+            (dict(data=True), b""),
+            (dict(data=False, credits=37), b""),
+            (dict(data=False, close=True), b""),
+            (dict(data=True, credits=300), b"x" * 100),
+            # multi-byte memoryview: len() counts elements, the header
+            # must count BYTES (a desync here poisons the connection)
+            (dict(data=True), memoryview(array.array("I", [1, 2, 3]))),
+        ]:
+            s = Stream()
+            s.peer_id = 0x1234
+            s.socket = _Rec()
+            s._send_frame(payload, None, **kw)
+            got = s.socket.wires[-1]
+
+            meta = pb.RpcMeta()
+            ss = meta.stream_settings
+            ss.stream_id = 0x1234
+            if kw.get("data"):
+                ss.frame_seq = 1
+            if kw.get("close"):
+                ss.close = True
+            if kw.get("credits"):
+                ss.credits = kw["credits"]
+            pay = bytes(payload)
+            mb = meta.SerializeToString()
+            want = _HDR.pack(MAGIC, len(mb) + len(pay), len(mb)) \
+                + mb + pay
+            assert got == want, (kw, got.hex(), want.hex())
+            s.close()
+
+    def test_scanner_yields_stream_records(self):
+        from brpc_tpu.native import fastcore
+        from brpc_tpu.protocol.tpu_std import MAGIC, SMALL_FRAME_MAX
+        fc = fastcore.get()
+        if fc is None:
+            import pytest
+            pytest.skip("fastcore unavailable")
+
+        class _Rec:
+            def __init__(self):
+                self.wires = []
+
+            def write(self, w):
+                self.wires.append(w if isinstance(w, bytes) else w.to_bytes())
+
+        s = Stream()
+        s.peer_id = 99
+        s.socket = _Rec()
+        s._send_frame(b"payload-bytes", None)                  # data
+        s._send_frame(b"", None, credits=16, data=False)       # grant
+        s._send_frame(b"", None, close=True, data=False)       # close
+        blob = b"".join(s.socket.wires)
+        consumed, frames = fc.scan_frames(blob, MAGIC, SMALL_FRAME_MAX, 16)
+        assert consumed == len(blob)
+        assert [f[0] for f in frames] == [2, 2, 2]
+        k, sid, seq, credits, sclose, po, pl, ao, al = frames[0]
+        assert (sid, seq, credits, sclose) == (99, 1, 0, 0)
+        assert blob[po:po + pl] == b"payload-bytes"
+        assert frames[1][1:5] == (99, 0, 16, 0)
+        assert frames[2][1:5] == (99, 0, 0, 1)
+        s.close()
+
+    def test_establishment_frames_stay_classic(self):
+        # request + stream_settings (the Open RPC) must DEFER — the
+        # scanner serves live frames only, never establishment
+        import struct
+
+        from brpc_tpu.native import fastcore
+        from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
+        from brpc_tpu.protocol.tpu_std import MAGIC, SMALL_FRAME_MAX
+        fc = fastcore.get()
+        if fc is None:
+            import pytest
+            pytest.skip("fastcore unavailable")
+        m = pb.RpcMeta()
+        m.request.service_name = "S"
+        m.request.method_name = "Open"
+        m.correlation_id = 5
+        m.stream_settings.stream_id = 7
+        mb = m.SerializeToString()
+        wire = struct.pack(">4sII", MAGIC, len(mb), len(mb)) + mb
+        consumed, frames = fc.scan_frames(wire, MAGIC, SMALL_FRAME_MAX, 16)
+        assert consumed == 0 and frames == []
