@@ -1,9 +1,10 @@
 """Mixed-traffic soak: the long-lived-server hygiene check.
 
-Three concurrent client loops against a standalone echo server —
+Four concurrent client loops against a standalone echo server —
 sequential small sync RPCs (native serve lane), pipelined 1MB
-attachment echoes (cut-through lane), and connection churn (a fresh
-channel per call) — while sampling server/client RSS, fd counts and
+attachment echoes (cut-through lane), connection churn (a fresh
+channel per call), and StreamingRPC open/push-8MB/close cycles (the
+native stream-frame lane + stream lifecycle) — while sampling server/client RSS, fd counts and
 live-fiber counts. A leak in any lane shows as monotonic growth;
 pass/fail is printed as one JSON line.
 
@@ -56,7 +57,7 @@ def main() -> int:
     from brpc_tpu.rpc import Channel, ChannelOptions, Controller
 
     stop = [False]
-    counts = [0, 0, 0]
+    counts = [0, 0, 0, 0]
     errors: list = []
 
     def small_loop():
@@ -95,8 +96,45 @@ def main() -> int:
             counts[2] += 1
             time.sleep(0.01)
 
+    def stream_loop():
+        # StreamingRPC lifecycle + the native stream-frame lane: open a
+        # stream, push 8MB of 64KB frames (small enough to ride the
+        # kind-2 scanner records), await the sink's ack, close — a leak
+        # in stream-pool entries, credits or ExecutionQueues shows as
+        # fiber/RSS growth
+        from brpc_tpu import fiber
+        from brpc_tpu.rpc.stream import StreamOptions
+        frame = b"\x33" * (64 << 10)
+        n = 128
+        while not stop[0]:
+            done = threading.Event()
+            ch = Channel(f"tcp://127.0.0.1:{port}",
+                         ChannelOptions(timeout_ms=15000))
+            cntl = ch.call_sync(
+                "Bench", "StreamSink", str(len(frame) * n).encode(),
+                stream_options=StreamOptions(
+                    on_received=lambda s, m: done.set()))
+            stream = cntl.stream
+            if cntl.failed() or stream is None:
+                errors.append(f"stream open: {cntl.error_text}")
+                ch.close()
+                continue
+
+            async def producer():
+                for _ in range(n):
+                    if not await stream.write(frame):
+                        break
+
+            f = fiber.spawn(producer)
+            f.join(20)
+            if not done.wait(10):
+                errors.append("stream sink never acked")
+            stream.close()
+            ch.close()
+            counts[3] += 1
+
     ths = [threading.Thread(target=f, daemon=True)
-           for f in (small_loop, big_loop, churn_loop)]
+           for f in (small_loop, big_loop, churn_loop, stream_loop)]
     for t in ths:
         t.start()
     samples = []
@@ -127,8 +165,8 @@ def main() -> int:
     print(json.dumps({
         "ok": ok,
         "calls": {"small_sync": counts[0], "big_1mb": counts[1],
-                  "conn_churn": counts[2]},
-        "moved_GB": round(counts[1] * 2 / 1024, 1),
+                  "conn_churn": counts[2], "stream_8mb": counts[3]},
+        "moved_GB": round(counts[1] * 2 / 1024 + counts[3] * 8 / 1024, 1),
         "errors": len(errors),
         "first_sample": first, "last_sample": last, "growth": growth,
     }))
