@@ -170,6 +170,19 @@ class Server:
                 expose_default_variables)
             add_builtin_services(self)
             expose_default_variables()   # process_* vars (idempotent)
+            # socket traffic + fast-lane counters follow the same
+            # lifecycle: their import-time expose is stripped forever
+            # by an unexpose_all() (test fixtures) — re-register here
+            # like the process_* vars, so /vars keeps them for any
+            # server started afterward in the process
+            from brpc_tpu.transport.socket import (npluck_defer,
+                                                   npluck_fast, nreads,
+                                                   nwrites)
+            for var, name in ((nwrites, "socket_writes"),
+                              (nreads, "socket_read_bytes"),
+                              (npluck_fast, "pluck_fast_responses"),
+                              (npluck_defer, "pluck_defers")):
+                var.expose(name)
             # best-effort: SIGUSR2 -> fiber stacks on stderr, so
             # tools/fiber_stacks.py <pid> works like the reference's
             # gdb_bthread_stack.py (no-op off the main thread)

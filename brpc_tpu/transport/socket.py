@@ -127,8 +127,12 @@ def _fastcore():
         _fc_mod = _fastcore_loader.get()
     return _fc_mod
 
-nwrites = Adder()
-nreads = Adder()
+# socket-level traffic + fast-lane health, visible at /vars (the
+# reference self-instruments every subsystem the same way)
+nwrites = Adder().expose("socket_writes")
+nreads = Adder().expose("socket_read_bytes")
+npluck_fast = Adder().expose("pluck_fast_responses")   # native-loop wins
+npluck_defer = Adder().expose("pluck_defers")          # classic fallbacks
 
 # Installed by the RPC layer (brpc_tpu.rpc.channel): callable
 # ``(socket, [controllers])`` that fails or re-issues the client calls
@@ -748,6 +752,7 @@ class Socket:
                         continue
                     carry = b""
                     if tag == 0:          # the response for cid
+                        npluck_fast.add(1)
                         _, ec, et, payload, att, leftover, _nr = r
                         if leftover:
                             self.input_portal.append_user_data(leftover)
@@ -762,6 +767,7 @@ class Socket:
                             break
                         continue
                     if tag == 1:          # defer: classic path judges
+                        npluck_defer.add(1)
                         if r[1]:
                             self.input_portal.append_user_data(r[1])
                         scan = None

@@ -588,6 +588,23 @@ class TestLanesEndToEnd:
         finally:
             server.stop()
 
+    def test_lane_counters_account_pluck_wins(self):
+        # the fast lanes self-instrument like every other subsystem:
+        # sequential sync echoes must land in pluck_fast_responses
+        from brpc_tpu.transport.socket import npluck_fast
+        server, ep = _echo_server()
+        try:
+            before = npluck_fast.get_value()
+            ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                         ChannelOptions(timeout_ms=5000))
+            for i in range(30):
+                cl = ch.call_sync("Bench", "Echo", b"n%d" % i)
+                assert not cl.failed()
+            assert npluck_fast.get_value() - before >= 25  # ~total wins
+            ch.close()
+        finally:
+            server.stop()
+
     def test_two_sync_threads_share_one_multiplexed_socket(self):
         # two threads call_sync on the SAME shared channel: one wins the
         # pre-send pluck claim, the other's response crosses the winner's
