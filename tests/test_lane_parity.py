@@ -1,0 +1,185 @@
+"""Full-stack server-lane parity: a NATIVE server (fd loops, serve
+lanes, cut-through) and a pure-Python FALLBACK server
+(BRPC_TPU_NO_NATIVE=1) must answer identical byte sequences with
+per-correlation-id byte-identical response frames — the strongest form
+of the judge-or-defer contract: the fast lanes may only change WHERE
+work happens, never what leaves the socket. (Response ORDER across
+independent pipelined requests may differ: the classic burst fan-out
+completes out of order, exactly like the reference's QueueMessage
+discipline.)"""
+
+import os
+import socket
+import struct
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
+from brpc_tpu.protocol.tpu_std import MAGIC, _py_pack_small_frame
+
+
+def _req(cid, payload=b"ping", service="Bench", method="Echo", att=b""):
+    m = pb.RpcMeta()
+    m.request.service_name = service
+    m.request.method_name = method
+    return _py_pack_small_frame(m.SerializeToString(), cid, payload, att)
+
+
+def _spawn(extra_env=None):
+    from spawn_util import spawn_port_server
+    base = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("BRPC_TPU_NO_NATIVE", None)
+    if extra_env:
+        env.update(extra_env)
+    return spawn_port_server(
+        [os.path.join(base, "tools", "bench_echo_server.py")],
+        wall_s=30.0, env=env)
+
+
+def _split_frames(buf):
+    out = []
+    off = 0
+    while off + 12 <= len(buf):
+        magic, body, meta = struct.unpack_from(">4sII", buf, off)
+        if magic != MAGIC or off + 12 + body > len(buf):
+            break
+        out.append(buf[off:off + 12 + body])
+        off += 12 + body
+    return out
+
+
+def _by_cid(frames):
+    """Map correlation id -> full response frame bytes. Response ORDER
+    across independent pipelined requests is legal to differ (the
+    classic burst fan-out completes out of order, exactly like the
+    reference's QueueMessage discipline) — the contract is per-cid
+    byte identity."""
+    out = {}
+    for fr in frames:
+        meta_len = struct.unpack_from(">I", fr, 8)[0]
+        m = pb.RpcMeta()
+        m.ParseFromString(fr[12:12 + meta_len])
+        out[m.correlation_id] = fr
+    return out
+
+
+def _drive(port, wire, expect_frames):
+    """Send `wire` raw, read back `expect_frames` complete frames;
+    returns the exact response byte stream."""
+    c = socket.socket()
+    c.connect(("127.0.0.1", port))
+    c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    c.settimeout(10.0)
+    c.sendall(wire)
+    got = b""
+    frames = 0
+    while frames < expect_frames:
+        chunk = c.recv(65536)
+        if not chunk:
+            break
+        got += chunk
+        # count complete frames in `got`
+        frames = 0
+        off = 0
+        while off + 12 <= len(got):
+            magic, body, meta = struct.unpack_from(">4sII", got, off)
+            if magic != MAGIC or off + 12 + body > len(got):
+                break
+            frames += 1
+            off += 12 + body
+    c.close()
+    return got
+
+
+SEQUENCES = [
+    # one plain echo
+    _req(1, b"hello"),
+    # pipelined burst, mixed payload sizes + attachment
+    _req(2, b"a") + _req(3, b"b" * 500, att=b"ATT") + _req(4, b""),
+    # unknown method then echo (error + success interleave)
+    _req(5, b"x", method="NoSuchMethod") + _req(6, b"y"),
+    # unknown service
+    _req(7, b"x", service="NoSuchService"),
+    # a large frame (> SMALL_FRAME_MAX): classic/cut-through territory
+    _req(8, b"L" * 50000),
+    # large then small pipelined behind it
+    _req(9, b"L" * 40000) + _req(10, b"tail"),
+]
+EXPECT = [1, 3, 2, 1, 1, 2]
+
+
+@pytest.mark.skipif(os.environ.get("BRPC_TPU_NO_NATIVE") == "1",
+                    reason="parity needs the native side")
+def test_native_and_fallback_servers_answer_bit_identically():
+    pn, native_port = _spawn()
+    pf, fallback_port = _spawn({"BRPC_TPU_NO_NATIVE": "1"})
+    assert native_port and fallback_port, "server spawn failed"
+    try:
+        for i, (wire, n) in enumerate(zip(SEQUENCES, EXPECT)):
+            a = _by_cid(_split_frames(_drive(native_port, wire, n)))
+            b = _by_cid(_split_frames(_drive(fallback_port, wire, n)))
+            assert a.keys() == b.keys(), (i, sorted(a), sorted(b))
+            for cid in a:
+                assert a[cid] == b[cid], (
+                    f"sequence {i} cid {cid}: responses diverge\n"
+                    f"native:   {a[cid][:120].hex()}\n"
+                    f"fallback: {b[cid][:120].hex()}")
+    finally:
+        pn.terminate()
+        pf.terminate()
+
+
+@pytest.mark.skipif(os.environ.get("BRPC_TPU_NO_NATIVE") == "1",
+                    reason="parity needs the native side")
+def test_parity_under_fragmented_delivery():
+    # the same bytes, dribbled in awkward fragments: partial headers,
+    # split metas, frame boundaries straddled — lane handoffs
+    # (serve_drain carry, portal re-inject) must not change the output
+    pn, native_port = _spawn()
+    pf, fallback_port = _spawn({"BRPC_TPU_NO_NATIVE": "1"})
+    assert native_port and fallback_port, "server spawn failed"
+
+    def dribble(port, wire, expect_frames, cuts):
+        c = socket.socket()
+        c.connect(("127.0.0.1", port))
+        c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        c.settimeout(10.0)
+        pos = 0
+        for cut in cuts:
+            c.sendall(wire[pos:cut])
+            pos = cut
+            time.sleep(0.005)
+        c.sendall(wire[pos:])
+        got = b""
+        frames = 0
+        while frames < expect_frames:
+            chunk = c.recv(65536)
+            if not chunk:
+                break
+            got += chunk
+            frames = 0
+            off = 0
+            while off + 12 <= len(got):
+                magic, body, meta = struct.unpack_from(">4sII", got, off)
+                if magic != MAGIC or off + 12 + body > len(got):
+                    break
+                frames += 1
+                off += 12 + body
+        c.close()
+        return got
+
+    try:
+        wire = _req(21, b"a" * 100) + _req(22, b"b" * 3000) + _req(23, b"c")
+        cuts = [3, 11, 13, 60, 150, len(wire) - 5]
+        a = _by_cid(_split_frames(dribble(native_port, wire, 3, cuts)))
+        b = _by_cid(_split_frames(dribble(fallback_port, wire, 3, cuts)))
+        assert a.keys() == b.keys() and all(a[c] == b[c] for c in a)
+    finally:
+        pn.terminate()
+        pf.terminate()
