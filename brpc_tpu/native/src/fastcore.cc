@@ -394,18 +394,32 @@ inline bool walk_meta(const unsigned char* p, const unsigned char* end,
 // frame's total size, or -1 (stop: incomplete / oversized / slow /
 // not this magic). Shared by scan_frames and serve_scan so their
 // eligibility ladders can never diverge.
+//
+// max_stream_body (0 = off): a relaxed bound for LIVE STREAM frames
+// only — a data frame's payload is opaque bytes heading for one
+// delivery callback, so size does not change its dispatch eligibility
+// the way it does for requests (whose oversized bodies belong to
+// cut-through/classic assembly). The frame must be COMPLETE in the
+// window; request/response frames over max_body still stop the scan.
 inline Py_ssize_t cut_fast_frame(const unsigned char* d, Py_ssize_t off,
                                  Py_ssize_t len, const void* magic,
-                                 Py_ssize_t max_body, MetaScan* m) {
+                                 Py_ssize_t max_body, MetaScan* m,
+                                 Py_ssize_t max_stream_body = 0) {
   if (off + 12 > len) return -1;
   const unsigned char* h = d + off;
   if (memcmp(h, magic, 4) != 0) return -1;
   uint32_t body = load_be32(h + 4);
   uint32_t meta_size = load_be32(h + 8);
-  if (meta_size > body || Py_ssize_t(body) > max_body) return -1;
+  if (meta_size > body) return -1;
+  const bool oversized = Py_ssize_t(body) > max_body;
+  if (oversized &&
+      (max_stream_body <= 0 || Py_ssize_t(body) > max_stream_body))
+    return -1;
   Py_ssize_t total = 12 + Py_ssize_t(body);
   if (off + total > len) return -1;
   if (!walk_meta(h + 12, h + 12 + meta_size, m)) return -1;
+  if (oversized && m->kind != 2)
+    return -1;  // big request/response: cut-through/classic territory
   if (m->att > body - meta_size) return -1;  // lying size: classic fails it
   m->meta_size = meta_size;
   m->body = body;
@@ -416,8 +430,9 @@ PyObject* fc_scan_frames(PyObject*, PyObject* args) {
   Py_buffer view, magic;
   Py_ssize_t max_body = 32768;
   Py_ssize_t max_frames = 128;
-  if (!PyArg_ParseTuple(args, "y*y*|nn", &view, &magic, &max_body,
-                        &max_frames))
+  Py_ssize_t max_stream_body = 0;
+  if (!PyArg_ParseTuple(args, "y*y*|nnn", &view, &magic, &max_body,
+                        &max_frames, &max_stream_body))
     return nullptr;
   const unsigned char* d = static_cast<const unsigned char*>(view.buf);
   Py_ssize_t off = 0;
@@ -434,7 +449,7 @@ PyObject* fc_scan_frames(PyObject*, PyObject* args) {
   while (PyList_GET_SIZE(frames) < max_frames) {
     MetaScan m;
     Py_ssize_t total = cut_fast_frame(d, off, view.len, magic.buf,
-                                      max_body, &m);
+                                      max_body, &m, max_stream_body);
     if (total < 0) break;
     Py_ssize_t p_off = off + 12 + m.meta_size;
     Py_ssize_t p_len = Py_ssize_t(m.body - m.meta_size - m.att);
@@ -1008,9 +1023,11 @@ PyMethodDef module_methods[] = {
     {"parse_head", fc_parse_head, METH_VARARGS,
      "parse_head(view, magic) -> None | -1 | (body, meta_size, meta|None)"},
     {"scan_frames", fc_scan_frames, METH_VARARGS,
-     "scan_frames(view, magic, max_body=32768, max_frames=128) -> "
-     "(consumed, frames): cut + meta-decode every complete small fast "
-     "frame in one native pass"},
+     "scan_frames(view, magic, max_body=32768, max_frames=128, "
+     "max_stream_body=0) -> (consumed, frames): cut + meta-decode "
+     "every complete small fast frame in one native pass; "
+     "max_stream_body>0 additionally admits complete LIVE STREAM data "
+     "frames up to that size"},
     {"serve_scan", fc_serve_scan, METH_VARARGS,
      "serve_scan(view, magic, service, method, max_body=32768) -> "
      "(consumed, out_bytes, n): echo-serve matching request frames "

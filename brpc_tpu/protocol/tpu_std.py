@@ -50,6 +50,14 @@ _TAG_ATTACHMENT_SIZE = 0x28  # field 5, wire type 0
 # stay zero-copy IOBuf chains — the fast path's attachment flatten +
 # one-allocation assembly would COPY them
 SMALL_FRAME_MAX = 32768
+# scan_frames additionally admits complete live-stream DATA frames up
+# to THIS size (its max_stream_body arg; both lanes pass it). 0 = off:
+# the record's payload slice is a memcpy, while the classic path moves
+# large payloads as zero-copy IOBuf refs that consumers which only
+# size/forward never flatten — measured at parity-to-slightly-worse on
+# 256KB frames here (box noise bounds the comparison). Scan admission
+# pays off for small frames, where the pb-parse saving dominates.
+STREAM_SCAN_MAX = 0
 
 
 def _varint(n: int) -> bytes:
@@ -390,7 +398,8 @@ class TpuStdProtocol(Protocol):
         win = portal.first_host_view()
         if win is None or len(win) < HEADER_SIZE:
             return None
-        consumed, frames = scan(win, MAGIC, SMALL_FRAME_MAX, 128)
+        consumed, frames = scan(win, MAGIC, SMALL_FRAME_MAX, 128,
+                                STREAM_SCAN_MAX)
         if not frames:
             return None
         recs = []

@@ -38,7 +38,8 @@ def make_client_fast_drain():
     scan = getattr(fc, "scan_frames", None) if fc is not None else None
     if scan is None:
         return None
-    from brpc_tpu.protocol.tpu_std import MAGIC, SMALL_FRAME_MAX
+    from brpc_tpu.protocol.tpu_std import (MAGIC, SMALL_FRAME_MAX,
+                                           STREAM_SCAN_MAX)
     from brpc_tpu.rpc.stream import process_stream_frame_fast
     from brpc_tpu.transport.socket import pull_chunks as _pull_chunks
 
@@ -48,7 +49,8 @@ def make_client_fast_drain():
         data, handled = _pull_chunks(sock)   # self-disables on fd conns
         if data is None:
             return handled
-        consumed, frames = scan(data, MAGIC, SMALL_FRAME_MAX, 128)
+        consumed, frames = scan(data, MAGIC, SMALL_FRAME_MAX, 128,
+                                STREAM_SCAN_MAX)
         if any(f[0] == 0 for f in frames):
             # a request-shaped frame on a client socket: hand the WHOLE
             # run to the classic machinery in parse order (scan records

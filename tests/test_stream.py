@@ -403,3 +403,50 @@ class TestNativeStreamLane:
         wire = struct.pack(">4sII", MAGIC, len(mb), len(mb)) + mb
         consumed, frames = fc.scan_frames(wire, MAGIC, SMALL_FRAME_MAX, 16)
         assert consumed == 0 and frames == []
+
+    def test_scanner_stream_cap_admits_big_data_frames(self):
+        # the max_stream_body capability (default OFF in the lanes —
+        # large payload delivery is zero-copy on the classic path):
+        # complete big DATA frames become kind-2 records; big REQUEST
+        # frames never do
+        from brpc_tpu.native import fastcore
+        from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
+        from brpc_tpu.protocol.tpu_std import (MAGIC, SMALL_FRAME_MAX,
+                                               _py_pack_small_frame)
+        fc = fastcore.get()
+        if fc is None:
+            import pytest
+            pytest.skip("fastcore unavailable")
+
+        class _Rec:
+            def __init__(self):
+                self.wires = []
+
+            def write(self, w):
+                self.wires.append(w if isinstance(w, bytes) else w.to_bytes())
+
+        s = Stream()
+        s.peer_id = 5
+        s.socket = _Rec()
+        big = b"\x44" * (SMALL_FRAME_MAX * 3)
+        s._send_frame(big, None)
+        wire = s.socket.wires[-1]
+        # without the cap: the scan stops (classic path territory)
+        consumed, frames = fc.scan_frames(wire, MAGIC, SMALL_FRAME_MAX, 16)
+        assert consumed == 0 and frames == []
+        # with the cap: one kind-2 record, payload offsets exact
+        consumed, frames = fc.scan_frames(wire, MAGIC, SMALL_FRAME_MAX, 16,
+                                          4 << 20)
+        assert consumed == len(wire) and len(frames) == 1
+        k, sid, seq, credits, sclose, po, pl, ao, al = frames[0]
+        assert (k, sid, seq) == (2, 5, 1)
+        assert wire[po:po + pl] == big
+        # a big REQUEST frame stays classic even with the cap
+        m = pb.RpcMeta()
+        m.request.service_name = "S"
+        m.request.method_name = "M"
+        req = _py_pack_small_frame(m.SerializeToString(), 9, big)
+        consumed, frames = fc.scan_frames(req, MAGIC, SMALL_FRAME_MAX, 16,
+                                          4 << 20)
+        assert consumed == 0 and frames == []
+        s.close()
