@@ -1,0 +1,76 @@
+"""graftlint CLI: ``python -m brpc_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean (or every finding waived with a reason), 1 active
+findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from brpc_tpu.analysis.core import Analyzer
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="framework-invariant static analysis for brpc_tpu")
+    p.add_argument("paths", nargs="*", default=["brpc_tpu"],
+                   help="files or directories to analyze "
+                        "(default: brpc_tpu)")
+    p.add_argument("--rules", metavar="R1,R2",
+                   help="run only these rules (comma-separated names)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="list available rules and exit")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings as one JSON object on stdout")
+    p.add_argument("--show-waived", action="store_true",
+                   help="also print waived findings (with reasons)")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    from brpc_tpu.analysis.rules import default_rules
+    rules = default_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name:18} {r.description}")
+        return 0
+    if args.rules:
+        wanted = {n.strip() for n in args.rules.split(",") if n.strip()}
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            print(f"graftlint: unknown rules: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.name in wanted]
+    analyzer = Analyzer(rules=rules)
+    active, waived = analyzer.run(args.paths or ["brpc_tpu"])
+    if args.as_json:
+        print(json.dumps({
+            "active": [f.to_dict() for f in active],
+            "waived": [f.to_dict() for f in waived],
+            "rules": [r.name for r in rules],
+        }, indent=None))
+        return 1 if active else 0
+    for f in active:
+        print(f.format())
+    if args.show_waived:
+        for f in waived:
+            print(f.format() + (f" [reason: {f.reason}]"
+                                if f.reason else ""))
+    n_w = len(waived)
+    if active:
+        print(f"graftlint: {len(active)} finding(s)"
+              f" ({n_w} waived)", file=sys.stderr)
+        return 1
+    print(f"graftlint: clean ({n_w} waived)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,19 @@
+"""graftlint rule registry."""
+
+from __future__ import annotations
+
+from typing import List
+
+from brpc_tpu.analysis.core import Rule
+
+
+def default_rules() -> List[Rule]:
+    from brpc_tpu.analysis.rules.fiber_blocking import FiberBlockingRule
+    from brpc_tpu.analysis.rules.iobuf_aliasing import IOBufAliasingRule
+    from brpc_tpu.analysis.rules.judge_defer import JudgeDeferRule
+    from brpc_tpu.analysis.rules.lock_order import LockOrderRule
+    from brpc_tpu.analysis.rules.registry_complete import (
+        RegistryCompleteRule,
+    )
+    return [FiberBlockingRule(), IOBufAliasingRule(), JudgeDeferRule(),
+            LockOrderRule(), RegistryCompleteRule()]

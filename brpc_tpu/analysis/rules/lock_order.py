@@ -1,0 +1,220 @@
+"""lock-order: the static lock graph over ``with <lock>:`` nesting
+must be acyclic.
+
+Two code paths that take the same pair of locks in opposite orders
+deadlock under concurrency; with fibers multiplexed onto carrier
+pthreads the window is wider than it looks (a parked fiber holds its
+Python locks across suspension). The rule builds a conservative
+static graph:
+
+  * a ``with A:`` containing a nested ``with B:`` adds edge A -> B
+    (also through ``with A, B:`` multi-item forms);
+  * a call made while holding A to a same-module function/method that
+    itself takes B adds A -> B (one-hop call closure, fixpointed);
+  * lock identity is the qualified attribute name — ``Class._x_lock``
+    for ``self._x_lock``, ``module:_lock`` for module globals — so
+    distinct instances of the same class attribute share a node
+    (conservative: instance-level cycles are reported even when
+    runtime instances differ; waive with a reason where that split is
+    load-bearing).
+
+Only names that look like locks (``*lock*``) participate; ``with``
+over files/portals/contexts stays out of the graph. Reported once per
+cycle, at the first edge's location.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from brpc_tpu.analysis.core import Context, Finding, Rule, SourceFile
+
+
+
+def _lock_name(node: ast.AST, module: str,
+               cls: Optional[str]) -> Optional[str]:
+    """Qualified lock node name, or None when the expr isn't lock-like."""
+    if isinstance(node, ast.Attribute) and "lock" in node.attr.lower():
+        base = node.value
+        if isinstance(base, ast.Name) and base.id == "self" and cls:
+            return f"{cls}.{node.attr}"
+        return f"{module}:{node.attr}"
+    if isinstance(node, ast.Name) and "lock" in node.id.lower():
+        return f"{module}:{node.id}"
+    return None
+
+
+class _FuncLocks(ast.NodeVisitor):
+    """Per-function: edges between nested with-locks, the set of locks
+    acquired anywhere, and (held-lock -> called function keys)."""
+
+    def __init__(self, module: str, cls: Optional[str], defs: Set[str]):
+        self.module = module
+        self.cls = cls
+        self.defs = defs
+        self.held: List[str] = []
+        self.edges: List[Tuple[str, str, int]] = []
+        self.acquired: Set[str] = set()
+        self.calls_under: List[Tuple[str, str, int]] = []  # (lock, key, ln)
+        self.calls: Set[str] = set()
+
+    def visit_With(self, node: ast.With) -> None:
+        entered = 0
+        for item in node.items:
+            name = _lock_name(item.context_expr, self.module, self.cls)
+            if name:
+                for h in self.held:
+                    self.edges.append((h, name, node.lineno))
+                self.held.append(name)
+                self.acquired.add(name)
+                entered += 1
+        self.generic_visit(node)
+        for _ in range(entered):
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node: ast.Call) -> None:
+        key = None
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in self.defs:
+            key = fn.id
+        elif (isinstance(fn, ast.Attribute)
+              and isinstance(fn.value, ast.Name)
+              and fn.value.id == "self" and self.cls
+              and f"{self.cls}.{fn.attr}" in self.defs):
+            key = f"{self.cls}.{fn.attr}"
+        if key:
+            self.calls.add(key)
+            for h in self.held:
+                self.calls_under.append((h, key, node.lineno))
+        self.generic_visit(node)
+
+    # nested defs get their own pass; don't double-count their bodies
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+
+class LockOrderRule(Rule):
+    name = "lock-order"
+    description = ("the static lock graph built from 'with lock:' "
+                   "nesting (plus same-module call closure) must have "
+                   "no cycles")
+
+    def __init__(self) -> None:
+        # edge -> first (path, line) witnessing it
+        self._edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def check(self, sf: SourceFile, ctx: Context) -> Iterable[Finding]:
+        if not sf.is_python or "/analysis/" in sf.relpath:
+            return ()
+        module = sf.relpath.rsplit("/", 1)[-1][:-3]
+        defs: Set[str] = set()
+        funcs: List[Tuple[str, Optional[str], ast.AST]] = []
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.add(node.name)
+                funcs.append((node.name, None, node))
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        defs.add(f"{node.name}.{item.name}")
+                        funcs.append((item.name, node.name, item))
+        summaries: Dict[str, _FuncLocks] = {}
+        for name, cls, node in funcs:
+            v = _FuncLocks(module, cls, defs)
+            for child in node.body:
+                v.visit(child)
+            key = f"{cls}.{name}" if cls else name
+            summaries[key] = v
+        # locks-acquired closure over same-module calls
+        reach: Dict[str, Set[str]] = {
+            k: set(v.acquired) for k, v in summaries.items()}
+        changed = True
+        while changed:
+            changed = False
+            for k, v in summaries.items():
+                for callee in v.calls:
+                    extra = reach.get(callee, set()) - reach[k]
+                    if extra:
+                        reach[k].update(extra)
+                        changed = True
+        for key, v in summaries.items():
+            for a, b, line in v.edges:
+                self._edges.setdefault((a, b), (sf.relpath, line))
+            for held, callee, line in v.calls_under:
+                for b in reach.get(callee, ()):
+                    if b != held:
+                        self._edges.setdefault((held, b),
+                                               (sf.relpath, line))
+        return ()
+
+    def finalize(self, ctx: Context) -> Iterable[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in self._edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        findings: List[Finding] = []
+        for cycle in self._cycles(graph):
+            members = set(cycle)
+            first = min((loc for (a, b), loc in self._edges.items()
+                         if a in members and b in members),
+                        default=None)
+            if first is None:
+                continue
+            path, line = first
+            order = " -> ".join(cycle + (cycle[0],))
+            findings.append(Finding(
+                self.name, path, line,
+                f"potential lock-order cycle: {order} — two paths can "
+                "acquire these locks in opposite orders and deadlock"))
+        self._edges.clear()
+        return findings
+
+    @staticmethod
+    def _cycles(graph: Dict[str, Set[str]]) -> List[Tuple[str, ...]]:
+        """Elementary cycles via Tarjan SCCs (every SCC with an edge
+        inside it is reported as one canonical cycle)."""
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        counter = [0]
+        sccs: List[List[str]] = []
+
+        def strongconnect(v: str) -> None:
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            for w in sorted(graph.get(v, ())):
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                sccs.append(scc)
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+        out: List[Tuple[str, ...]] = []
+        for scc in sccs:
+            if len(scc) > 1:
+                out.append(tuple(sorted(scc)))
+            elif scc and scc[0] in graph.get(scc[0], ()):
+                out.append((scc[0],))
+        return out

@@ -36,6 +36,9 @@ from typing import Optional
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+# BRPC_TPU_SANITIZE value the cache was latched under: a change after
+# latching must raise, not silently serve the mismatched artifact
+_latched_san: Optional[str] = None
 
 c_u8p = ctypes.POINTER(ctypes.c_uint8)
 c_u32 = ctypes.c_uint32
@@ -140,23 +143,45 @@ def _declare(lib: ctypes.CDLL) -> None:
 def lib() -> Optional[ctypes.CDLL]:
     """The native library, building it on first call. None if unavailable
     (no compiler / build failure) — callers fall back to pure Python."""
-    global _lib, _tried
+    global _lib, _tried, _latched_san
     if _lib is not None or _tried:
+        if os.environ.get("BRPC_TPU_SANITIZE", "") != _latched_san:
+            from brpc_tpu.native.build import sanitize_changed_error
+            raise sanitize_changed_error(_latched_san)
         return _lib
     with _lock:
         if _lib is not None or _tried:
             return _lib
-        _tried = True
+        # validate BRPC_TPU_SANITIZE before latching _tried, before the
+        # broad except, and before the BRPC_TPU_NO_NATIVE short-circuit:
+        # a typo must raise — on EVERY call, not just the first — never
+        # silently run the uninstrumented pure-Python fallback while
+        # claiming sanitizer coverage
+        from brpc_tpu.native.build import (build, check_no_native_conflict,
+                                           sanitize_mode,
+                                           sanitized_load_failure)
+        san = sanitize_mode()
         if os.environ.get("BRPC_TPU_NO_NATIVE"):
+            check_no_native_conflict(san)
+            _latched_san = ""
+            _tried = True
             return None
         try:
-            from brpc_tpu.native.build import build
             path = build()
             L = ctypes.CDLL(path)
             _declare(L)
             _lib = L
-        except Exception:
+        except Exception as e:
             _lib = None
+            if san:
+                # a VALID sanitize mode whose artifact fails to
+                # build/load must be just as loud as a typo, and must
+                # not latch _tried: proceeding on pure Python would
+                # pass the run off as sanitized with zero coverage
+                raise sanitized_load_failure(
+                    san, "native library") from e
+        _latched_san = os.environ.get("BRPC_TPU_SANITIZE", "")
+        _tried = True
     return _lib
 
 

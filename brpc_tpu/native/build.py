@@ -3,6 +3,17 @@
 Invoked automatically on first import of brpc_tpu.native (and rebuilt when
 any source is newer than the library). Can also be run directly:
     python -m brpc_tpu.native.build
+
+Sanitizer lane: with BRPC_TPU_SANITIZE set (e.g. "address,undefined"),
+both artifacts build under the requested sanitizers into SEPARATE
+``.san.so`` files with their own staleness cache, so the fast lane's
+plain artifacts are never clobbered by an instrumented build (and vice
+versa). Loading an ASan-instrumented extension requires the sanitizer
+runtime to be FIRST in the link order, which a stock CPython is not —
+run the interpreter with the env from ``sanitizer_env()`` (LD_PRELOAD
+of libasan/libubsan + leak detection off for CPython's arena leaks).
+The tier-2 test lane (tests/test_sanitizer_lane.py) and the preflight
+gate (tools/preflight.py --gate) both drive this path.
 """
 
 from __future__ import annotations
@@ -10,6 +21,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+from typing import List, Optional, Sequence, Tuple
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 SRC_DIR = os.path.join(_DIR, "src")
@@ -19,11 +31,120 @@ CXX = os.environ.get("CXX", "g++")
 CXXFLAGS = ["-O2", "-g", "-std=c++17", "-fPIC", "-shared", "-pthread",
             "-Wall", "-Wextra", "-fno-exceptions"]
 
+# supported BRPC_TPU_SANITIZE tokens -> compiler flag groups
+_SANITIZERS = {
+    "address": ["-fsanitize=address"],
+    "undefined": ["-fsanitize=undefined"],
+    "thread": ["-fsanitize=thread"],
+}
+_SAN_COMMON = ["-fno-omit-frame-pointer", "-fno-sanitize-recover=all"]
+# sanitizer token -> runtime library the host interpreter must preload
+_SAN_RUNTIMES = {"address": "libasan.so", "undefined": "libubsan.so",
+                 "thread": "libtsan.so"}
+
 
 # fastcore.cc is a CPython extension module (needs Python headers,
 # exports PyInit__brpc_fastcore) — built separately from the C-ABI lib
 FASTCORE_SRCS = ("fastcore.cc", "respool.cc", "queues.cc", "httpparse.cc")
 FASTCORE_PATH = os.path.join(_DIR, "_brpc_fastcore.so")
+
+
+def sanitize_mode(env: Optional[str] = None) -> Tuple[str, ...]:
+    """Parse BRPC_TPU_SANITIZE (or the given string) into a normalized
+    sanitizer tuple; unknown tokens raise so a typo can't silently run
+    the uninstrumented lane while claiming sanitizer coverage."""
+    raw = os.environ.get("BRPC_TPU_SANITIZE", "") if env is None else env
+    out = []
+    for tok in raw.replace(";", ",").split(","):
+        tok = tok.strip().lower()
+        if not tok:
+            continue
+        if tok not in _SANITIZERS:
+            raise ValueError(
+                f"BRPC_TPU_SANITIZE: unknown sanitizer {tok!r} "
+                f"(known: {', '.join(sorted(_SANITIZERS))})")
+        if tok not in out:
+            out.append(tok)
+    return tuple(out)
+
+
+def check_no_native_conflict(san: Sequence[str]) -> None:
+    """Raise when BRPC_TPU_NO_NATIVE would silently drop an active
+    sanitize mode: disabling the native lane runs pure Python while
+    the env claims sanitizer coverage."""
+    if san:
+        raise RuntimeError(
+            "BRPC_TPU_SANITIZE=%s conflicts with BRPC_TPU_NO_NATIVE: "
+            "disabling the native lane would run pure Python while "
+            "the env claims sanitizer coverage" % ",".join(san))
+
+
+def sanitized_load_failure(san: Sequence[str],
+                           what: str) -> RuntimeError:
+    """The error for a sanitized artifact that failed to build or
+    load — raised instead of the silent uninstrumented fallback."""
+    return RuntimeError(
+        "BRPC_TPU_SANITIZE=%s is set but the sanitized %s failed to "
+        "build or load; refusing the uninstrumented pure-Python "
+        "fallback. Run the interpreter with the env from "
+        "brpc_tpu.native.build.sanitizer_env() (LD_PRELOAD of the "
+        "sanitizer runtimes)." % (",".join(san), what))
+
+
+def sanitize_changed_error(latched: Optional[str]) -> RuntimeError:
+    """The error for BRPC_TPU_SANITIZE changing AFTER a native loader
+    latched its cache: the cached artifact no longer matches the
+    requested instrumentation."""
+    cur = os.environ.get("BRPC_TPU_SANITIZE", "")
+    return RuntimeError(
+        "BRPC_TPU_SANITIZE changed to %r after the native loader "
+        "latched under %r: the cached artifact no longer matches the "
+        "requested instrumentation — set the env before the first "
+        "native use, or restart the process" % (cur, latched or ""))
+
+
+def _san_path(base: str, san: Sequence[str]) -> str:
+    """Artifact path for a sanitizer combo: foo.so -> foo.san.so (one
+    cache per combo would be overkill; the .san artifact records its
+    combo in a sidecar tag so a different combo forces a rebuild)."""
+    if not san:
+        return base
+    root, ext = os.path.splitext(base)
+    return f"{root}.san{ext}"
+
+
+def _cxxflags(san: Sequence[str]) -> List[str]:
+    """Base flags + sanitizer instrumentation for a build."""
+    flags = list(CXXFLAGS)
+    for tok in san:
+        flags.extend(_SANITIZERS[tok])
+    if san:
+        flags.extend(_SAN_COMMON)
+    return flags
+
+
+def _tag_path(out_path: str) -> str:
+    return out_path + ".tag"
+
+
+def _stale(out_path: str, srcs, san: Sequence[str] = ()) -> bool:
+    if not os.path.exists(out_path):
+        return True
+    if san:
+        try:
+            with open(_tag_path(out_path)) as f:
+                if f.read().strip() != ",".join(san):
+                    return True
+        except OSError:
+            return True
+    mtime = os.path.getmtime(out_path)
+    return any(os.path.getmtime(s) > mtime for s in srcs)
+
+
+def _write_tag(out_path: str, san: Sequence[str]) -> None:
+    if san:
+        with open(_tag_path(out_path), "w") as f:
+            f.write(",".join(san))
 
 
 def sources() -> list:
@@ -35,45 +156,111 @@ def sources() -> list:
     )
 
 
-def _stale(out_path: str, srcs) -> bool:
-    if not os.path.exists(out_path):
-        return True
-    mtime = os.path.getmtime(out_path)
-    return any(os.path.getmtime(s) > mtime for s in srcs)
-
-
 def needs_build() -> bool:
-    return _stale(LIB_PATH, sources())
+    san = sanitize_mode()
+    return _stale(_san_path(LIB_PATH, san), sources(), san)
 
 
-def build(force: bool = False) -> str:
-    """Compile if stale; returns the library path. Raises on failure."""
-    if not force and not needs_build():
-        return LIB_PATH
-    cmd = [CXX, *CXXFLAGS, "-o", LIB_PATH, *sources()]
+def build(force: bool = False,
+          sanitize: Optional[Sequence[str]] = None) -> str:
+    """Compile if stale; returns the library path. Raises on failure.
+    ``sanitize`` defaults to the BRPC_TPU_SANITIZE env setting."""
+    san = sanitize_mode() if sanitize is None else tuple(sanitize)
+    out = _san_path(LIB_PATH, san)
+    srcs = sources()
+    if not force and not _stale(out, srcs, san):
+        return out
+    cmd = [CXX, *_cxxflags(san), "-o", out, *srcs]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(
             f"native build failed:\n$ {' '.join(cmd)}\n{proc.stderr}")
-    return LIB_PATH
+    _write_tag(out, san)
+    return out
 
 
-def build_fastcore(force: bool = False) -> str:
+def build_fastcore(force: bool = False,
+                   sanitize: Optional[Sequence[str]] = None) -> str:
     """Compile the _brpc_fastcore CPython extension if stale."""
     import sysconfig
+    san = sanitize_mode() if sanitize is None else tuple(sanitize)
+    out = _san_path(FASTCORE_PATH, san)
     srcs = [os.path.join(SRC_DIR, f) for f in FASTCORE_SRCS]
-    if not force and not _stale(FASTCORE_PATH, srcs):
-        return FASTCORE_PATH
+    if not force and not _stale(out, srcs, san):
+        return out
     include = sysconfig.get_paths()["include"]
-    cmd = [CXX, *CXXFLAGS, f"-I{include}", "-o", FASTCORE_PATH, *srcs]
+    cmd = [CXX, *_cxxflags(san), f"-I{include}", "-o", out, *srcs]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(
             f"fastcore build failed:\n$ {' '.join(cmd)}\n{proc.stderr}")
-    return FASTCORE_PATH
+    _write_tag(out, san)
+    return out
+
+
+def _runtime_lib(name: str) -> Optional[str]:
+    """Absolute path of a sanitizer runtime (libasan.so / libubsan.so)
+    via the compiler, or None when the toolchain lacks it."""
+    try:
+        proc = subprocess.run([CXX, f"-print-file-name={name}"],
+                              capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    path = proc.stdout.strip()
+    return path if path and os.path.isabs(path) and os.path.exists(path) \
+        else None
+
+
+def sanitizer_toolchain_missing(
+        san: Sequence[str] = ("address", "undefined")) -> List[str]:
+    """Names of the toolchain pieces missing for an instrumented build
+    (empty list = ready): the compiler plus each requested sanitizer's
+    runtime. The single probe authority for the preflight gate and the
+    tier-2 test lane."""
+    import shutil
+    missing = []
+    if shutil.which(CXX) is None:
+        missing.append(CXX)
+    for tok in san:
+        lib = _SAN_RUNTIMES.get(tok)
+        if lib and _runtime_lib(lib) is None:
+            missing.append(lib)
+    return missing
+
+
+def sanitizer_env(san: Optional[Sequence[str]] = None) -> dict:
+    """Environment overlay for RUNNING python against .san artifacts:
+    LD_PRELOAD of the sanitizer runtimes (they must initialize before
+    the interpreter) and options tuned for a CPython host process
+    (leak detection off — the interpreter's arenas never fully free;
+    abort on any real ASan/UBSan diagnosis so tests fail loudly).
+    Returns {} when no sanitizer is configured."""
+    san = sanitize_mode() if san is None else tuple(san)
+    if not san:
+        return {}
+    preload = []
+    for tok in san:
+        lib = _SAN_RUNTIMES.get(tok)
+        p = _runtime_lib(lib) if lib else None
+        if p:
+            preload.append(p)
+    env = {
+        "BRPC_TPU_SANITIZE": ",".join(san),
+        "ASAN_OPTIONS": "detect_leaks=0:abort_on_error=1:"
+                        "allocator_may_return_null=1",
+        "UBSAN_OPTIONS": "halt_on_error=1:abort_on_error=1:"
+                         "print_stacktrace=1",
+    }
+    if preload:
+        prior = os.environ.get("LD_PRELOAD", "")
+        env["LD_PRELOAD"] = " ".join(preload + ([prior] if prior else []))
+    return env
 
 
 if __name__ == "__main__":
-    path = build(force="--force" in sys.argv)
+    force = "--force" in sys.argv
+    path = build(force=force)
     print(path)
-    print(build_fastcore(force="--force" in sys.argv))
+    print(build_fastcore(force=force))
+    if sanitize_mode():
+        print("sanitizers:", ",".join(sanitize_mode()))

@@ -16,28 +16,54 @@ import threading
 _lock = threading.Lock()
 _mod = None
 _tried = False
+# BRPC_TPU_SANITIZE value the cache was latched under: a change after
+# latching must raise, not silently serve the mismatched artifact
+_latched_san = None
 
 
 def get():
-    global _mod, _tried
+    global _mod, _tried, _latched_san
     if _mod is not None or _tried:
+        if os.environ.get("BRPC_TPU_SANITIZE", "") != _latched_san:
+            from brpc_tpu.native.build import sanitize_changed_error
+            raise sanitize_changed_error(_latched_san)
         return _mod
     with _lock:
         if _mod is not None or _tried:
             return _mod
-        _tried = True
+        # validate BRPC_TPU_SANITIZE before latching _tried, before the
+        # broad except, and before the BRPC_TPU_NO_NATIVE short-circuit:
+        # a typo must raise — on EVERY call, not just the first — never
+        # silently drop both native and sanitizer coverage via the
+        # pure-Python fallback
+        from brpc_tpu.native.build import (build_fastcore,
+                                           check_no_native_conflict,
+                                           sanitize_mode,
+                                           sanitized_load_failure)
+        san = sanitize_mode()
         if os.environ.get("BRPC_TPU_NO_NATIVE"):
+            check_no_native_conflict(san)
+            _latched_san = ""
+            _tried = True
             return None
         try:
-            from brpc_tpu.native.build import build_fastcore
             path = build_fastcore()
             spec = importlib.util.spec_from_file_location(
                 "_brpc_fastcore", path)
             mod = importlib.util.module_from_spec(spec)
             spec.loader.exec_module(mod)
             _mod = mod
-        except Exception:
+        except Exception as e:
             _mod = None
+            if san:
+                # a VALID sanitize mode whose artifact fails to
+                # build/load must be just as loud as a typo, and must
+                # not latch _tried: proceeding on pure Python would
+                # pass the run off as sanitized with zero coverage
+                raise sanitized_load_failure(
+                    san, "fastcore extension") from e
+        _latched_san = os.environ.get("BRPC_TPU_SANITIZE", "")
+        _tried = True
     return _mod
 
 

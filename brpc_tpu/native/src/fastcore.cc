@@ -259,6 +259,9 @@ inline bool walk_request_meta(const unsigned char* p,
       case (3u << 3) | 0:  // log_id
         if (!read_varint(p, end, &m->log_id)) return false;
         break;
+      // graftlint: disable=judge-defer -- timeout_ms is advisory: server
+      // dispatch never reads it on the classic lane either, so dropping
+      // it here cannot diverge observable semantics
       case (4u << 3) | 0: {  // timeout_ms (server side ignores)
         uint64_t ignored;
         if (!read_varint(p, end, &ignored)) return false;
@@ -297,8 +300,11 @@ inline bool walk_response_meta(const unsigned char* p,
 }
 
 // StreamSettings submessage (tpu_rpc_meta.proto): stream_id=1,
-// need_feedback=2 (read, unused by the dispatch path), frame_seq=3,
-// credits=4, close=5 — the whole vocabulary of a live stream frame
+// need_feedback=2 (defers — the scan record does not carry it, so the
+// classic lane must render any frame where it is set), frame_seq=3,
+// credits=4 (int32 on the wire: out-of-range varints defer so the
+// classic parser's int32 semantics stay the single verdict), close=5
+// — the whole vocabulary of a live stream frame
 inline bool walk_stream_meta(const unsigned char* p,
                              const unsigned char* end, MetaScan* m) {
   while (p < end) {
@@ -308,14 +314,21 @@ inline bool walk_stream_meta(const unsigned char* p,
       case (1u << 3) | 0:
         if (!read_varint(p, end, &m->stream_id)) return false;
         break;
-      case (2u << 3) | 0:  // need_feedback
+      case (2u << 3) | 0:  // need_feedback: not in the scan record —
+        // a fast-lane frame materializing meta would show False where
+        // the classic lane shows True. Defer set bits (judge-or-defer)
         if (!read_varint(p, end, &v)) return false;
+        if (v != 0) return false;
         break;
       case (3u << 3) | 0:
         if (!read_varint(p, end, &m->frame_seq)) return false;
         break;
-      case (4u << 3) | 0:
+      case (4u << 3) | 0:  // credits: declared int32 — a negative
+        // (10-byte varint) or > INT32_MAX value must not ride the fast
+        // lane as a huge credit grant while the classic lane sees a
+        // negative int32; defer and let the classic parser judge
         if (!read_varint(p, end, &m->s_credits)) return false;
+        if (m->s_credits > 0x7FFFFFFFull) return false;
         break;
       case (5u << 3) | 0:
         if (!read_varint(p, end, &v)) return false;
@@ -360,7 +373,13 @@ inline bool walk_meta(const unsigned char* p, const unsigned char* end,
         if (!read_varint(p, end, &m->cid)) return false;
         break;
       case (5u << 3) | 0:
+        // attachment_size is int32: values past INT32_MAX (including
+        // negatives, which arrive as 10-byte varints) fail the classic
+        // parse — defer so it renders that verdict (the downstream
+        // att > body bound would also catch these, but the invariant
+        // belongs where the field is admitted)
         if (!read_varint(p, end, &m->att)) return false;
+        if (m->att > 0x7FFFFFFFull) return false;
         break;
       case (6u << 3) | 2:  // stream_settings: a live stream frame —
         // but establishment (request + stream_settings) and anything

@@ -74,9 +74,9 @@ def _varint(n: int) -> bytes:
 def _py_pack_small_frame(meta_prefix: bytes, cid: int, payload: bytes,
                          attachment: bytes = b"",
                          magic: bytes = MAGIC) -> bytes:
-    meta = meta_prefix + _TAG_CORRELATION_ID.to_bytes() + _varint(cid)
+    meta = meta_prefix + _TAG_CORRELATION_ID.to_bytes(1, "big") + _varint(cid)
     if attachment:
-        meta += _TAG_ATTACHMENT_SIZE.to_bytes() + _varint(len(attachment))
+        meta += _TAG_ATTACHMENT_SIZE.to_bytes(1, "big") + _varint(len(attachment))
     meta_size = len(meta)
     body = meta_size + len(payload) + len(attachment)
     return b"".join((_HDR.pack(magic, body, meta_size), meta, payload,
@@ -533,10 +533,10 @@ class TpuStdProtocol(Protocol):
         if att < 0 or att > pa_len:
             return False         # lying size: classic path fails it
         # response header+meta: fully determined by the request meta
-        resp_meta = (_TAG_CORRELATION_ID.to_bytes()
+        resp_meta = (_TAG_CORRELATION_ID.to_bytes(1, "big")
                      + _varint(meta.correlation_id))
         if att:
-            resp_meta += _TAG_ATTACHMENT_SIZE.to_bytes() + _varint(att)
+            resp_meta += _TAG_ATTACHMENT_SIZE.to_bytes(1, "big") + _varint(att)
         portal.pop_front(HEADER_SIZE + meta_size)
         state = {"remaining": pa_len, "key": tgt[2],
                  "t0": time.monotonic_ns(), "server": server}

@@ -28,8 +28,8 @@ from brpc_tpu.protocol.tpu_std import (_HDR as _TPU_HDR, MAGIC as _TPU_MAGIC,
                                        pack_message, pack_small_frame,
                                        serialize_payload)
 
-_TAG_CORRELATION_ID_B = _TAG_CORRELATION_ID.to_bytes()
-_TAG_ATTACHMENT_SIZE_B = _TAG_ATTACHMENT_SIZE.to_bytes()
+_TAG_CORRELATION_ID_B = _TAG_CORRELATION_ID.to_bytes(1, "big")
+_TAG_ATTACHMENT_SIZE_B = _TAG_ATTACHMENT_SIZE.to_bytes(1, "big")
 from brpc_tpu.rpc import errno_codes as berr
 from brpc_tpu.rpc.controller import Controller, address_call, take_call
 from brpc_tpu.transport import socket as _socket_mod

@@ -346,7 +346,13 @@ class FastStreamMsg:
     PayloadBytes) — no RpcMeta object, no IOBuf. ``meta`` materializes
     a pb view lazily for the rare consumer that wants it, carrying
     EVERY StreamSettings field the frame had (the classic lane's
-    msg.meta does — the lanes must not observably diverge)."""
+    msg.meta does — the lanes must not observably diverge). The
+    scanner upholds that contract by DEFERRING any frame whose
+    StreamSettings carries a field outside this record's vocabulary
+    (need_feedback=true, credits past INT32_MAX): such frames reach
+    the classic lane only, so a materialized meta here is always
+    faithful (fastcore.cc walk_stream_meta; pinned by
+    test_stream.py::TestScannerLaneParity)."""
 
     __slots__ = ("payload", "attachment", "device_arrays", "_ss")
 

@@ -676,6 +676,10 @@ class Socket:
         if leftover and not self.failed:
             self._process_input_entry()
 
+    # graftlint: disable=judge-defer -- the defer exit here is
+    # re-injection, not a return: frames the native loop can't judge are
+    # appended back into input_portal and settled through the classic
+    # machinery before pluck_until returns pred()
     def pluck_until(self, pred, deadline_s: float, fast=None,
                     preclaimed: bool = False) -> bool:
         """Sync-pluck lane: a joining (non-worker) thread adopts this

@@ -29,6 +29,15 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: tier-2 tests excluded from the tier-1 gate "
+        "(-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "sanitize: rebuilds the native lane under "
+        "ASan/UBSan and re-runs the differential fuzzers against it")
+
+
 def _live_children():
     """(pid, cmdline) of our direct live children, zombies excluded
     (a reaped-later zombie is not a leak)."""

@@ -1,0 +1,5 @@
+# Seeded-violation fixture modules for tests/test_graftlint.py.
+# Each bad_*.py carries EXACTLY the violations its test asserts; the
+# clean fixture carries near-misses that must stay silent. These files
+# are linted, never imported or executed (no test_ prefix, so pytest
+# never collects them), and the preflight gate lints brpc_tpu/ only.

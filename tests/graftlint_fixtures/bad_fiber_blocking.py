@@ -1,0 +1,16 @@
+"""Seeded fiber-blocking violations: a carrier-pthread-blocking call
+inside an async def (a fiber context), both directly and through a
+same-module helper (context propagation). The helper is deliberately
+defined BELOW its caller: forward call edges must resolve too."""
+
+import time
+
+
+async def fiber_entry(conn):
+    time.sleep(0.1)          # VIOLATION: direct block in a fiber
+    _helper_that_blocks()    # VIOLATION: block via same-module closure
+    await conn.flush()
+
+
+def _helper_that_blocks():
+    time.sleep(0.5)          # blocking, reached FROM a fiber context

@@ -1,0 +1,16 @@
+"""Seeded iobuf-aliasing violations: a buffer is mutated after being
+handed to the socket write path (the writer fiber aliases its blocks
+zero-copy from the handoff on) — straight-line, and carried across a
+loop iteration (the append at the top of iteration N+1 races the
+write enqueued in iteration N)."""
+
+
+def respond(sock, buf, trailer):
+    sock.write(buf)
+    buf.append(trailer)      # VIOLATION: mutates the handed-off buffer
+
+
+def pump(sock, buf, chunks):
+    for chunk in chunks:
+        buf.append(chunk)    # VIOLATION: iteration N's write still
+        sock.write(buf)      # aliases the blocks this append mutates

@@ -1,0 +1,19 @@
+"""Seeded judge-defer violation (Python side): a fast-lane function —
+it consumes the native scanner — with no defer exit back to the
+classic lane."""
+
+
+def turbo_dispatch(fc, view, out):
+    consumed, frames = fc.scan_frames(view)
+    for f in frames:
+        out.append(f)
+    return consumed          # VIOLATION: no return None/False defer exit
+
+
+def turbo_nested_decoy(fc, view):
+    def on_frame(f):
+        return None          # a NESTED def's defer exit must not count
+    consumed, frames = fc.scan_frames(view)
+    for f in frames:
+        on_frame(f)
+    return consumed          # VIOLATION: the fast lane itself never defers

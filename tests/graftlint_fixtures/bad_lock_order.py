@@ -1,0 +1,19 @@
+"""Seeded lock-order violation: two paths acquire the same module
+locks in opposite orders — the classic AB/BA deadlock."""
+
+import threading
+
+_io_lock = threading.Lock()
+_state_lock = threading.Lock()
+
+
+def path_ab():
+    with _io_lock:
+        with _state_lock:    # edge io -> state
+            pass
+
+
+def path_ba():
+    with _state_lock:
+        with _io_lock:       # VIOLATION: edge state -> io closes a cycle
+            pass

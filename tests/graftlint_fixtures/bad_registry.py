@@ -1,0 +1,15 @@
+"""Seeded registry-complete violation: a protocol registered into the
+global table with a parse() but no dispatch surface, no client-side
+packing hook, and no failure-code vocabulary anywhere in its modules.
+(Deliberately nameless about failure codes: this file must not mention
+the vocabulary tokens the rule greps for.)"""
+
+
+class HalfProtocol:
+    name = "half"
+
+    def parse(self, portal, sock, read_eof):
+        return None
+
+
+register_protocol(HalfProtocol())   # noqa: F821 — lint fixture, never run

@@ -1,0 +1,25 @@
+"""Waiver-machinery fixture: one violation properly waived with a
+reason (must come back as waived, not active) and one waived WITHOUT a
+reason (the bare waiver itself must be reported as waiver-reason)."""
+
+import time
+
+
+async def waived_with_reason():
+    # graftlint: disable=fiber-blocking -- fixture: proves reasoned waivers suppress
+    time.sleep(0.1)
+
+
+async def waived_without_reason():
+    time.sleep(0.2)   # graftlint: disable=fiber-blocking
+
+
+async def waived_with_wrapped_reason():
+    # graftlint: disable=fiber-blocking -- fixture: a reason that wraps
+    # onto the next comment line must be recorded whole
+    time.sleep(0.3)
+
+
+async def adjacent_line_stays_active():
+    time.sleep(0.4)   # graftlint: disable=fiber-blocking -- fixture: this line only
+    time.sleep(0.5)   # VIOLATION: the waiver above must NOT leak here

@@ -1,0 +1,51 @@
+// Seeded judge-defer violations (C++ side), shaped like fastcore.cc's
+// meta walkers. Never compiled — linted only.
+//
+//   * walk_stream_meta admits the int32 `credits` field into a 64-bit
+//     slot with no INT32_MAX bound (ADVICE finding 1's shape);
+//   * it also reads `need_feedback` into a scratch local and drops it
+//     (ADVICE finding 2's shape);
+//   * walk_meta bounds attachment_size correctly — must stay silent.
+
+inline bool walk_stream_meta(const unsigned char* p,
+                             const unsigned char* end, MetaScan* m) {
+  while (p < end) {
+    uint64_t tag, v;
+    if (!read_varint(p, end, &tag)) return false;
+    switch (tag) {
+      case (2u << 3) | 0:  // need_feedback — v must gate or defer
+        if (!read_varint(p, end, &v)) return false;
+        break;             // VIOLATION: v read-and-dropped; the comment
+                           // naming v above must not count as a use
+      case (4u << 3) | 0:  // credits: int32, must be <= INT32_MAX
+        if (!read_varint(p, end, &m->s_credits)) return false;
+        break;             // VIOLATION: unbounded — the 0x7FFFFFFF /
+                           // INT32_MAX words in comments must not
+                           // satisfy the bound check
+      default:
+        return false;
+    }
+  }
+  // tail decoy: a REAL bound on an unrelated field after the switch —
+  // the last case's block must end at the default: label, so this
+  // 0x7FFFFFFF must not satisfy the credits case's bound check
+  if (m->s_window > 0x7FFFFFFFull) return false;
+  return true;
+}
+
+inline bool walk_meta(const unsigned char* p, const unsigned char* end,
+                      MetaScan* m) {
+  while (p < end) {
+    uint64_t tag;
+    if (!read_varint(p, end, &tag)) return false;
+    switch (tag) {
+      case (5u << 3) | 0:  // attachment_size: bounded — no finding
+        if (!read_varint(p, end, &m->att)) return false;
+        if (m->att > 0x7FFFFFFFull) return false;
+        break;
+      default:
+        return false;
+    }
+  }
+  return true;
+}

@@ -450,3 +450,105 @@ class TestNativeStreamLane:
                                           4 << 20)
         assert consumed == 0 and frames == []
         s.close()
+
+
+class TestScannerLaneParity:
+    """ADVICE.md round-5 findings pinned: StreamSettings fields outside
+    the scan record's vocabulary must DEFER to the classic lane, never
+    ride the fast lane with divergent semantics."""
+
+    @staticmethod
+    def _fc():
+        from brpc_tpu.native import fastcore
+        fc = fastcore.get()
+        if fc is None:
+            import pytest
+            pytest.skip("fastcore unavailable")
+        return fc
+
+    @staticmethod
+    def _stream_frame(payload=b"data", **ss_fields):
+        import struct
+
+        from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
+        from brpc_tpu.protocol.tpu_std import MAGIC
+        m = pb.RpcMeta()
+        ss = m.stream_settings
+        for k, v in ss_fields.items():
+            setattr(ss, k, v)
+        mb = m.SerializeToString()
+        return struct.pack(">4sII", MAGIC, len(mb) + len(payload),
+                           len(mb)) + mb + payload
+
+    def test_oversized_credits_defer_to_classic_lane(self):
+        """credits is int32 on the wire: a varint past INT32_MAX (or a
+        negative int32's 10-byte encoding) must stop the scan — the
+        classic protobuf parser renders the verdict, and the writer's
+        credit counter can never be inflated by a peer-controlled
+        out-of-range grant (ADVICE.md finding 1)."""
+        from brpc_tpu.protocol.tpu_std import MAGIC, SMALL_FRAME_MAX
+        fc = self._fc()
+        # INT32_MAX itself still rides the fast lane (in-range)
+        ok = self._stream_frame(stream_id=3, frame_seq=1,
+                                credits=2 ** 31 - 1)
+        consumed, frames = fc.scan_frames(ok, MAGIC, SMALL_FRAME_MAX, 16)
+        assert consumed == len(ok) and len(frames) == 1
+        assert frames[0][:5] == (2, 3, 1, 2 ** 31 - 1, 0)
+        # negative int32 (wire: 10-byte varint) defers
+        neg = self._stream_frame(stream_id=3, frame_seq=1, credits=-1)
+        consumed, frames = fc.scan_frames(neg, MAGIC, SMALL_FRAME_MAX, 16)
+        assert consumed == 0 and frames == []
+        # hand-encoded varint just past INT32_MAX defers (protobuf's
+        # serializer can't produce it from the int32 field, but a raw
+        # peer can)
+        import struct
+
+        from brpc_tpu.protocol.tpu_std import _varint
+        inner = b"\x08\x03" + b"\x18\x01" + b"\x20" + _varint(2 ** 31)
+        mb = b"\x32" + _varint(len(inner)) + inner
+        raw = struct.pack(">4sII", MAGIC, len(mb) + 4, len(mb)) + mb + b"data"
+        consumed, frames = fc.scan_frames(raw, MAGIC, SMALL_FRAME_MAX, 16)
+        assert consumed == 0 and frames == []
+
+    def test_need_feedback_frames_defer_to_classic_lane(self):
+        """The scan record carries (stream_id, frame_seq, credits,
+        close) only: a frame with need_feedback=true must defer so the
+        lazily materialized FastStreamMsg.meta can never show False
+        where the classic lane's meta shows True (ADVICE.md finding 2)."""
+        from brpc_tpu.protocol.tpu_std import MAGIC, SMALL_FRAME_MAX
+        fc = self._fc()
+        wire = self._stream_frame(stream_id=3, frame_seq=2,
+                                  need_feedback=True)
+        consumed, frames = fc.scan_frames(wire, MAGIC, SMALL_FRAME_MAX, 16)
+        assert consumed == 0 and frames == []
+        # the same frame without the bit rides the fast lane
+        wire = self._stream_frame(stream_id=3, frame_seq=2)
+        consumed, frames = fc.scan_frames(wire, MAGIC, SMALL_FRAME_MAX, 16)
+        assert consumed == len(wire) and len(frames) == 1
+
+    def test_fast_msg_meta_matches_classic_lane_meta(self):
+        """For every frame the scanner ADMITS, FastStreamMsg.meta must
+        be field-for-field identical to the classic lane's parsed meta
+        — the 'EVERY StreamSettings field' contract, now enforceable
+        because unrepresentable frames defer (see the two tests above)."""
+        from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
+        from brpc_tpu.protocol.tpu_std import MAGIC, SMALL_FRAME_MAX
+        from brpc_tpu.rpc.stream import FastStreamMsg
+        fc = self._fc()
+        shapes = [dict(stream_id=9, frame_seq=1),
+                  dict(stream_id=9, frame_seq=4, credits=16),
+                  dict(stream_id=9, close=True),
+                  dict(stream_id=9, credits=2 ** 31 - 1)]
+        for ss_fields in shapes:
+            wire = self._stream_frame(**ss_fields)
+            consumed, frames = fc.scan_frames(wire, MAGIC,
+                                              SMALL_FRAME_MAX, 16)
+            assert consumed == len(wire) and len(frames) == 1, ss_fields
+            k, sid, seq, credits, sclose, po, pl, ao, al = frames[0]
+            assert k == 2
+            fast = FastStreamMsg(wire[po:po + pl], b"", sid, seq,
+                                 credits, sclose)
+            classic = pb.RpcMeta()
+            classic.ParseFromString(wire[12:12 + (len(wire) - 12 - pl)])
+            assert fast.meta == classic, ss_fields
+            assert fast.payload.to_bytes() == b"data"

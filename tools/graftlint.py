@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""graftlint launcher: `python tools/graftlint.py [paths...]`.
+
+Thin wrapper over `python -m brpc_tpu.analysis` for invocations from
+outside the package root (CI steps, editors). See docs/invariants.md
+for the rule catalogue and waiver syntax.
+"""
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from brpc_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -19,8 +19,10 @@ empty lists, never to a crash (the bench must run).
 
 from __future__ import annotations
 
+import json
 import os
 import signal
+import subprocess
 import sys
 import time
 from typing import List, Optional
@@ -174,3 +176,88 @@ def run_preflight() -> dict:
     except Exception as e:  # noqa: BLE001
         report["scan_error"] = f"{type(e).__name__}: {e}"[:200]
     return report
+
+
+# --------------------------------------------------------------- gates
+#
+# `python tools/preflight.py --gate` is the correctness gate every PR
+# runs for free: graftlint over the whole package (unwaived findings
+# fail) plus a sanitizer smoke-build of both native artifacts (the
+# cheap half of the tier-2 lane — the instrumented fuzz RUN lives in
+# tests/test_sanitizer_lane.py). docs/invariants.md documents both.
+
+GATE_SANITIZERS = ("address", "undefined")
+
+
+def gate_graftlint() -> dict:
+    """Run graftlint over brpc_tpu/; ok iff no unwaived finding."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "brpc_tpu.analysis", "brpc_tpu", "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    out: dict = {"ok": proc.returncode == 0}
+    try:
+        report = json.loads(proc.stdout)
+        out["active"] = len(report["active"])
+        out["waived"] = len(report["waived"])
+        if report["active"]:
+            out["findings"] = [
+                f"{f['path']}:{f['line']}: [{f['rule']}] {f['message']}"
+                for f in report["active"]]
+    except (ValueError, KeyError):
+        out["ok"] = False
+        out["error"] = (proc.stdout + proc.stderr)[-500:]
+    return out
+
+
+def gate_sanitizer_smoke() -> dict:
+    """Build both native artifacts under ASan/UBSan (separate .san.so
+    cache — the plain lane is untouched). A missing sanitizer
+    toolchain SKIPS (ok) with the reason named; a build failure under
+    instrumentation FAILS the gate."""
+    from brpc_tpu.native.build import (build, build_fastcore,
+                                       sanitizer_toolchain_missing)
+    missing = sanitizer_toolchain_missing(GATE_SANITIZERS)
+    if missing:
+        return {"ok": True, "skipped": f"toolchain lacks {missing}"}
+    try:
+        lib = build(sanitize=GATE_SANITIZERS)
+        fast = build_fastcore(sanitize=GATE_SANITIZERS)
+    except RuntimeError as e:
+        return {"ok": False, "error": str(e)[-800:]}
+    return {"ok": True, "artifacts": [os.path.basename(lib),
+                                      os.path.basename(fast)]}
+
+
+def run_gate() -> int:
+    report = {}
+    for name, fn in (("graftlint", gate_graftlint),
+                     ("sanitizer_smoke", gate_sanitizer_smoke)):
+        try:
+            report[name] = fn()
+        except Exception as e:  # noqa: BLE001 - a hung/crashed gate
+            # must still yield the structured report, not a traceback
+            report[name] = {"ok": False,
+                            "error": f"{type(e).__name__}: {e}"[:800]}
+    ok = all(g.get("ok") for g in report.values())
+    report["ok"] = ok
+    print(json.dumps(report, indent=2))
+    return 0 if ok else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        description="bench preflight (default) or the per-PR "
+                    "correctness gate (--gate)")
+    p.add_argument("--gate", action="store_true",
+                   help="run graftlint + sanitizer smoke-build; exit 1 "
+                        "on any unwaived finding or build failure")
+    args = p.parse_args(argv)
+    if args.gate:
+        return run_gate()
+    print(json.dumps(run_preflight(), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
