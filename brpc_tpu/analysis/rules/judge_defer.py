@@ -28,7 +28,14 @@ field case must be faithful:
   * a scratch-read field (read into a local, not carried in MetaScan)
     must still be USED after the read — to defer or to gate —
     otherwise the fast lane silently drops wire semantics the classic
-    lane preserves (ADVICE finding 2).
+    lane preserves (ADVICE finding 2);
+  * a DEADLINE field (RpcRequestMeta.timeout_ms) must be enforced or
+    deferred, never read-and-ignored: since deadline propagation
+    (ISSUE 2) the classic lane stamps arrival and sheds expired
+    requests, so a native lane that admits a timeout-bearing request
+    without a defer exit after the read serves traffic the classic
+    lane would shed — its case block needs a conditional
+    ``return false`` (the defer gate) downstream of the read.
 """
 
 from __future__ import annotations
@@ -55,6 +62,10 @@ WALKER_MESSAGES = {
 }
 
 _NARROW_TYPES = ("int32", "sint32", "sfixed32")
+# deadline-class fields: reading one obliges the lane to enforce or
+# defer (a conditional `return false` after the read) — see module doc
+_DEADLINE_FIELDS = {("RpcRequestMeta", "timeout_ms")}
+_DEFER_EXIT_RE = re.compile(r"return\s+false")
 _BOUND_RE = re.compile(r"INT32_MAX|0x7FFFFFFF|static_cast<int32_t>")
 _CASE_RE = re.compile(r"case\s*\((\d+)u?\s*<<\s*3\)\s*\|\s*0\s*:")
 # any switch label bounds a case block — including wiretype-2 cases and
@@ -239,6 +250,24 @@ class JudgeDeferRule(Rule):
             target = read.group(1)
             line = start_line + body.count("\n", 0, cm.start())
             after = block[read.end():]
+            # the truncation guard `if (!read_varint(...)) return false;`
+            # trails every read — its `return false` is not a defer
+            # decision about the VALUE, so it must not satisfy the
+            # deadline check below
+            after_guard = re.sub(r"^\s*\)\s*return\s+false\s*;", "",
+                                 after)
+            if (message, fname) in _DEADLINE_FIELDS \
+                    and not _DEFER_EXIT_RE.search(after_guard):
+                findings.append(Finding(
+                    self.name, sf.relpath, line,
+                    f"{walker}: {message}.{fname} is read without "
+                    "either enforcing or deferring — the classic lane "
+                    "stamps arrival and sheds expired requests "
+                    "(deadline propagation), so this lane needs a "
+                    "conditional `return false` after the read (defer "
+                    "the frame, or gate it on an enforce-by-"
+                    "construction posture like MetaScan.defer_timeout)"))
+                continue
             if target.startswith("m->"):
                 if ftype in _NARROW_TYPES and not _BOUND_RE.search(block):
                     findings.append(Finding(

@@ -11,6 +11,27 @@ from brpc_tpu.bvar.variable import dump_exposed
 from brpc_tpu.rpc.service import Service
 
 
+def connections_page(server) -> dict:
+    """Connection table + the robustness pane: per-endpoint breaker
+    state and the chaos/deadline counters, so a chaos run (or a real
+    incident) is debuggable from the browser — which peer is isolated,
+    for how long, how much load was shed. ONE builder shared by the
+    RPC builtin service and the HTTP /connections handler, so the two
+    views cannot diverge."""
+    from brpc_tpu.rpc.circuit_breaker import all_breaker_snapshots
+    robustness = dict(dump_exposed("chaos_injected_"))
+    for name in ("server_deadline_shed", "retry_suppressed_budget"):
+        robustness.update(dump_exposed(name))
+    return {
+        "connections": [{
+            "remote": str(s.remote_endpoint) if s.remote_endpoint else None,
+            "failed": s.failed,
+        } for s in server.connections()],
+        "breakers": all_breaker_snapshots(),
+        "robustness": robustness,
+    }
+
+
 def add_builtin_services(server) -> None:
     builtin = Service("builtin")
 
@@ -42,11 +63,7 @@ def add_builtin_services(server) -> None:
 
     @builtin.method()
     def connections(cntl, request):
-        conns = server.connections()
-        return json.dumps([{
-            "remote": str(s.remote_endpoint) if s.remote_endpoint else None,
-            "failed": s.failed,
-        } for s in conns]).encode()
+        return json.dumps(connections_page(server), default=str).encode()
 
     try:
         server.add_service(builtin)

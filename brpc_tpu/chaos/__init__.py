@@ -1,0 +1,39 @@
+"""Deterministic fault injection at the transport seam (the "chaos
+lane").
+
+Basiri et al., *Chaos Engineering* (IEEE Software 2016): failure-handling
+machinery (retry, backup request, failover, circuit breaker, health
+check) only counts once it survives injected faults on the REAL
+transport — hand-rolled stubs exercise the handler, not the stack. The
+chaos lane installs a seeded, scripted :class:`FaultPlan` around the
+registered transports (``mem://``, ``tcp://``, ``ici://``), so every
+layer above the ``Conn`` byte-stream contract — Socket write
+arbitration, the input messenger, dispatch, retries, breakers, health
+checks — experiences the fault exactly as production would.
+
+Determinism contract: a plan is addressed by (endpoint, connection
+index) and byte offsets, never by wall-clock; the same plan against the
+same call sequence injects the same faults. ``FaultPlan.random(seed)``
+expands to a concrete script via ``random.Random(seed)`` so a storm is
+reproducible from its seed alone.
+
+Injection counters (exposed bvars, one per primitive)::
+
+    chaos_injected_delay / drop / corrupt / partial / refuse / flap
+
+The standing invariants a chaos run must uphold (asserted by
+``tools/chaos.py``, documented in docs/robustness.md):
+
+  1. every call reaches a verdict — no hangs (completion, error, or the
+     caller's own deadline);
+  2. a flapping peer is isolated (breaker/health) and revived by the
+     health check once the flap ends;
+  3. no socket/fiber/stream leaks after the storm settles.
+"""
+
+from brpc_tpu.chaos.plan import Fault, FaultPlan
+from brpc_tpu.chaos.inject import (chaos_counters, install, installed_plan,
+                                   uninstall)
+
+__all__ = ["Fault", "FaultPlan", "install", "uninstall", "installed_plan",
+           "chaos_counters"]

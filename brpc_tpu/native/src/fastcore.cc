@@ -211,6 +211,14 @@ struct MetaScan {
   uint64_t cid = 0;
   uint64_t att = 0;
   uint64_t log_id = 0;
+  uint64_t timeout_ms = 0;  // RpcRequestMeta.timeout_ms (0 = absent)
+  // judge-or-defer posture for timeout-bearing requests: true (the
+  // scan/dispatch lanes) defers them to the classic lane, which is the
+  // single deadline authority (stamp arrival, shed expired —
+  // rpc/server_dispatch.py); false (the pure-C echo loops) ENFORCES
+  // instead — they serve at the instant of arrival, so the remaining
+  // budget equals the whole budget and a shed can never be due.
+  bool defer_timeout = true;
   int kind = -1;  // 0 request, 1 response, 2 stream frame
   const char* svc = nullptr; size_t svc_len = 0;
   const char* mth = nullptr; size_t mth_len = 0;
@@ -259,14 +267,15 @@ inline bool walk_request_meta(const unsigned char* p,
       case (3u << 3) | 0:  // log_id
         if (!read_varint(p, end, &m->log_id)) return false;
         break;
-      // graftlint: disable=judge-defer -- timeout_ms is advisory: server
-      // dispatch never reads it on the classic lane either, so dropping
-      // it here cannot diverge observable semantics
-      case (4u << 3) | 0: {  // timeout_ms (server side ignores)
-        uint64_t ignored;
-        if (!read_varint(p, end, &ignored)) return false;
+      case (4u << 3) | 0:  // timeout_ms: the client's deadline budget —
+        // deadline propagation (ISSUE 2) makes this field load-bearing:
+        // the classic lane stamps arrival and sheds expired requests,
+        // so a fast lane may not silently drop it. Scan/dispatch lanes
+        // defer (the record does not carry a budget); the echo loops
+        // enforce by construction (see MetaScan.defer_timeout).
+        if (!read_varint(p, end, &m->timeout_ms)) return false;
+        if (m->defer_timeout && m->timeout_ms != 0) return false;
         break;
-      }
       default:
         return false;  // auth_token or unknown: slow path
     }
@@ -566,6 +575,10 @@ PyObject* serve_core(const unsigned char* d, Py_ssize_t len,
   Item items[128];
   while (n_served < 128) {
     MetaScan m;
+    // echo loop: serve-at-arrival enforces the deadline trivially
+    // (remaining == whole budget), so timeout-bearing frames stay
+    // eligible here — see MetaScan.defer_timeout
+    m.defer_timeout = false;
     Py_ssize_t total = cut_fast_frame(d, off, len, magic, max_body, &m);
     if (total < 0) break;
     if (m.kind != 0) break;

@@ -275,9 +275,9 @@ class HttpProtocol(Protocol):
         if path == "/flags" or path.startswith("/flags/"):
             return self._flags(req, path)
         if path == "/connections":
-            conns = [{"remote": str(s.remote_endpoint), "failed": s.failed}
-                     for s in server.connections()]
-            return 200, "application/json", json.dumps(conns).encode()
+            from brpc_tpu.builtin.services import connections_page
+            return 200, "application/json", json.dumps(
+                connections_page(server), default=str).encode()
         if path == "/rpcz":
             from brpc_tpu.rpc.span import global_collector, global_store
             tid = req.query.get("trace_id")

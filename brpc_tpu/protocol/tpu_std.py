@@ -113,7 +113,8 @@ def pack_small_frame(meta_prefix: bytes, cid: int, payload: bytes,
 class RpcMessage:
     """One parsed tpu_std message."""
 
-    __slots__ = ("meta", "payload", "attachment", "device_arrays")
+    __slots__ = ("meta", "payload", "attachment", "device_arrays",
+                 "arrival_ns")
 
     def __init__(self, meta: pb.RpcMeta, payload: IOBuf, attachment: IOBuf,
                  device_arrays: Optional[List] = None):
@@ -121,6 +122,12 @@ class RpcMessage:
         self.payload = payload
         self.attachment = attachment
         self.device_arrays = device_arrays or []
+        # cut-time stamp: the server-side deadline budget (request
+        # timeout_ms) counts from HERE, so dispatch queueing — a burst
+        # fanned out to fibers behind busy workers — spends the budget
+        # (the reference stamps received_us in InputMessenger the same
+        # way; pre-cut kernel/portal buffering is invisible to both)
+        self.arrival_ns = time.monotonic_ns()
 
 
 def serialize_payload(obj) -> bytes:

@@ -30,6 +30,7 @@ from brpc_tpu.protocol.tpu_std import (_HDR as _TPU_HDR, MAGIC as _TPU_MAGIC,
 
 _TAG_CORRELATION_ID_B = _TAG_CORRELATION_ID.to_bytes(1, "big")
 _TAG_ATTACHMENT_SIZE_B = _TAG_ATTACHMENT_SIZE.to_bytes(1, "big")
+from brpc_tpu.bvar.reducer import Adder
 from brpc_tpu.rpc import errno_codes as berr
 from brpc_tpu.rpc.controller import Controller, address_call, take_call
 from brpc_tpu.transport import socket as _socket_mod
@@ -72,6 +73,13 @@ _socket_mod.inflight_failer = _fail_inflight_calls
 
 
 _client_fdr = None   # lazily built; False = extension unavailable
+
+# retries/backups not issued because the call's deadline budget could
+# not possibly cover them (/vars) — the client half of deadline
+# propagation: an attempt that cannot complete is never launched
+nretry_suppressed = Adder().expose("retry_suppressed_budget")
+
+_csc = None   # lazily bound server_dispatch.current_serving_controller
 
 
 def client_fast_drain_hook(options):
@@ -260,6 +268,36 @@ class Channel:
         cntl.start_us = time.monotonic_ns() // 1000
         if cntl.timeout_ms is None:
             cntl.timeout_ms = self.options.timeout_ms
+        # deadline inheritance: a call made INSIDE a serving handler may
+        # not outlive the request being served — shrink to the parent's
+        # remaining budget (min rule; docs/robustness.md). A parent with
+        # no deadline inherits nothing.
+        global _csc
+        if _csc is None:
+            from brpc_tpu.rpc.server_dispatch import \
+                current_serving_controller as _csc_mod
+            _csc = _csc_mod
+        parent = _csc()
+        if parent is not None and parent is not cntl:
+            rem = parent.remaining_ms()
+            if rem is not None:
+                if rem <= 0.0:
+                    # the parent's budget is already gone: issuing would
+                    # waste a downstream server's time on a reply nobody
+                    # can use — fail fast, before any socket work
+                    cntl._done_cb = done
+                    cntl.set_failed(berr.ERPCTIMEDOUT,
+                                    "parent request's deadline budget "
+                                    "exhausted before nested call")
+                    cntl._complete()
+                    return cntl
+                if cntl.timeout_ms is None or cntl.timeout_ms > rem:
+                    cntl.timeout_ms = rem
+        if cntl.timeout_ms is not None:
+            # the client-side absolute deadline: retry/backup scheduling
+            # clamps to it (cheap: one subtraction per retry decision)
+            cntl.__dict__["_deadline_ns"] = time.monotonic_ns() \
+                + int(cntl.timeout_ms * 1e6)
         if cntl.max_retry is None:
             cntl.max_retry = self.options.max_retry
         if cntl.backup_request_ms is None:
@@ -633,6 +671,13 @@ class Channel:
         # while the timer thread can block on cntl._arb_lock
         allow = (cntl.current_try < cntl.max_retry
                  and self._policy_allows(cntl, code, text))
+        if allow and self._budget_exhausted(cntl):
+            # deadline clamp: a retry that cannot possibly complete
+            # inside the remaining budget is not issued — the deadline
+            # timer delivers the final verdict; this attempt's error
+            # stands if it wins the take below
+            allow = False
+            nretry_suppressed.add(1)
         with cntl._arb_lock:
             if address_call(cid) is not cntl:
                 return
@@ -651,11 +696,69 @@ class Channel:
             # report the failed attempt before moving on (the final
             # attempt is reported by the completion hook instead)
             self._on_attempt_failed(cntl, code, text, failed_ep)
-            self._issue_rpc(cntl)
+            self._launch_retry(cntl, code, text)
             return
         if taken:
             cntl.set_failed(code, text)
             cntl._complete()
+
+    def _budget_exhausted(self, cntl: Controller) -> bool:
+        dl = cntl.__dict__.get("_deadline_ns")
+        return dl is not None and time.monotonic_ns() >= dl
+
+    def _launch_retry(self, cntl: Controller, code: int, text: str) -> None:
+        """Issue the next attempt — immediately (the default,
+        backoff-free policy) or after the policy's exponential backoff,
+        clamped so the wait cannot outlive the deadline budget. The
+        delayed re-issue re-checks call liveness: a deadline completion
+        during the backoff wins and the retry evaporates."""
+        backoff_s = 0.0
+        try:
+            # current_try was already incremented for the NEW attempt:
+            # the policy contract wants the 0-based index of the attempt
+            # that just FAILED
+            view = _PolicyView(cntl, code, text,
+                               current_try=max(0, cntl.current_try - 1))
+            backoff_s = float(
+                self._retry_policy().retry_backoff_s(view) or 0.0)
+        except Exception:
+            backoff_s = 0.0   # a broken policy must not kill the retry
+        if backoff_s > 0.0:
+            dl = cntl.__dict__.get("_deadline_ns")
+            if dl is not None:
+                backoff_s = min(backoff_s, max(
+                    0.0, (dl - time.monotonic_ns()) / 1e9 - 1e-3))
+        if backoff_s <= 0.0 and not cntl.__dict__.get("_retry_reentry"):
+            self._reissue_guarded(cntl)
+            return
+        # deferred re-issue — two reasons share it: a backoff wait, or
+        # a synchronously-failing endpoint (dead connect) that would
+        # otherwise recurse issue->fail->retry->issue on this stack
+        # until it overflows. The timer callback only SPAWNS: _issue_rpc
+        # can block in connect() for seconds, and the process-wide timer
+        # thread must keep firing deadlines/backups for every other call
+        # (the chaos lane's no-hangs invariant depends on it).
+        cid = cntl.correlation_id
+
+        def _fire():
+            if address_call(cid) is cntl:
+                self._control.spawn(
+                    (lambda: address_call(cid) is cntl
+                     and self._reissue_guarded(cntl)),
+                    name="retry_reissue")
+
+        global_timer().schedule_after(max(0.0, backoff_s), _fire)
+
+    def _reissue_guarded(self, cntl: Controller) -> None:
+        """_issue_rpc with the reentry latch held: a failure inside it
+        that retries again is recognized by _launch_retry and deferred
+        to the timer instead of growing the stack."""
+        d = cntl.__dict__
+        d["_retry_reentry"] = True
+        try:
+            self._issue_rpc(cntl)
+        finally:
+            d.pop("_retry_reentry", None)
 
     def _policy_allows(self, cntl: Controller, code: int, text: str) -> bool:
         """Consult the retry policy with the failure visible through a
@@ -689,6 +792,10 @@ class Channel:
             allow = self._policy_allows(cntl, code, text)
         if cntl.current_try >= cntl.max_retry or not allow:
             return False
+        if self._budget_exhausted(cntl):
+            # same clamp as _maybe_retry: no budget, no new attempt
+            nretry_suppressed.add(1)
+            return False
         cntl.current_try += 1
         self._on_attempt_failed(cntl, code, text, failed_ep)
         cntl._register_call()
@@ -719,22 +826,34 @@ class Channel:
         (backup_request_ms, controller.cpp:331)."""
         if address_call(cntl.correlation_id) is not cntl:
             return
+        if self._budget_exhausted(cntl):
+            # a backup issued at/after the deadline cannot win: the
+            # timeout completion is already due (or racing this timer)
+            nretry_suppressed.add(1)
+            return
         cntl.used_backup = True
         self._issue_rpc(cntl)
 
 
 class _PolicyView:
-    """Read-only controller facade handed to RetryPolicy.do_retry: the
-    attempt's error is visible, every other attribute proxies to the
-    real controller, and writes are rejected — so policies cannot race
-    the completion paths."""
+    """Read-only controller facade handed to RetryPolicy.do_retry /
+    retry_backoff_s: the attempt's error is visible, every other
+    attribute proxies to the real controller, and writes are rejected —
+    so policies cannot race the completion paths. ``current_try`` may
+    be pinned by the caller (the backoff path runs after the increment
+    for the new attempt, but the contract exposes the index of the
+    attempt that just failed)."""
 
-    __slots__ = ("_cntl", "error_code", "error_text")
+    __slots__ = ("_cntl", "error_code", "error_text", "current_try")
 
-    def __init__(self, cntl, code: int, text: str):
+    def __init__(self, cntl, code: int, text: str,
+                 current_try: Optional[int] = None):
         object.__setattr__(self, "_cntl", cntl)
         object.__setattr__(self, "error_code", code)
         object.__setattr__(self, "error_text", text)
+        object.__setattr__(self, "current_try",
+                           cntl.current_try if current_try is None
+                           else current_try)
 
     def failed(self) -> bool:
         return self.error_code != 0

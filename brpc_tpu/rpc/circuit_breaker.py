@@ -8,7 +8,8 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict
+import weakref
+from typing import Dict, List
 
 from brpc_tpu.butil.endpoint import EndPoint
 
@@ -63,6 +64,48 @@ class CircuitBreaker:
     def error_rate(self) -> float:
         return self._short
 
+    @property
+    def isolated_until(self) -> float:
+        """Monotonic instant isolation ends (0.0 = never isolated)."""
+        return self._isolated_until
+
+    @property
+    def isolation_s(self) -> float:
+        """The NEXT trip's isolation duration (the backoff level)."""
+        return self._isolation_s
+
+    def snapshot(self) -> dict:
+        """Consistent observability snapshot (builtin status page /
+        chaos driver): one lock acquisition, plain JSON-able scalars."""
+        with self._lock:
+            now = time.monotonic()
+            return {
+                "isolated": now < self._isolated_until,
+                "isolated_for_s": max(0.0, self._isolated_until - now),
+                "isolation_s": self._isolation_s,
+                "error_rate_short": self._short,
+                "error_rate_long": self._long,
+                "samples": self._samples,
+            }
+
+
+# every live ClusterBreakers in the process, for the builtin status
+# page: breakers belong to CLIENT cluster channels, but operators debug
+# them from whatever server the process also runs — the page shows
+# process-wide state (weakly held: a closed channel's breakers vanish
+# with it)
+_registry: "weakref.WeakSet[ClusterBreakers]" = weakref.WeakSet()
+
+
+def all_breaker_snapshots() -> Dict[str, dict]:
+    """Per-endpoint breaker snapshots across every cluster channel in
+    the process (endpoints reached by several channels report the LAST
+    channel's view — they are distinct breakers by design)."""
+    out: Dict[str, dict] = {}
+    for cb in list(_registry):
+        out.update(cb.snapshot())
+    return out
+
 
 class ClusterBreakers:
     """Breaker per endpoint + the recovery gate
@@ -74,6 +117,12 @@ class ClusterBreakers:
     def __init__(self):
         self._breakers: Dict[EndPoint, CircuitBreaker] = {}
         self._lock = threading.Lock()
+        _registry.add(self)
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            items = list(self._breakers.items())
+        return {str(ep): b.snapshot() for ep, b in items}
 
     def breaker(self, ep: EndPoint) -> CircuitBreaker:
         b = self._breakers.get(ep)

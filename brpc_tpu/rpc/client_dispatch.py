@@ -149,8 +149,9 @@ def process_response(proto, msg: RpcMessage, socket) -> None:
                     cntl, code, text, socket.remote_endpoint, allow=allow)
             if retrying:
                 # re-registered under a fresh correlation id; issue the
-                # new attempt outside the lock (connects can block)
-                channel._issue_rpc(cntl)
+                # new attempt outside the lock (connects can block) —
+                # through the backoff gate, like every other retry
+                channel._launch_retry(cntl, code, text)
                 return
             cntl.responded_server = socket.remote_endpoint
             cntl.set_failed(code, text)

@@ -125,6 +125,12 @@ class Controller:
     _progressive = None        # ProgressiveAttachment (http chunked)
     _session_local = None      # borrowed from the server's data pool
     _session_kv: Optional[dict] = None    # kvmap.h SessionKV
+    # ---- deadline propagation (both sides): absolute monotonic-ns
+    # deadline. Server side: stamped from the request's timeout_ms at
+    # arrival (server_dispatch); client side: stamped by Channel.call —
+    # retry/backup scheduling clamps to it (a retry that cannot possibly
+    # complete is not issued).
+    _deadline_ns: Optional[int] = None
     _completed = False         # set under _arb_lock by _complete
     _finalized = False         # _complete ran end-to-end (joiners gate)
     _issue_socket = None       # socket of the current attempt (pluck lane)
@@ -235,6 +241,26 @@ class Controller:
     def latency_us(self) -> int:
         return max(0, self.end_us - self.start_us)
 
+    # ---------------------------------------------------- deadline budget
+    def remaining_ms(self) -> Optional[float]:
+        """Milliseconds left in this call's deadline budget, clamped at
+        0.0; None when no deadline applies. Server side this is the
+        CLIENT's remaining budget (arrival stamp + request timeout_ms):
+        a handler past it is computing a response nobody will read —
+        check it inside long loops, and nested calls the handler makes
+        inherit it automatically (Channel.call shrinks their timeout to
+        min(own timeout, this))."""
+        dl = self._deadline_ns
+        if dl is None:
+            return None
+        return max(0.0, (dl - time.monotonic_ns()) / 1e6)
+
+    def deadline_expired(self) -> bool:
+        """True once the deadline budget is exhausted (False when no
+        deadline applies)."""
+        dl = self._deadline_ns
+        return dl is not None and time.monotonic_ns() >= dl
+
     # ---------------------------------------------------- client completion
     def _reset_for_call(self) -> None:
         """Per-CALL client state must reset on controller reuse (called
@@ -262,6 +288,7 @@ class Controller:
         # only materialize them
         d = self.__dict__
         d.pop("end_us", None)
+        d.pop("_deadline_ns", None)        # new call, new budget
         d.pop("_pending_deadline", None)   # stale lazy deadline would
         #                                    clamp the new call's pluck
         d.pop("_pluck_fast", None)         # per-issue native-pluck hint

@@ -11,6 +11,7 @@ stays dead."""
 
 from __future__ import annotations
 
+import random
 import threading
 from typing import Callable, Dict, Optional, Set, Tuple
 
@@ -48,15 +49,28 @@ def rpc_health_check(service: str = "health", method: str = "Check",
 class HealthChecker:
     BASE_BACKOFF_S = 0.05
     MAX_BACKOFF_S = 5.0
+    # probe spread: each sleep is backoff * [1-J, 1+J). Without it a
+    # mass-death of N endpoints (switch bounce, server restart) puts
+    # every revival probe on the SAME pure backoff*2 schedule — N
+    # synchronized connect storms against a server that is trying to
+    # come back (the thundering-herd the reference's
+    # -health_check_interval jitter exists to break)
+    JITTER = 0.5
 
     def __init__(self, control: Optional[TaskControl] = None,
-                 app_check: Optional[Callable[[EndPoint], bool]] = None):
+                 app_check: Optional[Callable[[EndPoint], bool]] = None,
+                 rng: Optional[random.Random] = None):
         self._control = control or global_control()
         self._dead: Set[EndPoint] = set()
         self._checking: Set[EndPoint] = set()
         self._lock = threading.Lock()
         self._stopped = False
         self._app_check = app_check
+        self._rng = rng or random.Random()   # injectable: seeded tests
+
+    def _jittered(self, backoff: float) -> float:
+        return backoff * (1.0 + self.JITTER
+                          * (2.0 * self._rng.random() - 1.0))
 
     def dead_set(self) -> Set[EndPoint]:
         with self._lock:
@@ -84,7 +98,7 @@ class HealthChecker:
             with self._lock:
                 if ep not in self._dead:
                     break  # dropped from naming or already revived
-            await sleep(backoff)
+            await sleep(self._jittered(backoff))
             try:
                 conn = get_transport(ep.scheme).connect(ep)
                 conn.close()

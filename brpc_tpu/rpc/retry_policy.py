@@ -11,6 +11,8 @@ never client-fatal ones (bad request, auth, deadline).
 
 from __future__ import annotations
 
+import random
+
 from brpc_tpu.rpc import errno_codes as berr
 
 
@@ -20,6 +22,14 @@ class RetryPolicy:
 
     def do_retry(self, cntl) -> bool:
         raise NotImplementedError
+
+    def retry_backoff_s(self, cntl) -> float:
+        """Seconds to wait before the next attempt (0 = immediate, the
+        default — existing latency behavior is unchanged unless a
+        policy opts in). ``cntl.current_try`` is the 0-based index of
+        the attempt that just failed. The channel clamps the wait to
+        the call's remaining deadline budget."""
+        return 0.0
 
 
 class RpcRetryPolicy(RetryPolicy):
@@ -36,6 +46,45 @@ class RpcRetryPolicy(RetryPolicy):
 
     def do_retry(self, cntl) -> bool:
         return cntl.error_code in self.RETRYABLE
+
+
+class RetryBackoffPolicy(RpcRetryPolicy):
+    """Exponential backoff **with jitter** between retry attempts (the
+    reference's ``retry_backoff`` policy family, retry_policy.h):
+    attempt N waits ``base_ms * 2**N``, capped at ``max_ms``, then
+    spread by ``jitter`` (a ±fraction — attempt storms from correlated
+    failures must not re-synchronize on the retry schedule). The
+    channel additionally clamps every wait to the call's remaining
+    deadline budget, so opting in can never push a call past its own
+    deadline.
+
+    ``rng`` is injectable for deterministic tests (chaos lane);
+    ``retryable`` optionally overrides the retry decision (a callable
+    ``(cntl)->bool``), defaulting to the standard transport-error set.
+    """
+
+    def __init__(self, base_ms: float = 20.0, max_ms: float = 1000.0,
+                 jitter: float = 0.5, rng: random.Random | None = None,
+                 retryable=None):
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError("jitter must be within [0, 1]")
+        self.base_ms = float(base_ms)
+        self.max_ms = float(max_ms)
+        self.jitter = float(jitter)
+        self._rng = rng or random.Random()
+        self._retryable = retryable
+
+    def do_retry(self, cntl) -> bool:
+        if self._retryable is not None:
+            return bool(self._retryable(cntl))
+        return super().do_retry(cntl)
+
+    def retry_backoff_s(self, cntl) -> float:
+        b = min(self.base_ms * (2.0 ** cntl.current_try), self.max_ms)
+        if self.jitter:
+            # b * [1-jitter, 1+jitter): full spread around the nominal
+            b *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return b / 1e3
 
 
 _default: RetryPolicy | None = None

@@ -14,6 +14,8 @@ from typing import Optional
 
 from brpc_tpu.butil.flags import flag
 from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.bvar.reducer import Adder
+from brpc_tpu.fiber.keys import FiberLocal
 from brpc_tpu.fiber.scheduler import SchedAwaitable, current_group
 from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
 from brpc_tpu.protocol.tpu_std import (
@@ -25,6 +27,26 @@ from brpc_tpu.rpc.controller import Controller
 
 _UNSET = object()
 _dumper = None   # lazily bound brpc_tpu.rpc.rpc_dump.global_dumper
+
+# requests shed with ERPCTIMEDOUT because their client budget was gone
+# before handler entry (the tail-at-scale lever: a pod under load must
+# not burn cycles on requests whose callers gave up) — /vars
+nshed = Adder().expose("server_deadline_shed")
+
+# the controller of the request THIS fiber is currently serving —
+# nested Channel.call inside a handler reads it to inherit the parent's
+# remaining deadline budget (min(own timeout, parent remaining)). Set
+# around handler invocation only, cleared in finally: input fibers
+# serve many requests over their life and a stale context would clamp
+# an unrelated later call.
+_serving_cntl = FiberLocal()
+
+
+def current_serving_controller() -> Optional[Controller]:
+    """The server-side Controller whose handler is running on this
+    fiber/thread, or None outside a handler. Not propagated into
+    ``usercode_in_pthread`` pool threads (those handlers see None)."""
+    return _serving_cntl.get()
 
 
 class _NullSpan:
@@ -131,6 +153,16 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     t0 = time.monotonic_ns()
     cntl = Controller()
     d = cntl.__dict__
+    # deadline propagation: the wire's timeout_ms is the client's whole
+    # budget; it counts from the message's cut-time stamp so dispatch
+    # queueing (spawned fibers behind busy workers) spends it. The
+    # native lanes DEFER timeout-carrying requests to this path
+    # (fastcore.cc walk_request_meta), so this stamp-and-shed is the
+    # single server-side deadline authority.
+    budget_ms = req_meta.timeout_ms
+    if budget_ms > 0:
+        d["_deadline_ns"] = (getattr(msg, "arrival_ns", 0) or t0) \
+            + budget_ms * 1_000_000
     # zero/empty proto3 defaults match the Controller's class defaults:
     # write only what's actually set (instance-dict writes add up here)
     if meta.trace_id:
@@ -156,6 +188,21 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     else:
         span = _NULL_SPAN
         finish_span = _null_finish_span
+    if budget_ms > 0 and time.monotonic_ns() >= d["_deadline_ns"]:
+        # the client's budget was spent before this request reached
+        # dispatch (queued behind busy workers / a pipelined burst):
+        # shed it NOW — before parse, interceptor and handler — instead
+        # of computing a response nobody is waiting for (Dean & Barroso,
+        # The Tail at Scale: expired work amplifies the tail)
+        nshed.add(1)
+        server.on_request_end(method_key, 0, failed=True)
+        cntl.set_failed(berr.ERPCTIMEDOUT,
+                        f"deadline {budget_ms}ms expired before dispatch")
+        _send_error(proto, socket, cid, berr.ERPCTIMEDOUT,
+                    f"deadline {budget_ms}ms expired before dispatch")
+        finish_span(span, cntl)   # shed load must show in /rpcz
+        cntl.flush_session_kv()
+        return
     peer_stream = meta.stream_settings.stream_id   # absent -> 0
     if peer_stream:
         cntl._peer_stream_id = peer_stream
@@ -228,6 +275,9 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     if pool is not None:
         cntl._session_local = pool.borrow()
     response = None
+    # serving context: nested Channel.call made by the handler inherits
+    # this request's remaining budget (min(own timeout, remaining))
+    _serving_cntl.set(cntl)
     try:
         if not method.is_coroutine and current_group() is None and \
                 not getattr(server.options, "usercode_in_pthread", False):
@@ -241,7 +291,18 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
             # Async handlers stay inline: suspension converts them to a
             # normal fiber at their first real await.
             await _HopToWorker()
-        if getattr(server.options, "usercode_in_pthread", False) and \
+        r = None
+        if budget_ms > 0 and time.monotonic_ns() >= d["_deadline_ns"]:
+            # the hop parked this request behind busy workers long
+            # enough to spend the client's whole budget: shed at the
+            # last gate before handler entry (the entry-time shed above
+            # catches fan-out queueing; this one catches worker-queue
+            # delay)
+            nshed.add(1)
+            cntl.set_failed(berr.ERPCTIMEDOUT,
+                            f"deadline {budget_ms}ms expired before "
+                            "handler entry")
+        elif getattr(server.options, "usercode_in_pthread", False) and \
                 not method.is_coroutine:
             # blocking user code runs on the backup pthread pool; this
             # fiber (and its worker) stays free to pump IO
@@ -255,6 +316,9 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     except Exception as e:
         cntl.set_failed(berr.EINTERNAL, f"{type(e).__name__}: {e}")
     finally:
+        # cleared HERE, not at fiber exit: input fibers serve many
+        # requests and a stale serving context would clamp later calls
+        _serving_cntl.set(None)
         if pool is not None:
             pool.give_back(cntl._session_local)
             cntl._session_local = None

@@ -5,7 +5,28 @@
 //     slot with no INT32_MAX bound (ADVICE finding 1's shape);
 //   * it also reads `need_feedback` into a scratch local and drops it
 //     (ADVICE finding 2's shape);
+//   * walk_request_meta admits the DEADLINE field `timeout_ms` without
+//     enforcing or deferring (no `return false` after the read) — the
+//     lane would serve requests the classic lane sheds as expired;
 //   * walk_meta bounds attachment_size correctly — must stay silent.
+
+inline bool walk_request_meta(const unsigned char* p,
+                              const unsigned char* end, MetaScan* m) {
+  while (p < end) {
+    uint64_t tag;
+    if (!read_varint(p, end, &tag)) return false;
+    switch (tag) {
+      case (4u << 3) | 0:  // timeout_ms — must defer (return false) or
+        // enforce; the words `return false` in this comment must not
+        // satisfy the check
+        if (!read_varint(p, end, &m->timeout_ms)) return false;
+        break;             // VIOLATION: deadline admitted, never acted on
+      default:
+        return false;
+    }
+  }
+  return true;
+}
 
 inline bool walk_stream_meta(const unsigned char* p,
                              const unsigned char* end, MetaScan* m) {

@@ -97,13 +97,45 @@ class TestSeededViolations:
         # 0x7FFFFFFF and the dropped local: a bound or use that exists
         # only in a comment must not satisfy the rule
         active, _ = _lint("cxx")
-        assert [f.rule for f in active] == ["judge-defer"] * 2, active
+        assert [f.rule for f in active] == ["judge-defer"] * 3, active
         msgs = " | ".join(f.message for f in active)
         assert "StreamSettings.credits" in msgs and "INT32_MAX" in msgs
         assert "StreamSettings.need_feedback" in msgs \
             and "dropped" in msgs
+        # deadline propagation: a lane reading timeout_ms without
+        # enforcing or deferring fires (the read guard's own
+        # `return false` — and the one in the fixture's comment —
+        # must not satisfy the check)
+        assert "RpcRequestMeta.timeout_ms" in msgs \
+            and "enforcing or deferring" in msgs
         # the correctly bounded walk_meta attachment_size stays silent
         assert "attachment_size" not in msgs
+
+    def test_cxx_rule_survives_timeout_gate_removal_in_real_fastcore(
+            self, tmp_path):
+        """Mutation pin for the deadline clause: strip the defer gate
+        off walk_request_meta's timeout_ms case in the real fastcore.cc
+        (keeping its comments, which mention defer and the classic
+        lane) — the rule must fire, so the lane can never silently go
+        back to serving requests the classic lane sheds."""
+        src = open(os.path.join(
+            REPO_ROOT, "brpc_tpu", "native", "src", "fastcore.cc")).read()
+        gate = [ln for ln in src.splitlines()
+                if "m->defer_timeout && m->timeout_ms != 0" in ln]
+        assert len(gate) == 1, gate
+        mutated = src.replace(gate[0] + "\n", "")
+        native = tmp_path / "native"
+        native.mkdir()
+        (native / "fastcore.cc").write_text(mutated)
+        proto_dir = tmp_path / "protocol" / "proto"
+        proto_dir.mkdir(parents=True)
+        proto_src = os.path.join(REPO_ROOT, "brpc_tpu", "protocol",
+                                 "proto", "tpu_rpc_meta.proto")
+        (proto_dir / "tpu_rpc_meta.proto").write_text(
+            open(proto_src).read())
+        active, _ = Analyzer().run([str(tmp_path)])
+        msgs = " | ".join(f.message for f in active)
+        assert "RpcRequestMeta.timeout_ms" in msgs, msgs
 
     def test_cxx_rule_survives_guard_removal_in_real_fastcore(self, tmp_path):
         """Mutation pin: strip the actual credits guard out of the real

@@ -1,0 +1,292 @@
+"""Chaos driver: run a loopback cluster through seeded fault storms and
+assert the global robustness invariants (ISSUE 2 acceptance):
+
+  1. deadline storm — a burst of tiny-budget requests against a slow
+     handler: >= 99% of requests whose budget expired before handler
+     entry are SHED by the server (``server_deadline_shed``), and zero
+     expired requests reach the handler;
+  2. mixed storm — delay/drop/corrupt/partial/refuse/flap from a fixed
+     seed against a 3-peer cluster: every call reaches a verdict (no
+     hangs), the flapped peer is isolated (breaker and/or health) and
+     revived once the flap ends, and the storm leaks no sockets, fibers
+     or streams.
+
+Reproducibility: the fault schedule is a pure function of the seed
+(``FaultPlan`` addresses faults by connection index + byte offset, not
+wall-clock); ``--seed N`` replays the same schedule. Which individual
+calls fail can vary with thread interleaving — the asserted invariants
+hold regardless.
+
+Usage:
+    python tools/chaos.py --smoke            # preflight gate: ~10s, mem://
+    python tools/chaos.py --seed 7           # full storm at seed 7
+    python tools/chaos.py --scheme tcp       # storm over real sockets
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+from typing import List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from brpc_tpu import chaos                                   # noqa: E402
+from brpc_tpu.chaos import Fault, FaultPlan                  # noqa: E402
+from brpc_tpu.fiber import global_control                    # noqa: E402
+from brpc_tpu.rpc import (Channel, ChannelOptions, Controller,  # noqa: E402
+                          Server, ServerOptions, Service)
+from brpc_tpu.rpc import errno_codes as berr                 # noqa: E402
+from brpc_tpu.rpc.cluster_channel import ClusterChannel      # noqa: E402
+from brpc_tpu.rpc.retry_policy import RetryBackoffPolicy     # noqa: E402
+from brpc_tpu.rpc.server_dispatch import nshed               # noqa: E402
+
+_seq = iter(range(100000))
+
+
+def _addr(scheme: str, name: str) -> str:
+    if scheme == "mem":
+        return f"mem://{name}-{next(_seq)}"
+    return "tcp://127.0.0.1:0"
+
+
+# ----------------------------------------------------------- leak probe
+def leak_snapshot() -> dict:
+    from brpc_tpu.rpc import stream as _stream
+    from brpc_tpu.transport import socket as _socket
+    return {
+        "sockets": len(_socket._pool()),
+        "fibers": global_control().nfibers.get_value(),
+        "streams": len(_stream._stream_pool),
+    }
+
+
+def settle_to(baseline: dict, timeout_s: float = 10.0) -> dict:
+    """Poll until the live-object counts return to the pre-storm
+    baseline (closing is asynchronous); returns the final snapshot."""
+    deadline = time.monotonic() + timeout_s
+    snap = leak_snapshot()
+    while time.monotonic() < deadline:
+        snap = leak_snapshot()
+        if all(snap[k] <= baseline[k] for k in baseline):
+            break
+        time.sleep(0.05)
+    return snap
+
+
+# -------------------------------------------------------- deadline storm
+def deadline_storm(scheme: str = "mem", n: int = 300,
+                   timeout_ms: float = 40.0,
+                   handler_ms: float = 10.0) -> dict:
+    """Expired-deadline request storm: a slow sync handler self-clogs
+    the worker pool; requests queued past their budget must be shed
+    BEFORE handler entry."""
+    server = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("Storm")
+    entered: List[bool] = []
+
+    @svc.method()
+    def Slow(cntl, request):
+        entered.append(cntl.deadline_expired())
+        time.sleep(handler_ms / 1e3)
+        return b"ok"
+
+    server.add_service(svc)
+    ep = server.start(_addr(scheme, "deadline"))
+    addr = str(ep)
+    try:
+        ch = Channel(addr, ChannelOptions(timeout_ms=3000))
+        c = ch.call_sync("Storm", "Slow", b"warm")
+        assert not c.failed(), f"warm call failed: {c.error_text}"
+        base_shed = nshed.get_value()
+        cntls = []
+        for _ in range(n):
+            cn = Controller()
+            cn.timeout_ms = timeout_ms
+            cn.max_retry = 0
+            cntls.append(ch.call("Storm", "Slow", b"x", cntl=cn))
+        for cn in cntls:
+            assert cn.join(30.0), "call never reached a verdict (hang)"
+        deadline = time.monotonic() + 15.0
+        # the server keeps judging shed/served after clients gave up:
+        # wait until every request is accounted for
+        while time.monotonic() < deadline:
+            shed = nshed.get_value() - base_shed
+            if shed + len(entered) >= n:
+                break
+            time.sleep(0.05)
+        shed = nshed.get_value() - base_shed
+        served_ok = sum(1 for expired in entered if not expired)
+        served_expired = sum(1 for expired in entered if expired)
+        ch.close()
+    finally:
+        server.stop()
+    expired_total = shed + served_expired
+    ratio = shed / expired_total if expired_total else 1.0
+    report = {
+        "requests": n,
+        "shed": shed,
+        "served_within_budget": served_ok,
+        "served_expired": served_expired,
+        "expired_shed_ratio": round(ratio, 4),
+    }
+    assert expired_total > 0, \
+        f"storm produced no expired requests (tune n/handler_ms): {report}"
+    assert ratio >= 0.99, f"expired-shed ratio below 99%: {report}"
+    return report
+
+
+# ----------------------------------------------------------- mixed storm
+def mixed_storm(seed: int = 7, scheme: str = "mem",
+                n_calls: int = 120) -> dict:
+    """Seeded delay/drop/corrupt/partial/refuse/flap storm against a
+    3-peer cluster. Asserts the three global invariants (module doc)."""
+    baseline = leak_snapshot()
+    rng = random.Random(seed)
+    servers = []
+    addrs = []
+    for name in ("a", "b", "c"):
+        s = Server(ServerOptions(enable_builtin_services=False))
+        svc = Service("S")
+
+        @svc.method()
+        def Echo(cntl, request):
+            return bytes(request)
+
+        s.add_service(svc)
+        ep = s.start(_addr(scheme, f"storm{name}"))
+        servers.append(s)
+        addrs.append(str(ep))
+
+    flapped = addrs[0]
+    # byte-stream noise on the healthy peers + a scripted flap on peer
+    # A: its first connection dies mid-stream, the next connects are
+    # refused (health probes included), then the link is back
+    plan = (FaultPlan.random(seed, addrs[1:], conns=12,
+                             kinds=("delay", "corrupt", "drop"))
+            .at(flapped, 0, Fault("drop", at_byte=400))
+            .flap(flapped, at_conn=1, refuse_next=4)
+            .at(flapped, 6, Fault("partial_stall", at_byte=16)))
+    chaos.install(plan)
+    verdicts = {"ok": 0, "failed": 0}
+    saw_excluded = False
+    try:
+        cluster = ClusterChannel(
+            "list://" + ",".join(addrs), "rr",
+            ChannelOptions(
+                timeout_ms=400, max_retry=3,
+                retry_policy=RetryBackoffPolicy(
+                    base_ms=2.0, max_ms=20.0,
+                    rng=random.Random(seed + 1))))
+        flapped_ep = None
+        for ep in cluster.servers():
+            if str(ep) == flapped:
+                flapped_ep = ep
+        assert flapped_ep is not None, (flapped, cluster.servers())
+        inflight = []
+        for i in range(n_calls):
+            c = cluster.call("S", "Echo", b"m%d" % i)
+            inflight.append(c)
+            if len(inflight) >= rng.randrange(2, 8):
+                for c in inflight:
+                    assert c.join(30.0), "call hung"
+                    verdicts["ok" if not c.failed() else "failed"] += 1
+                inflight = []
+            if not saw_excluded:
+                breaker = cluster._breakers.breaker(flapped_ep)
+                if breaker.isolated() or \
+                        flapped_ep in cluster._health.dead_set():
+                    saw_excluded = True
+        for c in inflight:
+            assert c.join(30.0), "call hung"
+            verdicts["ok" if not c.failed() else "failed"] += 1
+
+        assert saw_excluded, \
+            "flapped peer was never isolated (breaker) nor health-dead"
+        # revival: once the flap's refusal budget is consumed, probes
+        # connect again — the peer must come back into service
+        revive_deadline = time.monotonic() + 20.0
+        revived = False
+        while time.monotonic() < revive_deadline:
+            if flapped_ep not in cluster._health.dead_set() and \
+                    not cluster._breakers.breaker(flapped_ep).isolated():
+                probe = Channel(flapped, ChannelOptions(
+                    timeout_ms=400, max_retry=0, share_connections=False))
+                pc = probe.call_sync("S", "Echo", b"revived?")
+                probe.close()
+                if not pc.failed():
+                    revived = True
+                    break
+            time.sleep(0.1)
+        assert revived, "flapped peer never revived after the storm"
+        cluster.close()
+    finally:
+        chaos.uninstall()
+        for s in servers:
+            s.stop()
+    snap = settle_to(baseline)
+    leaks = {k: snap[k] - baseline[k] for k in baseline
+             if snap[k] > baseline[k]}
+    assert not leaks, f"storm leaked live objects: {leaks} " \
+                      f"(baseline {baseline}, after {snap})"
+    report = {
+        "seed": seed,
+        "calls": n_calls,
+        "verdicts": verdicts,
+        "flapped_peer": flapped,
+        "isolated_then_revived": True,
+        "injected": {k: v.get_value()
+                     for k, v in chaos.chaos_counters.items()},
+        "fired_schedule_len": len(plan.fired()),
+        "leaks": leaks,
+    }
+    assert verdicts["ok"] > 0, f"no call ever succeeded: {report}"
+    return report
+
+
+def smoke(seed: int = 7) -> dict:
+    """The preflight gate's 10-second budget: one seeded storm pair
+    over mem://."""
+    t0 = time.monotonic()
+    out = {
+        "deadline": deadline_storm("mem", n=150),
+        "mixed": mixed_storm(seed, "mem", n_calls=60),
+    }
+    out["elapsed_s"] = round(time.monotonic() - t0, 2)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="one seeded mem:// storm pair (~10s) — the "
+                        "preflight gate")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--scheme", default="mem", choices=("mem", "tcp"))
+    p.add_argument("--calls", type=int, default=120)
+    args = p.parse_args(argv)
+    try:
+        if args.smoke:
+            report = {"smoke": smoke(args.seed)}
+        else:
+            report = {
+                "deadline": deadline_storm(args.scheme),
+                "mixed": mixed_storm(args.seed, args.scheme, args.calls),
+            }
+    except AssertionError as e:
+        print(json.dumps({"ok": False, "invariant": str(e)}, indent=2))
+        return 1
+    report["ok"] = True
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

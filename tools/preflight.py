@@ -182,9 +182,11 @@ def run_preflight() -> dict:
 #
 # `python tools/preflight.py --gate` is the correctness gate every PR
 # runs for free: graftlint over the whole package (unwaived findings
-# fail) plus a sanitizer smoke-build of both native artifacts (the
-# cheap half of the tier-2 lane — the instrumented fuzz RUN lives in
-# tests/test_sanitizer_lane.py). docs/invariants.md documents both.
+# fail), a sanitizer smoke-build of both native artifacts (the cheap
+# half of the tier-2 lane — the instrumented fuzz RUN lives in
+# tests/test_sanitizer_lane.py), and a seeded chaos smoke (one fault
+# storm over mem://, tools/chaos.py). docs/invariants.md and
+# docs/robustness.md document all three.
 
 GATE_SANITIZERS = ("address", "undefined")
 
@@ -228,10 +230,35 @@ def gate_sanitizer_smoke() -> dict:
                                       os.path.basename(fast)]}
 
 
+def gate_chaos_smoke() -> dict:
+    """One seeded fault storm over mem:// (tools/chaos.py --smoke,
+    ~10s budget): deadline shedding >= 99%, every call reaches a
+    verdict, flapped peer isolated-then-revived, zero leaks. A
+    subprocess so a wedged storm cannot hang the gate."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "chaos.py"),
+         "--smoke"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    out: dict = {"ok": proc.returncode == 0}
+    try:
+        report = json.loads(proc.stdout)
+        if proc.returncode == 0:
+            out["elapsed_s"] = report["smoke"]["elapsed_s"]
+            out["shed_ratio"] = \
+                report["smoke"]["deadline"]["expired_shed_ratio"]
+        else:
+            out["invariant"] = report.get("invariant")
+    except (ValueError, KeyError):
+        out["ok"] = False
+        out["error"] = (proc.stdout + proc.stderr)[-500:]
+    return out
+
+
 def run_gate() -> int:
     report = {}
     for name, fn in (("graftlint", gate_graftlint),
-                     ("sanitizer_smoke", gate_sanitizer_smoke)):
+                     ("sanitizer_smoke", gate_sanitizer_smoke),
+                     ("chaos_smoke", gate_chaos_smoke)):
         try:
             report[name] = fn()
         except Exception as e:  # noqa: BLE001 - a hung/crashed gate
