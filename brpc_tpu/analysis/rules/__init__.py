@@ -15,5 +15,6 @@ def default_rules() -> List[Rule]:
     from brpc_tpu.analysis.rules.registry_complete import (
         RegistryCompleteRule,
     )
+    from brpc_tpu.analysis.rules.span_finish import SpanFinishRule
     return [FiberBlockingRule(), IOBufAliasingRule(), JudgeDeferRule(),
-            LockOrderRule(), RegistryCompleteRule()]
+            LockOrderRule(), RegistryCompleteRule(), SpanFinishRule()]

@@ -32,6 +32,31 @@ def connections_page(server) -> dict:
     }
 
 
+def status_page(server) -> dict:
+    """The /status payload: server state, per-method latency windows
+    (qps + p50/p90/p99/max — "which method is slow" without scraping
+    /vars), and the saturation pane naming WHY it is slow (worker-busy
+    fraction, run-queue depth, socket write-queue bytes — the three
+    counters the rpcz stage timelines implicate). ONE builder shared by
+    the RPC builtin service and the HTTP /status handler, so the two
+    views cannot diverge."""
+    from brpc_tpu.transport.socket import nwqueue_bytes
+    saturation = server._control.saturation_snapshot()
+    saturation["socket_wqueue_bytes"] = nwqueue_bytes.get_value()
+    return {
+        "running": server.is_running,
+        "endpoint": str(server.endpoint) if server.endpoint else None,
+        "concurrency": server.concurrency,
+        "processed": server.nprocessed,
+        "errors": server.nerror,
+        "services": {n: sorted(s.methods)
+                     for n, s in server.services().items()},
+        "method_status": {k: lr.get_value()
+                          for k, lr in server.method_status.items()},
+        "saturation": saturation,
+    }
+
+
 def add_builtin_services(server) -> None:
     builtin = Service("builtin")
 
@@ -41,16 +66,7 @@ def add_builtin_services(server) -> None:
 
     @builtin.method()
     def status(cntl, request):
-        methods = {k: lr.get_value() for k, lr in server.method_status.items()}
-        return json.dumps({
-            "running": server.is_running,
-            "endpoint": str(server.endpoint) if server.endpoint else None,
-            "services": {n: sorted(s.methods) for n, s in server.services().items()},
-            "concurrency": server.concurrency,
-            "processed": server.nprocessed,
-            "errors": server.nerror,
-            "method_status": methods,
-        }, default=str).encode()
+        return json.dumps(status_page(server), default=str).encode()
 
     @builtin.method()
     def vars(cntl, request):

@@ -59,6 +59,7 @@ class LatencyRecorder(Variable):
             "qps": self.qps(),
             "latency_avg_us": self.latency(),
             "latency_p50_us": self.latency_percentile(0.5),
+            "latency_p90_us": self.latency_percentile(0.9),
             "latency_p99_us": self.latency_percentile(0.99),
             "latency_p999_us": self.latency_percentile(0.999),
             "max_latency_us": self.max_latency(),

@@ -33,7 +33,7 @@ from collections import deque
 from typing import Any, Callable, Deque, List, Optional
 
 from brpc_tpu.butil.fast_rand import fast_rand_less_than
-from brpc_tpu.bvar.reducer import Adder, PassiveStatus
+from brpc_tpu.bvar.reducer import Adder, Maxer, PassiveStatus
 
 _wake_rec = None
 _wake_rec_lock = threading.Lock()
@@ -275,6 +275,16 @@ class TaskControl:
         self._stop = False
         self.nfibers = Adder(0)
         self.nfibers_created = Adder(0)
+        # saturation instrumentation (the scheduler half of the rpcz
+        # timeline story: when spans show queue_us growing, these name
+        # the culprit). busy_ns accumulates worker time spent stepping
+        # fibers — windowed into a busy fraction; runq_peak records the
+        # deepest run queue seen at schedule() time — windowed into a
+        # per-interval high-water mark.
+        self.busy_ns = Adder(0)
+        self.runq_peak = Maxer()
+        self._busy_window = None       # PerSecond, created on first use
+        self._runq_peak_window = None  # Window, created on first use
         self._error_handlers: List[Callable] = []
         self._started = False
         self._start_lock = threading.Lock()
@@ -373,7 +383,10 @@ class TaskControl:
         fiber._ready_ns = time.perf_counter_ns()
         fiber.state = FIBER_STATE_READY
         if fiber.bound_group is not None:
-            self.groups[fiber.bound_group].bound_rq.append(fiber)
+            g = self.groups[fiber.bound_group]
+            g.bound_rq.append(fiber)
+            self.runq_peak.update(
+                len(g.rq) + len(g.remote_rq) + len(g.bound_rq))
             self.parking_lot.signal(1)
             return
         g = _tls.group
@@ -384,8 +397,12 @@ class TaskControl:
                 g.rq.append(fiber)        # Chase-Lev bottom: owner runs it next
         else:
             # remote push: spread by random target group
-            target = self.groups[fast_rand_less_than(self.concurrency)]
-            target.remote_rq.append(fiber)
+            g = self.groups[fast_rand_less_than(self.concurrency)]
+            g.remote_rq.append(fiber)
+        # saturation high-water mark: the depth of the queue this fiber
+        # just joined (cheap: three lens + a thread-local max update)
+        self.runq_peak.update(
+            len(g.rq) + len(g.remote_rq) + len(g.bound_rq))
         self.parking_lot.signal(1)
 
     # ------------------------------------------------------------- worker
@@ -449,16 +466,20 @@ class TaskControl:
                 _wake_recorder().record(
                     (time.perf_counter_ns() - ready_ns) / 1e3)
         fiber._ready_ns = 0
+        t0 = time.perf_counter_ns()
         try:
             token = fiber.coro.send(fiber._resume_value)
         except StopIteration as e:
+            self.busy_ns.add(time.perf_counter_ns() - t0)
             _tls.current = prev
             fiber._finish(e.value, None)
             return
         except BaseException as e:
+            self.busy_ns.add(time.perf_counter_ns() - t0)
             _tls.current = prev
             fiber._finish(None, e)
             return
+        self.busy_ns.add(time.perf_counter_ns() - t0)
         _tls.current = prev
         fiber.state = FIBER_STATE_SUSPENDED
         fiber._resume_value = None
@@ -483,6 +504,44 @@ class TaskControl:
     def add_error_handler(self, h: Callable) -> None:
         self._error_handlers.append(h)
 
+    def runqueue_depth(self) -> int:
+        """Instantaneous ready-but-not-running fiber count across all
+        groups — nonzero under load means requests are waiting for a
+        worker (the scheduler-side cause of span queue_us)."""
+        return sum(len(g.rq) + len(g.remote_rq) + len(g.bound_rq)
+                   for g in self.groups)
+
+    def _saturation_windows(self):
+        """Windowed views over busy_ns / runq_peak, created on first
+        use (a Window registers with the background sampler — don't
+        start that thread for TaskControls nobody inspects)."""
+        if self._busy_window is None:
+            from brpc_tpu.bvar.window import PerSecond, Window
+            self._busy_window = PerSecond(self.busy_ns, 10)
+            self._runq_peak_window = Window(self.runq_peak, 10)
+        return self._busy_window, self._runq_peak_window
+
+    def worker_busy_fraction(self) -> float:
+        """Fraction of worker capacity spent stepping fibers over the
+        sampler window: ~1.0 means every worker is saturated and new
+        work queues (span queue_us inflates); ~0 means latency lives
+        elsewhere (network, handler awaits)."""
+        busy, _ = self._saturation_windows()
+        per_s = busy.get_value() or 0.0
+        if self.concurrency <= 0:
+            return 0.0
+        return min(1.0, per_s / 1e9 / self.concurrency)
+
+    def saturation_snapshot(self) -> dict:
+        """The /status saturation pane's scheduler half."""
+        _, peak = self._saturation_windows()
+        return {
+            "workers": self.concurrency,
+            "runqueue_depth": self.runqueue_depth(),
+            "runqueue_peak_10s": peak.get_value() or 0,
+            "worker_busy_fraction": round(self.worker_busy_fraction(), 4),
+        }
+
     def expose_vars(self, prefix: str = "fiber") -> None:
         self.nfibers.expose(f"{prefix}_count")
         self.nfibers_created.expose(f"{prefix}_created")
@@ -491,6 +550,17 @@ class TaskControl:
             f"{prefix}_switch_count")
         PassiveStatus(lambda: sum(g.nsteals for g in self.groups)).expose(
             f"{prefix}_steal_count")
+        # saturation trio (windowed where a point sample would alias):
+        # depth is a live gauge; the peak and busy fraction read the
+        # sampler's last-10s window (zero-defaulted: an empty window
+        # must still render on /vars and the prometheus dump)
+        PassiveStatus(self.runqueue_depth).expose(
+            f"{prefix}_runqueue_depth")
+        _, peak = self._saturation_windows()
+        PassiveStatus(lambda: peak.get_value() or 0).expose(
+            f"{prefix}_runqueue_peak_10s")
+        PassiveStatus(self.worker_busy_fraction).expose(
+            f"{prefix}_worker_busy_fraction")
 
 
 # ----------------------------------------------------------------- globals

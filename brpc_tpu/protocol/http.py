@@ -91,6 +91,21 @@ def _query_flag(req: "HttpRequest", name: str) -> bool:
     return v.lower() in ("1", "true", "yes")
 
 
+def _trace_id_candidates(tid: str) -> set:
+    """Both readings of a trace id: spans dump ids as 016x hex, but
+    operators paste decimal from logs just as often — "123456" is
+    ambiguous, so /rpcz matches EITHER reading (a 64-bit random id
+    virtually never collides with its other-base twin)."""
+    out = set()
+    try:
+        out.add(int(tid, 16))
+    except ValueError:
+        pass
+    if tid.isdigit():
+        out.add(int(tid, 10))
+    return out
+
+
 def _thread_stacks() -> bytes:
     """All OS threads' Python stacks (the /bthreads + /threads pages of
     the reference — here workers ARE pthreads running fibers)."""
@@ -281,15 +296,24 @@ class HttpProtocol(Protocol):
         if path == "/rpcz":
             from brpc_tpu.rpc.span import global_collector, global_store
             tid = req.query.get("trace_id")
-            n = max(1, int(req.query.get("n", "50")))
+            ids = None
+            if tid:
+                ids = _trace_id_candidates(tid)
+                if not ids:
+                    return (400, "text/plain",
+                            f"bad trace_id {tid!r}".encode())
+            try:
+                n = max(1, int(req.query.get("n", "50")))
+            except ValueError:
+                return (400, "text/plain",
+                        f"bad n {req.query.get('n')!r}".encode())
             if _query_flag(req, "history"):
                 # read back from the on-disk SpanDB analog (rpcz_dir):
                 # spans that aged out of the in-memory ring
-                rows = global_store.read(
-                    n, trace_id=int(tid, 16) if tid else None)
+                rows = global_store.read(n, trace_id=ids)
                 return 200, "application/json", json.dumps(rows).encode()
-            if tid:
-                spans = global_collector.find_trace(int(tid, 16))
+            if ids:
+                spans = global_collector.find_trace(ids)
             else:
                 spans = global_collector.recent(n)
             return 200, "application/json", json.dumps(
@@ -377,6 +401,7 @@ class HttpProtocol(Protocol):
                 "fail_reason": str(getattr(s, "fail_reason", "") or ""),
                 "write_queue": (s._wq.depth()
                                 if getattr(s, "_wq", None) is not None else 0),
+                "write_queue_bytes": getattr(s, "wq_bytes", 0),
                 "preferred_protocol": s.preferred_protocol,
             })
             # device-lane introspection for ici:// conns (the page the
@@ -486,17 +511,8 @@ class HttpProtocol(Protocol):
         return render_index(server)
 
     def _status(self, server) -> bytes:
-        return json.dumps({
-            "running": server.is_running,
-            "endpoint": str(server.endpoint) if server.endpoint else None,
-            "concurrency": server.concurrency,
-            "processed": server.nprocessed,
-            "errors": server.nerror,
-            "services": {n: sorted(s.methods)
-                         for n, s in server.services().items()},
-            "method_status": {k: lr.get_value()
-                              for k, lr in server.method_status.items()},
-        }, default=str).encode()
+        from brpc_tpu.builtin.services import status_page
+        return json.dumps(status_page(server), default=str).encode()
 
     def _flags(self, req: HttpRequest, path: str):
         if path.startswith("/flags/") and ("setvalue" in req.query

@@ -409,6 +409,11 @@ class TpuStdProtocol(Protocol):
                                 STREAM_SCAN_MAX)
         if not frames:
             return None
+        # cut-time stamp for the whole scanned run: records that defer
+        # to the classic path (rpcz on, timeout-bearing metas) carry it
+        # into the synthesized RpcMessage, so the server deadline budget
+        # and the span's received_us anchor at the real frame cut
+        socket.user_data["_turbo_cut_ns"] = time.monotonic_ns()
         recs = []
         for f in frames:
             if f[0] == 1:
@@ -592,6 +597,7 @@ class TpuStdProtocol(Protocol):
         server = socket.user_data.get("server")
         pending = []
         last = len(recs) - 1
+        cut_ns = socket.user_data.get("_turbo_cut_ns", 0)
         for i, rec in enumerate(recs):
             if rec[0] == 1:
                 process_response_fast(rec[1], rec[2], rec[3], rec[4],
@@ -604,7 +610,8 @@ class TpuStdProtocol(Protocol):
             else:
                 r = process_request_fast(self, socket, server, rec[1],
                                          rec[2], rec[3], rec[4], rec[5],
-                                         rec[6], is_last=(i == last))
+                                         rec[6], is_last=(i == last),
+                                         arrival_ns=cut_ns)
                 if r is not None:
                     pending.append(r)
         if not pending:

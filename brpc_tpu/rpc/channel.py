@@ -279,6 +279,15 @@ class Channel:
             _csc = _csc_mod
         parent = _csc()
         if parent is not None and parent is not cntl:
+            # trace propagation: a nested call joins the serving
+            # request's trace (the server span's id becomes this call's
+            # parent), so a client->A->B chain assembles into ONE tree
+            # across processes (tools/trace.py). Only when the parent
+            # actually carries a trace — otherwise the fast framing
+            # path stays trace-free.
+            if parent.trace_id and not cntl.trace_id:
+                cntl.trace_id = parent.trace_id
+                cntl.span_id = parent.span_id
             rem = parent.remaining_ms()
             if rem is not None:
                 if rem <= 0.0:
@@ -330,6 +339,10 @@ class Channel:
             from brpc_tpu.rpc.span import finish_span, start_client_span
             span = start_client_span(cntl, service_name, method_name)
             span.request_size = len(cntl._request_bytes)
+            # the issue path stamps write_done_us on it (request write
+            # completion) and the response path stamps first_byte /
+            # parse_done — per-call, popped by _reset_for_call on reuse
+            cntl.__dict__["_client_span"] = span
             # a reused Controller must not accumulate span hooks across
             # calls (stale spans would be re-finished with this call's
             # data and resubmitted)
@@ -573,8 +586,8 @@ class Channel:
                     wire.append_buf(att)
             try:
                 sock.write(wire, on_done=lambda err, s=sock,
-                           q=d["_issue_seq"]:
-                           self._on_write_done(cntl, err, s, q))
+                           q=d["_issue_seq"], sp=d.get("_client_span"):
+                           self._on_write_done(cntl, err, s, q, sp))
             except (BlockingIOError, ConnectionError, OSError) as e:
                 self._maybe_retry(cntl, berr.EFAILEDSOCKET, str(e),
                                   failed_ep=sock.remote_endpoint)
@@ -615,12 +628,13 @@ class Channel:
                 with sock.lane_lock:
                     sock.write_device_payload(lane)
                     sock.write(wire, on_done=lambda err, s=sock,
-                               q=d["_issue_seq"]:
-                               self._on_write_done(cntl, err, s, q))
+                               q=d["_issue_seq"],
+                               sp=d.get("_client_span"):
+                               self._on_write_done(cntl, err, s, q, sp))
             else:
                 sock.write(wire, on_done=lambda err, s=sock,
-                           q=d["_issue_seq"]:
-                           self._on_write_done(cntl, err, s, q))
+                           q=d["_issue_seq"], sp=d.get("_client_span"):
+                           self._on_write_done(cntl, err, s, q, sp))
         except (BlockingIOError, ConnectionError, OSError) as e:
             # lane backpressure / dead conn must fail the controller (or
             # retry), never escape to the caller with the call leaked
@@ -628,8 +642,18 @@ class Channel:
                               failed_ep=sock.remote_endpoint)
 
     def _on_write_done(self, cntl: Controller, err: Optional[BaseException],
-                       sock=None, seq: Optional[int] = None):
+                       sock=None, seq: Optional[int] = None, span=None):
         if err is None:
+            # stage stamp: request write completed. ``span`` was
+            # captured at issue time — a parked write completing after
+            # the controller was recycled onto a NEW call must stamp
+            # the OLD call's span, not the new one's. First attempt
+            # wins (a retry's re-send must not overwrite the issue
+            # timeline); a write parked behind a blocked conn (chaos
+            # delay, full kernel buffer) lands here late and shows as
+            # queue_us.
+            if span is not None and not span.write_done_us:
+                span.write_done_us = time.monotonic_ns() // 1000
             return
         self._maybe_retry(cntl, berr.EFAILEDSOCKET, str(err),
                           failed_ep=sock.remote_endpoint

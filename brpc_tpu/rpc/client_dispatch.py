@@ -3,6 +3,8 @@ policy/baidu_rpc_protocol.cpp:565 -> OnVersionedRPCReturned)."""
 
 from __future__ import annotations
 
+import time
+
 from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.protocol.tpu_std import RpcMessage, unpack_inline_device_arrays
 from brpc_tpu.rpc import errno_codes as berr
@@ -110,6 +112,9 @@ def process_response_fast(cid: int, err_code: int, err_text, payload: bytes,
         if take_call(cid) is not cntl:
             return  # raced with timeout/backup completion
     cntl.responded_server = socket.remote_endpoint
+    span = cntl.__dict__.get("_client_span")
+    if span is not None:
+        span.first_byte_us = time.monotonic_ns() // 1000
     try:
         cntl.response_payload = PayloadBytes(payload)
         if cntl.response_msg is not None:
@@ -120,6 +125,9 @@ def process_response_fast(cid: int, err_code: int, err_text, payload: bytes,
             cntl.__dict__["response_attachment"] = ab
     except Exception as e:
         cntl.set_failed(berr.ERESPONSE, f"bad response: {e}")
+    if span is not None:
+        span.parse_done_us = time.monotonic_ns() // 1000
+        span.response_size = len(payload)
     cntl._complete()
 
 
@@ -164,12 +172,21 @@ def process_response(proto, msg: RpcMessage, socket) -> None:
     # in flight, the last-selected server is not necessarily the one
     # whose response completed the call
     cntl.responded_server = socket.remote_endpoint
+    span = cntl.__dict__.get("_client_span")
+    if span is not None:
+        # the frame's cut-time stamp is the closest honest "first
+        # response byte" the classic path has (span.h received_us)
+        span.first_byte_us = \
+            (getattr(msg, "arrival_ns", 0) or time.monotonic_ns()) // 1000
     try:
         _fill_response(cntl, msg, socket)
     except Exception as e:
         # the controller is already out of the pool: it MUST complete here
         # or join() hangs forever (e.g. corrupt compressed payload)
         cntl.set_failed(berr.ERESPONSE, f"bad response: {e}")
+    if span is not None:
+        span.parse_done_us = time.monotonic_ns() // 1000
+        span.response_size = msg.payload.size
     cntl._complete()
 
 

@@ -294,6 +294,13 @@ class Controller:
         d.pop("_pluck_fast", None)         # per-issue native-pluck hint
         d.pop("_fail_handled", None)       # per-attempt failure latch
         d.pop("_sync_fast", None)          # per-call pre-claim hint
+        d.pop("_client_span", None)        # previous call's rpcz span
+        # trace context is per-CALL: a stale trace_id would defeat the
+        # serving-trace inheritance in Channel.call (the nested call
+        # would chain onto the PREVIOUS request's tree) and pin every
+        # reused controller to its first call's trace forever
+        d.pop("trace_id", None)
+        d.pop("span_id", None)
         pre = d.pop("_pluck_preclaimed", None)
         if pre is not None:                # unconsumed pre-send claim
             pre.pluck_release()

@@ -175,14 +175,23 @@ class Server:
             # by an unexpose_all() (test fixtures) — re-register here
             # like the process_* vars, so /vars keeps them for any
             # server started afterward in the process
-            from brpc_tpu.transport.socket import (npluck_defer,
+            from brpc_tpu.transport.socket import (_wqueue_peak_window,
+                                                   npluck_defer,
                                                    npluck_fast, nreads,
-                                                   nwrites)
+                                                   nwqueue_bytes, nwrites)
             for var, name in ((nwrites, "socket_writes"),
                               (nreads, "socket_read_bytes"),
                               (npluck_fast, "pluck_fast_responses"),
-                              (npluck_defer, "pluck_defers")):
+                              (npluck_defer, "pluck_defers"),
+                              (nwqueue_bytes, "socket_wqueue_bytes")):
                 var.expose(name)
+            from brpc_tpu.bvar.reducer import PassiveStatus
+            wq_peak = _wqueue_peak_window()
+            PassiveStatus(lambda: wq_peak.get_value() or 0).expose(
+                "socket_wqueue_peak_10s")
+            # scheduler saturation trio (runqueue depth/peak, worker
+            # busy fraction) + fiber counters: /vars + prometheus
+            self._control.expose_vars()
             # best-effort: SIGUSR2 -> fiber stacks on stderr, so
             # tools/fiber_stacks.py <pid> works like the reference's
             # gdb_bthread_stack.py (no-op off the main thread)

@@ -180,11 +180,20 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     d["_service_name"] = req_meta.service_name
     d["_method_name"] = req_meta.method_name
     d["_server_socket"] = socket
-    if flag("rpcz_enabled"):
+    rz = flag("rpcz_enabled")
+    if rz:
         from brpc_tpu.rpc.span import finish_span, start_server_span
         span = start_server_span(cntl, req_meta.service_name,
                                  req_meta.method_name)
         span.request_size = msg.payload.size + msg.attachment.size
+        # timeline base: the frame's cut-time stamp — latency_us then
+        # measures full server residence (arrival -> response flushed),
+        # and the received->dispatch gap IS the dispatch queueing a
+        # flat start/end span could never show (span.h received_us)
+        arrival_us = (getattr(msg, "arrival_ns", 0) or t0) // 1000
+        span.received_us = arrival_us
+        span.start_us = arrival_us
+        span.dispatch_us = t0 // 1000
     else:
         span = _NULL_SPAN
         finish_span = _null_finish_span
@@ -246,6 +255,8 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
         finish_span(span, cntl)  # malformed traffic must show in /rpcz
         cntl.flush_session_kv()
         return
+    if rz:
+        span.parse_done_us = time.monotonic_ns() // 1000
 
     # interceptor gate (interceptor.h Accept): runs with the decoded
     # request visible on cntl, before the user handler
@@ -302,20 +313,27 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
             cntl.set_failed(berr.ERPCTIMEDOUT,
                             f"deadline {budget_ms}ms expired before "
                             "handler entry")
-        elif getattr(server.options, "usercode_in_pthread", False) and \
-                not method.is_coroutine:
-            # blocking user code runs on the backup pthread pool; this
-            # fiber (and its worker) stays free to pump IO
-            from brpc_tpu.rpc.usercode import run_usercode
-            r = await run_usercode(method.handler, cntl, request)
         else:
-            r = method.handler(cntl, request)
+            if rz:
+                span.handler_start_us = time.monotonic_ns() // 1000
+            if getattr(server.options, "usercode_in_pthread", False) and \
+                    not method.is_coroutine:
+                # blocking user code runs on the backup pthread pool;
+                # this fiber (and its worker) stays free to pump IO
+                from brpc_tpu.rpc.usercode import run_usercode
+                r = await run_usercode(method.handler, cntl, request)
+            else:
+                r = method.handler(cntl, request)
         if inspect.isawaitable(r):
             r = await r
         response = r
     except Exception as e:
         cntl.set_failed(berr.EINTERNAL, f"{type(e).__name__}: {e}")
     finally:
+        # handler exit stamp covers the exception path too (a span whose
+        # handler raised still shows where the time went)
+        if rz and span.handler_start_us and not span.handler_end_us:
+            span.handler_end_us = time.monotonic_ns() // 1000
         # cleared HERE, not at fiber exit: input fibers serve many
         # requests and a stale serving context would clamp later calls
         _serving_cntl.set(None)
@@ -331,19 +349,27 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     # that close (notify_on_cancel exists to stop RUNNING work)
     cntl._drop_cancel_subs()
     try:
-        _send_response(proto, socket, cid, cntl, response)
-        finish_span(span, cntl)
+        _send_response(proto, socket, cid, cntl, response,
+                       span=span if rz else None)
     finally:
+        # finish in the finally: a response write that throws (peer
+        # already gone) must still land the span in /rpcz — the error
+        # sessions are exactly the ones operators grep for. With the
+        # flush latch armed, submission waits for the write's on_done.
+        finish_span(span, cntl)
         # kvmap.h: one greppable line per session — even when the
-        # response write throws (peer already gone)
+        # response write throws
         cntl.flush_session_kv()
 
 
 def _synth_request_msg(cid: int, service: str, method_name: str,
-                       log_id: int, payload: bytes,
-                       att: bytes) -> RpcMessage:
+                       log_id: int, payload: bytes, att: bytes,
+                       arrival_ns: int = 0) -> RpcMessage:
     """Rebuild a classic RpcMessage from scan_frames fields (the rare
-    turbo->classic fallback: unknown method, configured auth, rpcz on)."""
+    turbo->classic fallback: unknown method, configured auth, rpcz on).
+    ``arrival_ns`` carries the scan lane's cut-time stamp forward so the
+    deadline budget and the span's received_us anchor at the real frame
+    cut, not at this re-synthesis."""
     meta = pb.RpcMeta()
     meta.correlation_id = cid
     meta.request.service_name = service
@@ -357,7 +383,10 @@ def _synth_request_msg(cid: int, service: str, method_name: str,
     a = IOBuf()
     if att:
         a.append(att)
-    return RpcMessage(meta, p, a)
+    msg = RpcMessage(meta, p, a)
+    if arrival_ns:
+        msg.arrival_ns = arrival_ns
+    return msg
 
 
 def make_fast_drain(server):
@@ -577,7 +606,8 @@ async def _drive_fast_inner(proto, socket, server, method, method_key: str,
 
 def process_request_fast(proto, socket, server, cid: int, service: str,
                          method_name: str, log_id: int, payload: bytes,
-                         att: bytes, is_last: bool = True):
+                         att: bytes, is_last: bool = True,
+                         arrival_ns: int = 0):
     """Dispatch one scan_frames request record. Returns None when fully
     handled (inline completion or adopted continuation), or a classic
     process_request coroutine for the caller to run (fallback cases).
@@ -591,7 +621,7 @@ def process_request_fast(proto, socket, server, cid: int, service: str,
             flag("rpcz_enabled") or flag("rpc_dump_dir"):
         return process_request(
             proto, _synth_request_msg(cid, service, method_name, log_id,
-                                      payload, att), socket)
+                                      payload, att, arrival_ns), socket)
     method = server.find_method(service, method_name)
     if method is None:
         # error responses here run synchronously in the input context:
@@ -629,7 +659,16 @@ def process_request_fast(proto, socket, server, cid: int, service: str,
 
 
 def _send_response(proto, socket, cid: int, cntl: Controller,
-                   response) -> None:
+                   response, span=None) -> None:
+    """``span``: a live rpcz Span to stamp the serialize/flush stages
+    on. The flushed_us stamp rides the write's completion callback
+    (expect_flush/mark_flushed latch), so a blocked response write —
+    saturated peer, chaos delay — shows up as write-stage time instead
+    of vanishing between dispatch and /rpcz."""
+    on_done = None
+    if span is not None:
+        from brpc_tpu.rpc.span import expect_flush, mark_flushed
+        on_done = lambda err, s=span: mark_flushed(s, err)  # noqa: E731
     # small-call fast path: a successful tpu_std-framed response with no
     # stream/device/progressive sections needs only correlation_id (+
     # attachment_size) in its meta — hand-encoded varints over a single
@@ -648,7 +687,11 @@ def _send_response(proto, socket, cid: int, cntl: Controller,
                 wire = pack_small_frame(b"", cid, payload,
                                         att.to_bytes() if att else b"",
                                         magic=proto.MAGIC)
-                socket.write_small(wire)
+                if span is not None:
+                    span.response_size = len(payload)
+                    span.serialized_us = time.monotonic_ns() // 1000
+                    expect_flush(span)
+                socket.write_small(wire, on_done=on_done)
                 return
             # big response: stay zero-copy (IOBuf chain) below
     meta = pb.RpcMeta()
@@ -682,13 +725,22 @@ def _send_response(proto, socket, cid: int, cntl: Controller,
         wire, lane = pack_message(meta, payload, attachment=att,
                                   device_arrays=cntl.response_device_arrays,
                                   device_lane=use_lane)
+    if span is not None:
+        span.response_size = len(payload)
+        span.serialized_us = time.monotonic_ns() // 1000
     if lane is not None:
         # adjacent pair under the lane lock (see Channel._issue_rpc)
         with socket.lane_lock:
             socket.write_device_payload(lane)
-            socket.write(wire)
+            if span is not None:
+                # armed only once the write is certain to be issued (an
+                # armed latch with no callback would strand the span)
+                expect_flush(span)
+            socket.write(wire, on_done=on_done)
     else:
-        socket.write(wire)
+        if span is not None:
+            expect_flush(span)
+        socket.write(wire, on_done=on_done)
 
 
 def _send_error(proto, socket, cid: int, code: int, text: str) -> None:

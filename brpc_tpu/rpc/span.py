@@ -25,6 +25,14 @@ from brpc_tpu.butil.fast_rand import fast_rand
 from brpc_tpu.butil.flags import flag
 
 
+# monotonic->wall-clock anchor, computed once per process: stage stamps
+# use the monotonic clock (immune to NTP steps mid-RPC), but cross-process
+# trace assembly needs a shared timeline — to_dict emits base_real_us =
+# start_us + this offset (the reference's span.h base_real_us plays the
+# same role for its cpuwide stamps)
+_REAL_OFFSET_US = time.time_ns() // 1000 - time.monotonic_ns() // 1000
+
+
 @dataclass
 class Span:
     trace_id: int
@@ -40,7 +48,30 @@ class Span:
     log_id: int = 0
     request_size: int = 0
     response_size: int = 0
+    # ---- stage timeline (monotonic us; 0 = stage never reached). The
+    # reference records the same waypoints in span.h (received_us,
+    # start_parse_us, start_callback_us, sent_us): they are what turns
+    # "this RPC was slow" into "it queued / it computed / it flushed".
+    # Server side:
+    received_us: int = 0        # frame cut (RpcMessage.arrival_ns)
+    dispatch_us: int = 0        # dispatch context entered (queue exit)
+    parse_done_us: int = 0      # request payload decoded (server) /
+    #                             response payload decoded (client)
+    handler_start_us: int = 0   # user handler entered
+    handler_end_us: int = 0     # user handler returned/raised
+    serialized_us: int = 0      # response frame packed
+    flushed_us: int = 0         # response write completed (on_done)
+    # Client side:
+    write_done_us: int = 0      # request write completed (on_done)
+    first_byte_us: int = 0      # response frame seen by the client
     annotations: List[Tuple[int, str]] = field(default_factory=list)
+    # response-flush delegation latch (server side): when the response
+    # write's completion callback owns the flush stamp, finish_span may
+    # run before OR after it — exactly one of them submits the span
+    _flush_lock: threading.Lock = field(default_factory=threading.Lock,
+                                        repr=False, compare=False)
+    _await_flush: bool = field(default=False, repr=False, compare=False)
+    _finish_ready: bool = field(default=False, repr=False, compare=False)
 
     def annotate(self, text: str) -> None:
         self.annotations.append((time.monotonic_ns() // 1000, text))
@@ -49,7 +80,29 @@ class Span:
     def latency_us(self) -> int:
         return max(0, self.end_us - self.start_us)
 
+    def stage_breakdown(self) -> Tuple[int, int, int]:
+        """(queue_us, handle_us, write_us) — the three-way attribution
+        tail debugging needs. Server: arrival->handler (queueing +
+        parse), handler, handler->flush (serialize + write). Client:
+        issue->write-done, write-done->first-response-byte (network +
+        server residence), first-byte->completion. Sums to ~latency_us;
+        a span that never reached its handler puts everything in
+        queue_us."""
+        if self.side == "server":
+            base = self.received_us or self.start_us
+            mid0, mid1 = self.handler_start_us, self.handler_end_us
+            tail = self.flushed_us or self.end_us
+        else:
+            base = self.start_us
+            mid0, mid1 = self.write_done_us, self.first_byte_us
+            tail = self.end_us
+        if mid0 and mid1:
+            return (max(0, mid0 - base), max(0, mid1 - mid0),
+                    max(0, tail - mid1))
+        return (max(0, tail - base), 0, 0)
+
     def to_dict(self) -> dict:
+        queue_us, handle_us, write_us = self.stage_breakdown()
         return {
             "trace_id": f"{self.trace_id:016x}",
             "span_id": f"{self.span_id:016x}",
@@ -63,6 +116,25 @@ class Span:
             "log_id": self.log_id,
             "request_size": self.request_size,
             "response_size": self.response_size,
+            # timeline: start_us is process-monotonic (stage stamps share
+            # its clock); base_real_us anchors it on the wall clock so
+            # stores from different processes assemble onto one axis
+            "pid": os.getpid(),
+            "start_us": self.start_us,
+            "end_us": self.end_us,
+            "base_real_us": self.start_us + _REAL_OFFSET_US,
+            "received_us": self.received_us,
+            "dispatch_us": self.dispatch_us,
+            "parse_done_us": self.parse_done_us,
+            "handler_start_us": self.handler_start_us,
+            "handler_end_us": self.handler_end_us,
+            "serialized_us": self.serialized_us,
+            "flushed_us": self.flushed_us,
+            "write_done_us": self.write_done_us,
+            "first_byte_us": self.first_byte_us,
+            "queue_us": queue_us,
+            "handle_us": handle_us,
+            "write_us": write_us,
             "annotations": [
                 {"us": us, "text": t} for us, t in self.annotations],
         }
@@ -94,9 +166,13 @@ class SpanCollector:
         with self._lock:
             return list(self._ring)[-n:]
 
-    def find_trace(self, trace_id: int) -> List[Span]:
+    def find_trace(self, trace_id) -> List[Span]:
+        """``trace_id``: an int, or a collection of candidate ints (the
+        /rpcz handler accepts both hex and decimal spellings of an id
+        and matches either reading)."""
+        ids = {trace_id} if isinstance(trace_id, int) else set(trace_id)
         with self._lock:
-            return [s for s in self._ring if s.trace_id == trace_id]
+            return [s for s in self._ring if s.trace_id in ids]
 
     def clear(self) -> None:
         with self._lock:
@@ -167,8 +243,9 @@ class SpanStore:
             except OSError:
                 self._buf.clear()   # persistence must never fail the RPC
 
-    def read(self, n: int = 100,
-             trace_id: Optional[int] = None) -> List[dict]:
+    def read(self, n: int = 100, trace_id=None) -> List[dict]:
+        """``trace_id``: None (all spans), an int, or a collection of
+        candidate ints (matched against any)."""
         dirpath = flag("rpcz_dir")
         if not dirpath or n <= 0:
             return []
@@ -183,6 +260,9 @@ class SpanStore:
                     self._flush_locked(dirpath)
                 except OSError:
                     self._buf.clear()
+        ids = None
+        if trace_id is not None:
+            ids = {trace_id} if isinstance(trace_id, int) else set(trace_id)
         # bounded ring while scanning — never materialize all lines
         rows: Deque[dict] = deque(maxlen=n)
         for old in (True, False):       # aged file first: oldest→newest
@@ -195,9 +275,8 @@ class SpanStore:
                             d = json.loads(line)
                         except ValueError:
                             continue
-                        if trace_id is None or \
-                                int(d.get("trace_id", "0"),
-                                    16) == trace_id:
+                        if ids is None or \
+                                int(d.get("trace_id", "0"), 16) in ids:
                             rows.append(d)
             except OSError:
                 continue
@@ -268,11 +347,49 @@ def start_client_span(cntl, service: str, method: str) -> Span:
     return span
 
 
+def expect_flush(span: Span) -> None:
+    """Arm the flush-delegation latch: the response write's completion
+    callback (mark_flushed) owns the flushed_us stamp, and whichever of
+    finish_span / mark_flushed runs LAST submits the span — so the
+    stored timeline includes the real write completion even when the
+    conn blocks (a chaos ``delay`` fault, a saturated peer) and the
+    dispatch context moves on."""
+    span._await_flush = True
+
+
+def mark_flushed(span: Span, err=None) -> None:
+    """The write on_done half of the latch (stamps only on success —
+    a failed write has no flush time)."""
+    submit = False
+    with span._flush_lock:
+        if err is None and not span.flushed_us:
+            span.flushed_us = time.monotonic_ns() // 1000
+        span._await_flush = False
+        if span._finish_ready:
+            span._finish_ready = False
+            submit = True
+            if span.end_us < span.flushed_us:
+                span.end_us = span.flushed_us
+    if submit:
+        _submit_span(span)
+
+
 def finish_span(span: Span, cntl) -> None:
     span.end_us = time.monotonic_ns() // 1000
     span.error_code = cntl.error_code
     if cntl.remote_side and not span.remote_side:
         span.remote_side = str(cntl.remote_side)
+    if span._await_flush:
+        with span._flush_lock:
+            if span._await_flush:
+                # the response write hasn't completed: mark_flushed
+                # submits when it does (end_us then covers the flush)
+                span._finish_ready = True
+                return
+    _submit_span(span)
+
+
+def _submit_span(span: Span) -> None:
     global_collector.submit(span)
     if flag("rpcz_enabled"):
         global_store.write(span)

@@ -31,7 +31,7 @@ from brpc_tpu.butil.flags import define_flag, flag
 from brpc_tpu.butil.iobuf import (DEFAULT_BLOCK_SIZE, IOBuf, IOPortal,
                                   _BIG_BLOCK_SIZE)
 from brpc_tpu.butil.resource_pool import INVALID_ID, ResourcePool, VersionedId
-from brpc_tpu.bvar.reducer import Adder
+from brpc_tpu.bvar.reducer import Adder, Maxer
 from brpc_tpu.fiber import TaskControl, global_control
 from brpc_tpu.fiber.butex import Butex
 from brpc_tpu.transport.base import Conn, get_transport
@@ -133,6 +133,26 @@ nwrites = Adder().expose("socket_writes")
 nreads = Adder().expose("socket_read_bytes")
 npluck_fast = Adder().expose("pluck_fast_responses")   # native-loop wins
 npluck_defer = Adder().expose("pluck_defers")          # classic fallbacks
+# write-queue saturation: bytes accepted by write() but not yet handed
+# to the conn, across all sockets (a live gauge: +size at enqueue,
+# -size at dequeue) — sustained growth means peers or the network can't
+# absorb the response rate, which an rpcz timeline shows as write_us.
+# The windowed peak catches bursts a point sample between drains misses.
+nwqueue_bytes = Adder().expose("socket_wqueue_bytes")
+_wqueue_peak = Maxer()
+
+
+def _wqueue_peak_window():
+    """Windowed high-water mark of any single socket's queued bytes,
+    created lazily (a Window starts the background sampler thread)."""
+    global _wq_peak_win
+    if _wq_peak_win is None:
+        from brpc_tpu.bvar.window import Window
+        _wq_peak_win = Window(_wqueue_peak, 10)
+    return _wq_peak_win
+
+
+_wq_peak_win = None
 
 # Installed by the RPC layer (brpc_tpu.rpc.channel): callable
 # ``(socket, [controllers])`` that fails or re-issues the client calls
@@ -238,6 +258,10 @@ class Socket:
         # Installed by Server for eligible sockets, self-disabling.
         self.fast_drain: Optional[Callable] = None
         self.user_data: dict = {}                 # per-conn session state
+        # bytes enqueued to _wq and not yet popped by a writer (owner
+        # thread +=, writer -=; GIL-atomic enough for a gauge) — the
+        # per-socket write-queue saturation signal (/sockets page)
+        self.wq_bytes = 0
         # pairs a device-lane batch with its wire frame: concurrent
         # device-payload writers must not interleave (lane batches are
         # matched to messages by FIFO order)
@@ -305,12 +329,25 @@ class Socket:
                     pass
             return False
         nwrites.add(1)
+        sz = data.size if isinstance(data, IOBuf) else len(data)
+        self.wq_bytes += sz
+        nwqueue_bytes.add(sz)
+        _wqueue_peak.update(self.wq_bytes)
         if not self._wq.push((data, on_done)):
             return True          # the active writer drains it in order
         if self._inline_write:
             return self._drain_writes_inline()
         self._control.spawn(self._keep_write, name="keep_write")
         return True
+
+    def _wq_acct_pop(self, item) -> None:
+        """Settle the write-queue gauge for one popped item (called at
+        drain_one sites only — a handoff continuation was already
+        settled when the item first left the queue)."""
+        data = item[0]
+        sz = data.size if isinstance(data, IOBuf) else len(data)
+        self.wq_bytes -= sz
+        nwqueue_bytes.add(-sz)
 
     def _write_data_once(self, data):
         """Single pass over one item; returns (err, leftover_iobuf|None).
@@ -360,6 +397,8 @@ class Socket:
         while True:
             if item is None:
                 item = self._wq.drain_one()
+                if item is not None:
+                    self._wq_acct_pop(item)
             if item is None:
                 if self._wq.try_retire():
                     return ok
@@ -373,9 +412,16 @@ class Socket:
                 err, leftover = self._write_data_once(data)
                 if err is None and leftover is not None:
                     # blocked mid-frame: park writership on the writable
-                    # event (continuation takes it via _take_handoff)
+                    # event (continuation takes it via _take_handoff).
+                    # The parked bytes re-enter the queue gauge — a
+                    # stalled peer holding megabytes mid-frame is
+                    # exactly what socket_wqueue_bytes exists to show
+                    # (_take_handoff settles it when the park resolves)
+                    lsz = leftover.size
                     with self._handoff_lock:
                         self._handoff = (leftover, cb)
+                        self.wq_bytes += lsz
+                        nwqueue_bytes.add(lsz)
                     try:
                         self.conn.request_writable_event()
                     except Exception as e:
@@ -407,6 +453,12 @@ class Socket:
     def _take_handoff(self):
         with self._handoff_lock:
             item, self._handoff = self._handoff, None
+            if item is not None:
+                # every taker disposes of the item immediately (resumes
+                # the write or fails its callback): settle the gauge
+                sz = item[0].size
+                self.wq_bytes -= sz
+                nwqueue_bytes.add(-sz)
         return item
 
     def write_device_payload(self, arrays) -> bool:
@@ -463,6 +515,7 @@ class Socket:
                 if self._wq.try_retire():
                     return
                 continue
+            self._wq_acct_pop(item)
             data, cb = item
             err: Optional[BaseException] = None
             if self.failed:

@@ -1,0 +1,38 @@
+"""Seeded span-finish violations: a started rpcz span escaping through
+a return and through a raise without reaching finish_span. The happy
+paths DO finish — the rule must flag the leaky exits specifically."""
+
+from brpc_tpu.rpc.span import (finish_span, start_client_span,
+                               start_server_span)
+
+
+def serve_one(cntl, msg, handle):
+    span = start_server_span(cntl, "Echo", "Hop")
+    if msg is None:
+        # BAD: the shed/error exit drops the span — exactly the record
+        # an operator would grep /rpcz for
+        return None
+    result = handle(msg)
+    finish_span(span, cntl)
+    return result
+
+
+def issue_one(cntl):
+    span = start_client_span(cntl, "Echo", "Hop")
+    if cntl.failed():
+        # BAD: raising past the span loses it just as silently
+        raise RuntimeError("issue failed")
+    finish_span(span, cntl)
+    return span
+
+
+def serve_batch(cntl, items, handle):
+    outer = start_server_span(cntl, "Echo", "Batch")
+    finish_span(outer, cntl)
+    for item in items:
+        # BAD: the loop starts a span per iteration and finishes none
+        # of them — the earlier finished OUTER span must not launder
+        # the merged path
+        start_client_span(cntl, "Echo", "Hop")
+        handle(item)
+    return len(items)

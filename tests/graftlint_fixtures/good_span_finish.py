@@ -1,0 +1,40 @@
+"""span-finish's clean twin: every legitimate finishing pattern the
+rule must accept — direct finish on an early exit, try/finally
+coverage, and the deferred completion-hook idiom (Channel.call) where
+a registered lambda finishes the span on every completion path."""
+
+from brpc_tpu.rpc.span import (finish_span, start_client_span,
+                               start_server_span)
+
+
+def serve_all_paths(cntl, msg, handle):
+    span = start_server_span(cntl, "Echo", "Hop")
+    if msg is None:
+        finish_span(span, cntl)
+        return None
+    try:
+        result = handle(msg)
+    finally:
+        # the finally covers the success return AND a raising handler
+        finish_span(span, cntl)
+    return result
+
+
+def issue_with_hook(cntl):
+    span = start_client_span(cntl, "Echo", "Hop")
+    hook = lambda c, s=span: finish_span(s, c)  # noqa: E731
+    cntl._complete_hooks.append(hook)
+    if cntl.failed():
+        return None      # the hook finishes on every completion path
+    return span
+
+
+def branch_gated(cntl, enabled, null_span, handle):
+    if enabled:
+        span = start_server_span(cntl, "Echo", "Hop")
+    else:
+        span = null_span
+    try:
+        handle(cntl)
+    finally:
+        finish_span(span, cntl)

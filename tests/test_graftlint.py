@@ -163,6 +163,53 @@ class TestSeededViolations:
         assert "StreamSettings.credits" in msgs, msgs
 
 
+class TestSpanFinish:
+    def test_leaky_exits_detected(self):
+        active, _ = _lint("bad_span_finish.py")
+        assert [f.rule for f in active] == ["span-finish"] * 3, \
+            [f.format() for f in active]
+        msgs = " | ".join(f.message for f in active)
+        assert "returns" in msgs and "raises" in msgs
+        # the violations anchor on the leaky exits, not the start calls
+        src = open(os.path.join(
+            FIXTURES, "bad_span_finish.py")).read().splitlines()
+        for f in active:
+            assert "return" in src[f.line - 1] or "raise" in src[f.line - 1]
+        # the loop case: a span started per iteration leaks even though
+        # an earlier (different) span in the same function WAS finished
+        assert any("len(items)" in src[f.line - 1] for f in active), \
+            [f.format() for f in active]
+
+    def test_finishing_patterns_accepted(self):
+        # the fixture pair's clean half: direct finish on early exits,
+        # try/finally coverage, the deferred completion-hook idiom, and
+        # the branch-gated null-span alias — zero findings
+        active, waived = _lint("good_span_finish.py")
+        assert active == [] and waived == [], \
+            [f.format() for f in active + waived]
+
+    def test_mutation_deleting_finish_fires_on_real_dispatch(self):
+        """Mutation pin: delete the shed path's finish_span from the
+        real server_dispatch.py — the rule must fire, so a future edit
+        can never silently drop shed spans from /rpcz again."""
+        from brpc_tpu.analysis.core import Context, SourceFile
+        from brpc_tpu.analysis.rules.span_finish import SpanFinishRule
+        path = os.path.join(REPO_ROOT, "brpc_tpu", "rpc",
+                            "server_dispatch.py")
+        src = open(path).read()
+        target = [ln for ln in src.splitlines()
+                  if "finish_span(span, cntl)" in ln
+                  and "shed load" in ln]
+        assert len(target) == 1, target
+        sf = SourceFile(path, "brpc_tpu/rpc/server_dispatch.py",
+                        src.replace(target[0] + "\n", ""))
+        found = list(SpanFinishRule().check(sf, Context([sf])))
+        assert any(f.rule == "span-finish" for f in found), found
+        # and the unmutated file stays clean
+        sf_ok = SourceFile(path, "brpc_tpu/rpc/server_dispatch.py", src)
+        assert list(SpanFinishRule().check(sf_ok, Context([sf_ok]))) == []
+
+
 class TestCleanFixture:
     def test_zero_false_positives(self):
         active, waived = _lint("clean.py")

@@ -358,6 +358,55 @@ class TestObservabilityDepth:
         finally:
             set_flag("rpcz_dir", "")
 
+    def test_rpcz_trace_id_accepts_hex_and_decimal(self, server, tmp_path):
+        """/rpcz?trace_id= must match both the hex form spans are
+        dumped as AND the plain decimal an operator pastes from a log —
+        on the in-memory ring and on the history=1 on-disk path."""
+        from brpc_tpu.butil.flags import flag, set_flag
+        _, ep = server
+        saved_enabled = flag("rpcz_enabled")
+        set_flag("rpcz_enabled", True)
+        set_flag("rpcz_dir", str(tmp_path))
+        try:
+            ch = Channel(str(ep))
+            cntl = ch.call_sync("EchoService", "Echo", b"dual-form")
+            assert not cntl.failed()
+            hex_id = f"{cntl.trace_id:016x}"
+            dec_id = str(cntl.trace_id)
+            deadline = time.monotonic() + 3
+            while time.monotonic() < deadline:
+                status, body = http_get(ep, f"/rpcz?trace_id={hex_id}")
+                assert status == 200
+                if len(json.loads(body)) >= 2:   # client + server span
+                    break
+                time.sleep(0.05)
+            by_hex = json.loads(body)
+            assert len(by_hex) >= 2 \
+                and all(s["trace_id"] == hex_id for s in by_hex)
+            # decimal spelling: same spans from the ring
+            status, body = http_get(ep, f"/rpcz?trace_id={dec_id}")
+            assert status == 200
+            by_dec = json.loads(body)
+            assert {s["span_id"] for s in by_dec} == \
+                {s["span_id"] for s in by_hex}
+            # and through the on-disk history path, both forms again
+            for form in (hex_id, dec_id):
+                status, body = http_get(
+                    ep, f"/rpcz?history=1&trace_id={form}")
+                assert status == 200
+                rows = json.loads(body)
+                assert rows and all(r["trace_id"] == hex_id
+                                    for r in rows), (form, rows)
+            # garbage query params are a clean 400, not a 500
+            status, _ = http_get(ep, "/rpcz?trace_id=not-an-id")
+            assert status == 400
+            status, _ = http_get(ep, "/rpcz?n=abc")
+            assert status == 400
+            ch.close()
+        finally:
+            set_flag("rpcz_enabled", saved_enabled)
+            set_flag("rpcz_dir", "")
+
 
 def test_tools_rpc_press_drives_server(server):
     """tools/rpc_press as an e2e: load-generate against a live server

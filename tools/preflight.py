@@ -184,9 +184,11 @@ def run_preflight() -> dict:
 # runs for free: graftlint over the whole package (unwaived findings
 # fail), a sanitizer smoke-build of both native artifacts (the cheap
 # half of the tier-2 lane — the instrumented fuzz RUN lives in
-# tests/test_sanitizer_lane.py), and a seeded chaos smoke (one fault
-# storm over mem://, tools/chaos.py). docs/invariants.md and
-# docs/robustness.md document all three.
+# tests/test_sanitizer_lane.py), a seeded chaos smoke (one fault
+# storm over mem://, tools/chaos.py), and a trace smoke (loopback
+# multi-hop rpcz burst assembled + Perfetto-validated,
+# tools/trace.py). docs/invariants.md, docs/robustness.md and
+# docs/observability.md document them.
 
 GATE_SANITIZERS = ("address", "undefined")
 
@@ -230,6 +232,30 @@ def gate_sanitizer_smoke() -> dict:
                                       os.path.basename(fast)]}
 
 
+def gate_trace_smoke() -> dict:
+    """Loopback multi-hop burst with rpcz_dir set (tools/trace.py
+    --smoke): spans persist, assemble into per-call trace chains, and
+    the Perfetto export loads with every event well-formed. A
+    subprocess so a wedged burst cannot hang the gate."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "trace.py"),
+         "--smoke"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    out: dict = {"ok": proc.returncode == 0}
+    try:
+        report = json.loads(proc.stdout)
+        if proc.returncode == 0:
+            out["spans"] = report["spans"]
+            out["chains"] = report["chains"]
+            out["perfetto_slices"] = report["perfetto_slices"]
+        else:
+            out["invariant"] = report.get("invariant")
+    except (ValueError, KeyError):
+        out["ok"] = False
+        out["error"] = (proc.stdout + proc.stderr)[-500:]
+    return out
+
+
 def gate_chaos_smoke() -> dict:
     """One seeded fault storm over mem:// (tools/chaos.py --smoke,
     ~10s budget): deadline shedding >= 99%, every call reaches a
@@ -258,7 +284,8 @@ def run_gate() -> int:
     report = {}
     for name, fn in (("graftlint", gate_graftlint),
                      ("sanitizer_smoke", gate_sanitizer_smoke),
-                     ("chaos_smoke", gate_chaos_smoke)):
+                     ("chaos_smoke", gate_chaos_smoke),
+                     ("trace_smoke", gate_trace_smoke)):
         try:
             report[name] = fn()
         except Exception as e:  # noqa: BLE001 - a hung/crashed gate
