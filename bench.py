@@ -710,6 +710,12 @@ def main() -> None:
             "efficiency_vs_raw": round(gbps / raw_msg, 3) if raw_msg else None,
             "efficiency_vs_stream_raw": round(gbps / raw_stream, 3)
             if raw_stream else None,
+            # headline: StreamingRPC one-way throughput as a fraction
+            # of the box's boundary-less raw stream ceiling (the
+            # credit-window + frame path's efficiency figure)
+            "streaming_efficiency": round(
+                result["streaming_GBps"] / raw_stream, 3)
+            if raw_stream and result.get("streaming_GBps") else None,
             "avg_us": round(rec.latency(), 1),
             "p50_us": round(rec.latency_percentile(0.5), 1),
             "p99_us": round(rec.latency_percentile(0.99), 1),
@@ -883,6 +889,15 @@ def main() -> None:
             result["concurrency_sweep"]["clients_4B"][str(nclients)] = pt
             _progress({"progress": "concurrency_point",
                        "clients": nclients, **pt})
+        # headline: 8-client scaling factor over 1 client (flat scaling
+        # = a serialized hot path; the dispatcher-wake/batching work is
+        # accountable for this number) + the absolute 8-client qps
+        c4 = result["concurrency_sweep"]["clients_4B"]
+        q1 = (c4.get("1") or {}).get("qps")
+        q8 = (c4.get("8") or {}).get("qps")
+        if q1 and q8:
+            result["concurrency_scaling_8c"] = round(q8 / q1, 2)
+            result["qps_8c_4B"] = q8
         for depth in (1, 2, 4, 8):
             if deadline.remaining() < 8.0:
                 result["concurrency_sweep"]["inflight_1MB"][str(depth)] = \
@@ -939,6 +954,9 @@ def main() -> None:
         "small_rpc_p99_us": result.get("small_rpc_p99_us"),
         "small_rpc_min_us": result.get("small_rpc_min_us"),
         "streaming_GBps": result.get("streaming_GBps"),
+        "streaming_efficiency": result.get("streaming_efficiency"),
+        "concurrency_scaling_8c": result.get("concurrency_scaling_8c"),
+        "qps_8c_4B": result.get("qps_8c_4B"),
         "device_lane": ("error" if ("error" in lane or
                                     "lane_error" in lane)
                         else ("ok" if lane else "absent")),

@@ -8,6 +8,7 @@ from brpc_tpu.analysis.core import Rule
 
 
 def default_rules() -> List[Rule]:
+    from brpc_tpu.analysis.rules.block_recycle import BlockRecycleRule
     from brpc_tpu.analysis.rules.fiber_blocking import FiberBlockingRule
     from brpc_tpu.analysis.rules.iobuf_aliasing import IOBufAliasingRule
     from brpc_tpu.analysis.rules.judge_defer import JudgeDeferRule
@@ -16,5 +17,6 @@ def default_rules() -> List[Rule]:
         RegistryCompleteRule,
     )
     from brpc_tpu.analysis.rules.span_finish import SpanFinishRule
-    return [FiberBlockingRule(), IOBufAliasingRule(), JudgeDeferRule(),
-            LockOrderRule(), RegistryCompleteRule(), SpanFinishRule()]
+    return [BlockRecycleRule(), FiberBlockingRule(), IOBufAliasingRule(),
+            JudgeDeferRule(), LockOrderRule(), RegistryCompleteRule(),
+            SpanFinishRule()]

@@ -40,9 +40,21 @@ def status_page(server) -> dict:
     counters the rpcz stage timelines implicate). ONE builder shared by
     the RPC builtin service and the HTTP /status handler, so the two
     views cannot diverge."""
-    from brpc_tpu.transport.socket import nwqueue_bytes
+    from brpc_tpu.butil.iobuf import pool as iobuf_pool
+    from brpc_tpu.transport.socket import ncoalesced, nwqueue_bytes
+    from brpc_tpu.transport.input_messenger import (dispatch_batch_avg_10s,
+                                                    dispatch_batch_peak_10s)
     saturation = server._control.saturation_snapshot()
     saturation["socket_wqueue_bytes"] = nwqueue_bytes.get_value()
+    # hot-path batching health: is the input loop batching (avg > 1
+    # under load), is the write path coalescing, are blocks recycling
+    # (hit ratio ~1 once warm) — the three "is the overhaul working"
+    # gauges next to the pressure counters they relieve
+    saturation["dispatch_batch_size_avg_10s"] = dispatch_batch_avg_10s()
+    saturation["dispatch_batch_size_peak_10s"] = dispatch_batch_peak_10s()
+    saturation["socket_write_coalesced_frames"] = ncoalesced.get_value()
+    saturation["iobuf_pool_hit_ratio"] = round(iobuf_pool.hit_ratio(), 4)
+    saturation["iobuf_pool_bytes"] = iobuf_pool.cached_bytes()
     return {
         "running": server.is_running,
         "endpoint": str(server.endpoint) if server.endpoint else None,

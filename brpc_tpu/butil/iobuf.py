@@ -49,28 +49,161 @@ def _big_block_size_from_env() -> int:
 
 
 _BIG_BLOCK_SIZE = _big_block_size_from_env()
-_MAX_CACHED_BIG_BLOCKS_PER_THREAD = max(1, (16 << 20) // _BIG_BLOCK_SIZE)
+_MAX_CACHED_BIG_BLOCKS = max(1, (16 << 20) // _BIG_BLOCK_SIZE)
+
+# debug poisoning: recycled buffers are filled with _POISON_BYTE and
+# sentinel windows are verified intact at reuse — a consumer that held
+# a memoryview/BlockRef past the recycle point reads 0xDD garbage
+# (loud) instead of another call's payload (silent corruption), and a
+# stale WRITER trips the sentinel check at the next acquire
+_POISON_BYTE = 0xDD
+_POISON_SENTINEL = 32
 
 
-# PROCESS-GLOBAL freelists (list append/pop are GIL-atomic). The
-# reference caches per-thread to dodge a lock on multicore
-# (iobuf.cpp:318-430); under the GIL a global list costs the same as a
-# TLS lookup and — decisively — keeps recycling working when blocks are
-# freed on a different thread than the one reading (server reads on the
-# dispatcher, frees after the response on a worker: per-thread caches
-# never hit there, and every miss is a fresh ZEROED 256KB bytearray —
-# measured as the dominant CPU cost of the 1MB echo path).
-_free_blocks: List[bytearray] = []
-_free_big_blocks: List[bytearray] = []
+class BlockPool:
+    """PROCESS-GLOBAL size-classed block freelists (list append/pop are
+    GIL-atomic). The reference caches per-thread to dodge a lock on
+    multicore (iobuf.cpp:318-430); under the GIL a global pool costs
+    the same as a TLS lookup and — decisively — keeps recycling working
+    when blocks are freed on a different thread than the one reading
+    (server reads on the dispatcher, frees after the response on a
+    worker: per-thread caches never hit there, and every miss is a
+    fresh ZEROED bytearray whose page-fault cost dominates the recv
+    syscall itself; see malloc_tune.py for the measurement).
+
+    Every recycle bumps the pool generation and tags the buffer with
+    it: a Block records the generation it was born under, so debug
+    tooling (and the use-after-recycle tests) can prove a view predates
+    the buffer's latest recycle. ``BRPC_TPU_IOBUF_POOL=0`` disables
+    pooling entirely (every miss allocates, every recycle drops);
+    ``BRPC_TPU_IOBUF_DEBUG=1`` turns on poisoning + exact outstanding
+    accounting (a lock per acquire/recycle — debug only)."""
+
+    __slots__ = ("enabled", "debug", "classes", "caps",
+                 "hits", "misses", "recycled", "dropped",
+                 "generation", "_debug_lock", "outstanding")
+
+    def __init__(self, enabled: bool, debug: bool):
+        self.enabled = enabled
+        self.debug = debug
+        # each freelist entry is ONE (buffer, generation) tuple so the
+        # pop and the append each stay a single GIL-atomic list op —
+        # parallel buffer/gen lists would let concurrent threads pair
+        # a buffer with another recycle's tag (or IndexError between
+        # the two pops and silently drop a cached buffer)
+        self.classes = {DEFAULT_BLOCK_SIZE: [], _BIG_BLOCK_SIZE: []}
+        self.caps = {DEFAULT_BLOCK_SIZE: _MAX_CACHED_BLOCKS_PER_THREAD,
+                     _BIG_BLOCK_SIZE: _MAX_CACHED_BIG_BLOCKS}
+        # approximate under races (stats, not invariants): exact
+        # accounting costs a lock, paid only in debug mode
+        self.hits = 0
+        self.misses = 0
+        self.recycled = 0
+        self.dropped = 0
+        self.generation = 0
+        self._debug_lock = threading.Lock()
+        self.outstanding = 0          # debug-exact pooled buffers out
+
+    # ------------------------------------------------------------ acquire
+    def acquire(self, capacity: int):
+        """(buffer, generation) for a pooled size class — reused when
+        cached, freshly allocated otherwise. None for foreign sizes."""
+        lst = self.classes.get(capacity)
+        if lst is None:
+            return None
+        if self.debug:
+            return self._acquire_debug(capacity, lst)
+        # pop inside try: the truthiness check and the pop are two
+        # bytecodes — another thread can empty a one-element list
+        # between them
+        try:
+            buf, gen = lst.pop()
+            self.hits += 1
+            return buf, gen
+        except IndexError:
+            self.misses += 1
+            return bytearray(capacity), self.generation
+
+    def _acquire_debug(self, capacity: int, lst):
+        with self._debug_lock:
+            self.outstanding += 1
+            if lst:
+                buf, gen = lst.pop()
+                self.hits += 1
+                sent = bytes((_POISON_BYTE,)) * _POISON_SENTINEL
+                if (bytes(buf[:_POISON_SENTINEL]) != sent
+                        or bytes(buf[-_POISON_SENTINEL:]) != sent):
+                    raise RuntimeError(
+                        "iobuf pool: poisoned block was written after "
+                        "its recycle point (use-after-recycle)")
+                return buf, gen
+            self.misses += 1
+            return bytearray(capacity), self.generation
+
+    # ------------------------------------------------------------ recycle
+    def recycle(self, buf: bytearray) -> None:
+        """Return a buffer to its size class (called by the Block
+        finalizer once no BlockRef/memoryview can reach it — THE
+        recycle point every held view must not outlive)."""
+        if not self.enabled:
+            return
+        cap = len(buf)
+        lst = self.classes.get(cap)
+        if lst is None:
+            return
+        if self.debug:
+            with self._debug_lock:
+                self.outstanding -= 1
+                self.generation += 1
+                if len(lst) >= self.caps[cap]:
+                    self.dropped += 1
+                    return
+                buf[:] = bytes((_POISON_BYTE,)) * cap
+                lst.append((buf, self.generation))
+                self.recycled += 1
+            return
+        if len(lst) >= self.caps[cap]:
+            self.dropped += 1
+            return
+        self.generation += 1
+        lst.append((buf, self.generation))
+        self.recycled += 1
+
+    def clear(self) -> None:
+        """Drop every cached buffer (tests / memory pressure hooks)."""
+        for cap in self.classes:
+            self.classes[cap].clear()
+
+    # -------------------------------------------------------------- stats
+    def hit_ratio(self) -> float:
+        n = self.hits + self.misses
+        return self.hits / n if n else 0.0
+
+    def cached_bytes(self) -> int:
+        return sum(cap * len(lst) for cap, lst in self.classes.items())
+
+    def snapshot(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_ratio": round(self.hit_ratio(), 4),
+            "recycled": self.recycled,
+            "dropped": self.dropped,
+            "cached_bytes": self.cached_bytes(),
+            "cached_blocks": {str(c): len(l)
+                              for c, l in self.classes.items()},
+            "generation": self.generation,
+        }
+
+
+pool = BlockPool(
+    enabled=_os.environ.get("BRPC_TPU_IOBUF_POOL", "1") != "0",
+    debug=_os.environ.get("BRPC_TPU_IOBUF_DEBUG", "") not in ("", "0"))
 
 
 def _recycle_buffer(buf: bytearray) -> None:
-    if len(buf) == DEFAULT_BLOCK_SIZE:
-        if len(_free_blocks) < _MAX_CACHED_BLOCKS_PER_THREAD:
-            _free_blocks.append(buf)
-    elif len(buf) == _BIG_BLOCK_SIZE:
-        if len(_free_big_blocks) < _MAX_CACHED_BIG_BLOCKS_PER_THREAD:
-            _free_big_blocks.append(buf)
+    pool.recycle(buf)
 
 
 class Block:
@@ -80,27 +213,20 @@ class Block:
     appending into the spare capacity as long as it owns the tail ref.
     """
 
-    __slots__ = ("data", "size", "capacity", "user_meta", "__weakref__")
+    __slots__ = ("data", "size", "capacity", "user_meta", "gen",
+                 "__weakref__")
 
     def __init__(self, capacity: int = DEFAULT_BLOCK_SIZE, _recycle: bool = True):
-        # pop inside try: the truthiness check and the pop are two
-        # bytecodes — another thread can empty a one-element list
-        # between them
-        data = None
-        try:
-            if capacity == DEFAULT_BLOCK_SIZE:
-                data = _free_blocks.pop()
-            elif capacity == _BIG_BLOCK_SIZE:
-                data = _free_big_blocks.pop()
-        except IndexError:
-            pass
-        self.data = data if data is not None else bytearray(capacity)
+        got = pool.acquire(capacity) if (_recycle and pool.enabled) else None
+        if got is not None:
+            self.data, self.gen = got
+            weakref.finalize(self, _recycle_buffer, self.data)
+        else:
+            self.data = bytearray(capacity)
+            self.gen = 0
         self.size = 0
         self.capacity = len(self.data)
         self.user_meta = None
-        if _recycle and self.capacity in (DEFAULT_BLOCK_SIZE,
-                                          _BIG_BLOCK_SIZE):
-            weakref.finalize(self, _recycle_buffer, self.data)
 
     def left_space(self) -> int:
         return self.capacity - self.size
@@ -116,6 +242,7 @@ class Block:
         blk.size = len(mv)
         blk.capacity = len(mv)
         blk.user_meta = meta
+        blk.gen = 0
         if deleter is not None:
             weakref.finalize(blk, deleter, data)
         return blk
@@ -168,6 +295,18 @@ class BlockRef:
             arr = self.device_array()
             import numpy as np
             return np.asarray(arr).tobytes()
+        blk = self.block
+        d = blk.data
+        if self.offset == 0 and self.length == blk.size \
+                and type(d) is memoryview and type(d.obj) is bytes \
+                and d.nbytes == len(d.obj) and d.contiguous:
+            # zero-copy: the ref covers a whole wrapped immutable
+            # payload (append_user_data / the zero-copy append path) —
+            # hand the original bytes back instead of copying it.
+            # The nbytes+contiguous guard rejects views that are a
+            # slice/recast of a larger object (mv.obj is the BASE
+            # object, not the slice).
+            return d.obj
         return bytes(self.memoryview())
 
     def device_array(self):
@@ -341,7 +480,15 @@ class IOBuf:
         return None
 
     def peek_bytes(self, n: int) -> bytes:
-        """Copy out the first n bytes without consuming."""
+        """First n bytes without consuming. Single-block fast path: no
+        chunk list, no join — and zero-copy outright when the head ref
+        is exactly a wrapped immutable payload of n bytes."""
+        refs = self._refs
+        if refs and not refs[0].is_device and refs[0].length >= n:
+            r = refs[0]
+            if r.length == n:
+                return r.to_bytes()          # zero-copy when wrapped
+            return bytes(r.memoryview()[:n])
         chunks = []
         need = n
         for r in self._refs:

@@ -99,3 +99,11 @@ def expose_default_variables() -> None:
     ]:
         PassiveStatus(_getter(key)).expose(name)
     PassiveStatus(lambda: os.getpid()).expose("process_pid")
+    # IOBuf block-pool health (butil/iobuf.py BlockPool): the hit ratio
+    # is THE "are blocks recycling or reallocating per call" signal the
+    # hot-path overhaul is accountable for; bytes shows what the pool
+    # currently pins
+    from brpc_tpu.butil.iobuf import pool as _iobuf_pool
+    PassiveStatus(lambda: round(_iobuf_pool.hit_ratio(), 4)).expose(
+        "iobuf_pool_hit_ratio")
+    PassiveStatus(_iobuf_pool.cached_bytes).expose("iobuf_pool_bytes")

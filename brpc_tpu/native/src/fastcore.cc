@@ -144,6 +144,54 @@ PyObject* fc_pack_frame(PyObject*, PyObject* args) {
   return out;
 }
 
+// ----------------------------------------------------- pack_frame_head --
+// pack_frame_head(magic, meta_prefix, cid, att_size, tail_len) -> bytes
+// Header + meta for a frame whose payload/attachment stay OUT of the
+// allocation (they ride as zero-copy IOBuf refs behind this head):
+// body_size = meta_size + tail_len + att_size. One allocation, no
+// Python-side byte joins — the big-frame twin of pack_frame (the
+// small-frame path flattens payload+attachment into the same buffer;
+// a 1MB attachment must not).
+PyObject* fc_pack_frame_head(PyObject*, PyObject* args) {
+  Py_buffer magic, prefix;
+  unsigned long long cid, att, tail;
+  if (!PyArg_ParseTuple(args, "y*y*KKK", &magic, &prefix, &cid, &att, &tail))
+    return nullptr;
+  if (magic.len != 4) {
+    PyBuffer_Release(&magic); PyBuffer_Release(&prefix);
+    PyErr_SetString(PyExc_ValueError, "magic must be 4 bytes");
+    return nullptr;
+  }
+  size_t cid_field = 1 + varint_len(cid);
+  size_t att_field = att ? 1 + varint_len(att) : 0;
+  size_t meta_size = prefix.len + cid_field + att_field;
+  size_t body = meta_size + tail + att;
+  if (body > 0xFFFFFFFFull) {
+    PyBuffer_Release(&magic); PyBuffer_Release(&prefix);
+    PyErr_SetString(PyExc_OverflowError,
+                    "frame body exceeds u32 wire header");
+    return nullptr;
+  }
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, 12 + meta_size);
+  if (out != nullptr) {
+    char* p = PyBytes_AS_STRING(out);
+    memcpy(p, magic.buf, 4);
+    store_be32(p + 4, static_cast<uint32_t>(body));
+    store_be32(p + 8, static_cast<uint32_t>(meta_size));
+    p += 12;
+    memcpy(p, prefix.buf, prefix.len);
+    p += prefix.len;
+    *p++ = kTagCorrelationId;
+    p = varint_write(p, cid);
+    if (att_field) {
+      *p++ = kTagAttachmentSize;
+      varint_write(p, att);
+    }
+  }
+  PyBuffer_Release(&magic); PyBuffer_Release(&prefix);
+  return out;
+}
+
 // --------------------------------------------------------- parse_head --
 // parse_head(view, magic) ->
 //   None                                  view shorter than a header
@@ -459,8 +507,15 @@ PyObject* fc_scan_frames(PyObject*, PyObject* args) {
   Py_ssize_t max_body = 32768;
   Py_ssize_t max_frames = 128;
   Py_ssize_t max_stream_body = 0;
-  if (!PyArg_ParseTuple(args, "y*y*|nnn", &view, &magic, &max_body,
-                        &max_frames, &max_stream_body))
+  // materialize=1: records carry payload/attachment as BYTES instead
+  // of (offset, length) pairs — the whole batch of per-frame slices
+  // happens inside this one call, so a pipelined burst pays zero
+  // Python-side slicing (turbo_scan hands the list straight to
+  // turbo_dispatch). Offsets mode stays for callers that subscript
+  // the window themselves.
+  Py_ssize_t materialize = 0;
+  if (!PyArg_ParseTuple(args, "y*y*|nnnn", &view, &magic, &max_body,
+                        &max_frames, &max_stream_body, &materialize))
     return nullptr;
   const unsigned char* d = static_cast<const unsigned char*>(view.buf);
   Py_ssize_t off = 0;
@@ -483,11 +538,29 @@ PyObject* fc_scan_frames(PyObject*, PyObject* args) {
     Py_ssize_t p_len = Py_ssize_t(m.body - m.meta_size - m.att);
     Py_ssize_t a_off = p_off + p_len;
     Py_ssize_t a_len = Py_ssize_t(m.att);
+    PyObject* pay = nullptr;
+    PyObject* att = nullptr;
+    if (materialize) {
+      pay = PyBytes_FromStringAndSize(
+          reinterpret_cast<const char*>(d) + p_off, p_len);
+      att = pay == nullptr ? nullptr : PyBytes_FromStringAndSize(
+          reinterpret_cast<const char*>(d) + a_off, a_len);
+      if (att == nullptr) {
+        Py_XDECREF(pay);
+        fail = true;
+        break;
+      }
+    }
     PyObject* rec;
     if (m.kind == 2) {
       // live stream frame: (2, stream_id, frame_seq, credits, close,
-      // payload_off, payload_len, att_off, att_len)
-      rec = Py_BuildValue(
+      // payload_off, payload_len, att_off, att_len) — or with
+      // materialize, payload/attachment bytes in the offsets' place
+      rec = materialize ? Py_BuildValue(
+          "iKKKiNN", 2, (unsigned long long)m.stream_id,
+          (unsigned long long)m.frame_seq,
+          (unsigned long long)m.s_credits, (int)(m.s_close ? 1 : 0),
+          pay, att) : Py_BuildValue(
           "iKKKinnnn", 2, (unsigned long long)m.stream_id,
           (unsigned long long)m.frame_seq,
           (unsigned long long)m.s_credits, (int)(m.s_close ? 1 : 0),
@@ -503,23 +576,32 @@ PyObject* fc_scan_frames(PyObject*, PyObject* args) {
           m.mth ? m.mth : "", (Py_ssize_t)m.mth_len, nullptr);
       if (mth_s == nullptr) {
         Py_XDECREF(svc_s);
+        Py_XDECREF(pay); Py_XDECREF(att);
         PyErr_Clear();
         break;
       }
       // log_id is int64 on the wire: negatives arrive as 10-byte
       // varints and must round-trip signed ("L"), not as 2^64-x
-      rec = Py_BuildValue(
+      rec = materialize ? Py_BuildValue(
+          "iKNNLNN", 0, (unsigned long long)m.cid, svc_s, mth_s,
+          (long long)(int64_t)m.log_id, pay, att) : Py_BuildValue(
           "iKNNLnnnn", 0, (unsigned long long)m.cid, svc_s, mth_s,
           (long long)(int64_t)m.log_id, p_off, p_len, a_off, a_len);
     } else {
       PyObject* err_text;
       if (m.err != nullptr) {
         err_text = PyUnicode_DecodeUTF8(m.err, m.err_len, "replace");
-        if (err_text == nullptr) { fail = true; break; }
+        if (err_text == nullptr) {
+          Py_XDECREF(pay); Py_XDECREF(att);
+          fail = true;
+          break;
+        }
       } else {
         err_text = Py_NewRef(Py_None);
       }
-      rec = Py_BuildValue(
+      rec = materialize ? Py_BuildValue(
+          "iKiNNN", 1, (unsigned long long)m.cid, (int)m.err_code,
+          err_text, pay, att) : Py_BuildValue(
           "iKiNnnnn", 1, (unsigned long long)m.cid, (int)m.err_code,
           err_text, p_off, p_len, a_off, a_len);
     }
@@ -1054,12 +1136,17 @@ PyMethodDef module_methods[] = {
      "pack_frame(magic, meta_prefix, cid, payload, attachment) -> bytes"},
     {"parse_head", fc_parse_head, METH_VARARGS,
      "parse_head(view, magic) -> None | -1 | (body, meta_size, meta|None)"},
+    {"pack_frame_head", fc_pack_frame_head, METH_VARARGS,
+     "pack_frame_head(magic, meta_prefix, cid, att_size, tail_len) -> "
+     "bytes: header + meta for a frame whose payload/attachment ride "
+     "as zero-copy refs behind it (big-frame twin of pack_frame)"},
     {"scan_frames", fc_scan_frames, METH_VARARGS,
      "scan_frames(view, magic, max_body=32768, max_frames=128, "
-     "max_stream_body=0) -> (consumed, frames): cut + meta-decode "
-     "every complete small fast frame in one native pass; "
+     "max_stream_body=0, materialize=0) -> (consumed, frames): cut + "
+     "meta-decode every complete small fast frame in one native pass; "
      "max_stream_body>0 additionally admits complete LIVE STREAM data "
-     "frames up to that size"},
+     "frames up to that size; materialize=1 returns payload/attachment "
+     "bytes in place of the (offset, length) pairs"},
     {"serve_scan", fc_serve_scan, METH_VARARGS,
      "serve_scan(view, magic, service, method, max_body=32768) -> "
      "(consumed, out_bytes, n): echo-serve matching request frames "

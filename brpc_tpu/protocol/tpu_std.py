@@ -110,6 +110,32 @@ def pack_small_frame(meta_prefix: bytes, cid: int, payload: bytes,
     return _py_pack_small_frame(meta_prefix, cid, payload, attachment, magic)
 
 
+def _py_pack_frame_head(meta_prefix: bytes, cid: int, att_size: int,
+                        tail_len: int, magic: bytes = MAGIC) -> bytes:
+    meta = meta_prefix + _TAG_CORRELATION_ID.to_bytes(1, "big") + _varint(cid)
+    if att_size:
+        meta += _TAG_ATTACHMENT_SIZE.to_bytes(1, "big") + _varint(att_size)
+    return _HDR.pack(magic, len(meta) + tail_len + att_size,
+                     len(meta)) + meta
+
+
+def pack_frame_head(meta_prefix: bytes, cid: int, att_size: int,
+                    tail_len: int, magic: bytes = MAGIC) -> bytes:
+    """Header + meta scratch for a BIG frame whose payload/attachment
+    ride behind it as zero-copy IOBuf refs (fastcore.cc
+    pack_frame_head; bit-identical Python twin). body_size covers
+    meta + tail_len + att_size — the caller appends exactly those
+    bytes. Kills the per-call prefix+varint byte joins on the
+    big-attachment request path and the cut-through response head."""
+    fc = _fc
+    if fc is False:
+        fc = _resolve_fc()
+    fn = getattr(fc, "pack_frame_head", None) if fc is not None else None
+    if fn is not None:
+        return fn(magic, meta_prefix, cid, att_size, tail_len)
+    return _py_pack_frame_head(meta_prefix, cid, att_size, tail_len, magic)
+
+
 class RpcMessage:
     """One parsed tpu_std message."""
 
@@ -398,38 +424,35 @@ class TpuStdProtocol(Protocol):
             fc = _fc
             if fc is False:
                 fc = _resolve_fc()
-            # None when the extension is missing or prebuilt-stale
-            scan = self._scan_fn = getattr(fc, "scan_frames", None)
+            # None when the extension is missing or prebuilt-stale —
+            # including one too old for the materialize arg (probed
+            # once here, not per drain)
+            scan = getattr(fc, "scan_frames", None)
+            if scan is not None:
+                try:
+                    scan(b"", MAGIC, 0, 0, 0, 1)
+                except TypeError:
+                    scan = None
+            self._scan_fn = scan
         if scan is None:
             return None
         win = portal.first_host_view()
         if win is None or len(win) < HEADER_SIZE:
             return None
-        consumed, frames = scan(win, MAGIC, SMALL_FRAME_MAX, 128,
-                                STREAM_SCAN_MAX)
-        if not frames:
+        # materialize=1: the whole batch's payload/attachment slices
+        # happen inside the ONE native call — the records come back
+        # dispatch-ready (no per-frame Python slicing), already in
+        # turbo_dispatch's field order. Bytes are copied out before
+        # the portal pops, so read blocks recycle safely.
+        consumed, recs = scan(win, MAGIC, SMALL_FRAME_MAX, 128,
+                              STREAM_SCAN_MAX, 1)
+        if not recs:
             return None
         # cut-time stamp for the whole scanned run: records that defer
         # to the classic path (rpcz on, timeout-bearing metas) carry it
         # into the synthesized RpcMessage, so the server deadline budget
         # and the span's received_us anchor at the real frame cut
         socket.user_data["_turbo_cut_ns"] = time.monotonic_ns()
-        recs = []
-        for f in frames:
-            if f[0] == 1:
-                _, cid, ec, et, po, pl, ao, al = f
-                recs.append((1, cid, ec, et, bytes(win[po:po + pl]),
-                             bytes(win[ao:ao + al]) if al else b""))
-            elif f[0] == 2:
-                _, sid, seq, credits, sclose, po, pl, ao, al = f
-                recs.append((2, sid, seq, credits, sclose,
-                             bytes(win[po:po + pl]),
-                             bytes(win[ao:ao + al]) if al else b""))
-            else:
-                _, cid, svc, mth, lid, po, pl, ao, al = f
-                recs.append((0, cid, svc, mth, lid,
-                             bytes(win[po:po + pl]),
-                             bytes(win[ao:ao + al]) if al else b""))
         portal.pop_front(consumed)
         return recs
 
@@ -544,20 +567,16 @@ class TpuStdProtocol(Protocol):
         pa_len = body_size - meta_size           # payload + attachment
         if att < 0 or att > pa_len:
             return False         # lying size: classic path fails it
-        # response header+meta: fully determined by the request meta
-        resp_meta = (_TAG_CORRELATION_ID.to_bytes(1, "big")
-                     + _varint(meta.correlation_id))
-        if att:
-            resp_meta += _TAG_ATTACHMENT_SIZE.to_bytes(1, "big") + _varint(att)
         portal.pop_front(HEADER_SIZE + meta_size)
         state = {"remaining": pa_len, "key": tgt[2],
                  "t0": time.monotonic_ns(), "server": server}
         socket.user_data["_cut_forward"] = state
-        # header + already-arrived body leave in ONE write (a separate
-        # header write is its own packet under TCP_NODELAY — an extra
-        # syscall here and an extra wakeup on the client)
-        head = _HDR.pack(MAGIC, len(resp_meta) + pa_len,
-                         len(resp_meta)) + resp_meta
+        # response header+meta in ONE native allocation (no Python
+        # varint joins), and header + already-arrived body leave in ONE
+        # write (a separate header write is its own packet under
+        # TCP_NODELAY — an extra syscall here and an extra wakeup on
+        # the client)
+        head = pack_frame_head(b"", meta.correlation_id, att, pa_len - att)
         self.cut_forward(portal, socket, state, prefix=head)
         return True
 
@@ -617,12 +636,13 @@ class TpuStdProtocol(Protocol):
         if not pending:
             return None
         # same discipline as the classic loop: earlier fallbacks get
-        # fresh fibers (under a pending_responses claim, so the
-        # cut-through gate sees them before the fiber starts), the
-        # last runs in place
-        from brpc_tpu.transport.input_messenger import counted_spawn
-        for c in pending[:-1]:
-            counted_spawn(socket._control, socket, c, "process_tpu_std")
+        # fresh fibers (under pending_responses claims, so the
+        # cut-through gate sees them before any fiber starts) with ONE
+        # amortized wake for the whole spill, the last runs in place
+        from brpc_tpu.transport.input_messenger import counted_spawn_many
+        if len(pending) > 1:
+            counted_spawn_many(socket._control, socket, pending[:-1],
+                               "process_tpu_std")
         return pending[-1]
 
     # -------------------------------------------------------------- process

@@ -25,8 +25,8 @@ from brpc_tpu.protocol.tpu_std import (_HDR as _TPU_HDR, MAGIC as _TPU_MAGIC,
                                        SMALL_FRAME_MAX,
                                        _TAG_ATTACHMENT_SIZE,
                                        _TAG_CORRELATION_ID, _varint,
-                                       pack_message, pack_small_frame,
-                                       serialize_payload)
+                                       pack_frame_head, pack_message,
+                                       pack_small_frame, serialize_payload)
 
 _TAG_CORRELATION_ID_B = _TAG_CORRELATION_ID.to_bytes(1, "big")
 _TAG_ATTACHMENT_SIZE_B = _TAG_ATTACHMENT_SIZE.to_bytes(1, "big")
@@ -198,7 +198,8 @@ class Channel:
                                                        global_socket_map)
             with self._socket_lock:
                 s = self._socket
-                if s is not None and not s.failed:
+                if s is not None and not s.failed \
+                        and not s.probe_unobserved():
                     return s
             # the key carries the credential flavor (socket_map.h keys
             # include ssl/auth settings): channels with different
@@ -464,7 +465,7 @@ class Channel:
                 self._pool_closed = False   # channel in use again
                 while self._conn_pool:
                     sock = self._conn_pool.pop()
-                    if not sock.failed:
+                    if not sock.failed and not sock.probe_unobserved():
                         break
                 else:
                     sock = None
@@ -572,16 +573,16 @@ class Channel:
                     d["_pluck_preclaimed"] = sock
             else:
                 # large attachment: same cached-prefix meta (no pb build
-                # per call), attachment rides as zero-copy refs behind
-                # one contiguous header+meta+payload block
-                meta_bytes = (prefix + _TAG_CORRELATION_ID_B
-                              + _varint(cntl.correlation_id))
-                if att_size:
-                    meta_bytes += _TAG_ATTACHMENT_SIZE_B + _varint(att_size)
-                body = len(meta_bytes) + len(cntl._request_bytes) + att_size
+                # per call), header+meta in one native allocation
+                # (pack_frame_head — no Python varint joins), attachment
+                # rides as zero-copy refs behind it
+                head = pack_frame_head(prefix, cntl.correlation_id,
+                                       att_size, len(cntl._request_bytes))
                 wire = IOBuf()
-                wire.append(_TPU_HDR.pack(_TPU_MAGIC, body, len(meta_bytes))
-                            + meta_bytes + cntl._request_bytes)
+                if cntl._request_bytes:
+                    wire.append(head + cntl._request_bytes)
+                else:
+                    wire.append(head)
                 if att_size:
                     wire.append_buf(att)
             try:

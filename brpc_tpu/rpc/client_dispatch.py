@@ -38,10 +38,15 @@ def make_client_fast_drain():
     from brpc_tpu.native import fastcore as _fc_loader
     fc = _fc_loader.get()
     scan = getattr(fc, "scan_frames", None) if fc is not None else None
-    if scan is None:
-        return None
     from brpc_tpu.protocol.tpu_std import (MAGIC, SMALL_FRAME_MAX,
                                            STREAM_SCAN_MAX)
+    if scan is not None:
+        try:
+            scan(b"", MAGIC, 0, 0, 0, 1)   # materialize support probe
+        except TypeError:
+            scan = None                    # prebuilt-stale extension
+    if scan is None:
+        return None
     from brpc_tpu.rpc.stream import process_stream_frame_fast
     from brpc_tpu.transport.socket import pull_chunks as _pull_chunks
 
@@ -52,26 +57,24 @@ def make_client_fast_drain():
         if data is None:
             return handled
         consumed, frames = scan(data, MAGIC, SMALL_FRAME_MAX, 128,
-                                STREAM_SCAN_MAX)
+                                STREAM_SCAN_MAX, 1)
         if any(f[0] == 0 for f in frames):
             # a request-shaped frame on a client socket: hand the WHOLE
-            # run to the classic machinery in parse order (scan records
-            # carry payload offsets, not frame starts, so a partial
-            # dispatch could not find its cut point)
+            # run to the classic machinery in parse order (the records
+            # don't carry frame starts, so a partial dispatch could not
+            # find its cut point)
             sock.input_portal.append_user_data(data)
             return False
         for f in frames:
             if f[0] == 2:
                 # live stream frame: dispatched in parse order, like
                 # the turbo lane
-                _, sid, seq, credits, sclose, po, pl, ao, al = f
-                process_stream_frame_fast(
-                    sid, seq, credits, sclose, data[po:po + pl],
-                    data[ao:ao + al] if al else b"")
+                _, sid, seq, credits, sclose, pay, att = f
+                process_stream_frame_fast(sid, seq, credits, sclose,
+                                          pay, att)
                 continue
-            _, cid, ec, et, po, pl, ao, al = f
-            process_response_fast(cid, ec, et, data[po:po + pl],
-                                  data[ao:ao + al] if al else b"", sock)
+            _, cid, ec, et, pay, att = f
+            process_response_fast(cid, ec, et, pay, att, sock)
         if consumed == len(data):
             if frames:
                 sock.__dict__["_fdrain_defer_streak"] = 0

@@ -431,21 +431,17 @@ def make_fast_drain(server):
             return False
         pfd = getattr(sock.conn, "pluck_fd", None)
         if pfd is not None:
-            # dup pins the kernel socket: a concurrent set_failed can
-            # close the conn's fd mid-recv and the OS could reuse the
-            # NUMBER for a new connection (see Socket.pluck_until)
-            try:
-                dfd = os.dup(pfd())
-            except OSError:
+            # the pinned dup (Socket.pin_fd_acquire) pins the kernel
+            # socket against fd-number recycling mid-recv, amortized
+            # over the connection instead of a dup+close per event
+            dfd = sock.pin_fd_acquire()
+            if dfd < 0:
                 return False
             t0 = time.monotonic_ns()
             try:
                 r = sd(dfd, MAGIC, tgt[0], tgt[1], SMALL_FRAME_MAX)
             finally:
-                try:
-                    os.close(dfd)
-                except OSError:
-                    pass
+                sock.pin_fd_release()
             tag = r[0]
             nr = r[-1]            # bytes the C loop read this call
             if nr:

@@ -147,20 +147,24 @@ class EventDispatcher:
                 events = self._selector.select(timeout=0.5)
             except OSError:
                 continue
-            for key, mask in events:
-                if key.data is None:  # wakeup pipe
-                    try:
-                        while self._wakeup_r.recv(4096):
+            # resolve the WHOLE event batch under one lock hold (a
+            # deep wakeup used to pay one acquire/release per ready
+            # fd), then fire callbacks outside the lock in event order
+            fired = []
+            with self._lock:
+                for key, mask in events:
+                    if key.data is None:  # wakeup pipe
+                        try:
+                            while self._wakeup_r.recv(4096):
+                                pass
+                        except (BlockingIOError, OSError):
                             pass
-                    except (BlockingIOError, OSError):
-                        pass
-                    continue
-                fd = key.data
-                on_readable = on_writable = None
-                with self._lock:
+                        continue
+                    fd = key.data
                     h = self._handlers.get(fd)
                     if h is None:
                         continue
+                    on_readable = on_writable = None
                     rearm = False
                     if mask & selectors.EVENT_READ:
                         on_readable = h[0]
@@ -184,14 +188,17 @@ class EventDispatcher:
                                     del self._handlers[fd]
                         except (KeyError, ValueError, OSError):
                             pass
-                for cb in (on_readable, on_writable):
-                    if cb is not None:
-                        try:
-                            cb()
-                        except Exception:
-                            import logging
-                            logging.getLogger("brpc_tpu.transport").exception(
-                                "event callback failed for fd %d", fd)
+                    if on_readable is not None:
+                        fired.append((fd, on_readable))
+                    if on_writable is not None:
+                        fired.append((fd, on_writable))
+            for fd, cb in fired:
+                try:
+                    cb()
+                except Exception:
+                    import logging
+                    logging.getLogger("brpc_tpu.transport").exception(
+                        "event callback failed for fd %d", fd)
 
     def stop(self):
         self._stop = True

@@ -15,10 +15,68 @@ import threading
 from collections import deque
 from typing import Callable, List, Optional
 
+from brpc_tpu.butil.flags import define_flag, flag
 from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.bvar.reducer import Adder, Maxer, PassiveStatus
 from brpc_tpu.fiber import TaskControl, global_control
 from brpc_tpu.protocol.registry import PARSE_OK, PARSE_NOT_ENOUGH_DATA, PARSE_TRY_OTHERS, get_protocols
 from brpc_tpu.transport.socket import Socket
+
+# Run-to-completion budget for a pipelined burst: up to this many
+# messages of one dispatcher wakeup process IN the dispatch context
+# (each still escalates to a fiber the moment it suspends — only the
+# sync leg runs inline), anything past it spills to fibers with ONE
+# amortized parking-lot signal (TaskControl.spawn_many). The budget
+# bounds how long a burst of sync handlers can hold the event thread.
+define_flag("dispatch_inline_budget", 16,
+            "messages of one input burst processed in the dispatch "
+            "context before the rest spill to fibers (single batch "
+            "wake); suspending handlers escalate immediately")
+
+# dispatch batch size: messages the Python dispatch loop settled per
+# dispatcher wakeup cycle (native echo-serve batches are accounted
+# separately via rpc server native batch counters). Windowed avg/peak
+# on /vars + prometheus + the /status saturation pane.
+_batch_msgs = Adder().expose("dispatch_batch_msgs")
+_batch_cycles = Adder().expose("dispatch_batches")
+_batch_peak = Maxer()
+_batch_windows = None
+
+
+def _batch_window_views():
+    """(msgs_per_s, cycles_per_s, peak_window), created on first scrape
+    (a Window registers with the background sampler thread)."""
+    global _batch_windows
+    if _batch_windows is None:
+        from brpc_tpu.bvar.window import PerSecond, Window
+        _batch_windows = (PerSecond(_batch_msgs, 10),
+                          PerSecond(_batch_cycles, 10),
+                          Window(_batch_peak, 10))
+    return _batch_windows
+
+
+def dispatch_batch_avg_10s() -> float:
+    """Windowed mean messages per dispatch cycle (1.0 = no batching)."""
+    msgs, cycles, _ = _batch_window_views()
+    c = cycles.get_value() or 0
+    if not c:
+        return 0.0
+    return round((msgs.get_value() or 0) / c, 2)
+
+
+def dispatch_batch_peak_10s() -> int:
+    _, _, peak = _batch_window_views()
+    return peak.get_value() or 0
+
+
+PassiveStatus(dispatch_batch_avg_10s).expose("dispatch_batch_size_avg_10s")
+PassiveStatus(dispatch_batch_peak_10s).expose("dispatch_batch_size_peak_10s")
+
+
+def record_dispatch_batch(n: int) -> None:
+    _batch_msgs.add(n)
+    _batch_cycles.add(1)
+    _batch_peak.update(n)
 
 
 async def _counted_dispatch(socket, work):
@@ -48,6 +106,40 @@ def counted_spawn(control, socket, work, name: str) -> None:
     with socket.pending_lock:
         socket.pending_responses += 1
     control.spawn(_counted_dispatch(socket, work), name=name)
+
+
+def counted_spawn_many(control, socket, works, name: str) -> None:
+    """Batch twin of counted_spawn: every work's claim lands before any
+    fiber can start, and the whole spill pays ONE parking-lot signal
+    (TaskControl.spawn_many)."""
+    from brpc_tpu.rpc.server_dispatch import _track_pending
+    if not _track_pending(socket):
+        control.spawn_many(works, name=name)
+        return
+    with socket.pending_lock:
+        socket.pending_responses += len(works)
+    control.spawn_many([_counted_dispatch(socket, w) for w in works],
+                       name=name)
+
+
+def counted_run_inline(control, socket, work, name: str) -> None:
+    """Process one queued message IN the dispatch context under its
+    pending claim (run-to-completion: the sync leg runs right here
+    with zero wakes; the first real suspension parks the remainder as
+    a normal fiber). The budgeted middle of a pipelined burst."""
+    from brpc_tpu.rpc.server_dispatch import _track_pending
+    if not _track_pending(socket):
+        control.run_inline(_drive(work), name=name)
+        return
+    with socket.pending_lock:
+        socket.pending_responses += 1
+    control.run_inline(_counted_dispatch(socket, work), name=name)
+
+
+async def _drive(work):
+    r = work() if callable(work) else work
+    if hasattr(r, "__await__"):
+        await r
 
 
 class InputMessenger:
@@ -137,6 +229,7 @@ class InputMessenger:
                 if mid_frame:
                     return None
                 if all_recs:
+                    record_dispatch_batch(len(all_recs))
                     tail = proto.turbo_dispatch(all_recs, socket)
                     if not socket.input_portal:
                         return tail
@@ -155,6 +248,7 @@ class InputMessenger:
             proto = protocols[idx]
             status, msg = proto.parse(socket.input_portal, socket)
             if status == PARSE_OK and not socket.input_portal:
+                record_dispatch_batch(1)
                 if not proto.process_inline(msg, socket):
                     r = proto.process(msg, socket)
                     if r is not None and hasattr(r, "__await__"):
@@ -224,13 +318,37 @@ class InputMessenger:
             break
         if not msgs:
             return None
-        # earlier messages -> fresh fibers; last one processed in place
-        # (queued under a pending_responses claim so the cut-through
-        # gate sees them before their fibers start)
-        for proto, msg in msgs[:-1]:
-            counted_spawn(self._control, socket,
-                          (lambda p=proto, m=msg: p.process(m, socket)),
-                          name=f"process_{proto.name}")
+        record_dispatch_batch(len(msgs))
+        if len(msgs) > 1:
+            # bounded run-to-completion for the burst: RESPONSE
+            # messages (no user handler — pure completion work) process
+            # right here in parse order up to the inline budget, paying
+            # zero wakes; requests and past-budget messages keep the
+            # classic fresh-fiber fan-out (a blocking sync handler must
+            # not serialize the burst), now spilled through ONE
+            # amortized parking-lot signal (spawn_many) instead of a
+            # signal per message.
+            budget = flag("dispatch_inline_budget")
+            inline_run = []
+            spill = []
+            for proto, msg in msgs[:-1]:
+                meta = getattr(msg, "meta", None)
+                if (len(inline_run) < budget and meta is not None
+                        and hasattr(meta, "HasField")
+                        and not meta.HasField("request")):
+                    inline_run.append((proto, msg))
+                else:
+                    spill.append((proto, msg))
+            if spill:
+                counted_spawn_many(
+                    self._control, socket,
+                    [(lambda p=p_, m=m_: p.process(m, socket))
+                     for p_, m_ in spill], name="process_burst")
+            for proto, msg in inline_run:
+                counted_run_inline(
+                    self._control, socket,
+                    (lambda p=proto, m=msg: p.process(m, socket)),
+                    name=f"process_{proto.name}")
         proto, msg = msgs[-1]
         r = proto.process(msg, socket)
         if hasattr(r, "__await__"):

@@ -23,6 +23,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Callable, Optional
 
@@ -41,6 +42,38 @@ define_flag("socket_inline_process", True,
             "until the handler first suspends (process-in-place, "
             "input_messenger.cpp:183); handlers that await park as "
             "normal fibers. Off = always spawn a fiber per busy period")
+
+# Writes at/above this size claim writership through a keep_write fiber
+# instead of sending inline from the submitting context: the kernel
+# copy of a large frame (a sendmsg releases the GIL for its whole
+# duration) then overlaps with whatever the submitter does next — on
+# the event thread that means the NEXT frame's recv runs concurrently
+# with this frame's send, which is the difference between one thread
+# and two threads carrying the 1MB echo pipeline. 0 disables (single-
+# core hosts: there is nothing to overlap with, and the fiber wake is
+# pure cost). Applies only to fd transports (kernel-copy writes).
+define_flag("socket_async_write_min",
+            131072 if (os.cpu_count() or 1) > 1 else 0,
+            "min frame bytes routed to a keep_write fiber instead of "
+            "the inline send (0 = always inline); fd transports only")
+
+# gather-write coalescing bounds: adjacent queued frames merge into one
+# writev/sendmsg batch up to these caps (the iovec cap keeps a batch
+# under IOV_MAX with headroom; the byte cap bounds how much one syscall
+# pins while the queue drains)
+_COALESCE_MAX_FRAMES = 32
+_COALESCE_MAX_BYTES = 1 << 20
+
+
+def _close_pinned(cell) -> None:
+    """Finalizer for a socket's pinned-fd cell (belt and braces: the
+    normal close runs at set_failed once no native loop holds it)."""
+    fd, cell[0] = cell[0], -1
+    if fd is not None and fd >= 0:
+        try:
+            os.close(fd)
+        except OSError:
+            pass
 
 
 class _PyMpsc:
@@ -140,6 +173,9 @@ npluck_defer = Adder().expose("pluck_defers")          # classic fallbacks
 # The windowed peak catches bursts a point sample between drains misses.
 nwqueue_bytes = Adder().expose("socket_wqueue_bytes")
 _wqueue_peak = Maxer()
+# frames that left in a merged gather-write batch beyond the first —
+# each one is a send/sendmsg syscall the coalescer removed
+ncoalesced = Adder().expose("socket_write_coalesced_frames")
 
 
 def _wqueue_peak_window():
@@ -246,6 +282,15 @@ class Socket:
         self._lazy_plucker = None
         self._busy_rearmed = False   # one probe re-arm per busy period
         self._busy_paused = False    # level-trigger: read interest paused
+        # sticky pluck pause: after a sync-pluck settles with nothing in
+        # flight, read interest STAYS paused (the next pluck_preclaim
+        # consumes it for free — per-call epoll_ctl pair removed from
+        # the sync-RPC path). Any non-pluck consumer of the socket
+        # (async issue registration, a direct write, a stream binding)
+        # must unstick first; _submit does. _sticky_since gates the
+        # dead-peer probe (probe_unobserved) to genuinely idle reuse.
+        self._pluck_sticky = False
+        self._sticky_since = 0.0
         self._read_hint = 8192                    # adaptive read-block size
         self.preferred_protocol = -1              # InputMessenger cache
         # protocol hint: total portal bytes needed before the next parse
@@ -277,6 +322,21 @@ class Socket:
         self._writev = getattr(conn, "writev", None)
         self._readv = getattr(conn, "read_into_v", None)
         self._read_chunks = getattr(conn, "read_chunks", None)
+        # async big-write routing applies only to kernel-copy fd conns
+        # (pluck_fd is the "real fd" marker shared with the pluck lane)
+        self._async_write_min = (flag("socket_async_write_min")
+                                 if getattr(conn, "pluck_fd", None)
+                                 is not None else 0)
+        # pinned-fd cache for the native fd loops (pluck_scan /
+        # serve_drain): ONE dup per socket instead of one dup+close
+        # per call/event. Refcounted so set_failed can close it the
+        # moment no native loop holds it (a lingering dup would delay
+        # the FIN a set_failed close is supposed to send).
+        self._pin_lock = threading.Lock()
+        self._pin_cell = [None]      # dup'd fd (None = not yet, -1 = closed)
+        self._pin_refs = 0
+        self._pin_closed = False
+        weakref.finalize(self, _close_pinned, self._pin_cell)
         try:
             self.id: SocketId = _pool().insert(self)
         except RuntimeError:
@@ -291,6 +351,58 @@ class Socket:
                 pass
             raise ConnectionError("socket pool exhausted") from None
         conn.start_events(self._on_readable_event, self._on_writable_event)
+
+    # ---------------------------------------------------------- pinned fd
+    def pin_fd_acquire(self) -> int:
+        """Acquire the cached dup of the conn's fd for a native loop
+        (pluck_scan / serve_drain). The dup pins the kernel socket: a
+        concurrent set_failed closes the conn's own fd while the C
+        loop sits in poll/recv with the GIL released, and the OS could
+        hand that fd NUMBER to a brand-new connection whose bytes the
+        loop would then consume. Returns -1 when unavailable (no fd
+        conn, already closed, dup failed). MUST be balanced by
+        pin_fd_release()."""
+        with self._pin_lock:
+            if self._pin_closed:
+                return -1
+            fd = self._pin_cell[0]
+            if fd is None:
+                pfd = getattr(self.conn, "pluck_fd", None)
+                if pfd is None:
+                    return -1
+                try:
+                    fd = os.dup(pfd())
+                except OSError:
+                    return -1
+                self._pin_cell[0] = fd
+            self._pin_refs += 1
+            return fd
+
+    def pin_fd_release(self) -> None:
+        with self._pin_lock:
+            self._pin_refs -= 1
+            if (self._pin_refs == 0 and self._pin_closed
+                    and self._pin_cell[0] is not None
+                    and self._pin_cell[0] >= 0):
+                fd, self._pin_cell[0] = self._pin_cell[0], -1
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+
+    def _pin_fd_shutdown(self) -> None:
+        """set_failed's half: close the pinned dup as soon as no native
+        loop holds it (the loop in flight sees EOF/reset through its
+        still-open dup and releases; the LAST releaser closes)."""
+        with self._pin_lock:
+            self._pin_closed = True
+            if (self._pin_refs == 0 and self._pin_cell[0] is not None
+                    and self._pin_cell[0] >= 0):
+                fd, self._pin_cell[0] = self._pin_cell[0], -1
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
 
     # ----------------------------------------------------------- identity
     @property
@@ -328,6 +440,10 @@ class Socket:
                 except Exception:
                     pass
             return False
+        if self._pluck_sticky and not self._plucking:
+            # a non-pluck writer is using a sticky-paused socket: the
+            # response/peer data needs live read events again
+            self.unstick_reads()
         nwrites.add(1)
         sz = data.size if isinstance(data, IOBuf) else len(data)
         self.wq_bytes += sz
@@ -335,7 +451,8 @@ class Socket:
         _wqueue_peak.update(self.wq_bytes)
         if not self._wq.push((data, on_done)):
             return True          # the active writer drains it in order
-        if self._inline_write:
+        m = self._async_write_min
+        if self._inline_write and not (m and sz >= m):
             return self._drain_writes_inline()
         self._control.spawn(self._keep_write, name="keep_write")
         return True
@@ -406,6 +523,20 @@ class Socket:
             data, cb = item
             item = None
             err: Optional[BaseException] = None
+            if not self.failed and self._writev is not None:
+                # gather-write coalescing: if more frames already queued
+                # behind this one, merge the run into one bounded
+                # writev batch — one syscall instead of one per frame
+                nxt = self._wq.drain_one()
+                if nxt is not None:
+                    self._wq_acct_pop(nxt)
+                    status = self._write_coalesced(data, cb, nxt)
+                    if status == 0:
+                        continue      # batch fully sent: keep draining
+                    if status == 1:
+                        return ok     # parked on the writable event
+                    ok = False        # batch failed (socket now failed)
+                    continue
             if self.failed:
                 err = self.fail_reason
             else:
@@ -460,6 +591,141 @@ class Socket:
                 self.wq_bytes -= sz
                 nwqueue_bytes.add(-sz)
         return item
+
+    def _write_coalesced(self, data, cb, nxt) -> int:
+        """Send a run of queued frames as ONE gather-write batch:
+        ``data``/``cb`` plus ``nxt`` plus whatever else sits in the
+        queue, up to the coalescing caps. Per-frame callbacks fire as
+        their bytes are fully accepted; a blocked batch parks its
+        remainder (with the unfired callbacks composited) through the
+        same handoff protocol as a single frame. Device-ref-bearing
+        IOBufs keep their semantics: refs merge in FIFO frame order,
+        so the lane-batch pairing (write_device_payload immediately
+        before its wire frame) cannot interleave.
+
+        Returns 0 = batch fully sent (keep draining), 1 = parked on
+        the writable event (writership parked), 2 = failed (socket is
+        now failed; every callback fired with the reason)."""
+        agg = IOBuf()
+        marks = []                    # (end_offset, cb) per frame
+        total = 0
+
+        def add(d, c):
+            nonlocal total
+            if isinstance(d, IOBuf):
+                agg.append_buf(d)
+                total += d.size
+            elif len(d):
+                agg.append_user_data(d)
+                total += len(d)
+            marks.append((total, c))
+
+        add(data, cb)
+        add(nxt[0], nxt[1])
+        while total < _COALESCE_MAX_BYTES and len(marks) < _COALESCE_MAX_FRAMES:
+            more = self._wq.drain_one()
+            if more is None:
+                break
+            self._wq_acct_pop(more)
+            add(more[0], more[1])
+        ncoalesced.add(len(marks) - 1)
+        try:
+            self._cut_buf(agg)        # gather writev; absorbs EAGAIN
+        except (BrokenPipeError, ConnectionError, OSError) as e:
+            self.set_failed(e)
+            for _, c in marks:
+                if c is not None:
+                    try:
+                        c(e)
+                    except Exception:
+                        pass
+            return 2
+        sent = total - agg.size
+        pending_cbs = []
+        for end, c in marks:
+            if end <= sent:
+                if c is not None:
+                    try:
+                        c(None)
+                    except Exception:
+                        pass
+            elif c is not None:
+                pending_cbs.append(c)
+        if not agg:
+            return 0
+        # blocked mid-batch: park the remainder with the unfired
+        # callbacks composited into one done (same protocol as the
+        # single-frame park in _drain_writes_inline)
+        if pending_cbs:
+            def comp(err, _cbs=pending_cbs):
+                for c in _cbs:
+                    try:
+                        c(err)
+                    except Exception:
+                        pass
+        else:
+            comp = None
+        lsz = agg.size
+        with self._handoff_lock:
+            self._handoff = (agg, comp)
+            self.wq_bytes += lsz
+            nwqueue_bytes.add(lsz)
+        try:
+            self.conn.request_writable_event()
+        except Exception as e:
+            took = self._take_handoff()
+            self.set_failed(e if isinstance(e, Exception)
+                            else ConnectionError(str(e)))
+            if took is not None and took[1] is not None:
+                try:
+                    took[1](self.fail_reason)
+                except Exception:
+                    pass
+            return 2
+        return 1
+
+    def probe_unobserved(self) -> bool:
+        """True when this socket is (now) failed. A sticky pluck pause
+        leaves NOTHING watching the fd between sync calls, so a peer
+        FIN lands unseen — callers about to REUSE a socket (channel
+        single/pooled pick) probe here: one non-consuming MSG_PEEK
+        (only when the socket is actually in the unobserved state)
+        restores the dead-peer detection the dispatcher's read event
+        used to provide, BEFORE a call is issued into the corpse."""
+        if self.failed:
+            return True
+        if not self._pluck_sticky:
+            return False          # reads armed: the dispatcher watches
+        if time.monotonic() - self._sticky_since < 0.005:
+            # back-to-back sync calls: skip the probe syscall — a peer
+            # close in a <5ms window still surfaces through the pluck
+            # read itself, this probe exists for IDLE reuse
+            return False
+        peek = getattr(self.conn, "peek_closed", None)
+        if peek is not None:
+            try:
+                if peek():
+                    self.set_failed(ConnectionResetError("peer closed"))
+                    return True
+            except Exception:
+                pass
+        return False
+
+    def unstick_reads(self) -> None:
+        """Re-arm read interest left sticky-paused by a settled pluck
+        (see _pluck_sticky). Idempotent; never touches a socket whose
+        pause is owned by a live plucker or busy period."""
+        with self._nevent_lock:
+            if not self._pluck_sticky:
+                return
+            self._pluck_sticky = False
+            if self._busy_paused and not self._plucking:
+                self._busy_paused = False
+                if not self.failed:
+                    try:
+                        self.conn.resume_read_events()
+                    except Exception:
+                        pass
 
     def write_device_payload(self, arrays) -> bool:
         """Out-of-band device lane (mem/tpu transports); host transports
@@ -637,6 +903,9 @@ class Socket:
             if self._nevent > 0:
                 return True
             self._busy_rearmed = False   # busy period over
+            self._pluck_sticky = False   # a live busy period owns the
+            #                              pause again: never leave the
+            #                              flag claiming otherwise
             if self._busy_paused and not self._plucking:
                 # paired with the pause in _on_readable_event: both run
                 # under the lock so the paused flag always matches the
@@ -698,6 +967,10 @@ class Socket:
             if self._nevent > 0 or self._plucking:
                 return False
             self._plucking = True
+            # a sticky pause from the previous settle is consumed here:
+            # read interest is already off, so the claim pays NO
+            # epoll_ctl (the steady sync-RPC state)
+            self._pluck_sticky = False
             if self._level_triggered and not self._busy_paused:
                 self._busy_paused = True
                 try:
@@ -714,18 +987,34 @@ class Socket:
         so they can never disagree; deferred events we didn't settle
         get one normal pass (its finish cycle restores read interest
         and balances the _nevent accounting)."""
-        with self._nevent_lock:
-            if not self._plucking:
-                return
-            self._plucking = False
-            leftover = self._nevent > 0
-            if self._busy_paused and not leftover:
-                self._busy_paused = False
-                if not self.failed:
-                    try:
-                        self.conn.resume_read_events()
-                    except Exception:
-                        pass
+        with self.pending_lock:
+            # pending_lock FIRST (established order: pending -> nevent):
+            # the sticky decision below reads client_inflight, and it
+            # must serialize against _set_issue_socket registrations —
+            # either the registration lands first (we see it and
+            # resume) or we stick first (the issuer's write sees the
+            # sticky flag and unsticks). No window hangs a response.
+            with self._nevent_lock:
+                if not self._plucking:
+                    return
+                self._plucking = False
+                leftover = self._nevent > 0
+                if self._busy_paused and not leftover:
+                    if (not self.failed and self.client_inflight == 0
+                            and not self.user_data.get("bound_streams")):
+                        # sticky pause: nothing in flight can produce
+                        # input — leave reads off so the next sync call
+                        # claims the lane for free (unstick_reads is
+                        # every non-pluck consumer's entry)
+                        self._pluck_sticky = True
+                        self._sticky_since = time.monotonic()
+                    else:
+                        self._busy_paused = False
+                        if not self.failed:
+                            try:
+                                self.conn.resume_read_events()
+                            except Exception:
+                                pass
         if leftover and not self.failed:
             self._process_input_entry()
 
@@ -774,16 +1063,12 @@ class Socket:
             fc = _fastcore()
             scan = getattr(fc, "pluck_scan", None) if fc is not None else None
             if scan is not None:
-                # pin the kernel socket for the native loop: a concurrent
-                # set_failed closes the conn's fd while the C call sits
-                # in poll/recv with the GIL released, and the OS could
-                # hand the fd NUMBER to a brand-new connection — whose
-                # bytes the loop would then consume. The dup holds this
-                # socket open for the loop's duration; after a close the
-                # loop sees clean EOF/reset, never a foreign stream.
-                try:
-                    dup_fd = os.dup(fd)
-                except OSError:
+                # pinned fd: the refcounted cached dup (pin_fd_acquire)
+                # pins the kernel socket for the loop's duration — same
+                # fd-recycling protection as a per-call dup, without
+                # the dup+close syscall pair on every sync RPC
+                dup_fd = self.pin_fd_acquire()
+                if dup_fd < 0:
                     scan = None
         poller = None
         escalated = False
@@ -859,10 +1144,7 @@ class Socket:
                     break
         finally:
             if dup_fd >= 0:
-                try:
-                    os.close(dup_fd)
-                except OSError:
-                    pass
+                self.pin_fd_release()
             if carry:
                 # a partial frame read by the native loop: back into the
                 # portal — more bytes must arrive for it to complete, and
@@ -1029,6 +1311,10 @@ class Socket:
             self.conn.close()
         except Exception:
             pass
+        # the pinned dup (native fd loops) must not outlive the close —
+        # it would silently delay the FIN; closed now or by the last
+        # pin_fd_release still in flight
+        self._pin_fd_shutdown()
         self._writable_butex.fetch_add(1)
         self._writable_butex.wake_all()
         # a writer parked on a writable event will never be woken by the

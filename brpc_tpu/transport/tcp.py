@@ -37,9 +37,12 @@ class TcpConn(Conn):
         try:
             # bulk-transfer buffers: default rmem/wmem mean ~64-128KB per
             # recv wakeup on a 1MB payload — each extra chunk costs a
-            # syscall plus block bookkeeping on the drain path
-            sock.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_RCVBUF, 1 << 20)
-            sock.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_SNDBUF, 1 << 20)
+            # syscall plus block bookkeeping on the drain path. 2MB (two
+            # 1MB frames in flight per direction) keeps the pipe full
+            # across a writable-event wake gap; 4MB measured no better
+            # and grows the cache working set
+            sock.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_RCVBUF, 2 << 20)
+            sock.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_SNDBUF, 2 << 20)
         except OSError:
             pass
         self._sock = sock

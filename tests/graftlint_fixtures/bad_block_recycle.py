@@ -1,0 +1,22 @@
+"""Fixture: views into pooled blocks used after the recycle point."""
+
+
+def use_after_pop(portal):
+    win = portal.first_host_view()
+    portal.pop_front(12)           # recycle point: blocks may be reused
+    return bytes(win[:12])         # BAD: stale view read
+
+
+def derived_slice_after_cut(portal, n):
+    win = portal.first_host_view()
+    head = win[:n]                 # a slice of a view is still a view
+    portal.cut(n)                  # recycle point
+    return bytes(head)             # BAD: derived view read
+
+
+def consume_in_loop(portal, sizes):
+    win = portal.first_host_view()
+    for n in sizes:
+        payload = bytes(win[:n])   # BAD on pass 2: pop happened below
+        portal.pop_front(n)
+    return payload

@@ -1,0 +1,26 @@
+"""Fixture: the slice-then-pop discipline (and healing rebinds)."""
+
+
+def slice_then_pop(portal):
+    win = portal.first_host_view()
+    out = bytes(win[:12])          # copied out BEFORE the recycle point
+    portal.pop_front(12)
+    return out
+
+
+def rebind_heals(portal):
+    win = portal.first_host_view()
+    first = bytes(win[:4])
+    portal.pop_front(4)
+    win = portal.first_host_view()  # fresh view after the pop: fine
+    return first + bytes(win[:4])
+
+
+def disjoint_branches(portal, fast):
+    win = portal.first_host_view()
+    if fast:
+        out = bytes(win[:8])
+    else:
+        portal.pop_front(8)        # consume only on this branch
+        out = b""
+    return out

@@ -210,6 +210,51 @@ class TestSpanFinish:
         assert list(SpanFinishRule().check(sf_ok, Context([sf_ok]))) == []
 
 
+class TestBlockRecycle:
+    def test_seeded_violations(self):
+        active, _ = _lint("bad_block_recycle.py")
+        assert [f.rule for f in active] == ["block-recycle"] * 3, \
+            [f.format() for f in active]
+        msgs = " | ".join(f.message for f in active)
+        assert "pooled blocks" in msgs and "recycled" in msgs
+        # the loop-carried case: a pop late in iteration N stales the
+        # window read at the top of iteration N+1
+        src = open(os.path.join(
+            FIXTURES, "bad_block_recycle.py")).read().splitlines()
+        assert any("BAD on pass 2" in src[f.line - 1] for f in active), \
+            [f.format() for f in active]
+
+    def test_good_fixture_zero_false_positives(self):
+        active, waived = _lint("good_block_recycle.py")
+        assert active == [] and waived == [], \
+            [f.format() for f in active + waived]
+
+    def test_mutation_pop_before_scan_fires_on_real_pool_code(self):
+        """Mutation pin on the REAL scan lane: reorder turbo_scan's
+        portal.pop_front(consumed) to before the native scan reads the
+        window — the rule must fire, so the slice-then-pop discipline
+        that keeps pooled blocks safe to recycle cannot be silently
+        reordered away."""
+        from brpc_tpu.analysis.core import Context, SourceFile
+        from brpc_tpu.analysis.rules.block_recycle import BlockRecycleRule
+        path = os.path.join(REPO_ROOT, "brpc_tpu", "protocol",
+                            "tpu_std.py")
+        src = open(path).read()
+        scan_line = "        consumed, recs = scan(win, MAGIC, SMALL_FRAME_MAX, 128,\n"
+        pop_line = "        portal.pop_front(consumed)\n"
+        assert scan_line in src and pop_line in src
+        mutated = src.replace(pop_line, "").replace(
+            scan_line, "        portal.pop_front(12)\n" + scan_line)
+        sf = SourceFile(path, "brpc_tpu/protocol/tpu_std.py", mutated)
+        found = list(BlockRecycleRule().check(sf, Context([sf])))
+        assert any(f.rule == "block-recycle" and "'win'" in f.message
+                   for f in found), [f.format() for f in found]
+        # and the unmutated file stays clean
+        sf_ok = SourceFile(path, "brpc_tpu/protocol/tpu_std.py", src)
+        assert list(BlockRecycleRule().check(sf_ok, Context([sf_ok]))) \
+            == []
+
+
 class TestCleanFixture:
     def test_zero_false_positives(self):
         active, waived = _lint("clean.py")

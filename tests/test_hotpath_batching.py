@@ -1,0 +1,495 @@
+"""Hot-path batching tests (ISSUE 4): the batched native frame scan
+must be bit-equivalent to the one-frame-at-a-time classic parse on
+chaos-mangled streams, pooled blocks must survive a corrupt+flap storm
+with zero leaks and no poisoned reads, and the zero-copy small-buf
+fast paths must actually be zero-copy.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+from brpc_tpu.butil.iobuf import IOBuf, IOPortal, pool
+from brpc_tpu.chaos.plan import Fault, FaultPlan
+from brpc_tpu.native import fastcore
+from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
+from brpc_tpu.protocol.registry import PARSE_OK
+from brpc_tpu.protocol.tpu_std import (_HDR, HEADER_SIZE, MAGIC,
+                                       SMALL_FRAME_MAX, TpuStdProtocol)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------- frame corpus
+def _frame(meta: pb.RpcMeta, payload: bytes = b"", att: bytes = b"") -> bytes:
+    if att:
+        meta.attachment_size = len(att)
+    mb = meta.SerializeToString()
+    return _HDR.pack(MAGIC, len(mb) + len(payload) + len(att),
+                     len(mb)) + mb + payload + att
+
+
+def _request(cid, svc="EchoService", mth="Echo", payload=b"req",
+             att=b"", log_id=0, timeout_ms=0):
+    m = pb.RpcMeta()
+    m.request.service_name = svc
+    m.request.method_name = mth
+    if log_id:
+        m.request.log_id = log_id
+    if timeout_ms:
+        m.request.timeout_ms = timeout_ms
+    m.correlation_id = cid
+    return _frame(m, payload, att)
+
+
+def _response(cid, payload=b"resp", att=b"", error_code=0, error_text=""):
+    m = pb.RpcMeta()
+    m.correlation_id = cid
+    if error_code:
+        m.response.error_code = error_code
+        m.response.error_text = error_text
+    return _frame(m, payload, att)
+
+
+def _stream_frame(sid, seq=0, credits=0, close=False, payload=b"data"):
+    m = pb.RpcMeta()
+    m.stream_settings.stream_id = sid
+    if seq:
+        m.stream_settings.frame_seq = seq
+    if credits:
+        m.stream_settings.credits = credits
+    if close:
+        m.stream_settings.close = True
+    return _frame(m, payload)
+
+
+def _traced_request(cid):                 # slow-path: scan must defer
+    m = pb.RpcMeta()
+    m.request.service_name = "S"
+    m.request.method_name = "M"
+    m.correlation_id = cid
+    m.trace_id = 0xABCDEF
+    m.span_id = 7
+    return _frame(m, b"traced")
+
+
+def _corpus(rng: random.Random) -> bytes:
+    """A seeded stream mixing fast, slow, and big frames."""
+    frames = []
+    for i in range(rng.randrange(3, 12)):
+        pick = rng.random()
+        cid = rng.randrange(1, 1 << 20)
+        if pick < 0.35:
+            frames.append(_response(cid, payload=bytes(
+                rng.randrange(256) for _ in range(rng.randrange(0, 40))),
+                att=b"a" * rng.randrange(0, 9)))
+        elif pick < 0.55:
+            frames.append(_request(cid, payload=b"x" * rng.randrange(0, 64)))
+        elif pick < 0.65:
+            frames.append(_response(cid, payload=b"",
+                                    error_code=rng.randrange(1, 3000),
+                                    error_text="boom"))
+        elif pick < 0.75:
+            frames.append(_stream_frame(rng.randrange(1, 99),
+                                        seq=rng.randrange(0, 5),
+                                        credits=rng.randrange(0, 100),
+                                        close=rng.random() < 0.3))
+        elif pick < 0.85:
+            frames.append(_traced_request(cid))          # defer: trace id
+        elif pick < 0.93:
+            frames.append(_request(cid, timeout_ms=50))  # defer: deadline
+        else:
+            frames.append(_response(cid,                 # big: classic
+                                    payload=b"B" * (SMALL_FRAME_MAX + 7)))
+    return b"".join(frames)
+
+
+# ----------------------------------------------------- classic reference
+class _StubSocket:
+    def __init__(self):
+        self.input_need = 0
+        self.failed = False
+        self.fail_reason = None
+        self.user_data = {}
+
+    def set_failed(self, e):
+        self.failed = True
+        self.fail_reason = e
+
+    def take_device_payload(self):
+        return None
+
+
+def _classic_parse_all(data: bytes):
+    """One-frame-at-a-time reference: (messages, per-frame sizes,
+    socket) — exactly what the classic lane would deliver."""
+    proto = TpuStdProtocol()
+    portal = IOPortal()
+    portal.append_user_data(data)
+    sock = _StubSocket()
+    msgs, sizes = [], []
+    while portal and not sock.failed:
+        before = portal.size
+        sock.input_need = 0
+        try:
+            st, m = proto.parse(portal, sock)
+        except Exception as e:
+            # the real lane routes an escaping parse error to
+            # Socket._input_error (connection dropped): the stream
+            # definitively ends here for the classic lane too
+            sock.set_failed(e)
+            break
+        if st != PARSE_OK:
+            break
+        msgs.append(m)
+        sizes.append(before - portal.size)
+    return msgs, sizes, sock
+
+
+def _assert_rec_matches(rec, msg) -> None:
+    meta = msg.meta
+    if rec[0] == 0:
+        _, cid, svc, mth, log_id, pay, att = rec
+        assert meta.HasField("request")
+        assert cid == meta.correlation_id
+        assert svc == meta.request.service_name
+        assert mth == meta.request.method_name
+        assert log_id == meta.request.log_id
+        assert meta.request.timeout_ms == 0     # deadline frames defer
+    elif rec[0] == 1:
+        _, cid, ec, et, pay, att = rec
+        assert not meta.HasField("request")
+        assert cid == meta.correlation_id
+        assert ec == (meta.response.error_code
+                      if meta.HasField("response") else 0)
+        if et is not None:
+            assert et == meta.response.error_text
+    else:
+        _, sid, seq, credits, close, pay, att = rec
+        ss = meta.stream_settings
+        assert meta.HasField("stream_settings")
+        assert (sid, seq, credits, bool(close)) == \
+            (ss.stream_id, ss.frame_seq, ss.credits, ss.close)
+    assert pay == msg.payload.to_bytes()
+    assert att == msg.attachment.to_bytes()
+
+
+def _scan_fn():
+    fc = fastcore.get()
+    scan = getattr(fc, "scan_frames", None) if fc is not None else None
+    if scan is None:
+        pytest.skip("fastcore extension unavailable")
+    return scan
+
+
+class TestBatchedScanDifferential:
+    """scan_frames (the batched native lane, materialize mode) against
+    the classic parser, frame by frame, on seeded chaos streams —
+    judge-or-defer means every record the batch emits must be EXACTLY
+    what the classic lane would have parsed, and everything deferred
+    must still reach the classic lane intact."""
+
+    def test_clean_streams(self):
+        scan = _scan_fn()
+        for seed in range(25):
+            data = _corpus(random.Random(seed))
+            msgs, sizes, _ = _classic_parse_all(data)
+            consumed, recs = scan(data, MAGIC, SMALL_FRAME_MAX, 128, 0, 1)
+            assert len(recs) <= len(msgs)
+            for rec, msg in zip(recs, msgs):
+                _assert_rec_matches(rec, msg)
+            assert consumed == sum(sizes[:len(recs)])
+            # deferred tail: the classic lane parses it identically
+            # from the stop offset (nothing was half-consumed)
+            tail_msgs, _, _ = _classic_parse_all(data[consumed:])
+            assert len(tail_msgs) == len(msgs) - len(recs)
+
+    def test_chaos_corrupted_streams(self):
+        """Seeded FaultPlan corruption: flip bytes at scripted offsets
+        (the chaos lane's ``corrupt`` primitive applied at the byte
+        level) — the batch may judge fewer frames, never different
+        ones."""
+        scan = _scan_fn()
+        for seed in range(40):
+            rng = random.Random(1000 + seed)
+            data = bytearray(_corpus(rng))
+            plan = FaultPlan.random(seed, ["mem://diff"], conns=4,
+                                    fault_rate=1.0, kinds=("corrupt",))
+            for by_idx in plan._scripts.values():
+                for faults in by_idx.values():
+                    for f in faults:
+                        if f.kind == "corrupt" and f.at_byte < len(data):
+                            data[f.at_byte] ^= (f.xor_mask or 0xFF)
+            data = bytes(data)
+            msgs, sizes, sock = _classic_parse_all(data)
+            consumed, recs = scan(data, MAGIC, SMALL_FRAME_MAX, 128, 0, 1)
+            assert len(recs) <= len(msgs), \
+                f"seed {seed}: scan judged a frame the classic lane " \
+                f"did not parse"
+            for rec, msg in zip(recs, msgs):
+                _assert_rec_matches(rec, msg)
+            assert consumed == sum(sizes[:len(recs)])
+
+    def test_partial_stall_truncation(self):
+        """partial_stall at a scripted offset: the stream ends mid-
+        frame — the batch must stop cleanly at the last complete
+        frame, equal to the classic lane's stop."""
+        scan = _scan_fn()
+        for seed in range(25):
+            rng = random.Random(2000 + seed)
+            data = _corpus(rng)
+            stall = Fault("partial_stall",
+                          at_byte=rng.randrange(1, len(data)))
+            data = data[:stall.at_byte]
+            msgs, sizes, _ = _classic_parse_all(data)
+            consumed, recs = scan(data, MAGIC, SMALL_FRAME_MAX, 128, 0, 1)
+            assert len(recs) <= len(msgs)
+            for rec, msg in zip(recs, msgs):
+                _assert_rec_matches(rec, msg)
+            assert consumed == sum(sizes[:len(recs)])
+
+    def test_split_boundary_streams(self):
+        """The input-loop shape: the stream arrives in seeded chunks
+        (each its own block, like a chunk-handoff transport), the scan
+        lane drains window by window with the classic lane judging
+        every deferred remainder — total delivery must equal the
+        classic lane alone."""
+        scan = _scan_fn()
+        for seed in range(25):
+            rng = random.Random(3000 + seed)
+            data = _corpus(rng)
+            ref_msgs, _, _ = _classic_parse_all(data)
+
+            portal = IOPortal()
+            pos = 0
+            while pos < len(data):            # seeded split boundaries
+                cut = min(len(data), pos + rng.randrange(1, 97))
+                portal.append_user_data(data[pos:cut])
+                pos = cut
+            got = 0
+            while portal:
+                win = portal.first_host_view()
+                if win is not None and len(win) >= HEADER_SIZE:
+                    consumed, recs = scan(win, MAGIC, SMALL_FRAME_MAX,
+                                          128, 0, 1)
+                    if recs:
+                        for rec in recs:
+                            _assert_rec_matches(rec, ref_msgs[got])
+                            got += 1
+                        portal.pop_front(consumed)
+                        continue
+                # deferred / boundary-straddling: one classic frame
+                proto = TpuStdProtocol()
+                sock = _StubSocket()
+                st, m = proto.parse(portal, sock)
+                if st != PARSE_OK:
+                    break
+                _assert_same_message(m, ref_msgs[got])
+                got += 1
+            assert got == len(ref_msgs)
+
+
+def _assert_same_message(a, b) -> None:
+    assert a.meta.SerializeToString() == b.meta.SerializeToString()
+    assert a.payload.to_bytes() == b.payload.to_bytes()
+    assert a.attachment.to_bytes() == b.attachment.to_bytes()
+
+
+# ------------------------------------------------- pooled block stress
+_STRESS_SRC = r"""
+import gc, json, os, sys, time
+sys.path.insert(0, %(repo)r)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["BRPC_TPU_IOBUF_DEBUG"] = "1"     # poison + exact accounting
+
+from brpc_tpu.butil.iobuf import pool
+from brpc_tpu import chaos
+from brpc_tpu.chaos.plan import FaultPlan
+from brpc_tpu.rpc import Channel, ChannelOptions, Server, ServerOptions, Service
+
+ep_name = "tcp://127.0.0.1:0"
+server = Server(ServerOptions(enable_builtin_services=False))
+svc = Service("Bench")
+
+@svc.method(native="echo")
+async def Echo(cntl, request):
+    if cntl.request_attachment.size:
+        cntl.response_attachment = cntl.request_attachment
+    return request
+
+server.add_service(svc)
+
+# corrupt + flap storm, installed BEFORE start so accept conns wrap too
+plan = FaultPlan.random(int(sys.argv[1]), [ep_name], conns=24,
+                        fault_rate=0.6, kinds=("corrupt",))
+plan.flap(ep_name, at_conn=3, refuse_next=2)
+chaos.install(plan)
+ep = server.start(ep_name)
+
+poisoned = 0
+failures = 0
+ok = 0
+payload = b"\x5a" * 20000                     # multi-block attachment
+for i in range(120):
+    ch = Channel(str(ep), ChannelOptions(timeout_ms=400, max_retry=1,
+                                         share_connections=False))
+    try:
+        from brpc_tpu.butil.iobuf import IOBuf
+        from brpc_tpu.rpc import Controller
+        cntl = Controller()
+        att = IOBuf(); att.append(payload)
+        cntl.request_attachment = att
+        cl = ch.call_sync("Bench", "Echo", b"ping", cntl=cntl)
+        if cl.failed():
+            failures += 1
+        else:
+            got = cl.response_attachment.to_bytes()
+            if got != payload:
+                poisoned += 1                 # corrupted OR poisoned read
+            ok += 1
+    except RuntimeError as e:
+        if "poisoned" in str(e):
+            poisoned += 1
+            break
+        failures += 1
+    finally:
+        ch.close()
+chaos.uninstall()
+server.stop(); server.join(2)
+
+# every pooled buffer must come home once nothing references it
+deadline = time.monotonic() + 5.0
+out = -1
+while time.monotonic() < deadline:
+    gc.collect()
+    out = pool.outstanding
+    if out == 0:
+        break
+    time.sleep(0.1)
+print(json.dumps({"outstanding": out, "ok": ok, "failures": failures,
+                  "poisoned": poisoned, "hits": pool.hits,
+                  "recycled": pool.recycled}))
+os._exit(0)
+"""
+
+
+@pytest.mark.parametrize("seed", [11, 47])
+def test_pooled_block_stress_under_chaos(seed):
+    """corrupt+flap storm with debug poisoning ON: zero leaked pooled
+    blocks afterwards (exact outstanding accounting) and no poisoned
+    bytes ever reached a successful response."""
+    proc = subprocess.run(
+        [sys.executable, "-c", _STRESS_SRC % {"repo": REPO_ROOT},
+         str(seed)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["outstanding"] == 0, report   # zero leaked blocks
+    assert report["poisoned"] == 0, report      # no poisoned reads
+    assert report["ok"] > 0, report             # the storm still served
+
+
+# ------------------------------------------- sticky pause vs dead peers
+def test_sticky_paused_socket_detects_peer_close_before_reuse():
+    """The sticky pluck pause leaves nothing watching an idle sync
+    socket's fd — a peer close must still be detected BEFORE the next
+    call issues into the corpse (probe_unobserved at socket pick), so
+    even a max_retry=0 channel survives a server-side idle close."""
+    from brpc_tpu.rpc import (Channel, ChannelOptions, Server,
+                              ServerOptions, Service)
+    server = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("Probe")
+
+    @svc.method()
+    def Echo(cntl, request):
+        return request
+
+    server.add_service(svc)
+    ep = server.start("tcp://127.0.0.1:0")
+    ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                 ChannelOptions(timeout_ms=3000, max_retry=0))
+    try:
+        assert not ch.call_sync("Probe", "Echo", b"a").failed()
+        s0 = ch._get_socket()
+        # the server closes every accepted connection under the idle
+        # (sticky-paused) client
+        for s in list(server.connections()):
+            s.set_failed(ConnectionError("server idle close"))
+        # wait until the FIN is observable on the client conn (a
+        # non-consuming probe that does NOT mark the socket failed)
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline and not s0.conn.peek_closed():
+            time.sleep(0.02)
+        assert s0.conn.peek_closed()
+        time.sleep(0.02)   # past the probe's 5ms back-to-back gate
+        # the VERY NEXT call must succeed with zero retries: the pick
+        # probes the (idle) unobserved socket, fails it, and dials fresh
+        cl = ch.call_sync("Probe", "Echo", b"b")
+        assert not cl.failed(), cl.error_text
+        assert s0.failed                 # the corpse was detected
+    finally:
+        ch.close()
+        server.stop()
+        server.join(2)
+
+
+# ------------------------------------------------ zero-copy micro-bench
+class TestZeroCopySmallBufFastPath:
+    def test_single_block_identity(self):
+        data = b"z" * 20000                  # >= _APPEND_ZEROCOPY_MIN
+        buf = IOBuf()
+        buf.append(data)
+        assert buf.backing_block_count == 1
+        # the zero-copy proof: the SAME object comes back, no copy
+        assert buf.to_bytes() is data
+        assert buf.peek_bytes(len(data)) is data
+        v = buf.first_host_view()
+        assert v is not None and v.obj is data and v.nbytes == len(data)
+
+    def test_user_data_identity(self):
+        data = b"u" * 64
+        buf = IOBuf()
+        buf.append_user_data(data)
+        assert buf.to_bytes() is data
+        assert buf.peek_bytes(64) is data
+
+    def test_peek_shorter_than_block_still_correct(self):
+        data = b"0123456789" * 10
+        buf = IOBuf()
+        buf.append_user_data(data)
+        assert buf.peek_bytes(7) == data[:7]
+        buf2 = IOBuf()
+        buf2.append(b"abc")                  # bytearray-backed block
+        assert buf2.peek_bytes(2) == b"ab"
+        assert buf2.to_bytes() == b"abc"
+
+    def test_micro_bench_o1_regardless_of_size(self):
+        """1000 single-block to_bytes/peek_bytes of an 8MB buffer: a
+        copying implementation moves ~8GB and takes seconds; the
+        zero-copy path is O(1) and finishes orders of magnitude under
+        the bound."""
+        big = b"y" * (8 << 20)
+        buf = IOBuf()
+        buf.append(big)
+        t0 = time.perf_counter()
+        for _ in range(1000):
+            assert buf.to_bytes() is big
+            assert buf.peek_bytes(len(big)) is big
+        assert time.perf_counter() - t0 < 0.5
+
+    def test_mutating_sliced_refs_still_copy(self):
+        data = b"q" * 20000
+        buf = IOBuf()
+        buf.append(data)
+        head = buf.cut(10)                   # partial ref: must copy
+        assert head.to_bytes() == data[:10]
+        assert buf.to_bytes() == data[10:]
+        gc.collect()

@@ -2,7 +2,7 @@ import numpy as np
 import pytest
 
 from brpc_tpu.butil.iobuf import (
-    DEFAULT_BLOCK_SIZE, Block, DeviceBlock, IOBuf, IOPortal, _free_blocks,
+    DEFAULT_BLOCK_SIZE, Block, DeviceBlock, IOBuf, IOPortal, pool,
 )
 
 
@@ -149,17 +149,23 @@ def test_ioportal_append_from_reader():
     assert portal.to_bytes() == b"streamed-data" * 100
 
 
+@pytest.mark.skipif(not pool.enabled,
+                    reason="BRPC_TPU_IOBUF_POOL=0: recycling disabled")
 def test_block_recycling_returns_buffer_to_free_list():
-    # process-global freelist: blocks freed on ANY thread are reusable
+    # process-global pool: blocks freed on ANY thread are reusable
     # by every other (the cross-thread server read/free pattern)
     import gc
-    _free_blocks.clear()
+    free = pool.classes[DEFAULT_BLOCK_SIZE]
+    pool.clear()
+    gen0 = pool.generation
     buf = IOBuf()
     buf.append(b"q" * DEFAULT_BLOCK_SIZE)
     del buf
     gc.collect()
-    assert len(_free_blocks) == 1
-    # a fresh block reuses the cached bytearray
-    reused = _free_blocks[0]
+    assert len(free) == 1
+    assert pool.generation > gen0        # recycle bumped the generation
+    # a fresh block reuses the cached bytearray and carries its tag
+    reused, tag = free[0]
     blk = Block()
     assert blk.data is reused
+    assert blk.gen == tag                # generation tag rides the reuse

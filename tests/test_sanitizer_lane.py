@@ -27,9 +27,13 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 SAN = ("address", "undefined")
 
-# the differential fuzz surface the ISSUE pins to this lane
+# the differential fuzz surface the ISSUE pins to this lane (the
+# hotpath class covers scan_frames' materialize mode — the batched
+# scan's payload/attachment slicing runs in C and must fuzz
+# instrumented)
 FUZZ_TARGETS = ["tests/test_decoder_fuzz.py", "tests/test_protocol_fuzz.py",
-                "tests/test_native.py"]
+                "tests/test_native.py",
+                "tests/test_hotpath_batching.py::TestBatchedScanDifferential"]
 # engagement/wiring assertions that are timing-sensitive under the
 # sanitizers' ~2-10x slowdown (burst accumulation); they are perf-path
 # wiring checks, not memory-safety differentials — tier-1 covers them

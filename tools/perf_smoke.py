@@ -1,0 +1,149 @@
+"""Fast hot-path perf smoke (tools/preflight.py --gate's perf lane).
+
+Measures the two headline shapes of ISSUE 4's overhaul in a few
+seconds, each NORMALIZED against a raw-socket calibration measured in
+the same run on the same box — ratios transfer across machines where
+absolute QPS/GB/s do not (the r05 harness ran small RPCs at 77us p50;
+sandboxes run the same code at 400us because their syscalls cost 5x):
+
+  qps_ratio   sequential sync 4B RPC qps / raw two-process TCP
+              ping-pong qps (the per-call overhead the pluck lane,
+              sticky pause and pinned fd are accountable for)
+  mb_eff      pooled 1MB echo GB/s / raw boundary-less stream-echo
+              GB/s (bench.py's efficiency_vs_stream_raw shape, short)
+
+Prints ONE JSON line. Floors are enforced by the gate, not here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+BASE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, BASE)
+sys.path.insert(0, os.path.join(BASE, "tools"))
+
+_RAW_PING_SRC = r"""
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0)); s.listen(1)
+print(f"PORT {s.getsockname()[1]}", flush=True)
+c, _ = s.accept()
+c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+while True:
+    d = c.recv(4096)
+    if not d: break
+    c.sendall(d)
+"""
+
+
+def measure_raw_ping(n: int = 600) -> float:
+    """Raw two-process loopback ping-pong qps (the machine's sync-RPC
+    floor: two syscalls + one cross-process wake per direction)."""
+    import socket as pysock
+    proc = subprocess.Popen([sys.executable, "-c", _RAW_PING_SRC],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL)
+    try:
+        port = int(proc.stdout.readline().split()[1])
+        c = pysock.create_connection(("127.0.0.1", port))
+        c.setsockopt(pysock.IPPROTO_TCP, pysock.TCP_NODELAY, 1)
+        c.settimeout(10.0)
+        for _ in range(50):
+            c.sendall(b"warm")
+            c.recv(4096)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            c.sendall(b"ping")
+            c.recv(4096)
+        dt = time.perf_counter() - t0
+        c.close()
+        return n / dt
+    finally:
+        proc.terminate()
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import bench  # raw stream calibration lives there
+    from spawn_util import spawn_port_server
+
+    out = {}
+    out["raw_ping_qps"] = round(measure_raw_ping(), 1)
+    out["raw_stream_GBps"] = round(bench.measure_raw_loopback(1.5), 3)
+
+    proc, port = spawn_port_server(
+        [os.path.join(BASE, "tools", "bench_echo_server.py")], wall_s=20.0)
+    if port is None:
+        print(json.dumps({"error": "echo server spawn failed"}))
+        return 1
+    try:
+        from brpc_tpu.butil.iobuf import IOBuf
+        from brpc_tpu.rpc import Channel, ChannelOptions, Controller
+        from pipeline_runner import run_pipelined
+
+        ch = Channel(f"tcp://127.0.0.1:{port}",
+                     ChannelOptions(timeout_ms=5000))
+        for _ in range(100):
+            ch.call_sync("Bench", "Echo", b"w")
+        n = 800
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ch.call_sync("Bench", "Echo", b"p")
+        out["rpc_1c_qps"] = round(n / (time.perf_counter() - t0), 1)
+        ch.close()
+
+        pooled = Channel(f"tcp://127.0.0.1:{port}",
+                         ChannelOptions(timeout_ms=60000,
+                                        connection_type="pooled"))
+        payload = b"\xa5" * (1 << 20)
+        expect = len(payload)
+
+        def issue(on_done):
+            cntl = Controller()
+            att = IOBuf()
+            att.append(payload)
+            cntl.request_attachment = att
+
+            def _done(c):
+                if c.failed():
+                    on_done(RuntimeError(c.error_text))
+                elif c.response_attachment.size != expect:
+                    on_done(RuntimeError("size mismatch"))
+                else:
+                    on_done(None)
+
+            pooled.call("Bench", "Echo", b"", cntl=cntl, done=_done)
+
+        run_pipelined(24, 8, issue, 30.0)           # warm the pool
+        best = 0.0
+        for _ in range(2):
+            k = 60
+            dt = run_pipelined(k, 8, issue, 30.0)
+            best = max(best, k * (1 << 20) * 2 / dt / 1e9)
+        out["mb_echo_GBps"] = round(best, 3)
+        pooled.close()
+    finally:
+        try:
+            proc.terminate()
+        except Exception:
+            pass
+    if out["raw_ping_qps"]:
+        out["qps_ratio"] = round(out["rpc_1c_qps"] / out["raw_ping_qps"], 3)
+    if out["raw_stream_GBps"]:
+        out["mb_eff"] = round(out["mb_echo_GBps"] / out["raw_stream_GBps"],
+                              3)
+    print(json.dumps(out))
+    sys.stdout.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    rc = main()
+    # hard-exit like bench.py: runtime daemon threads (fiber workers,
+    # dispatcher) must not stall or crash the interpreter teardown
+    os._exit(rc)
