@@ -913,6 +913,72 @@ def main() -> None:
                   "iters": it}
             result["concurrency_sweep"]["inflight_1MB"][str(depth)] = pt
             _progress({"progress": "inflight_point", "depth": depth, **pt})
+        # ---------------- sharded lane (shard-group serving): the
+        # SO_REUSEPORT worker-process escape from the one-core GIL
+        # ceiling the clients_4B sweep exposes. Measures the
+        # Python-dispatch method (PyEcho — the GIL-bound framework
+        # path; the native-C echo saturates beyond what same-box
+        # Python clients can generate) against the SAME multi-process
+        # pipelined client load twice: the single-process server
+        # above, then an N-shard group. Headline keys: qps_sharded_4B
+        # and shard_scaling (sharded / single at equal client count).
+        cores = os.cpu_count() or 1
+        if cores < 4:
+            result["sharded"] = {"skipped": f"only {cores} cores"}
+        elif deadline.remaining() < 20.0:
+            result["sharded"] = {"skipped": "wall budget"}
+            result["partial"] = True
+        else:
+            try:
+                from qps_client import drive_multiproc
+                from spawn_util import spawn_announcing_server
+                nsh = max(4, min(8, cores // 3))
+                ncl = max(4, min(8, cores // 3))
+                win = min(2.0, max(1.0, deadline.remaining() * 0.05))
+                single_mp = drive_multiproc(port, nprocs=ncl,
+                                            seconds=win, conns=2,
+                                            inflight=8, method="PyEcho")
+                sproc, got = spawn_announcing_server(
+                    [os.path.join(base, "tools", "shard_server.py"),
+                     "--shards", str(nsh)], wall_s=30.0,
+                    keys=("ADMIN", "PORT"))
+                if got is None:
+                    raise RuntimeError("shard server spawn failed")
+                try:
+                    sharded = drive_multiproc(got["PORT"], nprocs=ncl,
+                                              seconds=win, conns=2,
+                                              inflight=8,
+                                              method="PyEcho")
+                finally:
+                    try:
+                        sproc.terminate()
+                        sproc.wait(10)
+                    except Exception:
+                        pass
+                lane = {
+                    "shards": nsh, "client_procs": ncl,
+                    "window_s": win,
+                    "qps_single_mp": single_mp["qps"],
+                    "qps_sharded": sharded["qps"],
+                    "client_failures": single_mp["failures"]
+                    + sharded["failures"],
+                    "dead_workers": single_mp["dead_workers"]
+                    + sharded["dead_workers"],
+                }
+                result["sharded"] = lane
+                result["shard_count"] = nsh
+                result["qps_sharded_4B"] = sharded["qps"]
+                if single_mp["qps"]:
+                    result["shard_scaling"] = round(
+                        sharded["qps"] / single_mp["qps"], 2)
+                _progress({"progress": "sharded_lane", **lane,
+                           "shard_scaling": result.get("shard_scaling")})
+            except Exception as e:  # noqa: BLE001 - diagnostics only
+                result["sharded"] = {
+                    "error": f"{type(e).__name__}: {e}"[:200]}
+                result["partial"] = True
+                _progress({"progress": "error", "phase": "sharded",
+                           "error": result["sharded"]["error"]})
         ch.close()
     except BaseException as e:  # noqa: BLE001 - salvage partial data
         result["partial"] = True
@@ -957,6 +1023,9 @@ def main() -> None:
         "streaming_efficiency": result.get("streaming_efficiency"),
         "concurrency_scaling_8c": result.get("concurrency_scaling_8c"),
         "qps_8c_4B": result.get("qps_8c_4B"),
+        "qps_sharded_4B": result.get("qps_sharded_4B"),
+        "shard_scaling": result.get("shard_scaling"),
+        "shard_count": result.get("shard_count"),
         "device_lane": ("error" if ("error" in lane or
                                     "lane_error" in lane)
                         else ("ok" if lane else "absent")),

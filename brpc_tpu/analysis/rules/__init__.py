@@ -13,10 +13,11 @@ def default_rules() -> List[Rule]:
     from brpc_tpu.analysis.rules.iobuf_aliasing import IOBufAliasingRule
     from brpc_tpu.analysis.rules.judge_defer import JudgeDeferRule
     from brpc_tpu.analysis.rules.lock_order import LockOrderRule
+    from brpc_tpu.analysis.rules.postfork_reset import PostforkResetRule
     from brpc_tpu.analysis.rules.registry_complete import (
         RegistryCompleteRule,
     )
     from brpc_tpu.analysis.rules.span_finish import SpanFinishRule
     return [BlockRecycleRule(), FiberBlockingRule(), IOBufAliasingRule(),
-            JudgeDeferRule(), LockOrderRule(), RegistryCompleteRule(),
-            SpanFinishRule()]
+            JudgeDeferRule(), LockOrderRule(), PostforkResetRule(),
+            RegistryCompleteRule(), SpanFinishRule()]

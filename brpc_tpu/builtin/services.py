@@ -78,15 +78,28 @@ def add_builtin_services(server) -> None:
 
     @builtin.method()
     def status(cntl, request):
+        # a shard-group SUPERVISOR serves the merged view: sums for
+        # counters, pooled-reservoir percentiles, per-shard breakdown
+        # (the supervisor itself serves no traffic worth reporting)
+        agg = getattr(server, "shard_aggregator", None)
+        if agg is not None:
+            return json.dumps(agg.merged_status(), default=str).encode()
         return json.dumps(status_page(server), default=str).encode()
 
     @builtin.method()
     def vars(cntl, request):
         prefix = bytes(request).decode() if request else ""
+        agg = getattr(server, "shard_aggregator", None)
+        if agg is not None:
+            return json.dumps(agg.merged_vars(prefix),
+                              default=str).encode()
         return json.dumps(dict(dump_exposed(prefix)), default=str).encode()
 
     @builtin.method()
     def prometheus_metrics(cntl, request):
+        agg = getattr(server, "shard_aggregator", None)
+        if agg is not None:
+            return agg.prometheus_text().encode()
         return dump_prometheus().encode()
 
     @builtin.method()

@@ -174,6 +174,23 @@ class BlockPool:
         for cap in self.classes:
             self.classes[cap].clear()
 
+    def postfork_reset(self) -> None:
+        """Fork hygiene (butil.postfork): reset IN PLACE — other
+        modules hold `from iobuf import pool` references, so rebinding
+        the module global would fork the state in two. Cached buffers
+        are dropped (they are shared COW pages; writing into one from
+        the child forces a copy anyway, and debug-mode generation tags
+        would collide with the parent's), stats restart, and the debug
+        lock — possibly held by a parent thread mid-recycle at fork
+        time — is replaced. Outstanding blocks from the parent's
+        in-flight calls are forgotten, not leaked-tracked."""
+        for lst in self.classes.values():
+            lst.clear()
+        self.hits = self.misses = self.recycled = self.dropped = 0
+        self.generation = 0
+        self._debug_lock = threading.Lock()
+        self.outstanding = 0
+
     # -------------------------------------------------------------- stats
     def hit_ratio(self) -> float:
         n = self.hits + self.misses
@@ -200,6 +217,11 @@ class BlockPool:
 pool = BlockPool(
     enabled=_os.environ.get("BRPC_TPU_IOBUF_POOL", "1") != "0",
     debug=_os.environ.get("BRPC_TPU_IOBUF_DEBUG", "") not in ("", "0"))
+
+from brpc_tpu.butil import postfork as _postfork  # noqa: E402
+#   (registration ships with the pool it resets)
+
+_postfork.register("butil.iobuf", pool.postfork_reset)
 
 
 def _recycle_buffer(buf: bytearray) -> None:

@@ -22,6 +22,26 @@ def _escape_label(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+def dump_prometheus_items(items) -> str:
+    """Prometheus text from (name, value) pairs instead of live
+    Variables — the shard supervisor's merged dump renders through
+    this (its numbers come from the per-shard JSON stores, not from
+    this process's registry). Same scalar/composite rules as
+    dump_prometheus; non-numeric values are skipped."""
+    lines: List[str] = []
+    for name, value in items:
+        mname = _sanitize(name)
+        if isinstance(value, dict):
+            for k, v in value.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    lines.append(f"{mname}_{_sanitize(str(k))} {v}")
+        elif isinstance(value, bool):
+            lines.append(f"{mname} {int(value)}")
+        elif isinstance(value, (int, float)):
+            lines.append(f"{mname} {value}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 def dump_prometheus(prefix: str = "") -> str:
     from brpc_tpu.bvar.multi_dimension import MultiDimension
     lines: List[str] = []

@@ -79,3 +79,18 @@ def unexpose_all() -> None:
         for v in list(_registry.values()):
             v._name = None
         _registry.clear()
+
+
+def _postfork_reset() -> None:
+    """Fork hygiene: the registry contents are plain references (each
+    shard keeps its copy and its counters diverge privately — that is
+    the per-shard bvar store), but the lock may have been held by a
+    parent thread mid-expose at fork time."""
+    global _registry_lock
+    _registry_lock = threading.Lock()
+
+
+from brpc_tpu.butil import postfork as _postfork  # noqa: E402
+#   (registration ships with the registry it guards)
+
+_postfork.register("bvar.variable", _postfork_reset)

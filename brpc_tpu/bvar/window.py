@@ -83,6 +83,24 @@ class Sampler:
 global_sampler = Sampler()
 
 
+def _postfork_reset() -> None:
+    """Fork hygiene: the sampler thread exists only in the parent and
+    its lock may be held by that dead thread (fork mid-sample). Fresh
+    lock, and restart the tick thread iff anything is registered —
+    inherited Windows keep sampling in the child."""
+    global_sampler._lock = threading.Lock()
+    global_sampler._stop = threading.Event()
+    global_sampler._thread = None
+    if global_sampler._series:
+        global_sampler._ensure_thread()
+
+
+from brpc_tpu.butil import postfork as _postfork  # noqa: E402
+#   (registration ships with the singleton it resets)
+
+_postfork.register("bvar.window", _postfork_reset)
+
+
 class Window(Variable):
     """Value accumulated over the last ``window_size`` seconds."""
 

@@ -156,6 +156,20 @@ def global_poller() -> DeviceEventPoller:
     return _global_poller
 
 
+def _postfork_reset() -> None:
+    """Fork hygiene: the poller thread and its parked fibers belong to
+    the parent's scheduler; a fresh child polls nothing yet."""
+    global _global_poller, _lock
+    _global_poller = None
+    _lock = threading.Lock()
+
+
+from brpc_tpu.butil import postfork  # noqa: E402  (registration ships
+#                                      with the singleton it resets)
+
+postfork.register("fiber.device_poller", _postfork_reset)
+
+
 def device_ready(obj: Any) -> SchedAwaitable:
     """Awaitable: park the fiber until a jax.Array / Future is ready, then
     resume with the object itself (its result for Futures)."""

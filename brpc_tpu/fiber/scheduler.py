@@ -636,3 +636,22 @@ def spawn(fn, *args, **kwargs) -> Fiber:
 
 def spawn_urgent(fn, *args, **kwargs) -> Fiber:
     return global_control().spawn(fn, *args, urgent=True, **kwargs)
+
+
+def _postfork_reset() -> None:
+    """Fork hygiene: worker pthreads exist only in the parent; the
+    inherited TaskControl believes it is _started but owns no threads,
+    so every post-fork spawn would queue forever. Drop it (and the
+    wake recorder, whose Window rides the parent's sampler) so the
+    first post-fork spawn builds a fresh control with live workers."""
+    global _global_control, _global_lock, _wake_rec, _wake_rec_lock
+    _global_control = None
+    _global_lock = threading.Lock()
+    _wake_rec = None
+    _wake_rec_lock = threading.Lock()
+
+
+from brpc_tpu.butil import postfork  # noqa: E402  (registration ships
+#                                      with the singleton it resets)
+
+postfork.register("fiber.scheduler", _postfork_reset)

@@ -120,6 +120,21 @@ def global_timer() -> TimerThread:
     return _global_timer
 
 
+def _postfork_reset() -> None:
+    """Fork hygiene: the timer thread died with the parent, and every
+    heaped callback closes over parent-side state (RPC deadlines for
+    calls the child never issued). Start from an empty heap."""
+    global _global_timer, _lock
+    _global_timer = None
+    _lock = threading.Lock()
+
+
+from brpc_tpu.butil import postfork  # noqa: E402  (registration ships
+#                                      with the singleton it resets)
+
+postfork.register("fiber.timer", _postfork_reset)
+
+
 def sleep(seconds: float) -> SchedAwaitable:
     """Awaitable fiber sleep (bthread_usleep)."""
 

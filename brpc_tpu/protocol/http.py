@@ -80,6 +80,24 @@ def _response(status: int, body: bytes, content_type: str = "text/plain",
     return out
 
 
+def _shard_param(agg, req: "HttpRequest"):
+    """Parse ?shard=i against the aggregator: (index|None, error|None)
+    — None index means 'merged view'; a malformed or out-of-range value
+    is a client error, not a silent fallback to merged."""
+    raw = req.query.get("shard")
+    if raw is None:
+        return None, None
+    try:
+        i = int(raw)
+    except ValueError:
+        return None, (400, "text/plain", f"bad shard {raw!r}".encode())
+    if not 0 <= i < agg.num_shards:
+        return None, (400, "text/plain",
+                      f"shard {i} out of range 0.."
+                      f"{agg.num_shards - 1}".encode())
+    return i, None
+
+
 def _query_flag(req: "HttpRequest", name: str) -> bool:
     """Boolean query param: ?x=1 / ?x=true are on; ?x=0 / ?x=false are
     off (a raw truthy-string check would treat \"0\" as on). Bare keys
@@ -277,16 +295,78 @@ class HttpProtocol(Protocol):
                 return 200, "text/plain", (
                     r if isinstance(r, bytes) else str(r).encode())
             return 200, "text/plain", b"OK"
+        # shard-group supervisor: /status, /vars and the prometheus dump
+        # serve the MERGED view over the per-shard stores; ?shard=i
+        # narrows any of them to one worker's snapshot
+        agg = getattr(server, "shard_aggregator", None)
         if path == "/status":
+            if agg is not None:
+                shard, err = _shard_param(agg, req)
+                if err is not None:
+                    return err
+                if shard is not None:
+                    dump = agg.shard_dump(shard)
+                    if dump is None:
+                        return (404, "text/plain",
+                                f"no dump for shard {shard}".encode())
+                    view = dict(dump.get("status", {}))
+                    view.update(shard=dump.get("shard"),
+                                pid=dump.get("pid"))
+                    return 200, "application/json", json.dumps(
+                        view, default=str).encode()
+                return 200, "application/json", json.dumps(
+                    agg.merged_status(), default=str).encode()
             return 200, "application/json", self._status(server)
         if path == "/vars" or path.startswith("/vars/"):
             from brpc_tpu.bvar.variable import dump_exposed
             prefix = req.query.get("prefix", path[6:] if len(path) > 6 else "")
-            lines = [f"{n} : {v}" for n, v in dump_exposed(prefix)]
+            if agg is not None:
+                shard, err = _shard_param(agg, req)
+                if err is not None:
+                    return err
+                if shard is not None:
+                    dump = agg.shard_dump(shard)
+                    if dump is None:
+                        return (404, "text/plain",
+                                f"no dump for shard {shard}".encode())
+                    items = sorted((n, v)
+                                   for n, v in dump.get("vars", {}).items()
+                                   if n.startswith(prefix))
+                else:
+                    items = sorted(agg.merged_vars(prefix).items())
+            else:
+                items = dump_exposed(prefix)
+            lines = [f"{n} : {v}" for n, v in items]
             return 200, "text/plain", ("\n".join(lines) + "\n").encode()
         if path == "/brpc_metrics" or path == "/metrics":
             from brpc_tpu.bvar.prometheus import dump_prometheus
+            if agg is not None:
+                shard, err = _shard_param(agg, req)
+                if err is not None:
+                    return err
+                if shard is not None:
+                    from brpc_tpu.bvar.prometheus import (
+                        dump_prometheus_items)
+                    dump = agg.shard_dump(shard)
+                    if dump is None:
+                        return (404, "text/plain",
+                                f"no dump for shard {shard}".encode())
+                    return 200, "text/plain", dump_prometheus_items(
+                        sorted(dump.get("vars", {}).items())).encode()
+                return 200, "text/plain", agg.prometheus_text().encode()
             return 200, "text/plain", dump_prometheus().encode()
+        if path == "/shards":
+            if agg is None:
+                return (404, "text/plain",
+                        b"not a shard-group supervisor")
+            out = {"shards": agg.num_shards,
+                   "heartbeat_age_s": {
+                       str(i): agg.heartbeat_age_s(i)
+                       for i in range(agg.num_shards)}}
+            if agg.group is not None:
+                out["group"] = agg.group.group_status()
+            return 200, "application/json", json.dumps(
+                out, default=str).encode()
         if path == "/flags" or path.startswith("/flags/"):
             return self._flags(req, path)
         if path == "/connections":

@@ -62,6 +62,21 @@ def _make_pool():
         return _call_pool
 
 
+def _postfork_reset() -> None:
+    """Fork hygiene: every slot in the pool is a parent-side in-flight
+    call whose socket/fiber the child does not own; a fresh child has
+    zero calls in flight by definition."""
+    global _call_pool, _call_pool_lock
+    _call_pool = None
+    _call_pool_lock = threading.Lock()
+
+
+from brpc_tpu.butil import postfork  # noqa: E402  (registration ships
+#                                      with the singleton it resets)
+
+postfork.register("rpc.controller", _postfork_reset)
+
+
 def address_call(correlation_id: int):
     return _pool().address(correlation_id)
 

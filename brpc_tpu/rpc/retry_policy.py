@@ -97,6 +97,20 @@ def default_retry_policy() -> RetryPolicy:
     return _default
 
 
+def _postfork_reset() -> None:
+    """Fork hygiene: a seeded backoff policy's RNG would emit the SAME
+    jitter sequence in every forked worker — jitter exists to
+    desynchronize; a fresh default re-seeds per process."""
+    global _default
+    _default = None
+
+
+from brpc_tpu.butil import postfork as _postfork  # noqa: E402
+#   (registration ships with the singleton it resets)
+
+_postfork.register("rpc.retry_policy", _postfork_reset)
+
+
 def resolve(policy) -> RetryPolicy:
     """Accept a RetryPolicy, a bare callable, or None (default)."""
     if policy is None:

@@ -59,6 +59,27 @@ class RpcDumper:
 global_dumper = RpcDumper()
 
 
+def _postfork_reset() -> None:
+    """Fork hygiene: the dump file is keyed by pid — a forked worker
+    inheriting the parent's fh would interleave into the parent-pid
+    file through the shared offset; its lock may be held by a dead
+    thread. Fresh lock, lazily reopened per-pid file."""
+    global_dumper._lock = threading.Lock()
+    fh, global_dumper._fh = global_dumper._fh, None
+    global_dumper._dir = None
+    if fh is not None:
+        try:
+            fh.close()
+        except Exception:
+            pass
+
+
+from brpc_tpu.butil import postfork as _postfork  # noqa: E402
+#   (registration ships with the dumper it resets)
+
+_postfork.register("rpc.rpc_dump", _postfork_reset)
+
+
 def load_dump(path: str):
     """Yield (service, method, payload_bytes, log_id) records."""
     with open(path) as f:

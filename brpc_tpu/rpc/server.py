@@ -130,6 +130,8 @@ class Server:
         self._concurrency_lock = threading.Lock()
         self.nprocessed = 0
         self.nerror = 0
+        self._shard_group = None        # supervisor handle (num_shards>1)
+        self.shard_index = None         # set in shard workers
 
     # ------------------------------------------------------------ services
     def add_service(self, service: Service) -> None:
@@ -158,11 +160,34 @@ class Server:
         return dict(self._services)
 
     # ----------------------------------------------------------- lifecycle
-    def start(self, address: str | EndPoint) -> EndPoint:
+    def start(self, address: str | EndPoint,
+              num_shards: Optional[int] = None,
+              shard_options=None) -> EndPoint:
         """Listen and serve; returns the bound endpoint (with the real
-        port for tcp://host:0)."""
+        port for tcp://host:0).
+
+        ``num_shards=N`` (N>1, tcp only) turns this call into
+        shard-group serving: N worker processes each bind the same
+        port with SO_REUSEPORT and run a fully private stack — the
+        GIL-parallel escape hatch mapping the reference's -reuse_port
+        (see rpc/shard_group.py). This process becomes the SUPERVISOR:
+        it serves no traffic itself; stop()/join() drain the group."""
         if self._running:
             raise RuntimeError("server already started")
+        if num_shards is not None and num_shards > 1:
+            import copy
+            from brpc_tpu.rpc.shard_group import (ShardGroup,
+                                                  ShardGroupOptions)
+            # copy before overriding num_shards: the caller may reuse
+            # their options object for another group
+            opts = copy.copy(shard_options) if shard_options is not None \
+                else ShardGroupOptions()
+            opts.num_shards = num_shards
+            self._shard_group = ShardGroup(self, address, opts)
+            self._endpoint = self._shard_group.start()
+            self._running = True
+            self._stopped_event.clear()
+            return self._endpoint
         ep = address if isinstance(address, EndPoint) else str2endpoint(address)
         if self.options.enable_builtin_services:
             from brpc_tpu.builtin.services import add_builtin_services
@@ -251,11 +276,18 @@ class Server:
             return
         self._running = False
         _sigterm_registry.discard(self)
+        if self._shard_group is not None:
+            self._shard_group.stop()
+            self._stopped_event.set()
+            return
         if self._listener is not None:
             self._listener.stop()
 
     def join(self, timeout_s: float = 5.0) -> None:
         """Wait for in-flight requests, then close connections."""
+        if self._shard_group is not None:
+            self._shard_group.join(timeout_s)
+            return
         import time
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
@@ -298,6 +330,34 @@ class Server:
             remove_pidfile(pidfile)
         self.stop()
         self.join()
+
+    def _postfork_child_reset(self) -> None:
+        """Re-arm this Server for a forked shard worker: the template's
+        services/options survive the fork as plain data, but every
+        runtime organ — TaskControl, InputMessenger, listener, conns,
+        per-method recorders — referenced the PARENT's (now reset)
+        machinery and must be rebuilt against the child's fresh
+        singletons before start() runs here."""
+        self._control = global_control()
+        self._messenger = InputMessenger(control=self._control)
+        self._listener = None
+        self._endpoint = None
+        self._conns = []
+        self._conns_lock = threading.Lock()
+        self._concurrency_lock = threading.Lock()
+        self._running = False
+        self._stopped_event = threading.Event()
+        self._fast_drain_hook = None
+        self.method_status = {}
+        self.concurrency = 0
+        self.nprocessed = 0
+        self.nerror = 0
+        self._shard_group = None
+        if self.session_local_pool is not None:
+            from brpc_tpu.rpc.data_pool import SimpleDataPool
+            self.session_local_pool = SimpleDataPool(
+                self.options.session_local_data_factory,
+                reset=self.options.session_local_data_reset)
 
     # ----------------------------------------------------------- accounting
     def on_request_start(self) -> bool:

@@ -1,0 +1,634 @@
+"""Shard-group serving: N worker processes behind one SO_REUSEPORT port.
+
+One CPython process is GIL-bound to roughly one core of framework work,
+so the single-process qps curve flat-lines as clients grow. The
+reference escapes through ``-reuse_port`` (server.cpp StartInternal +
+acceptor.cpp): every worker binds the same port with ``SO_REUSEPORT``
+and the KERNEL spreads accepted connections across them — shared-nothing
+per-core reactors, the same shape a TPU pod uses (one process per chip).
+
+``Server.start(address, num_shards=N)`` builds a :class:`ShardGroup`:
+
+  * the SUPERVISOR binds a placeholder reuseport socket (never listens)
+    to pin the concrete port, then forks N workers;
+  * each WORKER crosses the fork through the postfork-reset registry
+    (butil/postfork.py) — fresh dispatcher, fresh TaskControl, fresh
+    timer, fresh socket map, fresh bvar sampler, fresh IOBuf pool — and
+    runs a fully private stack: its own GIL, its own event loop, its
+    own bvar store. It binds the same port with ``reuse_port=1`` and
+    serves;
+  * each worker dumps its counters + latency reservoirs to a per-shard
+    JSON file (the cross-process rpcz_dir pattern from the trace work);
+    the dump doubles as its HEARTBEAT;
+  * the supervisor restarts crashed/hung workers with jittered
+    exponential backoff (re-binding the same port), and serves the
+    MERGED observability view — ``/status``, ``/vars``, prometheus —
+    from an admin endpoint, with per-shard breakdown behind ``?shard=``;
+  * stop() drains gracefully: each shard closes its listener (the
+    kernel stops routing new connections to it), finishes in-flight
+    calls under the existing deadline machinery, flushes a final dump,
+    and exits.
+
+Surviving shards never notice a sibling's death: their connections,
+fibers and counters live in their own process — the blast radius of a
+crash is exactly one shard's connections, which clients re-dial onto a
+live shard through the normal retry path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import re
+import signal
+import socket as pysocket
+import sys
+import tempfile
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional
+
+from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+
+
+class ShardGroupOptions:
+    def __init__(self,
+                 num_shards: int = 2,
+                 admin_address: Optional[str] = None,
+                 enable_admin: bool = True,
+                 dump_interval_s: float = 0.3,
+                 heartbeat_timeout_s: float = 10.0,
+                 restart_backoff_s: float = 0.25,
+                 restart_backoff_max_s: float = 5.0,
+                 restart_jitter: float = 0.5,
+                 drain_timeout_s: float = 5.0,
+                 shard_dir: Optional[str] = None,
+                 seed: Optional[int] = None):
+        self.num_shards = num_shards
+        # merged-observability endpoint (the supervisor's builtin
+        # /status, /vars, /brpc_metrics). None = auto (same host,
+        # ephemeral port); only honored when enable_admin.
+        self.admin_address = admin_address
+        self.enable_admin = enable_admin
+        self.dump_interval_s = dump_interval_s
+        # a shard whose dump went stale this long while its process is
+        # still alive is considered hung and gets SIGKILL + restart;
+        # <= 0 disables the hang check (crash detection stays on)
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.restart_backoff_s = restart_backoff_s
+        self.restart_backoff_max_s = restart_backoff_max_s
+        self.restart_jitter = restart_jitter
+        self.drain_timeout_s = drain_timeout_s
+        self.shard_dir = shard_dir           # None = private tempdir
+        self.seed = seed                     # jitter reproducibility
+
+
+# ------------------------------------------------------------------ dumps
+
+def write_shard_dump(dirpath: str, index: int, server, seq: int) -> None:
+    """One shard's observability snapshot, written atomically (tmp +
+    rename) so the supervisor never reads a torn file. Carries the full
+    /vars dump, the /status payload, and the RAW latency reservoirs per
+    method — percentiles merge from pooled samples, not from averaged
+    percentiles (averaging percentiles is wrong; pooling reservoirs is
+    the same estimator LatencyRecorder itself uses)."""
+    from brpc_tpu.builtin.services import status_page
+    from brpc_tpu.bvar.variable import dump_exposed
+    samples = {}
+    for key, lr in server.method_status.items():
+        samples[key] = lr._percentile.merged_samples()[:1024]
+    doc = {
+        "shard": index,
+        "pid": os.getpid(),
+        "seq": seq,
+        "time": time.time(),
+        "vars": dict(dump_exposed("")),
+        "status": status_page(server),
+        "latency_samples": samples,
+    }
+    path = os.path.join(dirpath, f"shard-{index}.json")
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, default=str)
+    os.replace(tmp, path)
+
+
+_PCTL_RE = re.compile(r"(^|_)p\d")      # p50, latency_p99_us, ...
+
+
+def _merge_stat_dict(dicts: List[dict]) -> dict:
+    """Merge composite stat dicts (LatencyRecorder.get_value shapes):
+    counts/qps sum, maxima/peaks take the max, averages / fractions /
+    ratios / percentile FIELDS weight by count (equal weights when no
+    counts exist, e.g. saturation panes). Weighted percentiles are a
+    fallback for vars whose raw reservoirs were not dumped —
+    method_status merges use the pooled-sample path instead
+    (merged_method_status)."""
+    out: dict = {}
+    total = sum(d.get("count", 0) or 0 for d in dicts)
+    for d in dicts:
+        for k, v in d.items():
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                out.setdefault(k, v)
+                continue
+            if k in ("count", "qps") or k.endswith(("_count", "_qps")):
+                out[k] = out.get(k, 0) + v
+            elif "max" in k or "peak" in k:
+                out[k] = max(out.get(k, v), v)
+            elif ("avg" in k or "fraction" in k or "ratio" in k
+                    or _PCTL_RE.search(k)):
+                w = (d.get("count", 0) or 0) / total if total else \
+                    1.0 / len(dicts)
+                out[k] = out.get(k, 0.0) + v * w
+            else:
+                out[k] = out.get(k, 0) + v
+    return {k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in out.items()}
+
+
+def merge_var_values(values: list):
+    """Merge one exposed variable's per-shard values: numbers sum
+    (counters), dicts merge stat-wise, anything else keeps the first
+    shard's reading (strings, None)."""
+    nums = [v for v in values
+            if isinstance(v, (int, float)) and not isinstance(v, bool)]
+    if nums and len(nums) == len(values):
+        s = sum(nums)
+        return round(s, 3) if isinstance(s, float) else s
+    dicts = [v for v in values if isinstance(v, dict)]
+    if dicts and len(dicts) == len(values):
+        return _merge_stat_dict(dicts)
+    return values[0] if values else None
+
+
+def _percentile(sorted_samples: List[float], ratio: float) -> float:
+    if not sorted_samples:
+        return 0.0
+    idx = min(len(sorted_samples) - 1, int(ratio * len(sorted_samples)))
+    return sorted_samples[idx]
+
+
+class ShardAggregator:
+    """Reads the per-shard dump files and serves the merged view. The
+    merged numbers cover the SHARDS only (the supervisor process does
+    no serving; mixing its own counters in would make 'merged equals
+    the sum of the shard dumps' false)."""
+
+    def __init__(self, dirpath: str, num_shards: int):
+        self.dirpath = dirpath
+        self.num_shards = num_shards
+        self.group = None      # back-ref set by ShardGroup (supervisor)
+
+    # ------------------------------------------------------------- reads
+    def shard_dump(self, index: int) -> Optional[dict]:
+        path = os.path.join(self.dirpath, f"shard-{index}.json")
+        try:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def read_dumps(self) -> List[dict]:
+        out = []
+        for i in range(self.num_shards):
+            d = self.shard_dump(i)
+            if d is not None:
+                out.append(d)
+        return out
+
+    def heartbeat_age_s(self, index: int) -> Optional[float]:
+        path = os.path.join(self.dirpath, f"shard-{index}.json")
+        try:
+            return max(0.0, time.time() - os.stat(path).st_mtime)
+        except OSError:
+            return None
+
+    # ------------------------------------------------------------ merges
+    def merged_vars(self, prefix: str = "") -> Dict[str, object]:
+        dumps = self.read_dumps()
+        names: List[str] = []
+        seen = set()
+        for d in dumps:
+            for n in d.get("vars", {}):
+                if n.startswith(prefix) and n not in seen:
+                    seen.add(n)
+                    names.append(n)
+        out = {}
+        for n in sorted(names):
+            out[n] = merge_var_values(
+                [d["vars"][n] for d in dumps if n in d.get("vars", {})])
+        return out
+
+    def merged_method_status(self, dumps: Optional[List[dict]] = None):
+        """Per-method latency merged the honest way: counts/qps sum,
+        max takes the max, avg weights by count, and percentiles come
+        from the POOLED reservoir samples of every shard."""
+        dumps = self.read_dumps() if dumps is None else dumps
+        keys = sorted({k for d in dumps
+                       for k in d.get("status", {}).get("method_status", {})})
+        merged = {}
+        for key in keys:
+            stats = [d["status"]["method_status"][key] for d in dumps
+                     if key in d.get("status", {}).get("method_status", {})]
+            m = _merge_stat_dict(stats)
+            pooled: List[float] = []
+            for d in dumps:
+                pooled.extend(d.get("latency_samples", {}).get(key, ()))
+            pooled.sort()
+            if pooled:
+                m["latency_p50_us"] = round(_percentile(pooled, 0.5), 1)
+                m["latency_p90_us"] = round(_percentile(pooled, 0.9), 1)
+                m["latency_p99_us"] = round(_percentile(pooled, 0.99), 1)
+                m["latency_p999_us"] = round(_percentile(pooled, 0.999), 1)
+            merged[key] = m
+        return merged
+
+    def merged_status(self) -> dict:
+        dumps = self.read_dumps()
+        statuses = [d.get("status", {}) for d in dumps]
+        services: Dict[str, list] = {}
+        for st in statuses:
+            services.update(st.get("services", {}))
+        saturation = _merge_stat_dict(
+            [st.get("saturation", {}) for st in statuses]) \
+            if statuses else {}
+        out = {
+            "mode": "shard_group",
+            "running": bool(dumps),
+            "shards": self.num_shards,
+            "shards_reporting": len(dumps),
+            "concurrency": sum(st.get("concurrency", 0) for st in statuses),
+            "processed": sum(st.get("processed", 0) for st in statuses),
+            "errors": sum(st.get("errors", 0) for st in statuses),
+            "services": services,
+            "method_status": self.merged_method_status(dumps),
+            "saturation": saturation,
+            "shard_breakdown": {
+                str(d.get("shard")): {
+                    "pid": d.get("pid"),
+                    "processed": d.get("status", {}).get("processed", 0),
+                    "errors": d.get("status", {}).get("errors", 0),
+                    "concurrency": d.get("status", {}).get("concurrency", 0),
+                    "heartbeat_age_s": self.heartbeat_age_s(
+                        d.get("shard", 0)),
+                } for d in dumps},
+        }
+        if self.group is not None:
+            out["endpoint"] = str(self.group.endpoint)
+            out["supervisor"] = self.group.group_status()
+        return out
+
+    def prometheus_text(self) -> str:
+        from brpc_tpu.bvar.prometheus import dump_prometheus_items
+        return dump_prometheus_items(sorted(self.merged_vars().items()))
+
+
+# ------------------------------------------------------------- the group
+
+class _ShardState:
+    __slots__ = ("index", "pid", "state", "restarts", "consecutive",
+                 "restart_at", "started_at", "hb_sig", "hb_seen")
+
+    def __init__(self, index: int):
+        self.index = index
+        self.pid = 0
+        self.state = "starting"        # starting|running|restarting
+        self.restarts = 0              # lifetime restarts
+        self.consecutive = 0           # crashes since last healthy spell
+        self.restart_at = 0.0          # monotonic deadline for refork
+        self.started_at = 0.0
+        self.hb_sig = None             # last observed dump mtime_ns
+        self.hb_seen = 0.0             # monotonic time hb_sig last moved
+
+    def to_dict(self) -> dict:
+        return {"index": self.index, "pid": self.pid, "state": self.state,
+                "restarts": self.restarts,
+                "uptime_s": round(time.monotonic() - self.started_at, 1)
+                if self.started_at else 0.0}
+
+
+class ShardGroup:
+    """Supervisor for a reuseport shard group (see module doc)."""
+
+    # a shard considered healthy for this long resets the crash streak
+    _HEALTHY_AFTER_S = 5.0
+
+    def __init__(self, server, address, options: Optional[ShardGroupOptions] = None):
+        self.server = server
+        self.options = options or ShardGroupOptions()
+        ep = address if isinstance(address, EndPoint) else str2endpoint(address)
+        if ep.scheme != "tcp":
+            raise ValueError(
+                f"shard groups need SO_REUSEPORT, a tcp:// kernel "
+                f"feature; got {ep.scheme}://")
+        if self.options.num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        self._requested_ep = ep
+        self._endpoint: Optional[EndPoint] = None
+        self._placeholder: Optional[pysocket.socket] = None
+        self._shards: List[_ShardState] = [
+            _ShardState(i) for i in range(self.options.num_shards)]
+        self._lock = threading.Lock()
+        self._stopping = False
+        self._monitor: Optional[threading.Thread] = None
+        self._admin_server = None
+        self._admin_endpoint: Optional[EndPoint] = None
+        self._rng = random.Random(self.options.seed)
+        self.shard_dir = self.options.shard_dir
+        self._own_shard_dir = self.options.shard_dir is None
+        self.aggregator: Optional[ShardAggregator] = None
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> EndPoint:
+        """Bind the port, fork the workers, start the monitor and the
+        admin endpoint; returns the data-plane endpoint."""
+        ep = self._requested_ep
+        # the placeholder socket pins the concrete port for the whole
+        # group lifetime WITHOUT serving: it never listens, so the
+        # kernel's reuseport balancing only ever sees the workers'
+        # listening sockets. Restarted shards re-bind the same port
+        # because this socket keeps the reuseport group alive even if
+        # every worker is momentarily dead.
+        sock = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM)
+        sock.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_REUSEADDR, 1)
+        sock.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_REUSEPORT, 1)
+        sock.bind((ep.host or "127.0.0.1", ep.port))
+        host, port = sock.getsockname()[:2]
+        self._placeholder = sock
+        self._endpoint = EndPoint("tcp", host, port, ())
+        if self.shard_dir is None:
+            self.shard_dir = tempfile.mkdtemp(prefix="brpc-tpu-shards-")
+        else:
+            os.makedirs(self.shard_dir, exist_ok=True)
+        self.aggregator = ShardAggregator(self.shard_dir,
+                                          self.options.num_shards)
+        self.aggregator.group = self
+        try:
+            for st in self._shards:
+                self._fork_shard(st)
+            self._monitor = threading.Thread(target=self._monitor_loop,
+                                             name="shard_supervisor",
+                                             daemon=True)
+            self._monitor.start()
+            if self.options.enable_admin:
+                self._start_admin()
+        except BaseException:
+            # a failure past the first fork (admin port in use, monitor
+            # thread limit) must not leak live workers serving a port
+            # the caller believes never started — Server.stop() would
+            # be a no-op since _running was never set
+            self.stop()
+            raise
+        return self._endpoint
+
+    def _start_admin(self) -> None:
+        from brpc_tpu.rpc.server import Server, ServerOptions
+        admin = Server(ServerOptions(enable_builtin_services=True))
+        admin.shard_aggregator = self.aggregator
+        addr = self.options.admin_address or \
+            f"tcp://{self._endpoint.host}:0"
+        self._admin_endpoint = admin.start(addr)
+        self._admin_server = admin
+
+    @property
+    def endpoint(self) -> Optional[EndPoint]:
+        return self._endpoint
+
+    @property
+    def admin_endpoint(self) -> Optional[EndPoint]:
+        return self._admin_endpoint
+
+    def shard_pids(self) -> List[int]:
+        with self._lock:
+            return [st.pid for st in self._shards if st.state == "running"]
+
+    def group_status(self) -> dict:
+        with self._lock:
+            return {"stopping": self._stopping,
+                    "admin": str(self._admin_endpoint)
+                    if self._admin_endpoint else None,
+                    "shard_dir": self.shard_dir,
+                    "shards": [st.to_dict() for st in self._shards]}
+
+    def stop(self) -> None:
+        """Graceful drain: SIGTERM every shard (each closes its
+        listener, finishes in-flight calls, flushes a last dump and
+        exits), escalate to SIGKILL past the drain budget."""
+        with self._lock:
+            if self._stopping:
+                return
+            self._stopping = True
+            pids = [st.pid for st in self._shards if st.pid > 0]
+        for pid in pids:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.monotonic() + self.options.drain_timeout_s + 2.0
+        live = set(pids)
+        while live and time.monotonic() < deadline:
+            for pid in list(live):
+                try:
+                    done, _ = os.waitpid(pid, os.WNOHANG)
+                    if done:
+                        live.discard(pid)
+                except OSError:
+                    live.discard(pid)
+            if live:
+                time.sleep(0.02)
+        for pid in live:
+            try:
+                os.kill(pid, signal.SIGKILL)
+                os.waitpid(pid, 0)
+            except OSError:
+                pass
+        if self._admin_server is not None:
+            try:
+                self._admin_server.stop()
+                self._admin_server.join(1.0)
+            except Exception:
+                pass
+        if self._placeholder is not None:
+            try:
+                self._placeholder.close()
+            except OSError:
+                pass
+
+    def join(self, timeout_s: float = 10.0) -> None:
+        t = self._monitor
+        if t is not None:
+            t.join(timeout_s)
+
+    # ------------------------------------------------------------ forking
+    def _fork_shard(self, st: _ShardState) -> None:
+        pid = os.fork()
+        if pid == 0:
+            # ---- CHILD: never returns. The postfork-reset registry
+            # already ran inside fork(); every singleton accessor now
+            # rebuilds privately.
+            try:
+                self._child_main(st.index)
+            except BaseException:
+                try:
+                    traceback.print_exc(file=sys.stderr)
+                    sys.stderr.flush()
+                except Exception:
+                    pass
+            finally:
+                os._exit(1)
+        with self._lock:
+            st.pid = pid
+            st.state = "running"
+            st.started_at = time.monotonic()
+            st.hb_sig = None
+            st.hb_seen = st.started_at
+            stopping = self._stopping
+        if stopping:
+            # raced stop(): its SIGTERM sweep already ran and would
+            # never reach this brand-new child — and stop() may have
+            # RETURNED, so nobody else will reap it either. SIGKILL
+            # (the child is milliseconds old, it has nothing to drain)
+            # and wait right here, so a restart landing mid-shutdown
+            # can neither keep the port served behind the group's back
+            # nor linger as a zombie for the supervisor's lifetime.
+            try:
+                os.kill(pid, signal.SIGKILL)
+                os.waitpid(pid, 0)
+            except OSError:
+                pass
+
+    def _backoff_s(self, st: _ShardState) -> float:
+        base = min(self.options.restart_backoff_max_s,
+                   self.options.restart_backoff_s
+                   * (2 ** max(0, st.consecutive - 1)))
+        # jitter DESYNCHRONIZES restarts: N shards felled by one cause
+        # (OOM killer sweep) must not re-bind and re-crash in lockstep
+        return base * (1.0 + self.options.restart_jitter
+                       * self._rng.random())
+
+    # ------------------------------------------------------------ monitor
+    def _monitor_loop(self) -> None:
+        hb = self.options.heartbeat_timeout_s
+        while True:
+            with self._lock:
+                if self._stopping:
+                    return
+                shards = list(self._shards)
+            now = time.monotonic()
+            for st in shards:
+                if st.state == "running":
+                    crashed = False
+                    try:
+                        done, _ = os.waitpid(st.pid, os.WNOHANG)
+                        crashed = bool(done)
+                    except ChildProcessError:
+                        crashed = True
+                    except OSError:
+                        pass
+                    if not crashed and hb > 0 and self.aggregator:
+                        # hang detection on the MONITOR's monotonic
+                        # clock: a dump whose mtime moved is a fresh
+                        # heartbeat; one that hasn't moved for hb while
+                        # the process lives means hung. (Comparing
+                        # wall-clock dump age directly would SIGKILL
+                        # every healthy shard at once after an NTP
+                        # step or VM suspend/resume.)
+                        try:
+                            sig = os.stat(os.path.join(
+                                self.shard_dir,
+                                f"shard-{st.index}.json")).st_mtime_ns
+                        except OSError:
+                            sig = None
+                        if sig is not None and sig != st.hb_sig:
+                            st.hb_sig = sig
+                            st.hb_seen = now
+                        elif now - st.hb_seen > hb \
+                                and now - st.started_at > hb:
+                            # alive but not dumping: hung. SIGKILL and
+                            # reap on the next tick like any crash.
+                            try:
+                                os.kill(st.pid, signal.SIGKILL)
+                            except OSError:
+                                pass
+                    if crashed:
+                        with self._lock:
+                            if self._stopping:
+                                return
+                            if now - st.started_at > self._HEALTHY_AFTER_S:
+                                st.consecutive = 0
+                            st.consecutive += 1
+                            st.restarts += 1
+                            st.state = "restarting"
+                            # the pid is reaped and may be RECYCLED by
+                            # the OS at any moment: zero it so a
+                            # concurrent stop() can never SIGTERM an
+                            # unrelated process that inherited it
+                            st.pid = 0
+                            st.restart_at = now + self._backoff_s(st)
+                elif st.state == "restarting" and now >= st.restart_at:
+                    self._fork_shard(st)
+            time.sleep(0.05)
+
+    # -------------------------------------------------------------- child
+    def _child_main(self, index: int) -> None:
+        """Worker body. Runs with a freshly reset singleton registry:
+        builds its private serving stack, binds the shared port with
+        SO_REUSEPORT, heartbeats via the dump file, and drains on
+        SIGTERM."""
+        # inherited supervisor fds we can name: close OUR copies so a
+        # worker never holds the admin listener or the placeholder open
+        # past the supervisor's close (closing a dup does not release
+        # the parent's port reservation)
+        admin = self._admin_server
+        admin_sock = getattr(getattr(admin, "_listener", None), "_sock",
+                             None) if admin is not None else None
+        for obj in (self._placeholder, admin_sock):
+            try:
+                if obj is not None:
+                    obj.close()
+            except OSError:
+                pass
+        stop_ev = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *_: stop_ev.set())
+        signal.signal(signal.SIGINT, lambda *_: stop_ev.set())
+
+        server = self.server
+        server._postfork_child_reset()
+        server.shard_index = index
+        from brpc_tpu.bvar.reducer import PassiveStatus
+        PassiveStatus(lambda: index).expose("shard_index")
+        ep = EndPoint("tcp", self._endpoint.host, self._endpoint.port,
+                      (("reuse_port", "1"),))
+        server.start(ep)
+
+        # SIGTERM must land on OUR drain path, not the generic
+        # stop-only handler server.start may have installed
+        signal.signal(signal.SIGTERM, lambda *_: stop_ev.set())
+
+        parent = os.getppid()
+        seq = 0
+        interval = max(0.05, self.options.dump_interval_s)
+        while not stop_ev.is_set():
+            seq += 1
+            try:
+                write_shard_dump(self.shard_dir, index, server, seq)
+            except OSError:
+                pass   # disk hiccup: serving must not die for a dump
+            if os.getppid() != parent:
+                break  # supervisor died without SIGTERM: orphan exit
+            stop_ev.wait(interval)
+
+        # graceful drain: close the listener FIRST (the kernel drops us
+        # from the reuseport group; new connections go to siblings),
+        # then let in-flight calls finish under the deadline machinery
+        server.stop()
+        server.join(self.options.drain_timeout_s)
+        try:
+            write_shard_dump(self.shard_dir, index, server, seq + 1)
+        except OSError:
+            pass
+        from brpc_tpu.rpc.span import global_store
+        global_store.flush()
+        os._exit(0)

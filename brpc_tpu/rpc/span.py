@@ -306,6 +306,31 @@ import atexit  # noqa: E402  (registration belongs with the store)
 atexit.register(global_store.flush)
 
 
+def _postfork_reset() -> None:
+    """Fork hygiene: the store's open fh shares one file offset with
+    the parent (interleaved writes would shred the JSONL), its lock
+    may be held by a dead thread, and buffered/ringed spans describe
+    parent-side RPCs. A shard starts with an empty rpcz state and its
+    own store, opened lazily at its own rpcz_dir."""
+    global_store._lock = threading.Lock()
+    fh, global_store._fh = global_store._fh, None
+    global_store._dir = None
+    global_store._buf = []
+    if fh is not None:
+        try:
+            fh.close()     # only the child's dup of the descriptor
+        except Exception:
+            pass
+    global_collector._lock = threading.Lock()
+    global_collector._ring.clear()
+
+
+from brpc_tpu.butil import postfork as _postfork  # noqa: E402
+#   (registration ships with the store it resets)
+
+_postfork.register("rpc.span", _postfork_reset)
+
+
 def new_trace_id() -> int:
     return fast_rand() or 1
 

@@ -32,6 +32,20 @@ def _get_pool() -> ThreadPoolExecutor:
         return _pool
 
 
+def _postfork_reset() -> None:
+    """Fork hygiene: the executor's pthreads exist only in the parent
+    — submitting to the inherited pool would queue work nobody runs."""
+    global _pool, _pool_lock
+    _pool = None
+    _pool_lock = threading.Lock()
+
+
+from brpc_tpu.butil import postfork  # noqa: E402  (registration ships
+#                                      with the singleton it resets)
+
+postfork.register("rpc.usercode", _postfork_reset)
+
+
 async def run_usercode(fn, *args):
     """Run ``fn(*args)`` on the backup pool; the calling fiber suspends
     (not its worker thread) until done."""

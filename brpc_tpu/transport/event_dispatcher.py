@@ -216,3 +216,32 @@ def global_dispatcher() -> EventDispatcher:
             if _global is None:
                 _global = EventDispatcher()
     return _global
+
+
+def _postfork_reset() -> None:
+    """Fork hygiene: the dispatcher thread exists only in the parent,
+    and the inherited epoll fd is the parent's kernel object — any
+    EPOLL_CTL from the child would corrupt the parent's poll set.
+    Abandon the instance (closing only the child's fd copies; close(2)
+    never mutates the shared interest list) so the first post-fork
+    consumer builds a private dispatcher with its own thread."""
+    global _global, _glock
+    d, _global = _global, None
+    _glock = threading.Lock()
+    if d is not None:
+        d._stop = True
+        try:
+            d._selector.close()
+        except Exception:
+            pass
+        for s in (d._wakeup_r, d._wakeup_w):
+            try:
+                s.close()
+            except Exception:
+                pass
+
+
+from brpc_tpu.butil import postfork  # noqa: E402  (registration ships
+#                                      with the singleton it resets)
+
+postfork.register("transport.event_dispatcher", _postfork_reset)

@@ -98,6 +98,27 @@ _conn_cache: Dict[str, object] = {}
 _lane_status_var = None
 
 
+def _postfork_reset() -> None:
+    """Fork hygiene: the PjRt transfer server and its connection cache
+    are device-runtime handles owned by the parent — a forked shard
+    must re-probe the lane itself (or, the normal case, never touch
+    the device at all)."""
+    global _transfer_server, _transfer_failed, _transfer_error
+    global _conn_cache, _lane_status_var, _server_lock
+    _transfer_server = None
+    _transfer_failed = False
+    _transfer_error = None
+    _conn_cache = {}
+    _lane_status_var = None
+    _server_lock = threading.Lock()
+
+
+from brpc_tpu.butil import postfork as _postfork  # noqa: E402
+#   (registration ships with the lane state it resets)
+
+_postfork.register("transport.ici", _postfork_reset)
+
+
 def _publish_lane_status() -> None:
     """Expose transfer-server state as a bvar (/vars ici_transfer_lane)
     so lane degradation is observable, not a silent latch."""

@@ -69,6 +69,19 @@ def dispatch_batch_peak_10s() -> int:
     return peak.get_value() or 0
 
 
+def _postfork_reset() -> None:
+    """Fork hygiene: the window views are registered with the parent's
+    sampler series; recreate them against the child's sampler."""
+    global _batch_windows
+    _batch_windows = None
+
+
+from brpc_tpu.butil import postfork as _postfork  # noqa: E402
+#   (registration ships with the singleton it resets)
+
+_postfork.register("transport.input_messenger", _postfork_reset)
+
+
 PassiveStatus(dispatch_batch_avg_10s).expose("dispatch_batch_size_avg_10s")
 PassiveStatus(dispatch_batch_peak_10s).expose("dispatch_batch_size_peak_10s")
 

@@ -190,6 +190,23 @@ def _wqueue_peak_window():
 
 _wq_peak_win = None
 
+
+def _postfork_reset() -> None:
+    """Fork hygiene: the versioned-ref socket pool addresses PARENT
+    sockets (their fds are mere dup'd copies here, their event
+    registrations live in the parent's dispatcher), and the peak
+    window rides the parent's sampler. Fresh child, fresh pool."""
+    global _socket_pool, _socket_pool_lock, _wq_peak_win
+    _socket_pool = None
+    _socket_pool_lock = threading.Lock()
+    _wq_peak_win = None
+
+
+from brpc_tpu.butil import postfork as _postfork  # noqa: E402
+#   (registration ships with the singletons it resets)
+
+_postfork.register("transport.socket", _postfork_reset)
+
 # Installed by the RPC layer (brpc_tpu.rpc.channel): callable
 # ``(socket, [controllers])`` that fails or re-issues the client calls
 # still in flight on a socket that just failed — the transport layer

@@ -104,3 +104,19 @@ def global_socket_map() -> SocketMap:
             if _global is None:
                 _global = SocketMap()
     return _global
+
+
+def _postfork_reset() -> None:
+    """Fork hygiene: pooled client sockets in the map are duplicated
+    fds whose event registrations live in the PARENT's dispatcher —
+    reusing one from the child would write on a connection the parent
+    still owns. Drop the map; post-fork channels redial privately."""
+    global _global, _glock
+    _global = None
+    _glock = threading.Lock()
+
+
+from brpc_tpu.butil import postfork  # noqa: E402  (registration ships
+#                                      with the singleton it resets)
+
+postfork.register("transport.socket_map", _postfork_reset)

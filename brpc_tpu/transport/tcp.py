@@ -14,8 +14,21 @@ import threading
 from typing import Callable, Optional
 
 from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+from brpc_tpu.butil.flags import define_flag, flag
+from brpc_tpu.bvar.reducer import Adder
 from brpc_tpu.transport.base import Conn, Listener, Transport
 from brpc_tpu.transport.event_dispatcher import global_dispatcher
+
+define_flag("acceptor_backoff_ms", 100,
+            "pause accepting for this long after the accept loop hits "
+            "fd exhaustion (EMFILE/ENFILE) — a level-triggered listener "
+            "would otherwise spin the dispatcher at 100% while the "
+            "process is out of descriptors",
+            validator=lambda v: v > 0)
+
+# accept-loop health: each pause is one fd-exhaustion incident the
+# timer-driven resume absorbed instead of a dispatcher hot-loop
+naccept_pauses = Adder().expose("acceptor_fd_exhausted_pauses")
 
 
 class TcpConn(Conn):
@@ -167,6 +180,7 @@ class _TcpListener(Listener):
         self._sock = sock
         self._ep = ep
         self._on_new_conn = on_new_conn
+        self._stopped = False
         sock.setblocking(False)
         global_dispatcher().add_consumer(sock.fileno(), self._on_acceptable)
 
@@ -175,13 +189,42 @@ class _TcpListener(Listener):
         while True:
             try:
                 s, addr = self._sock.accept()
-            except (BlockingIOError, OSError):
+            except BlockingIOError:
+                return
+            except OSError as e:
+                if e.errno in (errno.EMFILE, errno.ENFILE, errno.ENOMEM):
+                    # fd exhaustion: the pending connection stays in the
+                    # kernel backlog, so this LEVEL-triggered fd would
+                    # re-fire the instant we return — a hot loop pinning
+                    # the dispatcher exactly when the process is
+                    # resource-starved. Pause accept interest and let a
+                    # timer resume it once some fds may have freed
+                    # (acceptor.cpp's EMFILE backoff discipline).
+                    self._pause_accept()
                 return
             local = self._ep
             remote = str2endpoint(f"tcp://{addr[0]}:{addr[1]}")
             self._on_new_conn(TcpConn(s, local, remote))
 
+    def _pause_accept(self) -> None:
+        naccept_pauses.add(1)
+        global_dispatcher().pause_read(self._sock.fileno())
+        from brpc_tpu.fiber.timer import global_timer
+        global_timer().schedule_after(
+            flag("acceptor_backoff_ms") / 1e3, self._resume_accept)
+
+    def _resume_accept(self) -> None:
+        if self._stopped:
+            return     # raced stop(): never re-arm a closed (reusable) fd
+        # re-arming is enough: the listener is LEVEL-triggered, so a
+        # still-pending backlog re-fires _on_acceptable on the
+        # dispatcher thread at its next select — accepting here on the
+        # timer thread would both race that fire and stall every queued
+        # timer behind a potentially backlog-deep accept loop
+        global_dispatcher().resume_read(self._sock.fileno())
+
     def stop(self) -> None:
+        self._stopped = True
         global_dispatcher().remove_consumer(self._sock.fileno())
         try:
             self._sock.close()
@@ -199,6 +242,13 @@ class TcpTransport(Transport):
     def listen(self, ep: EndPoint, on_new_conn) -> Listener:
         sock = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM)
         sock.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_REUSEADDR, 1)
+        if ep.extra("reuse_port") in ("1", "true"):
+            # shard-group serving (the reference's -reuse_port,
+            # server.cpp StartInternal): N worker processes each bind
+            # this port and the kernel spreads accepted connections
+            # across their listeners. Must be set BEFORE bind, and
+            # every member of the group must set it.
+            sock.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_REUSEPORT, 1)
         sock.bind((ep.host or "127.0.0.1", ep.port))
         sock.listen(1024)
         host, port = sock.getsockname()[:2]

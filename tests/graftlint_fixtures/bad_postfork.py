@@ -1,0 +1,39 @@
+"""Seeded postfork-reset violations: a lazy-global singleton accessor
+and a module-level resource-bearing singleton, in a module with NO
+butil.postfork registration — a forked shard worker would inherit the
+dead thread and the stale freelist silently."""
+
+import threading
+
+
+class LoopThread:
+    """Resource-bearing: owns a worker thread."""
+
+    def __init__(self):
+        self._thread = threading.Thread(target=lambda: None, daemon=True)
+
+
+class BufferCache:
+    """Resource-bearing: keeps a reuse freelist."""
+
+    def __init__(self):
+        self.freelist = []
+
+    def recycle(self, buf):
+        self.freelist.append(buf)
+
+
+_global = None
+
+
+def global_loop():
+    # BAD: lazy-global accessor, no postfork.register anywhere in the
+    # module — the child's first use returns the parent's dead loop
+    global _global
+    if _global is None:
+        _global = LoopThread()
+    return _global
+
+
+# BAD: module-level resource-bearing singleton, same missing reset
+cache = BufferCache()

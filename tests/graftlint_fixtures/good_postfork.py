@@ -1,0 +1,53 @@
+"""postfork-reset's clean twin: every singleton shape the rule must
+accept — a lazy-global accessor whose module registers a reset, and
+module-level singletons of plain-data classes (safe to inherit across
+fork, never flagged). The protocol-registrar exemption is pinned
+against the real protocol/tpu_std.py in test_graftlint.py."""
+
+import re
+import threading
+
+
+class FancyPoller:
+    """Resource-bearing: starts a thread (the marker the rule keys on
+    for module-level instantiation)."""
+
+    def __init__(self):
+        self._thread = threading.Thread(target=lambda: None, daemon=True)
+
+
+class PlainCounter:
+    """Pure data — safe to inherit across fork."""
+
+    def __init__(self):
+        self.n = 0
+
+
+_global = None
+
+
+def global_poller():
+    """Lazy accessor + module-level postfork registration below."""
+    global _global
+    if _global is None:
+        _global = FancyPoller()
+    return _global
+
+
+def _postfork_reset():
+    global _global
+    _global = None
+
+
+class _FakePostfork:
+    @staticmethod
+    def register(name, fn):
+        pass
+
+
+postfork = _FakePostfork()
+postfork.register("fixtures.good_postfork", _postfork_reset)
+
+# module-level singletons of data-only shapes: never flagged
+counter = PlainCounter()
+_PATTERN = re.compile(r"x+")

@@ -1,0 +1,85 @@
+"""The accept loop's fd-exhaustion backoff (transport/tcp.py): when
+accept() hits EMFILE/ENFILE, the LEVEL-triggered listener fd would
+re-fire instantly forever — a dispatcher hot-loop pinned at 100% CPU
+exactly while the process is starved. The fix pauses accept interest
+and resumes via a timer. Runs in a SUBPROCESS because it clamps
+RLIMIT_NOFILE and deliberately exhausts the fd table."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import json, os, resource, socket, subprocess, sys, time
+sys.path.insert(0, %(repo)r)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from brpc_tpu.butil.flags import set_flag
+from brpc_tpu.rpc import Channel, ChannelOptions, Server, ServerOptions, \
+    Service
+from brpc_tpu.transport.tcp import naccept_pauses
+
+set_flag("acceptor_backoff_ms", 50)
+server = Server(ServerOptions(enable_builtin_services=False))
+svc = Service("T")
+
+@svc.method()
+def Echo(cntl, request):
+    return bytes(request)
+
+server.add_service(svc)
+ep = server.start("tcp://127.0.0.1:0")
+
+# a client in ANOTHER process (this one is about to run out of fds):
+# its connect completes in the kernel backlog regardless of accept()
+peer = subprocess.Popen([sys.executable, "-c",
+    "import socket,sys,time; "
+    "s=socket.create_connection(('127.0.0.1', %%d), timeout=10); "
+    "time.sleep(30)" %% ep.port])
+
+# clamp the limit just above current usage, then exhaust what is left
+used = len(os.listdir("/proc/self/fd"))
+soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+resource.setrlimit(resource.RLIMIT_NOFILE, (used + 4, hard))
+hogs = []
+try:
+    while True:
+        hogs.append(os.dup(0))
+except OSError:
+    pass
+
+# the pending connection now drives accept() into EMFILE: the listener
+# must PAUSE (counter moves) instead of hot-looping the dispatcher
+deadline = time.monotonic() + 5
+while naccept_pauses.get_value() == 0 and time.monotonic() < deadline:
+    time.sleep(0.02)
+paused = naccept_pauses.get_value()
+
+# free descriptors: the timer-driven resume must pick the backlog
+# connection up and serve it — no new SYN required
+for fd in hogs:
+    os.close(fd)
+resource.setrlimit(resource.RLIMIT_NOFILE, (soft, hard))
+served = False
+if paused:
+    ch = Channel("tcp://127.0.0.1:%%d" %% ep.port,
+                 ChannelOptions(timeout_ms=5000, max_retry=2))
+    served = not ch.call_sync("T", "Echo", b"after-release").failed()
+    ch.close()
+peer.kill()
+print(json.dumps({"paused": int(paused), "served_after_release": served}))
+os._exit(0)
+"""
+
+
+def test_emfile_pauses_accept_and_timer_resumes():
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD % {"repo": REPO_ROOT}],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["paused"] >= 1, report
+    assert report["served_after_release"] is True, report

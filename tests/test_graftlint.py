@@ -285,6 +285,70 @@ class TestWaivers:
         assert "must NOT leak" in line, line
 
 
+class TestPostforkReset:
+    def test_seeded_violations(self):
+        active, _ = _lint("bad_postfork.py")
+        assert [f.rule for f in active] == ["postfork-reset"] * 2, \
+            [f.format() for f in active]
+        msgs = " | ".join(f.message for f in active)
+        assert "global_loop" in msgs and "'cache'" in msgs
+        # the findings anchor on the accessor def and the singleton
+        # assignment, not on the classes
+        src = open(os.path.join(
+            FIXTURES, "bad_postfork.py")).read().splitlines()
+        anchors = [src[f.line - 1] for f in active]
+        assert any("def global_loop" in a for a in anchors), anchors
+        assert any("cache = BufferCache()" in a for a in anchors), anchors
+
+    def test_good_fixture_zero_false_positives(self):
+        # registered accessor, plain-data module singletons, compiled
+        # regexes: zero findings under the FULL analyzer
+        active, waived = _lint("good_postfork.py")
+        assert active == [] and waived == [], \
+            [f.format() for f in active + waived]
+
+    def test_protocol_registrar_exempt_on_real_module(self):
+        """ensure_registered() in protocol/tpu_std.py is the lazy
+        accessor shape but hands the instance to register_protocol —
+        the protocol table is fork-safe codec data, so the rule must
+        stay silent there (and the module carries no waiver)."""
+        from brpc_tpu.analysis.core import Context, SourceFile
+        from brpc_tpu.analysis.rules.postfork_reset import PostforkResetRule
+        path = os.path.join(REPO_ROOT, "brpc_tpu", "protocol", "tpu_std.py")
+        src = open(path).read()
+        assert "def ensure_registered" in src and \
+            "postfork" not in src  # no registration, no waiver
+        sf = SourceFile(path, "brpc_tpu/protocol/tpu_std.py", src)
+        found = list(PostforkResetRule().check(sf, Context([sf])))
+        assert found == [], [f.format() for f in found]
+
+    def test_mutation_dropping_registration_fires_on_real_dispatcher(self):
+        """Mutation pin: strip the postfork.register line from the real
+        transport/event_dispatcher.py — the rule must fire, so the
+        dispatcher singleton can never silently lose its fork reset
+        (a forked shard would EPOLL_CTL the parent's epoll set)."""
+        from brpc_tpu.analysis.core import Context, SourceFile
+        from brpc_tpu.analysis.rules.postfork_reset import PostforkResetRule
+        path = os.path.join(REPO_ROOT, "brpc_tpu", "transport",
+                            "event_dispatcher.py")
+        src = open(path).read()
+        target = [ln for ln in src.splitlines()
+                  if "postfork.register(" in ln]
+        assert len(target) == 1, target
+        mutated = src.replace(target[0] + "\n", "")
+        sf = SourceFile(path, "brpc_tpu/transport/event_dispatcher.py",
+                        mutated)
+        found = list(PostforkResetRule().check(sf, Context([sf])))
+        assert any(f.rule == "postfork-reset"
+                   and "global_dispatcher" in f.message
+                   for f in found), [f.format() for f in found]
+        # and the unmutated module stays clean
+        sf_ok = SourceFile(path, "brpc_tpu/transport/event_dispatcher.py",
+                           src)
+        assert list(PostforkResetRule().check(sf_ok, Context([sf_ok]))) \
+            == []
+
+
 class TestCli:
     def _run(self, *args):
         return subprocess.run(

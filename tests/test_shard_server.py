@@ -1,0 +1,453 @@
+"""Shard-group serving (SO_REUSEPORT worker processes): e2e process
+tests over the tools/shard_server.py runner — connection spread,
+SIGKILL-one-shard chaos robustness (supervised restart, zero errors on
+survivors, retried success on the victim's connections), and the
+merged observability contract (aggregated /vars equals the sum of the
+per-shard dumps, pooled percentiles, ?shard= single views) — plus the
+aggregator's merge math on synthetic dumps, no forking needed."""
+
+import http.client
+import json
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+from spawn_util import spawn_announcing_server  # noqa: E402
+
+from brpc_tpu import chaos  # noqa: E402
+from brpc_tpu.chaos import Fault, FaultPlan  # noqa: E402
+from brpc_tpu.rpc import Channel, ChannelOptions  # noqa: E402
+
+_TOOL = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "shard_server.py")
+
+
+def _spawn_group(shards: int):
+    proc, got = spawn_announcing_server(
+        [_TOOL, "--shards", str(shards)], wall_s=30,
+        keys=("ADMIN", "PORT"))
+    assert got, "shard group never came up"
+    return proc, got["PORT"], got["ADMIN"]
+
+
+def _get(port: int, path: str):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        c.request("GET", path)
+        r = c.getresponse()
+        return r.status, r.read()
+    finally:
+        c.close()
+
+
+def _pid_of(ch) -> int:
+    c = ch.call_sync("Bench", "Pid", b"")
+    assert not c.failed(), c.error_text
+    return int(c.response_payload.to_bytes())
+
+
+def _chans_by_pid(port: int, want_pids: int, limit: int = 24):
+    """Open channels until connections landed on ``want_pids`` distinct
+    shards (kernel 4-tuple hashing spreads a handful of ephemeral
+    ports fast); returns {pid: [channels]} — caller closes."""
+    by_pid = {}
+    chans = []
+    deadline = time.monotonic() + 15.0
+    while len(by_pid) < want_pids and len(chans) < limit \
+            and time.monotonic() < deadline:
+        ch = Channel(f"tcp://127.0.0.1:{port}",
+                     ChannelOptions(timeout_ms=4000, max_retry=3,
+                                    share_connections=False))
+        chans.append(ch)
+        by_pid.setdefault(_pid_of(ch), []).append(ch)
+    assert len(by_pid) >= want_pids, \
+        f"only {len(by_pid)} shards reached over {len(chans)} conns"
+    return by_pid, chans
+
+
+def _close_all(chans):
+    for ch in chans:
+        try:
+            ch.close()
+        except Exception:
+            pass
+
+
+def _stop(proc):
+    try:
+        proc.terminate()
+        proc.wait(10)
+    except Exception:
+        try:
+            proc.kill()
+        except Exception:
+            pass
+
+
+class TestShardServing:
+    def test_connections_spread_and_echo_works_everywhere(self):
+        proc, port, _ = _spawn_group(3)
+        chans = []
+        try:
+            by_pid, chans = _chans_by_pid(port, want_pids=2)
+            for pid, chs in by_pid.items():
+                for ch in chs:
+                    c = ch.call_sync("Bench", "Echo", b"hello-%d" % pid)
+                    assert not c.failed(), c.error_text
+                    assert c.response_payload.to_bytes() == \
+                        b"hello-%d" % pid
+        finally:
+            _close_all(chans)
+            _stop(proc)
+
+
+class TestShardChaosRobustness:
+    def test_sigkill_mid_burst_restart_and_zero_survivor_errors(self):
+        """The chaos-lane shard invariant: SIGKILL one shard while a
+        burst is in flight (chaos delay faults keep writes parked
+        mid-call across the kill). Clients pinned to surviving shards
+        must see ZERO errors, retried calls on the victim's broken
+        connections must succeed (the redial lands on a live shard),
+        and the supervisor must restart the shard within the backoff
+        budget."""
+        proc, port, admin = _spawn_group(3)
+        chans = []
+        try:
+            by_pid, chans = _chans_by_pid(port, want_pids=2)
+            victim = min(by_pid)      # deterministic choice
+            survivors = [c for p, v in by_pid.items() if p != victim
+                         for c in v]
+            victims = by_pid[victim]
+
+            # chaos plumbing (tests/test_chaos.py's fault primitives):
+            # delay a couple of upcoming writes on this endpoint so the
+            # kill lands while calls sit in flight, not between calls
+            ep = f"tcp://127.0.0.1:{port}"
+            plan = FaultPlan(seed=11)
+            for idx in range(2):
+                plan.at(ep, idx, Fault("delay", at_byte=4, delay_ms=40))
+            chaos.install(plan)
+            try:
+                os.kill(victim, signal.SIGKILL)
+                t_kill = time.monotonic()
+                errs = 0
+                calls = 0
+                while time.monotonic() - t_kill < 1.5:
+                    for ch in survivors:
+                        calls += 1
+                        if ch.call_sync("Bench", "Echo", b"s").failed():
+                            errs += 1
+                assert errs == 0, \
+                    f"{errs}/{calls} errors on surviving shards"
+                assert calls > 0
+                # the victim's channels: retry must succeed through a
+                # redial onto a live shard
+                for ch in victims:
+                    c = ch.call_sync("Bench", "Echo", b"v")
+                    assert not c.failed(), c.error_text
+            finally:
+                chaos.uninstall()
+
+            # supervised restart within the backoff budget, observed
+            # through the admin /shards page
+            restarted = False
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                st, body = _get(admin, "/shards")
+                assert st == 200
+                shards = json.loads(body)["group"]["shards"]
+                pids = {s["pid"] for s in shards
+                        if s["state"] == "running"}
+                if len(pids) == 3 and victim not in pids:
+                    restarted = True
+                    break
+                time.sleep(0.1)
+            assert restarted, "killed shard never restarted"
+            assert any(s["restarts"] >= 1 for s in shards), shards
+        finally:
+            _close_all(chans)
+            _stop(proc)
+
+
+class TestHangDetection:
+    def test_sigstopped_shard_is_killed_and_replaced(self):
+        """A shard that is alive but not heartbeating (SIGSTOP: the
+        process exists, the dump file stops moving) must be SIGKILLed
+        by the supervisor and replaced — crash detection alone would
+        wait forever on a wedged worker. In-process group: the fork
+        crosses the postfork registry from inside pytest."""
+        from brpc_tpu.rpc import Server, ServerOptions, Service
+        from brpc_tpu.rpc.shard_group import ShardGroupOptions
+        server = Server(ServerOptions(enable_builtin_services=False))
+        svc = Service("H")
+
+        @svc.method()
+        def Echo(cntl, request):
+            return bytes(request)
+
+        server.add_service(svc)
+        server.start("tcp://127.0.0.1:0", num_shards=2,
+                     shard_options=ShardGroupOptions(
+                         dump_interval_s=0.1, heartbeat_timeout_s=1.0,
+                         restart_backoff_s=0.2, enable_admin=False))
+        grp = server._shard_group
+        try:
+            pids0 = grp.shard_pids()
+            assert len(pids0) == 2
+            victim = pids0[0]
+            os.kill(victim, signal.SIGSTOP)
+            replaced = False
+            deadline = time.monotonic() + 12.0
+            while time.monotonic() < deadline:
+                pids = grp.shard_pids()
+                if len(pids) == 2 and victim not in pids:
+                    replaced = True
+                    break
+                time.sleep(0.1)
+            assert replaced, (victim, grp.group_status())
+        finally:
+            server.stop()
+            server.join(5)
+
+
+class TestMergedObservability:
+    def test_vars_merge_equals_sum_and_shard_views(self):
+        proc, port, admin = _spawn_group(2)
+        chans = []
+        try:
+            by_pid, chans = _chans_by_pid(port, want_pids=2)
+            for _ in range(30):
+                for ch in chans:
+                    assert not ch.call_sync(
+                        "Bench", "PyEcho", b"m").failed()
+            # traffic stopped: within a dump interval the per-shard
+            # counters freeze, and merged must equal their sum EXACTLY
+            key = "socket_read_bytes"
+            ok = False
+            for _ in range(10):
+                st, merged = _get(admin, f"/vars?prefix={key}")
+                assert st == 200
+                st0, v0 = _get(admin, f"/vars?prefix={key}&shard=0")
+                st1, v1 = _get(admin, f"/vars?prefix={key}&shard=1")
+                if st0 != 200 or st1 != 200:
+                    time.sleep(0.3)
+                    continue
+
+                def val(body):
+                    line = body.decode().strip().splitlines()[0]
+                    return int(float(line.split(":")[1]))
+
+                if val(merged) == val(v0) + val(v1) and val(v0) > 0 \
+                        and val(v1) > 0:
+                    ok = True
+                    break
+                time.sleep(0.3)
+            assert ok, "merged /vars never equaled the shard-dump sum"
+            # bad shard params are client errors, not silent fallbacks
+            st, body = _get(admin, "/vars?shard=7")
+            assert st == 400 and b"out of range" in body
+            st, body = _get(admin, "/vars?shard=x")
+            assert st == 400
+        finally:
+            _close_all(chans)
+            _stop(proc)
+
+    def test_status_merged_and_single_shard_views(self):
+        proc, port, admin = _spawn_group(2)
+        chans = []
+        try:
+            by_pid, chans = _chans_by_pid(port, want_pids=2)
+            for _ in range(40):
+                for ch in chans:
+                    assert not ch.call_sync(
+                        "Bench", "PyEcho", b"s").failed()
+            time.sleep(0.6)    # let both shards dump the final counts
+            st, body = _get(admin, "/status")
+            assert st == 200
+            merged = json.loads(body)
+            assert merged["mode"] == "shard_group"
+            assert merged["shards_reporting"] == 2
+            views = []
+            for i in range(2):
+                st, body = _get(admin, f"/status?shard={i}")
+                assert st == 200
+                v = json.loads(body)
+                assert v["shard"] == i and v["pid"] in by_pid
+                views.append(v)
+            # counters: merged == sum of the single-shard views
+            assert merged["processed"] == sum(
+                v["processed"] for v in views)
+            ms = merged["method_status"]["Bench.PyEcho"]
+            per = [v["method_status"]["Bench.PyEcho"] for v in views
+                   if "Bench.PyEcho" in v["method_status"]]
+            assert ms["count"] == sum(p["count"] for p in per)
+            # pooled percentiles land inside the per-shard envelope
+            # (they are an order statistic of the union)
+            p50s = [p["latency_p50_us"] for p in per]
+            assert min(p50s) * 0.5 <= ms["latency_p50_us"] \
+                <= max(p50s) * 2.0, (ms, per)
+            assert ms["max_latency_us"] == max(
+                p["max_latency_us"] for p in per)
+            # per-shard breakdown names both pids
+            pids = {v["pid"] for v in views}
+            assert {b["pid"] for b in
+                    merged["shard_breakdown"].values()} == pids
+        finally:
+            _close_all(chans)
+            _stop(proc)
+
+    def test_prometheus_merged_dump(self):
+        proc, port, admin = _spawn_group(2)
+        chans = []
+        try:
+            by_pid, chans = _chans_by_pid(port, want_pids=2)
+            for _ in range(10):
+                for ch in chans:
+                    ch.call_sync("Bench", "Echo", b"p")
+            time.sleep(0.6)
+            st, body = _get(admin, "/brpc_metrics")
+            assert st == 200
+            text = body.decode()
+            lines = {ln.split()[0]: ln.split()[1]
+                     for ln in text.splitlines() if " " in ln}
+            assert "socket_read_bytes" in lines, text[:400]
+            assert float(lines["socket_read_bytes"]) > 0
+            # and it matches the merged /vars reading of the same scrape
+            # window's order of magnitude (exactness is the /vars test)
+            st, mv = _get(admin, "/vars?prefix=socket_read_bytes")
+            assert st == 200
+            # ?shard=i narrows the prometheus dump to one worker too
+            st, b0 = _get(admin, "/brpc_metrics?shard=0")
+            assert st == 200 and b"socket_read_bytes" in b0
+            v0 = float([ln for ln in b0.decode().splitlines()
+                        if ln.startswith("socket_read_bytes ")][0]
+                       .split()[1])
+            assert 0 < v0 < float(lines["socket_read_bytes"])
+            st, bad = _get(admin, "/brpc_metrics?shard=9")
+            assert st == 400
+        finally:
+            _close_all(chans)
+            _stop(proc)
+
+
+class TestAggregatorMath:
+    """Merge math on synthetic dumps — no processes, exact assertions."""
+
+    def _write(self, tmp, i, vars=None, method=None, samples=None,
+               processed=0):
+        doc = {"shard": i, "pid": 1000 + i, "seq": 1, "time": time.time(),
+               "vars": vars or {},
+               "status": {"processed": processed, "errors": 0,
+                          "concurrency": 0, "services": {},
+                          "method_status": method or {},
+                          "saturation": {}},
+               "latency_samples": samples or {}}
+        with open(os.path.join(tmp, f"shard-{i}.json"), "w") as f:
+            json.dump(doc, f)
+
+    def test_counters_sum_exactly(self, tmp_path):
+        from brpc_tpu.rpc.shard_group import ShardAggregator
+        tmp = str(tmp_path)
+        self._write(tmp, 0, vars={"socket_writes": 120, "x_count": 3})
+        self._write(tmp, 1, vars={"socket_writes": 45, "x_count": 4})
+        agg = ShardAggregator(tmp, 2)
+        mv = agg.merged_vars()
+        assert mv["socket_writes"] == 165
+        assert mv["x_count"] == 7
+
+    def test_percentiles_merge_from_pooled_samples(self, tmp_path):
+        from brpc_tpu.rpc.shard_group import ShardAggregator
+        tmp = str(tmp_path)
+        # shard 0 fast (100..199us), shard 1 slow (1000..1999us), equal
+        # weights: pooled p50 sits at the boundary, p99 deep in shard
+        # 1's tail — an averaged-percentile merge would put p99 near
+        # 1500, the pooled order statistic near 1980
+        fast = [100.0 + i for i in range(100)]
+        slow = [1000.0 + 10 * i for i in range(100)]
+        self._write(tmp, 0,
+                    method={"S.M": {"count": 100, "qps": 10.0,
+                                    "latency_avg_us": 149.5,
+                                    "max_latency_us": 199.0}},
+                    samples={"S.M": fast})
+        self._write(tmp, 1,
+                    method={"S.M": {"count": 100, "qps": 5.0,
+                                    "latency_avg_us": 1495.0,
+                                    "max_latency_us": 1990.0}},
+                    samples={"S.M": slow})
+        agg = ShardAggregator(tmp, 2)
+        m = agg.merged_method_status()["S.M"]
+        assert m["count"] == 200
+        assert m["qps"] == 15.0
+        assert m["max_latency_us"] == 1990.0
+        pooled = sorted(fast + slow)
+        assert m["latency_p50_us"] == pytest.approx(
+            pooled[int(0.5 * len(pooled))], abs=1.0)
+        assert m["latency_p99_us"] == pytest.approx(
+            pooled[int(0.99 * len(pooled))], abs=1.0)
+        assert m["latency_p99_us"] > 1900    # not the averaged ~1500
+        # avg weights by count
+        assert m["latency_avg_us"] == pytest.approx(
+            (149.5 + 1495.0) / 2, rel=0.01)
+
+    def test_var_merge_semantics(self, tmp_path):
+        from brpc_tpu.rpc.shard_group import merge_var_values
+        # plain numbers sum
+        assert merge_var_values([3, 4]) == 7
+        # strings keep the first shard's reading
+        assert merge_var_values(["up", "up"]) == "up"
+        # stat dicts: counts sum, peaks max, fractions average
+        merged = merge_var_values([
+            {"count": 10, "peak_10s": 5, "busy_fraction": 0.2},
+            {"count": 30, "peak_10s": 9, "busy_fraction": 0.6},
+        ])
+        assert merged["count"] == 40
+        assert merged["peak_10s"] == 9
+        assert 0.2 <= merged["busy_fraction"] <= 0.6
+
+    def test_missing_and_torn_dumps_degrade(self, tmp_path):
+        from brpc_tpu.rpc.shard_group import ShardAggregator
+        tmp = str(tmp_path)
+        self._write(tmp, 0, vars={"socket_writes": 7}, processed=7)
+        with open(os.path.join(tmp, "shard-1.json"), "w") as f:
+            f.write('{"torn": ')       # unreadable: skipped, not fatal
+        agg = ShardAggregator(tmp, 2)
+        assert agg.merged_vars()["socket_writes"] == 7
+        st = agg.merged_status()
+        assert st["shards_reporting"] == 1
+        assert st["processed"] == 7
+        assert agg.shard_dump(1) is None
+
+
+class TestStartArguments:
+    def test_shard_mode_requires_tcp(self):
+        from brpc_tpu.rpc import Server, ServerOptions
+        server = Server(ServerOptions(enable_builtin_services=False))
+        with pytest.raises(ValueError, match="SO_REUSEPORT"):
+            server.start("mem://no-shards", num_shards=4)
+
+    def test_num_shards_one_is_plain_start(self):
+        from brpc_tpu.rpc import Server, ServerOptions, Service
+        server = Server(ServerOptions(enable_builtin_services=False))
+        svc = Service("S")
+
+        @svc.method()
+        def Echo(cntl, request):
+            return bytes(request)
+
+        server.add_service(svc)
+        try:
+            ep = server.start("tcp://127.0.0.1:0", num_shards=1)
+            assert server._shard_group is None
+            ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                         ChannelOptions(timeout_ms=3000))
+            assert not ch.call_sync("S", "Echo", b"one").failed()
+            ch.close()
+        finally:
+            server.stop()
+            server.join(2)

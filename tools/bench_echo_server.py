@@ -41,6 +41,13 @@ def main() -> None:
         return request
 
     @svc.method()
+    def PyEcho(cntl, request):
+        # plain Python-dispatch echo (no native C loop): the sharded
+        # lane measures single-vs-sharded on THIS method so the
+        # per-call cost is the GIL-bound framework path itself
+        return bytes(request)
+
+    @svc.method()
     async def Slow(cntl, request):
         # the 1%-long-tail request of the reference's latency-CDF
         # benchmark (docs/cn/benchmark.md:126-199): a deliberately slow

@@ -127,6 +127,52 @@ def main() -> int:
             best = max(best, k * (1 << 20) * 2 / dt / 1e9)
         out["mb_echo_GBps"] = round(best, 3)
         pooled.close()
+
+        # ---- shard scaling (ISSUE 5): sharded-group qps over
+        # single-process qps at EQUAL multi-process client load, on the
+        # Python-dispatch method (PyEcho) — the GIL-bound framework
+        # path shard groups exist to parallelize (the native-C echo
+        # saturates beyond what same-box Python clients can generate,
+        # which would measure the clients, not the shards). Clients
+        # must be separate PROCESSES for the same GIL reason. Skipped
+        # below 4 cores: there is no parallelism to measure there.
+        cores = os.cpu_count() or 1
+        if cores < 4:
+            out["shard_skipped"] = f"only {cores} cores"
+        else:
+            from qps_client import drive_multiproc
+            from spawn_util import spawn_announcing_server
+            nsh = max(2, min(4, cores // 3))
+            nclients = nsh + 2
+            single = drive_multiproc(port, nprocs=nclients, seconds=1.3,
+                                     conns=2, inflight=8,
+                                     method="PyEcho")
+            out["qps_single_mp"] = single["qps"]
+            sproc, got = spawn_announcing_server(
+                [os.path.join(BASE, "tools", "shard_server.py"),
+                 "--shards", str(nsh)], wall_s=30.0,
+                keys=("ADMIN", "PORT"))
+            if got is None:
+                out["shard_error"] = "shard server spawn failed"
+            else:
+                try:
+                    sharded = drive_multiproc(got["PORT"],
+                                              nprocs=nclients,
+                                              seconds=1.3, conns=2,
+                                              inflight=8,
+                                              method="PyEcho")
+                    out["qps_sharded_4B"] = sharded["qps"]
+                    out["shard_count"] = nsh
+                    out["shard_client_failures"] = sharded["failures"]
+                    if single["qps"]:
+                        out["shard_scaling"] = round(
+                            sharded["qps"] / single["qps"], 2)
+                finally:
+                    try:
+                        sproc.terminate()
+                        sproc.wait(10)
+                    except Exception:
+                        pass
     finally:
         try:
             proc.terminate()

@@ -256,6 +256,32 @@ def gate_trace_smoke() -> dict:
     return out
 
 
+def gate_shard_smoke() -> dict:
+    """One 2-shard reuseport group (tools/shard_server.py --smoke):
+    connections spread, a SIGKILLed shard restarts within the backoff
+    budget with zero errors on surviving shards' channels, retried
+    calls on the victim's connections succeed, and the merged /vars
+    counters equal the sum of the per-shard dumps. A subprocess so a
+    wedged group cannot hang the gate."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "shard_server.py"), "--smoke"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    out: dict = {"ok": proc.returncode == 0}
+    try:
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+        if proc.returncode == 0:
+            out["elapsed_s"] = report["smoke"]["elapsed_s"]
+            out["restart_s"] = report["smoke"]["restart_s"]
+            out["survivor_calls"] = report["smoke"]["survivor_calls"]
+        else:
+            out["invariant"] = report.get("invariant")
+    except (ValueError, KeyError, IndexError):
+        out["ok"] = False
+        out["error"] = (proc.stdout + proc.stderr)[-500:]
+    return out
+
+
 def gate_chaos_smoke() -> dict:
     """One seeded fault storm over mem:// (tools/chaos.py --smoke,
     ~10s budget): deadline shedding >= 99%, every call reaches a
@@ -332,6 +358,23 @@ def gate_perf_smoke() -> dict:
         if got < floor:
             out["ok"] = False
             out["regression"] = f"{key} {got} < floor {round(floor, 3)}"
+    # shard scaling is MACHINE-RELATIVE by construction: the shard
+    # count derives from the core count inside perf_smoke (skipped
+    # below 4 cores), and the floor scales with it — 0.4x per shard
+    # tolerates sandbox scheduling noise while a real serialization
+    # regression (scaling ~1) still fails by a wide margin.
+    if "shard_scaling" in report:
+        sfloor = 0.4 * report.get("shard_count", 0) * scale
+        out["shard_scaling_floor"] = round(sfloor, 2)
+        if report["shard_scaling"] < sfloor:
+            out["ok"] = False
+            out["regression"] = (f"shard_scaling {report['shard_scaling']}"
+                                 f" < floor {round(sfloor, 2)}")
+    elif "shard_skipped" not in report and \
+            "shard_error" not in report and \
+            os.cpu_count() and os.cpu_count() >= 4:
+        out["ok"] = False
+        out["regression"] = "shard_scaling missing from perf smoke"
     return out
 
 
@@ -341,6 +384,7 @@ def run_gate() -> int:
                      ("sanitizer_smoke", gate_sanitizer_smoke),
                      ("chaos_smoke", gate_chaos_smoke),
                      ("trace_smoke", gate_trace_smoke),
+                     ("shard_smoke", gate_shard_smoke),
                      ("perf_smoke", gate_perf_smoke)):
         try:
             report[name] = fn()

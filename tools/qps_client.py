@@ -1,0 +1,161 @@
+"""Multi-process qps driver for the sharded bench/smoke lanes.
+
+A single CPython client process is just as GIL-bound as a single
+server process: 8 threads of sync 4B echoes in one interpreter cap at
+roughly one core of client-side work, which would make a sharded
+SERVER look like it doesn't scale. Measuring shard scaling honestly
+needs client load that scales with cores too — so the driver is this
+tool run N times as separate processes, each driving ``conns``
+single-connection channels of PIPELINED async echoes (every completion
+re-issues from its done callback) for a fixed window.
+
+CLI (one worker):  qps_client.py PORT SECONDS CONNS [INFLIGHT] [METHOD]
+    prints one JSON line {"calls": n, "elapsed_s": dt, "qps": q}
+
+Library (the fan-out): ``drive_multiproc(port, nprocs, seconds,
+conns)`` spawns nprocs workers, sums their windows, and returns the
+aggregate qps — used by bench.py's sharded lane and the perf-smoke
+``shard_scaling`` gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+BASE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, BASE)
+
+
+def drive_window(port: int, seconds: float, conns: int,
+                 inflight: int = 8, method: str = "Echo") -> dict:
+    """Drive ``conns`` private connections for ``seconds``; returns
+    calls/elapsed/qps (failures counted apart — a dead window must be
+    visible, not a zero that looks slow).
+
+    Each connection runs ``inflight`` pipelined async calls, every
+    completion re-issuing from its done callback (the reference's
+    async-client loop): a sync sequential call is LATENCY-bound
+    (1/RTT per connection ≈ 1.5-3k qps here) and would measure the
+    round-trip, not the server's capacity; ``inflight=1`` degrades to
+    exactly that sync shape if wanted."""
+    from brpc_tpu.rpc import Channel, ChannelOptions
+
+    chs = [Channel(f"tcp://127.0.0.1:{port}",
+                   ChannelOptions(timeout_ms=5000, max_retry=2,
+                                  share_connections=False))
+           for _ in range(conns)]
+    for c in chs:
+        for _ in range(10):
+            c.call_sync("Bench", method, b"w")
+    counts = [0] * conns
+    failures = [0] * conns
+    stop_at = time.perf_counter() + seconds
+    done_ev = threading.Event()
+    live = [conns * inflight]          # in-flight lanes still running
+    # completions may land on different threads (inline on the
+    # dispatcher normally, fiber workers on spill): += is a
+    # read-modify-write, so the counters need a real lock — a lost
+    # live[0] decrement would park the window on its 20s timeout and
+    # report qps ~15x low, poisoning the shard_scaling gate
+    lock = threading.Lock()
+
+    def lane_done() -> None:
+        with lock:
+            live[0] -= 1
+            last = live[0] <= 0
+        if last:
+            done_ev.set()
+
+    def issue(i: int) -> None:
+        ch = chs[i]
+
+        def _done(cntl) -> None:
+            with lock:
+                if cntl.failed():
+                    failures[i] += 1
+                else:
+                    counts[i] += 1
+            if time.perf_counter() < stop_at:
+                issue(i)
+            else:
+                lane_done()
+
+        try:
+            ch.call("Bench", method, b"q", done=_done)
+        except Exception:
+            with lock:
+                failures[i] += 1
+            lane_done()
+
+    t0 = time.perf_counter()
+    for i in range(conns):
+        for _ in range(inflight):
+            issue(i)
+    done_ev.wait(seconds + 20)
+    dt = time.perf_counter() - t0
+    for c in chs:
+        c.close()
+    return {"calls": sum(counts), "failures": sum(failures),
+            "elapsed_s": round(dt, 3),
+            "qps": round(sum(counts) / dt, 1) if dt > 0 else 0.0}
+
+
+def drive_multiproc(port: int, nprocs: int, seconds: float,
+                    conns: int, inflight: int = 8,
+                    method: str = "Echo",
+                    wall_s: float = 60.0) -> dict:
+    """Aggregate qps over ``nprocs`` worker PROCESSES (each its own
+    GIL). Workers that fail to report are counted in ``dead_workers``
+    rather than silently shrinking the load."""
+    procs = []
+    for _ in range(nprocs):
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             str(port), str(seconds), str(conns), str(inflight),
+             method],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL))
+    total_calls = 0
+    total_failures = 0
+    dead = 0
+    max_dt = 0.0
+    deadline = time.monotonic() + wall_s
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=max(5.0,
+                                               deadline - time.monotonic()))
+            rec = json.loads(out.strip().splitlines()[-1])
+            total_calls += rec["calls"]
+            total_failures += rec.get("failures", 0)
+            max_dt = max(max_dt, rec["elapsed_s"])
+        except Exception:
+            dead += 1
+            try:
+                p.kill()
+            except Exception:
+                pass
+    return {"calls": total_calls, "failures": total_failures,
+            "workers": nprocs, "dead_workers": dead,
+            "elapsed_s": round(max_dt, 3),
+            "qps": round(total_calls / max_dt, 1) if max_dt > 0 else 0.0}
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    port = int(sys.argv[1])
+    seconds = float(sys.argv[2]) if len(sys.argv) > 2 else 1.5
+    conns = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+    inflight = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+    method = sys.argv[5] if len(sys.argv) > 5 else "Echo"
+    print(json.dumps(drive_window(port, seconds, conns, inflight, method)),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    rc = main()
+    os._exit(rc)   # skip runtime-thread teardown, like bench.py

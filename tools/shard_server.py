@@ -1,0 +1,231 @@
+"""Shard-group echo server tool: the sharded sibling of
+bench_echo_server.py, and the shard smoke that tools/preflight.py
+--gate runs.
+
+Server mode (tests + bench lane)::
+
+    shard_server.py [--shards N] [--port P]
+
+prints ``ADMIN <port>`` (the supervisor's merged-observability
+endpoint) then ``PORT <port>`` (the SO_REUSEPORT data plane) on
+stdout, then blocks until SIGTERM/parent-death like every tool server
+here. The Bench service exposes Echo (native fast path in each shard)
+and Pid — Pid is how a client learns which shard the kernel routed its
+connection to, the pinning primitive the chaos tests use.
+
+Smoke mode (``--smoke``, the preflight gate): a 2-shard group on an
+ephemeral port must (1) spread connections over both shards, (2)
+survive a SIGKILL of one shard with ZERO errors on channels pinned to
+the survivor and retried success on the victim's channels, (3) restart
+the dead shard within the backoff budget, and (4) serve a merged
+/vars whose counters equal the sum of the per-shard dumps. Prints one
+JSON line; rc 1 with {"invariant": ...} on the first violated
+invariant.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_template_server():
+    from brpc_tpu.rpc import Server, ServerOptions, Service
+
+    server = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("Bench")
+
+    @svc.method(native="echo")
+    async def Echo(cntl, request):
+        if cntl.request_attachment.size:
+            cntl.response_attachment = cntl.request_attachment
+        return request
+
+    @svc.method()
+    def PyEcho(cntl, request):
+        # the shard-scaling lane's measured method: a PLAIN Python
+        # handler, so every call pays the full GIL-bound framework
+        # path (parse, dispatch, fiber, serialize) — the cost shard
+        # groups exist to parallelize. The native="echo" method above
+        # is served in C and saturates far beyond what same-box Python
+        # clients can generate, which would measure the clients.
+        return bytes(request)
+
+    @svc.method()
+    def Pid(cntl, request):
+        # shard identity probe: which worker process owns THIS
+        # connection (reuseport routing is per-connection, so the
+        # answer is stable for a channel's lifetime)
+        return str(os.getpid()).encode()
+
+    server.add_service(svc)
+    return server
+
+
+def serve(shards: int, port: int) -> None:
+    from brpc_tpu.rpc.shard_group import ShardGroupOptions
+
+    server = make_template_server()
+    ep = server.start(f"tcp://127.0.0.1:{port}", num_shards=shards,
+                      shard_options=ShardGroupOptions(
+                          dump_interval_s=0.2))
+    grp = server._shard_group
+    print(f"ADMIN {grp.admin_endpoint.port}", flush=True)
+    print(f"PORT {ep.port}", flush=True)
+    server.run_until_asked_to_quit()
+
+
+# ------------------------------------------------------------------ smoke
+
+class SmokeFailure(AssertionError):
+    pass
+
+
+def _check(ok: bool, invariant: str) -> None:
+    if not ok:
+        raise SmokeFailure(invariant)
+
+
+def run_smoke() -> dict:
+    from brpc_tpu.rpc import Channel, ChannelOptions
+    from brpc_tpu.rpc.shard_group import ShardGroupOptions
+
+    report: dict = {}
+    server = make_template_server()
+    ep = server.start("tcp://127.0.0.1:0", num_shards=2,
+                      shard_options=ShardGroupOptions(
+                          dump_interval_s=0.15, restart_backoff_s=0.2))
+    grp = server._shard_group
+    chans = []
+    try:
+        pids0 = set(grp.shard_pids())
+        _check(len(pids0) == 2, "expected 2 live shards after start")
+
+        def new_chan():
+            ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                         ChannelOptions(timeout_ms=3000, max_retry=3,
+                                        share_connections=False))
+            chans.append(ch)
+            return ch
+
+        def pid_of(ch) -> int:
+            c = ch.call_sync("Bench", "Pid", b"")
+            _check(not c.failed(), f"Pid call failed: {c.error_text}")
+            return int(c.response_payload.to_bytes())
+
+        # connections must spread over both shards (kernel 4-tuple
+        # hashing: a handful of ephemeral ports covers 2 shards fast)
+        by_pid: dict = {}
+        deadline = time.monotonic() + 10.0
+        while len(by_pid) < 2 and time.monotonic() < deadline:
+            ch = new_chan()
+            by_pid.setdefault(pid_of(ch), []).append(ch)
+        _check(len(by_pid) == 2, "connections never spread to 2 shards")
+        report["conn_spread"] = {str(p): len(v) for p, v in by_pid.items()}
+
+        victim = next(iter(by_pid))
+        survivors = [c for p, v in by_pid.items() if p != victim for c in v]
+        victims = by_pid[victim]
+        os.kill(victim, signal.SIGKILL)
+        t_kill = time.monotonic()
+
+        # survivors: their connections live in other processes — ZERO
+        # errors allowed while the victim is down and restarting
+        errs = 0
+        calls = 0
+        while time.monotonic() - t_kill < 1.5:
+            for c in survivors:
+                calls += 1
+                if c.call_sync("Bench", "Echo", b"s").failed():
+                    errs += 1
+        report["survivor_calls"] = calls
+        _check(errs == 0, f"{errs} errors on surviving shards' channels")
+
+        # the victim's channels: the broken connection re-dials and the
+        # kernel routes it to a live shard — retried calls succeed
+        for c in victims:
+            r = c.call_sync("Bench", "Echo", b"v")
+            _check(not r.failed(),
+                   f"retried call on killed shard's channel failed: "
+                   f"{r.error_text}")
+
+        # supervisor restart within the backoff budget
+        restarted = False
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            pids = grp.shard_pids()
+            if len(pids) == 2 and victim not in pids:
+                restarted = True
+                break
+            time.sleep(0.05)
+        _check(restarted, "killed shard not restarted within 10s")
+        report["restart_s"] = round(time.monotonic() - t_kill, 2)
+
+        # merged /vars sanity: with traffic stopped, the merged counter
+        # equals the sum of the per-shard dumps (allow one dump
+        # interval for the restarted shard's first write)
+        time.sleep(0.5)
+        agg = grp.aggregator
+        key = "server_processed" if "server_processed" in \
+            agg.merged_vars() else "socket_read_bytes"
+        ok_sum = False
+        for _ in range(5):
+            dumps = agg.read_dumps()
+            merged = agg.merged_vars(key).get(key)
+            parts = [d["vars"].get(key) for d in dumps
+                     if key in d.get("vars", {})]
+            if len(dumps) == 2 and merged == sum(parts):
+                ok_sum = True
+                break
+            time.sleep(0.3)
+        _check(ok_sum, f"merged /vars {key} != sum of shard dumps")
+        report["merged_var"] = {key: merged, "shards": parts}
+        st = agg.merged_status()
+        _check(st.get("mode") == "shard_group"
+               and st.get("shards_reporting") == 2,
+               f"merged status malformed: {st.get('mode')}/"
+               f"{st.get('shards_reporting')}")
+        report["processed"] = st["processed"]
+        return report
+    finally:
+        for c in chans:
+            try:
+                c.close()
+            except Exception:
+                pass
+        server.stop()
+        server.join(5)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import argparse
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--shards", type=int, default=2)
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args()
+    if args.smoke:
+        t0 = time.monotonic()
+        try:
+            report = run_smoke()
+        except SmokeFailure as e:
+            print(json.dumps({"invariant": str(e)}))
+            return 1
+        report["elapsed_s"] = round(time.monotonic() - t0, 2)
+        print(json.dumps({"smoke": report}))
+        return 0
+    serve(args.shards, args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.stdout.flush()
+    os._exit(rc)

@@ -26,8 +26,24 @@ def spawn_port_server(argv, wall_s: float, env: Optional[dict] = None,
 
     Returns (proc, port); (None, None) if the child died or never
     announced within the deadline (the child is killed in that case).
-    Never raises.
+    Never raises. (The single-key shape of spawn_announcing_server.)
     """
+    proc, got = spawn_announcing_server(argv, wall_s, keys=("PORT",),
+                                        env=env, stderr=stderr)
+    if got is None:
+        return None, None
+    return proc, got["PORT"]
+
+
+def spawn_announcing_server(argv, wall_s: float, keys=("PORT",),
+                            env: Optional[dict] = None,
+                            stderr=subprocess.DEVNULL):
+    """Like spawn_port_server but collects SEVERAL ``<KEY> <n>``
+    announce lines (the shard tool prints ADMIN then PORT). Returns
+    (proc, {key: int}) once every key arrived; (None, None) if the
+    child died or the deadline passed first (child killed)."""
+    want = set(keys)
+    got = {}
     try:
         proc = subprocess.Popen([sys.executable] + list(argv),
                                 stdout=subprocess.PIPE, stderr=stderr,
@@ -44,8 +60,11 @@ def spawn_port_server(argv, wall_s: float, env: Optional[dict] = None,
                 pending += chunk
                 complete, _, pending = pending.rpartition(b"\n")
                 for ln in complete.decode("utf-8", "replace").splitlines():
-                    if ln.startswith("PORT "):
-                        return proc, int(ln.split()[1])
+                    parts = ln.split()
+                    if len(parts) == 2 and parts[0] in want:
+                        got[parts[0]] = int(parts[1])
+                if want.issubset(got):
+                    return proc, got
             if proc.poll() is not None:
                 return None, None
             time.sleep(0.05)
@@ -53,7 +72,7 @@ def spawn_port_server(argv, wall_s: float, env: Optional[dict] = None,
         pass
     try:
         proc.kill()
-        proc.wait(10)  # reap: no zombie for the rest of the caller's run
+        proc.wait(10)
     except Exception:
         pass
     return None, None
