@@ -979,6 +979,69 @@ def main() -> None:
                 result["partial"] = True
                 _progress({"progress": "error", "phase": "sharded",
                            "error": result["sharded"]["error"]})
+        # ---- flight-recorder lane (ISSUE 6): the measurement floor
+        # for every subsequent perf PR — continuous-profiler overhead
+        # (headline profiler_overhead_pct, acceptance <=5%) and the
+        # resident cost of an idle connection (bytes_per_idle_conn
+        # from a >=5k-conn hold, the connection-diet PR's baseline).
+        # Subprocesses: a wedged lane must not take the bench down.
+        if deadline.remaining() < 30.0:
+            result["flight"] = {"skipped": "wall budget"}
+            result["partial"] = True
+        else:
+            import subprocess as _sp
+            lane: dict = {}
+            try:
+                p = _sp.run(
+                    [sys.executable,
+                     os.path.join(base, "tools", "flight_smoke.py")],
+                    capture_output=True, text=True, timeout=180)
+                rep = json.loads(p.stdout.strip().splitlines()[-1])
+                lane["single"] = rep
+                if "profiler_overhead_pct" in rep:
+                    result["profiler_overhead_pct"] = \
+                        rep["profiler_overhead_pct"]
+            except Exception as e:  # noqa: BLE001 - diagnostics only
+                lane["error"] = f"{type(e).__name__}: {e}"[:200]
+                result["partial"] = True
+            if deadline.remaining() > 60.0 and (os.cpu_count() or 1) >= 4:
+                try:
+                    p = _sp.run(
+                        [sys.executable,
+                         os.path.join(base, "tools", "flight_smoke.py"),
+                         "--shards", "8", "--seconds", "2"],
+                        capture_output=True, text=True, timeout=180)
+                    lane["sharded"] = json.loads(
+                        p.stdout.strip().splitlines()[-1])
+                except Exception as e:  # noqa: BLE001
+                    lane["sharded"] = {
+                        "error": f"{type(e).__name__}: {e}"[:200]}
+            if deadline.remaining() > 45.0:
+                try:
+                    p = _sp.run(
+                        [sys.executable,
+                         os.path.join(base, "tools", "soak.py"),
+                         "--idle-conns", "5000", "--settle", "3"],
+                        capture_output=True, text=True, timeout=180)
+                    rep = json.loads(p.stdout.strip().splitlines()[-1])
+                    lane["idle_conns"] = rep
+                    if rep.get("ok"):
+                        result["bytes_per_idle_conn"] = \
+                            rep["bytes_per_idle_conn"]
+                except Exception as e:  # noqa: BLE001
+                    lane["idle_conns"] = {
+                        "error": f"{type(e).__name__}: {e}"[:200]}
+            else:
+                lane["idle_conns"] = {"skipped": "wall budget"}
+                result["partial"] = True
+            result["flight"] = lane
+            _progress({"progress": "flight_lane",
+                       "profiler_overhead_pct":
+                       result.get("profiler_overhead_pct"),
+                       "bytes_per_idle_conn":
+                       result.get("bytes_per_idle_conn"),
+                       "sharded_attribution":
+                       lane.get("sharded", {}).get("attribution_ratio")})
         ch.close()
     except BaseException as e:  # noqa: BLE001 - salvage partial data
         result["partial"] = True
@@ -1026,6 +1089,8 @@ def main() -> None:
         "qps_sharded_4B": result.get("qps_sharded_4B"),
         "shard_scaling": result.get("shard_scaling"),
         "shard_count": result.get("shard_count"),
+        "profiler_overhead_pct": result.get("profiler_overhead_pct"),
+        "bytes_per_idle_conn": result.get("bytes_per_idle_conn"),
         "device_lane": ("error" if ("error" in lane or
                                     "lane_error" in lane)
                         else ("ok" if lane else "absent")),

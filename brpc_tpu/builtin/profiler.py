@@ -1,59 +1,19 @@
-"""Sampling CPU profiler for /hotspots (builtin/hotspots_service.cpp —
-the reference shells into gperftools; a Python runtime profiles itself
-by sampling ``sys._current_frames()`` across ALL threads, which is what
-the fiber workers are).
+"""/hotspots rendering + heap profiles (builtin/hotspots_service.cpp —
+the reference shells into gperftools; a Python runtime profiles itself).
 
-Output: aggregated top-of-stack counts plus folded stacks compatible
-with flamegraph tooling (the reference renders the same data through
-pprof+flamegraph)."""
+The SAMPLING itself lives in ``builtin/flight_recorder.py``: the
+continuous profiler's dedicated thread walks ``sys._current_frames()``
+and also executes on-demand profile jobs, so the HTTP handler never
+pins a worker for the sample window. This module keeps the render
+half — text top-N, folded stacks for flamegraph.pl, the self-contained
+SVG flamegraph — and the tracemalloc heap/growth pages."""
 
 from __future__ import annotations
 
-import sys
 import threading
-import time
 import zlib
 from collections import Counter
-from typing import Dict, List, Tuple
-
-_profile_lock = threading.Lock()     # one profile at a time, like /hotspots
-
-
-def _frame_id(frame) -> str:
-    code = frame.f_code
-    return f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}:{frame.f_lineno})"
-
-
-def sample_cpu(seconds: float = 1.0, interval_s: float = 0.005,
-               max_stack: int = 64) -> Tuple[Counter, Counter, int]:
-    """Sample every thread's stack for ``seconds``. Returns
-    (leaf_counts, folded_stack_counts, nsamples)."""
-    if not _profile_lock.acquire(blocking=False):
-        raise RuntimeError("another profile is already running")
-    try:
-        me = threading.get_ident()
-        leaves: Counter = Counter()
-        folded: Counter = Counter()
-        nsamples = 0
-        deadline = time.monotonic() + seconds
-        while time.monotonic() < deadline:
-            for tid, frame in sys._current_frames().items():
-                if tid == me:
-                    continue
-                stack: List[str] = []
-                f = frame
-                while f is not None and len(stack) < max_stack:
-                    stack.append(_frame_id(f))
-                    f = f.f_back
-                if not stack:
-                    continue
-                leaves[stack[0]] += 1
-                folded[";".join(reversed(stack))] += 1
-                nsamples += 1
-            time.sleep(interval_s)
-        return leaves, folded, nsamples
-    finally:
-        _profile_lock.release()
+from typing import List
 
 
 def render_text(leaves: Counter, nsamples: int, top: int = 40) -> str:

@@ -17,19 +17,59 @@ def connections_page(server) -> dict:
     incident) is debuggable from the browser — which peer is isolated,
     for how long, how much load was shed. ONE builder shared by the
     RPC builtin service and the HTTP /connections handler, so the two
-    views cannot diverge."""
+    views cannot diverge. Each row carries its resource-census cost
+    (bytes held, idle class, last-active) from the same accounting
+    authority as /census (socket_census_rows), so THIS server's rows
+    sum to the census sockets subsystem's server_bytes/server_count
+    (the process-wide bytes/count additionally include client-channel
+    sockets, which /connections does not list)."""
+    import time as _time
+
+    from brpc_tpu.butil.flags import flag as _flag
     from brpc_tpu.rpc.circuit_breaker import all_breaker_snapshots
     robustness = dict(dump_exposed("chaos_injected_"))
     for name in ("server_deadline_shed", "retry_suppressed_budget"):
         robustness.update(dump_exposed(name))
-    return {
-        "connections": [{
+    idle_after = _flag("census_idle_s")
+    now = _time.monotonic_ns()
+    rows = []
+    for s in server.connections():
+        idle_s = (now - s.last_active_ns) / 1e9
+        rows.append({
             "remote": str(s.remote_endpoint) if s.remote_endpoint else None,
             "failed": s.failed,
-        } for s in server.connections()],
+            "resident_bytes": s.input_portal.size + s.wq_bytes,
+            "last_active_s": round(idle_s, 3),
+            "idle_class": "idle" if idle_s >= idle_after else "active",
+        })
+    return {
+        "connections": rows,
         "breakers": all_breaker_snapshots(),
         "robustness": robustness,
     }
+
+
+def census_page_payload(server=None) -> dict:
+    """The /census payload: per-subsystem byte/object census (registered
+    through butil.resource_census) plus the connection roll-up from the
+    shared accounting authority. ONE builder shared by the RPC builtin
+    service and the HTTP /census handler, so the two views cannot
+    diverge."""
+    from brpc_tpu.butil.resource_census import census_page
+    out = census_page()
+    # connection roll-up derived from the sockets subsystem's ONE walk
+    # (a second socket pass here would double both the cost and the
+    # race window, and could disagree with the subsystem numbers)
+    sub = out["subsystems"].get("sockets", {})
+    count = sub.get("count", 0) or 0
+    total = sub.get("bytes", 0) or 0
+    out["connections"] = {
+        "count": count,
+        "resident_bytes": total,
+        "idle": sub.get("idle", 0) or 0,
+        "avg_bytes": round(total / count, 1) if count else 0.0,
+    }
+    return out
 
 
 def status_page(server) -> dict:
@@ -105,6 +145,11 @@ def add_builtin_services(server) -> None:
     @builtin.method()
     def connections(cntl, request):
         return json.dumps(connections_page(server), default=str).encode()
+
+    @builtin.method()
+    def census(cntl, request):
+        return json.dumps(census_page_payload(server),
+                          default=str).encode()
 
     try:
         server.add_service(builtin)

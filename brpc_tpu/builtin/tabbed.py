@@ -15,8 +15,10 @@ TABS = [
     ("flags", "/flags"),
     ("rpcz", "/rpcz"),
     ("hotspots", "/hotspots?seconds=1"),
+    ("continuous", "/hotspots?mode=continuous"),
     ("heap", "/hotspots?type=heap"),
     ("contentions", "/contentions"),
+    ("census", "/census"),
     ("connections", "/connections"),
     ("sockets", "/sockets"),
     ("fibers", "/fibers"),

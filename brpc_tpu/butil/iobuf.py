@@ -223,6 +223,16 @@ from brpc_tpu.butil import postfork as _postfork  # noqa: E402
 
 _postfork.register("butil.iobuf", pool.postfork_reset)
 
+from brpc_tpu.butil import resource_census as _census  # noqa: E402
+#   (census registration ships with the pool it measures)
+
+_census.register("iobuf_pool", lambda: {
+    "bytes": pool.cached_bytes(),
+    "count": sum(len(l) for l in pool.classes.values()),
+    "hit_ratio": round(pool.hit_ratio(), 4),
+    "outstanding": pool.outstanding,
+})
+
 
 def _recycle_buffer(buf: bytearray) -> None:
     pool.recycle(buf)

@@ -1,0 +1,114 @@
+"""Resource census: who holds how many bytes/objects, per subsystem.
+
+The connection-scale roadmap item starts from a measurement problem:
+nothing bounds per-connection cost because nothing MEASURES it. This
+registry is the measurement floor — each resource-holding subsystem
+(IOBuf BlockPool, live sockets, span store, bvar registry, pending
+timers, live fibers, open fds) registers a snapshot callback at import
+time, and ``snapshot()`` assembles the process-wide census served at
+``/census`` and embedded in shard dumps.
+
+Provider contract: a zero-arg callable returning a flat dict of
+numbers/strings. Keys named ``bytes`` (or ``*_bytes``) roll up into the
+census total; ``count`` is the subsystem's object count. Providers must
+be CHEAP (the page is on-demand, but shard dumps may embed the census
+at their dump cadence) and must never raise — snapshot() quarantines a
+throwing provider into an ``error`` entry instead of losing the page.
+
+Like the bvar registry, the census registry itself is fork-safe plain
+data: providers are module-level registrations that survive the fork
+and re-read their (reset) singletons lazily. Only the lock needs
+postfork hygiene.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Tuple
+
+_lock = threading.Lock()
+_providers: List[Tuple[str, Callable[[], dict]]] = []
+
+
+def register(name: str, fn: Callable[[], dict]) -> None:
+    """Register (or replace) subsystem ``name``'s census provider.
+    Replacement keyed by name keeps module reloads from stacking stale
+    closures (same discipline as butil.postfork.register)."""
+    with _lock:
+        for i, (n, _) in enumerate(_providers):
+            if n == name:
+                _providers[i] = (name, fn)
+                return
+        _providers.append((name, fn))
+
+
+def registered_names() -> List[str]:
+    with _lock:
+        return [n for n, _ in _providers]
+
+
+def snapshot() -> Dict[str, dict]:
+    """One census pass: {subsystem: provider_dict}. A failing provider
+    yields {"error": ...} — the rest of the census must still render
+    (observability never takes down observability)."""
+    with _lock:
+        providers = list(_providers)
+    out: Dict[str, dict] = {}
+    for name, fn in providers:
+        try:
+            d = fn()
+            out[name] = d if isinstance(d, dict) else {"value": d}
+        except Exception as e:  # noqa: BLE001 - quarantine, don't lose page
+            out[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    return out
+
+
+def total_bytes(census: Dict[str, dict] | None = None) -> int:
+    """Sum of every provider's byte-denominated keys."""
+    census = snapshot() if census is None else census
+    total = 0
+    for d in census.values():
+        for k, v in d.items():
+            if (k == "bytes" or k.endswith("_bytes")) and \
+                    isinstance(v, (int, float)) and not isinstance(v, bool):
+                total += int(v)
+    return total
+
+
+def census_page() -> dict:
+    """The /census payload (shared by the HTTP handler and the builtin
+    RPC service so the two views cannot diverge)."""
+    c = snapshot()
+    return {"subsystems": c, "total_bytes": total_bytes(c)}
+
+
+def _postfork_reset() -> None:
+    """Fork hygiene: registrations are plain data and stay valid (each
+    provider re-reads its subsystem's post-reset singletons), but the
+    lock may have been held by a dead parent thread at fork time."""
+    global _lock
+    _lock = threading.Lock()
+
+
+from brpc_tpu.butil import postfork  # noqa: E402  (registration ships
+#                                      with the registry it guards)
+
+postfork.register("butil.resource_census", _postfork_reset)
+
+
+# ------------------------------------------------------------ providers
+# Providers for subsystems with no importable module of their own (fds)
+# or whose module must not import census machinery (keep butil leaf
+# modules dependency-light). Everything else registers from its own
+# module bottom: iobuf pool, sockets, span store, bvar registry, timers,
+# fibers.
+
+def _fd_census() -> dict:
+    import os
+    try:
+        return {"count": len(os.listdir("/proc/self/fd"))}
+    except OSError:
+        return {"count": -1}
+
+
+register("fds", _fd_census)

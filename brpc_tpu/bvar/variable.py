@@ -94,3 +94,16 @@ from brpc_tpu.butil import postfork as _postfork  # noqa: E402
 #   (registration ships with the registry it guards)
 
 _postfork.register("bvar.variable", _postfork_reset)
+
+
+def _bvar_census() -> dict:
+    """Resource census: exposed-variable count (per-connection or
+    per-method bvar leaks show up here long before they hurt)."""
+    with _registry_lock:
+        return {"count": len(_registry)}
+
+
+from brpc_tpu.butil import resource_census as _census  # noqa: E402
+#   (census registration ships with the registry it measures)
+
+_census.register("bvar", _bvar_census)

@@ -29,6 +29,22 @@ class ContentionSample(NamedTuple):
 global_contention_collector = Collector(200, name="contention")
 
 
+def _postfork_reset() -> None:
+    """Fork hygiene: the collected samples describe PARENT-side lock
+    waits and the budget lock may have been held by a dead thread at
+    fork time — a shard starts with a clean contention profile."""
+    import threading
+    global_contention_collector._lock = threading.Lock()
+    global_contention_collector._ring.clear()
+    global_contention_collector._window_used = 0
+
+
+from brpc_tpu.butil import postfork as _postfork  # noqa: E402
+#   (registration ships with the collector it resets)
+
+_postfork.register("fiber.contention", _postfork_reset)
+
+
 def record_contention(mutex, wait_us: float) -> None:
     if not flag("contention_profiler_enabled"):
         return

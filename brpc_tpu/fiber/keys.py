@@ -27,6 +27,13 @@ class FiberLocal:
             return getattr(self._thread_fallback, "value", default)
         return f.locals.get(self._id, default)
 
+    def peek(self, fiber, default: Any = None) -> Any:
+        """Read ANOTHER fiber's slot (no thread fallback) — the
+        flight-recorder sampler uses this to attribute a worker
+        thread's sample to the RPC the fiber on it is serving. Racy by
+        contract: dict reads are GIL-atomic, staleness is acceptable."""
+        return fiber.locals.get(self._id, default)
+
     def set(self, value: Any) -> None:
         f = current_fiber()
         if f is None:

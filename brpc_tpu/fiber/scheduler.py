@@ -177,18 +177,68 @@ class Fiber:
 _joiner_init_lock = threading.Lock()
 
 
+class _CurrentCell:
+    """Per-thread mutable holder of the fiber being stepped. A PLAIN
+    object (not thread-local storage) registered by thread ident, so
+    the flight-recorder sampler can read any thread's current fiber
+    from outside — attributing a stack sample to the RPC method that
+    fiber is serving. Reads are racy by design: a torn read costs one
+    misattributed sample, never a crash."""
+
+    __slots__ = ("current",)
+
+    def __init__(self):
+        self.current: Optional[Fiber] = None
+
+
 class _WorkerTLS(threading.local):
     def __init__(self):
         self.group: Optional["TaskGroup"] = None
-        self.current: Optional[Fiber] = None
         self.inline_depth: int = 0
+        # threading.local runs __init__ on each thread's FIRST attribute
+        # touch, on that thread — so this registration executes exactly
+        # once per thread, keyed by its own ident
+        self.cell = _CurrentCell()
+        _cell_by_thread[threading.get_ident()] = self.cell
+
+
+# thread ident -> that thread's _CurrentCell (see _WorkerTLS.__init__);
+# entries of dead threads are pruned by the sampler against the live
+# tid set from sys._current_frames()
+_cell_by_thread: dict = {}
 
 
 _tls = _WorkerTLS()
 
 
 def current_fiber() -> Optional[Fiber]:
-    return _tls.current
+    return _tls.cell.current
+
+
+def thread_current_fiber(tid: int) -> Optional[Fiber]:
+    """The fiber currently being stepped on thread ``tid`` (racy
+    snapshot for samplers/watchdogs), or None for non-fiber threads and
+    threads between steps."""
+    cell = _cell_by_thread.get(tid)
+    return cell.current if cell is not None else None
+
+
+_prune_suspects: set = set()
+
+
+def prune_thread_registry(live_tids) -> None:
+    """Drop cells of dead threads (sampler housekeeping). TWO-strike:
+    a cell is only removed when its thread was absent from two
+    CONSECUTIVE live snapshots — a brand-new thread can register its
+    cell between the sampler's frames snapshot and this prune, and a
+    one-shot prune would delete it forever (threading.local.__init__
+    never reruns, so the cell could not come back)."""
+    global _prune_suspects
+    # snapshot: another thread's FIRST _tls touch inserts mid-iteration
+    gone = {tid for tid in list(_cell_by_thread) if tid not in live_tids}
+    for tid in gone & _prune_suspects:
+        _cell_by_thread.pop(tid, None)
+    _prune_suspects = gone
 
 
 def current_group() -> Optional["TaskGroup"]:
@@ -492,8 +542,9 @@ class TaskControl:
 
     def _step(self, group: TaskGroup, fiber: Fiber) -> None:
         """Advance the fiber one leg: run until it finishes or awaits."""
-        prev = _tls.current
-        _tls.current = fiber
+        cell = _tls.cell
+        prev = cell.current
+        cell.current = fiber
         fiber.state = FIBER_STATE_RUNNING
         ready_ns = fiber._ready_ns
         group.nswitches += 1
@@ -515,16 +566,16 @@ class TaskControl:
             token = fiber.coro.send(fiber._resume_value)
         except StopIteration as e:
             self.busy_ns.add(time.perf_counter_ns() - t0)
-            _tls.current = prev
+            cell.current = prev
             fiber._finish(e.value, None)
             return
         except BaseException as e:
             self.busy_ns.add(time.perf_counter_ns() - t0)
-            _tls.current = prev
+            cell.current = prev
             fiber._finish(None, e)
             return
         self.busy_ns.add(time.perf_counter_ns() - t0)
-        _tls.current = prev
+        cell.current = prev
         fiber.state = FIBER_STATE_SUSPENDED
         fiber._resume_value = None
         if token is None:
@@ -649,9 +700,32 @@ def _postfork_reset() -> None:
     _global_lock = threading.Lock()
     _wake_rec = None
     _wake_rec_lock = threading.Lock()
+    # the cell registry names PARENT threads; only the forking thread
+    # survives — re-register its own cell (its thread-local state
+    # itself survives the fork)
+    _cell_by_thread.clear()
+    _cell_by_thread[threading.get_ident()] = _tls.cell
 
 
 from brpc_tpu.butil import postfork  # noqa: E402  (registration ships
 #                                      with the singleton it resets)
 
 postfork.register("fiber.scheduler", _postfork_reset)
+
+
+def _fiber_census() -> dict:
+    """Resource census: live fiber count off the cheap Adder (the
+    gc-walk in fiber.stacks is for on-demand stack dumps only). Peeks —
+    a census scrape must not build a TaskControl."""
+    c = _global_control
+    if c is None:
+        return {"count": 0, "workers": 0}
+    return {"count": max(0, int(c.nfibers.get_value() or 0)),
+            "workers": c.concurrency,
+            "runqueue_depth": c.runqueue_depth()}
+
+
+from brpc_tpu.butil import resource_census as _census  # noqa: E402
+#   (census registration ships with the singleton it measures)
+
+_census.register("fibers", _fiber_census)

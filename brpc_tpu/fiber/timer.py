@@ -101,6 +101,12 @@ class TimerThread:
                 wait = (self._heap[0][0] - now) if self._heap else 1.0
                 self._cond.wait(min(max(wait, 0.0), 1.0))
 
+    def pending(self) -> int:
+        """Live (non-cancelled) timers in the heap — a per-connection
+        timer leak is visible here long before the heap hurts."""
+        with self._cond:
+            return len(self._boxes)
+
     def stop(self) -> None:
         self._stop = True
         with self._cond:
@@ -133,6 +139,13 @@ from brpc_tpu.butil import postfork  # noqa: E402  (registration ships
 #                                      with the singleton it resets)
 
 postfork.register("fiber.timer", _postfork_reset)
+
+from brpc_tpu.butil import resource_census as _census  # noqa: E402
+#   (census registration ships with the singleton it measures)
+
+#   peek, never instantiate: a census scrape must not start the thread
+_census.register("timers", lambda: {
+    "count": _global_timer.pending() if _global_timer is not None else 0})
 
 
 def sleep(seconds: float) -> SchedAwaitable:

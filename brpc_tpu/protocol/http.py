@@ -435,7 +435,26 @@ class HttpProtocol(Protocol):
                 {"inflight_client_calls": max(0, len(_call_pool) - 1)}
             ).encode()
         if path == "/hotspots" or path == "/pprof/profile":
-            return await self._hotspots(req)
+            return await self._hotspots(req, agg=agg)
+        if path == "/census":
+            from brpc_tpu.builtin.services import census_page_payload
+            if agg is not None:
+                # supervisor: the group-wide census (per-shard payloads
+                # ride the dumps; counts/bytes sum); ?shard=i narrows
+                shard, err = _shard_param(agg, req)
+                if err is not None:
+                    return err
+                if shard is not None:
+                    dump = agg.shard_dump(shard)
+                    if dump is None or not dump.get("census"):
+                        return (404, "text/plain",
+                                f"no census for shard {shard}".encode())
+                    return 200, "application/json", json.dumps(
+                        dump["census"], default=str).encode()
+                return 200, "application/json", json.dumps(
+                    agg.merged_census(), default=str).encode()
+            return 200, "application/json", json.dumps(
+                census_page_payload(server), default=str).encode()
         if path == "/contentions":
             from brpc_tpu.fiber.contention import contention_report
             rows = contention_report(int(req.query.get("n", "30")))
@@ -505,12 +524,10 @@ class HttpProtocol(Protocol):
                 for g in c.groups},
         }).encode()
 
-    async def _hotspots(self, req: HttpRequest):
-        import threading
-
+    async def _hotspots(self, req: HttpRequest, agg=None):
         from brpc_tpu.builtin.profiler import (
             growth_profile, heap_profile, heap_stop, render_flamegraph_svg,
-            render_folded, render_text, sample_cpu)
+            render_folded, render_text)
         from brpc_tpu.fiber.sync import FiberEvent
         ptype = req.query.get("type", "cpu")
         if ptype in ("heap", "growth"):
@@ -526,31 +543,78 @@ class HttpProtocol(Protocol):
             return 200, "text/plain", text.encode()
         if ptype != "cpu":
             return 400, "text/plain", b"type must be cpu|heap|growth"
+        fmt = req.query.get("format")
+        from brpc_tpu.builtin import flight_recorder as fr
+        if req.query.get("mode") == "continuous":
+            # the always-on flight recorder: serve the windowed ring,
+            # no sample wait. A shard-group SUPERVISOR merges the
+            # per-shard recorder states from the dumps (counters sum —
+            # the PR 5 aggregation discipline); ?shard=i narrows.
+            if agg is not None:
+                if _query_flag(req, "diff"):
+                    return (400, "text/plain",
+                            b"diff is per-process; use ?shard=i on a "
+                            b"worker")
+                shard, err = _shard_param(agg, req)
+                if err is not None:
+                    return err
+                states = []
+                dumps = [agg.shard_dump(shard)] if shard is not None \
+                    else agg.read_dumps()
+                for d in dumps:
+                    if d and d.get("hotspots"):
+                        states.append(d["hotspots"])
+                m = fr.merge_dump_states(states)
+            else:
+                rec = fr.global_recorder()
+                if _query_flag(req, "diff"):
+                    return (200, "text/plain",
+                            fr.render_diff_text(rec.window_diff()).encode())
+                m = rec.merged()
+                from brpc_tpu.transport.event_dispatcher import (
+                    stall_ms_max_10s)
+                m["stall_ms_max_10s"] = stall_ms_max_10s()
+            if fmt == "folded":
+                return 200, "text/plain", render_folded(
+                    m["folded"]).encode()
+            if fmt in ("svg", "flamegraph"):
+                return (200, "image/svg+xml",
+                        render_flamegraph_svg(m["folded"]).encode())
+            if fmt == "json":
+                return 200, "application/json", json.dumps({
+                    "nsamples": m["nsamples"], "nbusy": m["nbusy"],
+                    "windows": m.get("windows"),
+                    "span_s": m.get("span_s"),
+                    "stall_ms_max_10s": m.get("stall_ms_max_10s"),
+                    "labels": dict(m["labels"]),
+                    "folded": dict(m["folded"].most_common(200)),
+                }).encode()
+            return (200, "text/plain",
+                    fr.render_continuous_text(m).encode())
         try:
             seconds = min(30.0, float(req.query.get("seconds", "1")))
         except ValueError:
             return 400, "text/plain", b"bad seconds"
-        # sample on a dedicated pthread: the time.sleep loop would
-        # otherwise pin this worker (and profile an idle process)
+        # on-demand profile: the sample loop runs on the flight
+        # recorder's sampler thread; THIS handler fiber parks on an
+        # event (a worker is never pinned for the sample window), and a
+        # concurrent profile is refused with 503 instead of queueing —
+        # one profile at a time, like the reference's /hotspots.
         done = FiberEvent()
         result: dict = {}
 
-        def run():
-            try:
-                result["v"] = sample_cpu(seconds)
-            except Exception as e:
-                result["e"] = e
+        def on_done(leaves, folded, n):
+            result["v"] = (leaves, folded, n)
             done.set()
 
-        threading.Thread(target=run, name="hotspots_sampler",
-                         daemon=True).start()
+        rec = fr.global_recorder()
+        if not rec.request_profile(seconds, 0.005, on_done):
+            return (503, "text/plain",
+                    b"another profile is already running")
         await done.wait(seconds + 30)
-        if "e" in result:
-            return 503, "text/plain", str(result["e"]).encode()
         if "v" not in result:
             return 503, "text/plain", b"profile did not complete"
         leaves, folded, n = result["v"]
-        fmt = req.query.get("format")
         if fmt == "folded":
             return 200, "text/plain", render_folded(folded).encode()
         if fmt in ("svg", "flamegraph"):

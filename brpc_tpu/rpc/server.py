@@ -214,6 +214,13 @@ class Server:
             wq_peak = _wqueue_peak_window()
             PassiveStatus(lambda: wq_peak.get_value() or 0).expose(
                 "socket_wqueue_peak_10s")
+            # connection-cost census + stall-watchdog bvars follow the
+            # same re-expose lifecycle as the socket counters above
+            from brpc_tpu.transport.event_dispatcher import (
+                expose_stall_vars)
+            from brpc_tpu.transport.socket import expose_conn_census_vars
+            expose_conn_census_vars()
+            expose_stall_vars()
             # scheduler saturation trio (runqueue depth/peak, worker
             # busy fraction) + fiber counters: /vars + prometheus
             self._control.expose_vars()
@@ -228,6 +235,12 @@ class Server:
         self._running = True
         self._stopped_event.clear()
         self._maybe_install_sigterm()
+        # flight recorder: continuous profiler + event-loop stall
+        # watchdog ride a serving process (honors the hz flag at
+        # runtime; a forked shard re-starts its own — the postfork
+        # registry dropped the parent's recorder)
+        from brpc_tpu.builtin.flight_recorder import global_recorder
+        global_recorder().ensure_running()
         return self._endpoint
 
     def _maybe_install_sigterm(self) -> None:

@@ -153,6 +153,28 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     t0 = time.monotonic_ns()
     cntl = Controller()
     d = cntl.__dict__
+    # serving context for the WHOLE request residence (parse, shed
+    # gates, interceptor, handler, response serialize/write): nested
+    # Channel.call reads it for deadline/trace inheritance, and the
+    # flight recorder's sampler reads it to attribute this fiber's
+    # samples to the method. Cleared in the outermost finally — input
+    # fibers serve many requests and a stale context would clamp an
+    # unrelated later call.
+    _serving_cntl.set(cntl)
+    try:
+        await _process_request_body(proto, msg, socket, server, method,
+                                    method_key, cntl, d, t0)
+    finally:
+        _serving_cntl.set(None)
+
+
+async def _process_request_body(proto, msg: RpcMessage, socket, server,
+                                method, method_key: str, cntl: Controller,
+                                d: dict, t0: int) -> None:
+    meta = msg.meta
+    cid = meta.correlation_id
+    req_meta = meta.request
+    auth_ctx = socket.user_data.get("auth_context")
     # deadline propagation: the wire's timeout_ms is the client's whole
     # budget; it counts from the message's cut-time stamp so dispatch
     # queueing (spawned fibers behind busy workers) spends it. The
@@ -180,11 +202,19 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     d["_service_name"] = req_meta.service_name
     d["_method_name"] = req_meta.method_name
     d["_server_socket"] = socket
+    # connection-affinity hint for the flight recorder: transport-side
+    # samples (the dispatcher draining this conn's bytes) attribute to
+    # the method the conn last served — one attr store per request
+    socket.last_method = method_key
     rz = flag("rpcz_enabled")
     if rz:
         from brpc_tpu.rpc.span import finish_span, start_server_span
         span = start_server_span(cntl, req_meta.service_name,
                                  req_meta.method_name)
+        # the flight recorder's stall watchdog reaches the ACTIVE span
+        # through the serving controller (thread -> fiber -> cntl ->
+        # span) to annotate an event-thread monopolization in place
+        d["_span"] = span
         span.request_size = msg.payload.size + msg.attachment.size
         # timeline base: the frame's cut-time stamp — latency_us then
         # measures full server residence (arrival -> response flushed),
@@ -286,9 +316,8 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     if pool is not None:
         cntl._session_local = pool.borrow()
     response = None
-    # serving context: nested Channel.call made by the handler inherits
-    # this request's remaining budget (min(own timeout, remaining))
-    _serving_cntl.set(cntl)
+    # (the serving context was installed by process_request for the
+    # whole request residence; nested Channel.call inherits through it)
     try:
         if not method.is_coroutine and current_group() is None and \
                 not getattr(server.options, "usercode_in_pthread", False):
@@ -334,9 +363,6 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
         # handler raised still shows where the time went)
         if rz and span.handler_start_us and not span.handler_end_us:
             span.handler_end_us = time.monotonic_ns() // 1000
-        # cleared HERE, not at fiber exit: input fibers serve many
-        # requests and a stale serving context would clamp later calls
-        _serving_cntl.set(None)
         if pool is not None:
             pool.give_back(cntl._session_local)
             cntl._session_local = None
@@ -632,11 +658,15 @@ def process_request_fast(proto, socket, server, cid: int, service: str,
                     "max_concurrency reached")
         return None
     method_key = method.full_name or f"{service}.{method_name}"
+    socket.last_method = method_key   # flight-recorder affinity hint
     if _track_pending(socket):
         # claimed HERE (before the handler can suspend and let the
         # input loop continue); _drive_fast's finally settles it
         with socket.pending_lock:
             socket.pending_responses += 1
+    # the fiber is NAMED with the method key: the flight recorder's
+    # sampler attributes a turbo-lane sample to its RPC method through
+    # the fiber name alone — the slim path never pays a fiber-local set
     coro = _drive_fast(proto, socket, server, method, method_key, cid,
                        service, method_name, log_id, payload, att)
     if not method.is_coroutine and not is_last:
@@ -645,12 +675,12 @@ def process_request_fast(proto, socket, server, cid: int, service: str,
         # burst message gets a fresh fiber, so it can't serialize the
         # burst behind it (async handlers stay inline — suspension is
         # their fan-out)
-        socket._control.spawn(coro, name="turbo_req")
+        socket._control.spawn(coro, name=method_key)
     else:
         # run_inline gives the first leg full fiber context
         # (_tls.current for fiber-locals) and owns the depth cap /
         # suspension parking
-        socket._control.run_inline(coro, name="turbo_req")
+        socket._control.run_inline(coro, name=method_key)
     return None
 
 

@@ -94,7 +94,8 @@ def write_shard_dump(dirpath: str, index: int, server, seq: int) -> None:
     method — percentiles merge from pooled samples, not from averaged
     percentiles (averaging percentiles is wrong; pooling reservoirs is
     the same estimator LatencyRecorder itself uses)."""
-    from brpc_tpu.builtin.services import status_page
+    from brpc_tpu.builtin.flight_recorder import global_recorder
+    from brpc_tpu.builtin.services import census_page_payload, status_page
     from brpc_tpu.bvar.variable import dump_exposed
     samples = {}
     for key, lr in server.method_status.items():
@@ -107,6 +108,11 @@ def write_shard_dump(dirpath: str, index: int, server, seq: int) -> None:
         "vars": dict(dump_exposed("")),
         "status": status_page(server),
         "latency_samples": samples,
+        # flight-recorder state (bounded folded stacks + attribution):
+        # the supervisor's /hotspots?mode=continuous merges these by
+        # summing counters — same discipline as the vars/percentiles
+        "hotspots": global_recorder().dump_state(),
+        "census": census_page_payload(server),
     }
     path = os.path.join(dirpath, f"shard-{index}.json")
     tmp = path + f".tmp.{os.getpid()}"
@@ -283,6 +289,38 @@ class ShardAggregator:
     def prometheus_text(self) -> str:
         from brpc_tpu.bvar.prometheus import dump_prometheus_items
         return dump_prometheus_items(sorted(self.merged_vars().items()))
+
+    def merged_hotspots(self) -> dict:
+        """The group-wide continuous profile: per-shard flight-recorder
+        states merged by summing sample counters (stall maxima take the
+        max) — the same never-average-percentiles discipline, applied
+        to profiles."""
+        from brpc_tpu.builtin.flight_recorder import merge_dump_states
+        return merge_dump_states(
+            [d["hotspots"] for d in self.read_dumps()
+             if d.get("hotspots")])
+
+    def merged_census(self) -> dict:
+        """The group-wide resource census: per-subsystem stat dicts
+        merged with the shared counter/ratio/max rules, totals and the
+        connection roll-up summed across shards."""
+        censuses = [d["census"] for d in self.read_dumps()
+                    if d.get("census")]
+        subs: Dict[str, list] = {}
+        for c in censuses:
+            for name, d in c.get("subsystems", {}).items():
+                subs.setdefault(name, []).append(d)
+        out = {
+            "mode": "shard_group",
+            "shards_reporting": len(censuses),
+            "subsystems": {n: _merge_stat_dict(ds)
+                           for n, ds in sorted(subs.items())},
+            "total_bytes": sum(c.get("total_bytes", 0) or 0
+                               for c in censuses),
+            "connections": _merge_stat_dict(
+                [c.get("connections", {}) for c in censuses]),
+        }
+        return out
 
 
 # ------------------------------------------------------------- the group
@@ -511,6 +549,9 @@ class ShardGroup:
     # ------------------------------------------------------------ monitor
     def _monitor_loop(self) -> None:
         hb = self.options.heartbeat_timeout_s
+        # Event-parked tick (not time.sleep): the flight recorder's
+        # idle classifier must see this supervisor thread as waiting
+        park = threading.Event()
         while True:
             with self._lock:
                 if self._stopping:
@@ -569,7 +610,7 @@ class ShardGroup:
                             st.restart_at = now + self._backoff_s(st)
                 elif st.state == "restarting" and now >= st.restart_at:
                     self._fork_shard(st)
-            time.sleep(0.05)
+            park.wait(0.05)
 
     # -------------------------------------------------------------- child
     def _child_main(self, index: int) -> None:

@@ -331,6 +331,23 @@ from brpc_tpu.butil import postfork as _postfork  # noqa: E402
 _postfork.register("rpc.span", _postfork_reset)
 
 
+def _span_census() -> dict:
+    """Resource census: what rpcz holds in memory — the bounded ring
+    plus the store's not-yet-flushed line buffer."""
+    with global_store._lock:
+        buffered = sum(len(s) for s in global_store._buf)
+    with global_collector._lock:
+        ring = len(global_collector._ring)
+    return {"count": ring, "bytes": buffered,
+            "ring_capacity": global_collector._ring.maxlen}
+
+
+from brpc_tpu.butil import resource_census as _census  # noqa: E402
+#   (census registration ships with the store it measures)
+
+_census.register("span_store", _span_census)
+
+
 def new_trace_id() -> int:
     return fast_rand() or 1
 

@@ -13,7 +13,68 @@ import os
 import selectors
 import socket as pysocket
 import threading
+import time
 from typing import Callable, Dict, Optional, Tuple
+
+from brpc_tpu.bvar.reducer import Adder, Maxer, PassiveStatus
+
+# event-loop stall instrumentation (the flight recorder's watchdog
+# half): the longest time one wakeup's callback batch held the event
+# thread, over the sampler's 10s window. The dispatcher stamps tick
+# start/end (two clock reads per non-empty batch); completed ticks
+# update the Maxer here, in-progress ticks are caught by the flight
+# recorder's sampler thread (note_stall), which sees a handler
+# monopolizing the event thread BEFORE the tick ever completes.
+_tick_ms_max = Maxer()
+# ticks that overran the dispatcher_stall_ms budget (flight_recorder
+# annotates the serving rpcz span when it catches one live)
+nstalls = Adder()
+_stall_win = None
+_stall_win_lock = threading.Lock()
+
+
+def _stall_window():
+    """Windowed view over the tick-duration Maxer, created on first
+    scrape (a Window registers with the background sampler thread).
+    Locked double-check: a LOSING racer's Window would stay registered
+    with the sampler forever and drain the delta-mode Maxer via
+    reset() each tick, zeroing the kept window's samples."""
+    global _stall_win
+    if _stall_win is None:
+        with _stall_win_lock:
+            if _stall_win is None:
+                from brpc_tpu.bvar.window import Window
+                _stall_win = Window(_tick_ms_max, 10)
+    return _stall_win
+
+
+def stall_ms_max_10s() -> float:
+    """Max tick duration over the sampler window, INCLUDING the
+    current not-yet-sampled tick value (the bvar sampler snapshots
+    1/s; a stall must be visible the moment it is recorded, not up to
+    a second later)."""
+    win = _stall_window().get_value() or 0.0
+    live = _tick_ms_max.get_value() or 0.0
+    return round(max(win, live), 3)
+
+
+_stall_var = PassiveStatus(stall_ms_max_10s)
+
+
+def expose_stall_vars() -> None:
+    """(Re-)expose the watchdog bvars — called at import and again
+    from Server.start, surviving a test fixture's unexpose_all like
+    the other socket/scheduler counters."""
+    nstalls.expose("dispatcher_stalls")
+    _stall_var.expose("dispatcher_stall_ms_max_10s")
+
+
+expose_stall_vars()
+
+
+def note_stall(ms: float) -> None:
+    """Record an in-progress tick overrun observed by the sampler."""
+    _tick_ms_max.update(ms)
 
 
 class EventDispatcher:
@@ -29,6 +90,12 @@ class EventDispatcher:
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._name = name
+        # tick telemetry for the stall watchdog: _tick_start_ns is
+        # nonzero exactly while this wakeup's callback batch runs on
+        # the event thread; _tick_seq disambiguates ticks so the
+        # watchdog annotates each overrun once
+        self._tick_start_ns = 0
+        self._tick_seq = 0
         # epoll interest changes take effect while another thread sits
         # in epoll_wait — pause/resume need no wakeup-pipe kick there
         # (one write + one dispatcher wake per call otherwise; the
@@ -192,13 +259,25 @@ class EventDispatcher:
                         fired.append((fd, on_readable))
                     if on_writable is not None:
                         fired.append((fd, on_writable))
-            for fd, cb in fired:
-                try:
-                    cb()
-                except Exception:
-                    import logging
-                    logging.getLogger("brpc_tpu.transport").exception(
-                        "event callback failed for fd %d", fd)
+            if not fired:
+                continue
+            self._tick_seq += 1
+            self._tick_start_ns = time.monotonic_ns()
+            try:
+                for fd, cb in fired:
+                    try:
+                        cb()
+                    except Exception:
+                        import logging
+                        logging.getLogger("brpc_tpu.transport").exception(
+                            "event callback failed for fd %d", fd)
+            finally:
+                dur_ms = (time.monotonic_ns() - self._tick_start_ns) / 1e6
+                self._tick_start_ns = 0
+                if dur_ms > 1.0:
+                    # sub-ms ticks are the normal case and not worth a
+                    # Maxer lock; anything longer feeds the stall gauge
+                    _tick_ms_max.update(dur_ms)
 
     def stop(self):
         self._stop = True
@@ -218,6 +297,12 @@ def global_dispatcher() -> EventDispatcher:
     return _global
 
 
+def peek_dispatcher() -> Optional[EventDispatcher]:
+    """The global dispatcher if one exists — watchdogs must observe,
+    never instantiate (a fresh dispatcher has nothing to stall)."""
+    return _global
+
+
 def _postfork_reset() -> None:
     """Fork hygiene: the dispatcher thread exists only in the parent,
     and the inherited epoll fd is the parent's kernel object — any
@@ -225,9 +310,11 @@ def _postfork_reset() -> None:
     Abandon the instance (closing only the child's fd copies; close(2)
     never mutates the shared interest list) so the first post-fork
     consumer builds a private dispatcher with its own thread."""
-    global _global, _glock
+    global _global, _glock, _stall_win, _stall_win_lock
     d, _global = _global, None
     _glock = threading.Lock()
+    _stall_win = None    # the Window rode the parent's sampler series
+    _stall_win_lock = threading.Lock()
     if d is not None:
         d._stop = True
         try:

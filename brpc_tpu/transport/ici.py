@@ -579,11 +579,20 @@ class IciConn(Conn):
                 if self._poisoned is not None:
                     raise ConnectionError(self._poisoned)
                 while self._wirebuf:
+                    # the memoryview is released EXPLICITLY before the
+                    # resize below: callee frames keep the view object
+                    # alive in their locals, and a frame-walking sampler
+                    # (the flight recorder) can briefly pin those frames
+                    # — a refcount-implicit release would then race the
+                    # `del` into "BufferError: Existing exports of data"
+                    mv = memoryview(self._wirebuf)
                     try:
-                        n = self._inner.write(memoryview(self._wirebuf))
+                        n = self._inner.write(mv)
                     except BlockingIOError:
                         self._inner.request_writable_event()
                         return False
+                    finally:
+                        mv.release()
                     del self._wirebuf[:n]
                 poison = None
                 with self._lock:

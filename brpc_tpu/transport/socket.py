@@ -32,7 +32,7 @@ from brpc_tpu.butil.flags import define_flag, flag
 from brpc_tpu.butil.iobuf import (DEFAULT_BLOCK_SIZE, IOBuf, IOPortal,
                                   _BIG_BLOCK_SIZE)
 from brpc_tpu.butil.resource_pool import INVALID_ID, ResourcePool, VersionedId
-from brpc_tpu.bvar.reducer import Adder, Maxer
+from brpc_tpu.bvar.reducer import Adder, Maxer, PassiveStatus
 from brpc_tpu.fiber import TaskControl, global_control
 from brpc_tpu.fiber.butex import Butex
 from brpc_tpu.transport.base import Conn, get_transport
@@ -177,18 +177,124 @@ _wqueue_peak = Maxer()
 # each one is a send/sendmsg syscall the coalescer removed
 ncoalesced = Adder().expose("socket_write_coalesced_frames")
 
+# ---------------------------------------------------------------- census
+# Every live Socket, regardless of owner (server conns, client channel
+# sockets): the resource census measures per-connection cost across the
+# whole process, not just one server's accept list. WeakSet so the
+# registry itself can never pin a connection's memory. The lock
+# serializes ADDs against census snapshots (a concurrent add during
+# iteration raises "Set changed size"; GC-driven removals are already
+# deferred by WeakSet's own _IterationGuard).
+_live_sockets: "weakref.WeakSet" = weakref.WeakSet()
+_live_sockets_lock = threading.Lock()
+
+define_flag("census_idle_s", 10.0,
+            "a connection with no read/write activity for this long "
+            "counts as idle on /census, /connections and the "
+            "idle_conn_count bvar")
+
+
+_rows_memo = (0.0, [])     # (expires_monotonic, rows) — GIL-atomic swap
+
+
+def socket_census_rows(max_age_s: float = 0.2):
+    """One pass over every live, non-failed socket: (socket, resident
+    bytes, idle seconds). THE shared accounting authority — the /census
+    subsystem totals, the /connections per-conn rows and the idle/avg
+    bvars all derive from this, so they cannot disagree on what a
+    connection 'costs'. Resident bytes = parser-buffered input + queued
+    unsent output (the two elastic per-conn buffers; fixed object
+    overhead is what bytes_per_idle_conn measures via RSS).
+
+    Memoized for ``max_age_s`` (0 forces fresh): one /vars scrape
+    evaluates BOTH census gauges and a shard dump adds the census
+    provider — without the memo that is three full walks over every
+    live connection per scrape, which matters at the 100k-conn
+    target."""
+    global _rows_memo
+    now_mono = time.monotonic()
+    expires, rows = _rows_memo
+    if max_age_s > 0 and now_mono < expires:
+        return rows
+    now = time.monotonic_ns()
+    with _live_sockets_lock:
+        socks = list(_live_sockets)
+    rows = []
+    for s in socks:
+        if s is None or s.failed:
+            continue
+        rows.append((s, s.input_portal.size + s.wq_bytes,
+                     (now - s.last_active_ns) / 1e9))
+    _rows_memo = (now_mono + 0.2, rows)
+    return rows
+
+
+def _socket_census() -> dict:
+    """Process-wide socket census, with the server-side subset broken
+    out: ``bytes``/``count`` cover EVERY live socket (client channels
+    included — they cost memory too), while ``server_bytes``/
+    ``server_count`` cover only accepted server connections, the set
+    /connections lists (a server conn carries user_data['server'])."""
+    rows = socket_census_rows()
+    idle_after = flag("census_idle_s")
+    srv = [(s, b, i) for s, b, i in rows
+           if s.user_data.get("server") is not None]
+    return {
+        "bytes": sum(b for _, b, _ in rows),
+        "count": len(rows),
+        "idle": sum(1 for _, _, i in rows if i >= idle_after),
+        "server_bytes": sum(b for _, b, _ in srv),
+        "server_count": len(srv),
+    }
+
+
+def idle_conn_count() -> int:
+    idle_after = flag("census_idle_s")
+    return sum(1 for _, _, i in socket_census_rows() if i >= idle_after)
+
+
+def conn_resident_bytes_avg() -> float:
+    rows = socket_census_rows()
+    if not rows:
+        return 0.0
+    return round(sum(b for _, b, _ in rows) / len(rows), 1)
+
+
+def expose_conn_census_vars() -> None:
+    """(Re-)expose the connection-cost bvars — called at import and
+    again from Server.start, surviving a test fixture's unexpose_all
+    like the other socket counters."""
+    _idle_var.expose("idle_conn_count")
+    _avg_var.expose("conn_resident_bytes_avg")
+
+
+_idle_var = PassiveStatus(idle_conn_count)
+_avg_var = PassiveStatus(conn_resident_bytes_avg)
+expose_conn_census_vars()
+
+from brpc_tpu.butil import resource_census as _resource_census  # noqa: E402
+#   (census registration ships with the socket registry it measures)
+
+_resource_census.register("sockets", _socket_census)
+
 
 def _wqueue_peak_window():
     """Windowed high-water mark of any single socket's queued bytes,
-    created lazily (a Window starts the background sampler thread)."""
+    created lazily (a Window starts the background sampler thread).
+    Locked double-check: a losing racer's Window would stay registered
+    with the sampler and drain the delta-mode Maxer via reset() each
+    tick, zeroing the kept window's samples."""
     global _wq_peak_win
     if _wq_peak_win is None:
-        from brpc_tpu.bvar.window import Window
-        _wq_peak_win = Window(_wqueue_peak, 10)
+        with _wq_peak_win_lock:
+            if _wq_peak_win is None:
+                from brpc_tpu.bvar.window import Window
+                _wq_peak_win = Window(_wqueue_peak, 10)
     return _wq_peak_win
 
 
 _wq_peak_win = None
+_wq_peak_win_lock = threading.Lock()
 
 
 def _postfork_reset() -> None:
@@ -197,9 +303,17 @@ def _postfork_reset() -> None:
     registrations live in the parent's dispatcher), and the peak
     window rides the parent's sampler. Fresh child, fresh pool."""
     global _socket_pool, _socket_pool_lock, _wq_peak_win
+    global _live_sockets_lock, _rows_memo, _wq_peak_win_lock
     _socket_pool = None
     _socket_pool_lock = threading.Lock()
     _wq_peak_win = None
+    _wq_peak_win_lock = threading.Lock()
+    _rows_memo = (0.0, [])    # memoized rows describe parent sockets
+    # census registry: the listed sockets are the PARENT's connections
+    # (the child holds mere fd dups it will never serve), and the lock
+    # may have been mid-hold at fork time
+    _live_sockets_lock = threading.Lock()
+    _live_sockets.clear()
 
 
 from brpc_tpu.butil import postfork as _postfork  # noqa: E402
@@ -320,6 +434,10 @@ class Socket:
         # Installed by Server for eligible sockets, self-disabling.
         self.fast_drain: Optional[Callable] = None
         self.user_data: dict = {}                 # per-conn session state
+        # last read-event/write stamp (monotonic ns): the idle-class
+        # signal for /census, /connections and idle_conn_count — one
+        # attr store per readable event / queued write
+        self.last_active_ns = time.monotonic_ns()
         # bytes enqueued to _wq and not yet popped by a writer (owner
         # thread +=, writer -=; GIL-atomic enough for a gauge) — the
         # per-socket write-queue saturation signal (/sockets page)
@@ -367,6 +485,8 @@ class Socket:
             except Exception:
                 pass
             raise ConnectionError("socket pool exhausted") from None
+        with _live_sockets_lock:         # resource-census registry
+            _live_sockets.add(self)
         conn.start_events(self._on_readable_event, self._on_writable_event)
 
     # ---------------------------------------------------------- pinned fd
@@ -462,6 +582,7 @@ class Socket:
             # response/peer data needs live read events again
             self.unstick_reads()
         nwrites.add(1)
+        self.last_active_ns = time.monotonic_ns()
         sz = data.size if isinstance(data, IOBuf) else len(data)
         self.wq_bytes += sz
         nwqueue_bytes.add(sz)
@@ -831,6 +952,7 @@ class Socket:
     def _on_readable_event(self):
         """May fire from the dispatcher thread or a peer's fiber; only the
         0->1 transition starts a processing fiber."""
+        self.last_active_ns = time.monotonic_ns()
         with self._nevent_lock:
             self._nevent += 1
             # a plucking joiner owns the input: events defer to it

@@ -22,6 +22,17 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 def main() -> None:
     from brpc_tpu.rpc import Server, ServerOptions, Service
 
+    # the idle-conn soak holds thousands of connections against this
+    # server: lift the soft fd limit to the hard cap (harmless for the
+    # normal bench lanes)
+    try:
+        import resource
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < hard:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+    except Exception:
+        pass
+
     port = int(sys.argv[1]) if len(sys.argv) > 1 else 0
 
     server = Server(ServerOptions(enable_builtin_services=False))

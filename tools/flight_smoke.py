@@ -1,0 +1,219 @@
+"""Flight-recorder smoke (tools/preflight.py --gate's observability
+lane): the continuous profiler must SEE the workload, must not SLOW the
+workload, and the resource census must ADD UP.
+
+Three invariants over a loopback PyEcho burst:
+
+  1. capture    — with continuous profiling on (default 20 Hz), the
+                  merged profile attributes the busy samples to
+                  Bench.PyEcho and its folded stacks contain PyEcho
+                  frames;
+  2. overhead   — qps with the profiler on stays within 5% of
+                  profiler-off (alternating windows, best-of, so box
+                  noise doesn't fail a 1%-cost feature);
+  3. census     — /census subsystem totals equal the sum of the
+                  per-connection rows on /connections.
+
+``--shards N`` drives an N-shard reuseport group instead and checks the
+SUPERVISOR's merged continuous profile (per-shard recorder states
+summed through the dump/aggregator pattern) — the acceptance shape for
+"merged folded stacks from an 8-shard group". Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+BASE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, BASE)
+sys.path.insert(0, os.path.join(BASE, "tools"))
+
+ECHO_ATTRIBUTION_FLOOR = 0.8
+OVERHEAD_PCT_MAX = 5.0
+
+
+def http_get(port: int, path: str):
+    from spawn_util import http_get_local
+    _, body = http_get_local(port, path)
+    try:
+        return json.loads(body)
+    except ValueError:
+        return body.decode("latin1")
+
+
+def _echo_ratio(prof: dict) -> float:
+    labels = prof.get("labels", {})
+    nbusy = prof.get("nbusy") or 0
+    echo = sum(n for k, n in labels.items()
+               if k.startswith("rpc:") and "Echo" in k)
+    return echo / nbusy if nbusy else 0.0
+
+
+def _census_consistent(port: int, tries: int = 4):
+    """subsystems.sockets SERVER totals vs the /connections per-conn
+    rows — same accounting authority and same scope (the process-wide
+    bytes/count additionally include client-channel sockets, which
+    /connections never lists), so they must agree (modulo a conn
+    appearing between the two page fetches: retry)."""
+    last = None
+    for _ in range(tries):
+        census = http_get(port, "/census")
+        conns = http_get(port, "/connections")
+        rows = conns["connections"]
+        row_sum = sum(r["resident_bytes"] for r in rows)
+        sub = census["subsystems"]["sockets"]
+        last = {"census_bytes": sub["server_bytes"],
+                "rows_bytes": row_sum,
+                "census_count": sub["server_count"],
+                "rows_count": len(rows)}
+        if sub["server_bytes"] == row_sum and \
+                sub["server_count"] == len(rows):
+            return True, last
+        time.sleep(0.3)
+    return False, last
+
+
+def run_single(out: dict, seconds: float) -> None:
+    from qps_client import drive_multiproc
+    from spawn_util import spawn_port_server
+    proc, port = spawn_port_server(
+        [os.path.join(BASE, "tools", "bench_echo_server.py")], wall_s=20.0)
+    if port is None:
+        out["error"] = "echo server spawn failed"
+        return
+    try:
+        nprocs = min(4, max(2, (os.cpu_count() or 2) // 4))
+
+        def set_hz(hz: int) -> None:
+            r = http_get(port,
+                         f"/flags/continuous_profiler_hz?setvalue={hz}")
+            assert r == "OK", r
+
+        def window() -> float:
+            return drive_multiproc(port, nprocs=nprocs, seconds=seconds,
+                                   conns=2, inflight=8,
+                                   method="PyEcho")["qps"]
+
+        # alternating A/B windows, profiler off/on; best-of each side
+        # damps box noise around a sub-1% real cost
+        qps_off: list = []
+        qps_on: list = []
+        rounds = 2
+        while True:
+            for _ in range(rounds):
+                set_hz(0)
+                qps_off.append(window())
+                set_hz(20)
+                qps_on.append(window())
+            out["qps_off"] = round(max(qps_off), 1)
+            out["qps_on"] = round(max(qps_on), 1)
+            if out["qps_off"] > 0:
+                out["profiler_overhead_pct"] = round(
+                    max(0.0, (1.0 - out["qps_on"] / out["qps_off"]) * 100),
+                    2)
+            # a failing overhead reading earns ONE more A/B round: the
+            # real cost of 20 Hz sampling is <1%, so a >5% readout is
+            # usually the box drifting mid-run, and best-of over more
+            # windows separates the two
+            if rounds == 1 or \
+                    out.get("profiler_overhead_pct", 100.0) \
+                    <= OVERHEAD_PCT_MAX:
+                break
+            rounds = 1
+
+        prof = http_get(port, "/hotspots?mode=continuous&format=json")
+        out["profile_nbusy"] = prof.get("nbusy")
+        out["attribution_ratio"] = round(_echo_ratio(prof), 3)
+        out["pyecho_in_folded"] = any(
+            "PyEcho" in k for k in prof.get("folded", {}))
+        out["stall_ms_max_10s"] = prof.get("stall_ms_max_10s")
+
+        ok, detail = _census_consistent(port)
+        out["census_ok"] = ok
+        out["census_detail"] = detail
+
+        skip_perf = os.environ.get("BRPC_TPU_PERF_SMOKE", "1") == "0"
+        out["ok"] = bool(
+            out.get("pyecho_in_folded")
+            and out.get("attribution_ratio", 0) >= ECHO_ATTRIBUTION_FLOOR
+            and out.get("census_ok")
+            and (skip_perf
+                 or out.get("profiler_overhead_pct", 100.0)
+                 <= OVERHEAD_PCT_MAX))
+        if not out["ok"]:
+            out["invariant"] = "capture/overhead/census check failed"
+    finally:
+        try:
+            proc.terminate()
+        except Exception:
+            pass
+
+
+def run_sharded(out: dict, shards: int, seconds: float) -> None:
+    from qps_client import drive_multiproc
+    from spawn_util import spawn_announcing_server
+    sproc, got = spawn_announcing_server(
+        [os.path.join(BASE, "tools", "shard_server.py"),
+         "--shards", str(shards)], wall_s=30.0, keys=("ADMIN", "PORT"))
+    if got is None:
+        out["error"] = "shard server spawn failed"
+        return
+    try:
+        nprocs = min(shards + 2, max(2, (os.cpu_count() or 2) // 2))
+        res = drive_multiproc(got["PORT"], nprocs=nprocs, seconds=seconds,
+                              conns=2, inflight=8, method="PyEcho")
+        out["qps_sharded"] = res["qps"]
+        time.sleep(0.6)   # one dump interval: recorder states flush
+        prof = http_get(got["ADMIN"],
+                        "/hotspots?mode=continuous&format=json")
+        out["shards"] = shards
+        out["profile_nbusy"] = prof.get("nbusy")
+        out["attribution_ratio"] = round(_echo_ratio(prof), 3)
+        out["pyecho_in_folded"] = any(
+            "PyEcho" in k for k in prof.get("folded", {}))
+        out["stall_ms_max_10s"] = prof.get("stall_ms_max_10s")
+        out["ok"] = bool(
+            out.get("pyecho_in_folded")
+            and out.get("attribution_ratio", 0) >= ECHO_ATTRIBUTION_FLOOR)
+        if not out["ok"]:
+            out["invariant"] = "merged shard profile failed attribution"
+    finally:
+        try:
+            sproc.terminate()
+            sproc.wait(10)
+        except Exception:
+            pass
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shards", type=int, default=0,
+                    help="drive an N-shard group and check the merged "
+                         "continuous profile instead of the single-"
+                         "process overhead/census lane")
+    ap.add_argument("--seconds", type=float, default=1.3,
+                    help="load window length per measurement")
+    args = ap.parse_args()
+    out: dict = {"mode": f"sharded:{args.shards}" if args.shards
+                 else "single"}
+    try:
+        if args.shards:
+            run_sharded(out, args.shards, args.seconds)
+        else:
+            run_single(out, args.seconds)
+    except Exception as e:  # noqa: BLE001 - one JSON line either way
+        out["ok"] = False
+        out["error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(out))
+    sys.stdout.flush()
+    return 0 if out.get("ok") else 1
+
+
+if __name__ == "__main__":
+    rc = main()
+    os._exit(rc)

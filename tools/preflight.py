@@ -321,6 +321,35 @@ def gate_chaos_smoke() -> dict:
 PERF_FLOORS = {"mb_eff": 0.458, "qps_ratio": 0.25}
 
 
+def gate_flight_smoke() -> dict:
+    """Flight-recorder smoke (tools/flight_smoke.py): a loopback PyEcho
+    burst under continuous profiling must capture PyEcho frames with
+    >=80% busy-sample attribution, profiler-on qps must stay within 5%
+    of profiler-off, and /census totals must equal the sum of the
+    per-connection rows. A subprocess so a wedged burst cannot hang the
+    gate. BRPC_TPU_FLIGHT_SMOKE=0 skips; BRPC_TPU_PERF_SMOKE=0 skips
+    only the overhead criterion (capture + census still run)."""
+    if os.environ.get("BRPC_TPU_FLIGHT_SMOKE", "1") == "0":
+        return {"ok": True, "skipped": "BRPC_TPU_FLIGHT_SMOKE=0"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "flight_smoke.py")],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    out: dict = {"ok": proc.returncode == 0}
+    try:
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+        for k in ("profiler_overhead_pct", "attribution_ratio",
+                  "pyecho_in_folded", "census_ok", "qps_on", "qps_off"):
+            if k in report:
+                out[k] = report[k]
+        if proc.returncode != 0:
+            out["invariant"] = report.get("invariant", report.get("error"))
+    except (ValueError, IndexError):
+        out["ok"] = False
+        out["error"] = (proc.stdout + proc.stderr)[-500:]
+    return out
+
+
 def gate_perf_smoke() -> dict:
     """Fast hot-path perf gate: raw-socket-normalized small-RPC and
     1MB-echo ratios must stay within 30% of the BENCH_r05-era floors.
@@ -385,6 +414,7 @@ def run_gate() -> int:
                      ("chaos_smoke", gate_chaos_smoke),
                      ("trace_smoke", gate_trace_smoke),
                      ("shard_smoke", gate_shard_smoke),
+                     ("flight_smoke", gate_flight_smoke),
                      ("perf_smoke", gate_perf_smoke)):
         try:
             report[name] = fn()

@@ -40,10 +40,125 @@ def _nfds(pid: int) -> int:
     return len(os.listdir(f"/proc/{pid}/fd"))
 
 
+def _http_get_json(port: int, path: str):
+    from spawn_util import http_get_local
+    _, body = http_get_local(port, path)
+    try:
+        return json.loads(body)
+    except ValueError:
+        return body.decode("latin1")   # plain-text pages (/flags OK)
+
+
+def idle_conn_soak(nconns: int, settle_s: float) -> int:
+    """The connection-diet measurement lane: hold ``nconns`` IDLE
+    connections against a standalone echo server and report what each
+    one costs — server RSS growth per conn (the headline
+    ``bytes_per_idle_conn``) next to the census' elastic-buffer
+    accounting (/census, per-conn rows) so fixed object overhead and
+    buffer bloat are separable. Drives the ROADMAP 100k-conn item's
+    bench key from >=5k conns (bench.py runs this mode)."""
+    import resource
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    want = nconns + 512
+    if soft < want:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE,
+                               (min(want, hard), hard))
+        except (ValueError, OSError):
+            pass
+    soft, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+    nconns = min(nconns, max(256, soft - 512))
+
+    from spawn_util import spawn_port_server
+    proc, port = spawn_port_server(
+        [os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "bench_echo_server.py")], wall_s=20)
+    if port is None:
+        print(json.dumps({"ok": False, "error": "server spawn failed"}))
+        return 1
+    import socket as pysock
+    conns: list = []
+    result: dict = {"mode": "idle_conns", "requested": nconns}
+    try:
+        # baseline AFTER one warm RPC (lazy singletons — pools, fiber
+        # workers, recorder — must not be billed to the connections)
+        from brpc_tpu.rpc import Channel, ChannelOptions
+        ch = Channel(f"tcp://127.0.0.1:{port}",
+                     ChannelOptions(timeout_ms=5000))
+        c = ch.call_sync("Bench", "Echo", b"warm")
+        ch.close()
+        if c.failed():
+            print(json.dumps({"ok": False, "error": "warm rpc failed"}))
+            return 1
+        time.sleep(0.5)
+        rss0_kb = _rss_mb(proc.pid) * 1024
+        t_open0 = time.monotonic()
+        refused = 0
+        while len(conns) < nconns:
+            # bounded batches: a full-speed connect storm overflows the
+            # listen backlog and turns into refusals/timeouts
+            for _ in range(min(200, nconns - len(conns))):
+                try:
+                    s = pysock.create_connection(("127.0.0.1", port),
+                                                 timeout=10)
+                    conns.append(s)
+                except OSError:
+                    refused += 1
+                    if refused > nconns // 10 + 20:
+                        raise
+            time.sleep(0.02)
+        open_s = time.monotonic() - t_open0
+        # settle: let the server accept everything and cross the idle
+        # threshold (lowered via /flags so the census calls them idle)
+        _http_get_json(port, "/flags/census_idle_s?setvalue=1")
+        deadline = time.monotonic() + max(settle_s, 3.0) + 30.0
+        census = None
+        while time.monotonic() < deadline:
+            time.sleep(1.0)
+            census = _http_get_json(port, "/census")
+            if census["connections"]["count"] >= nconns and \
+                    census["connections"]["idle"] >= nconns:
+                break
+        rss1_kb = _rss_mb(proc.pid) * 1024
+        per_conn = (rss1_kb - rss0_kb) * 1024 / max(1, len(conns))
+        result.update({
+            "ok": census is not None
+            and census["connections"]["count"] >= len(conns) > 0,
+            "idle_conns": len(conns),
+            "open_s": round(open_s, 1),
+            "refused": refused,
+            "bytes_per_idle_conn": round(per_conn, 1),
+            "srv_rss_before_mb": rss0_kb // 1024,
+            "srv_rss_after_mb": rss1_kb // 1024,
+            "census_connections": census["connections"] if census else None,
+            "census_total_bytes": census.get("total_bytes")
+            if census else None,
+        })
+    except Exception as e:  # noqa: BLE001 - report, don't traceback
+        result.update({"ok": False,
+                       "error": f"{type(e).__name__}: {e}"[:300]})
+    finally:
+        for s in conns:
+            try:
+                s.close()
+            except OSError:
+                pass
+        proc.terminate()
+    print(json.dumps(result))
+    return 0 if result.get("ok") else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=60.0)
+    ap.add_argument("--idle-conns", type=int, default=0,
+                    help="idle-connection cost mode: hold N idle conns "
+                         "and report bytes_per_idle_conn instead of the "
+                         "mixed-traffic soak")
+    ap.add_argument("--settle", type=float, default=3.0)
     args = ap.parse_args()
+    if args.idle_conns:
+        return idle_conn_soak(args.idle_conns, args.settle)
 
     from spawn_util import spawn_port_server
     proc, port = spawn_port_server(

@@ -15,6 +15,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import threading
 import time
 from typing import Optional, Tuple
 
@@ -81,9 +82,43 @@ def spawn_announcing_server(argv, wall_s: float, keys=("PORT",),
 def parent_death_watchdog_loop() -> None:
     """Server-side half of the protocol: block forever, exiting when the
     parent dies so a stray server never outlives its driver on a
-    shared-chip harness."""
+    shared-chip harness. Parks on an Event (not time.sleep) so the
+    flight recorder's idle classifier sees a waiting thread, not a busy
+    leaf monopolizing the profile."""
     parent = os.getppid()
+    park = threading.Event()
     while True:
-        time.sleep(1)
+        park.wait(1)
         if os.getppid() != parent:
             os._exit(0)
+
+
+def http_get_local(port: int, path: str,
+                   timeout_s: float = 10.0) -> Tuple[int, bytes]:
+    """Minimal loopback HTTP/1.1 GET against a spawned server's builtin
+    pages: (status, body). One implementation shared by the tools that
+    scrape /census, /flags, /hotspots etc. (soak.py, flight_smoke.py) —
+    Content-Length framing only, which is all the builtin pages emit."""
+    import socket as pysock
+    s = pysock.create_connection(("127.0.0.1", port), timeout=timeout_s)
+    s.sendall(f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+              f"Content-Length: 0\r\n\r\n".encode())
+    data = b""
+    s.settimeout(timeout_s)
+    try:
+        while True:
+            chunk = s.recv(1 << 16)
+            if not chunk:
+                break
+            data += chunk
+            head, sep, rest = data.partition(b"\r\n\r\n")
+            if sep and b"content-length" in head.lower():
+                clen = [int(h.split(b":")[1]) for h in head.split(b"\r\n")
+                        if h.lower().startswith(b"content-length")][0]
+                if len(rest) >= clen:
+                    break
+    finally:
+        s.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    status = int(head.split(b" ")[1]) if head else 0
+    return status, body
