@@ -1042,6 +1042,85 @@ def main() -> None:
                        result.get("bytes_per_idle_conn"),
                        "sharded_attribution":
                        lane.get("sharded", {}).get("attribution_ratio")})
+        # ---- cluster lane (ISSUE 7): the client-side fabric floor.
+        # Multi-process pipelined load through CLUSTER channels at two
+        # local backends — headline cluster_qps seeds the key the
+        # roadmap's fabric item (LALB/hedging) will gate on, and
+        # backend_stats_overhead_pct prices the per-backend stat cells
+        # (BRPC_TPU_BACKEND_STATS=0 in the off window — the env rides
+        # into the qps_client worker processes).
+        if deadline.remaining() < 20.0:
+            result["cluster"] = {"skipped": "wall budget"}
+            result["partial"] = True
+        else:
+            try:
+                from qps_client import drive_multiproc
+                from spawn_util import spawn_port_server
+                backends = []
+                cports = []
+                for _ in range(2):
+                    bproc, bport = spawn_port_server(
+                        [os.path.join(base, "tools",
+                                      "bench_echo_server.py")],
+                        wall_s=20.0)
+                    if bport is None:
+                        raise RuntimeError("cluster backend spawn failed")
+                    backends.append(bproc)
+                    cports.append(bport)
+                try:
+                    plist = ",".join(str(p) for p in cports)
+                    ncl = max(2, min(6, (os.cpu_count() or 2) // 4))
+                    win = min(2.0, max(1.0, deadline.remaining() * 0.04))
+                    saved = os.environ.pop("BRPC_TPU_BACKEND_STATS", None)
+                    try:
+                        on_w = drive_multiproc(plist, nprocs=ncl,
+                                               seconds=win, conns=2,
+                                               inflight=8,
+                                               method="PyEcho")
+                        os.environ["BRPC_TPU_BACKEND_STATS"] = "0"
+                        off_w = drive_multiproc(plist, nprocs=ncl,
+                                                seconds=win, conns=2,
+                                                inflight=8,
+                                                method="PyEcho")
+                    finally:
+                        # a raising window must not leave the rest of
+                        # the bench (or the operator's explicit value)
+                        # stuck with cells forced off
+                        if saved is None:
+                            os.environ.pop("BRPC_TPU_BACKEND_STATS",
+                                           None)
+                        else:
+                            os.environ["BRPC_TPU_BACKEND_STATS"] = saved
+                    lane = {"backends": 2, "client_procs": ncl,
+                            "window_s": win,
+                            "qps_cells_on": on_w["qps"],
+                            "qps_cells_off": off_w["qps"],
+                            "client_failures": on_w["failures"]
+                            + off_w["failures"],
+                            "dead_workers": on_w["dead_workers"]
+                            + off_w["dead_workers"]}
+                    result["cluster"] = lane
+                    result["cluster_qps"] = on_w["qps"]
+                    if off_w["qps"]:
+                        result["backend_stats_overhead_pct"] = round(
+                            max(0.0, (1.0 - on_w["qps"] / off_w["qps"])
+                                * 100), 2)
+                    _progress({"progress": "cluster_lane", **lane,
+                               "backend_stats_overhead_pct":
+                               result.get("backend_stats_overhead_pct")})
+                finally:
+                    for bproc in backends:
+                        try:
+                            bproc.terminate()
+                            bproc.wait(5)
+                        except Exception:
+                            pass
+            except Exception as e:  # noqa: BLE001 - diagnostics only
+                result["cluster"] = {
+                    "error": f"{type(e).__name__}: {e}"[:200]}
+                result["partial"] = True
+                _progress({"progress": "error", "phase": "cluster",
+                           "error": result["cluster"]["error"]})
         ch.close()
     except BaseException as e:  # noqa: BLE001 - salvage partial data
         result["partial"] = True
@@ -1091,6 +1170,9 @@ def main() -> None:
         "shard_count": result.get("shard_count"),
         "profiler_overhead_pct": result.get("profiler_overhead_pct"),
         "bytes_per_idle_conn": result.get("bytes_per_idle_conn"),
+        "cluster_qps": result.get("cluster_qps"),
+        "backend_stats_overhead_pct":
+        result.get("backend_stats_overhead_pct"),
         "device_lane": ("error" if ("error" in lane or
                                     "lane_error" in lane)
                         else ("ok" if lane else "absent")),

@@ -8,6 +8,7 @@ import json
 
 from brpc_tpu.bvar.prometheus import dump_prometheus
 from brpc_tpu.bvar.variable import dump_exposed
+from brpc_tpu.rpc import errno_codes as berr
 from brpc_tpu.rpc.service import Service
 
 
@@ -36,14 +37,38 @@ def connections_page(server) -> dict:
     for s in server.connections():
         idle_s = (now - s.last_active_ns) / 1e9
         rows.append({
+            "role": "server",
             "remote": str(s.remote_endpoint) if s.remote_endpoint else None,
             "failed": s.failed,
             "resident_bytes": s.input_portal.size + s.wq_bytes,
             "last_active_s": round(idle_s, 3),
             "idle_class": "idle" if idle_s >= idle_after else "active",
         })
+    # client-channel sockets, labeled with their owner identity
+    # (channel name + backend endpoint — Channel._label_socket): the
+    # census always counted their bytes, but the rows were previously
+    # invisible here, so a connection leak in a client channel was
+    # indistinguishable from server fan-in. Listed SEPARATELY from the
+    # server rows — /census's server_bytes equality is over
+    # ``connections`` only.
+    from brpc_tpu.transport.socket import socket_census_rows
+    crows = []
+    for s, resident, idle_s in socket_census_rows():
+        ch = s.user_data.get("channel")
+        if ch is None:
+            continue
+        crows.append({
+            "role": "client",
+            "channel": ch,
+            "backend": s.user_data.get("backend"),
+            "remote": str(s.remote_endpoint) if s.remote_endpoint else None,
+            "resident_bytes": resident,
+            "last_active_s": round(idle_s, 3),
+            "idle_class": "idle" if idle_s >= idle_after else "active",
+        })
     return {
         "connections": rows,
+        "client_connections": crows,
         "breakers": all_breaker_snapshots(),
         "robustness": robustness,
     }
@@ -150,6 +175,24 @@ def add_builtin_services(server) -> None:
     def census(cntl, request):
         return json.dumps(census_page_payload(server),
                           default=str).encode()
+
+    @builtin.method()
+    def backends(cntl, request):
+        # per-backend CLIENT telemetry (this process's channels) — the
+        # builtin-RPC twin of HTTP /backends
+        from brpc_tpu.rpc.backend_stats import backends_page_payload
+        return json.dumps(backends_page_payload(), default=str).encode()
+
+    @builtin.method()
+    def lb_trace(cntl, request):
+        # request bytes = channel name (empty = channel directory)
+        from brpc_tpu.rpc.backend_stats import lb_trace_payload
+        name = bytes(request).decode() if request else ""
+        payload = lb_trace_payload(name or None)
+        if payload is None:
+            cntl.set_failed(berr.EREQUEST, f"no such channel {name!r}")
+            return b""
+        return json.dumps(payload, default=str).encode()
 
     try:
         server.add_service(builtin)

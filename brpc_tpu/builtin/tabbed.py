@@ -19,6 +19,8 @@ TABS = [
     ("heap", "/hotspots?type=heap"),
     ("contentions", "/contentions"),
     ("census", "/census"),
+    ("backends", "/backends"),
+    ("lb_trace", "/lb_trace"),
     ("connections", "/connections"),
     ("sockets", "/sockets"),
     ("fibers", "/fibers"),

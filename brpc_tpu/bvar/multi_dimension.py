@@ -70,6 +70,15 @@ class MultiDimension(Variable):
             items = list(self._stats.items())
         return {k: v.get_value() for k, v in items}
 
+    def labeled_items(self) -> List[Tuple[Tuple, object]]:
+        """(label_values_tuple, value) pairs — the prometheus dumper
+        reads labels through this instead of get_value(), so a subclass
+        may flatten get_value() keys for JSON consumers (/vars) without
+        losing its label structure in the metrics dump."""
+        with self._lock:
+            items = list(self._stats.items())
+        return [(k, v.get_value()) for k, v in items]
+
     def describe(self) -> str:
         return (f"MultiDimension({','.join(self._label_names)}: "
                 f"{self.count_stats()} series)")

@@ -48,9 +48,11 @@ def dump_prometheus(prefix: str = "") -> str:
     for name, var in dump_exposed_variables(prefix):
         mname = _sanitize(name)
         if isinstance(var, MultiDimension):
-            # labeled series: name{k="v",...} value
+            # labeled series: name{k="v",...} value — labels come from
+            # labeled_items(), NOT get_value() (a subclass may flatten
+            # get_value keys for JSON consumers)
             label_names = [_sanitize(ln) for ln in var.label_names]
-            for key, v in sorted(var.get_value().items()):
+            for key, v in sorted(var.labeled_items()):
                 if isinstance(v, dict):
                     # composite stat (e.g. LatencyRecorder): one line per
                     # numeric component

@@ -373,6 +373,27 @@ class HttpProtocol(Protocol):
             from brpc_tpu.builtin.services import connections_page
             return 200, "application/json", json.dumps(
                 connections_page(server), default=str).encode()
+        if path == "/backends":
+            # per-backend CLIENT telemetry: this process's channels,
+            # one row per (channel, backend) stat cell — the data
+            # tools/cluster_top.py scrapes and pools across nodes
+            from brpc_tpu.rpc.backend_stats import backends_page_payload
+            return 200, "application/json", json.dumps(
+                backends_page_payload(), default=str).encode()
+        if path == "/lb_trace":
+            from brpc_tpu.rpc.backend_stats import lb_trace_payload
+            try:
+                n = max(1, int(req.query.get("n", "100")))
+            except ValueError:
+                return (400, "text/plain",
+                        f"bad n {req.query.get('n')!r}".encode())
+            name = req.query.get("channel")
+            payload = lb_trace_payload(name, n)
+            if payload is None:
+                return (404, "text/plain",
+                        f"no decision ring for channel {name!r}".encode())
+            return 200, "application/json", json.dumps(
+                payload, default=str).encode()
         if path == "/rpcz":
             from brpc_tpu.rpc.span import global_collector, global_store
             tid = req.query.get("trace_id")

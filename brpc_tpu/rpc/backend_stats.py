@@ -1,0 +1,519 @@
+"""Per-backend client telemetry: the measurement substrate under the
+cluster fabric (LALB, adaptive concurrency, budget-aware hedging).
+
+Every observability layer so far watches the SERVER side; this module
+watches the CLIENT's view of the cluster. Each (channel, backend
+endpoint) pair owns a stat cell — decayed qps, latency EWMA plus
+pooled-sample percentiles (bvar/percentile.py reservoirs, never
+averaged percentiles), an inflight gauge, error counts by errno class,
+bytes in/out — updated from the channel's attempt lifecycle:
+
+  attempt_start     an attempt was issued at a backend (inflight+1)
+  attempt_error     an intermediate attempt failed there (retry moves
+                    on; latency observed, error classed)
+  call_complete     the call reached its verdict: the responding
+                    backend gets the final observation, every other
+                    still-open selection (a backup that lost the race)
+                    is abandoned — inflight returns without polluting
+                    latency stats, mirroring LoadBalancer.abandon
+
+The cells live in a MultiDimension labeled (channel, backend), so the
+prometheus dump renders proper ``backend_stats_*{channel=..,backend=..}``
+series. The page builders here (``backends_page_payload``,
+``lb_trace_payload``) are shared by the HTTP routes and the builtin RPC
+service, so the two views cannot diverge; rows carry bounded raw
+latency samples so ``tools/cluster_top.py`` can pool percentiles
+across nodes (the ShardAggregator discipline, cross-node).
+
+The LB decision ring is a bounded per-channel deque of
+select/feedback/abandon/exclude/health/naming events recording WHY each
+backend was chosen or skipped (exclusion sets, breaker isolation,
+locality-aware weight factors), served at ``/lb_trace?channel=``.
+
+Cost gating: ``BRPC_TPU_BACKEND_STATS=0`` (env, read at import) or the
+runtime flag ``backend_stats_enabled`` turns the whole layer into one
+flag check per call — the bench's ``backend_stats_overhead_pct``
+headline key is exactly on-vs-off qps.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.butil.fast_rand import fast_rand_less_than
+from brpc_tpu.butil.flags import define_flag, flag as _flag
+from brpc_tpu.bvar.multi_dimension import MultiDimension
+from brpc_tpu.bvar.reducer import Adder
+from brpc_tpu.bvar.variable import Variable
+from brpc_tpu.bvar.window import PerSecond
+from brpc_tpu.rpc import errno_codes as berr
+
+define_flag("backend_stats_enabled",
+            os.environ.get("BRPC_TPU_BACKEND_STATS", "1") != "0",
+            "per-backend client stat cells + LB decision ring "
+            "(/backends, /lb_trace); BRPC_TPU_BACKEND_STATS=0 sets the "
+            "default off for overhead A/B runs")
+define_flag("lb_trace_ring", 256,
+            "events kept per channel in the LB decision ring "
+            "(/lb_trace)", validator=lambda v: v >= 16)
+
+# cells are keyed by operator-meaningful names, but a runaway caller
+# (a channel constructed per request) must degrade to a bounded table,
+# not an unbounded registry — overflow lands on one catch-all cell
+MAX_CELLS = 4096
+_OVERFLOW_KEY = ("_overflow", "_overflow")
+
+EWMA_ALPHA = 0.2
+
+
+def enabled() -> bool:
+    return _flag("backend_stats_enabled")
+
+
+def ep_key(ep) -> str:
+    """Canonical backend row key: scheme://host:port, extras stripped —
+    a naming entry ``tcp://a:1#w=3`` and the socket's remote endpoint
+    ``tcp://a:1`` must land on ONE row."""
+    if isinstance(ep, EndPoint):
+        port = f":{ep.port}" if ep.port else ""
+        return f"{ep.scheme}://{ep.host}{port}"
+    return str(ep)
+
+
+class BackendCell(Variable):
+    """One (channel, backend) stat cell. Counter discipline: every
+    ``attempts`` increment is matched by exactly one ``completed`` or
+    ``abandoned`` increment (the chaos test's attribution invariant);
+    ``connect_errors`` count selections that never became an issued
+    attempt (refused connects) and sit outside that balance.
+
+    The update paths sit on EVERY client attempt, so the cell keeps
+    its own reservoir + sum/max under ONE lock instead of composing a
+    LatencyRecorder (whose four thread-safe sub-recorders cost ~4x in
+    calls alone); the one thing a composed bvar still buys — decayed
+    qps — rides a single Adder + PerSecond window."""
+
+    SAMPLE_CAP = 512
+
+    __slots__ = ("_lock", "_count_var", "_qps", "ewma_us", "inflight",
+                 "attempts", "completed", "abandoned", "connect_errors",
+                 "errors", "bytes_in", "bytes_out", "_samples",
+                 "_nsampled", "_sum_us", "_max_us")
+
+    def __init__(self):
+        super().__init__()
+        self._lock = threading.Lock()
+        self._count_var = Adder(0)
+        self._qps = PerSecond(self._count_var)
+        self.ewma_us = 0.0
+        self.inflight = 0
+        self.attempts = 0
+        self.completed = 0
+        self.abandoned = 0
+        self.connect_errors = 0
+        self.errors: Dict[str, int] = {}
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self._samples: List[float] = []
+        self._nsampled = 0
+        self._sum_us = 0.0
+        self._max_us = 0.0
+
+    # ------------------------------------------------------------ updates
+    def on_start(self, nbytes_out: int) -> None:
+        with self._lock:
+            self.inflight += 1
+            self.attempts += 1
+            self.bytes_out += nbytes_out
+
+    def on_feedback(self, latency_us: float, failed: bool, code: int,
+                    nbytes_in: int = 0) -> None:
+        with self._lock:
+            if self.inflight > 0:
+                self.inflight -= 1
+            self.completed += 1
+            self.bytes_in += nbytes_in
+            self._sum_us += latency_us
+            if latency_us > self._max_us:
+                self._max_us = latency_us
+            # bounded reservoir (the Percentile discipline, one lock):
+            # pooled on read for percentiles, shipped raw on /backends
+            # rows for cross-node pooling
+            n = self._nsampled
+            self._nsampled = n + 1
+            s = self._samples
+            if len(s) < self.SAMPLE_CAP:
+                s.append(latency_us)
+            else:
+                i = fast_rand_less_than(n + 1)
+                if i < self.SAMPLE_CAP:
+                    s[i] = latency_us
+            ewma = self.ewma_us
+            self.ewma_us = (1 - EWMA_ALPHA) * ewma \
+                + EWMA_ALPHA * latency_us if ewma else latency_us
+            if failed:
+                cls = berr.errno_name(code)
+                self.errors[cls] = self.errors.get(cls, 0) + 1
+        self._count_var.add(1)     # thread-local; outside the cell lock
+
+    def on_abandon(self) -> None:
+        with self._lock:
+            if self.inflight > 0:
+                self.inflight -= 1
+            self.abandoned += 1
+
+    def on_connect_error(self, code: int) -> None:
+        with self._lock:
+            self.connect_errors += 1
+            cls = berr.errno_name(code)
+            self.errors[cls] = self.errors.get(cls, 0) + 1
+
+    # ------------------------------------------------------------- reads
+    def samples(self, limit: int = 256) -> List[float]:
+        """Bounded raw latency reservoir — what cluster_top pools for
+        cross-node percentiles (never averages node percentiles)."""
+        with self._lock:
+            return self._samples[:limit]
+
+    @staticmethod
+    def _pick(sorted_samples: List[float], ratio: float) -> float:
+        if not sorted_samples:
+            return 0.0
+        idx = min(len(sorted_samples) - 1,
+                  int(ratio * len(sorted_samples)))
+        return sorted_samples[idx]
+
+    def get_value(self) -> dict:
+        with self._lock:
+            nerr = sum(self.errors.values())
+            observed = self.completed + self.connect_errors
+            s = sorted(self._samples)
+            out = {
+                "attempts": self.attempts,
+                "completed": self.completed,
+                "abandoned": self.abandoned,
+                "connect_errors": self.connect_errors,
+                "inflight": self.inflight,
+                "errors": nerr,
+                "error_ratio": round(nerr / observed, 4) if observed
+                else 0.0,
+                "latency_ewma_us": round(self.ewma_us, 1),
+                "bytes_in": self.bytes_in,
+                "bytes_out": self.bytes_out,
+                "count": self.completed,
+                "latency_avg_us": round(self._sum_us / self.completed, 1)
+                if self.completed else 0.0,
+                "max_latency_us": self._max_us,
+            }
+            for cls, n in self.errors.items():
+                out[f"errors_{cls}"] = n
+        out["qps"] = self._qps.get_value()
+        out["latency_p50_us"] = self._pick(s, 0.5)
+        out["latency_p90_us"] = self._pick(s, 0.9)
+        out["latency_p99_us"] = self._pick(s, 0.99)
+        return out
+
+
+class _BackendDim(MultiDimension):
+    """The labeled family, with a JSON-safe get_value: /vars dumps call
+    json.dumps on the value and tuple keys would raise — the prometheus
+    dumper reads labels through ``labeled_items()`` instead, so the
+    (channel, backend) labels stay intact there."""
+
+    def get_value(self) -> Dict[str, object]:
+        with self._lock:
+            items = list(self._stats.items())
+        return {"|".join(k): v.get_value() for k, v in items}
+
+
+class BackendStats:
+    """Process-wide registry: the labeled cell family, the per-channel
+    decision rings, and weak back-refs to the owning channels (for
+    breaker/health/naming state on the page)."""
+
+    def __init__(self):
+        self._dim = _BackendDim(("channel", "backend"), BackendCell)
+        self._rings: Dict[str, deque] = {}
+        self._ring_lock = threading.Lock()
+        self._channels: "weakref.WeakValueDictionary[str, object]" = \
+            weakref.WeakValueDictionary()
+        self.unattributed = 0           # verdicts with no attributable row
+
+    # ------------------------------------------------------------- cells
+    def cell(self, channel: str, backend: str) -> BackendCell:
+        key = (channel, backend)
+        if not self._dim.has_stats(key) \
+                and self._dim.count_stats() >= MAX_CELLS:
+            key = _OVERFLOW_KEY
+        return self._dim.get_stats(key)
+
+    def rows(self) -> List[Tuple[Tuple[str, str], BackendCell]]:
+        return [(k, self._dim.get_stats(k))
+                for k in self._dim.list_stats()]
+
+    # -------------------------------------------------------------- ring
+    def ring(self, channel: str) -> deque:
+        want = _flag("lb_trace_ring")
+        with self._ring_lock:
+            r = self._rings.get(channel)
+            if r is None or r.maxlen != want:
+                r = deque(r or (), maxlen=want)
+                self._rings[channel] = r
+            return r
+
+    def ring_names(self) -> Dict[str, int]:
+        with self._ring_lock:
+            return {n: len(r) for n, r in self._rings.items()}
+
+    # ---------------------------------------------------------- channels
+    def register_channel(self, name: str, owner) -> None:
+        self._channels[name] = owner
+
+    def channel_owner(self, name: str):
+        return self._channels.get(name)
+
+
+_registry: Optional[BackendStats] = None
+_registry_lock = threading.Lock()
+
+
+def global_stats() -> BackendStats:
+    global _registry
+    reg = _registry
+    if reg is None:
+        with _registry_lock:
+            if _registry is None:
+                _registry = BackendStats()
+                _registry._dim.expose("backend_stats")
+            reg = _registry
+    return reg
+
+
+def expose_backend_vars() -> None:
+    """(Re-)expose the labeled family — called from Server.start like
+    the socket counters, surviving a test fixture's unexpose_all."""
+    global_stats()._dim.expose("backend_stats")
+
+
+def _postfork_reset() -> None:
+    """Fork hygiene: every cell and ring event describes PARENT-side
+    client traffic on sockets the child does not own; a forked shard
+    starts its cluster view from zero."""
+    global _registry, _registry_lock
+    _registry = None
+    _registry_lock = threading.Lock()
+
+
+from brpc_tpu.butil import postfork  # noqa: E402  (registration ships
+#                                      with the singleton it resets)
+
+postfork.register("rpc.backend_stats", _postfork_reset)
+
+
+def _backend_census() -> dict:
+    """Resource census: cells + buffered ring events, with the
+    reservoir samples as the byte-denominated cost (the elastic part —
+    a leaking per-request channel shows up here as runaway cells)."""
+    reg = _registry
+    if reg is None:
+        return {"count": 0, "events": 0, "bytes": 0}
+    nbytes = 0
+    for _, cell in reg.rows():
+        nbytes += len(cell.samples(1024)) * 8
+    events = sum(reg.ring_names().values())
+    return {"count": reg._dim.count_stats(), "events": events,
+            "bytes": nbytes + events * 200}
+
+
+from brpc_tpu.butil import resource_census as _census  # noqa: E402
+#   (census registration ships with the registry it measures)
+
+_census.register("backend_stats", _backend_census)
+
+
+# --------------------------------------------------- attempt accounting
+#
+# Per-call open-attempt records ride the controller
+# (``cntl._bs_attempts``: [backend_key, start_ns, cell] triples —
+# the cell rides the record so the hot completion paths never touch
+# the registry) under the controller's ``_arb_lock`` (an RLock the
+# call path has already materialized; the failure paths may hold it
+# when they land here, which is exactly why it must be re-entrant).
+
+def attempt_start(cntl, rec: list, hook=None) -> None:
+    """Record an opened attempt (the channel resolved the cell and
+    stamped the record — see Channel._bs_attempt_begin). ``hook`` is
+    the channel's completion sweep, registered under the SAME lock
+    hold (one RLock round trip per attempt, and the registration is
+    completion-aware like Controller._add_complete_hook — a hook that
+    missed the window runs immediately so the record cannot leak)."""
+    run_now = False
+    with cntl._arb_lock:
+        cntl.__dict__.setdefault("_bs_attempts", []).append(rec)
+        if hook is not None:
+            if not cntl._completed:
+                hooks = cntl._complete_hooks
+                if hook not in hooks:
+                    hooks.append(hook)
+            else:
+                run_now = True
+    if run_now:
+        try:
+            hook(cntl)
+        except Exception:
+            pass
+
+
+def attempt_error(channel: str, cntl, code: int, ep=None) -> None:
+    """An intermediate attempt failed. Pops the matching open record
+    (by endpoint when the failure path knows it — with a concurrent
+    backup the last record may belong to a different, healthy backend);
+    a failure with NO open record (a connect that never issued) is
+    classed on the right row as a connect error."""
+    key = ep_key(ep) if ep is not None else None
+    rec = None
+    with cntl._arb_lock:
+        recs = cntl.__dict__.get("_bs_attempts")
+        if recs:
+            if key is not None:
+                for r in reversed(recs):
+                    if r[0] == key:
+                        rec = r
+                        break
+            if rec is None:
+                rec = recs[-1]
+            recs.remove(rec)
+    if rec is None:
+        reg = global_stats()
+        if key is not None:
+            reg.cell(channel, key).on_connect_error(code)
+        else:
+            reg.unattributed += 1
+        return
+    lat_us = (time.monotonic_ns() - rec[1]) / 1e3
+    rec[2].on_feedback(lat_us, True, code)
+
+
+def call_complete(cntl) -> None:
+    """The call reached its verdict: the record matching the backend
+    whose response completed the call (or the last attempt, for
+    timeouts/failures with no responder) gets the final observation;
+    every other open record is a losing backup/stale retry and is
+    abandoned. Cancellation (ECANCELED) is client-local — no backend
+    failed and the truncated latency is meaningless, so every open
+    record abandons, mirroring the cluster channel's LB sweep."""
+    d = cntl.__dict__
+    with cntl._arb_lock:
+        recs = d.pop("_bs_attempts", None)
+    if not recs:
+        return
+    if cntl.error_code == berr.ECANCELED:
+        for rec in recs:
+            rec[2].on_abandon()
+        return
+    winner = recs[-1]
+    if len(recs) > 1:
+        ep = cntl.responded_server
+        if ep is not None:
+            key = ep_key(ep)
+            for r in reversed(recs):
+                if r[0] == key:
+                    winner = r
+                    break
+    lat_us = (time.monotonic_ns() - winner[1]) / 1e3
+    winner[2].on_feedback(lat_us, cntl.failed(), cntl.error_code,
+                          d.get("_bs_resp_bytes", 0))
+    if len(recs) > 1:
+        for rec in recs:
+            if rec is not winner:
+                rec[2].on_abandon()
+
+
+# ----------------------------------------------------- LB decision ring
+
+def _ep_list(eps, limit: int = 8) -> List[str]:
+    out = [ep_key(e) for e in list(eps)[:limit]]
+    more = len(eps) - len(out)
+    if more > 0:
+        out.append(f"+{more} more")
+    return out
+
+
+def ring_event(channel: str, kind: str, ring: Optional[deque] = None,
+               **fields) -> None:
+    """Append one decision event. Callers on the per-call hot path
+    pass their cached ``ring`` deque (Channel._bs_ring) to skip the
+    registry lock; deque.append is itself thread-safe."""
+    if not enabled():
+        return
+    fields["t"] = round(time.time(), 3)
+    fields["kind"] = kind
+    if ring is None:
+        ring = global_stats().ring(channel)
+    ring.append(fields)
+
+
+def lb_trace_payload(channel: Optional[str],
+                     n: int = 100) -> Optional[dict]:
+    """The /lb_trace payload: one channel's recent decision events
+    (oldest first), or — with no channel named — the channel
+    directory. None = unknown channel (the route 404s)."""
+    reg = global_stats()
+    if not channel:
+        return {"channels": reg.ring_names(),
+                "hint": "/lb_trace?channel=<name>&n=<events>"}
+    with reg._ring_lock:
+        r = reg._rings.get(channel)
+        events = list(r)[-n:] if r is not None else None
+    if events is None:
+        return None
+    return {"channel": channel, "events": events}
+
+
+# ------------------------------------------------------------ the page
+
+def backends_page_payload(samples: int = 256) -> dict:
+    """The /backends payload, shared by the HTTP route and the builtin
+    RPC service. Rows group by channel; each carries the cell's
+    counters plus breaker/health/naming state resolved from the owning
+    channel (weakly held — a closed channel's rows stay, its state
+    goes ``unknown``), and a bounded raw latency reservoir for
+    cross-node pooling (tools/cluster_top.py)."""
+    reg = global_stats()
+    channels: Dict[str, dict] = {}
+    totals = {"attempts": 0, "completed": 0, "errors": 0, "inflight": 0,
+              "abandoned": 0, "connect_errors": 0}
+    for (ch_name, backend), cell in reg.rows():
+        entry = channels.get(ch_name)
+        if entry is None:
+            owner = reg.channel_owner(ch_name)
+            entry = channels[ch_name] = {
+                "lb": getattr(owner, "lb_name", None)
+                if owner is not None else None,
+                "naming": owner.naming_info()
+                if hasattr(owner, "naming_info") else None,
+                "backends": {},
+            }
+        row = cell.get_value()
+        row["latency_samples"] = cell.samples(samples)
+        owner = reg.channel_owner(ch_name)
+        if owner is not None and hasattr(owner, "backend_state"):
+            try:
+                row["state"] = owner.backend_state(backend)
+            except Exception:
+                row["state"] = {"error": "state provider failed"}
+        entry["backends"][backend] = row
+        for k in totals:
+            totals[k] += row.get(k, 0)
+    return {
+        "enabled": enabled(),
+        "channels": channels,
+        "totals": totals,
+        "unattributed_errors": reg.unattributed,
+    }

@@ -10,6 +10,7 @@ arm deadline/backup timers, wait.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from dataclasses import dataclass, field
@@ -31,6 +32,7 @@ from brpc_tpu.protocol.tpu_std import (_HDR as _TPU_HDR, MAGIC as _TPU_MAGIC,
 _TAG_CORRELATION_ID_B = _TAG_CORRELATION_ID.to_bytes(1, "big")
 _TAG_ATTACHMENT_SIZE_B = _TAG_ATTACHMENT_SIZE.to_bytes(1, "big")
 from brpc_tpu.bvar.reducer import Adder
+from brpc_tpu.rpc import backend_stats as _bs
 from brpc_tpu.rpc import errno_codes as berr
 from brpc_tpu.rpc.controller import Controller, address_call, take_call
 from brpc_tpu.transport import socket as _socket_mod
@@ -99,6 +101,12 @@ def client_fast_drain_hook(options):
 class ChannelOptions:
     protocol: str = "tpu_std"
     connection_type: str = "single"      # single | pooled | short
+    # stable channel name for per-backend client telemetry (/backends,
+    # /lb_trace, the backend_stats prometheus labels); empty = an
+    # auto-generated "channel-N" (cluster channels default to their
+    # naming url). Reuse ONE name for channels that mean the same
+    # dependency — cells are keyed by it.
+    name: str = ""
     timeout_ms: Optional[float] = 1000.0
     max_retry: int = 3
     backup_request_ms: Optional[float] = None
@@ -152,11 +160,20 @@ def connect_dedup(lock, read_fn, write_fn, make_fn):
     return new
 
 
+_chan_seq = itertools.count(1)
+
+
 class Channel:
     def __init__(self, address: Optional[str | EndPoint] = None,
                  options: Optional[ChannelOptions] = None,
                  control: Optional[TaskControl] = None):
         self.options = options or ChannelOptions()
+        # per-backend telemetry identity (backend_stats cells + the LB
+        # decision ring are keyed by it); subclasses override
+        # _default_stats_name (ClusterChannel: its naming url) so the
+        # registration happens exactly once
+        self._stats_name = self.options.name or self._default_stats_name()
+        _bs.global_stats().register_channel(self._stats_name, self)
         self._control = control or global_control()
         self._messenger = InputMessenger(control=self._control)
         self._socket: Optional[Socket] = None
@@ -180,6 +197,25 @@ class Channel:
         self._endpoint = (address if isinstance(address, EndPoint)
                           else str2endpoint(address))
 
+    def _default_stats_name(self) -> str:
+        return f"channel-{next(_chan_seq)}"
+
+    @property
+    def stats_name(self) -> str:
+        """This channel's row key on /backends and /lb_trace."""
+        return self._stats_name
+
+    lb_name = None    # /backends channel header; ClusterChannel overrides
+
+    def _label_socket(self, s, ep) -> None:
+        """Tag a channel-owned socket with its owner identity so the
+        /connections client rows are attributable at a glance. First
+        owner wins on a socket_map-shared connection — the label names
+        who DIALED it, not every multiplexed tenant."""
+        ud = s.user_data
+        ud.setdefault("channel", self._stats_name)
+        ud.setdefault("backend", _bs.ep_key(ep))
+
     # ---------------------------------------------------------- connection
     def _get_socket(self) -> Socket:
         def _make():
@@ -187,6 +223,7 @@ class Channel:
                 self._endpoint, on_input=self._messenger.on_new_messages,
                 control=self._control)
             s.fast_drain = client_fast_drain_hook(self.options)
+            self._label_socket(s, self._endpoint)
             return s
 
         if (self.options.connection_type == "single"
@@ -210,6 +247,7 @@ class Channel:
             key = SocketMap.key(self._endpoint,
                                 f"{self.options.protocol}|{auth_part}")
             s = global_socket_map().acquire(key, _make)
+            self._label_socket(s, self._endpoint)
             with self._socket_lock:
                 old, self._socket = self._socket, s
                 self._map_key = key
@@ -350,7 +388,8 @@ class Channel:
             cntl._complete_hooks = [
                 h for h in cntl._complete_hooks
                 if not getattr(h, "_span_hook", False)]
-            hook = lambda c, s=span: finish_span(s, c)  # noqa: E731
+            hook = lambda c, s=span: (finish_span(s, c),  # noqa: E731
+                                      _settle_attempt_spans(c))
             hook._span_hook = True
             cntl._complete_hooks.append(hook)
         cntl._owner_channel = self  # response-path retry needs the channel
@@ -474,6 +513,7 @@ class Channel:
                     self._endpoint, on_input=self._messenger.on_new_messages,
                     control=self._control)
                 sock.fast_drain = client_fast_drain_hook(self.options)
+                self._label_socket(sock, self._endpoint)
 
             def _return(c, s=sock):
                 if s.failed:
@@ -493,6 +533,7 @@ class Channel:
                 self._endpoint, on_input=self._messenger.on_new_messages,
                 control=self._control)
             sock.fast_drain = client_fast_drain_hook(self.options)
+            self._label_socket(sock, self._endpoint)
             cntl._add_complete_hook(
                 lambda c, s=sock: s.failed or s.set_failed(
                     ConnectionError("short connection done")))
@@ -531,11 +572,21 @@ class Channel:
         cntl.remote_side = sock.remote_endpoint
         cntl.local_side = sock.local_endpoint
         cntl._set_issue_socket(sock)  # sync-pluck lane (Controller.join)
+        att = cntl.__dict__.get("request_attachment")
+        # per-backend telemetry: this attempt is now issued AT a
+        # concrete backend — open its stat-cell record (closed by
+        # _on_attempt_failed or the completion sweep) and, under rpcz,
+        # a per-attempt child span so retry/backup fan-out is visible
+        # in the trace tree (submitted only for multi-attempt calls)
+        if _bs.enabled():
+            self._bs_attempt_begin(cntl, sock, att)
+        span = d.get("_client_span")
+        if span is not None:
+            self._add_attempt_span(cntl, span, sock, d["_issue_seq"])
         # small-call fast path: the default protocol with none of the
         # optional sections (compress/trace/stream/device arrays) frames
         # from a cached meta prefix into ONE bytes object and sends it
         # straight from this context — no pb object, no IOBuf
-        att = cntl.__dict__.get("request_attachment")
         if (self._framer_cache is pack_message or
                 (self._framer_cache is None
                  and self.options.protocol in ("", "tpu_std"))) \
@@ -826,13 +877,78 @@ class Channel:
         cntl._register_call()
         return True
 
+    # ------------------------------------------- per-backend telemetry
+    def _bs_cell(self, ep) -> tuple:
+        """(backend_key, cell) for an endpoint, cached per channel —
+        the hot path must not pay a registry lookup per attempt."""
+        cells = self.__dict__.get("_bs_cells")
+        if cells is None:
+            cells = {}
+            self.__dict__["_bs_cells"] = cells
+        entry = cells.get(ep)
+        if entry is None:
+            key = _bs.ep_key(ep)
+            entry = (key, _bs.global_stats().cell(self._stats_name, key))
+            cells[ep] = entry
+        return entry
+
+    def _bs_attempt_begin(self, cntl: Controller, sock, att) -> None:
+        key, cell = self._bs_cell(sock.remote_endpoint)
+        cell.on_start(len(cntl._request_bytes) + (att.size if att else 0))
+        _bs.attempt_start(cntl, [key, time.monotonic_ns(), cell],
+                          self._bs_on_complete)
+
+    def _bs_on_complete(self, cntl: Controller) -> None:
+        _bs.call_complete(cntl)
+
+    def _add_attempt_span(self, cntl: Controller, parent, sock,
+                          seq: int) -> None:
+        from brpc_tpu.rpc.span import start_attempt_span
+        sp = start_attempt_span(parent, cntl._service_name,
+                                cntl._method_name, seq,
+                                self._bs_cell(sock.remote_endpoint)[0],
+                                backup=cntl.used_backup)
+        with cntl._arb_lock:
+            cntl.__dict__.setdefault("_attempt_spans", []).append(sp)
+
+    def _close_attempt_span(self, cntl: Controller, code: int,
+                            key: Optional[str] = None) -> None:
+        """Stamp the failing attempt's span with its verdict — matched
+        by backend key when the failure path knows it (with a
+        concurrent backup, the newest open span belongs to a DIFFERENT,
+        healthy backend and must not inherit this error); newest-open
+        is the fallback when the endpoint is unknown."""
+        spans = cntl.__dict__.get("_attempt_spans")
+        if not spans:
+            return
+        now = time.monotonic_ns() // 1000
+        with cntl._arb_lock:
+            victim = None
+            for sp in reversed(spans):
+                if sp.end_us:
+                    continue
+                if victim is None:
+                    victim = sp
+                if key is not None and sp.remote_side == key:
+                    victim = sp
+                    break
+            if victim is not None:
+                victim.end_us = now
+                victim.error_code = code
+
     def _on_attempt_failed(self, cntl: Controller, code: int, text: str,
                            failed_ep=None) -> None:
-        """Per-attempt failure hook for cluster channels (LB feedback +
-        circuit breaker on intermediate retries). ``failed_ep`` names the
-        attempt's endpoint when the failure path knows it — with a
-        concurrent backup selection, tried_servers[-1] may already be a
-        DIFFERENT server."""
+        """Per-attempt failure hook (LB feedback + circuit breaker ride
+        the ClusterChannel override; per-backend stat cells and attempt
+        spans settle here for every channel flavor). ``failed_ep``
+        names the attempt's endpoint when the failure path knows it —
+        with a concurrent backup selection, tried_servers[-1] may
+        already be a DIFFERENT server."""
+        ep = failed_ep or self._endpoint
+        if _bs.enabled():
+            _bs.attempt_error(self._stats_name, cntl, code, ep)
+        self._close_attempt_span(cntl, code,
+                                 _bs.ep_key(ep) if ep is not None else None)
 
     def _on_timeout(self, cntl: Controller) -> None:
         # under the arbitration lock: a response-error retry swapping
@@ -858,6 +974,27 @@ class Channel:
             return
         cntl.used_backup = True
         self._issue_rpc(cntl)
+
+
+def _settle_attempt_spans(cntl) -> None:
+    """Settle the per-attempt child spans after the main client span
+    finished: stragglers (the final attempt; a backup that lost the
+    race) close with the call's verdict, and the set is submitted ONLY
+    when the call used more than one attempt — a single-attempt call
+    keeps exactly one client span, a retried/hedged call shows its
+    fan-out in /rpcz and tools/trace.py critical paths."""
+    from brpc_tpu.rpc.span import submit_span
+    spans = cntl.__dict__.pop("_attempt_spans", None)
+    if not spans:
+        return
+    now = time.monotonic_ns() // 1000
+    for sp in spans:
+        if not sp.end_us:
+            sp.end_us = now
+            sp.error_code = cntl.error_code
+    if len(spans) > 1:
+        for sp in spans:
+            submit_span(sp)
 
 
 class _PolicyView:

@@ -32,6 +32,7 @@ class CircuitBreaker:
         self._samples = 0
         self._isolated_until = 0.0
         self._isolation_s = self.BASE_ISOLATION_S
+        self._trips = 0              # lifetime isolation count
         self._lock = threading.Lock()
 
     def on_call(self, failed: bool) -> None:
@@ -49,6 +50,7 @@ class CircuitBreaker:
                     self._isolated_until = now + self._isolation_s
                     self._isolation_s = min(self._isolation_s * 2,
                                             self.MAX_ISOLATION_S)
+                    self._trips += 1
                 self._short = 0.0
                 self._samples = 0
 
@@ -86,6 +88,7 @@ class CircuitBreaker:
                 "error_rate_short": self._short,
                 "error_rate_long": self._long,
                 "samples": self._samples,
+                "trips": self._trips,
             }
 
 

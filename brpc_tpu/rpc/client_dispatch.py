@@ -115,6 +115,9 @@ def process_response_fast(cid: int, err_code: int, err_text, payload: bytes,
         if take_call(cid) is not cntl:
             return  # raced with timeout/backup completion
     cntl.responded_server = socket.remote_endpoint
+    # wire size of the winning response, for the backend stat cell's
+    # bytes_in (the completion sweep attributes it to the responder)
+    cntl.__dict__["_bs_resp_bytes"] = len(payload) + len(att)
     span = cntl.__dict__.get("_client_span")
     if span is not None:
         span.first_byte_us = time.monotonic_ns() // 1000
@@ -175,6 +178,9 @@ def process_response(proto, msg: RpcMessage, socket) -> None:
     # in flight, the last-selected server is not necessarily the one
     # whose response completed the call
     cntl.responded_server = socket.remote_endpoint
+    # wire size before decompression — the backend cell accounts what
+    # the network carried, not what the codec expanded it to
+    cntl.__dict__["_bs_resp_bytes"] = msg.payload.size + msg.attachment.size
     span = cntl.__dict__.get("_client_span")
     if span is not None:
         # the frame's cut-time stamp is the closest honest "first

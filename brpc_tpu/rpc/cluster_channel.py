@@ -14,6 +14,7 @@ from typing import Dict, Optional
 
 from brpc_tpu.butil.endpoint import EndPoint
 from brpc_tpu.fiber import TaskControl
+from brpc_tpu.rpc import backend_stats as _bs
 from brpc_tpu.rpc import errno_codes as berr
 from brpc_tpu.rpc.channel import Channel, ChannelOptions
 from brpc_tpu.rpc.circuit_breaker import ClusterBreakers
@@ -28,16 +29,25 @@ class ClusterChannel(Channel):
     def __init__(self, naming_url: str, load_balancer: str | LoadBalancer = "rr",
                  options: Optional[ChannelOptions] = None,
                  control: Optional[TaskControl] = None):
+        # telemetry identity: the naming url names the dependency
+        # better than an auto "channel-N" — stashed BEFORE super() so
+        # the base constructor's one registration uses it
+        self._naming_url = naming_url
         super().__init__(address=None, options=options, control=control)
         self._lb = (load_balancer if isinstance(load_balancer, LoadBalancer)
                     else new_load_balancer(load_balancer))
+        # resolved once: does this balancer expose decision factors for
+        # the trace ring (rr/random/hash return None — skip the call)
+        self._lb_has_info = type(self._lb).decision_info \
+            is not LoadBalancer.decision_info
         self._breakers = ClusterBreakers()
         self._sockets: Dict[EndPoint, Socket] = {}
         self._sockets_lock = threading.Lock()
         self._servers: list = []
         self._health = HealthChecker(
             control=self._control,
-            app_check=self.options.app_health_check)
+            app_check=self.options.app_health_check,
+            on_event=self._on_health_event)
         self._ns = NamingServiceThread(naming_url, control=self._control)
         self._ns.watch(self._on_servers)
         self._ns.wait_first_update(5.0)
@@ -52,20 +62,109 @@ class ClusterChannel(Channel):
         self._servers = servers
         self._lb.reset_servers(servers)
         self._health.retain(servers)
+        _bs.ring_event(self._stats_name, "naming", count=len(servers),
+                       servers=_bs._ep_list(servers))
 
     def servers(self):
         return list(self._servers)
 
+    def _on_health_event(self, event: str, ep) -> None:
+        """Health-checker transitions land in the decision ring: a
+        'dead' event explains why later selects exclude the backend, a
+        'revived' one why it reappears."""
+        _bs.ring_event(self._stats_name, "health", event=event,
+                       endpoint=_bs.ep_key(ep))
+
+    def _bs_ring(self):
+        """The channel's decision-ring deque, cached (re-resolved when
+        the lb_trace_ring flag moves — the registry rebuilds the deque
+        then, and events must land where /lb_trace reads)."""
+        from brpc_tpu.butil.flags import flag
+        r = self.__dict__.get("_bs_ring_cache")
+        if r is None or r.maxlen != flag("lb_trace_ring"):
+            r = _bs.global_stats().ring(self._stats_name)
+            self.__dict__["_bs_ring_cache"] = r
+        return r
+
+    # ------------------------------------------------- telemetry state
+    def _default_stats_name(self) -> str:
+        return self._naming_url
+
+    @property
+    def lb_name(self) -> str:
+        return getattr(self._lb, "name", type(self._lb).__name__)
+
+    def naming_info(self) -> dict:
+        return {"url": str(getattr(self._ns, "url", "")) or None,
+                "servers": len(self._servers),
+                "revision": self._ns.revision(),
+                "last_update_age_s": self._ns.last_update_age_s()}
+
+    def backend_state(self, key: str) -> dict:
+        """Breaker/health/naming state for one /backends row (``key``
+        is the canonical backend key). Rows for backends no longer in
+        any list report in_naming=False — stale rows are visible, not
+        silently dropped."""
+        out = {"in_naming": False, "health_dead": False}
+        for ep in list(self._servers):
+            if _bs.ep_key(ep) == key:
+                out["in_naming"] = True
+                break
+        for ep in self._health.dead_set():
+            if _bs.ep_key(ep) == key:
+                out["health_dead"] = True
+                break
+        with self._breakers._lock:
+            items = list(self._breakers._breakers.items())
+        for ep, b in items:
+            if _bs.ep_key(ep) == key:
+                out["breaker"] = b.snapshot()
+                break
+        return out
+
     # ----------------------------------------------------------- selection
     def _pick_socket(self, cntl: Controller) -> Socket:
-        exclude = set(cntl.tried_servers)
-        exclude |= self._breakers.isolated_set(self._servers)
-        exclude |= self._health.dead_set()
+        tried = set(cntl.tried_servers)
+        isolated = self._breakers.isolated_set(self._servers)
+        dead = self._health.dead_set()
+        exclude = tried | isolated | dead
         key = getattr(cntl, "request_key", None)
         ep = self._lb.select_server(exclude or None, request_key=key)
-        if ep is None:
+        fallback = False
+        if ep is None and exclude:
             # every server excluded: last resort, try anyone the LB knows
+            fallback = True
             ep = self._lb.select_server(None, request_key=key)
+        if _bs.enabled():
+            # the decision ring records WHY: the chosen backend, what
+            # was excluded and for which reason, and (for weighted
+            # balancers that expose it) the decision factors behind
+            # the winner's weight
+            info = None
+            if ep is not None and self._lb_has_info:
+                try:
+                    info = self._lb.decision_info(ep)
+                except Exception:
+                    info = None
+            ev = {"endpoint": self._bs_cell(ep)[0] if ep is not None
+                  else None,
+                  "lb": self.lb_name, "attempt": len(tried) + 1}
+            excluded = {}
+            if tried:
+                excluded["tried"] = _bs._ep_list(tried)
+            if isolated:
+                excluded["breaker"] = _bs._ep_list(isolated)
+            if dead:
+                excluded["health"] = _bs._ep_list(dead)
+            if excluded:
+                ev["excluded"] = excluded
+            if fallback:
+                ev["fallback"] = True   # every backend excluded: the
+                #                         recover gate probed anyway
+            if info:
+                ev["info"] = info
+            _bs.ring_event(self._stats_name, "select",
+                           ring=self._bs_ring(), **ev)
         if ep is None:
             raise ConnectionError("no server available")
         # a backup attempt can lose the race with the primary response:
@@ -77,6 +176,9 @@ class ClusterChannel(Channel):
         with cntl._lb_lock:
             if cntl._lb_swept_n is not None:
                 self._lb.abandon(ep)
+                _bs.ring_event(self._stats_name, "abandon",
+                               endpoint=_bs.ep_key(ep),
+                               why="late attempt after completion")
                 raise ConnectionError("call already completed "
                                       "(late backup/retry attempt dropped)")
             cntl.tried_servers.append(ep)
@@ -93,6 +195,7 @@ class ClusterChannel(Channel):
             from brpc_tpu.rpc.channel import client_fast_drain_hook
             s.fast_drain = client_fast_drain_hook(self.options)
             s.on_failed(lambda sock, ep=ep: self._on_socket_failed(ep))
+            self._label_socket(s, ep)
             return s
 
         def _write(s):
@@ -119,10 +222,24 @@ class ClusterChannel(Channel):
             elif tried:
                 ep = tried[-1]
             else:
-                return
-            cntl._lb_fed.append(ep)
+                ep = None
+            if ep is not None:
+                # under the SAME hold as the resolve: the completion
+                # sweep's fed-snapshot must see this entry or it would
+                # abandon a selection whose feedback is being delivered
+                cntl._lb_fed.append(ep)
+        # backend stat cells + attempt spans (base hook) see the same
+        # resolved endpoint the LB/breaker feedback uses
+        super()._on_attempt_failed(cntl, code, text, ep)
+        if ep is None:
+            return
         self._lb.feedback(ep, cntl.latency_us(), True)
         self._breakers.on_call(ep, failed=True)
+        if _bs.enabled():
+            _bs.ring_event(self._stats_name, "feedback",
+                           ring=self._bs_ring(),
+                           endpoint=self._bs_cell(ep)[0], failed=True,
+                           code=code)
 
     def _on_call_complete(self, cntl: Controller):
         # the marker and the tried snapshot are taken under the same
@@ -152,10 +269,20 @@ class ClusterChannel(Channel):
                     fed_snapshot.remove(s)
                 else:
                     self._lb.abandon(s)
+                    if _bs.enabled():
+                        _bs.ring_event(self._stats_name, "abandon",
+                                       endpoint=_bs.ep_key(s),
+                                       why="canceled")
             return
         failed = cntl.failed() and cntl.error_code != berr.ERPCTIMEDOUT
         self._lb.feedback(ep, cntl.latency_us(), cntl.failed())
         self._breakers.on_call(ep, failed)
+        if _bs.enabled():
+            _bs.ring_event(self._stats_name, "feedback",
+                           ring=self._bs_ring(),
+                           endpoint=self._bs_cell(ep)[0],
+                           failed=cntl.failed(), code=cntl.error_code,
+                           latency_us=cntl.latency_us(), final=True)
         # every selection must be matched by exactly one feedback or
         # abandon: attempts that never produced an observation (a backup
         # request that lost the race) return their inflight slot, or an
@@ -169,6 +296,10 @@ class ClusterChannel(Channel):
                 fed.remove(s)
             else:
                 self._lb.abandon(s)
+                if _bs.enabled():
+                    _bs.ring_event(self._stats_name, "abandon",
+                                   endpoint=_bs.ep_key(s),
+                                   why="backup/retry lost the race")
 
     def close(self):
         self._ns.stop()

@@ -310,6 +310,11 @@ class Controller:
         d.pop("_fail_handled", None)       # per-attempt failure latch
         d.pop("_sync_fast", None)          # per-call pre-claim hint
         d.pop("_client_span", None)        # previous call's rpcz span
+        d.pop("_attempt_spans", None)      # previous call's attempt spans
+        d.pop("_bs_attempts", None)        # previous call's open backend
+        #                                    stat-cell records (swept at
+        #                                    completion; belt & braces)
+        d.pop("_bs_resp_bytes", None)      # previous response's wire size
         # trace context is per-CALL: a stale trace_id would defeat the
         # serving-trace inheritance in Channel.call (the nested call
         # would chain onto the PREVIOUS request's tree) and pin every

@@ -34,7 +34,11 @@ def rpc_health_check(service: str = "health", method: str = "Check",
         ch = Channel(ep, ChannelOptions(
             protocol=protocol, timeout_ms=timeout_ms, max_retry=0,
             auth_token=auth_token, auth=auth,
-            share_connections=False))   # probe on its own connection
+            share_connections=False,    # probe on its own connection
+            name="health_probe"))       # one stat-cell channel for ALL
+        #                                 probes — a per-probe auto name
+        #                                 would mint a fresh /backends
+        #                                 row per revival attempt
         try:
             cntl = ch.call_sync(service, method, request)
             return not cntl.failed()
@@ -59,7 +63,8 @@ class HealthChecker:
 
     def __init__(self, control: Optional[TaskControl] = None,
                  app_check: Optional[Callable[[EndPoint], bool]] = None,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 on_event: Optional[Callable[[str, EndPoint], None]] = None):
         self._control = control or global_control()
         self._dead: Set[EndPoint] = set()
         self._checking: Set[EndPoint] = set()
@@ -67,6 +72,20 @@ class HealthChecker:
         self._stopped = False
         self._app_check = app_check
         self._rng = rng or random.Random()   # injectable: seeded tests
+        # observer hook ("dead"/"revived", endpoint) — the cluster
+        # channel feeds its LB decision ring with these transitions;
+        # fired outside the lock and never allowed to raise into the
+        # check fiber
+        self._on_event = on_event
+
+    def _emit(self, event: str, ep: EndPoint) -> None:
+        cb = self._on_event
+        if cb is None:
+            return
+        try:
+            cb(event, ep)
+        except Exception:
+            pass
 
     def _jittered(self, backoff: float) -> float:
         return backoff * (1.0 + self.JITTER
@@ -84,6 +103,7 @@ class HealthChecker:
                 return
             self._dead.add(ep)
             self._checking.add(ep)
+        self._emit("dead", ep)
         self._control.spawn(self._check_loop, ep, name=f"health_{ep.host}")
 
     def retain(self, servers) -> None:
@@ -117,6 +137,7 @@ class HealthChecker:
                     continue
             with self._lock:
                 self._dead.discard(ep)
+            self._emit("revived", ep)
             break
         with self._lock:
             self._checking.discard(ep)

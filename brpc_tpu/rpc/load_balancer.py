@@ -50,6 +50,13 @@ class LoadBalancer:
         balancers must return the slot without polluting their stats."""
         pass
 
+    def decision_info(self, server: EndPoint) -> Optional[dict]:
+        """Optional per-server decision factors for the LB trace ring
+        (/lb_trace): balancers that weigh servers (la) report WHY this
+        one won — weight, latency estimate, inflight. None = the
+        balancer has nothing beyond its name (rr/random/hash)."""
+        return None
+
 
 class _SnapshotLB(LoadBalancer):
     def __init__(self):
@@ -297,6 +304,15 @@ class LocalityAwareLB(_SnapshotLB):
                 self._tree.set(i, self._weight(s))
 
     # ---------------------------------------------------------- protocol
+    def decision_info(self, server):
+        with self._lock:
+            lat = self._lat.get(server)
+            if lat is None:
+                return None
+            return {"weight": round(self._weight(server), 3),
+                    "lat_ewma_us": round(lat, 1),
+                    "inflight": self._inflight.get(server, 0)}
+
     def abandon(self, server):
         with self._lock:
             inf = self._inflight.get(server, 0)

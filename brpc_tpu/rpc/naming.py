@@ -309,6 +309,7 @@ class NamingServiceThread:
 
     def __init__(self, url: str, control: Optional[TaskControl] = None):
         scheme, _, param = url.partition("://")
+        self.url = url
         self._ns = get_naming_service(scheme)
         self._param = param
         self._control = control or global_control()
@@ -317,14 +318,22 @@ class NamingServiceThread:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._first_update = threading.Event()
+        # freshness telemetry for /backends: how many list resets this
+        # thread has delivered and when the last one landed (a stale
+        # naming feed explains a frozen backend set at a glance)
+        self._revision = 0
+        self._last_update_mono: Optional[float] = None
 
         outer = self
 
         class _Actions(NamingServiceActions):
             def reset_servers(self, servers):
+                import time as _time
                 with outer._lock:
                     outer._servers = list(servers)
                     watchers = list(outer._watchers)
+                    outer._revision += 1
+                    outer._last_update_mono = _time.monotonic()
                 # notify watchers BEFORE releasing wait_first_update():
                 # a ClusterChannel constructor blocked on that event must
                 # find its LB already seeded when it wakes, or its first
@@ -354,6 +363,19 @@ class NamingServiceThread:
     def servers(self) -> List[EndPoint]:
         with self._lock:
             return list(self._servers)
+
+    def revision(self) -> int:
+        """Server-list resets delivered so far (0 = never updated)."""
+        with self._lock:
+            return self._revision
+
+    def last_update_age_s(self) -> Optional[float]:
+        """Seconds since the last list reset; None = never updated."""
+        import time as _time
+        with self._lock:
+            if self._last_update_mono is None:
+                return None
+            return round(_time.monotonic() - self._last_update_mono, 3)
 
     def wait_first_update(self, timeout_s: float = 5.0) -> bool:
         return self._first_update.wait(timeout_s)

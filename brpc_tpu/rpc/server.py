@@ -221,6 +221,10 @@ class Server:
             from brpc_tpu.transport.socket import expose_conn_census_vars
             expose_conn_census_vars()
             expose_stall_vars()
+            # per-backend client stat cells (labeled prometheus family)
+            # follow the same re-expose lifecycle
+            from brpc_tpu.rpc.backend_stats import expose_backend_vars
+            expose_backend_vars()
             # scheduler saturation trio (runqueue depth/peak, worker
             # busy fraction) + fiber counters: /vars + prometheus
             self._control.expose_vars()

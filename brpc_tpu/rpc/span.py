@@ -389,6 +389,38 @@ def start_client_span(cntl, service: str, method: str) -> Span:
     return span
 
 
+def start_attempt_span(parent: Span, service: str, method: str,
+                       attempt: int, backend: str,
+                       backup: bool = False) -> Span:
+    """A per-attempt child of a client call span: retries and backup
+    requests fan the one logical call out over several backends, and a
+    single client span collapses that into an undifferentiated blob.
+    The attempt span carries the 1-based attempt index and the selected
+    backend endpoint (remote_side + a greppable annotation). The
+    channel submits the set only for multi-attempt calls — see
+    channel._finish_call_spans."""
+    span = Span(
+        trace_id=parent.trace_id,
+        span_id=new_trace_id(),
+        parent_span_id=parent.span_id,
+        side="client",
+        service=service,
+        method=method,
+        remote_side=backend,
+        start_us=time.monotonic_ns() // 1000,
+        log_id=parent.log_id,
+    )
+    span.annotate(f"attempt={attempt} backend={backend}"
+                  + (" backup" if backup else ""))
+    return span
+
+
+def submit_span(span: Span) -> None:
+    """Submit an externally-finished span (attempt children whose
+    end_us/error_code the channel stamped itself)."""
+    _submit_span(span)
+
+
 def expect_flush(span: Span) -> None:
     """Arm the flush-delegation latch: the response write's completion
     callback (mark_flushed) owns the flushed_us stamp, and whichever of

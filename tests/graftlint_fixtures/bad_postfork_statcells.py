@@ -1,0 +1,43 @@
+"""Seeded postfork-reset violations in the stat-cell registry shape
+(the rpc/backend_stats.py idiom): a lazy-global cell-registry accessor
+plus a module-level ring store holding reuse freelists, in a module
+with NO butil.postfork registration — a forked shard would inherit
+cells describing the PARENT's client traffic and report them as its
+own."""
+
+import threading
+
+
+class CellRegistry:
+    """Resource-bearing: keeps a sampler thread for decayed windows."""
+
+    def __init__(self):
+        self._cells = {}
+        self._sampler = threading.Thread(target=lambda: None, daemon=True)
+
+
+class RingStore:
+    """Resource-bearing: recycles event buffers through a freelist."""
+
+    def __init__(self):
+        self.freelist = []
+
+    def recycle(self, ring):
+        self.freelist.append(ring)
+
+
+_cells = None
+
+
+def global_cells():
+    # BAD: lazy-global stat-cell accessor, no postfork.register in the
+    # module — a forked child's first /backends page would serve the
+    # parent's per-backend counters
+    global _cells
+    if _cells is None:
+        _cells = CellRegistry()
+    return _cells
+
+
+# BAD: module-level resource-bearing singleton, same missing reset
+rings = RingStore()

@@ -1,0 +1,51 @@
+"""postfork-reset's clean twin for the stat-cell registry shape
+(rpc/backend_stats.py): the lazy cell-registry accessor registers its
+reset, and the plain-data cell class (counters only, no threads/fds/
+freelists) may live at module level unflagged."""
+
+import threading
+
+
+class CellRegistry:
+    """Resource-bearing: owns a sampler thread for decayed windows."""
+
+    def __init__(self):
+        self._cells = {}
+        self._sampler = threading.Thread(target=lambda: None, daemon=True)
+
+
+class PlainCell:
+    """Pure counters — safe to inherit across fork."""
+
+    def __init__(self):
+        self.attempts = 0
+        self.errors = 0
+
+
+_cells = None
+
+
+def global_cells():
+    """Lazy accessor + module-level postfork registration below."""
+    global _cells
+    if _cells is None:
+        _cells = CellRegistry()
+    return _cells
+
+
+def _postfork_reset():
+    global _cells
+    _cells = None
+
+
+class _FakePostfork:
+    @staticmethod
+    def register(name, fn):
+        pass
+
+
+postfork = _FakePostfork()
+postfork.register("fixtures.good_postfork_statcells", _postfork_reset)
+
+# plain-data module singleton: never flagged
+overflow_cell = PlainCell()

@@ -1,0 +1,419 @@
+"""Per-backend client telemetry (ISSUE 7): stat-cell attribution under
+a seeded chaos storm, the LB decision ring, the /backends + /lb_trace
+pages (HTTP and builtin twins share one builder), labeled prometheus
+export, and postfork hygiene.
+
+The load-bearing invariant is the attribution balance: every issued
+attempt lands on exactly one backend row (attempts == completed +
+abandoned once drained, inflight == 0, unattributed == 0), and faults
+injected at ONE backend appear on THAT backend's row only.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from brpc_tpu import chaos
+from brpc_tpu.butil.flags import flag, set_flag
+from brpc_tpu.chaos import Fault, FaultPlan
+from brpc_tpu.rpc import (Channel, ChannelOptions, ClusterChannel, Server,
+                          ServerOptions, Service)
+from brpc_tpu.rpc import backend_stats as bs
+
+_seq = iter(range(100000))
+
+
+def _start_server(tag: str):
+    server = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("EchoService")
+
+    @svc.method()
+    def Echo(cntl, request):
+        return tag.encode() + b":" + bytes(request)
+
+    server.add_service(svc)
+    ep = server.start(f"mem://{tag}-{next(_seq)}")
+    return server, ep
+
+
+def _rows(name):
+    page = bs.backends_page_payload()
+    return page["channels"].get(name, {}).get("backends", {})
+
+
+def _drained(name, deadline_s=3.0):
+    """Wait for every row's inflight gauge to reach zero (losing
+    backup sweeps can trail the join by a beat)."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        rows = _rows(name)
+        if rows and all(r["inflight"] == 0 for r in rows.values()):
+            return rows
+        time.sleep(0.02)
+    return _rows(name)
+
+
+class TestPlainChannelCells:
+    def test_single_backend_row_accounts_everything(self):
+        server, ep = _start_server("pc")
+        name = f"plain-{next(_seq)}"
+        ch = Channel(str(ep), ChannelOptions(timeout_ms=2000, name=name))
+        try:
+            for i in range(6):
+                c = ch.call_sync("EchoService", "Echo", b"x%d" % i)
+                assert not c.failed(), c.error_text
+            rows = _drained(name)
+            assert len(rows) == 1, rows
+            row = next(iter(rows.values()))
+            assert row["attempts"] == 6
+            assert row["completed"] == 6
+            assert row["abandoned"] == 0 and row["inflight"] == 0
+            assert row["errors"] == 0
+            assert row["bytes_out"] >= 12      # 6 x "xN"
+            assert row["bytes_in"] >= 6 * 5    # "pc:xN"
+            assert row["latency_ewma_us"] > 0
+            assert len(row["latency_samples"]) == 6
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+    def test_disabled_flag_records_nothing(self):
+        server, ep = _start_server("off")
+        name = f"off-{next(_seq)}"
+        saved = flag("backend_stats_enabled")
+        set_flag("backend_stats_enabled", False)
+        ch = Channel(str(ep), ChannelOptions(timeout_ms=2000, name=name))
+        try:
+            c = ch.call_sync("EchoService", "Echo", b"q")
+            assert not c.failed(), c.error_text
+            assert _rows(name) == {}
+        finally:
+            set_flag("backend_stats_enabled", saved)
+            ch.close()
+            server.stop()
+            server.join(2)
+
+
+class TestClusterCells:
+    def test_rr_spreads_and_rows_balance(self):
+        servers = [_start_server(f"cs{i}") for i in range(3)]
+        name = f"cluster-{next(_seq)}"
+        ch = None
+        try:
+            urls = ",".join(str(ep) for _, ep in servers)
+            ch = ClusterChannel(f"list://{urls}", "rr",
+                                ChannelOptions(timeout_ms=2000, name=name))
+            for _ in range(12):
+                c = ch.call_sync("EchoService", "Echo", b"q")
+                assert not c.failed(), c.error_text
+            rows = _drained(name)
+            assert len(rows) == 3, rows
+            assert sum(r["attempts"] for r in rows.values()) == 12
+            for r in rows.values():
+                assert r["attempts"] == r["completed"] + r["abandoned"]
+                assert r["errors"] == 0
+                assert r["state"]["in_naming"] is True
+            assert bs.backends_page_payload()["unattributed_errors"] == 0
+        finally:
+            if ch is not None:
+                ch.close()
+            for s, _ in servers:
+                s.stop()
+                s.join(2)
+
+    def test_breaker_isolation_lands_on_right_row(self):
+        servers = [_start_server(f"bi{i}") for i in range(2)]
+        name = f"breaker-{next(_seq)}"
+        ch = None
+        try:
+            urls = ",".join(str(ep) for _, ep in servers)
+            ch = ClusterChannel(f"list://{urls}", "rr",
+                                ChannelOptions(timeout_ms=2000, name=name))
+            ch.call_sync("EchoService", "Echo", b"warm")
+            bad_ep = servers[0][1]
+            for _ in range(10):
+                ch._breakers.on_call(bad_ep, failed=True)
+            bad_key = bs.ep_key(bad_ep)
+            state = ch.backend_state(bad_key)
+            assert state["breaker"]["isolated"] is True
+            assert state["breaker"]["trips"] >= 1
+            other_key = bs.ep_key(servers[1][1])
+            other = ch.backend_state(other_key)
+            assert not other.get("breaker", {}).get("isolated")
+        finally:
+            if ch is not None:
+                ch.close()
+            for s, _ in servers:
+                s.stop()
+                s.join(2)
+
+
+class TestChaosStorm:
+    """The satellite's seeded storm: faults target backend 0 only —
+    every attempt still lands on exactly one row, the errors and
+    breaker samples land on backend 0's row, healthy rows stay clean,
+    and the gauges drain."""
+
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        yield
+        chaos.uninstall()
+
+    def test_storm_attribution_and_error_rows(self):
+        tags = [f"storm{i}-{next(_seq)}" for i in range(3)]
+        addrs = [f"mem://{t}" for t in tags]
+        # backend 0: first conn drops mid-response, the next three
+        # reconnects are refused — deterministic from the seed/script
+        plan = (FaultPlan(seed=11)
+                .at(addrs[0], 0, Fault("drop", at_byte=10, side="accept"))
+                .refuse(addrs[0], 1, 2, 3))
+        chaos.install(plan)
+        servers = []
+        for tag, addr in zip(tags, addrs):
+            server = Server(ServerOptions(enable_builtin_services=False))
+            svc = Service("EchoService")
+            svc.register_method("Echo",
+                                lambda cntl, request: bytes(request))
+            server.add_service(svc)
+            server.start(addr)
+            servers.append(server)
+        name = f"storm-{next(_seq)}"
+        ch = None
+        try:
+            ch = ClusterChannel(
+                f"list://{','.join(addrs)}", "rr",
+                ChannelOptions(timeout_ms=3000, max_retry=3, name=name))
+            ok = 0
+            for _ in range(30):
+                c = ch.call_sync("EchoService", "Echo", b"s")
+                if not c.failed():
+                    ok += 1
+            # retries route around the faulted backend: the burst lands
+            assert ok == 30, ok
+            rows = _drained(name)
+            key0 = bs.ep_key(addrs[0])
+            assert key0 in rows, rows.keys()
+            # attribution balance on EVERY row
+            for key, r in rows.items():
+                assert r["attempts"] == r["completed"] + r["abandoned"], \
+                    (key, r)
+                assert r["inflight"] == 0, (key, r)
+            assert bs.backends_page_payload()["unattributed_errors"] == 0
+            # faults land on backend 0's row ONLY
+            bad = rows[key0]
+            assert bad["errors"] + bad["connect_errors"] >= 1, bad
+            for key, r in rows.items():
+                if key != key0:
+                    assert r["errors"] == 0 and r["connect_errors"] == 0, \
+                        (key, r)
+            # the breaker heard about backend 0's failures
+            snap = ch.backend_state(key0).get("breaker")
+            assert snap is not None and snap["samples"] >= 0
+        finally:
+            if ch is not None:
+                ch.close()
+            chaos.uninstall()
+            for s in servers:
+                s.stop()
+                s.join(2)
+
+
+class TestLbTraceRing:
+    def test_select_and_feedback_events_recorded(self):
+        servers = [_start_server(f"ring{i}") for i in range(2)]
+        name = f"ring-{next(_seq)}"
+        ch = None
+        try:
+            urls = ",".join(str(ep) for _, ep in servers)
+            ch = ClusterChannel(f"list://{urls}", "rr",
+                                ChannelOptions(timeout_ms=2000, name=name))
+            for _ in range(4):
+                assert not ch.call_sync("EchoService", "Echo",
+                                        b"r").failed()
+            payload = bs.lb_trace_payload(name)
+            assert payload is not None
+            kinds = [e["kind"] for e in payload["events"]]
+            assert "select" in kinds and "feedback" in kinds
+            selects = [e for e in payload["events"]
+                       if e["kind"] == "select"]
+            assert all(e["lb"] == "rr" and e["endpoint"] for e in selects)
+            finals = [e for e in payload["events"]
+                      if e["kind"] == "feedback" and e.get("final")]
+            assert finals and all(e["failed"] is False for e in finals)
+            # naming reset was recorded too
+            assert "naming" in kinds
+            # unknown channel -> None (routes 404)
+            assert bs.lb_trace_payload("nope-" + name) is None
+        finally:
+            if ch is not None:
+                ch.close()
+            for s, _ in servers:
+                s.stop()
+                s.join(2)
+
+    def test_ring_is_bounded_by_flag(self):
+        name = f"bound-{next(_seq)}"
+        for i in range(flag("lb_trace_ring") + 50):
+            bs.ring_event(name, "select", endpoint=f"e{i}")
+        payload = bs.lb_trace_payload(name, n=10_000)
+        assert len(payload["events"]) == flag("lb_trace_ring")
+
+    def test_la_decision_info_rides_select_events(self):
+        servers = [_start_server(f"la{i}") for i in range(2)]
+        name = f"la-{next(_seq)}"
+        ch = None
+        try:
+            urls = ",".join(str(ep) for _, ep in servers)
+            ch = ClusterChannel(f"list://{urls}", "la",
+                                ChannelOptions(timeout_ms=2000, name=name))
+            for _ in range(6):
+                assert not ch.call_sync("EchoService", "Echo",
+                                        b"w").failed()
+            events = bs.lb_trace_payload(name)["events"]
+            infos = [e["info"] for e in events
+                     if e["kind"] == "select" and e.get("info")]
+            assert infos, events
+            assert {"weight", "lat_ewma_us", "inflight"} <= \
+                set(infos[-1].keys())
+        finally:
+            if ch is not None:
+                ch.close()
+            for s, _ in servers:
+                s.stop()
+                s.join(2)
+
+
+class TestPagesOverHttp:
+    def test_backends_lbtrace_and_client_connection_rows(self):
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools"))
+        from spawn_util import http_get_local
+
+        server = Server(ServerOptions(enable_builtin_services=True))
+        svc = Service("EchoService")
+
+        @svc.method()
+        def Echo(cntl, request):
+            return bytes(request)
+
+        server.add_service(svc)
+        ep = server.start("tcp://127.0.0.1:0")
+        name = f"http-{next(_seq)}"
+        ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                     ChannelOptions(timeout_ms=3000, name=name,
+                                    share_connections=False))
+        try:
+            for _ in range(3):
+                assert not ch.call_sync("EchoService", "Echo",
+                                        b"h").failed()
+            status, body = http_get_local(ep.port, "/backends")
+            assert status == 200
+            page = json.loads(body)
+            row = page["channels"][name]["backends"][
+                f"tcp://127.0.0.1:{ep.port}"]
+            assert row["attempts"] >= 3 and row["completed"] >= 3
+            # /lb_trace: directory + 404 on unknown channel
+            status, body = http_get_local(ep.port, "/lb_trace")
+            assert status == 200 and b"channels" in body
+            status, _ = http_get_local(ep.port,
+                                       "/lb_trace?channel=missing-xyz")
+            assert status == 404
+            # /connections labels the client socket with its owner
+            status, body = http_get_local(ep.port, "/connections")
+            assert status == 200
+            conns = json.loads(body)
+            assert all(r.get("role") == "server"
+                       for r in conns["connections"])
+            mine = [r for r in conns["client_connections"]
+                    if r.get("channel") == name]
+            assert mine, conns["client_connections"]
+            assert mine[0]["backend"] == f"tcp://127.0.0.1:{ep.port}"
+            assert mine[0]["role"] == "client"
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+
+class TestExportFormats:
+    def test_prometheus_labels_and_json_safe_vars(self):
+        server, ep = _start_server("fmt")
+        name = f"fmt-{next(_seq)}"
+        ch = Channel(str(ep), ChannelOptions(timeout_ms=2000, name=name))
+        try:
+            assert not ch.call_sync("EchoService", "Echo", b"p").failed()
+            bs.expose_backend_vars()
+            from brpc_tpu.bvar.prometheus import dump_prometheus
+            lines = [ln for ln in dump_prometheus().splitlines()
+                     if ln.startswith("backend_stats")
+                     and f'channel="{name}"' in ln]
+            assert any("backend_stats_attempts{" in ln for ln in lines)
+            assert any('backend="' in ln for ln in lines)
+            # /vars JSON path: tuple keys would crash json.dumps — the
+            # dim's get_value must be string-keyed
+            from brpc_tpu.bvar.variable import dump_exposed
+            dumped = json.dumps(dict(dump_exposed("backend_stats")),
+                                default=str)
+            assert name in dumped
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+    def test_labeled_items_keeps_tuple_labels(self):
+        reg = bs.global_stats()
+        reg.cell("li-chan", "mem://li").on_start(1)
+        items = dict(reg._dim.labeled_items())
+        assert ("li-chan", "mem://li") in items
+
+
+class TestPostfork:
+    def test_registered_and_child_starts_fresh(self):
+        from brpc_tpu.butil import postfork
+        assert "rpc.backend_stats" in postfork.registered_names()
+        reg = bs.global_stats()
+        reg.cell("fork-chan", "mem://fork").on_start(1)
+        bs.ring_event("fork-chan", "select", endpoint="mem://fork")
+        parent_cells = reg._dim.count_stats()
+        assert parent_cells >= 1
+
+        r, w = os.pipe()
+        pid = os.fork()
+        if pid == 0:
+            try:
+                child = bs.global_stats()
+                msg = "OK" if (child is not reg
+                               and child._dim.count_stats() == 0
+                               and child.ring_names() == {}) else \
+                    f"stale: {child._dim.count_stats()} cells"
+            except BaseException as e:  # noqa: BLE001 - report only
+                msg = f"EXC:{type(e).__name__}:{e}"
+            try:
+                os.write(w, msg.encode()[:4096])
+            finally:
+                os._exit(0)
+        os.close(w)
+        chunks = []
+        while True:
+            b = os.read(r, 4096)
+            if not b:
+                break
+            chunks.append(b)
+        os.close(r)
+        os.waitpid(pid, 0)
+        assert b"".join(chunks).decode() == "OK"
+        # parent untouched
+        assert bs.global_stats() is reg
+        assert reg._dim.count_stats() == parent_cells
+
+    def test_census_registered(self):
+        from brpc_tpu.butil import resource_census
+        assert "backend_stats" in resource_census.registered_names()
+        reg = bs.global_stats()
+        reg.cell("census-chan", "mem://census").on_start(1)
+        snap = resource_census.snapshot()["backend_stats"]
+        assert snap["count"] >= 1

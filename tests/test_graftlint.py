@@ -322,6 +322,46 @@ class TestPostforkReset:
         found = list(PostforkResetRule().check(sf, Context([sf])))
         assert found == [], [f.format() for f in found]
 
+    def test_statcell_fixture_violations(self):
+        """The stat-cell registry shape (rpc/backend_stats.py idiom):
+        a lazy cell-registry accessor and a freelist-bearing ring
+        store, unregistered — both must fire."""
+        active, _ = _lint("bad_postfork_statcells.py")
+        assert [f.rule for f in active] == ["postfork-reset"] * 2, \
+            [f.format() for f in active]
+        msgs = " | ".join(f.message for f in active)
+        assert "global_cells" in msgs and "'rings'" in msgs
+
+    def test_statcell_good_fixture_zero_false_positives(self):
+        active, waived = _lint("good_postfork_statcells.py")
+        assert active == [] and waived == [], \
+            [f.format() for f in active + waived]
+
+    def test_mutation_dropping_registration_fires_on_real_backend_stats(
+            self):
+        """Mutation pin: strip the postfork.register line from the real
+        rpc/backend_stats.py — the rule must fire on global_stats(), so
+        the stat-cell registry can never silently lose its fork reset
+        (a forked shard would serve the parent's per-backend cells)."""
+        from brpc_tpu.analysis.core import Context, SourceFile
+        from brpc_tpu.analysis.rules.postfork_reset import PostforkResetRule
+        path = os.path.join(REPO_ROOT, "brpc_tpu", "rpc",
+                            "backend_stats.py")
+        src = open(path).read()
+        target = [ln for ln in src.splitlines()
+                  if "postfork.register(" in ln]
+        assert len(target) == 1, target
+        mutated = src.replace(target[0] + "\n", "")
+        sf = SourceFile(path, "brpc_tpu/rpc/backend_stats.py", mutated)
+        found = list(PostforkResetRule().check(sf, Context([sf])))
+        assert any(f.rule == "postfork-reset"
+                   and "global_stats" in f.message
+                   for f in found), [f.format() for f in found]
+        # and the unmutated module stays clean
+        sf_ok = SourceFile(path, "brpc_tpu/rpc/backend_stats.py", src)
+        assert list(PostforkResetRule().check(sf_ok, Context([sf_ok]))) \
+            == []
+
     def test_mutation_dropping_registration_fires_on_real_dispatcher(self):
         """Mutation pin: strip the postfork.register line from the real
         transport/event_dispatcher.py — the rule must fire, so the

@@ -255,6 +255,80 @@ class TestChaosDelayAttribution:
             chaos.uninstall()
 
 
+class TestAttemptSpans:
+    """Retry/backup fan-out (ISSUE 7): a multi-attempt call emits one
+    child span per attempt (attempt index + selected backend ride the
+    span), parented to the main client span; a single-attempt call
+    keeps exactly one client span."""
+
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        yield
+        chaos.uninstall()
+
+    @staticmethod
+    def _attempt_spans(spans):
+        return [d for d in spans if any(
+            a["text"].startswith("attempt=") for a in d["annotations"])]
+
+    def test_retry_emits_child_span_per_attempt(self, rpcz):
+        addr = f"mem://attempt-{next(_seq)}"
+        # first connection dies mid-response: attempt 1 is issued, its
+        # socket fails, the retry re-issues on a fresh conn and wins
+        chaos.install(FaultPlan(seed=9).at(
+            addr, 0, Fault("drop", at_byte=10, side="accept")))
+        server = Server(ServerOptions(enable_builtin_services=False))
+        svc = Service("S")
+        svc.register_method("Echo", lambda cntl, request: bytes(request))
+        server.add_service(svc)
+        server.start(addr)
+        ch = Channel(addr, ChannelOptions(timeout_ms=4000, max_retry=3,
+                                          share_connections=False))
+        try:
+            cntl = ch.call_sync("S", "Echo", b"retry-me")
+            assert not cntl.failed(), cntl.error_text
+            assert cntl.current_try >= 1      # a retry actually happened
+            spans = _trace_spans(cntl.trace_id,
+                                 want=3 + cntl.current_try)
+            attempts = self._attempt_spans(spans)
+            assert len(attempts) == cntl.current_try + 1, \
+                [d["annotations"] for d in spans]
+            main = [d for d in spans if d["side"] == "client"
+                    and d not in attempts]
+            assert len(main) == 1
+            for d in attempts:
+                assert d["side"] == "client"
+                assert d["parent_span_id"] == main[0]["span_id"]
+                assert d["remote_side"], d      # the selected backend
+                assert d["end_us"] >= d["start_us"] > 0
+            indices = sorted(
+                int(a["text"].split()[0].split("=")[1])
+                for d in attempts for a in d["annotations"]
+                if a["text"].startswith("attempt="))
+            assert indices == list(range(1, len(attempts) + 1))
+            # the failed attempt carries its verdict; the winner is OK
+            codes = sorted(d["error_code"] for d in attempts)
+            assert codes[0] == 0 and codes[-1] != 0
+        finally:
+            ch.close()
+            server.stop()
+            chaos.uninstall()
+
+    def test_single_attempt_call_emits_no_attempt_spans(self, rpcz):
+        server, addr = _serve()
+        ch = Channel(addr, ChannelOptions(timeout_ms=4000))
+        try:
+            cntl = ch.call_sync("S", "Echo", b"one-shot")
+            assert not cntl.failed(), cntl.error_text
+            spans = _trace_spans(cntl.trace_id, want=2)
+            assert not self._attempt_spans(spans), spans
+            assert len([d for d in spans if d["side"] == "client"]) == 1
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+
 class TestCrossProcessTraceAssembly:
     def test_chain_across_three_processes_assembles_one_tree(
             self, rpcz, tmp_path):

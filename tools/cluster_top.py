@@ -1,0 +1,366 @@
+"""Live multi-node cluster "top": scrape N admin endpoints' /backends
++ /status and render ONE merged per-backend table.
+
+Each node's /backends page reports its own channels' view of the
+cluster (per-backend qps, percentiles, errors, inflight, breaker
+state). Across nodes the merge follows the ShardAggregator discipline,
+now cross-node: counters SUM, inflight sums, percentiles come from the
+POOLED raw latency reservoirs every row carries — never from averaging
+node percentiles (averaged percentiles are wrong; pooled reservoirs
+are the same estimator the cells themselves use).
+
+    python tools/cluster_top.py host:port [host:port ...]   # live top
+    python tools/cluster_top.py host:port --once --json     # scripting
+    python tools/cluster_top.py --smoke                     # the gate
+
+``--smoke`` (gate_cluster_top in tools/preflight.py --gate) spawns two
+echo backends, bursts a cluster channel at them from this process, and
+asserts the HTTP-scraped /backends totals equal the in-process channel
+bvar sums (every attempt attributed to a backend row, zero left in
+flight), the cross-node merge math reproduces the channel totals, and
+— unless BRPC_TPU_PERF_SMOKE=0 — that stat cells cost <= 5% qps
+(BRPC_TPU_BACKEND_STATS on vs off, alternating best-of windows).
+Prints one JSON line; BRPC_TPU_CLUSTER_SMOKE=0 skips the lane.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+BASE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, BASE)
+sys.path.insert(0, os.path.join(BASE, "tools"))
+
+OVERHEAD_PCT_MAX = 5.0
+
+# counters that sum across nodes; percentile fields are recomputed
+# from pooled samples instead (shard_group._merge_stat_dict would
+# count-weight them — fine as a fallback, wrong to prefer here where
+# every row ships its reservoir)
+_SUM_KEYS = ("attempts", "completed", "abandoned", "connect_errors",
+             "inflight", "errors", "count", "qps", "bytes_in",
+             "bytes_out")
+
+
+def fetch_json(hostport: str, path: str,
+               timeout_s: float = 5.0) -> Optional[dict]:
+    """GET host:port/path -> parsed JSON, None on any failure (a dead
+    node must not take the top down — it shows as nodes_down)."""
+    import http.client
+    host, _, port = hostport.partition(":")
+    try:
+        conn = http.client.HTTPConnection(host, int(port or 80),
+                                          timeout=timeout_s)
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        conn.close()
+        if resp.status != 200:
+            return None
+        return json.loads(body)
+    except Exception:
+        return None
+
+
+def merge_backends(pages: List[dict]) -> Dict[str, dict]:
+    """Merge N nodes' /backends payloads into {backend_key: row}:
+    counters sum, percentiles pool (the cross-node ShardAggregator
+    math), breaker isolation ORs (isolated anywhere = worth seeing)."""
+    from brpc_tpu.rpc.shard_group import _percentile
+    merged: Dict[str, dict] = {}
+    pooled: Dict[str, List[float]] = {}
+    for page in pages:
+        for ch in (page or {}).get("channels", {}).values():
+            for backend, row in ch.get("backends", {}).items():
+                m = merged.setdefault(backend, {"nodes": 0})
+                m["nodes"] += 1
+                for k in _SUM_KEYS:
+                    v = row.get(k)
+                    if isinstance(v, (int, float)):
+                        m[k] = round(m.get(k, 0) + v, 3)
+                pooled.setdefault(backend, []).extend(
+                    row.get("latency_samples") or ())
+                state = row.get("state") or {}
+                br = state.get("breaker") or {}
+                if br.get("isolated"):
+                    m["isolated"] = True
+                if state.get("health_dead"):
+                    m["health_dead"] = True
+    for backend, samples in pooled.items():
+        samples.sort()
+        if samples:
+            m = merged[backend]
+            m["latency_p50_us"] = round(_percentile(samples, 0.5), 1)
+            m["latency_p99_us"] = round(_percentile(samples, 0.99), 1)
+    for m in merged.values():
+        observed = (m.get("completed", 0) or 0) \
+            + (m.get("connect_errors", 0) or 0)
+        m["error_ratio"] = round((m.get("errors", 0) or 0) / observed, 4) \
+            if observed else 0.0
+    return merged
+
+
+def scrape(nodes: List[str]) -> dict:
+    pages = []
+    statuses = {}
+    down = []
+    for node in nodes:
+        page = fetch_json(node, "/backends")
+        if page is None:
+            down.append(node)
+            continue
+        pages.append(page)
+        st = fetch_json(node, "/status")
+        if st is not None:
+            statuses[node] = {"processed": st.get("processed"),
+                              "errors": st.get("errors"),
+                              "concurrency": st.get("concurrency")}
+    return {"backends": merge_backends(pages), "nodes": statuses,
+            "nodes_down": down, "nodes_up": len(pages)}
+
+
+def render(view: dict) -> str:
+    cols = ("backend", "nodes", "qps", "p50_us", "p99_us", "err%",
+            "inflight", "state")
+    rows = []
+    for backend in sorted(view["backends"]):
+        m = view["backends"][backend]
+        state = "ISOLATED" if m.get("isolated") else (
+            "DEAD" if m.get("health_dead") else "ok")
+        rows.append((backend, str(m.get("nodes", 0)),
+                     f"{m.get('qps', 0):.0f}",
+                     f"{m.get('latency_p50_us', 0):.0f}",
+                     f"{m.get('latency_p99_us', 0):.0f}",
+                     f"{100 * m.get('error_ratio', 0):.2f}",
+                     str(m.get("inflight", 0)), state))
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+              for i, c in enumerate(cols)]
+    out = ["  ".join(c.ljust(widths[i]) for i, c in enumerate(cols))]
+    out += ["  ".join(cell.ljust(widths[i]) for i, cell in enumerate(r))
+            for r in rows]
+    srv = view.get("nodes", {})
+    out.append("")
+    for node, st in sorted(srv.items()):
+        out.append(f"node {node}: processed={st.get('processed')} "
+                   f"errors={st.get('errors')} "
+                   f"concurrency={st.get('concurrency')}")
+    for node in view.get("nodes_down", []):
+        out.append(f"node {node}: DOWN")
+    return "\n".join(out)
+
+
+def run_top(nodes: List[str], interval: float, once: bool,
+            as_json: bool) -> int:
+    while True:
+        view = scrape(nodes)
+        if as_json:
+            print(json.dumps(view, default=str), flush=True)
+        else:
+            if not once:
+                sys.stdout.write("\x1b[2J\x1b[H")   # clear + home
+            stamp = time.strftime("%H:%M:%S")
+            print(f"cluster_top  {stamp}  nodes={view['nodes_up']}"
+                  f"/{len(nodes)}")
+            print(render(view), flush=True)
+        if once:
+            return 0 if view["nodes_up"] else 1
+        time.sleep(interval)
+
+
+# ---------------------------------------------------------------- smoke
+
+def _burst(ch, calls: int, seconds: float) -> int:
+    """Sync burst with a wall budget; returns successful calls."""
+    ok = 0
+    stop_at = time.perf_counter() + seconds
+    for _ in range(calls):
+        if time.perf_counter() >= stop_at:
+            break
+        if not ch.call_sync("Bench", "PyEcho", b"q").failed():
+            ok += 1
+    return ok
+
+
+def _overhead_window(ports: List[int], seconds: float) -> float:
+    """One pipelined multi-process window through CLUSTER channels at
+    the backends — the same driver and shape the bench lane's
+    backend_stats_overhead_pct headline is defined on (a sync
+    single-connection loop is ~3x more sensitive to box drift than
+    the cells are expensive). The on/off switch rides the env into
+    the worker processes."""
+    from qps_client import drive_multiproc
+    plist = ",".join(str(p) for p in ports)
+    nprocs = min(4, max(2, (os.cpu_count() or 2) // 4))
+    return drive_multiproc(plist, nprocs=nprocs, seconds=seconds,
+                           conns=2, inflight=8, method="PyEcho")["qps"]
+
+
+def run_smoke(out: dict) -> None:
+    from spawn_util import http_get_local, spawn_port_server
+
+    from brpc_tpu.rpc import (ChannelOptions, ClusterChannel, Server,
+                              ServerOptions)
+    from brpc_tpu.rpc import backend_stats as bs
+
+    procs = []
+    ch = None
+    admin = None
+    try:
+        ports = []
+        for _ in range(2):
+            proc, port = spawn_port_server(
+                [os.path.join(BASE, "tools", "bench_echo_server.py")],
+                wall_s=20.0)
+            if port is None:
+                out["error"] = "echo server spawn failed"
+                return
+            procs.append(proc)
+            ports.append(port)
+        # the admin endpoint THIS process serves: cluster_top scrapes
+        # our own /backends over real HTTP, closing the loop
+        admin = Server(ServerOptions(enable_builtin_services=True))
+        admin_ep = admin.start("tcp://127.0.0.1:0")
+        naming = "list://" + ",".join(
+            f"tcp://127.0.0.1:{p}" for p in ports)
+        ch = ClusterChannel(naming, "rr",
+                            ChannelOptions(timeout_ms=4000, max_retry=2,
+                                           name="smoke_cluster"))
+        calls = _burst(ch, 80, 10.0)
+        out["calls"] = calls
+        if calls < 40:
+            out["error"] = f"burst mostly failed ({calls}/80)"
+            return
+
+        # 1. scraped /backends totals == in-process channel bvar sums
+        _, body = http_get_local(admin_ep.port, "/backends")
+        scraped = json.loads(body)
+        local = bs.backends_page_payload()
+        s_rows = scraped["channels"]["smoke_cluster"]["backends"]
+        l_rows = local["channels"]["smoke_cluster"]["backends"]
+        out["backends"] = len(s_rows)
+        agree = set(s_rows) == set(l_rows) and all(
+            s_rows[k]["attempts"] == l_rows[k]["attempts"]
+            and s_rows[k]["completed"] == l_rows[k]["completed"]
+            for k in s_rows)
+        out["scrape_matches_bvars"] = agree
+
+        # 2. attribution: every attempt on exactly one backend row,
+        # nothing stuck in flight after the burst
+        attempts = sum(r["attempts"] for r in s_rows.values())
+        settled = sum(r["completed"] + r["abandoned"]
+                      for r in s_rows.values())
+        inflight = sum(r["inflight"] for r in s_rows.values())
+        out["attempts"] = attempts
+        out["attributed"] = bool(
+            len(s_rows) == 2 and attempts >= calls
+            and settled == attempts and inflight == 0
+            and scraped["unattributed_errors"] == 0)
+
+        # 3. the cross-node merge math reproduces the channel totals
+        # (echo backends contribute empty /backends pages)
+        nodes = [f"127.0.0.1:{admin_ep.port}"] + \
+            [f"127.0.0.1:{p}" for p in ports]
+        view = scrape(nodes)
+        out["nodes_up"] = view["nodes_up"]
+        merged = view["backends"]
+        out["merge_matches"] = bool(
+            view["nodes_up"] == 3 and set(merged) == set(s_rows)
+            and all(merged[k]["attempts"] >= s_rows[k]["attempts"]
+                    for k in merged))
+
+        # 4. overhead: cells on vs off (alternating best-of; a >5%
+        # readout earns one more round — box drift vs real cost)
+        skip_perf = os.environ.get("BRPC_TPU_PERF_SMOKE", "1") == "0"
+        if not skip_perf:
+            saved = os.environ.pop("BRPC_TPU_BACKEND_STATS", None)
+            qps_on: List[float] = []
+            qps_off: List[float] = []
+            rounds = 2
+            try:
+                while True:
+                    for _ in range(rounds):
+                        os.environ.pop("BRPC_TPU_BACKEND_STATS", None)
+                        qps_on.append(_overhead_window(ports, 0.9))
+                        os.environ["BRPC_TPU_BACKEND_STATS"] = "0"
+                        qps_off.append(_overhead_window(ports, 0.9))
+                    out["qps_on"] = round(max(qps_on), 1)
+                    out["qps_off"] = round(max(qps_off), 1)
+                    out["backend_stats_overhead_pct"] = round(
+                        max(0.0, (1.0 - max(qps_on) / max(qps_off))
+                            * 100), 2) if max(qps_off) else 100.0
+                    if rounds == 1 or out["backend_stats_overhead_pct"] \
+                            <= OVERHEAD_PCT_MAX:
+                        break
+                    rounds = 1
+            finally:
+                if saved is None:
+                    os.environ.pop("BRPC_TPU_BACKEND_STATS", None)
+                else:
+                    os.environ["BRPC_TPU_BACKEND_STATS"] = saved
+        ok = bool(out["scrape_matches_bvars"] and out["attributed"]
+                  and out["merge_matches"]
+                  and (skip_perf
+                       or out.get("backend_stats_overhead_pct", 100.0)
+                       <= OVERHEAD_PCT_MAX))
+        out["ok"] = ok
+        if not ok:
+            out["invariant"] = ("scrape/attribution/merge/overhead "
+                                "check failed")
+    finally:
+        try:
+            if ch is not None:
+                ch.close()
+        except Exception:
+            pass
+        try:
+            if admin is not None:
+                admin.stop()
+                admin.join(2)
+        except Exception:
+            pass
+        for p in procs:
+            try:
+                p.terminate()
+            except Exception:
+                pass
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(
+        description="live merged per-backend view over N nodes' "
+                    "/backends + /status")
+    ap.add_argument("nodes", nargs="*", help="host:port admin endpoints")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="one scrape, then exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-contained gate: 2 backends + a cluster "
+                         "burst; asserts scrape/attribution/merge/"
+                         "overhead invariants")
+    args = ap.parse_args()
+    if args.smoke:
+        out: dict = {}
+        try:
+            run_smoke(out)
+        except Exception as e:  # noqa: BLE001 - one JSON line either way
+            out["ok"] = False
+            out["error"] = f"{type(e).__name__}: {e}"[:300]
+        print(json.dumps(out))
+        sys.stdout.flush()
+        return 0 if out.get("ok") else 1
+    if not args.nodes:
+        ap.error("need at least one host:port (or --smoke)")
+    return run_top(args.nodes, args.interval, args.once, args.as_json)
+
+
+if __name__ == "__main__":
+    rc = main()
+    os._exit(rc)   # skip runtime-thread teardown, like bench.py

@@ -350,6 +350,37 @@ def gate_flight_smoke() -> dict:
     return out
 
 
+def gate_cluster_top() -> dict:
+    """Cluster-observatory smoke (tools/cluster_top.py --smoke): a
+    cluster-channel burst at two spawned backends must land 100% of
+    attempts on backend stat-cell rows, the HTTP-scraped /backends
+    totals must equal the in-process channel bvar sums, the cross-node
+    merge math must reproduce them, and the cells must cost <= 5% qps
+    on vs off (BRPC_TPU_PERF_SMOKE=0 skips just that criterion). A
+    subprocess so a wedged burst cannot hang the gate;
+    BRPC_TPU_CLUSTER_SMOKE=0 skips the lane."""
+    if os.environ.get("BRPC_TPU_CLUSTER_SMOKE", "1") == "0":
+        return {"ok": True, "skipped": "BRPC_TPU_CLUSTER_SMOKE=0"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "cluster_top.py"), "--smoke"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    out: dict = {"ok": proc.returncode == 0}
+    try:
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+        for k in ("backends", "attempts", "scrape_matches_bvars",
+                  "attributed", "merge_matches",
+                  "backend_stats_overhead_pct", "qps_on", "qps_off"):
+            if k in report:
+                out[k] = report[k]
+        if proc.returncode != 0:
+            out["invariant"] = report.get("invariant", report.get("error"))
+    except (ValueError, IndexError):
+        out["ok"] = False
+        out["error"] = (proc.stdout + proc.stderr)[-500:]
+    return out
+
+
 def gate_perf_smoke() -> dict:
     """Fast hot-path perf gate: raw-socket-normalized small-RPC and
     1MB-echo ratios must stay within 30% of the BENCH_r05-era floors.
@@ -415,6 +446,7 @@ def run_gate() -> int:
                      ("trace_smoke", gate_trace_smoke),
                      ("shard_smoke", gate_shard_smoke),
                      ("flight_smoke", gate_flight_smoke),
+                     ("cluster_top", gate_cluster_top),
                      ("perf_smoke", gate_perf_smoke)):
         try:
             report[name] = fn()

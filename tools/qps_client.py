@@ -31,11 +31,17 @@ BASE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, BASE)
 
 
-def drive_window(port: int, seconds: float, conns: int,
+def drive_window(port, seconds: float, conns: int,
                  inflight: int = 8, method: str = "Echo") -> dict:
     """Drive ``conns`` private connections for ``seconds``; returns
     calls/elapsed/qps (failures counted apart — a dead window must be
     visible, not a zero that looks slow).
+
+    ``port`` may be a comma-separated list ("5001,5002"): the driver
+    then spreads load over the backends through a ClusterChannel
+    (list:// naming + round-robin) per connection slot — the cluster
+    lane's client, exercising the per-backend stat cells under real
+    multi-backend load.
 
     Each connection runs ``inflight`` pipelined async calls, every
     completion re-issuing from its done callback (the reference's
@@ -43,12 +49,22 @@ def drive_window(port: int, seconds: float, conns: int,
     (1/RTT per connection ≈ 1.5-3k qps here) and would measure the
     round-trip, not the server's capacity; ``inflight=1`` degrades to
     exactly that sync shape if wanted."""
-    from brpc_tpu.rpc import Channel, ChannelOptions
+    from brpc_tpu.rpc import Channel, ChannelOptions, ClusterChannel
 
-    chs = [Channel(f"tcp://127.0.0.1:{port}",
-                   ChannelOptions(timeout_ms=5000, max_retry=2,
-                                  share_connections=False))
-           for _ in range(conns)]
+    ports = [int(p) for p in str(port).split(",")]
+    if len(ports) > 1:
+        naming = "list://" + ",".join(
+            f"tcp://127.0.0.1:{p}" for p in ports)
+        chs = [ClusterChannel(naming, "rr",
+                              ChannelOptions(timeout_ms=5000, max_retry=2,
+                                             share_connections=False,
+                                             name=f"qps-{i}"))
+               for i in range(conns)]
+    else:
+        chs = [Channel(f"tcp://127.0.0.1:{ports[0]}",
+                       ChannelOptions(timeout_ms=5000, max_retry=2,
+                                      share_connections=False))
+               for _ in range(conns)]
     for c in chs:
         for _ in range(10):
             c.call_sync("Bench", method, b"w")
@@ -105,12 +121,13 @@ def drive_window(port: int, seconds: float, conns: int,
             "qps": round(sum(counts) / dt, 1) if dt > 0 else 0.0}
 
 
-def drive_multiproc(port: int, nprocs: int, seconds: float,
+def drive_multiproc(port, nprocs: int, seconds: float,
                     conns: int, inflight: int = 8,
                     method: str = "Echo",
                     wall_s: float = 60.0) -> dict:
     """Aggregate qps over ``nprocs`` worker PROCESSES (each its own
-    GIL). Workers that fail to report are counted in ``dead_workers``
+    GIL); ``port`` accepts the same comma-list as drive_window.
+    Workers that fail to report are counted in ``dead_workers``
     rather than silently shrinking the load."""
     procs = []
     for _ in range(nprocs):
@@ -146,7 +163,7 @@ def drive_multiproc(port: int, nprocs: int, seconds: float,
 
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    port = int(sys.argv[1])
+    port = sys.argv[1]          # "5001" or "5001,5002" (cluster lane)
     seconds = float(sys.argv[2]) if len(sys.argv) > 2 else 1.5
     conns = int(sys.argv[3]) if len(sys.argv) > 3 else 2
     inflight = int(sys.argv[4]) if len(sys.argv) > 4 else 8
