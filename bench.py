@@ -1121,6 +1121,43 @@ def main() -> None:
                 result["partial"] = True
                 _progress({"progress": "error", "phase": "cluster",
                            "error": result["cluster"]["error"]})
+        # ---- serving lane (ISSUE 8): continuous-batching inference
+        # over streaming RPC — a 2-shard GenerateService under a
+        # chaos-flapped pipelined client mix (seeded transport drops
+        # mid-stream + redial). Headline keys: tokens_per_s and
+        # ttft_p99_ms; full_gen_p99_ms rides along as proof streaming
+        # is incremental (TTFT p99 must sit well under it). A
+        # subprocess so a wedged engine cannot take the bench down.
+        if deadline.remaining() < 30.0:
+            result["serving"] = {"skipped": "wall budget"}
+            result["partial"] = True
+        else:
+            import subprocess as _sp
+            try:
+                win = min(6.0, max(3.0, deadline.remaining() * 0.05))
+                p = _sp.run(
+                    [sys.executable,
+                     os.path.join(base, "tools", "serving_smoke.py"),
+                     "--bench", "--seconds", str(win)],
+                    capture_output=True, text=True, timeout=240)
+                rep = json.loads(p.stdout.strip().splitlines()[-1])
+                result["serving"] = rep
+                if rep.get("tokens_per_s") is not None:
+                    result["tokens_per_s"] = rep["tokens_per_s"]
+                if rep.get("ttft_p99_ms") is not None:
+                    result["ttft_p99_ms"] = rep["ttft_p99_ms"]
+                _progress({"progress": "serving_lane",
+                           "tokens_per_s": rep.get("tokens_per_s"),
+                           "ttft_p99_ms": rep.get("ttft_p99_ms"),
+                           "full_gen_p99_ms": rep.get("full_gen_p99_ms"),
+                           "flapped": rep.get("flapped"),
+                           "errors": rep.get("errors")})
+            except Exception as e:  # noqa: BLE001 - diagnostics only
+                result["serving"] = {
+                    "error": f"{type(e).__name__}: {e}"[:200]}
+                result["partial"] = True
+                _progress({"progress": "error", "phase": "serving",
+                           "error": result["serving"]["error"]})
         ch.close()
     except BaseException as e:  # noqa: BLE001 - salvage partial data
         result["partial"] = True

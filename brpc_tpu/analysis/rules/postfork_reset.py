@@ -42,6 +42,24 @@ function named ``register_postfork_reset``):
      Plain data singletons (Adder(), Maxer(), compiled regexes) stay
      out of scope.
 
+  3. the object-registry registrar::
+
+         _modules = []
+         def register_module(module):
+             _modules.append(module)
+
+     a module-level ``register*`` function appending its own parameter
+     into a module-level list carries LIVE caller-owned objects across
+     fork — a forked shard's fresh loops would drive the PARENT's
+     registered engines/callbacks (fiber/worker_module.py is the
+     canonical case: the child's workers would double-run the parent's
+     serving engine against controllers the child does not own).
+     ``register_protocol`` is exempt like the accessor case: the
+     protocol table is fork-safe codec data. Registrars that copy or
+     wrap the argument (``append((name, fn))``) stay out of scope —
+     name-keyed provider tables are replace-on-reregister by
+     convention here and fork-safe when their entries are.
+
 A singleton that is genuinely fork-safe can waive with a reason::
 
     # graftlint: disable=postfork-reset -- <why the fork inherits this safely>
@@ -158,6 +176,46 @@ class PostforkResetRule(Rule):
                     yield node
                     break
 
+    def _registry_registrars(self, sf: SourceFile) \
+            -> Iterable[ast.FunctionDef]:
+        """Module-level ``register*`` functions appending their own
+        parameter into a module-level list (idiom 3 in the module
+        doc)."""
+        module_lists: Set[str] = set()
+        for node in sf.tree.body:
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            if isinstance(value, ast.List):
+                module_lists.update(t.id for t in targets
+                                    if isinstance(t, ast.Name))
+        if not module_lists:
+            return
+        for node in sf.tree.body:
+            if not isinstance(node, ast.FunctionDef) or \
+                    not node.name.startswith("register"):
+                continue
+            if node.name == "register_protocol":
+                continue    # fork-safe codec table (module doc)
+            params = {a.arg for a in node.args.args}
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr == "append" and \
+                        isinstance(sub.func.value, ast.Name) and \
+                        sub.func.value.id in module_lists and \
+                        sub.args and \
+                        isinstance(sub.args[0], ast.Name) and \
+                        sub.args[0].id in params:
+                    yield node
+                    break
+
     # -------------------------------------------------------------- check
     def check(self, sf: SourceFile, ctx: Context) -> Iterable[Finding]:
         if not sf.is_python or "/analysis/" in sf.relpath \
@@ -183,4 +241,13 @@ class PostforkResetRule(Rule):
                     "resources (threads/fds/freelists) but the module "
                     "never registers a postfork reset "
                     "(butil.postfork.register)"))
+        for fn in self._registry_registrars(sf):
+            if not registered:
+                findings.append(Finding(
+                    self.name, sf.relpath, fn.lineno,
+                    f"'{fn.name}' appends caller-owned objects into a "
+                    "module-level registry but the module never "
+                    "registers a postfork reset (butil.postfork."
+                    "register) — a forked shard worker would run the "
+                    "PARENT's registered objects"))
         return findings

@@ -44,6 +44,12 @@ from collections import Counter, deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 from brpc_tpu.butil.flags import define_flag, flag
+# bound at module load, NOT inside the sampler's attribution path: an
+# import there opens the module file ON THE SAMPLER THREAD at sample
+# time — a transient fd that can appear/disappear mid-sample in
+# fd-exhaustion scenarios (the EMFILE accept-backoff test lost its
+# "no free descriptors" precondition to exactly that open/close)
+from brpc_tpu.fiber import worker_module as _worker_module
 
 define_flag("continuous_profiler_hz", 20,
             "continuous sampling profiler rate (samples/s across all "
@@ -271,6 +277,11 @@ class FlightRecorder:
                     return f"rpc:{name}"
                 return f"fiber:{name}"
             return "fiber:<anon>"
+        # worker-module engine slices (serving decode steps) run on the
+        # worker thread OUTSIDE any fiber: the module declares its label
+        lbl = _worker_module.active_label(tid)
+        if lbl:
+            return f"rpc:{lbl}" if "." in lbl else f"module:{lbl}"
         if hint_frame is not None:
             # f_locals on another thread's live frame builds a copy —
             # fine at sampling rate, never mutates the frame

@@ -184,6 +184,15 @@ def add_builtin_services(server) -> None:
         return json.dumps(backends_page_payload(), default=str).encode()
 
     @builtin.method()
+    def serving(cntl, request):
+        # continuous-batching engine state (running/waiting/evicted,
+        # batch-size histogram, KV occupancy) — the builtin-RPC twin
+        # of HTTP /serving, from the ONE shared builder
+        from brpc_tpu.serving.service import serving_page_payload
+        return json.dumps(serving_page_payload(server),
+                          default=str).encode()
+
+    @builtin.method()
     def lb_trace(cntl, request):
         # request bytes = channel name (empty = channel directory)
         from brpc_tpu.rpc.backend_stats import lb_trace_payload

@@ -19,6 +19,7 @@ TABS = [
     ("heap", "/hotspots?type=heap"),
     ("contentions", "/contentions"),
     ("census", "/census"),
+    ("serving", "/serving"),
     ("backends", "/backends"),
     ("lb_trace", "/lb_trace"),
     ("connections", "/connections"),

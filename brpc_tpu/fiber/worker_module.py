@@ -14,7 +14,7 @@ the worker threads instead of fighting them).
 from __future__ import annotations
 
 import threading
-from typing import List
+from typing import Dict, List, Optional
 
 
 class WorkerModule:
@@ -22,8 +22,14 @@ class WorkerModule:
         """Cheap check: is there engine work pending?"""
         return False
 
-    def process(self, group_index: int) -> None:
-        """Run a bounded slice of engine work on this worker."""
+    def process(self, group_index: int):
+        """Run a bounded slice of engine work on this worker.
+
+        Return ``False`` to report that NO progress was made (e.g. a
+        sibling worker already holds the engine's slice lock): the
+        worker loop then treats this round as idle and may park instead
+        of hot-spinning on work it cannot touch. Any other return
+        (including the default None) counts as progress."""
 
     def on_worker_start(self, group_index: int) -> None:
         """Called once per worker thread before its loop."""
@@ -34,6 +40,11 @@ class WorkerModule:
 
 _modules: List[WorkerModule] = []
 _lock = threading.Lock()
+# thread-id -> attribution label while that thread is inside a module's
+# process() slice: the flight recorder's sampler attributes busy samples
+# landing in engine work to the module's declared label (engine slices
+# run OUTSIDE any fiber, so the fiber-local attribution hooks miss them)
+_active: Dict[int, str] = {}
 
 
 def register_module(module: WorkerModule) -> None:
@@ -60,18 +71,35 @@ def has_modules() -> bool:
 
 def process_modules(group_index: int) -> bool:
     """One pass over registered modules from a worker loop; True if any
-    ran work (the worker then skips parking this round)."""
+    ran work (the worker then skips parking this round). A module whose
+    ``process`` returns False reported a no-progress slice and does NOT
+    keep the worker awake."""
     ran = False
+    tid = threading.get_ident()
     for m in _modules:
         try:
             if m.has_task():
-                m.process(group_index)
-                ran = True
+                label = getattr(m, "attribution_label", None)
+                if label is not None:
+                    _active[tid] = label
+                try:
+                    r = m.process(group_index)
+                finally:
+                    if label is not None:
+                        _active.pop(tid, None)
+                if r is not False:
+                    ran = True
         except Exception:
             import logging
             logging.getLogger("brpc_tpu.fiber").exception(
                 "worker module failed")
     return ran
+
+
+def active_label(tid: int) -> Optional[str]:
+    """The attribution label of the module slice thread ``tid`` is
+    currently inside, if any (read by the flight-recorder sampler)."""
+    return _active.get(tid)
 
 
 def notify_start(group_index: int) -> None:
@@ -88,3 +116,21 @@ def notify_stop(group_index: int) -> None:
             m.on_worker_stop(group_index)
         except Exception:
             pass
+
+
+def _postfork_reset() -> None:
+    """A forked shard must NOT inherit the parent's registered engines:
+    the parent's modules hold state (locks, batch arrays, controllers)
+    owned by threads that no longer exist, and the child's fresh worker
+    loops would double-run them against the parent's requests. Each
+    shard re-registers its own engine when its server starts."""
+    global _modules, _lock, _active
+    _modules = []
+    _lock = threading.Lock()
+    _active = {}
+
+
+from brpc_tpu.butil import postfork  # noqa: E402  (registration ships
+#                                      with the registry it resets)
+
+postfork.register("fiber.worker_module", _postfork_reset)

@@ -218,6 +218,36 @@ def flash_attention(q, k, v, *, causal: bool = False,
     return out.reshape(batch_shape + out.shape[-2:])
 
 
+def decode_attention(q, k_cache, v_cache, lengths, *,
+                     scale: Optional[float] = None,
+                     block_k: int = 128):
+    """Single-query attention over per-sequence KV caches — the decode
+    step of an incremental (continuous-batching) generation engine.
+
+    q: [B, d] — one query row per sequence (the newest position);
+    k_cache, v_cache: [B, L, d] — fixed-capacity caches, rows past each
+    sequence's length hold garbage; lengths: [B] int — the number of
+    VALID cache rows per sequence (the query sits at position
+    ``lengths - 1``).
+
+    Reuses the blockwise online-softmax recurrence (`_flash_lax`) with a
+    per-sequence ``q_offset = lengths - 1``: the causal mask then admits
+    exactly positions ``0 .. lengths-1``, so the padded tail never
+    leaks into the softmax regardless of what bytes it holds. Shapes are
+    static in (B, L, d) — one jit compilation serves every step of a
+    fixed-slot batch, which is what makes iteration-level scheduling
+    cheap enough to run between RPC fibers (serving/engine.py).
+    Returns [B, d]."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+
+    def one(q1, k1, v1, n):
+        return _flash_lax(q1[None, :], k1, v1, scale, True, block_k,
+                          q_offset=n - 1, k_offset=0)[0]
+
+    return jax.vmap(one)(q, k_cache, v_cache, lengths)
+
+
 def attention_reference(q, k, v, *, causal: bool = False,
                         scale: Optional[float] = None):
     """Naive full-matrix softmax attention — the numerics oracle."""

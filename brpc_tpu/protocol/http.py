@@ -380,6 +380,26 @@ class HttpProtocol(Protocol):
             from brpc_tpu.rpc.backend_stats import backends_page_payload
             return 200, "application/json", json.dumps(
                 backends_page_payload(), default=str).encode()
+        if path == "/serving":
+            from brpc_tpu.serving.service import serving_page_payload
+            if agg is not None:
+                # supervisor: merge the shard engines' payloads
+                # (counters sum, histograms merge); ?shard=i narrows
+                shard, err = _shard_param(agg, req)
+                if err is not None:
+                    return err
+                if shard is not None:
+                    dump = agg.shard_dump(shard)
+                    if dump is None or not dump.get("serving"):
+                        return (404, "text/plain",
+                                f"no serving dump for shard {shard}"
+                                .encode())
+                    return 200, "application/json", json.dumps(
+                        dump["serving"], default=str).encode()
+                return 200, "application/json", json.dumps(
+                    agg.merged_serving(), default=str).encode()
+            return 200, "application/json", json.dumps(
+                serving_page_payload(server), default=str).encode()
         if path == "/lb_trace":
             from brpc_tpu.rpc.backend_stats import lb_trace_payload
             try:

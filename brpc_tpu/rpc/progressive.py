@@ -39,16 +39,22 @@ class ProgressiveAttachment:
         self._socket = None
         self._pending: List[bytes] = []
         self._closed = False
+        self._failed = False            # peer gone (socket on_failed)
         self._finished = FiberEvent()   # terminator written (or conn dead)
 
     # ----------------------------------------------------- handler side
     def write(self, data) -> bool:
-        """Queue/send one chunk; False once closed or the peer is gone."""
+        """Queue/send one chunk; False once closed or the peer is gone.
+        A feeder streaming an unbounded body MUST watch this: after the
+        bound connection fails, every further write reports False so
+        the producer can stop (and release whatever generates the
+        body) instead of feeding a dead socket forever."""
         data = bytes(data)
         if not data:
-            return not self._closed
+            with self._lock:
+                return not self._closed and not self._failed
         with self._lock:
-            if self._closed:
+            if self._closed or self._failed:
                 return False
             if self._socket is None:
                 self._pending.append(data)
@@ -86,9 +92,17 @@ class ProgressiveAttachment:
             done = self._closed
             if done:
                 self._send_terminator(socket)
-        socket.on_failed(lambda _s: self._finished.set())
+        socket.on_failed(self._on_socket_failed)
         if done:
             self._finished.set()
+
+    def _on_socket_failed(self, _sock) -> None:
+        """The bound connection died: latch the failure under the lock
+        (write() must observably flip to False — a feeder racing this
+        is mid-write and picks it up next chunk) and release waiters."""
+        with self._lock:
+            self._failed = True
+        self._finished.set()
 
     async def wait_finished(self) -> None:
         """Await body completion (terminator sent or connection dead)."""

@@ -132,6 +132,7 @@ class Server:
         self.nerror = 0
         self._shard_group = None        # supervisor handle (num_shards>1)
         self.shard_index = None         # set in shard workers
+        self._serving = None            # GenerateService handle (serving/)
 
     # ------------------------------------------------------------ services
     def add_service(self, service: Service) -> None:
@@ -233,6 +234,13 @@ class Server:
             # gdb_bthread_stack.py (no-op off the main thread)
             from brpc_tpu.fiber.stacks import enable_stack_dump_signal
             enable_stack_dump_signal()
+        # serving lane: build THIS process's model replica + batcher and
+        # register the engine with the fiber workers before traffic can
+        # land. A shard worker reaches here post-fork with the module
+        # registry freshly cleared, so each shard runs a private
+        # replica — the supervisor (shard-group path above) runs none.
+        if self._serving is not None:
+            self._serving.on_server_start(self)
         transport = get_transport(ep.scheme)
         self._listener = transport.listen(ep, self._on_new_conn)
         self._endpoint = self._listener.endpoint
@@ -299,6 +307,10 @@ class Server:
             return
         if self._listener is not None:
             self._listener.stop()
+        if self._serving is not None:
+            # unregister the engine from the worker loops and retire
+            # in-flight sequences (their clients are being drained)
+            self._serving.on_server_stop(self)
 
     def join(self, timeout_s: float = 5.0) -> None:
         """Wait for in-flight requests, then close connections."""

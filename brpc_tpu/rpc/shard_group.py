@@ -114,6 +114,9 @@ def write_shard_dump(dirpath: str, index: int, server, seq: int) -> None:
         "hotspots": global_recorder().dump_state(),
         "census": census_page_payload(server),
     }
+    if getattr(server, "_serving", None) is not None:
+        from brpc_tpu.serving.service import serving_page_payload
+        doc["serving"] = serving_page_payload(server)
     path = os.path.join(dirpath, f"shard-{index}.json")
     tmp = path + f".tmp.{os.getpid()}"
     with open(tmp, "w", encoding="utf-8") as f:
@@ -299,6 +302,32 @@ class ShardAggregator:
         return merge_dump_states(
             [d["hotspots"] for d in self.read_dumps()
              if d.get("hotspots")])
+
+    def merged_serving(self) -> dict:
+        """The group-wide serving view: per-shard engine payloads
+        merged — counters/queue depths sum, the batch-size histogram
+        and steps-by-group maps merge by key, KV occupancy averages
+        over reporting shards (each shard owns an equal KV budget)."""
+        dumps = [d["serving"] for d in self.read_dumps()
+                 if d.get("serving") and d["serving"].get("enabled")]
+        out: dict = {"mode": "shard_group", "shards_reporting": len(dumps),
+                     "enabled": bool(dumps)}
+        if not dumps:
+            return out
+        for key in ("waiting", "completed", "evicted", "shed",
+                    "canceled", "tokens_out", "decode_steps"):
+            out[key] = sum(d.get(key, 0) or 0 for d in dumps)
+        out["running"] = sum(len(d.get("running", [])) for d in dumps)
+        for key in ("batch_size_hist", "steps_by_worker_group"):
+            merged: Dict[str, int] = {}
+            for d in dumps:
+                for k, v in (d.get(key) or {}).items():
+                    merged[str(k)] = merged.get(str(k), 0) + v
+            out[key] = dict(sorted(merged.items()))
+        occ = [d.get("kv_occupancy") for d in dumps
+               if d.get("kv_occupancy") is not None]
+        out["kv_occupancy"] = round(sum(occ) / len(occ), 4) if occ else 0.0
+        return out
 
     def merged_census(self) -> dict:
         """The group-wide resource census: per-subsystem stat dicts

@@ -1,0 +1,23 @@
+"""Inference serving lane: continuous-batching generation over
+streaming RPC, co-scheduled with the fiber workers (see
+docs/serving.md).
+
+    from brpc_tpu.serving import add_generate_service
+    server = Server()
+    add_generate_service(server)
+    server.start("tcp://0.0.0.0:8000", num_shards=4)   # replica/shard
+"""
+
+from .batcher import (CANCELED, COMPLETED, EVICTED, SHED,
+                      ContinuousBatcher, GenRequest, RequestTooLong)
+from .engine import ServingEngine
+from .model import TinyDecoder, TinyDecoderConfig
+from .service import (GenerateService, add_generate_service,
+                      serving_page_payload)
+
+__all__ = [
+    "CANCELED", "COMPLETED", "EVICTED", "SHED",
+    "ContinuousBatcher", "GenRequest", "RequestTooLong",
+    "ServingEngine", "TinyDecoder", "TinyDecoderConfig",
+    "GenerateService", "add_generate_service", "serving_page_payload",
+]

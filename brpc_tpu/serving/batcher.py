@@ -1,0 +1,438 @@
+"""Continuous batcher: iteration-level scheduling over fixed KV slots
+(the Orca idea — admit between decode steps, never between requests).
+
+A classic batcher collects a batch, decodes it to completion, then
+admits the next batch; a request arriving one step late waits a whole
+batch. Here the unit of scheduling is ONE decode step:
+
+  * **admission** — new requests join the running batch at the top of
+    the next step whenever a KV slot is free (a slot = one sequence's
+    fixed-capacity cache in the model's [max_batch, cache_len, dim]
+    arrays). The wait queue behind the slots is bounded
+    (``max_waiting``): a submit past that SHEDS immediately — better a
+    fast failure the client can retry elsewhere than an unbounded queue
+    every entry of which will miss its deadline anyway;
+  * **eviction** — every admitted request carries its serving
+    Controller, and each step starts by sweeping
+    ``cntl.deadline_expired()``: a sequence whose client budget ran out
+    mid-generation is retired with ``ERPCTIMEDOUT`` and its slot freed
+    for the queue — generation for a caller who stopped waiting is pure
+    waste (the serving twin of PR 2's pre-handler shed gates);
+  * **retirement** — a sequence hitting its token budget (or stop
+    token, or client disconnect) leaves at the END of the step it
+    finished in; survivors never notice.
+
+Thread model: ``step()`` is called from fiber-worker threads through
+the engine's WorkerModule hook (serving/engine.py) and is serialized by
+the engine's decode lock; THIS lock only guards the queues/slots, so
+``submit``/``cancel`` from handler fibers stay cheap. The jitted decode
+call runs OUTSIDE the lock (jax releases the GIL; a submit must not
+wait out a whole step), and user callbacks (``on_token``/``on_finish``)
+fire outside it too — they write to sockets whose failure paths call
+straight back into ``cancel``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import Counter, deque
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from brpc_tpu.bvar.reducer import Adder, PassiveStatus
+from brpc_tpu.bvar.window import PerSecond
+from brpc_tpu.rpc import errno_codes as berr
+
+from .model import TinyDecoder
+
+# request states
+WAITING = "waiting"
+RUNNING = "running"
+COMPLETED = "completed"
+EVICTED = "evicted"        # deadline expired mid-flight -> ERPCTIMEDOUT
+SHED = "shed"              # wait queue full at submit
+CANCELED = "canceled"      # client gone (stream/conn closed)
+
+_TERMINAL = frozenset((COMPLETED, EVICTED, SHED, CANCELED))
+
+# process-wide counters (the /vars surface; per-batcher figures live in
+# stats_snapshot). Exposed by expose_serving_vars from Server.start —
+# the unexpose_all-surviving lifecycle every subsystem here uses.
+nsubmitted = Adder()
+ncompleted = Adder()
+nevicted = Adder()
+nshed = Adder()
+ncanceled = Adder()
+ntokens = Adder()
+_tokens_ps = None           # PerSecond over ntokens, built on expose
+_live_batchers: "weakref.WeakSet[ContinuousBatcher]" = weakref.WeakSet()
+
+
+def _sum_live(attr: str) -> float:
+    return sum(getattr(b, attr)() for b in list(_live_batchers))
+
+
+def expose_serving_vars() -> None:
+    global _tokens_ps
+    nsubmitted.expose("serving_requests")
+    ncompleted.expose("serving_completed")
+    nevicted.expose("serving_evicted")
+    nshed.expose("serving_shed")
+    ncanceled.expose("serving_canceled")
+    ntokens.expose("serving_tokens")
+    if _tokens_ps is None:
+        _tokens_ps = PerSecond(ntokens, 10)
+    _tokens_ps.expose("serving_tokens_per_second_10s")
+    PassiveStatus(lambda: int(_sum_live("running_count"))).expose(
+        "serving_running")
+    PassiveStatus(lambda: int(_sum_live("waiting_count"))).expose(
+        "serving_waiting")
+    PassiveStatus(lambda: round(_sum_live("kv_occupancy"), 4)).expose(
+        "serving_kv_occupancy")
+
+
+def _postfork_reset() -> None:
+    """A forked shard inherits the parent's batcher objects through the
+    weakset; its counters restart with its private bvar store."""
+    global _live_batchers, _tokens_ps
+    _live_batchers = weakref.WeakSet()
+    _tokens_ps = None
+
+
+from brpc_tpu.butil import postfork  # noqa: E402  (registration ships
+#                                      with the registry it resets)
+
+postfork.register("serving.batcher", _postfork_reset)
+
+
+class RequestTooLong(ValueError):
+    """Prompt alone would overflow the KV slot — unservable, distinct
+    from shed (retrying elsewhere cannot help)."""
+
+
+class GenRequest:
+    """One generation request riding the batch: the prompt, the token
+    budget, the serving controller whose deadline drives eviction, and
+    the emit callbacks (called OUTSIDE batcher locks, on the engine's
+    worker thread)."""
+
+    _seq = 0
+    _seq_lock = threading.Lock()
+
+    def __init__(self, prompt_tokens: List[int], max_new_tokens: int,
+                 cntl=None,
+                 on_token: Optional[Callable[["GenRequest", int], None]] = None,
+                 on_finish: Optional[Callable[["GenRequest", str], None]] = None,
+                 stop_token: Optional[int] = None):
+        with GenRequest._seq_lock:
+            GenRequest._seq += 1
+            self.req_id = GenRequest._seq
+        self.prompt = list(prompt_tokens)
+        self.max_new_tokens = int(max_new_tokens)
+        self.cntl = cntl
+        self.on_token = on_token
+        self.on_finish = on_finish
+        self.stop_token = stop_token
+        self.state = WAITING
+        self.slot: Optional[int] = None
+        self.tokens: List[int] = []
+        self.created_ns = time.monotonic_ns()
+        self.admitted_ns = 0
+        self.first_token_ns = 0
+        self.finished_ns = 0
+        self.error_code = 0          # berr.* for evicted/shed
+        self._cancel = False         # set by cancel(); swept by step()
+
+    @property
+    def ntokens(self) -> int:
+        return len(self.tokens)
+
+    def ttft_ms(self) -> Optional[float]:
+        if not self.first_token_ns:
+            return None
+        return (self.first_token_ns - self.created_ns) / 1e6
+
+
+class ContinuousBatcher:
+    def __init__(self, model: Optional[TinyDecoder] = None,
+                 max_batch: int = 8, max_waiting: int = 32,
+                 wake=None):
+        self.model = model or TinyDecoder()
+        # worker wake-up hook (TaskControl.parking_lot.signal): a submit
+        # landing while every fiber worker is parked must not wait out
+        # the 0.5s park timeout — TTFT is a headline number here. Once a
+        # worker is stepping it keeps polling until the batch drains, so
+        # only the idle->busy edge needs the kick.
+        self._wake = wake
+        cfg = self.model.config
+        self.max_batch = int(max_batch)
+        self.max_waiting = int(max_waiting)
+        self.cache_len = cfg.cache_len
+        self._lock = threading.Lock()
+        self._k = np.zeros((self.max_batch, cfg.cache_len, cfg.dim),
+                           np.float32)
+        self._v = np.zeros_like(self._k)
+        self._h = np.zeros((self.max_batch, cfg.dim), np.float32)
+        self._lens = np.ones((self.max_batch,), np.int64)  # 1 = idle-safe
+        self._slots: List[Optional[GenRequest]] = [None] * self.max_batch
+        self._free = list(range(self.max_batch))
+        self._waiting: deque = deque()
+        self._nrunning = 0           # racy-read counter for has_work
+        self.stopped = False
+        # per-batcher observability (module Adders carry the /vars view)
+        self.batch_hist: Counter = Counter()     # batch size -> steps
+        self.steps_by_group: Counter = Counter()  # worker group -> steps
+        self.decode_steps = 0
+        self.completed = 0
+        self.evicted = 0
+        self.shed = 0
+        self.canceled = 0
+        self.tokens_out = 0
+        _live_batchers.add(self)
+
+    # ------------------------------------------------------------- intake
+    def submit(self, req: GenRequest) -> bool:
+        """Queue a request for admission at the next step boundary.
+        False = shed (bounded queue full, or batcher stopped); raises
+        RequestTooLong when the prompt cannot fit a KV slot at all."""
+        if len(req.prompt) + 1 > self.cache_len:
+            raise RequestTooLong(
+                f"prompt of {len(req.prompt)} tokens cannot fit a "
+                f"{self.cache_len}-token KV slot")
+        # clamp the budget to the slot: a request asking for more than
+        # fits generates what fits (the response says how many it got)
+        req.max_new_tokens = min(req.max_new_tokens,
+                                 self.cache_len - len(req.prompt))
+        with self._lock:
+            if self.stopped or len(self._waiting) >= self.max_waiting:
+                req.state = SHED
+                req.error_code = berr.ELIMIT
+                req.finished_ns = time.monotonic_ns()
+                self.shed += 1
+                nshed.add(1)
+                return False
+            nsubmitted.add(1)
+            self._waiting.append(req)
+        if self._wake is not None:
+            try:
+                self._wake(1)
+            except Exception:
+                pass
+        return True
+
+    def cancel(self, req: GenRequest) -> None:
+        """Client gone (stream closed, connection dropped): flag the
+        request; the next step retires it and frees its KV slot. Safe
+        from any thread, including socket-failure callbacks."""
+        req._cancel = True
+
+    # ------------------------------------------------------------ queries
+    def has_work(self) -> bool:
+        """Lock-free peek for the worker loops' has_task poll."""
+        return (self._nrunning > 0 or bool(self._waiting)) \
+            and not self.stopped
+
+    def running_count(self) -> int:
+        return self._nrunning
+
+    def waiting_count(self) -> int:
+        return len(self._waiting)
+
+    def kv_occupancy(self) -> float:
+        """Fraction of the KV budget (all slots x cache_len) holding
+        live sequence state."""
+        with self._lock:
+            used = sum(int(self._lens[i])
+                       for i, r in enumerate(self._slots) if r is not None)
+        return used / float(self.max_batch * self.cache_len)
+
+    # ------------------------------------------------------------ stepping
+    def _retire_locked(self, req: GenRequest, state: str,
+                       done: List[Tuple[GenRequest, str]]) -> None:
+        req.state = state
+        req.finished_ns = time.monotonic_ns()
+        if state == EVICTED:
+            req.error_code = berr.ERPCTIMEDOUT
+            self.evicted += 1
+            nevicted.add(1)
+        elif state == COMPLETED:
+            self.completed += 1
+            ncompleted.add(1)
+        elif state == CANCELED:
+            self.canceled += 1
+            ncanceled.add(1)
+        if req.slot is not None:
+            i = req.slot
+            self._slots[i] = None
+            self._lens[i] = 1
+            self._free.append(i)
+            self._nrunning -= 1
+            req.slot = None
+        done.append((req, state))
+
+    def step(self, group_index: Optional[int] = None) -> bool:
+        """One scheduling iteration: sweep evictions/cancels, admit from
+        the queue into free slots, run ONE decode step for the live
+        batch, emit tokens, retire finished sequences. Returns False
+        when there was nothing to do (the caller's worker may park).
+        Callers serialize steps (engine decode lock); this lock only
+        covers slot/queue state."""
+        emits: List[Tuple[GenRequest, int]] = []
+        done: List[Tuple[GenRequest, str]] = []
+        admitted: List[GenRequest] = []
+        with self._lock:
+            # 1. sweep the running batch: client-gone and deadline-dead
+            # sequences leave BEFORE we spend a step on them
+            for req in [r for r in self._slots if r is not None]:
+                if req._cancel:
+                    self._retire_locked(req, CANCELED, done)
+                elif req.cntl is not None and req.cntl.deadline_expired():
+                    self._retire_locked(req, EVICTED, done)
+            # ...and the WAIT QUEUE: a dead entry must not sit there
+            # pinning max_waiting capacity (shedding live traffic) for
+            # the whole duration of a full batch — it gets its verdict
+            # NOW, not at its eventual admission turn
+            if self._waiting:
+                survivors = deque()
+                for req in self._waiting:
+                    if req._cancel:
+                        self._retire_locked(req, CANCELED, done)
+                    elif req.cntl is not None \
+                            and req.cntl.deadline_expired():
+                        self._retire_locked(req, EVICTED, done)
+                    else:
+                        survivors.append(req)
+                self._waiting = survivors
+            # 2. iteration-level admission: free slots pull from the
+            # bounded queue between steps — never waiting for drain.
+            # Slot assignment here; the prefill compute below, outside
+            # the lock (submit/cancel/occupancy must stay cheap)
+            while self._free and self._waiting:
+                req = self._waiting.popleft()
+                i = self._free.pop()
+                self._slots[i] = req
+                req.slot = i
+                req.state = RUNNING
+                req.admitted_ns = time.monotonic_ns()
+                self._nrunning += 1
+                admitted.append(req)
+            active = [(i, r) for i, r in enumerate(self._slots)
+                      if r is not None]
+            if active:
+                self.decode_steps += 1
+                self.batch_hist[len(active)] += 1
+                if group_index is not None:
+                    self.steps_by_group[group_index] += 1
+        if not active:
+            self._fire(emits, done)
+            return bool(done)
+        # prefill the admissions outside the lock: the caches and lens
+        # are only written by step(), and steps are serialized by the
+        # engine's decode lock, so only the slot TABLE needed the lock
+        for req in admitted:
+            i = req.slot
+            kp, vp, hl = self.model.prefill(req.prompt)
+            n = len(req.prompt)
+            self._k[i, :n], self._v[i, :n] = kp, vp
+            self._h[i] = hl
+            self._lens[i] = n
+        # 3. the decode step proper — outside the lock (jax releases
+        # the GIL; submit/cancel must not wait a full step)
+        nxt, k_new, v_new, h_new = self.model.decode_step(
+            self._k, self._v, self._h, self._lens.copy())
+        with self._lock:
+            for i, req in active:
+                if self._slots[i] is not req:
+                    continue        # canceled+retired during the step
+                tok = int(nxt[i])
+                pos = int(self._lens[i])
+                self._k[i, pos], self._v[i, pos] = k_new[i], v_new[i]
+                self._h[i] = h_new[i]
+                self._lens[i] = pos + 1
+                req.tokens.append(tok)
+                self.tokens_out += 1
+                ntokens.add(1)
+                if not req.first_token_ns:
+                    req.first_token_ns = time.monotonic_ns()
+                emits.append((req, tok))
+                if (req.stop_token is not None and tok == req.stop_token) \
+                        or req.ntokens >= req.max_new_tokens \
+                        or int(self._lens[i]) >= self.cache_len:
+                    self._retire_locked(req, COMPLETED, done)
+        self._fire(emits, done)
+        return True
+
+    @staticmethod
+    def _fire(emits, done) -> None:
+        """User callbacks, outside every batcher lock: they write to
+        streams/attachments whose failure paths call back into
+        cancel()."""
+        for req, tok in emits:
+            if req.on_token is not None:
+                try:
+                    req.on_token(req, tok)
+                except Exception:
+                    import logging
+                    logging.getLogger("brpc_tpu.serving").exception(
+                        "on_token failed")
+        for req, state in done:
+            if req.on_finish is not None:
+                try:
+                    req.on_finish(req, state)
+                except Exception:
+                    import logging
+                    logging.getLogger("brpc_tpu.serving").exception(
+                        "on_finish failed")
+
+    # ----------------------------------------------------------- shutdown
+    def stop(self) -> List[GenRequest]:
+        """Refuse new work and retire everything in flight (CANCELED).
+        Returns the retired requests (the service fails their calls)."""
+        done: List[Tuple[GenRequest, str]] = []
+        with self._lock:
+            self.stopped = True
+            victims = [r for r in self._slots if r is not None]
+            victims += list(self._waiting)
+            self._waiting.clear()
+            for r in victims:
+                if r.state not in _TERMINAL:
+                    self._retire_locked(r, CANCELED, done)
+        self._fire([], done)
+        return [r for r, _ in done]
+
+    # ------------------------------------------------------ observability
+    def stats_snapshot(self) -> dict:
+        with self._lock:
+            running = [{
+                "req_id": r.req_id,
+                "tokens": r.ntokens,
+                "budget": r.max_new_tokens,
+                "remaining_ms": (None if r.cntl is None
+                                 else r.cntl.remaining_ms()),
+            } for r in self._slots if r is not None]
+            waiting = len(self._waiting)
+            hist = dict(sorted(self.batch_hist.items()))
+            groups = dict(sorted(self.steps_by_group.items()))
+            used = sum(int(self._lens[i])
+                       for i, r in enumerate(self._slots) if r is not None)
+        return {
+            "max_batch": self.max_batch,
+            "cache_len": self.cache_len,
+            "max_waiting": self.max_waiting,
+            "running": running,
+            "waiting": waiting,
+            "completed": self.completed,
+            "evicted": self.evicted,
+            "shed": self.shed,
+            "canceled": self.canceled,
+            "tokens_out": self.tokens_out,
+            "decode_steps": self.decode_steps,
+            "batch_size_hist": hist,
+            "steps_by_worker_group": groups,
+            "kv_occupancy": round(
+                used / float(self.max_batch * self.cache_len), 4),
+            "stopped": self.stopped,
+        }

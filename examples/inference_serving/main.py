@@ -1,0 +1,102 @@
+"""Inference serving demo: a continuous-batching GenerateService with
+tokens streamed back as they decode.
+
+The server registers the serving lane (``add_generate_service``) — a
+deterministic toy decoder whose decode steps run ON the fiber workers
+through the WorkerModule hook — and the client opens a streaming
+Generate call, printing each token the moment its frame arrives
+(time-to-first-token is the first decode step, not batch completion).
+
+Run it::
+
+    python examples/inference_serving/main.py            # in-process
+    python examples/inference_serving/main.py '' 64      # 64 tokens
+    python examples/inference_serving/main.py tcp://host:port  # client
+
+Server-only (e.g. to serve several clients, sharded across 2 worker
+processes with one model replica each)::
+
+    python -c "import sys; sys.argv=['x','--serve']; \
+               exec(open('examples/inference_serving/main.py').read())"
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/examples", 1)[0])
+
+from brpc_tpu.rpc import Channel, Server
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.stream import StreamOptions
+
+
+def main(address: str = "", max_tokens: int = 32,
+         prompt: str = "the quick brown fox") -> None:
+    max_tokens = int(max_tokens)
+    server = None
+    if not address:
+        from brpc_tpu.serving import add_generate_service
+        server = Server()
+        add_generate_service(server)
+        ep = server.start("tcp://127.0.0.1:0")
+        address = f"tcp://127.0.0.1:{ep.port}"
+        print(f"serving on {address} (builtin console: "
+              f"http://127.0.0.1:{ep.port}/serving)")
+
+    ch = Channel(address)
+    state = {"t0": 0.0, "ttft": None, "done": False, "n": 0}
+
+    def on_frame(stream, msg):
+        p = msg.payload.to_bytes()
+        tag, rest = p[:1], p[1:]
+        if tag == b"t":
+            now = time.monotonic()
+            if state["ttft"] is None:
+                state["ttft"] = now - state["t0"]
+            state["n"] += 1
+            # print each token AS IT ARRIVES (byte-level vocab)
+            sys.stdout.write(f"{rest[0]:3d} ")
+            sys.stdout.flush()
+        elif tag == b"d":
+            doc = json.loads(rest.decode())
+            print(f"\n[done: {doc['n']} tokens]")
+            state["done"] = True
+        elif tag == b"e":
+            print(f"\n[failed: errno {rest.decode()}]")
+            state["done"] = True
+
+    cntl = Controller()
+    cntl.timeout_ms = 60000
+    state["t0"] = time.monotonic()
+    cntl = ch.call_sync(
+        "GenerateService", "Generate",
+        json.dumps({"prompt": prompt, "max_tokens": max_tokens}).encode(),
+        cntl=cntl, stream_options=StreamOptions(on_received=on_frame))
+    assert not cntl.failed(), cntl.error_text
+    print(f"prompt: {prompt!r} -> streaming {max_tokens} tokens:")
+
+    deadline = time.monotonic() + 60
+    while not state["done"] and time.monotonic() < deadline:
+        time.sleep(0.01)
+    total = time.monotonic() - state["t0"]
+    print(f"ttft {state['ttft'] * 1e3:.1f}ms, "
+          f"total {total * 1e3:.1f}ms, "
+          f"{state['n'] / max(total, 1e-9):.0f} tokens/s")
+    cntl.stream.close()
+    ch.close()
+    if server is not None:
+        server.stop()
+        server.join(2)
+
+
+if __name__ == "__main__":
+    if "--serve" in sys.argv:
+        from brpc_tpu.serving import add_generate_service
+        srv = Server()
+        add_generate_service(srv)
+        endpoint = srv.start("tcp://127.0.0.1:0", num_shards=2)
+        print(f"serving (2 shards) on tcp://127.0.0.1:{endpoint.port}")
+        srv.run_until_asked_to_quit()
+    else:
+        main(*sys.argv[1:])

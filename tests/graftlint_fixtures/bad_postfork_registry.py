@@ -1,0 +1,19 @@
+"""Seeded postfork-reset registry violation: a module-level registrar
+appending caller-owned engine objects into a module list, with NO
+butil.postfork registration — a forked shard worker's loops would run
+the PARENT's registered engines (the fiber/worker_module.py shape)."""
+
+from typing import List
+
+_engines: List[object] = []
+
+
+def register_engine(engine) -> None:
+    # BAD: live caller-owned object carried across fork; no postfork
+    # reset anywhere in the module
+    _engines.append(engine)
+
+
+def drive_all(group_index: int) -> None:
+    for e in _engines:
+        e.process(group_index)

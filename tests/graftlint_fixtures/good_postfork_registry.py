@@ -1,0 +1,34 @@
+"""postfork-reset registry idiom's clean twins: a registered registrar
+(the fix), a tuple-wrapping provider table (out of scope by design:
+name-keyed, replace-on-reregister, fork-safe entries), and a
+``register_protocol`` (documented codec-table exemption)."""
+
+from typing import List, Tuple
+
+from brpc_tpu.butil import postfork
+
+_engines: List[object] = []
+_providers: List[Tuple[str, object]] = []
+
+
+def register_engine(engine) -> None:
+    # OK: the module registers a postfork reset below
+    _engines.append(engine)
+
+
+def register_provider(name: str, fn) -> None:
+    # OK: wrapped entry (name-keyed provider table), not a bare object
+    _providers.append((name, fn))
+
+
+def register_protocol(proto) -> None:
+    # OK: the documented fork-safe codec-table exemption
+    _engines.append(proto)
+
+
+def _postfork_reset() -> None:
+    global _engines
+    _engines = []
+
+
+postfork.register("tests.good_postfork_registry", _postfork_reset)

@@ -49,6 +49,12 @@ def test_streaming_echo_example():
     _load("streaming_echo").main(n_frames=5)
 
 
+def test_inference_serving_example(capsys):
+    _load("inference_serving").main(max_tokens=6)
+    out = capsys.readouterr().out
+    assert "[done: 6 tokens]" in out
+
+
 def _run_serving_example(name, monkeypatch, **kw):
     """Examples that end in run_until_asked_to_quit(): stub the serve
     loop so the rot guard exercises their full setup + self-drive and

@@ -362,6 +362,63 @@ class TestPostforkReset:
         assert list(PostforkResetRule().check(sf_ok, Context([sf_ok]))) \
             == []
 
+    def test_registry_fixture_violation(self):
+        """The object-registry registrar shape (fiber/worker_module.py
+        idiom): a register* function appending its bare parameter into
+        a module-level list, unregistered — must fire."""
+        active, _ = _lint("bad_postfork_registry.py")
+        assert [f.rule for f in active] == ["postfork-reset"], \
+            [f.format() for f in active]
+        assert "register_engine" in active[0].message
+        src = open(os.path.join(
+            FIXTURES, "bad_postfork_registry.py")).read().splitlines()
+        assert "def register_engine" in src[active[0].line - 1]
+
+    def test_registry_good_fixture_zero_false_positives(self):
+        active, waived = _lint("good_postfork_registry.py")
+        assert active == [] and waived == [], \
+            [f.format() for f in active + waived]
+
+    def test_register_protocol_registry_exempt_on_real_module(self):
+        """protocol/registry.py's register_protocol appends its bare
+        parameter into the module-level protocol list — exactly the
+        registry shape — but the protocol table is fork-safe codec
+        data: the rule must stay silent there without a waiver."""
+        from brpc_tpu.analysis.core import Context, SourceFile
+        from brpc_tpu.analysis.rules.postfork_reset import PostforkResetRule
+        path = os.path.join(REPO_ROOT, "brpc_tpu", "protocol",
+                            "registry.py")
+        src = open(path).read()
+        assert "_protocols.append(p)" in src and "postfork" not in src
+        sf = SourceFile(path, "brpc_tpu/protocol/registry.py", src)
+        found = list(PostforkResetRule().check(sf, Context([sf])))
+        assert found == [], [f.format() for f in found]
+
+    def test_mutation_dropping_registration_fires_on_worker_module(self):
+        """Mutation pin: strip the postfork.register line from the real
+        fiber/worker_module.py — the rule must fire on register_module,
+        so the worker-module registry can never silently lose its fork
+        reset (a forked shard's workers would double-run the parent's
+        serving engine)."""
+        from brpc_tpu.analysis.core import Context, SourceFile
+        from brpc_tpu.analysis.rules.postfork_reset import PostforkResetRule
+        path = os.path.join(REPO_ROOT, "brpc_tpu", "fiber",
+                            "worker_module.py")
+        src = open(path).read()
+        target = [ln for ln in src.splitlines()
+                  if "postfork.register(" in ln]
+        assert len(target) == 1, target
+        mutated = src.replace(target[0] + "\n", "")
+        sf = SourceFile(path, "brpc_tpu/fiber/worker_module.py", mutated)
+        found = list(PostforkResetRule().check(sf, Context([sf])))
+        assert any(f.rule == "postfork-reset"
+                   and "register_module" in f.message
+                   for f in found), [f.format() for f in found]
+        # and the unmutated module stays clean
+        sf_ok = SourceFile(path, "brpc_tpu/fiber/worker_module.py", src)
+        assert list(PostforkResetRule().check(sf_ok, Context([sf_ok]))) \
+            == []
+
     def test_mutation_dropping_registration_fires_on_real_dispatcher(self):
         """Mutation pin: strip the postfork.register line from the real
         transport/event_dispatcher.py — the rule must fire, so the

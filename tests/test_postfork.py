@@ -57,6 +57,36 @@ class TestRegistry:
                          "rpc.controller", "transport.input_messenger"):
             assert expected in names, (expected, sorted(names))
 
+    def test_worker_module_registry_resets_in_child(self):
+        """The worker-module registry must NOT survive fork: a forked
+        shard whose fresh worker loops polled the parent's modules
+        would double-run the parent's serving engine. The parent keeps
+        its registration."""
+        from brpc_tpu.fiber import worker_module as wm
+
+        class Probe(wm.WorkerModule):
+            pass
+
+        probe = Probe()
+        wm.register_module(probe)
+        try:
+            def check():
+                mods = wm.registered_modules()
+                if mods:
+                    return f"child inherited {len(mods)} modules"
+                # the child-side registry must be USABLE (fresh lock)
+                p2 = Probe()
+                wm.register_module(p2)
+                if wm.registered_modules() != [p2]:
+                    return "child re-registration broken"
+                return "OK"
+
+            assert _run_in_fork(check) == "OK"
+            # parent untouched
+            assert probe in wm.registered_modules()
+        finally:
+            wm.unregister_module(probe)
+
     def test_reregistering_a_name_replaces_not_stacks(self):
         calls = []
         postfork.register("test.dup", lambda: calls.append(1))

@@ -79,6 +79,52 @@ def test_progressive_write_before_bind_buffers():
         server.join(2)
 
 
+def test_progressive_write_observes_dead_peer():
+    """A feeder streaming an unbounded body to a client that vanished
+    must LEARN: once the bound connection fails, write() returns False
+    (previously it silently 'succeeded' forever, queueing chunks onto a
+    dead socket)."""
+    server = Server()
+    svc = Service("S")
+    results = []
+    done = threading.Event()
+
+    @svc.method()
+    def Infinite(cntl, request):
+        pa = cntl.create_progressive_attachment()
+
+        def feed():
+            # feed until the attachment reports the peer is gone (the
+            # 30s cap only bounds a REGRESSION where it never does)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if not pa.write(b"x" * 1024):
+                    results.append("observed-dead-peer")
+                    break
+                time.sleep(0.005)
+            else:
+                results.append("never-observed")
+            done.set()
+
+        threading.Thread(target=feed, daemon=True).start()
+        return None
+
+    server.add_service(svc)
+    ep = server.start("tcp://127.0.0.1:0")
+    try:
+        conn = http.client.HTTPConnection(ep.host, ep.port, timeout=5)
+        conn.request("POST", "/S/Infinite")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        resp.read(2048)         # consume a little mid-body...
+        conn.close()            # ...then vanish
+        assert done.wait(10), "feeder never finished"
+        assert results == ["observed-dead-peer"]
+    finally:
+        server.stop()
+        server.join(2)
+
+
 def test_progressive_write_after_close_fails():
     from brpc_tpu.rpc.progressive import ProgressiveAttachment
     pa = ProgressiveAttachment()

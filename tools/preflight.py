@@ -381,6 +381,37 @@ def gate_cluster_top() -> dict:
     return out
 
 
+def gate_serving_smoke() -> dict:
+    """Serving-lane smoke (tools/serving_smoke.py --smoke): a 2-shard
+    GenerateService under a mixed stream/HTTP/evict/overflow client set
+    — every request must end in exactly one of completed/evicted/shed,
+    TTFT must sit measurably below full-generation latency (streaming
+    is incremental, not buffered), and the supervisor's merged /serving
+    must account for the whole set. A subprocess so a wedged engine
+    cannot hang the gate; BRPC_TPU_SERVING_SMOKE=0 skips."""
+    if os.environ.get("BRPC_TPU_SERVING_SMOKE", "1") == "0":
+        return {"ok": True, "skipped": "BRPC_TPU_SERVING_SMOKE=0"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "serving_smoke.py"), "--smoke"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    out: dict = {"ok": proc.returncode == 0}
+    try:
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+        if proc.returncode == 0:
+            smoke = report["smoke"]
+            out["outcomes"] = smoke["outcomes"]
+            out["ttft_p50_ms"] = smoke["ttft_p50_ms"]
+            out["full_gen_p50_ms"] = smoke["full_gen_p50_ms"]
+            out["elapsed_s"] = smoke["elapsed_s"]
+        else:
+            out["invariant"] = report.get("invariant")
+    except (ValueError, KeyError, IndexError):
+        out["ok"] = False
+        out["error"] = (proc.stdout + proc.stderr)[-500:]
+    return out
+
+
 def gate_perf_smoke() -> dict:
     """Fast hot-path perf gate: raw-socket-normalized small-RPC and
     1MB-echo ratios must stay within 30% of the BENCH_r05-era floors.
@@ -447,6 +478,7 @@ def run_gate() -> int:
                      ("shard_smoke", gate_shard_smoke),
                      ("flight_smoke", gate_flight_smoke),
                      ("cluster_top", gate_cluster_top),
+                     ("serving_smoke", gate_serving_smoke),
                      ("perf_smoke", gate_perf_smoke)):
         try:
             report[name] = fn()
