@@ -20,3 +20,16 @@ Layering mirrors the reference's strict onion (see SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
+
+# BRPC_TPU_LOCK_DEBUG=1 (or =strict) arms the racelane BEFORE any
+# submodule creates its locks: threading.Lock/RLock are replaced with
+# instrumented twins that inject seeded deterministic yield points and
+# assert the declared lock order (analysis/racelane.py:LOCK_ORDER) at
+# every acquire. Costs nothing when the env var is unset — the hook
+# imports only stdlib until it decides to install.
+import os as _os
+
+if _os.environ.get("BRPC_TPU_LOCK_DEBUG") in ("1", "strict"):
+    from brpc_tpu.analysis import racelane as _racelane
+
+    _racelane.maybe_install_from_env()

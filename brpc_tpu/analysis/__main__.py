@@ -1,17 +1,28 @@
 """graftlint CLI: ``python -m brpc_tpu.analysis [paths...]``.
 
-Exit codes: 0 clean (or every finding waived with a reason), 1 active
-findings, 2 usage/internal error.
+Exit code = the UNWAIVED finding count (capped at 100) so CI can gate
+on zero and scripts can read severity without parsing; usage/internal
+errors exit 120. Machine consumers pick ``--format=json`` or
+``--format=sarif`` (SARIF 2.1.0 — editors and code-scanning UIs);
+``--changed [BASE]`` lints only files touched vs a git base ref
+(default HEAD) while still analyzing the whole tree for cross-module
+context; ``--show-waivers`` audits every waiver in force (file:line,
+rules, reason, and whether it suppressed anything this run).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Set
 
-from brpc_tpu.analysis.core import Analyzer
+from brpc_tpu.analysis.core import Analyzer, iter_source_files
+
+EXIT_CAP = 100         # finding-count exit codes stay below...
+EXIT_USAGE = 120       # ...the usage/internal-error code
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -25,20 +36,161 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run only these rules (comma-separated names)")
     p.add_argument("--list-rules", action="store_true",
                    help="list available rules and exit")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text", dest="fmt",
+                   help="output format (default text)")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="emit findings as one JSON object on stdout")
+                   help="alias for --format=json")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="BASE",
+                   help="report only findings in files changed vs the "
+                        "git base ref (default HEAD); the whole tree "
+                        "is still analyzed for cross-module context")
     p.add_argument("--show-waived", action="store_true",
                    help="also print waived findings (with reasons)")
+    p.add_argument("--show-waivers", action="store_true",
+                   help="list every waiver in force (file:line, rules, "
+                        "reason, used/unused this run) and exit 0")
     return p
+
+
+def changed_files(base: str, repo_root: str) -> Optional[Set[str]]:
+    """Absolute paths of .py/.cc files changed vs base (tracked diff +
+    untracked); None when git is unavailable."""
+    out: Set[str] = set()
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", base, "--"],
+            cwd=repo_root, capture_output=True, text=True, timeout=60)
+        if diff.returncode != 0:
+            return None
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=repo_root, capture_output=True, text=True, timeout=60)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    names = diff.stdout.splitlines()
+    if untracked.returncode == 0:
+        names += untracked.stdout.splitlines()
+    for n in names:
+        if n.endswith((".py", ".cc")):
+            out.add(os.path.abspath(os.path.join(repo_root, n)))
+    return out
+
+
+def _git_root() -> str:
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=30)
+        if proc.returncode == 0 and proc.stdout.strip():
+            return proc.stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return os.getcwd()
+
+
+def to_sarif(active, waived, rules) -> dict:
+    """Minimal valid SARIF 2.1.0: one run, one result per ACTIVE
+    finding (waived findings ride along as suppressed results)."""
+    rule_meta = [{"id": r.name,
+                  "shortDescription": {"text": r.description or r.name}}
+                 for r in rules]
+
+    def result(f, suppressed: bool) -> dict:
+        out = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+        }
+        if suppressed:
+            out["suppressions"] = [{
+                "kind": "inSource",
+                "justification": f.reason or "",
+            }]
+        return out
+
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri": "docs/invariants.md",
+                "rules": rule_meta,
+            }},
+            "results": ([result(f, False) for f in active]
+                        + [result(f, True) for f in waived]),
+        }],
+    }
+
+
+def collect_waivers(paths: List[str], waived_findings) -> List[dict]:
+    """Every waiver comment in force across the scanned files: location,
+    rules, reason, and whether it suppressed a finding this run."""
+    used_lines = {(f.path, f.line) for f in waived_findings}
+
+    def filewide_used(sf, rule: str) -> bool:
+        # a file-wide waiver only did the suppressing when no LINE
+        # waiver covered the finding (waiver_reason matches the line
+        # slot first) — keyed any looser, a stale disable-file hides
+        # behind its line-level siblings and escapes the UNUSED audit
+        for f in waived_findings:
+            if f.path != sf.relpath:
+                continue
+            if rule != "all" and f.rule != rule:
+                continue
+            dis = sf.waivers.get(f.line, ())
+            if f.rule not in dis and "all" not in dis:
+                return True
+        return False
+
+    merged: dict = {}
+    for sf in iter_source_files(paths):
+        if "/analysis/" in sf.relpath:
+            continue       # the linter's own docs show waiver EXAMPLES
+        for slot, names in sorted(sf.waivers.items()):
+            for name in sorted(names):
+                reason = sf.reasons.get((slot, name), "")
+                # a comment-above waiver occupies TWO slots (the
+                # comment line and the covered code line): merge them
+                # into one audit row, marked used if either fired
+                key = (sf.relpath, name, reason)
+                used = ((sf.relpath, slot) in used_lines if slot
+                        else filewide_used(sf, name))
+                row = merged.get(key)
+                if row is None:
+                    merged[key] = {
+                        "path": sf.relpath,
+                        "line": slot or 0,      # 0 = file-wide
+                        "rule": name,
+                        "reason": reason,
+                        "file_wide": slot == 0,
+                        "used": used,
+                    }
+                else:
+                    row["used"] = row["used"] or used
+                    if slot and (row["line"] == 0 or slot < row["line"]):
+                        row["line"] = slot
+    return sorted(merged.values(),
+                  key=lambda w: (w["path"], w["line"], w["rule"]))
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    fmt = "json" if args.as_json else args.fmt
     from brpc_tpu.analysis.rules import default_rules
     rules = default_rules()
     if args.list_rules:
         for r in rules:
-            print(f"{r.name:18} {r.description}")
+            print(f"{r.name:24} {r.description}")
         return 0
     if args.rules:
         wanted = {n.strip() for n in args.rules.split(",") if n.strip()}
@@ -46,17 +198,51 @@ def main(argv: Optional[List[str]] = None) -> int:
         if unknown:
             print(f"graftlint: unknown rules: {', '.join(sorted(unknown))}",
                   file=sys.stderr)
-            return 2
+            return EXIT_USAGE
         rules = [r for r in rules if r.name in wanted]
+    paths = args.paths or ["brpc_tpu"]
     analyzer = Analyzer(rules=rules)
-    active, waived = analyzer.run(args.paths or ["brpc_tpu"])
-    if args.as_json:
+    active, waived = analyzer.run(paths)
+
+    if args.show_waivers:
+        waivers = collect_waivers(paths, waived)
+        if fmt == "json":
+            print(json.dumps({"waivers": waivers}))
+        else:
+            for w in waivers:
+                where = (f"{w['path']}:{'file-wide' if w['file_wide'] else w['line']}")
+                mark = "" if w["used"] else " (UNUSED — stale?)"
+                print(f"{where}: disable={w['rule']}{mark}"
+                      f" -- {w['reason'] or '<no reason>'}")
+            print(f"graftlint: {len(waivers)} waiver(s) in force",
+                  file=sys.stderr)
+        return 0
+
+    if args.changed is not None:
+        repo_root = _git_root()
+        changed = changed_files(args.changed, repo_root)
+        if changed is None:
+            print("graftlint: --changed needs a git checkout",
+                  file=sys.stderr)
+            return EXIT_USAGE
+        active = [f for f in active
+                  if os.path.abspath(os.path.join(repo_root, f.path))
+                  in changed]
+        waived = [f for f in waived
+                  if os.path.abspath(os.path.join(repo_root, f.path))
+                  in changed]
+
+    exit_code = min(len(active), EXIT_CAP)
+    if fmt == "json":
         print(json.dumps({
             "active": [f.to_dict() for f in active],
             "waived": [f.to_dict() for f in waived],
             "rules": [r.name for r in rules],
         }, indent=None))
-        return 1 if active else 0
+        return exit_code
+    if fmt == "sarif":
+        print(json.dumps(to_sarif(active, waived, rules)))
+        return exit_code
     for f in active:
         print(f.format())
     if args.show_waived:
@@ -67,9 +253,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if active:
         print(f"graftlint: {len(active)} finding(s)"
               f" ({n_w} waived)", file=sys.stderr)
-        return 1
-    print(f"graftlint: clean ({n_w} waived)", file=sys.stderr)
-    return 0
+    else:
+        print(f"graftlint: clean ({n_w} waived)", file=sys.stderr)
+    return exit_code
 
 
 if __name__ == "__main__":

@@ -9,15 +9,27 @@ from brpc_tpu.analysis.core import Rule
 
 def default_rules() -> List[Rule]:
     from brpc_tpu.analysis.rules.block_recycle import BlockRecycleRule
+    from brpc_tpu.analysis.rules.event_wait import EventWaitNotSleepRule
     from brpc_tpu.analysis.rules.fiber_blocking import FiberBlockingRule
     from brpc_tpu.analysis.rules.iobuf_aliasing import IOBufAliasingRule
     from brpc_tpu.analysis.rules.judge_defer import JudgeDeferRule
-    from brpc_tpu.analysis.rules.lock_order import LockOrderRule
+    from brpc_tpu.analysis.rules.lock_graph import (
+        BlockingUnderLockRule, CallbackUnderLockRule, LockCycleRule,
+    )
+    from brpc_tpu.analysis.rules.memoryview_release import (
+        MemoryviewReleaseRule,
+    )
     from brpc_tpu.analysis.rules.postfork_reset import PostforkResetRule
     from brpc_tpu.analysis.rules.registry_complete import (
         RegistryCompleteRule,
     )
+    from brpc_tpu.analysis.rules.sampler_import import (
+        SamplerNoLazyImportRule,
+    )
     from brpc_tpu.analysis.rules.span_finish import SpanFinishRule
-    return [BlockRecycleRule(), FiberBlockingRule(), IOBufAliasingRule(),
-            JudgeDeferRule(), LockOrderRule(), PostforkResetRule(),
-            RegistryCompleteRule(), SpanFinishRule()]
+    return [BlockRecycleRule(), BlockingUnderLockRule(),
+            CallbackUnderLockRule(), EventWaitNotSleepRule(),
+            FiberBlockingRule(), IOBufAliasingRule(), JudgeDeferRule(),
+            LockCycleRule(), MemoryviewReleaseRule(),
+            PostforkResetRule(), RegistryCompleteRule(),
+            SamplerNoLazyImportRule(), SpanFinishRule()]

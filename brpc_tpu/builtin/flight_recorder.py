@@ -51,6 +51,30 @@ from brpc_tpu.butil.flags import define_flag, flag
 # "no free descriptors" precondition to exactly that open/close)
 from brpc_tpu.fiber import worker_module as _worker_module
 
+# the remaining sampler-path collaborators are import-CYCLIC with this
+# module at load time (scheduler/server_dispatch/event_dispatcher all
+# reach back into builtin), so they are bound by _bind_sampler_imports
+# from ensure_running — on the CALLER thread, before the sampler thread
+# exists. Sampler-reachable code must only ever read these globals
+# (enforced by the sampler-no-lazy-import graftlint rule).
+_sched = None                  # brpc_tpu.fiber.scheduler
+_thread_current_fiber = None   # scheduler.thread_current_fiber
+_serving_cntl = None           # server_dispatch._serving_cntl
+_ed = None                     # brpc_tpu.transport.event_dispatcher
+
+
+def _bind_sampler_imports() -> None:
+    """One-time import binding for everything the sampler thread
+    touches; runs on the thread that STARTS the sampler."""
+    global _sched, _thread_current_fiber, _serving_cntl, _ed
+    if _ed is not None:
+        return
+    from brpc_tpu.fiber import scheduler as sched
+    from brpc_tpu.fiber.scheduler import thread_current_fiber as tcf
+    from brpc_tpu.rpc.server_dispatch import _serving_cntl as sc
+    from brpc_tpu.transport import event_dispatcher as ed
+    _sched, _thread_current_fiber, _serving_cntl, _ed = sched, tcf, sc, ed
+
 define_flag("continuous_profiler_hz", 20,
             "continuous sampling profiler rate (samples/s across all "
             "threads); 0 disables the continuous profile only — "
@@ -154,6 +178,7 @@ class FlightRecorder:
 
     # ----------------------------------------------------------- lifecycle
     def ensure_running(self) -> None:
+        _bind_sampler_imports()
         with self._lock:
             if self._thread is None or not self._thread.is_alive():
                 self._stop_ev = threading.Event()
@@ -197,8 +222,8 @@ class FlightRecorder:
         me = threading.get_ident()
         frames = sys._current_frames()
         # housekeeping piggybacked on the walk we already paid for
-        from brpc_tpu.fiber import scheduler
-        scheduler.prune_thread_registry(frames.keys())
+        if _sched is not None:
+            _sched.prune_thread_registry(frames.keys())
         names = {t.ident: t.name for t in threading.enumerate()}
         # accumulate into pass-local counters and merge into the live
         # window under the lock ONCE: readers (merged(), shard dumps)
@@ -257,11 +282,11 @@ class FlightRecorder:
         last-served method (transport legs — the dispatcher draining a
         conn's bytes is serving that conn's traffic), then the thread
         name."""
-        from brpc_tpu.fiber.scheduler import thread_current_fiber
-        fiber = thread_current_fiber(tid)
+        if _thread_current_fiber is None:
+            return f"thread:{names.get(tid, tid)}"
+        fiber = _thread_current_fiber(tid)
         if fiber is not None:
             try:
-                from brpc_tpu.rpc.server_dispatch import _serving_cntl
                 cntl = _serving_cntl.peek(fiber)
             except Exception:
                 cntl = None
@@ -296,7 +321,9 @@ class FlightRecorder:
 
     # ------------------------------------------------------------ watchdog
     def _watchdog_pass(self, now_ns: int) -> None:
-        from brpc_tpu.transport import event_dispatcher as ed
+        ed = _ed
+        if ed is None:
+            return
         d = ed.peek_dispatcher()
         if d is None:
             return
@@ -317,14 +344,12 @@ class FlightRecorder:
         # name the culprit: the rpcz span of the request whose handler
         # is monopolizing the event thread right now (inline dispatch)
         t = d._thread
-        if t is None or t.ident is None:
+        if t is None or t.ident is None or _thread_current_fiber is None:
             return
-        from brpc_tpu.fiber.scheduler import thread_current_fiber
-        fiber = thread_current_fiber(t.ident)
+        fiber = _thread_current_fiber(t.ident)
         if fiber is None:
             return
         try:
-            from brpc_tpu.rpc.server_dispatch import _serving_cntl
             cntl = _serving_cntl.peek(fiber)
             span = cntl.__dict__.get("_span") if cntl is not None else None
             if span is not None and hasattr(span, "annotate"):

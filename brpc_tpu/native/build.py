@@ -171,6 +171,9 @@ def build(force: bool = False,
     if not force and not _stale(out, srcs, san):
         return out
     cmd = [CXX, *_cxxflags(san), "-o", out, *srcs]
+    # graftlint: disable=blocking-under-lock -- the loader latch lock IS
+    # the single-flight compile guard: a concurrent importer must wait
+    # for the one compiler run, not race a second cc1plus at the cache
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(
@@ -190,6 +193,9 @@ def build_fastcore(force: bool = False,
         return out
     include = sysconfig.get_paths()["include"]
     cmd = [CXX, *_cxxflags(san), f"-I{include}", "-o", out, *srcs]
+    # graftlint: disable=blocking-under-lock -- same single-flight
+    # compile discipline as build(): the fastcore loader lock must hold
+    # through the compiler run so importers share one artifact
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(

@@ -235,9 +235,12 @@ class Channel:
                                                        global_socket_map)
             with self._socket_lock:
                 s = self._socket
-                if s is not None and not s.failed \
-                        and not s.probe_unobserved():
-                    return s
+            # probe OUTSIDE _socket_lock: a dead peer turns the probe
+            # into set_failed, whose on_failed callbacks run inline and
+            # may re-enter the channel (callback-under-lock)
+            if s is not None and not s.failed \
+                    and not s.probe_unobserved():
+                return s
             # the key carries the credential flavor (socket_map.h keys
             # include ssl/auth settings): channels with different
             # credentials must not multiplex one verified connection
@@ -500,14 +503,19 @@ class Channel:
         if ctype in ("", "single"):
             return self._get_socket()
         if ctype == "pooled":
-            with self._pool_lock:
-                self._pool_closed = False   # channel in use again
-                while self._conn_pool:
-                    sock = self._conn_pool.pop()
-                    if not sock.failed and not sock.probe_unobserved():
-                        break
-                else:
-                    sock = None
+            sock = None
+            while sock is None:
+                with self._pool_lock:
+                    self._pool_closed = False   # channel in use again
+                    cand = self._conn_pool.pop() if self._conn_pool \
+                        else None
+                if cand is None:
+                    break
+                # probe OUTSIDE _pool_lock: a dead peer turns the probe
+                # into set_failed, whose on_failed callbacks run inline
+                # and may re-enter the channel (callback-under-lock)
+                if not cand.failed and not cand.probe_unobserved():
+                    sock = cand
             if sock is None:
                 sock = create_client_socket(
                     self._endpoint, on_input=self._messenger.on_new_messages,
@@ -679,6 +687,11 @@ class Channel:
                 # cross-match lane batches on the receiver
                 with sock.lane_lock:
                     sock.write_device_payload(lane)
+                    # graftlint: disable=callback-under-lock -- lane_lock
+                    # exists to make exactly this pair atomic (device
+                    # batch + envelope adjacent on the conn); Socket.write
+                    # only queues — it never parks and the on_done fires
+                    # from the drain, not here
                     sock.write(wire, on_done=lambda err, s=sock,
                                q=d["_issue_seq"],
                                sp=d.get("_client_span"):

@@ -112,6 +112,9 @@ class ProgressiveAttachment:
     def _send_terminator(socket) -> None:
         buf = IOBuf()
         buf.append(b"0\r\n\r\n")
+        # graftlint: disable=callback-under-lock -- _lock serializes
+        # chunk framing with the _failed latch (the dead-peer fix);
+        # Socket.write only queues, and the failure path flips a flag
         socket.write(buf)
 
     @staticmethod
@@ -120,4 +123,6 @@ class ProgressiveAttachment:
         buf.append(f"{len(data):x}\r\n".encode())
         buf.append(data)
         buf.append(b"\r\n")
+        # graftlint: disable=callback-under-lock -- same discipline as
+        # _send_terminator: framing order IS what _lock protects
         return socket.write(buf)

@@ -762,6 +762,10 @@ def _send_response(proto, socket, cid: int, cntl: Controller,
                 # armed only once the write is certain to be issued (an
                 # armed latch with no callback would strand the span)
                 expect_flush(span)
+            # graftlint: disable=callback-under-lock -- lane_lock makes
+            # the device batch + envelope adjacent on the conn (same
+            # pairing discipline as Channel._issue_rpc); Socket.write
+            # only queues and on_done fires from the drain
             socket.write(wire, on_done=on_done)
     else:
         if span is not None:

@@ -152,11 +152,17 @@ class Stream:
             hdr = _HDR.pack(MAGIC, len(meta_bytes) + pl,
                             len(meta_bytes)) + meta_bytes
             if pl <= 65536:
+                # graftlint: disable=callback-under-lock -- callers may
+                # hold their own sender lock for token ORDER (the
+                # serving _StreamSender does); Socket.write only queues
+                # — it never parks, and failure paths flip flags
                 self.socket.write(hdr + payload)
             else:
                 wire = IOBuf()
                 wire.append(hdr)
                 wire.append_user_data(payload)
+                # graftlint: disable=callback-under-lock -- see the
+                # small-frame branch above: write only queues
                 self.socket.write(wire)
             return
         meta = pb.RpcMeta()
@@ -177,6 +183,8 @@ class Stream:
                                   device_lane=use_lane)
         if lane is not None:
             self.socket.write_device_payload(lane)
+        # graftlint: disable=callback-under-lock -- see _send_frame's
+        # raw-frame branch: write only queues, sender locks order tokens
         self.socket.write(wire)
 
     # -------------------------------------------------------------- receive

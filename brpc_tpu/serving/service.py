@@ -123,6 +123,10 @@ class _StreamSender:
             while self._pending:
                 if self._dead():
                     return False
+                # graftlint: disable=callback-under-lock -- write_nowait
+                # never parks (credit check + queue only) and holding
+                # _lock here IS the token-order guarantee; the failure
+                # path (batcher.cancel) just flips a lock-free flag
                 if not self.stream.write_nowait(self._pending[0]):
                     # out of credits (or just died — next call notices)
                     break

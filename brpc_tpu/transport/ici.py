@@ -658,9 +658,16 @@ class IciConn(Conn):
     # ---------------------------------------------------------- inbound
     def _pump(self) -> None:
         with self._pump_lock:
-            self._pump_locked()
+            fire = self._pump_locked()
+        # the writable callback re-enters the write path (and a write
+        # completion can pump again through read_into) — it must run
+        # AFTER _pump_lock is released, never under it
+        if fire is not None:
+            fire()
 
-    def _pump_locked(self) -> None:
+    def _pump_locked(self) -> Optional[Callable[[], None]]:
+        """Drain + decode inbound frames; returns the writable callback
+        to fire once the caller has dropped _pump_lock (or None)."""
         buf = bytearray(256 << 10)
         while True:
             try:
@@ -705,9 +712,8 @@ class IciConn(Conn):
             drained = self._flush()
             if drained and self._want_writable:
                 self._want_writable = False
-                cb = self._on_writable_cb
-                if cb is not None:
-                    cb()
+                return self._on_writable_cb
+        return None
 
     def read_into(self, mv: memoryview) -> int:
         self._pump()

@@ -1,0 +1,27 @@
+"""Seeded blocking-under-lock violations: a sleep held inside the
+registry lock (every reader stalls for the nap) and an Event.wait
+reached under the same lock through a helper call."""
+
+import threading
+import time
+
+
+class Registry:
+    def __init__(self):
+        self._reg_lock = threading.Lock()
+        self._ready = threading.Event()
+        self.items = {}
+
+    def settle_and_add(self, key, value):
+        with self._reg_lock:
+            time.sleep(0.05)         # VIOLATION 1: nap under the lock
+            self.items[key] = value
+
+    def _await_ready(self):
+        # VIOLATION 2: reached while add_when_ready holds _reg_lock
+        self._ready.wait(1.0)
+
+    def add_when_ready(self, key, value):
+        with self._reg_lock:
+            self._await_ready()
+            self.items[key] = value

@@ -1,0 +1,28 @@
+"""Seeded callback-under-lock violations, the PR 8 batcher shape: user
+callbacks fired while the scheduler's own lock is held — a callback
+that writes a socket whose failure path calls back into cancel()
+re-enters this very lock."""
+
+import threading
+
+
+class MiniBatcher:
+    def __init__(self):
+        self._sched_lock = threading.Lock()
+        self.waiting = []
+
+    def step(self):
+        with self._sched_lock:
+            for req in self.waiting:
+                # VIOLATION 1: stored callback invoked under the lock
+                req.on_token(req, 1)
+            self.waiting.clear()
+
+    def _emit_done(self, req, state):
+        # VIOLATION 2: reached under the lock through retire_all's call
+        req.on_finish(req, state)
+
+    def retire_all(self, state):
+        with self._sched_lock:
+            for req in self.waiting:
+                self._emit_done(req, state)

@@ -1,0 +1,39 @@
+"""Seeded event-wait-not-sleep violations, the PR 6 watchdog shape: a
+long-lived thread loop pacing itself with time.sleep — stop() cannot
+interrupt the nap, and the profiler sees an opaque busy-ish leaf
+instead of a parked thread."""
+
+import threading
+import time
+
+
+class Monitor:
+    def __init__(self):
+        self._stopping = False
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+
+    def _watch(self):
+        while not self._stopping:
+            self._check()
+            time.sleep(0.5)          # VIOLATION 1: uninterruptible nap
+
+    def _check(self):
+        pass
+
+    def stop(self):
+        self._stopping = True        # ...which this cannot interrupt
+
+
+def _pacer(period):
+    while True:
+        time.sleep(period)           # VIOLATION 2: via bare function
+
+
+def spawn_pacer():
+    t = threading.Thread(target=_pacer, daemon=True)
+    t.start()
+    return t

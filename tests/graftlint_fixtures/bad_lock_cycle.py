@@ -1,0 +1,37 @@
+"""Seeded INTERPROCEDURAL lock cycle: neither function nests the two
+locks syntactically — the cycle only exists through the call edges
+(Journal.flush under _journal_lock calls Index.touch which takes
+_index_lock; Index.rebuild under _index_lock calls Journal.append
+which takes _journal_lock). v1's with-nesting rule cannot see this."""
+
+import threading
+
+
+class Journal:
+    def __init__(self):
+        self._journal_lock = threading.Lock()
+        self.entries = []
+
+    def record_entry(self, e):
+        with self._journal_lock:
+            self.entries.append(e)
+
+    def flush(self, index):
+        with self._journal_lock:          # holds journal...
+            for e in self.entries:
+                index.touch(e)            # ...and takes index inside
+            self.entries.clear()
+
+
+class Index:
+    def __init__(self):
+        self._index_lock = threading.Lock()
+        self.keys = {}
+
+    def touch(self, e):
+        with self._index_lock:
+            self.keys[e] = True
+
+    def rebuild(self, journal):
+        with self._index_lock:            # holds index...
+            journal.record_entry("rebuilt")     # ...and takes journal inside

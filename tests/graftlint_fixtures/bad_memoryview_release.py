@@ -1,0 +1,38 @@
+"""Seeded memoryview-release violations, the PR 6 BufferError shape: a
+view of a resizable buffer still exported when the buffer is resized —
+a frame-pinning sampler keeps the view alive and the resize raises
+``BufferError: Existing exports of data``."""
+
+
+def drain_no_release(conn, wirebuf: bytearray):
+    while wirebuf:
+        mv = memoryview(wirebuf)
+        n = conn.write(mv)
+        del wirebuf[:n]              # VIOLATION 1: mv never released
+
+
+def drain_conditional_release(conn, wirebuf: bytearray):
+    mv = memoryview(wirebuf)
+    n = conn.write(mv)
+    if n == 0:
+        mv.release()                 # releases on ONE path only...
+    del wirebuf[:n]                  # VIOLATION 2: the n>0 path leaks
+
+
+class Framer:
+    def __init__(self):
+        self._buf = bytearray()
+
+    def cut(self, conn):
+        view = memoryview(self._buf)
+        n = conn.write(view)
+        self._buf.clear()            # VIOLATION 3: clear() while the
+        return n                     # view still exports self._buf
+
+    def cut_some(self, conn, fast):
+        n = 0
+        if fast:
+            view = memoryview(self._buf)   # branch-local view...
+            n = conn.write(view)
+        del self._buf[:n]            # VIOLATION 4: ...leaks into the
+        return n                     # unconditional resize after the if

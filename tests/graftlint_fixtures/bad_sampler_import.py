@@ -1,0 +1,29 @@
+"""Seeded sampler-no-lazy-import violations, the PR 8 flight-recorder
+shape: imports executed inside the sampler thread's loop — the first
+execution opens module files ON the sampler thread at sample time."""
+
+import threading
+
+
+class StackSampler:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="stack_sampler", daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            import sys                    # VIOLATION 1: lazy import in
+            frames = sys._current_frames()  # the sampler loop itself
+            self._attribute(frames)
+            self._stop.wait(0.05)
+
+    def _attribute(self, frames):
+        # VIOLATION 2: reached from the loop through a helper
+        from collections import Counter
+        return Counter(len(f) if hasattr(f, "__len__") else 1
+                       for f in frames)

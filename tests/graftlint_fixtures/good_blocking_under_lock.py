@@ -1,0 +1,32 @@
+"""The clean twin of bad_blocking_under_lock: waits happen OUTSIDE the
+lock, and a Condition used as its own context manager (wait releases
+the lock it rides) stays out of scope by design."""
+
+import threading
+import time
+
+
+class Registry:
+    def __init__(self):
+        self._reg_lock = threading.Lock()
+        self._ready = threading.Event()
+        self._cv = threading.Condition()
+        self.items = {}
+
+    def settle_and_add(self, key, value):
+        time.sleep(0.05)             # nap first, lock after
+        with self._reg_lock:
+            self.items[key] = value
+
+    def add_when_ready(self, key, value):
+        self._ready.wait(1.0)        # wait OUTSIDE the critical section
+        with self._reg_lock:
+            self.items[key] = value
+
+    def consume(self):
+        # the condvar idiom: wait() atomically RELEASES the lock it
+        # rides — not a blocking-under-lock hazard
+        with self._cv:
+            while not self.items:
+                self._cv.wait(0.1)
+            return self.items.popitem()

@@ -1,0 +1,27 @@
+"""The clean twin of bad_callback_under_lock: the batcher discipline —
+collect emissions under the lock, fire them after releasing it."""
+
+import threading
+
+
+class MiniBatcher:
+    def __init__(self):
+        self._sched_lock = threading.Lock()
+        self.waiting = []
+
+    def step(self):
+        emits = []
+        with self._sched_lock:
+            emits.extend((req, 1) for req in self.waiting)
+            self.waiting.clear()
+        # callbacks OUTSIDE the lock: a socket-failure path calling
+        # back into cancel() finds the lock free
+        for req, tok in emits:
+            req.on_token(req, tok)
+
+    def retire_all(self, state):
+        with self._sched_lock:
+            done = list(self.waiting)
+            self.waiting.clear()
+        for req in done:
+            req.on_finish(req, state)

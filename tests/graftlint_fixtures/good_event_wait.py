@@ -1,0 +1,34 @@
+"""The clean twin of bad_event_wait: the loop parks on an Event with a
+timeout — stop() interrupts it instantly, and the flight recorder
+classifies the parked thread idle. A finite sleep in a non-thread
+helper stays out of scope."""
+
+import threading
+import time
+
+
+class Monitor:
+    def __init__(self):
+        self._stop_ev = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+
+    def _watch(self):
+        while not self._stop_ev.is_set():
+            self._check()
+            self._stop_ev.wait(0.5)   # interruptible, classifies idle
+
+    def _check(self):
+        pass
+
+    def stop(self):
+        self._stop_ev.set()
+
+
+def settle_briefly():
+    # not a thread target, not a loop: a one-shot settle delay in a
+    # test helper is no one's long-lived pacing nap
+    time.sleep(0.01)

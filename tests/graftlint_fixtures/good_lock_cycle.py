@@ -1,0 +1,38 @@
+"""The clean twin of bad_lock_cycle: the same two subsystems agree on
+ONE acquisition order (journal before index, everywhere) so the
+interprocedural graph is a DAG — zero findings."""
+
+import threading
+
+
+class Journal:
+    def __init__(self):
+        self._journal_lock = threading.Lock()
+        self.entries = []
+
+    def record_entry(self, e):
+        with self._journal_lock:
+            self.entries.append(e)
+
+    def flush(self, index):
+        with self._journal_lock:          # journal -> index, the
+            for e in self.entries:        # sanctioned order
+                index.touch(e)
+            self.entries.clear()
+
+
+class Index:
+    def __init__(self):
+        self._index_lock = threading.Lock()
+        self.keys = {}
+
+    def touch(self, e):
+        with self._index_lock:
+            self.keys[e] = True
+
+    def rebuild(self, journal):
+        # collect OUTSIDE _index_lock, then flush through the journal's
+        # own path: index never holds its lock into journal code
+        journal.record_entry("rebuilt")
+        with self._index_lock:
+            self.keys.clear()

@@ -1,0 +1,34 @@
+"""The clean twin of bad_memoryview_release: the ici _flush
+discipline — release in a finally BEFORE the resize, the with-form
+that releases at block exit, and read-only views that never see their
+source resized."""
+
+
+def drain_finally_release(conn, wirebuf: bytearray):
+    while wirebuf:
+        mv = memoryview(wirebuf)
+        try:
+            n = conn.write(mv)
+        finally:
+            mv.release()             # released on EVERY path...
+        del wirebuf[:n]              # ...before the resize
+
+
+def drain_with_form(conn, wirebuf: bytearray):
+    while wirebuf:
+        with memoryview(wirebuf) as mv:
+            n = conn.write(mv)
+        del wirebuf[:n]              # __exit__ already released
+
+
+def checksum_readonly(wirebuf: bytearray) -> int:
+    mv = memoryview(wirebuf)         # source never resized: no export
+    return sum(mv) & 0xFFFF          # hazard to begin with
+
+
+def rotate(conn, wirebuf: bytearray):
+    mv = memoryview(wirebuf)
+    n = conn.write(mv)
+    mv.release()                     # unconditional release, then resize
+    del wirebuf[:n]
+    return n

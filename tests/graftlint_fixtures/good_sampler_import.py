@@ -1,0 +1,39 @@
+"""The clean twin of bad_sampler_import: every collaborator the
+sampler loop touches is bound BEFORE the thread exists — at module
+load, or in the pre-start bind step for import-cycle-constrained
+modules. Zero findings."""
+
+import sys
+import threading
+from collections import Counter
+
+_helper = None      # bound by _bind_imports, never from the loop
+
+
+def _bind_imports():
+    global _helper
+    if _helper is None:
+        import collections
+        _helper = collections
+
+
+class StackSampler:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        _bind_imports()               # caller thread, before the loop
+        self._thread = threading.Thread(
+            target=self._loop, name="stack_sampler", daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            frames = sys._current_frames()
+            self._attribute(frames)
+            self._stop.wait(0.05)
+
+    def _attribute(self, frames):
+        return Counter(len(f) if hasattr(f, "__len__") else 1
+                       for f in frames)

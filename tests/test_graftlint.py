@@ -8,6 +8,7 @@ The fixtures are real checked-in modules so a rule regression shows up
 as a diffable test failure, not a silent loss of coverage.
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -65,8 +66,10 @@ class TestSeededViolations:
         assert "turbo_nested_decoy" in msgs
 
     def test_lock_order_cycle(self):
+        # v2: the with-nesting AB/BA cycle is now reported by the lock
+        # model's whole-program lock-cycle rule (lock-order's successor)
         active, _ = _lint("bad_lock_order.py")
-        assert [f.rule for f in active] == ["lock-order"], active
+        assert [f.rule for f in active] == ["lock-cycle"], active
         assert "_io_lock" in active[0].message
         assert "_state_lock" in active[0].message
 
@@ -452,16 +455,110 @@ class TestCli:
             [sys.executable, "-m", "brpc_tpu.analysis", *args],
             cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
 
-    def test_exit_1_on_findings_and_0_on_clean(self):
+    def test_exit_code_is_unwaived_finding_count(self):
+        # the CI contract: exit code == number of unwaived findings
+        # (0 = clean), pinned here so scripts can rely on it
         bad = self._run(os.path.join(FIXTURES, "bad_iobuf_aliasing.py"))
-        assert bad.returncode == 1 and "iobuf-aliasing" in bad.stdout
+        assert bad.returncode == 2 and "iobuf-aliasing" in bad.stdout
+        four = self._run(os.path.join(FIXTURES,
+                                      "bad_memoryview_release.py"))
+        assert four.returncode == 4, four.stdout + four.stderr
         clean = self._run(os.path.join(FIXTURES, "clean.py"))
         assert clean.returncode == 0, clean.stdout + clean.stderr
 
     def test_unknown_rule_is_usage_error(self):
         proc = self._run("--rules", "no-such-rule",
                          os.path.join(FIXTURES, "clean.py"))
-        assert proc.returncode == 2 and "unknown rules" in proc.stderr
+        assert proc.returncode == 120 and "unknown rules" in proc.stderr
+
+    def test_list_rules_names_the_v2_pack(self):
+        proc = self._run("--list-rules")
+        assert proc.returncode == 0
+        for rule in ("lock-cycle", "callback-under-lock",
+                     "blocking-under-lock", "sampler-no-lazy-import",
+                     "event-wait-not-sleep", "memoryview-release",
+                     "fiber-blocking", "postfork-reset"):
+            assert rule in proc.stdout, proc.stdout
+
+    def test_format_json(self):
+        proc = self._run("--format=json",
+                         os.path.join(FIXTURES, "bad_lock_cycle.py"))
+        report = json.loads(proc.stdout)
+        assert proc.returncode == len(report["active"]) == 1
+        assert report["active"][0]["rule"] == "lock-cycle"
+
+    def test_format_sarif_is_valid_2_1_0(self):
+        proc = self._run(
+            "--format=sarif",
+            os.path.join(FIXTURES, "bad_memoryview_release.py"))
+        sarif = json.loads(proc.stdout)
+        assert sarif["version"] == "2.1.0"
+        run = sarif["runs"][0]
+        assert run["tool"]["driver"]["name"] == "graftlint"
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        results = run["results"]
+        assert len(results) == 4 and proc.returncode == 4
+        for r in results:
+            assert r["ruleId"] in rule_ids
+            loc = r["locations"][0]["physicalLocation"]
+            assert loc["artifactLocation"]["uri"].endswith(
+                "bad_memoryview_release.py")
+            assert loc["region"]["startLine"] >= 1
+        # waived findings ride along as suppressed results
+        waived = self._run("--format=sarif",
+                           os.path.join(REPO_ROOT, "brpc_tpu", "rpc",
+                                        "progressive.py"))
+        wsarif = json.loads(waived.stdout)
+        sup = [r for r in wsarif["runs"][0]["results"]
+               if r.get("suppressions")]
+        assert sup and all(s["suppressions"][0]["justification"]
+                           for s in sup)
+
+    def test_show_waivers_audits_reasons_and_usage(self):
+        proc = self._run("--show-waivers",
+                         os.path.join(REPO_ROOT, "brpc_tpu"))
+        assert proc.returncode == 0
+        # every in-force waiver is listed with its reason, and the
+        # real-tree waivers all suppress something (no stale rows)
+        assert "disable=callback-under-lock" in proc.stdout
+        assert "disable=judge-defer" in proc.stdout
+        assert "UNUSED" not in proc.stdout, proc.stdout
+        js = self._run("--show-waivers", "--format=json",
+                       os.path.join(REPO_ROOT, "brpc_tpu"))
+        rows = json.loads(js.stdout)["waivers"]
+        assert rows and all(w["reason"] for w in rows)
+        assert all(w["used"] for w in rows)
+
+    def test_changed_filters_to_git_diff(self, tmp_path):
+        # a scratch git repo: one clean file committed, one bad file
+        # added after — --changed must report ONLY the bad file's
+        # findings even though both are analyzed
+        import shutil
+        repo = tmp_path / "repo"
+        repo.mkdir()
+        shutil.copy(os.path.join(FIXTURES, "clean.py"),
+                    repo / "settled.py")
+
+        def git(*a):
+            return subprocess.run(["git", *a], cwd=repo,
+                                  capture_output=True, text=True,
+                                  timeout=60)
+
+        git("init", "-q")
+        git("-c", "user.email=t@t", "-c", "user.name=t", "add", "-A")
+        git("-c", "user.email=t@t", "-c", "user.name=t",
+            "commit", "-qm", "seed")
+        shutil.copy(os.path.join(FIXTURES, "bad_lock_cycle.py"),
+                    repo / "fresh.py")
+        proc = subprocess.run(
+            [sys.executable, "-m", "brpc_tpu.analysis", "--changed",
+             "HEAD", "--format=json", str(repo)],
+            cwd=repo, capture_output=True, text=True, timeout=120,
+            env={**os.environ, "PYTHONPATH": REPO_ROOT})
+        report = json.loads(proc.stdout)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert [f["rule"] for f in report["active"]] == ["lock-cycle"]
+        assert report["active"][0]["path"].endswith("fresh.py")
 
 
 class TestRepoIsClean:
@@ -473,3 +570,343 @@ class TestRepoIsClean:
         assert active == [], [f.format() for f in active]
         assert all(f.reason for f in waived), \
             [f.format() for f in waived]
+
+
+def _ctx_for(path, relpath, src):
+    from brpc_tpu.analysis.core import Context, SourceFile
+    sf = SourceFile(path, relpath, src)
+    return sf, Context([sf])
+
+
+class TestLockModelSnapshot:
+    """The discovered whole-program lock graph is a pinned artifact:
+    the model must keep finding the real locks, and its edge count only
+    grows DELIBERATELY (update the pin with the docs registry when a
+    new nesting ships)."""
+
+    # update deliberately, together with docs/invariants.md
+    PINNED_EDGE_COUNT = 35
+
+    def _model(self):
+        from brpc_tpu.analysis.core import Context, iter_source_files
+        from brpc_tpu.analysis.lockmodel import get_lock_model
+        files = iter_source_files([os.path.join(REPO_ROOT, "brpc_tpu")])
+        return get_lock_model(Context(files))
+
+    def test_discovers_the_known_real_locks(self):
+        m = self._model()
+        names = set(m.locks)
+        for known in ("Controller._arb_lock", "Controller._lb_lock",
+                      "ContinuousBatcher._lock", "FlightRecorder._lock",
+                      "Channel._socket_lock", "Channel._pool_lock",
+                      "Socket.pending_lock", "ServingEngine._decode_lock",
+                      "EventDispatcher._lock", "BackendCell._lock"):
+            assert known in names, f"lock model lost {known}"
+        # the acceptance floor: >= 15 real locks across the package
+        assert len(names) >= 15, sorted(names)
+
+    def test_lazy_dict_locks_resolve_through_foreign_receivers(self):
+        # Controller's _LAZY dict declares _arb_lock as an RLock; the
+        # acquisition `with cntl._arb_lock:` in backend_stats.py must
+        # land on the Controller node, not an anonymous one
+        m = self._model()
+        assert m.locks["Controller._arb_lock"].kind == "RLock"
+        fkeys = [k for k in m.funcs
+                 if "backend_stats" in k and "attempt" in k.lower()]
+        hit = any("Controller._arb_lock" in
+                  {a for a, _ in m.funcs[k].acquires} for k in fkeys)
+        assert hit, fkeys
+
+    def test_edge_count_grows_only_deliberately(self):
+        m = self._model()
+        assert len(m.edges) == self.PINNED_EDGE_COUNT, (
+            f"lock graph has {len(m.edges)} edges, pinned "
+            f"{self.PINNED_EDGE_COUNT}: a new lock nesting shipped — "
+            "re-run the lock-cycle rule, extend the LOCK_ORDER "
+            "registry in analysis/racelane.py + docs/invariants.md, "
+            "then update this pin", sorted(m.edges))
+
+    def test_acquisition_graph_is_cycle_free(self):
+        m = self._model()
+        assert m.cycles() == []
+
+
+class TestLockCycle:
+    def test_interprocedural_cycle_detected_with_witness(self):
+        active, _ = _lint("bad_lock_cycle.py")
+        assert [f.rule for f in active] == ["lock-cycle"], \
+            [f.format() for f in active]
+        msg = active[0].message
+        # both hops of the witness are named with their call chains —
+        # neither function nests the locks syntactically
+        assert "Journal._journal_lock" in msg
+        assert "Index._index_lock" in msg
+        assert "via Journal.flush->Index.touch" in msg
+        assert "via Index.rebuild->Journal.record_entry" in msg
+
+    def test_consistent_order_is_clean(self):
+        active, waived = _lint("good_lock_cycle.py")
+        assert active == [] and waived == [], \
+            [f.format() for f in active + waived]
+
+    def test_mutation_arb_lb_inversion_on_real_modules(self):
+        """Mutation pin for the PR 7 bug class: the tree keeps
+        `_arb_lock`/`_lb_lock` strictly sequential (controller releases
+        arb before taking lb; the cluster channel calls the arb-taking
+        super()._on_attempt_failed AFTER its lb hold closes).
+        Re-nesting both — arb around lb in _reset_for_call, super()
+        inside the lb hold — closes the AB/BA cycle and the rule must
+        fire; the unmutated pair is cycle-free."""
+        from brpc_tpu.analysis.core import Context, SourceFile
+        from brpc_tpu.analysis.rules.lock_graph import LockCycleRule
+        cpath = os.path.join(REPO_ROOT, "brpc_tpu", "rpc",
+                             "controller.py")
+        clpath = os.path.join(REPO_ROOT, "brpc_tpu", "rpc",
+                              "cluster_channel.py")
+        chpath = os.path.join(REPO_ROOT, "brpc_tpu", "rpc",
+                              "channel.py")
+        csrc, clsrc = open(cpath).read(), open(clpath).read()
+        chsrc = open(chpath).read()
+        # hop 1: controller nests arb around lb
+        seq = "            with self._lb_lock:"
+        assert seq in csrc
+        cmut = csrc.replace(
+            seq, "            with self._arb_lock, self._lb_lock:")
+        # hop 2: cluster channel calls the arb-taking base hook while
+        # still holding the lb lock
+        tail = ("                cntl._lb_fed.append(ep)\n"
+                "        # backend stat cells + attempt spans (base "
+                "hook) see the same\n"
+                "        # resolved endpoint the LB/breaker feedback "
+                "uses\n"
+                "        super()._on_attempt_failed(cntl, code, text, "
+                "ep)\n")
+        assert tail in clsrc
+        clmut = clsrc.replace(
+            tail, "                cntl._lb_fed.append(ep)\n"
+                  "                super()._on_attempt_failed("
+                  "cntl, code, text, ep)\n")
+
+        def run(ctrl_src, clus_src):
+            files = [
+                SourceFile(cpath, "brpc_tpu/rpc/controller.py",
+                           ctrl_src),
+                SourceFile(clpath, "brpc_tpu/rpc/cluster_channel.py",
+                           clus_src),
+                SourceFile(chpath, "brpc_tpu/rpc/channel.py", chsrc),
+            ]
+            return list(LockCycleRule().finalize(Context(files)))
+
+        found = run(cmut, clmut)
+        assert any(f.rule == "lock-cycle"
+                   and "Controller._arb_lock" in f.message
+                   and "Controller._lb_lock" in f.message
+                   for f in found), [f.format() for f in found]
+        assert run(csrc, clsrc) == []       # the real pair stays clean
+
+
+class TestCallbackUnderLock:
+    def test_seeded_violations(self):
+        active, _ = _lint("bad_callback_under_lock.py")
+        assert [f.rule for f in active] == ["callback-under-lock"] * 2, \
+            [f.format() for f in active]
+        msgs = " | ".join(f.message for f in active)
+        assert "on_token" in msgs and "while holding" in msgs
+        # the helper case carries the witness chain
+        assert "on_finish" in msgs and "reached under" in msgs \
+            and "MiniBatcher.retire_all -> MiniBatcher._emit_done" in msgs
+
+    def test_collect_then_fire_is_clean(self):
+        active, waived = _lint("good_callback_under_lock.py")
+        assert active == [] and waived == [], \
+            [f.format() for f in active + waived]
+
+    def test_mutation_firing_inside_lock_on_real_batcher(self):
+        """Mutation pin on the REAL serving batcher: re-indenting the
+        final _fire into the `with self._lock:` block reintroduces the
+        PR 8 bug (callbacks fired under the batcher lock) — the rule
+        must fire, and the unmutated module must stay clean."""
+        from brpc_tpu.analysis.rules.lock_graph import (
+            CallbackUnderLockRule,
+        )
+        path = os.path.join(REPO_ROOT, "brpc_tpu", "serving",
+                            "batcher.py")
+        src = open(path).read()
+        tail = "        self._fire(emits, done)\n        return True"
+        assert tail in src
+        mutated = src.replace(
+            tail, "            self._fire(emits, done)\n"
+                  "        return True")
+        sf, ctx = _ctx_for(path, "brpc_tpu/serving/batcher.py", mutated)
+        found = list(CallbackUnderLockRule().finalize(ctx))
+        assert any(f.rule == "callback-under-lock"
+                   and "on_token" in f.message for f in found), \
+            [f.format() for f in found]
+        sf_ok, ctx_ok = _ctx_for(path, "brpc_tpu/serving/batcher.py",
+                                 src)
+        assert list(CallbackUnderLockRule().finalize(ctx_ok)) == []
+
+
+class TestBlockingUnderLock:
+    def test_seeded_violations(self):
+        active, _ = _lint("bad_blocking_under_lock.py")
+        assert [f.rule for f in active] == ["blocking-under-lock"] * 2, \
+            [f.format() for f in active]
+        msgs = " | ".join(f.message for f in active)
+        assert "time.sleep()" in msgs and "while holding" in msgs
+        assert "Event.wait" in msgs and "reached under" in msgs
+
+    def test_waits_outside_and_condvar_idiom_clean(self):
+        active, waived = _lint("good_blocking_under_lock.py")
+        assert active == [] and waived == [], \
+            [f.format() for f in active + waived]
+
+    def test_mutation_sleeping_under_recorder_lock(self):
+        """Mutation pin on the REAL flight recorder: pulling the loop's
+        interruptible sleep under self._lock stalls every /hotspots
+        reader for the nap — the rule must fire; unmutated stays
+        clean."""
+        from brpc_tpu.analysis.rules.lock_graph import (
+            BlockingUnderLockRule,
+        )
+        path = os.path.join(REPO_ROOT, "brpc_tpu", "builtin",
+                            "flight_recorder.py")
+        src = open(path).read()
+        line = "                self._sleep(0.05)\n"
+        assert line in src
+        mutated = src.replace(
+            line, "                with self._lock:\n"
+                  "                    self._sleep(0.05)\n", 1)
+        sf, ctx = _ctx_for(path, "brpc_tpu/builtin/flight_recorder.py",
+                           mutated)
+        found = list(BlockingUnderLockRule().finalize(ctx))
+        assert any(f.rule == "blocking-under-lock"
+                   and "FlightRecorder._lock" in f.message
+                   for f in found), [f.format() for f in found]
+        sf_ok, ctx_ok = _ctx_for(
+            path, "brpc_tpu/builtin/flight_recorder.py", src)
+        assert list(BlockingUnderLockRule().finalize(ctx_ok)) == []
+
+
+class TestSamplerNoLazyImport:
+    def test_seeded_violations(self):
+        active, _ = _lint("bad_sampler_import.py")
+        assert [f.rule for f in active] == \
+            ["sampler-no-lazy-import"] * 2, \
+            [f.format() for f in active]
+        msgs = " | ".join(f.message for f in active)
+        assert "StackSampler._loop" in msgs
+        assert "reached via StackSampler._loop -> " \
+            "StackSampler._attribute" in msgs
+
+    def test_bind_before_start_is_clean(self):
+        active, waived = _lint("good_sampler_import.py")
+        assert active == [] and waived == [], \
+            [f.format() for f in active + waived]
+
+    def test_mutation_lazy_import_in_real_attribution_path(self):
+        """Mutation pin on the REAL flight recorder: re-introducing the
+        PR 8 lazy import inside _attribute (the fd-churn flake) must
+        fire the rule; the fixed module stays clean."""
+        from brpc_tpu.analysis.rules.sampler_import import (
+            SamplerNoLazyImportRule,
+        )
+        path = os.path.join(REPO_ROOT, "brpc_tpu", "builtin",
+                            "flight_recorder.py")
+        src = open(path).read()
+        target = "                cntl = _serving_cntl.peek(fiber)\n"
+        assert target in src
+        mutated = src.replace(
+            target,
+            "                from brpc_tpu.rpc.server_dispatch import "
+            "_serving_cntl as sc\n"
+            "                cntl = sc.peek(fiber)\n", 1)
+        sf, ctx = _ctx_for(path, "brpc_tpu/builtin/flight_recorder.py",
+                           mutated)
+        found = list(SamplerNoLazyImportRule().finalize(ctx))
+        assert any(f.rule == "sampler-no-lazy-import"
+                   and "_attribute" in f.message for f in found), \
+            [f.format() for f in found]
+        sf_ok, ctx_ok = _ctx_for(
+            path, "brpc_tpu/builtin/flight_recorder.py", src)
+        assert list(SamplerNoLazyImportRule().finalize(ctx_ok)) == []
+
+
+class TestEventWaitNotSleep:
+    def test_seeded_violations(self):
+        active, _ = _lint("bad_event_wait.py")
+        assert [f.rule for f in active] == ["event-wait-not-sleep"] * 2, \
+            [f.format() for f in active]
+        msgs = " | ".join(f.message for f in active)
+        assert "Monitor._watch" in msgs and "_pacer" in msgs
+
+    def test_event_parked_loop_is_clean(self):
+        active, waived = _lint("good_event_wait.py")
+        assert active == [] and waived == [], \
+            [f.format() for f in active + waived]
+
+    def test_mutation_sleep_in_real_shard_monitor(self):
+        """Mutation pin on the REAL shard supervisor: swapping the
+        monitor loop's Event-parked tick back to time.sleep (the exact
+        pre-PR 6 shape) must fire the rule; unmutated stays clean."""
+        from brpc_tpu.analysis.rules.event_wait import (
+            EventWaitNotSleepRule,
+        )
+        path = os.path.join(REPO_ROOT, "brpc_tpu", "rpc",
+                            "shard_group.py")
+        src = open(path).read()
+        waits = [ln for ln in src.splitlines()
+                 if "park.wait(" in ln]
+        assert len(waits) == 1, waits
+        mutated = src.replace(
+            waits[0],
+            waits[0].replace("park.wait(", "time.sleep("))
+        sf, ctx = _ctx_for(path, "brpc_tpu/rpc/shard_group.py", mutated)
+        found = list(EventWaitNotSleepRule().finalize(ctx))
+        assert any(f.rule == "event-wait-not-sleep"
+                   and "_monitor_loop" in f.message for f in found), \
+            [f.format() for f in found]
+        sf_ok, ctx_ok = _ctx_for(path, "brpc_tpu/rpc/shard_group.py",
+                                 src)
+        assert list(EventWaitNotSleepRule().finalize(ctx_ok)) == []
+
+
+class TestMemoryviewRelease:
+    def test_seeded_violations(self):
+        active, _ = _lint("bad_memoryview_release.py")
+        assert [f.rule for f in active] == ["memoryview-release"] * 4, \
+            [f.format() for f in active]
+        src = open(os.path.join(
+            FIXTURES, "bad_memoryview_release.py")).read().splitlines()
+        # findings anchor on the RESIZE; the conditional-release decoy
+        # (released on one path only) and the branch-local view leaking
+        # into an unconditional resize both fire
+        assert any("VIOLATION 2" in src[f.line - 1] for f in active)
+        assert any("VIOLATION 4" in src[f.line - 1] for f in active)
+
+    def test_release_disciplines_are_clean(self):
+        active, waived = _lint("good_memoryview_release.py")
+        assert active == [] and waived == [], \
+            [f.format() for f in active + waived]
+
+    def test_mutation_dropping_release_in_real_ici_flush(self):
+        """Mutation pin on the REAL ici transport: deleting the
+        `finally: mv.release()` from _flush reintroduces the PR 6
+        BufferError (frame-pinning sampler vs `del wirebuf[:n]`) — the
+        rule must fire; the fixed module stays clean."""
+        from brpc_tpu.analysis.rules.memoryview_release import (
+            MemoryviewReleaseRule,
+        )
+        path = os.path.join(REPO_ROOT, "brpc_tpu", "transport", "ici.py")
+        src = open(path).read()
+        guard = ("                    finally:\n"
+                 "                        mv.release()\n")
+        assert guard in src
+        mutated = src.replace(guard, "")
+        sf, ctx = _ctx_for(path, "brpc_tpu/transport/ici.py", mutated)
+        found = list(MemoryviewReleaseRule().check(sf, ctx))
+        assert any(f.rule == "memoryview-release"
+                   and "_wirebuf" in f.message for f in found), \
+            [f.format() for f in found]
+        sf_ok, ctx_ok = _ctx_for(path, "brpc_tpu/transport/ici.py", src)
+        assert list(MemoryviewReleaseRule().check(sf_ok, ctx_ok)) == []

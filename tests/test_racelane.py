@@ -1,0 +1,253 @@
+"""racelane: the lock model's dynamic complement, plus the pinned
+regressions for the real concurrency bugs graftlint v2 found on this
+tree.
+
+Tier-1 half: install/uninstall hygiene, the strict order assert, and
+the channel probe-outside-lock regression pin. Tier-2 half (``slow``):
+the seeded interleaving lane — a subprocess under
+``BRPC_TPU_LOCK_DEBUG=1`` must reproduce the seeded AB/BA inversion
+DETERMINISTICALLY (same seed, same first violation, two runs) and run
+the real batcher clean under perturbation.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from brpc_tpu.analysis import racelane
+
+
+class TestInstrumentation:
+    def test_install_uninstall_restores_threading(self):
+        real_lock, real_rlock = threading.Lock, threading.RLock
+        racelane.install(seed=1, perturb=False)
+        try:
+            assert threading.Lock is racelane.DebugLock
+            lk = threading.Lock()
+            with lk:
+                assert lk.locked()
+            assert not lk.locked()
+        finally:
+            racelane.uninstall()
+            racelane.clear_violations()
+        assert threading.Lock is real_lock
+        assert threading.RLock is real_rlock
+
+    def test_creation_site_naming_and_rank(self):
+        racelane.install(seed=1, perturb=False)
+        try:
+            class Holder:
+                pass
+            o = Holder()
+            o._arb_lock = threading.RLock()   # unique registry suffix
+            o._misc_lock = threading.Lock()   # unranked
+            assert o._arb_lock.name.endswith(":_arb_lock")
+            assert o._arb_lock.rank is not None
+            assert o._misc_lock.rank is None
+        finally:
+            racelane.uninstall()
+            racelane.clear_violations()
+
+    def test_strict_order_assert_raises_without_leaking(self):
+        racelane.install(seed=1, strict=True, perturb=False)
+        try:
+            class Holder:
+                pass
+            o = Holder()
+            o._arb_lock = threading.RLock()
+            o._lb_lock = threading.Lock()
+            # sanctioned nesting passes...
+            with o._arb_lock:
+                with o._lb_lock:
+                    pass
+            # ...the inversion raises BEFORE anything is held
+            with o._lb_lock:
+                with pytest.raises(racelane.LockOrderViolation):
+                    o._arb_lock.acquire()
+            # nothing leaked: both locks acquirable again
+            with o._arb_lock:
+                with o._lb_lock:
+                    pass
+        finally:
+            racelane.uninstall()
+            racelane.clear_violations()
+
+    def test_real_lazy_controller_locks_rank_at_runtime(self, tmp_path):
+        # the PR 7 pair is factory-created (Controller._LAZY through
+        # __getattr__), so the creating line is `v = factory()` — the
+        # namer must walk up to the attribute ACCESS and still land on
+        # the registry rows, or the runtime assert would only ever
+        # cover synthetic locks. Runs as a SUBPROCESS under the env
+        # hook (the production arming path): the _LAZY dict captures
+        # whatever threading.RLock was at controller-import time, so an
+        # in-process install after this suite's earlier imports would
+        # test nothing.
+        driver = tmp_path / "drive.py"
+        driver.write_text(
+            "import sys, json\n"
+            f"sys.path.insert(0, {REPO_ROOT!r})\n"
+            "import brpc_tpu\n"
+            "from brpc_tpu.analysis import racelane\n"
+            "assert racelane.installed()\n"
+            "from brpc_tpu.rpc.controller import Controller\n"
+            "cntl = Controller()\n"
+            "with cntl._arb_lock:\n"
+            "    pass\n"
+            "lk = cntl.__dict__['_arb_lock']\n"
+            "assert lk.rank is not None, lk.name\n"
+            "with cntl._lb_lock:\n"
+            "    cntl._arb_lock.acquire()\n"
+            "    cntl._arb_lock.release()\n"
+            "print(json.dumps(racelane.violations()))\n")
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "BRPC_TPU_LOCK_DEBUG": "1"})
+        proc = subprocess.run([sys.executable, str(driver)], env=env,
+                              capture_output=True, text=True,
+                              timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        v = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert v and v[0]["acquiring"] == "Controller._arb_lock" \
+            and v[0]["holding"] == "Controller._lb_lock", v
+
+    def test_condition_over_instrumented_rlock(self):
+        # the stdlib Condition fallback probes ownership with a
+        # non-reentrant acquire(False) — the DebugRLock must speak the
+        # real protocol or every Condition.wait deadlocks
+        racelane.install(seed=1, perturb=False)
+        try:
+            cv = threading.Condition(threading.RLock())
+            hits = []
+
+            def waiter():
+                with cv:
+                    hits.append(cv.wait(2.0))
+
+            t = threading.Thread(target=waiter, daemon=True)
+            t.start()
+            import time
+            deadline = time.monotonic() + 2.0
+            while not hits and time.monotonic() < deadline:
+                with cv:
+                    cv.notify_all()
+                time.sleep(0.01)
+            t.join(2.0)
+            assert hits == [True], hits
+        finally:
+            racelane.uninstall()
+            racelane.clear_violations()
+
+
+class TestProbeCallbackRegression:
+    """Pin for the callback-under-lock bug graftlint v2 found in
+    Channel._pick_socket: probing a possibly-dead socket under
+    _socket_lock/_pool_lock runs probe_unobserved -> set_failed ->
+    inline on_failed callbacks UNDER channel locks. The probe must run
+    with both locks free."""
+
+    class _ProbeStub:
+        failed = False
+
+        def __init__(self, ch):
+            self.ch = ch
+            self.probed = 0
+            self.lock_free = None
+
+        def probe_unobserved(self):
+            self.probed += 1
+            free = self.ch._socket_lock.acquire(blocking=False)
+            if free:
+                self.ch._socket_lock.release()
+            pool_free = self.ch._pool_lock.acquire(blocking=False)
+            if pool_free:
+                self.ch._pool_lock.release()
+            self.lock_free = free and pool_free
+            return False          # alive: the pick returns this socket
+
+    def test_single_share_path_probes_outside_socket_lock(self):
+        from brpc_tpu.rpc.channel import Channel
+        from brpc_tpu.rpc.controller import Controller
+        ch = Channel("tcp://127.0.0.1:1")
+        stub = self._ProbeStub(ch)
+        ch._socket = stub
+        got = ch._pick_socket(Controller())
+        assert got is stub
+        assert stub.probed == 1
+        assert stub.lock_free is True, \
+            "probe_unobserved ran under a channel lock: set_failed's " \
+            "on_failed callbacks would fire inside it"
+
+    def test_pooled_path_probes_outside_pool_lock(self):
+        from brpc_tpu.rpc.channel import Channel, ChannelOptions
+        from brpc_tpu.rpc.controller import Controller
+        ch = Channel("tcp://127.0.0.1:1",
+                     ChannelOptions(connection_type="pooled"))
+        stub = self._ProbeStub(ch)
+        ch._conn_pool.append(stub)
+        cntl = Controller()
+        got = ch._pick_socket(cntl)
+        assert got is stub
+        assert stub.probed == 1
+        assert stub.lock_free is True, \
+            "pooled pick probed under _pool_lock"
+
+    def test_pooled_path_skips_dead_candidates(self):
+        from brpc_tpu.rpc.channel import Channel, ChannelOptions
+        from brpc_tpu.rpc.controller import Controller
+
+        class _Dead:
+            failed = True
+
+            def probe_unobserved(self):   # pragma: no cover - guarded
+                raise AssertionError("failed socket must not be probed")
+
+        ch = Channel("tcp://127.0.0.1:1",
+                     ChannelOptions(connection_type="pooled"))
+        live = self._ProbeStub(ch)
+        ch._conn_pool.extend([live, _Dead()])
+        got = ch._pick_socket(Controller())
+        assert got is live                 # dead one popped + dropped
+        assert not ch._conn_pool
+
+
+def _run_smoke(seed: int) -> dict:
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "BRPC_TPU_LOCK_DEBUG": "1",
+                "BRPC_TPU_LOCK_SEED": str(seed)})
+    proc = subprocess.run(
+        [sys.executable, "-m", "brpc_tpu.analysis.racelane", "--smoke"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=240)
+    assert proc.stdout, proc.stderr[-500:]
+    return json.loads(proc.stdout), proc.returncode
+
+
+@pytest.mark.slow
+class TestSeededInterleavings:
+    def test_seeded_race_reproduces_and_real_code_clean(self):
+        report, rc = _run_smoke(seed=42)
+        assert rc == 0, json.dumps(report)[:800]
+        # the seeded inversion is DETECTED both runs, with the same
+        # first violation — deterministic reproduction
+        assert report["inversion_detected"] is True
+        assert report["inversion_deterministic"] is True
+        first = report["seeded_inversion"][0]["first"]
+        assert first["acquiring"] == "Controller._arb_lock"
+        assert first["holding"] == "Controller._lb_lock"
+        # and the REAL batcher under the same perturbation stays clean
+        assert report["real_code_clean"] is True
+        assert report["real_code"]["stats"]["yields"] > 0, \
+            "perturbation never fired — the lane tested nothing"
+
+    def test_different_seed_still_detects(self):
+        # determinism is per-seed; detection is seed-independent
+        # (the assert fires on intent, not on lucky scheduling)
+        report, rc = _run_smoke(seed=7)
+        assert rc == 0, json.dumps(report)[:800]
+        assert report["inversion_detected"] is True

@@ -2,8 +2,11 @@
 """graftlint launcher: `python tools/graftlint.py [paths...]`.
 
 Thin wrapper over `python -m brpc_tpu.analysis` for invocations from
-outside the package root (CI steps, editors). See docs/invariants.md
-for the rule catalogue and waiver syntax.
+outside the package root (CI steps, editors). Exit code = unwaived
+finding count (0 = clean, capped at 100; 120 = usage error). CI and
+editors consume `--changed [BASE]` (lint only the git diff),
+`--format=json|sarif`, `--list-rules` and `--show-waivers`. See
+docs/invariants.md for the rule catalogue and waiver syntax.
 """
 
 import os

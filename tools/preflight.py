@@ -213,6 +213,117 @@ def gate_graftlint() -> dict:
     return out
 
 
+def gate_locklint() -> dict:
+    """graftlint v2's lock lane, gated standalone: the full-tree lock
+    rules (lock-cycle / callback-under-lock / blocking-under-lock plus
+    the learned-invariant pack) must report zero unwaived findings, AND
+    a mutation smoke must prove the rules still bite — stripping the
+    real guards (moving the batcher's callback fire inside its lock,
+    dropping ici's memoryview release) must make the rules fire. A
+    silent rule is worse than no rule."""
+    lock_rules = ("lock-cycle,callback-under-lock,blocking-under-lock,"
+                  "sampler-no-lazy-import,event-wait-not-sleep,"
+                  "memoryview-release")
+    proc = subprocess.run(
+        [sys.executable, "-m", "brpc_tpu.analysis", "brpc_tpu",
+         "--rules", lock_rules, "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    out: dict = {"ok": proc.returncode == 0}
+    try:
+        report = json.loads(proc.stdout)
+        out["active"] = len(report["active"])
+        out["waived"] = len(report["waived"])
+        if report["active"]:
+            out["findings"] = [
+                f"{f['path']}:{f['line']}: [{f['rule']}] {f['message']}"
+                for f in report["active"][:10]]
+    except (ValueError, KeyError):
+        out["ok"] = False
+        out["error"] = (proc.stdout + proc.stderr)[-500:]
+        return out
+    # mutation smoke, in-process over mutated SourceFiles: the real
+    # modules with their real guards stripped must trip the rules
+    try:
+        from brpc_tpu.analysis.core import Context, SourceFile
+        from brpc_tpu.analysis.rules.lock_graph import (
+            CallbackUnderLockRule,
+        )
+        from brpc_tpu.analysis.rules.memoryview_release import (
+            MemoryviewReleaseRule,
+        )
+        muts = []
+        # 1. batcher: fire callbacks INSIDE the lock (the PR 8 bug)
+        bpath = os.path.join(REPO_ROOT, "brpc_tpu", "serving",
+                             "batcher.py")
+        bsrc = open(bpath).read()
+        mutated = bsrc.replace(
+            "        self._fire(emits, done)\n        return True",
+            "            self._fire(emits, done)\n        return True")
+        assert mutated != bsrc
+        sf = SourceFile(bpath, "brpc_tpu/serving/batcher.py", mutated)
+        found = list(CallbackUnderLockRule().finalize(
+            _fresh_ctx([sf])))
+        muts.append(("callback-under-lock",
+                     any(f.rule == "callback-under-lock"
+                         for f in found)))
+        # 2. ici: drop the finally: mv.release() (the PR 6 BufferError)
+        ipath = os.path.join(REPO_ROOT, "brpc_tpu", "transport",
+                             "ici.py")
+        isrc = open(ipath).read()
+        mutated = isrc.replace(
+            "                    finally:\n"
+            "                        mv.release()\n", "")
+        assert mutated != isrc
+        sf = SourceFile(ipath, "brpc_tpu/transport/ici.py", mutated)
+        found = list(MemoryviewReleaseRule().check(sf, _fresh_ctx([sf])))
+        muts.append(("memoryview-release",
+                     any(f.rule == "memoryview-release"
+                         for f in found)))
+        out["mutations"] = {name: fired for name, fired in muts}
+        if not all(fired for _, fired in muts):
+            out["ok"] = False
+            out["error"] = "mutation smoke: a stripped guard went unseen"
+    except Exception as e:  # noqa: BLE001 - gate must report, not die
+        out["ok"] = False
+        out["error"] = f"mutation smoke failed: {type(e).__name__}: {e}"
+    return out
+
+
+def _fresh_ctx(files):
+    from brpc_tpu.analysis.core import Context
+    return Context(files)
+
+
+def gate_racelane() -> dict:
+    """The racelane seeded-interleaving smoke (python -m
+    brpc_tpu.analysis.racelane --smoke under BRPC_TPU_LOCK_DEBUG=1): a
+    seeded AB/BA inversion must be detected deterministically (same
+    first violation, two runs) and the real batcher must run a
+    submit/step/cancel storm clean under perturbation.
+    BRPC_TPU_RACELANE_SMOKE=0 skips."""
+    if os.environ.get("BRPC_TPU_RACELANE_SMOKE", "1") == "0":
+        return {"ok": True, "skipped": "BRPC_TPU_RACELANE_SMOKE=0"}
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "BRPC_TPU_LOCK_DEBUG": "1",
+                "BRPC_TPU_LOCK_SEED": env.get("BRPC_TPU_LOCK_SEED",
+                                              "42")})
+    proc = subprocess.run(
+        [sys.executable, "-m", "brpc_tpu.analysis.racelane", "--smoke"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=300)
+    out: dict = {"ok": proc.returncode == 0}
+    try:
+        report = json.loads(proc.stdout)
+        for k in ("inversion_detected", "inversion_deterministic",
+                  "real_code_clean"):
+            out[k] = report.get(k)
+        out["stats"] = report.get("real_code", {}).get("stats")
+    except ValueError:
+        out["ok"] = False
+        out["error"] = (proc.stdout + proc.stderr)[-500:]
+    return out
+
+
 def gate_sanitizer_smoke() -> dict:
     """Build both native artifacts under ASan/UBSan (separate .san.so
     cache — the plain lane is untouched). A missing sanitizer
@@ -472,6 +583,8 @@ def gate_perf_smoke() -> dict:
 def run_gate() -> int:
     report = {}
     for name, fn in (("graftlint", gate_graftlint),
+                     ("locklint", gate_locklint),
+                     ("racelane", gate_racelane),
                      ("sanitizer_smoke", gate_sanitizer_smoke),
                      ("chaos_smoke", gate_chaos_smoke),
                      ("trace_smoke", gate_trace_smoke),
