@@ -1121,6 +1121,46 @@ def main() -> None:
                 result["partial"] = True
                 _progress({"progress": "error", "phase": "cluster",
                            "error": result["cluster"]["error"]})
+        # ---- fabric storm lane (ISSUE 10): the overload-control loop
+        # under fault. Seeded kill/stall/outage/recover storm over 3
+        # nodes behind budget-hedging ClusterChannels — headline keys
+        # fault_goodput_ratio (fault-window goodput vs fault-free) and
+        # fault_p99_ms ride next to cluster_qps. A subprocess so a
+        # wedged storm cannot take the bench down.
+        if deadline.remaining() < 25.0:
+            result["fabric"] = {"skipped": "wall budget"}
+            result["partial"] = True
+        else:
+            import subprocess as _sp
+            try:
+                p = _sp.run(
+                    [sys.executable,
+                     os.path.join(base, "tools", "fabric_smoke.py"),
+                     "--bench"],
+                    capture_output=True, text=True, timeout=180)
+                rep = json.loads(p.stdout.strip().splitlines()[-1])
+                lane = {"fault_goodput_ratio": rep.get(
+                            "fault_goodput_ratio"),
+                        "fault_p99_ms": rep.get("fault_p99_ms"),
+                        "outage_amplification": rep.get(
+                            "outage_amplification"),
+                        "hedges_armed": rep.get("hedges_armed"),
+                        "hedges_past_budget": rep.get(
+                            "hedges_past_budget"),
+                        "problems": rep.get("problems")}
+                result["fabric"] = lane
+                if rep.get("fault_goodput_ratio") is not None:
+                    result["fault_goodput_ratio"] = \
+                        rep["fault_goodput_ratio"]
+                if rep.get("fault_p99_ms") is not None:
+                    result["fault_p99_ms"] = rep["fault_p99_ms"]
+                _progress({"progress": "fabric_lane", **lane})
+            except Exception as e:  # noqa: BLE001 - diagnostics only
+                result["fabric"] = {
+                    "error": f"{type(e).__name__}: {e}"[:200]}
+                result["partial"] = True
+                _progress({"progress": "error", "phase": "fabric",
+                           "error": result["fabric"]["error"]})
         # ---- serving lane (ISSUE 8): continuous-batching inference
         # over streaming RPC — a 2-shard GenerateService under a
         # chaos-flapped pipelined client mix (seeded transport drops
@@ -1210,6 +1250,8 @@ def main() -> None:
         "cluster_qps": result.get("cluster_qps"),
         "backend_stats_overhead_pct":
         result.get("backend_stats_overhead_pct"),
+        "fault_goodput_ratio": result.get("fault_goodput_ratio"),
+        "fault_p99_ms": result.get("fault_p99_ms"),
         "device_lane": ("error" if ("error" in lane or
                                     "lane_error" in lane)
                         else ("ok" if lane else "absent")),

@@ -89,6 +89,9 @@ LOCK_ORDER: List[Tuple[str, str]] = [
     ("variable:_registry_lock",     "bvar/variable.py"),
     ("postfork:_lock",              "butil/postfork.py"),
     ("resource_census:_lock",       "butil/resource_census.py"),
+    # leaf: drained inside Channel._retry_taken_call's _arb_lock hold
+    # (the one sanctioned nesting); never wraps another acquisition
+    ("RetryBudget._lock",           "rpc/retry_policy.py"),
 ]
 
 _RANK: Dict[str, int] = {name: i for i, (name, _) in enumerate(LOCK_ORDER)}

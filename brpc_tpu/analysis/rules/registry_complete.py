@@ -18,6 +18,14 @@ class's MRO across the analyzed file set:
     they import) must reference an errno mapping — ``errno_codes``,
     ``error_code``, a ``STATUS_*`` table, or an ``*Error`` exception
     class — so failures map to SOMETHING a peer can interpret.
+
+The same discipline covers the concurrency-limiter spec parser
+(``new_limiter``): a spec string names a limiter class the Server
+drives on its admission hot path, so every class the parser can
+construct must implement the full ConcurrencyLimiter contract —
+concrete ``on_requested``, ``on_responded`` and ``max_concurrency``
+(a raising stub would turn ``max_concurrency="auto"`` into a
+first-request crash).
 """
 
 from __future__ import annotations
@@ -34,17 +42,41 @@ _PACKISH_RE = re.compile(
     r"def\s+\w*(pack|serialize|encode|reply|response)\w*\s*\(")
 
 
+def _is_raise_stub(node: ast.AST) -> bool:
+    """A def whose body (docstring aside) is just ``raise
+    NotImplementedError`` — an abstract stub, not an implementation."""
+    body = list(getattr(node, "body", ()))
+    if body and isinstance(body[0], ast.Expr) \
+            and isinstance(body[0].value, ast.Constant) \
+            and isinstance(body[0].value.value, str):
+        body = body[1:]
+    if len(body) != 1 or not isinstance(body[0], ast.Raise):
+        return False
+    exc = body[0].exc
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    return isinstance(exc, ast.Name) and exc.id == "NotImplementedError"
+
+
 class RegistryCompleteRule(Rule):
     name = "registry-complete"
     description = ("every register_protocol()ed class must expose "
                    "parse + process(+_inline) + a pack/serialize "
                    "surface + an errno mapping")
 
+    # the ConcurrencyLimiter contract the Server's admission gate
+    # calls on every request (rpc/concurrency_limiter.py)
+    LIMITER_CONTRACT = ("on_requested", "on_responded", "max_concurrency")
+
     def check(self, sf: SourceFile, ctx: Context) -> Iterable[Finding]:
         if not sf.is_python or "/analysis/" in sf.relpath:
             return ()
         findings: List[Finding] = []
         for node in ast.walk(sf.tree):
+            if isinstance(node, ast.FunctionDef) \
+                    and node.name == "new_limiter":
+                findings.extend(self._check_limiter_parser(sf, node, ctx))
+                continue
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Name)
                     and node.func.id == "register_protocol"
@@ -58,6 +90,47 @@ class RegistryCompleteRule(Rule):
             if hit is None:
                 continue
             findings.extend(self._check_class(sf, node.lineno, hit, ctx))
+        return findings
+
+    # ------------------------------------------- limiter spec parser
+    def _check_limiter_parser(self, sf: SourceFile, fn: ast.FunctionDef,
+                              ctx: Context) -> Iterable[Finding]:
+        """Every class the spec parser can construct must be a complete
+        ConcurrencyLimiter: its contract methods run on the server's
+        per-request admission path, so an inherited raising stub is a
+        crash wired to a config string."""
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)):
+                continue
+            name = node.func.id
+            if name in seen:
+                continue
+            seen.add(name)
+            hit = ctx.resolve_class(f"{sf.relpath}:{name}") \
+                or ctx.resolve_class(name)
+            if hit is None:
+                continue   # int()/float()/errors — not a class here
+            hit_sf, hit_cls = hit
+            methods: Dict[str, Tuple[str, ast.AST]] = {}
+            for m_sf, m_cls in ctx.mro_class_defs(hit_sf, hit_cls):
+                for item in m_cls.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                            and item.name not in methods:
+                        methods[item.name] = (m_cls.name, item)
+            for want in self.LIMITER_CONTRACT:
+                owner = methods.get(want)
+                if owner is not None and not _is_raise_stub(owner[1]):
+                    continue
+                findings.append(Finding(
+                    self.name, sf.relpath, node.lineno,
+                    f"limiter spec parser constructs '{name}' with no "
+                    f"concrete {want}() — the Server's admission gate "
+                    "calls the full ConcurrencyLimiter contract on "
+                    "every request"))
         return findings
 
     def _resolve_class(self, sf: SourceFile,

@@ -29,7 +29,9 @@ def connections_page(server) -> dict:
     from brpc_tpu.butil.flags import flag as _flag
     from brpc_tpu.rpc.circuit_breaker import all_breaker_snapshots
     robustness = dict(dump_exposed("chaos_injected_"))
-    for name in ("server_deadline_shed", "retry_suppressed_budget"):
+    for name in ("server_deadline_shed", "server_limit_shed",
+                 "retry_suppressed_budget", "retry_throttled",
+                 "hedge_suppressed_budget", "naming_empty"):
         robustness.update(dump_exposed(name))
     idle_after = _flag("census_idle_s")
     now = _time.monotonic_ns()
@@ -120,6 +122,19 @@ def status_page(server) -> dict:
     saturation["socket_write_coalesced_frames"] = ncoalesced.get_value()
     saturation["iobuf_pool_hit_ratio"] = round(iobuf_pool.hit_ratio(), 4)
     saturation["iobuf_pool_bytes"] = iobuf_pool.cached_bytes()
+    # overload-control pane: the limiter's live limit + in-flight, the
+    # ELIMIT/deadline shed counters, and the process's most-drained
+    # retry token bucket. Merged shard views: *limit takes the max,
+    # inflight sums, *tokens takes the min (shard_group merge rules).
+    from brpc_tpu.rpc.retry_policy import min_retry_tokens
+    from brpc_tpu.rpc.server_dispatch import nlimit_shed, nshed
+    saturation["concurrency_limit"] = server.concurrency_limit()
+    saturation["inflight"] = server.concurrency
+    saturation["limit_shed"] = nlimit_shed.get_value()
+    saturation["deadline_shed"] = nshed.get_value()
+    tokens = min_retry_tokens()
+    if tokens is not None:
+        saturation["retry_tokens"] = tokens
     return {
         "running": server.is_running,
         "endpoint": str(server.endpoint) if server.endpoint else None,

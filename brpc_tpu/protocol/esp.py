@@ -80,7 +80,7 @@ class EspProtocol(Protocol):
                    if server is not None else None)
         if handler is None:
             return       # esp has no error channel: drop, like the reference
-        if not server.on_request_start():
+        if not server.on_request_start("esp.process"):
             return
         t0 = time.monotonic_ns()
         error = False

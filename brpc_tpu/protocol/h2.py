@@ -629,7 +629,7 @@ class H2ServerProtocol(Protocol):
             status, message = GRPC_INTERNAL, f"bad request: {e}"
             request = None
         if status == GRPC_OK:
-            if not server.on_request_start():
+            if not server.on_request_start(f"{service}.{method_name}"):
                 status, message = GRPC_UNAVAILABLE, "max_concurrency reached"
             else:
                 t0 = time.monotonic_ns()

@@ -733,7 +733,7 @@ class HttpProtocol(Protocol):
                     return 400, "text/plain", f"bad json: {e}".encode()
         else:
             request = req.body
-        if not server.on_request_start():
+        if not server.on_request_start(f"{service}.{method_name}"):
             return 500, "text/plain", b"max_concurrency reached"
         interceptor = getattr(server.options, "interceptor", None)
         if interceptor is not None:

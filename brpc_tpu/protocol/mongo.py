@@ -168,7 +168,7 @@ class MongoProtocol(Protocol):
             send({"ok": 0.0, "errmsg": f"no such command: '{cmd_name}'",
                   "code": 59})
             return
-        if not server.on_request_start():
+        if not server.on_request_start(f"mongo.{cmd_name}"):
             send({"ok": 0.0, "errmsg": "max_concurrency reached", "code": 202})
             return
         t0 = time.monotonic_ns()

@@ -109,7 +109,7 @@ class NsheadProtocol(Protocol):
                                      msg.log_id).pack())
             socket.write(out)
             return
-        if not server.on_request_start():
+        if not server.on_request_start("nshead.process"):
             return
         t0 = time.monotonic_ns()
         error = False

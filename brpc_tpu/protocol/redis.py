@@ -272,7 +272,7 @@ class RedisProtocol(Protocol):
             socket.write(_reply_buf(RedisError(
                 f"ERR unknown command '{name}'")))
             return
-        if not server.on_request_start():
+        if not server.on_request_start(f"redis.{name}"):
             socket.write(_reply_buf(RedisError("ERR max_concurrency reached")))
             return
         t0 = time.monotonic_ns()

@@ -336,7 +336,7 @@ class ThriftProtocol(Protocol):
             reply(MSG_EXCEPTION, app_exception_fields(
                 f"unknown method {msg.method!r}", 1))   # UNKNOWN_METHOD
             return
-        if not server.on_request_start():
+        if not server.on_request_start(f"thrift.{msg.method}"):
             reply(MSG_EXCEPTION, app_exception_fields(
                 "max_concurrency reached", 5))           # INTERNAL_ERROR
             return

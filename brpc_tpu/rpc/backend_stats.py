@@ -71,6 +71,19 @@ _OVERFLOW_KEY = ("_overflow", "_overflow")
 
 EWMA_ALPHA = 0.2
 
+# overload-rejection error classes (DAGOR discipline): the backend
+# answered "I'm shedding", in microseconds — a reject must neither
+# pollute latency telemetry (EWMA/reservoir) nor be mistaken for
+# breakage (LALB error penalty, circuit breaker). ERPCTIMEDOUT joins
+# the class only when a server RESPONDED with it (the deadline shed
+# gate) — a client-local timeout has no responder and stays a failure.
+REJECT_CODES = frozenset({berr.ELIMIT, berr.EOVERCROWDED})
+
+
+def is_reject(code: int, responded_server=None) -> bool:
+    return code in REJECT_CODES or (
+        code == berr.ERPCTIMEDOUT and responded_server is not None)
+
 
 def enabled() -> bool:
     return _flag("backend_stats_enabled")
@@ -103,7 +116,7 @@ class BackendCell(Variable):
 
     __slots__ = ("_lock", "_count_var", "_qps", "ewma_us", "inflight",
                  "attempts", "completed", "abandoned", "connect_errors",
-                 "errors", "bytes_in", "bytes_out", "_samples",
+                 "rejects", "errors", "bytes_in", "bytes_out", "_samples",
                  "_nsampled", "_sum_us", "_max_us")
 
     def __init__(self):
@@ -117,6 +130,7 @@ class BackendCell(Variable):
         self.completed = 0
         self.abandoned = 0
         self.connect_errors = 0
+        self.rejects = 0
         self.errors: Dict[str, int] = {}
         self.bytes_in = 0
         self.bytes_out = 0
@@ -162,6 +176,22 @@ class BackendCell(Variable):
                 self.errors[cls] = self.errors.get(cls, 0) + 1
         self._count_var.add(1)     # thread-local; outside the cell lock
 
+    def on_reject(self, code: int, nbytes_in: int = 0) -> None:
+        """The backend shed this attempt (ELIMIT/EOVERCROWDED or a
+        server-responded deadline shed): the error CLASS is counted so
+        overload is distinguishable from breakage, but the near-zero
+        reject round-trip never touches the latency EWMA/reservoir —
+        a shedding node must not look FAST to the balancer."""
+        with self._lock:
+            if self.inflight > 0:
+                self.inflight -= 1
+            self.completed += 1
+            self.rejects += 1
+            self.bytes_in += nbytes_in
+            cls = berr.errno_name(code)
+            self.errors[cls] = self.errors.get(cls, 0) + 1
+        self._count_var.add(1)     # thread-local; outside the cell lock
+
     def on_abandon(self) -> None:
         with self._lock:
             if self.inflight > 0:
@@ -181,6 +211,14 @@ class BackendCell(Variable):
         with self._lock:
             return self._samples[:limit]
 
+    def recent_p50_us(self) -> float:
+        """The reservoir's median (0.0 when empty) — the hedge arming
+        bar (Channel._on_backup_timer): one sorted copy of a bounded
+        list, cheap enough for the rare backup-timer path."""
+        with self._lock:
+            s = sorted(self._samples)
+        return self._pick(s, 0.5)
+
     @staticmethod
     def _pick(sorted_samples: List[float], ratio: float) -> float:
         if not sorted_samples:
@@ -199,6 +237,7 @@ class BackendCell(Variable):
                 "completed": self.completed,
                 "abandoned": self.abandoned,
                 "connect_errors": self.connect_errors,
+                "rejects": self.rejects,
                 "inflight": self.inflight,
                 "errors": nerr,
                 "error_ratio": round(nerr / observed, 4) if observed
@@ -207,8 +246,11 @@ class BackendCell(Variable):
                 "bytes_in": self.bytes_in,
                 "bytes_out": self.bytes_out,
                 "count": self.completed,
-                "latency_avg_us": round(self._sum_us / self.completed, 1)
-                if self.completed else 0.0,
+                # rejects complete without a latency observation: the
+                # average divides by the observed completions only
+                "latency_avg_us": round(
+                    self._sum_us / (self.completed - self.rejects), 1)
+                if self.completed > self.rejects else 0.0,
                 "max_latency_us": self._max_us,
             }
             for cls, n in self.errors.items():
@@ -396,6 +438,9 @@ def attempt_error(channel: str, cntl, code: int, ep=None) -> None:
         else:
             reg.unattributed += 1
         return
+    if code in REJECT_CODES:
+        rec[2].on_reject(code)
+        return
     lat_us = (time.monotonic_ns() - rec[1]) / 1e3
     rec[2].on_feedback(lat_us, True, code)
 
@@ -426,9 +471,13 @@ def call_complete(cntl) -> None:
                 if r[0] == key:
                     winner = r
                     break
-    lat_us = (time.monotonic_ns() - winner[1]) / 1e3
-    winner[2].on_feedback(lat_us, cntl.failed(), cntl.error_code,
-                          d.get("_bs_resp_bytes", 0))
+    code = cntl.error_code
+    if is_reject(code, cntl.responded_server):
+        winner[2].on_reject(code, d.get("_bs_resp_bytes", 0))
+    else:
+        lat_us = (time.monotonic_ns() - winner[1]) / 1e3
+        winner[2].on_feedback(lat_us, cntl.failed(), code,
+                              d.get("_bs_resp_bytes", 0))
     if len(recs) > 1:
         for rec in recs:
             if rec is not winner:
@@ -488,16 +537,20 @@ def backends_page_payload(samples: int = 256) -> dict:
     reg = global_stats()
     channels: Dict[str, dict] = {}
     totals = {"attempts": 0, "completed": 0, "errors": 0, "inflight": 0,
-              "abandoned": 0, "connect_errors": 0}
+              "abandoned": 0, "connect_errors": 0, "rejects": 0}
     for (ch_name, backend), cell in reg.rows():
         entry = channels.get(ch_name)
         if entry is None:
             owner = reg.channel_owner(ch_name)
+            rb = getattr(owner, "_retry_budget", None)
             entry = channels[ch_name] = {
                 "lb": getattr(owner, "lb_name", None)
                 if owner is not None else None,
                 "naming": owner.naming_info()
                 if hasattr(owner, "naming_info") else None,
+                # the channel's retry token bucket (retry_tokens et al);
+                # None = no budget configured
+                "retry_budget": rb.snapshot() if rb is not None else None,
                 "backends": {},
             }
         row = cell.get_value()

@@ -81,6 +81,22 @@ _client_fdr = None   # lazily built; False = extension unavailable
 # propagation: an attempt that cannot complete is never launched
 nretry_suppressed = Adder().expose("retry_suppressed_budget")
 
+# retries/hedges suppressed because the channel's retry token bucket
+# ran dry (RetryBudget — overload must not be amplified) — /vars
+nretry_throttled = Adder().expose("retry_throttled")
+
+# hedges not armed because the remaining deadline budget sat under the
+# fastest backend's recent p50 (a hedge that cannot win is pure load;
+# Dean & Barroso, The Tail at Scale) — /vars
+nhedge_suppressed = Adder().expose("hedge_suppressed_budget")
+
+# failure codes that never drain the retry token bucket: overload
+# REJECTS cost the server microseconds at the door (see _maybe_retry),
+# and a naming-empty fail-fast burns nothing anywhere — draining on it
+# would leave the channel throttled long after the naming url is fixed
+# (NamingEmptyError's stated contract)
+_NO_DRAIN_CODES = frozenset(_bs.REJECT_CODES) | {berr.ENAMINGEMPTY}
+
 _csc = None   # lazily bound server_dispatch.current_serving_controller
 
 
@@ -128,6 +144,18 @@ class ChannelOptions:
     # errors retry, semantic errors don't). Consulted for every failed
     # attempt while tries remain — including server-returned errors.
     retry_policy: Optional[Any] = None
+    # per-channel retry token bucket (retry_policy.RetryBudget — the
+    # gRPC retryThrottling shape): failed attempts drain, successes
+    # slowly refill, and an empty bucket suppresses retries AND hedges
+    # (`retry_throttled` bvar) so a cluster brown-out cannot be
+    # amplified into an outage by the retry storm. True = defaults
+    # (100 tokens, 0.1 refill), an instance = custom sizing, None = off.
+    retry_budget: Optional[Any] = None
+    # how long ClusterChannel's constructor waits for the naming
+    # service's first server-list update before giving up (calls then
+    # fail fast with ENAMINGEMPTY + the `naming_empty` bvar while the
+    # list stays empty)
+    naming_wait_s: float = 5.0
     # naming-service filter (naming_service_filter.h): callable
     # (EndPoint)->bool; servers it rejects never reach the load
     # balancer. Cluster channels only.
@@ -174,6 +202,12 @@ class Channel:
         # registration happens exactly once
         self._stats_name = self.options.name or self._default_stats_name()
         _bs.global_stats().register_channel(self._stats_name, self)
+        if self.options.retry_budget is not None:
+            from brpc_tpu.rpc.retry_policy import RetryBudget
+            self._retry_budget = RetryBudget.resolve(
+                self.options.retry_budget)
+        else:
+            self._retry_budget = None
         self._control = control or global_control()
         self._messenger = InputMessenger(control=self._control)
         self._socket: Optional[Socket] = None
@@ -575,7 +609,11 @@ class Channel:
         try:
             sock = self._pick_socket(cntl)
         except (ConnectionError, OSError, ValueError) as e:
-            self._maybe_retry(cntl, berr.EFAILEDSOCKET, str(e))
+            # a selection failure with its own errno (naming-empty)
+            # fails fast under that code; plain connect/pick failures
+            # stay EFAILEDSOCKET (retry-elsewhere)
+            self._maybe_retry(cntl, getattr(e, "berrno",
+                                            berr.EFAILEDSOCKET), str(e))
             return
         cntl.remote_side = sock.remote_endpoint
         cntl.local_side = sock.local_endpoint
@@ -767,6 +805,13 @@ class Channel:
             # stands if it wins the take below
             allow = False
             nretry_suppressed.add(1)
+        rb = self._retry_budget
+        if allow and rb is not None and rb.throttled():
+            # empty token bucket: the cluster is browning out and this
+            # channel's retries would amplify it — the attempt's error
+            # stands (gRPC retryThrottling / Tail-at-Scale discipline)
+            allow = False
+            nretry_throttled.add(1)
         with cntl._arb_lock:
             if address_call(cid) is not cntl:
                 return
@@ -781,6 +826,18 @@ class Channel:
                 cntl.current_try += 1
             else:
                 taken = take_call(cid) is cntl
+        if rb is not None and code not in _NO_DRAIN_CODES:
+            # drain AFTER the latch: the same dead socket surfaces
+            # through two failure paths, and only the one that won the
+            # latch may spend a token (a double drain per failure would
+            # halve the budget's real capacity). Overload REJECTS never
+            # drain: a shed costs the server microseconds at the door
+            # (DAGOR: shed early, shed cheaply) and the shedding node
+            # is already protecting itself — spending retry tokens on
+            # them would throttle the retries-elsewhere that keep
+            # goodput flat while one node sheds. The bucket guards
+            # against EXPENSIVE failures: dead conns, timeouts.
+            rb.drain()
         if allow:
             # report the failed attempt before moving on (the final
             # attempt is reported by the completion hook instead)
@@ -877,6 +934,13 @@ class Channel:
         the NEW id and completes the call with ERPCTIMEDOUT. Pass the
         policy verdict via ``allow`` (computed BEFORE the lock) so user
         policy code never runs on the timer thread's critical path."""
+        rb = self._retry_budget
+        if rb is not None and not _bs.is_reject(code, True):
+            # a server-returned error IS a failed attempt — except the
+            # reject class, which is a µs-cheap shed (see _maybe_retry).
+            # This path only runs for RESPONDED errors, so ERPCTIMEDOUT
+            # here is the server's own deadline shed: a reject too.
+            rb.drain()
         if allow is None:
             allow = self._policy_allows(cntl, code, text)
         if cntl.current_try >= cntl.max_retry or not allow:
@@ -884,6 +948,9 @@ class Channel:
         if self._budget_exhausted(cntl):
             # same clamp as _maybe_retry: no budget, no new attempt
             nretry_suppressed.add(1)
+            return False
+        if rb is not None and rb.throttled():
+            nretry_throttled.add(1)
             return False
         cntl.current_try += 1
         self._on_attempt_failed(cntl, code, text, failed_ep)
@@ -921,6 +988,17 @@ class Channel:
                                 cntl._method_name, seq,
                                 self._bs_cell(sock.remote_endpoint)[0],
                                 backup=cntl.used_backup)
+        if cntl.used_backup:
+            dec = cntl.__dict__.get("_hedge_decision")
+            if dec is not None:
+                # greppable arming evidence: remaining deadline budget
+                # vs the p50 bar at decision time (the fabric storm's
+                # "no hedge past budget" assert reads these)
+                r, p = dec
+                sp.annotate(
+                    "hedge_armed remaining_ms=%s p50_ms=%s"
+                    % ("inf" if r is None else round(r, 2),
+                       "na" if p is None else round(p, 2)))
         with cntl._arb_lock:
             cntl.__dict__.setdefault("_attempt_spans", []).append(sp)
 
@@ -975,9 +1053,34 @@ class Channel:
                             f"deadline {cntl.timeout_ms}ms exceeded")
             cntl._complete()
 
+    def _hedge_p50_ms(self) -> Optional[float]:
+        """The fastest backend's recent p50 (ms) among this channel's
+        stat cells — the hedge arming bar: when even the quickest
+        backend's median cannot fit inside the remaining budget, the
+        hedge is pure load on a cluster that is already slow. None =
+        no telemetry yet (stats disabled / no completed calls);
+        hedging then falls back to deadline-only gating."""
+        cells = self.__dict__.get("_bs_cells")
+        if not cells:
+            return None
+        best = None
+        for _key, cell in cells.values():
+            p = cell.recent_p50_us()
+            if p > 0.0 and (best is None or p < best):
+                best = p
+        return None if best is None else best / 1e3
+
     def _on_backup_timer(self, cntl: Controller) -> None:
         """Send a duplicate request; first response wins
-        (backup_request_ms, controller.cpp:331)."""
+        (backup_request_ms, controller.cpp:331). Budget-aware arming
+        (The Tail at Scale: hedged requests must never amplify
+        overload): the hedge is suppressed when the retry token bucket
+        is dry, and never armed when the remaining deadline sits under
+        the fastest backend's recent p50 — a hedge that cannot finish
+        in time is a guaranteed-wasted request. On first win the loser
+        is cancelled client-side: its pending timers unschedule at
+        completion, its LB selection and stat-cell record are swept as
+        abandoned, and its attempt span closes with the verdict."""
         if address_call(cntl.correlation_id) is not cntl:
             return
         if self._budget_exhausted(cntl):
@@ -985,7 +1088,23 @@ class Channel:
             # timeout completion is already due (or racing this timer)
             nretry_suppressed.add(1)
             return
+        rb = self._retry_budget
+        if rb is not None and rb.throttled():
+            # hedges amplify load exactly like retries: same bucket
+            nretry_throttled.add(1)
+            return
+        dl = cntl.__dict__.get("_deadline_ns")
+        remaining_ms = None if dl is None \
+            else (dl - time.monotonic_ns()) / 1e6
+        p50_ms = self._hedge_p50_ms()
+        if remaining_ms is not None and p50_ms is not None \
+                and remaining_ms < p50_ms:
+            nhedge_suppressed.add(1)
+            return
         cntl.used_backup = True
+        # the arming evidence rides the attempt span (fabric storm
+        # asserts no hedge was ever armed past budget from /rpcz)
+        cntl.__dict__["_hedge_decision"] = (remaining_ms, p50_ms)
         self._issue_rpc(cntl)
 
 

@@ -13,6 +13,7 @@ import threading
 from typing import Dict, Optional
 
 from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.bvar.reducer import Adder
 from brpc_tpu.fiber import TaskControl
 from brpc_tpu.rpc import backend_stats as _bs
 from brpc_tpu.rpc import errno_codes as berr
@@ -23,6 +24,20 @@ from brpc_tpu.rpc.health_check import HealthChecker
 from brpc_tpu.rpc.load_balancer import LoadBalancer, new_load_balancer
 from brpc_tpu.rpc.naming import NamingServiceThread
 from brpc_tpu.transport.socket import Socket, create_client_socket
+
+# calls failed fast because the naming service has delivered no
+# servers (never resolved, or resolved to an empty list) — /vars
+nnaming_empty = Adder().expose("naming_empty")
+
+
+class NamingEmptyError(ConnectionError):
+    """Selection failed because the server list is EMPTY (not because
+    every server is excluded): carries its own errno so callers see
+    ENAMINGEMPTY instead of a generic EFAILEDSOCKET pick failure —
+    a misconfigured naming url fails fast and greppably, it does not
+    burn the retry budget against nothing."""
+
+    berrno = berr.ENAMINGEMPTY
 
 
 class ClusterChannel(Channel):
@@ -50,7 +65,7 @@ class ClusterChannel(Channel):
             on_event=self._on_health_event)
         self._ns = NamingServiceThread(naming_url, control=self._control)
         self._ns.watch(self._on_servers)
-        self._ns.wait_first_update(5.0)
+        self._ns.wait_first_update(self.options.naming_wait_s)
 
     # ------------------------------------------------------------- naming
     def _on_servers(self, servers):
@@ -71,7 +86,15 @@ class ClusterChannel(Channel):
     def _on_health_event(self, event: str, ep) -> None:
         """Health-checker transitions land in the decision ring: a
         'dead' event explains why later selects exclude the backend, a
-        'revived' one why it reappears."""
+        'revived' one why it reappears — and tells the balancer to
+        reset the endpoint's adaptive state (a node that died with a
+        penalty-saturated latency estimate must not return at ~zero
+        weight)."""
+        if event == "revived":
+            try:
+                self._lb.revive(ep)
+            except Exception:
+                pass
         _bs.ring_event(self._stats_name, "health", event=event,
                        endpoint=_bs.ep_key(ep))
 
@@ -124,6 +147,19 @@ class ClusterChannel(Channel):
 
     # ----------------------------------------------------------- selection
     def _pick_socket(self, cntl: Controller) -> Socket:
+        if not self._servers:
+            # empty server list is its own failure mode: either the
+            # naming service never resolved (revision 0 — bad url,
+            # dead registry) or it resolved to nothing. Fail fast with
+            # a distinct errno instead of a generic pick failure that
+            # looks like N dead backends.
+            nnaming_empty.add(1)
+            rev = self._ns.revision()
+            why = ("never delivered a server list"
+                   if rev == 0 else f"delivered an empty list "
+                   f"(revision {rev})")
+            raise NamingEmptyError(
+                f"naming {self._naming_url!r} {why}")
         tried = set(cntl.tried_servers)
         isolated = self._breakers.isolated_set(self._servers)
         dead = self._health.dead_set()
@@ -132,9 +168,18 @@ class ClusterChannel(Channel):
         ep = self._lb.select_server(exclude or None, request_key=key)
         fallback = False
         if ep is None and exclude:
-            # every server excluded: last resort, try anyone the LB knows
+            # every server excluded: last resort — but staged. Drop the
+            # per-call exclusions (tried/breaker) FIRST while still
+            # avoiding known-dead backends: a retry-exhausted call that
+            # roulettes onto a dead node is a guaranteed failure, and
+            # probing the dead is the health checker's job, not a live
+            # request's. Only when every backend is dead (full outage)
+            # does the probe-anyone gate open.
             fallback = True
-            ep = self._lb.select_server(None, request_key=key)
+            if dead:
+                ep = self._lb.select_server(dead, request_key=key)
+            if ep is None:
+                ep = self._lb.select_server(None, request_key=key)
         if _bs.enabled():
             # the decision ring records WHY: the chosen backend, what
             # was excluded and for which reason, and (for weighted
@@ -190,19 +235,34 @@ class ClusterChannel(Channel):
         from brpc_tpu.rpc.channel import connect_dedup
 
         def _make():
-            s = create_client_socket(ep, on_input=self._messenger.on_new_messages,
-                                     control=self._control)
-            from brpc_tpu.rpc.channel import client_fast_drain_hook
-            s.fast_drain = client_fast_drain_hook(self.options)
-            s.on_failed(lambda sock, ep=ep: self._on_socket_failed(ep))
-            self._label_socket(s, ep)
-            return s
+            try:
+                s = create_client_socket(
+                    ep, on_input=self._messenger.on_new_messages,
+                    control=self._control)
+            except (ConnectionError, OSError):
+                # a refused/unreachable CONNECT is the dead-node signal
+                # for endpoints that never produced a Socket —
+                # established sockets report through on_failed below,
+                # but a killed node's fresh connects fail HERE, and
+                # without this mark the LB keeps selecting it (each
+                # hedged call's retries then burn against a node the
+                # health checker was never told about)
+                self._health.mark_dead(ep)
+                raise
+            return self._wire_socket(s, ep)
 
         def _write(s):
             self._sockets[ep] = s
 
         return connect_dedup(self._sockets_lock,
                              lambda: self._sockets.get(ep), _write, _make)
+
+    def _wire_socket(self, s: Socket, ep: EndPoint) -> Socket:
+        from brpc_tpu.rpc.channel import client_fast_drain_hook
+        s.fast_drain = client_fast_drain_hook(self.options)
+        s.on_failed(lambda sock, ep=ep: self._on_socket_failed(ep))
+        self._label_socket(s, ep)
+        return s
 
     def _on_socket_failed(self, ep: EndPoint):
         self._health.mark_dead(ep)
@@ -233,12 +293,22 @@ class ClusterChannel(Channel):
         super()._on_attempt_failed(cntl, code, text, ep)
         if ep is None:
             return
-        self._lb.feedback(ep, cntl.latency_us(), True)
-        self._breakers.on_call(ep, failed=True)
+        if code in _bs.REJECT_CODES:
+            # overload shed: failure-without-latency — the slot
+            # returns, the reject is counted, but neither the LALB
+            # latency EWMA (error penalty) nor the circuit breaker
+            # hears about it: a node protecting itself by shedding is
+            # NOT broken, and isolating it would dogpile the rest
+            self._lb.feedback_reject(ep)
+        else:
+            self._lb.feedback(ep, cntl.latency_us(), True)
+            self._breakers.on_call(ep, failed=True)
         if _bs.enabled():
             _bs.ring_event(self._stats_name, "feedback",
                            ring=self._bs_ring(),
-                           endpoint=self._bs_cell(ep)[0], failed=True,
+                           endpoint=self._bs_cell(ep)[0],
+                           failed=("reject" if code in _bs.REJECT_CODES
+                                   else True),
                            code=code)
 
     def _on_call_complete(self, cntl: Controller):
@@ -274,15 +344,28 @@ class ClusterChannel(Channel):
                                        endpoint=_bs.ep_key(s),
                                        why="canceled")
             return
-        failed = cntl.failed() and cntl.error_code != berr.ERPCTIMEDOUT
-        self._lb.feedback(ep, cntl.latency_us(), cntl.failed())
-        self._breakers.on_call(ep, failed)
-        if _bs.enabled():
-            _bs.ring_event(self._stats_name, "feedback",
-                           ring=self._bs_ring(),
-                           endpoint=self._bs_cell(ep)[0],
-                           failed=cntl.failed(), code=cntl.error_code,
-                           latency_us=cntl.latency_us(), final=True)
+        code = cntl.error_code
+        if _bs.is_reject(code, cntl.responded_server):
+            # the call's VERDICT is an overload shed (ELIMIT, write
+            # overcrowding, or a server-responded deadline shed): same
+            # reject discipline as the intermediate-attempt path —
+            # slot back, no latency sample, breaker untouched
+            self._lb.feedback_reject(ep)
+            if _bs.enabled():
+                _bs.ring_event(self._stats_name, "feedback",
+                               ring=self._bs_ring(),
+                               endpoint=self._bs_cell(ep)[0],
+                               failed="reject", code=code, final=True)
+        else:
+            failed = cntl.failed() and code != berr.ERPCTIMEDOUT
+            self._lb.feedback(ep, cntl.latency_us(), cntl.failed())
+            self._breakers.on_call(ep, failed)
+            if _bs.enabled():
+                _bs.ring_event(self._stats_name, "feedback",
+                               ring=self._bs_ring(),
+                               endpoint=self._bs_cell(ep)[0],
+                               failed=cntl.failed(), code=code,
+                               latency_us=cntl.latency_us(), final=True)
         # every selection must be matched by exactly one feedback or
         # abandon: attempts that never produced an observation (a backup
         # request that lost the race) return their inflight slot, or an

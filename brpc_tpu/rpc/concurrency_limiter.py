@@ -4,6 +4,13 @@ policy/auto_concurrency_limiter.cpp).
 ``constant``: fixed max in-flight. ``auto``: gradient/Vegas-style — track
 the best observed latency; if current latency inflates, shrink the limit,
 else grow it (the reference's AutoConcurrencyLimiter in miniature).
+``timeout``: admit only while expected queueing delay fits the budget.
+
+The Server drives whichever limiter its ``max_concurrency`` spec names
+from BOTH dispatch paths (classic process_request and the turbo
+process_request_fast lane) through ``on_requested``/``on_responded`` —
+see Server.on_request_start/on_request_end; per-method limits ride
+``ServerOptions.method_max_concurrency``.
 """
 
 from __future__ import annotations
@@ -41,7 +48,8 @@ class ConstantLimiter(ConcurrencyLimiter):
 
     def on_responded(self, latency_us, failed):
         with self._lock:
-            self._inflight -= 1
+            if self._inflight > 0:
+                self._inflight -= 1
 
     @property
     def max_concurrency(self):
@@ -49,20 +57,41 @@ class ConstantLimiter(ConcurrencyLimiter):
 
 
 class AutoLimiter(ConcurrencyLimiter):
-    MIN_LIMIT = 4
+    """Vegas/gradient adaptive limit. Two hardenings over the naive
+    sample-count window (both found by driving it from the server's
+    dispatch paths under fault):
+
+    * windows close on TIME as well as count — a shrunken limit under
+      light traffic would otherwise never collect SAMPLE_WINDOW
+      observations again and stay pinned small forever;
+    * failed responses release the in-flight slot but feed no latency
+      (an ELIMIT/exception corpse's near-zero latency would drag the
+      average DOWN exactly while the node is sick, growing the limit
+      into the overload).
+    """
+
+    MIN_LIMIT = 2
     MAX_LIMIT = 4096
     SAMPLE_WINDOW = 100
+    MIN_WINDOW_SAMPLES = 8      # time-closed windows need this many
+    WINDOW_S = 1.0
     INFLATE_TOLERANCE = 1.5     # latency may inflate this much before shrink
     GROW = 1.1
     SHRINK = 0.8
 
-    def __init__(self, initial: int = 32):
-        self._limit = float(initial)
+    def __init__(self, initial: int = 32, min_concurrency: int = 4,
+                 max_concurrency: int = 4096):
+        self.min_concurrency = max(self.MIN_LIMIT, int(min_concurrency))
+        self.max_limit = min(self.MAX_LIMIT, max(int(max_concurrency),
+                                                 self.min_concurrency))
+        self._limit = float(min(max(initial, self.min_concurrency),
+                                self.max_limit))
         self._inflight = 0
         self._lock = threading.Lock()
         self._best_latency = float("inf")
         self._lat_sum = 0.0
         self._lat_n = 0
+        self._win_start = time.monotonic()
 
     def on_requested(self) -> bool:
         with self._lock:
@@ -73,23 +102,35 @@ class AutoLimiter(ConcurrencyLimiter):
 
     def on_responded(self, latency_us, failed):
         with self._lock:
-            self._inflight -= 1
+            if self._inflight > 0:
+                self._inflight -= 1
             if failed:
                 return
             self._lat_sum += latency_us
             self._lat_n += 1
             if self._lat_n < self.SAMPLE_WINDOW:
-                return
-            avg = self._lat_sum / self._lat_n
-            self._lat_sum = 0.0
-            self._lat_n = 0
-            self._best_latency = min(self._best_latency, avg)
-            if avg > self._best_latency * self.INFLATE_TOLERANCE:
-                self._limit = max(self.MIN_LIMIT, self._limit * self.SHRINK)
-                # forgive the past: latency regimes change
-                self._best_latency = min(avg, self._best_latency * 1.1)
-            else:
-                self._limit = min(self.MAX_LIMIT, self._limit * self.GROW)
+                now = time.monotonic()
+                if (now - self._win_start < self.WINDOW_S
+                        or self._lat_n < self.MIN_WINDOW_SAMPLES):
+                    return
+            self._close_window_locked()
+
+    def _close_window_locked(self) -> None:
+        avg = self._lat_sum / self._lat_n
+        self._lat_sum = 0.0
+        self._lat_n = 0
+        self._win_start = time.monotonic()
+        self._best_latency = min(self._best_latency, avg)
+        if avg > self._best_latency * self.INFLATE_TOLERANCE:
+            self._limit = max(self.min_concurrency, self._limit * self.SHRINK)
+            # forgive the past: latency regimes change
+            self._best_latency = min(avg, self._best_latency * 1.1)
+        else:
+            self._limit = min(self.max_limit, self._limit * self.GROW)
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
 
     @property
     def max_concurrency(self):
@@ -97,15 +138,29 @@ class AutoLimiter(ConcurrencyLimiter):
 
 
 def new_limiter(spec) -> Optional[ConcurrencyLimiter]:
-    """spec: None | int | 'constant:N' | 'auto' | 'timeout:MS'
-    (AdaptiveMaxConcurrency)."""
+    """spec: None | int | 'constant:N' | 'auto[:initial[:min[:max]]]'
+    | 'timeout:MS' (the AdaptiveMaxConcurrency vocabulary —
+    ``-max_concurrency auto`` in the reference)."""
     if spec is None:
         return None
     if isinstance(spec, int):
         return ConstantLimiter(spec)
+    # NOTE deliberately no limiter-INSTANCE passthrough: the Server
+    # re-runs this parser in its postfork re-arm so a forked shard
+    # gets fresh inflight counts and locks — a shared instance would
+    # hand every shard the parent's mid-flight state (leaked admission
+    # slots, possibly a lock held at fork time)
     if isinstance(spec, str):
-        if spec == "auto":
-            return AutoLimiter()
+        if spec == "auto" or spec.startswith("auto:"):
+            args = [int(p) for p in spec.split(":")[1:]]
+            kw = {}
+            if len(args) >= 1:
+                kw["initial"] = args[0]
+            if len(args) >= 2:
+                kw["min_concurrency"] = args[1]
+            if len(args) >= 3:
+                kw["max_concurrency"] = args[2]
+            return AutoLimiter(**kw)
         if spec.startswith("constant:"):
             return ConstantLimiter(int(spec.split(":", 1)[1]))
         if spec.startswith("timeout:"):
@@ -148,7 +203,8 @@ class TimeoutLimiter(ConcurrencyLimiter):
         # A timeout corpse's latency (~the timeout) pushes the estimate
         # up; recovery pulls it back down through later successes.
         with self._lock:
-            self._inflight -= 1
+            if self._inflight > 0:
+                self._inflight -= 1
             if latency_us > 0:
                 if self._ema_us == 0:
                     self._ema_us = latency_us

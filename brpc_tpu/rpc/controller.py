@@ -329,6 +329,7 @@ class Controller:
         d.pop("response_device_arrays", None)
         d.pop("responded_server", None)
         d.pop("used_backup", None)
+        d.pop("_hedge_decision", None)     # previous call's hedge arming
         d.pop("stream", None)     # a previous call's stream must not
         #                           resurface on the new call's response
         hooks = d.get("_complete_hooks")
@@ -418,6 +419,25 @@ class Controller:
         with self._arb_lock:
             self._completed = True
         self.end_us = time.monotonic_ns() // 1000
+        # retry-budget accounting — here because _complete is the ONE
+        # point every client completion flavor passes through: every
+        # successful call slowly re-earns tokens, and a CLIENT-LOCAL
+        # timeout (no responder: the deadline timer or the sync-pluck
+        # joiner fired) drains one — a stalled cluster whose sockets
+        # stay alive produces exactly these, and without the drain the
+        # bucket would stay pinned at capacity while hedges pile load
+        # onto the stall. Other failures drained in the channel's
+        # failure paths already; a server-RESPONDED deadline shed is a
+        # reject (responded_server set) and costs nothing.
+        ch = d.get("_owner_channel")
+        if ch is not None:
+            rb = ch._retry_budget
+            if rb is not None:
+                if self.error_code == 0:
+                    rb.refill()
+                elif self.error_code == berr.ERPCTIMEDOUT \
+                        and d.get("responded_server") is None:
+                    rb.drain()
         # __dict__ peeks: lazily-created members that were never touched
         # need no completion work — don't materialize them just to find
         # them empty (this runs once per call)

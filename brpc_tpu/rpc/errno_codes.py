@@ -18,6 +18,9 @@ ELOGOFF = 2003          # server is stopping
 ELIMIT = 2004           # concurrency limiter rejected
 ECLOSE = 2005           # connection closed by peer
 ECANCELED = 2006        # call canceled
+ENAMINGEMPTY = 2007     # naming service resolved no servers (cluster
+#                         channel fails fast instead of a generic pick
+#                         failure — see /vars naming_empty)
 
 _NAMES = {v: k for k, v in list(globals().items()) if isinstance(v, int)}
 

@@ -50,6 +50,25 @@ class LoadBalancer:
         balancers must return the slot without polluting their stats."""
         pass
 
+    def revive(self, server: EndPoint) -> None:
+        """The health checker revived this endpoint: balancers holding
+        adaptive per-server state may reset it to a probe-friendly
+        value (a node that died with a penalty-saturated estimate
+        would otherwise return at ~zero weight and never earn the
+        feedback that proves it healthy again)."""
+        pass
+
+    def feedback_reject(self, server: EndPoint) -> None:
+        """The server SHED this attempt (ELIMIT / queue-delay shed /
+        write overcrowding) — failure-without-latency: the slot
+        returns and the reject is counted, but the microsecond reject
+        round-trip must not enter the latency estimate (a shedding
+        node would look FAST) and the overload must not be penalized
+        like breakage (the error-penalty EWMA kick that isolates
+        actually-broken nodes). Default: indistinguishable from
+        abandon for balancers with no reject-aware state."""
+        self.abandon(server)
+
     def decision_info(self, server: EndPoint) -> Optional[dict]:
         """Optional per-server decision factors for the LB trace ring
         (/lb_trace): balancers that weigh servers (la) report WHY this
@@ -274,12 +293,19 @@ class LocalityAwareLB(_SnapshotLB):
     ALPHA = 0.2
     DEFAULT_LAT_US = 1000.0
     ERROR_PENALTY_US = 1e6
+    # penalty ceiling: cur*10 per failure compounds, and a node that
+    # dies under sustained traffic would ride the exponential to
+    # float-inf — weight exactly 0.0, which makes the whole cluster
+    # unselectable in the all-excluded fallback during a full outage
+    # and leaves the node at zero weight forever after revival
+    MAX_PENALTY_US = 6e7
 
     def __init__(self):
         super().__init__()
         self._lock = threading.Lock()
         self._lat: Dict[EndPoint, float] = {}
         self._inflight: Dict[EndPoint, int] = {}
+        self._rejects: Dict[EndPoint, int] = {}   # overload sheds seen
         self._tree: Optional[_Fenwick] = None
         self._order: list = []          # index -> server
         self._index: Dict[EndPoint, int] = {}
@@ -295,6 +321,8 @@ class LocalityAwareLB(_SnapshotLB):
             self._lat = {s: v for s, v in self._lat.items() if s in keep}
             self._inflight = {s: v for s, v in self._inflight.items()
                               if s in keep}
+            self._rejects = {s: v for s, v in self._rejects.items()
+                             if s in keep}
             self._order = list(snapshot)
             self._index = {s: i for i, s in enumerate(self._order)}
             self._tree = _Fenwick(len(self._order)) if self._order else None
@@ -309,9 +337,44 @@ class LocalityAwareLB(_SnapshotLB):
             lat = self._lat.get(server)
             if lat is None:
                 return None
-            return {"weight": round(self._weight(server), 3),
+            info = {"weight": round(self._weight(server), 3),
                     "lat_ewma_us": round(lat, 1),
                     "inflight": self._inflight.get(server, 0)}
+            nrej = self._rejects.get(server, 0)
+            if nrej:
+                info["rejects"] = nrej
+            return info
+
+    def revive(self, server):
+        """Back from the dead: restart the latency estimate at the
+        cluster's best observed latency (the same optimistic probe
+        weight new servers get in _on_reset) — the penalty-saturated
+        EWMA the node died with would otherwise keep its weight near
+        zero, starving it of the very feedback that could clear it."""
+        with self._lock:
+            if server not in self._index:
+                return
+            best = min((v for s, v in self._lat.items() if s != server),
+                       default=self.DEFAULT_LAT_US)
+            self._lat[server] = min(best, self.MAX_PENALTY_US)
+            if self._tree is not None:
+                self._tree.set(self._index[server],
+                               self._weight(server))
+
+    def feedback_reject(self, server):
+        """Overload shed: return the in-flight slot and count the
+        reject, but leave the latency EWMA alone — the distinction the
+        overload-control loop depends on (a shedding node stops being
+        selected because its inflight stays high relative to the calls
+        it actually answers, not because it looks broken)."""
+        with self._lock:
+            inf = self._inflight.get(server, 0)
+            if inf > 0:
+                self._inflight[server] = inf - 1
+            self._rejects[server] = self._rejects.get(server, 0) + 1
+            i = self._index.get(server)
+            if i is not None and self._tree is not None:
+                self._tree.set(i, self._weight(server))
 
     def abandon(self, server):
         with self._lock:
@@ -329,7 +392,8 @@ class LocalityAwareLB(_SnapshotLB):
                 self._inflight[server] = inf - 1
             cur = self._lat.get(server, self.DEFAULT_LAT_US)
             sample = (latency_us if not failed
-                      else max(cur * 10, self.ERROR_PENALTY_US))
+                      else min(self.MAX_PENALTY_US,
+                               max(cur * 10, self.ERROR_PENALTY_US)))
             self._lat[server] = (1 - self.ALPHA) * cur + self.ALPHA * sample
             i = self._index.get(server)
             if i is not None and self._tree is not None:

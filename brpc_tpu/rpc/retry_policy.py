@@ -12,6 +12,8 @@ never client-fatal ones (bad request, auth, deadline).
 from __future__ import annotations
 
 import random
+import threading
+import weakref
 
 from brpc_tpu.rpc import errno_codes as berr
 
@@ -87,6 +89,100 @@ class RetryBackoffPolicy(RpcRetryPolicy):
         return b / 1e3
 
 
+class RetryBudget:
+    """Per-channel retry token bucket (the gRPC retryThrottling shape,
+    via *The Tail at Scale*'s rule that hedges/retries must never
+    amplify overload): every failed attempt drains one token, every
+    successful call slowly refills ``token_ratio``, and while the
+    bucket sits at or below half its capacity the channel suppresses
+    retries AND hedges (``retry_throttled`` bvar). Under a cluster
+    brown-out the buckets of every client drain within the first few
+    dozen failures, so the cluster sees ~1x the offered load instead
+    of (1 + max_retry)x — the retry storm that turns a brown-out into
+    an outage never forms. Healthy traffic keeps the bucket pinned at
+    capacity; an isolated failure burst (one node dying) spends a few
+    tokens and retries normally.
+
+    Opt in per channel with ``ChannelOptions(retry_budget=True)`` (or
+    an instance for custom sizing)."""
+
+    def __init__(self, max_tokens: float = 100.0,
+                 token_ratio: float = 0.1):
+        if max_tokens <= 0:
+            raise ValueError("max_tokens must be > 0")
+        self._max = float(max_tokens)
+        self._tokens = self._max
+        self._ratio = float(token_ratio)
+        self._threshold = self._max / 2.0
+        self._lock = threading.Lock()
+        _budgets.add(self)
+        _ensure_tokens_var()
+
+    def drain(self) -> None:
+        with self._lock:
+            self._tokens = max(0.0, self._tokens - 1.0)
+
+    def refill(self) -> None:
+        with self._lock:
+            if self._tokens < self._max:
+                self._tokens = min(self._max, self._tokens + self._ratio)
+
+    def throttled(self) -> bool:
+        """True while the bucket is at/below half capacity: the channel
+        must not launch retries or arm hedges."""
+        with self._lock:
+            return self._tokens <= self._threshold
+
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"tokens": round(self._tokens, 2),
+                    "max_tokens": self._max,
+                    "token_ratio": self._ratio,
+                    "throttled": self._tokens <= self._threshold}
+
+    @staticmethod
+    def resolve(spec) -> "RetryBudget | None":
+        """ChannelOptions.retry_budget: None/False = off, True =
+        defaults, an instance = itself."""
+        if spec is None or spec is False:
+            return None
+        if spec is True:
+            return RetryBudget()
+        if isinstance(spec, RetryBudget):
+            return spec
+        raise TypeError(f"not a retry budget: {spec!r}")
+
+
+# live budgets, weakly held — the /status saturation pane and the
+# merged shard views report the process's MOST DRAINED bucket
+# (retry_tokens: min across channels; a healthy fleet pins at max)
+_budgets: "weakref.WeakSet[RetryBudget]" = weakref.WeakSet()
+_tokens_var_exposed = False
+
+
+def min_retry_tokens():
+    """Lowest token count across live budgets; None when no channel
+    opted into a budget."""
+    vals = [b.tokens() for b in list(_budgets)]
+    return round(min(vals), 2) if vals else None
+
+
+def _ensure_tokens_var() -> None:
+    """Expose the retry_tokens_min gauge once the first budget exists
+    (a process with no budgets should not dump a meaningless -1)."""
+    global _tokens_var_exposed
+    if _tokens_var_exposed:
+        return
+    _tokens_var_exposed = True
+    from brpc_tpu.bvar.reducer import PassiveStatus
+    PassiveStatus(lambda: (lambda v: -1.0 if v is None else v)(
+        min_retry_tokens())).expose("retry_tokens_min")
+
+
 _default: RetryPolicy | None = None
 
 
@@ -100,9 +196,13 @@ def default_retry_policy() -> RetryPolicy:
 def _postfork_reset() -> None:
     """Fork hygiene: a seeded backoff policy's RNG would emit the SAME
     jitter sequence in every forked worker — jitter exists to
-    desynchronize; a fresh default re-seeds per process."""
-    global _default
+    desynchronize; a fresh default re-seeds per process. The budget
+    registry drops too: the parent's channel buckets describe traffic
+    on sockets the child does not own."""
+    global _default, _budgets, _tokens_var_exposed
     _default = None
+    _budgets = weakref.WeakSet()
+    _tokens_var_exposed = False
 
 
 from brpc_tpu.butil import postfork as _postfork  # noqa: E402

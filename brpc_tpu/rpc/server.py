@@ -14,12 +14,59 @@ import weakref
 from typing import Dict, List, Optional
 
 from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+from brpc_tpu.butil.flags import define_flag, flag
 from brpc_tpu.bvar.latency_recorder import LatencyRecorder
 from brpc_tpu.fiber import TaskControl, global_control
 from brpc_tpu.rpc.service import Method, Service
 from brpc_tpu.transport.base import get_transport
 from brpc_tpu.transport.input_messenger import InputMessenger
 from brpc_tpu.transport.socket import Socket
+
+define_flag("server_queue_shed_ms", 200.0,
+            "queue-delay shed budget: a request whose arrival-to-"
+            "dispatch time exceeds this is rejected with ELIMIT before "
+            "the handler runs (default gate for adaptive-limiter "
+            "servers; ServerOptions.queue_delay_shed_ms overrides "
+            "per server)", validator=lambda v: v > 0)
+
+_nlimit_shed = None   # lazily bound server_dispatch.nlimit_shed (the
+#                       Adder lives with the other dispatch counters;
+#                       importing it at module top would be a cycle for
+#                       nothing — the reject path is cold)
+
+
+def _count_limit_shed() -> None:
+    global _nlimit_shed
+    v = _nlimit_shed
+    if v is None:
+        from brpc_tpu.rpc.server_dispatch import nlimit_shed
+        v = _nlimit_shed = nlimit_shed
+    v.add(1)
+
+
+# the last-started server, weakly held: the process-wide
+# server_concurrency_limit/_inflight gauges read through it (multiple
+# servers in one process: the newest wins, like the other server vars)
+_limiter_var_server = None
+
+
+def _expose_limiter_vars(server) -> None:
+    global _limiter_var_server
+    _limiter_var_server = weakref.ref(server)
+    from brpc_tpu.bvar.reducer import PassiveStatus
+
+    def _read(attr_fn, default=0):
+        ref = _limiter_var_server
+        s = ref() if ref is not None else None
+        if s is None:
+            return default
+        return attr_fn(s)
+
+    PassiveStatus(lambda: _read(lambda s: s.concurrency_limit() or 0)) \
+        .expose("server_concurrency_limit")
+    PassiveStatus(lambda: _read(lambda s: s.concurrency)) \
+        .expose("server_concurrency_inflight")
+
 
 # process-wide graceful-SIGTERM state: weak so stopped/forgotten servers
 # don't linger, installed once so restart cycles don't chain handlers
@@ -57,7 +104,9 @@ def _install_sigterm_handler_once() -> None:
 
 class ServerOptions:
     def __init__(self, num_workers: Optional[int] = None,
-                 max_concurrency: Optional[int] = None,
+                 max_concurrency=None,
+                 method_max_concurrency: Optional[Dict[str, object]] = None,
+                 queue_delay_shed_ms: Optional[float] = None,
                  auth_token: Optional[str] = None,
                  auth=None, interceptor=None,
                  enable_builtin_services: bool = True,
@@ -69,7 +118,20 @@ class ServerOptions:
                  usercode_in_pthread: bool = False,
                  health_reporter=None):
         self.num_workers = num_workers
+        # server-wide in-flight cap: an int, or an adaptive spec string
+        # ('auto[:initial[:min[:max]]]' | 'constant:N' | 'timeout:MS' —
+        # the reference's -max_concurrency vocabulary); backed by a
+        # ConcurrencyLimiter driven from both dispatch paths
         self.max_concurrency = max_concurrency
+        # per-method caps: {"Service.Method": spec} — consulted after
+        # the server-wide limiter (rpc/concurrency_limiter.py)
+        self.method_max_concurrency = method_max_concurrency
+        # queue-delay shed gate (DAGOR-style overload control): requests
+        # whose arrival-to-dispatch time exceeds this budget are shed
+        # with ELIMIT before the handler runs. None = default ON (from
+        # the server_queue_shed_ms flag) when max_concurrency is an
+        # adaptive spec, OFF otherwise; a number forces it on.
+        self.queue_delay_shed_ms = queue_delay_shed_ms
         self.auth_token = auth_token
         # pluggable Authenticator (rpc/auth.py; brpc/authenticator.h) —
         # wins over auth_token, which is sugar for TokenAuthenticator
@@ -117,6 +179,7 @@ class Server:
         else:
             self.session_local_pool = None
         self._services: Dict[str, Service] = {}
+        self._build_limiters()
         self._listener = None
         self._endpoint: Optional[EndPoint] = None
         self._conns: List[Socket] = []
@@ -133,6 +196,30 @@ class Server:
         self._shard_group = None        # supervisor handle (num_shards>1)
         self.shard_index = None         # set in shard workers
         self._serving = None            # GenerateService handle (serving/)
+
+    def _build_limiters(self) -> None:
+        """Resolve the concurrency-limiter specs (construction and
+        postfork re-arm share this: a forked shard must not inherit the
+        parent limiter's inflight count or lock)."""
+        from brpc_tpu.rpc.concurrency_limiter import new_limiter
+        o = self.options
+        self._limiter = new_limiter(o.max_concurrency)
+        self._method_limiters = {
+            k: new_limiter(v)
+            for k, v in (o.method_max_concurrency or {}).items()}
+        qd = o.queue_delay_shed_ms
+        if qd is None and isinstance(o.max_concurrency, str):
+            # adaptive servers get the queue-delay gate by default: a
+            # saturated node must reject in microseconds, not let queued
+            # work time out in seconds (The Tail at Scale / DAGOR)
+            qd = flag("server_queue_shed_ms")
+        self._queue_shed_ns = int(qd * 1e6) if qd else 0
+
+    def concurrency_limit(self) -> Optional[int]:
+        """The server-wide limiter's current limit (None = unlimited) —
+        the /status saturation pane's ``concurrency_limit``."""
+        lim = self._limiter
+        return lim.max_concurrency if lim is not None else None
 
     # ------------------------------------------------------------ services
     def add_service(self, service: Service) -> None:
@@ -226,6 +313,9 @@ class Server:
             # follow the same re-expose lifecycle
             from brpc_tpu.rpc.backend_stats import expose_backend_vars
             expose_backend_vars()
+            # overload-control gauges (limiter limit + inflight) for
+            # prometheus and the merged shard views
+            _expose_limiter_vars(self)
             # scheduler saturation trio (runqueue depth/peak, worker
             # busy fraction) + fiber counters: /vars + prometheus
             self._control.expose_vars()
@@ -381,6 +471,7 @@ class Server:
         self.concurrency = 0
         self.nprocessed = 0
         self.nerror = 0
+        self._build_limiters()   # fresh inflight counts + locks
         self._shard_group = None
         if self.session_local_pool is not None:
             from brpc_tpu.rpc.data_pool import SimpleDataPool
@@ -389,11 +480,25 @@ class Server:
                 reset=self.options.session_local_data_reset)
 
     # ----------------------------------------------------------- accounting
-    def on_request_start(self) -> bool:
-        with self._concurrency_lock:
-            if (self.options.max_concurrency is not None
-                    and self.concurrency >= self.options.max_concurrency):
+    def on_request_start(self, method_key: Optional[str] = None) -> bool:
+        """Admission gate, both dispatch paths (classic AND the turbo
+        lane) plus every protocol front-end: consult the server-wide
+        limiter, then the method's (when configured). False = the
+        caller rejects with ELIMIT. Limiter locks are leaves — never
+        taken under _concurrency_lock."""
+        lim = self._limiter
+        if lim is not None and not lim.on_requested():
+            _count_limit_shed()
+            return False
+        if self._method_limiters and method_key is not None:
+            ml = self._method_limiters.get(method_key)
+            if ml is not None and not ml.on_requested():
+                if lim is not None:
+                    # release the server-wide slot the gate above took
+                    lim.on_responded(0.0, True)
+                _count_limit_shed()
                 return False
+        with self._concurrency_lock:
             self.concurrency += 1
         return True
 
@@ -415,6 +520,13 @@ class Server:
             self.nprocessed += 1
             if failed:
                 self.nerror += 1
+        lim = self._limiter
+        if lim is not None:
+            lim.on_responded(latency_us, failed)
+        if self._method_limiters:
+            ml = self._method_limiters.get(method_key)
+            if ml is not None:
+                ml.on_responded(latency_us, failed)
         lr = self.method_status.get(method_key)
         if lr is None:
             lr = self.method_status.setdefault(method_key, LatencyRecorder())

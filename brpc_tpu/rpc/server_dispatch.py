@@ -33,6 +33,26 @@ _dumper = None   # lazily bound brpc_tpu.rpc.rpc_dump.global_dumper
 # not burn cycles on requests whose callers gave up) — /vars
 nshed = Adder().expose("server_deadline_shed")
 
+# requests shed with ELIMIT by the overload-control gates — the
+# concurrency limiter's admission reject and the queue-delay gate
+# below (DAGOR-style: shed early, shed cheaply) — /vars
+nlimit_shed = Adder().expose("server_limit_shed")
+
+
+def _queue_delay_shed(server, arrival_ns: int) -> bool:
+    """True = this request sat in the dispatch queue past the server's
+    queue-delay budget and must be shed NOW (before parse/handler):
+    a saturated node rejecting in microseconds beats every caller
+    timing out in seconds. Counts from the frame's cut-time stamp —
+    the same arrival authority as the deadline gates."""
+    qns = server._queue_shed_ns
+    if not qns or not arrival_ns:
+        return False
+    if time.monotonic_ns() - arrival_ns <= qns:
+        return False
+    nlimit_shed.add(1)
+    return True
+
 # the controller of the request THIS fiber is currently serving —
 # nested Channel.call inside a handler reads it to inherit the parent's
 # remaining deadline budget (min(own timeout, parent remaining)). Set
@@ -144,12 +164,19 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
                     berr.ENOMETHOD if has_svc else berr.ENOSERVICE,
                     f"unknown {req_meta.service_name}.{req_meta.method_name}")
         return
-    if not server.on_request_start():
+    method_key = method.full_name or \
+        f"{req_meta.service_name}.{req_meta.method_name}"
+    if _queue_delay_shed(server, getattr(msg, "arrival_ns", 0)):
+        # overload: this request aged past the queue-delay budget before
+        # dispatch even saw it — reject before parse, interceptor,
+        # handler and before taking a concurrency slot
+        _send_error(proto, socket, cid, berr.ELIMIT,
+                    "queue delay over shed budget (server overloaded)")
+        return
+    if not server.on_request_start(method_key):
         _send_error(proto, socket, cid, berr.ELIMIT, "max_concurrency reached")
         return
 
-    method_key = method.full_name or \
-        f"{req_meta.service_name}.{req_meta.method_name}"
     t0 = time.monotonic_ns()
     cntl = Controller()
     d = cntl.__dict__
@@ -342,6 +369,14 @@ async def _process_request_body(proto, msg: RpcMessage, socket, server,
             cntl.set_failed(berr.ERPCTIMEDOUT,
                             f"deadline {budget_ms}ms expired before "
                             "handler entry")
+        elif _queue_delay_shed(server, getattr(msg, "arrival_ns", 0)):
+            # the hop parked this request behind busy workers past the
+            # queue-delay budget: the last gate before handler entry
+            # (the entry-time gate catches fan-out queueing; this one
+            # catches worker-queue delay)
+            cntl.set_failed(berr.ELIMIT,
+                            "queue delay over shed budget before "
+                            "handler entry (server overloaded)")
         else:
             if rz:
                 span.handler_start_us = time.monotonic_ns() // 1000
@@ -552,7 +587,8 @@ def _server_turbo_ok(server) -> bool:
 
 async def _drive_fast(proto, socket, server, method, method_key: str,
                       cid: int, service: str, method_name: str,
-                      log_id: int, payload: bytes, att: bytes) -> None:
+                      log_id: int, payload: bytes, att: bytes,
+                      arrival_ns: int = 0) -> None:
     """The turbo request body: Controller setup, handler, response —
     the classic process_request minus every branch the scan_frames
     eligibility rules already guarantee can't apply (no auth, no
@@ -562,12 +598,12 @@ async def _drive_fast(proto, socket, server, method, method_key: str,
     if not _track_pending(socket):
         await _drive_fast_inner(proto, socket, server, method, method_key,
                                 cid, service, method_name, log_id, payload,
-                                att)
+                                att, arrival_ns)
         return
     try:
         await _drive_fast_inner(proto, socket, server, method, method_key,
                                 cid, service, method_name, log_id, payload,
-                                att)
+                                att, arrival_ns)
     finally:
         # THE single settle of process_request_fast's claim — exactly
         # once, on success and on every escape path alike
@@ -576,7 +612,8 @@ async def _drive_fast(proto, socket, server, method, method_key: str,
 
 async def _drive_fast_inner(proto, socket, server, method, method_key: str,
                             cid: int, service: str, method_name: str,
-                            log_id: int, payload: bytes, att: bytes) -> None:
+                            log_id: int, payload: bytes, att: bytes,
+                            arrival_ns: int = 0) -> None:
     t0 = time.monotonic_ns()
     cntl = Controller()
     d = cntl.__dict__
@@ -607,6 +644,16 @@ async def _drive_fast_inner(proto, socket, server, method, method_key: str,
             # blocking user code must not run on the event thread
             # (same rule as the classic path)
             await _HopToWorker()
+        if _queue_delay_shed(server, arrival_ns):
+            # the turbo lane's post-hop queue-delay gate (mirrors the
+            # classic path): this request aged behind busy workers
+            # past the shed budget — reject before the handler runs
+            server.on_request_end(method_key, 0, failed=True)
+            cntl._drop_cancel_subs()
+            _send_error(proto, socket, cid, berr.ELIMIT,
+                        "queue delay over shed budget before handler "
+                        "entry (server overloaded)")
+            return
         r = method.handler(cntl, request)
         if inspect.isawaitable(r):
             r = await r
@@ -653,11 +700,18 @@ def process_request_fast(proto, socket, server, cid: int, service: str,
                     berr.ENOMETHOD if has_svc else berr.ENOSERVICE,
                     f"unknown {service}.{method_name}")
         return None
-    if not server.on_request_start():
+    method_key = method.full_name or f"{service}.{method_name}"
+    if _queue_delay_shed(server, arrival_ns):
+        # the turbo lane sheds through the same queue-delay gate as the
+        # classic path: the limiter/gate discipline must not depend on
+        # which dispatch lane a burst landed in
+        _send_error(proto, socket, cid, berr.ELIMIT,
+                    "queue delay over shed budget (server overloaded)")
+        return None
+    if not server.on_request_start(method_key):
         _send_error(proto, socket, cid, berr.ELIMIT,
                     "max_concurrency reached")
         return None
-    method_key = method.full_name or f"{service}.{method_name}"
     socket.last_method = method_key   # flight-recorder affinity hint
     if _track_pending(socket):
         # claimed HERE (before the handler can suspend and let the
@@ -668,7 +722,8 @@ def process_request_fast(proto, socket, server, cid: int, service: str,
     # sampler attributes a turbo-lane sample to its RPC method through
     # the fiber name alone — the slim path never pays a fiber-local set
     coro = _drive_fast(proto, socket, server, method, method_key, cid,
-                       service, method_name, log_id, payload, att)
+                       service, method_name, log_id, payload, att,
+                       arrival_ns)
     if not method.is_coroutine and not is_last:
         # the classic loop's fan-out discipline (QueueMessage,
         # input_messenger.cpp:183): a blocking handler for a non-last

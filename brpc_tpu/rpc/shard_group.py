@@ -146,6 +146,15 @@ def _merge_stat_dict(dicts: List[dict]) -> dict:
                 out[k] = out.get(k, 0) + v
             elif "max" in k or "peak" in k:
                 out[k] = max(out.get(k, v), v)
+            elif k.endswith("limit"):
+                # a shard group's capacity headroom is its biggest
+                # shard limit, not the sum (concurrency_limit et al;
+                # limit_shed stays a summed counter below)
+                out[k] = max(out.get(k, v), v)
+            elif "tokens" in k:
+                # retry budgets drain independently: the group's
+                # health is its MOST drained bucket
+                out[k] = min(out.get(k, v), v)
             elif ("avg" in k or "fraction" in k or "ratio" in k
                     or _PCTL_RE.search(k)):
                 w = (d.get("count", 0) or 0) / total if total else \
@@ -157,13 +166,24 @@ def _merge_stat_dict(dicts: List[dict]) -> dict:
             for k, v in out.items()}
 
 
-def merge_var_values(values: list):
+def merge_var_values(values: list, name: str = ""):
     """Merge one exposed variable's per-shard values: numbers sum
     (counters), dicts merge stat-wise, anything else keeps the first
-    shard's reading (strings, None)."""
+    shard's reading (strings, None). ``name`` applies the scalar-gauge
+    rules the saturation pane's dict merge uses — capacity limits take
+    the max, retry-token gauges the min — so merged /vars agrees with
+    merged /status on the overload-control gauges."""
     nums = [v for v in values
             if isinstance(v, (int, float)) and not isinstance(v, bool)]
     if nums and len(nums) == len(values):
+        if name.endswith("limit"):
+            return max(nums)
+        if "tokens" in name:
+            # -1 is the "no budget configured" sentinel
+            # (retry_tokens_min): a shard without budgets must not
+            # drag the group's most-drained reading to -1
+            real = [v for v in nums if v >= 0]
+            return min(real) if real else -1
         s = sum(nums)
         return round(s, 3) if isinstance(s, float) else s
     dicts = [v for v in values if isinstance(v, dict)]
@@ -227,7 +247,8 @@ class ShardAggregator:
         out = {}
         for n in sorted(names):
             out[n] = merge_var_values(
-                [d["vars"][n] for d in dumps if n in d.get("vars", {})])
+                [d["vars"][n] for d in dumps if n in d.get("vars", {})],
+                name=n)
         return out
 
     def merged_method_status(self, dumps: Optional[List[dict]] = None):

@@ -1,0 +1,31 @@
+"""Seeded registry-complete violation (limiter clause): a spec parser
+named ``new_limiter`` constructing a limiter class that inherits the
+abstract base's raising ``on_responded`` stub — the Server's admission
+gate would crash on the first completed request the moment a config
+string selects it."""
+
+
+class AbstractLimiter:
+    def on_requested(self) -> bool:
+        raise NotImplementedError
+
+    def on_responded(self, latency_us, failed):
+        raise NotImplementedError
+
+    @property
+    def max_concurrency(self) -> int:
+        raise NotImplementedError
+
+
+class HalfLimiter(AbstractLimiter):
+    """Admits everything, never accounts responses (on_responded and
+    max_concurrency stay the base's raising stubs)."""
+
+    def on_requested(self) -> bool:
+        return True
+
+
+def new_limiter(spec):
+    if spec == "half":
+        return HalfLimiter()
+    raise ValueError(spec)

@@ -1,0 +1,42 @@
+"""Clean twin of bad_limiter_registry.py: every class the spec parser
+can construct implements the full limiter contract."""
+
+
+class AbstractLimiter:
+    def on_requested(self) -> bool:
+        raise NotImplementedError
+
+    def on_responded(self, latency_us, failed):
+        raise NotImplementedError
+
+    @property
+    def max_concurrency(self) -> int:
+        raise NotImplementedError
+
+
+class WholeLimiter(AbstractLimiter):
+    def __init__(self, limit: int = 8):
+        self._limit = int(limit)
+        self._inflight = 0
+
+    def on_requested(self) -> bool:
+        if self._inflight >= self._limit:
+            return False
+        self._inflight += 1
+        return True
+
+    def on_responded(self, latency_us, failed):
+        if self._inflight > 0:
+            self._inflight -= 1
+
+    @property
+    def max_concurrency(self) -> int:
+        return self._limit
+
+
+def new_limiter(spec):
+    if spec == "whole":
+        return WholeLimiter()
+    if isinstance(spec, int):
+        return WholeLimiter(spec)
+    raise ValueError(spec)

@@ -95,6 +95,64 @@ class TestSeededViolations:
         active, _ = _lint("bad_registry.py")
         assert [f.rule for f in active] == ["registry-complete"], active
 
+    def test_incomplete_limiter_in_spec_parser(self):
+        # limiter clause: new_limiter constructing a class whose
+        # on_responded/max_concurrency are still the base's raising
+        # stubs must fire (rule level shows each missing member)
+        from brpc_tpu.analysis.core import Context, iter_source_files
+        from brpc_tpu.analysis.rules.registry_complete import (
+            RegistryCompleteRule,
+        )
+        files = iter_source_files(
+            [os.path.join(FIXTURES, "bad_limiter_registry.py")])
+        findings = list(RegistryCompleteRule().check(
+            files[0], Context(files)))
+        msgs = " | ".join(f.message for f in findings)
+        assert len(findings) == 2, [f.format() for f in findings]
+        assert "on_responded" in msgs and "max_concurrency" in msgs
+        # on_requested IS concrete on the fixture: must not be flagged
+        assert "no concrete on_requested" not in msgs
+        active, _ = _lint("bad_limiter_registry.py")
+        assert [f.rule for f in active] == ["registry-complete"], active
+
+    def test_complete_limiter_parser_is_clean(self):
+        active, _ = _lint("good_limiter_registry.py")
+        assert active == [], [f.format() for f in active]
+
+    def test_real_limiter_parser_passes_and_mutation_fires(self, tmp_path):
+        """The real rpc/concurrency_limiter.py must lint clean — and a
+        mutation replacing AutoLimiter.on_responded with the raising
+        stub must fire, pinning that the clause actually reads the real
+        parser's classes (not just the fixture's)."""
+        real = os.path.join(REPO_ROOT, "brpc_tpu", "rpc",
+                            "concurrency_limiter.py")
+        active, _ = Analyzer().run([real])
+        assert [f for f in active if f.rule == "registry-complete"] \
+            == [], [f.format() for f in active]
+        src = open(real).read()
+        # ConstantLimiter's whole on_responded (anchored by the
+        # @property that follows it, so the AutoLimiter method with the
+        # same first lines cannot match)
+        needle = ("    def on_responded(self, latency_us, failed):\n"
+                  "        with self._lock:\n"
+                  "            if self._inflight > 0:\n"
+                  "                self._inflight -= 1\n"
+                  "\n"
+                  "    @property\n")
+        assert needle in src, "ConstantLimiter.on_responded shape moved"
+        mutated = src.replace(
+            needle,
+            "    def on_responded(self, latency_us, failed):\n"
+            "        raise NotImplementedError\n"
+            "\n"
+            "    @property\n", 1)
+        mut = tmp_path / "concurrency_limiter.py"
+        mut.write_text(mutated)
+        active, _ = Analyzer().run([str(mut)])
+        hits = [f for f in active if f.rule == "registry-complete"
+                and "ConstantLimiter" in f.message]
+        assert hits, [f.format() for f in active]
+
     def test_cxx_walker_unbounded_int32_and_dropped_read(self):
         # the fixture's comments deliberately name INT32_MAX /
         # 0x7FFFFFFF and the dropped local: a bound or use that exists
@@ -585,7 +643,9 @@ class TestLockModelSnapshot:
     new nesting ships)."""
 
     # update deliberately, together with docs/invariants.md
-    PINNED_EDGE_COUNT = 35
+    # (36: +Controller._arb_lock -> RetryBudget._lock — the retry
+    # token bucket drains inside _retry_taken_call's arb hold)
+    PINNED_EDGE_COUNT = 36
 
     def _model(self):
         from brpc_tpu.analysis.core import Context, iter_source_files

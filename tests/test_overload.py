@@ -1,0 +1,591 @@
+"""Overload-control fabric tests (ISSUE 10): AutoLimiter convergence,
+the server's adaptive admission + queue-delay shed gates, the
+per-channel retry token budget, budget-aware hedging, LB reject
+classification (overload is not breakage), and the cluster channel's
+naming-empty fail-fast."""
+
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import fiber
+from brpc_tpu.rpc import (Channel, ChannelOptions, ClusterChannel, Server,
+                          ServerOptions, Service)
+from brpc_tpu.rpc import errno_codes as berr
+from brpc_tpu.rpc.concurrency_limiter import (AutoLimiter, ConstantLimiter,
+                                              TimeoutLimiter, new_limiter)
+from brpc_tpu.rpc.retry_policy import RetryBudget, min_retry_tokens
+
+
+# --------------------------------------------------------------- unit
+
+
+class TestAutoLimiterConvergence:
+    def _drive_window(self, lim, lat_us, n=AutoLimiter.SAMPLE_WINDOW):
+        """Feed one full sample window of successes at lat_us."""
+        for _ in range(n):
+            if lim.on_requested():
+                lim.on_responded(lat_us, False)
+
+    def test_shrinks_under_inflation_and_regrows_on_recovery(self):
+        lim = AutoLimiter(initial=64, min_concurrency=4,
+                          max_concurrency=256)
+        for _ in range(3):
+            self._drive_window(lim, 1000.0)
+        grown = lim.max_concurrency
+        assert grown > 64
+        # inflation well past INFLATE_TOLERANCE x best: every window
+        # shrinks (escalating so the forgiveness drift can't catch up)
+        lat = 5000.0
+        for _ in range(4):
+            self._drive_window(lim, lat)
+            lat *= 2
+        shrunk = lim.max_concurrency
+        assert shrunk < grown
+        # recovery: healthy windows regrow the limit
+        for _ in range(6):
+            self._drive_window(lim, 1000.0)
+        assert lim.max_concurrency > shrunk
+
+    def test_never_drops_below_min_concurrency(self):
+        lim = AutoLimiter(initial=8, min_concurrency=4, max_concurrency=64)
+        lat = 10_000.0
+        for _ in range(40):     # runaway inflation, every window worse
+            self._drive_window(lim, lat)
+            lat *= 2
+            assert lim.max_concurrency >= 4
+        assert lim.max_concurrency == 4
+
+    def test_time_closed_window_adapts_under_light_traffic(self):
+        # fewer than SAMPLE_WINDOW samples must still close a window
+        # once WINDOW_S elapsed (a shrunken limiter at low qps would
+        # otherwise never re-evaluate)
+        lim = AutoLimiter(initial=16, min_concurrency=2, max_concurrency=64)
+        lim._win_start -= AutoLimiter.WINDOW_S + 0.1    # age the window
+        self._drive_window(lim, 500.0, n=AutoLimiter.MIN_WINDOW_SAMPLES)
+        assert lim.max_concurrency > 16
+
+    def test_failed_responses_release_slot_without_latency(self):
+        lim = AutoLimiter(initial=8)
+        assert lim.on_requested()
+        lim.on_responded(0.0, True)
+        assert lim.inflight == 0
+        assert lim._lat_n == 0
+
+
+class TestLimiterSpecs:
+    def test_spec_vocabulary(self):
+        assert new_limiter(None) is None
+        assert isinstance(new_limiter(16), ConstantLimiter)
+        assert isinstance(new_limiter("constant:8"), ConstantLimiter)
+        assert isinstance(new_limiter("timeout:50"), TimeoutLimiter)
+        lim = new_limiter("auto:16:4:64")
+        assert isinstance(lim, AutoLimiter)
+        assert lim.max_concurrency == 16
+        assert lim.min_concurrency == 4
+        assert lim.max_limit == 64
+        with pytest.raises(ValueError):
+            new_limiter("gradient")
+        with pytest.raises(ValueError):
+            # no instance passthrough: the postfork re-arm re-parses
+            # the spec, and a shared instance would leak the parent's
+            # inflight state into every forked shard
+            new_limiter(AutoLimiter())
+
+    def test_server_builds_limiters_from_options(self):
+        s = Server(ServerOptions(max_concurrency="auto",
+                                 method_max_concurrency={"Svc.M": 2},
+                                 enable_builtin_services=False))
+        assert isinstance(s._limiter, AutoLimiter)
+        assert isinstance(s._method_limiters["Svc.M"], ConstantLimiter)
+        assert s._queue_shed_ns > 0          # auto => gate defaults ON
+        s2 = Server(ServerOptions(max_concurrency=4,
+                                  enable_builtin_services=False))
+        assert s2._queue_shed_ns == 0        # int cap: no gate
+
+
+class TestRetryBudget:
+    def test_drain_refill_throttle(self):
+        rb = RetryBudget(max_tokens=4, token_ratio=0.5)
+        assert not rb.throttled()
+        rb.drain()
+        rb.drain()                # tokens 2 == threshold -> throttled
+        assert rb.throttled()
+        for _ in range(3):
+            rb.refill()
+        assert rb.tokens() == pytest.approx(3.5)
+        assert not rb.throttled()
+        snap = rb.snapshot()
+        assert snap["max_tokens"] == 4 and not snap["throttled"]
+
+    def test_resolve_and_registry_min(self):
+        assert RetryBudget.resolve(None) is None
+        assert RetryBudget.resolve(False) is None
+        rb = RetryBudget.resolve(True)
+        assert isinstance(rb, RetryBudget)
+        assert RetryBudget.resolve(rb) is rb
+        with pytest.raises(TypeError):
+            RetryBudget.resolve(7)
+        low = RetryBudget(max_tokens=10)
+        for _ in range(9):
+            low.drain()
+        assert min_retry_tokens() <= 1.0
+
+
+class TestRejectFeedbackLALB:
+    def test_reject_returns_slot_without_ewma_penalty(self):
+        from brpc_tpu.butil.endpoint import str2endpoint
+        from brpc_tpu.rpc.load_balancer import LocalityAwareLB
+        a = str2endpoint("tcp://10.0.0.1:1")
+        b = str2endpoint("tcp://10.0.0.2:1")
+        lb = LocalityAwareLB()
+        lb.reset_servers([a, b])
+        lb.feedback(a, 800.0, False)
+        ewma = lb.decision_info(a)["lat_ewma_us"]
+        # overload rejections: slot back, reject counted, EWMA untouched
+        for _ in range(5):
+            lb._inflight[a] = lb._inflight.get(a, 0) + 1
+            lb.feedback_reject(a)
+        info = lb.decision_info(a)
+        assert info["lat_ewma_us"] == ewma
+        assert info["rejects"] == 5
+        assert info["inflight"] == 0
+        # breakage comparison: one failed feedback kicks the EWMA hard
+        lb.feedback(a, 0.0, True)
+        assert lb.decision_info(a)["lat_ewma_us"] > ewma * 10
+
+
+# ---------------------------------------------------------------- e2e
+
+
+def _make_server(handler_map, **server_kw):
+    server = Server(ServerOptions(enable_builtin_services=False,
+                                  **server_kw))
+    svc = Service("Load")
+    for name, fn in handler_map.items():
+        svc.method(name=name)(fn)
+    server.add_service(svc)
+    ep = server.start("tcp://127.0.0.1:0")
+    return server, ep
+
+
+def _flood(ch, method, n, timeout_ms=None, max_retry=0):
+    """Issue n concurrent calls, return the completed controllers."""
+    done = threading.Event()
+    out = []
+    lock = threading.Lock()
+
+    def _done(c):
+        with lock:
+            out.append(c)
+            if len(out) >= n:
+                done.set()
+
+    cntls = []
+    for _ in range(n):
+        from brpc_tpu.rpc.controller import Controller
+        c = Controller()
+        c.timeout_ms = timeout_ms
+        c.max_retry = max_retry
+        cntls.append(ch.call("Load", method, b"x", cntl=c, done=_done))
+    assert done.wait(30), f"flood stalled: {len(out)}/{n}"
+    return out
+
+
+class TestAutoShedE2E:
+    def test_auto_limiter_sheds_elimit_and_recovers(self):
+        async def Slow(cntl, request):
+            await fiber.sleep(0.08)
+            return request
+
+        server, ep = _make_server({"Slow": Slow},
+                                  max_concurrency="auto:4:2:8")
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(timeout_ms=5000, max_retry=0,
+                                    share_connections=False))
+        try:
+            out = _flood(ch, "Slow", 24, timeout_ms=5000)
+            codes = [c.error_code for c in out]
+            shed = codes.count(berr.ELIMIT)
+            ok = codes.count(0)
+            # saturation past limit 4 must shed, but the admitted 4
+            # (per round) must serve
+            assert shed > 0, codes
+            assert ok >= 4, codes
+            # recovery to the fault-free limit within a window: healthy
+            # sequential traffic regrows the limit and serves cleanly
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and \
+                    server._limiter.max_concurrency < 4:
+                c = ch.call_sync("Load", "Slow", b"r")
+                assert not c.failed(), c.error_text
+            assert server._limiter.max_concurrency >= 4
+            c = ch.call_sync("Load", "Slow", b"r")
+            assert not c.failed(), c.error_text
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+
+class TestQueueDelayShedE2E:
+    def test_queue_delay_gate_sheds_before_handler(self):
+        from brpc_tpu.rpc.server_dispatch import nlimit_shed
+        ran = []
+
+        def Clog(cntl, request):          # sync: occupies a worker
+            time.sleep(0.25)
+            return b"clog"
+
+        def Quick(cntl, request):
+            ran.append(1)
+            return b"quick"
+
+        server, ep = _make_server({"Clog": Clog, "Quick": Quick},
+                                  max_concurrency="auto:64:32:128",
+                                  queue_delay_shed_ms=40)
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(timeout_ms=None, max_retry=0,
+                                    share_connections=False))
+        shed_before = nlimit_shed.get_value()
+        try:
+            # clog every fiber worker with blocking handlers, then
+            # burst requests that must age in the worker queue past
+            # the 40ms budget -> ELIMIT before their handler runs
+            nworkers = getattr(server._control, "concurrency", 0) or 8
+            out = _flood(ch, "Clog", nworkers * 2 + 8, timeout_ms=None)
+            codes = [c.error_code for c in out]
+            assert codes.count(berr.ELIMIT) > 0, codes
+            shed_delta = nlimit_shed.get_value() - shed_before
+            assert shed_delta > 0
+            elimit = [c for c in out if c.error_code == berr.ELIMIT]
+            assert any("queue delay" in c.error_text for c in elimit), \
+                [c.error_text for c in elimit][:3]
+            # the gate sheds BEFORE handler entry: a Quick call after
+            # the storm drains must run normally
+            c = ch.call_sync("Load", "Quick", b"q")
+            assert not c.failed() and ran
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+
+class TestRetryBudgetE2E:
+    def test_throttled_budget_stops_retry_burn(self):
+        from brpc_tpu.rpc.channel import nretry_throttled
+        before = nretry_throttled.get_value()
+        ch = Channel("tcp://127.0.0.1:1",      # nothing listens here
+                     ChannelOptions(timeout_ms=2000, max_retry=50,
+                                    share_connections=False,
+                                    retry_budget=RetryBudget(
+                                        max_tokens=4, token_ratio=0.1)))
+        try:
+            cntl = ch.call_sync("Load", "Quick", b"x")
+            assert cntl.failed()
+            assert cntl.error_code in (berr.EFAILEDSOCKET,
+                                       berr.ERPCTIMEDOUT)
+            # tokens 4, threshold 2: two drains throttle the bucket —
+            # the other ~48 configured retries are never launched
+            assert cntl.current_try <= 4, cntl.current_try
+            assert nretry_throttled.get_value() > before
+        finally:
+            ch.close()
+
+    def test_client_local_timeout_drains_budget(self):
+        # a stalled cluster produces timeouts, not socket failures: the
+        # bucket must still drain (else hedges keep piling load onto
+        # the stall) — but a call the SERVER answered on time refills
+        async def Stall(cntl, request):
+            await fiber.sleep(0.3)
+            return request
+
+        server, ep = _make_server({"Stall": Stall})
+        rb = RetryBudget(max_tokens=10)
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(timeout_ms=60, max_retry=0,
+                                    share_connections=False,
+                                    retry_budget=rb))
+        try:
+            c = ch.call_sync("Load", "Stall", b"x")
+            assert c.error_code == berr.ERPCTIMEDOUT
+            assert c.responded_server is None
+            assert rb.tokens() == pytest.approx(9.0)
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+    def test_naming_empty_does_not_drain_budget(self):
+        rb = RetryBudget(max_tokens=10)
+        ch = ClusterChannel("list://", "rr",
+                            ChannelOptions(timeout_ms=500,
+                                           naming_wait_s=1.0,
+                                           share_connections=False,
+                                           retry_budget=rb))
+        try:
+            c = ch.call_sync("Load", "Ok", b"x")
+            assert c.error_code == berr.ENAMINGEMPTY
+            # fail-fast against nothing burns nothing: the bucket must
+            # be full when the naming url is fixed
+            assert rb.tokens() == pytest.approx(10.0)
+        finally:
+            ch.close()
+
+    def test_healthy_channel_keeps_retrying(self):
+        # an isolated failure with a full bucket must still retry:
+        # budget throttling is a storm lever, not a retry ban
+        rb = RetryBudget(max_tokens=100, token_ratio=0.1)
+
+        def Ok(cntl, request):
+            return request
+
+        server, ep = _make_server({"Ok": Ok})
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(timeout_ms=2000, max_retry=3,
+                                    share_connections=False,
+                                    retry_budget=rb))
+        try:
+            for _ in range(10):
+                c = ch.call_sync("Load", "Ok", b"x")
+                assert not c.failed()
+            assert not rb.throttled()
+            assert rb.tokens() == pytest.approx(100.0)
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+
+class TestBudgetAwareHedging:
+    def _slow_server(self, delay_s=0.1):
+        async def Slow(cntl, request):
+            await fiber.sleep(delay_s)
+            return request
+
+        return _make_server({"Slow": Slow})
+
+    def test_hedge_suppressed_when_budget_under_p50(self):
+        from brpc_tpu.rpc.channel import nhedge_suppressed
+        server, ep = self._slow_server(0.2)
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(timeout_ms=5000, max_retry=0,
+                                    share_connections=False))
+        try:
+            for _ in range(6):          # seed the cell's p50 (~200ms)
+                assert not ch.call_sync("Load", "Slow", b"w").failed()
+            assert ch._hedge_p50_ms() and ch._hedge_p50_ms() > 100.0
+            before = nhedge_suppressed.get_value()
+            # backup timer fires at 120ms with ~160ms of budget left —
+            # under the ~200ms p50: the hedge must NOT be armed (and
+            # the 280ms deadline still clears the ~205ms response with
+            # ~75ms to spare, so the call itself succeeds even on a
+            # loaded box; both margins scale with backup_request_ms)
+            from brpc_tpu.rpc.controller import Controller
+            c = Controller()
+            c.timeout_ms = 280.0
+            c.backup_request_ms = 120.0
+            cntl = ch.call("Load", "Slow", b"h", cntl=c)
+            cntl.join(5)
+            assert not cntl.failed(), cntl.error_text
+            assert not cntl.used_backup
+            assert nhedge_suppressed.get_value() > before
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+    def test_hedge_armed_when_budget_allows(self):
+        server, ep = self._slow_server(0.1)
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(timeout_ms=5000, max_retry=0,
+                                    share_connections=False))
+        try:
+            for _ in range(6):
+                assert not ch.call_sync("Load", "Slow", b"w").failed()
+            from brpc_tpu.rpc.controller import Controller
+            c = Controller()
+            c.timeout_ms = 5000.0
+            c.backup_request_ms = 30.0
+            cntl = ch.call("Load", "Slow", b"h", cntl=c)
+            cntl.join(10)
+            assert not cntl.failed(), cntl.error_text
+            assert cntl.used_backup
+            # the arming decision is recorded (remaining vs p50) for
+            # the rpcz attempt-span evidence trail
+            rem, p50 = cntl.__dict__["_hedge_decision"]
+            assert rem is not None and p50 is not None and rem >= p50
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+    def test_throttled_budget_suppresses_hedge(self):
+        from brpc_tpu.rpc.channel import nretry_throttled
+        server, ep = self._slow_server(0.1)
+        rb = RetryBudget(max_tokens=4)
+        for _ in range(4):
+            rb.drain()                  # pre-drained: throttled
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(timeout_ms=5000, max_retry=0,
+                                    share_connections=False,
+                                    retry_budget=rb))
+        try:
+            before = nretry_throttled.get_value()
+            from brpc_tpu.rpc.controller import Controller
+            c = Controller()
+            c.timeout_ms = 5000.0
+            c.backup_request_ms = 30.0
+            cntl = ch.call("Load", "Slow", b"h", cntl=c)
+            cntl.join(10)
+            assert not cntl.failed()
+            assert not cntl.used_backup
+            assert nretry_throttled.get_value() > before
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+
+class TestClusterRejectClassification:
+    def test_shedding_backend_is_not_breakage(self):
+        from brpc_tpu.rpc import backend_stats as _bs
+
+        def Ok(cntl, request):
+            return request
+
+        # backend A sheds EVERYTHING (limit 0); backend B serves
+        server_a, ep_a = _make_server({"Ok": Ok}, max_concurrency=0)
+        server_b, ep_b = _make_server({"Ok": Ok})
+        naming = (f"list://tcp://{ep_a.host}:{ep_a.port},"
+                  f"tcp://{ep_b.host}:{ep_b.port}")
+        ch = ClusterChannel(naming, "la",
+                            ChannelOptions(timeout_ms=3000, max_retry=2,
+                                           share_connections=False,
+                                           name="reject-e2e"))
+        try:
+            for _ in range(30):
+                c = ch.call_sync("Load", "Ok", b"x")
+                assert not c.failed(), (c.error_code, c.error_text)
+            key_a = _bs.ep_key(ep_a)
+            # overload is visible as rejects/errors_ELIMIT on A's row...
+            cell_a = _bs.global_stats().cell("reject-e2e", key_a)
+            row = cell_a.get_value()
+            assert row["rejects"] > 0
+            assert row.get("errors_ELIMIT", 0) > 0
+            # ...but A's breaker never trips and its latency EWMA never
+            # takes the breakage penalty (overload != broken)
+            state = ch.backend_state(key_a)
+            assert state.get("breaker", {}).get("trips", 0) == 0
+            from brpc_tpu.butil.endpoint import str2endpoint
+            info = ch._lb.decision_info(
+                str2endpoint(f"tcp://{ep_a.host}:{ep_a.port}"))
+            assert info["lat_ewma_us"] < 100_000.0, info
+            assert info.get("rejects", 0) > 0
+        finally:
+            ch.close()
+            server_a.stop()
+            server_b.stop()
+            server_a.join(2)
+            server_b.join(2)
+
+
+class TestNamingEmptyFailFast:
+    def test_never_resolving_naming_fails_with_distinct_errno(self):
+        from brpc_tpu.fiber import sleep as fiber_sleep
+        from brpc_tpu.rpc.cluster_channel import nnaming_empty
+        from brpc_tpu.rpc.naming import (NamingService,
+                                         register_naming_service)
+
+        class _NeverNS(NamingService):
+            async def run(self, param, actions, stop_event):
+                while not stop_event.is_set():
+                    await fiber_sleep(0.02)
+
+        register_naming_service("never", _NeverNS())
+        before = nnaming_empty.get_value()
+        ch = ClusterChannel("never://unresolvable", "rr",
+                            ChannelOptions(timeout_ms=1000, max_retry=3,
+                                           naming_wait_s=0.2,
+                                           share_connections=False))
+        try:
+            t0 = time.monotonic()
+            cntl = ch.call_sync("Load", "Ok", b"x")
+            assert cntl.failed()
+            assert cntl.error_code == berr.ENAMINGEMPTY, cntl.error_code
+            assert "never delivered" in cntl.error_text
+            # fail FAST: no retry burn, no waiting out the deadline
+            assert time.monotonic() - t0 < 0.5
+            assert nnaming_empty.get_value() > before
+        finally:
+            ch.close()
+
+    def test_empty_resolved_list_names_the_revision(self):
+        ch = ClusterChannel("list://", "rr",
+                            ChannelOptions(timeout_ms=1000,
+                                           naming_wait_s=2.0,
+                                           share_connections=False))
+        try:
+            cntl = ch.call_sync("Load", "Ok", b"x")
+            assert cntl.error_code == berr.ENAMINGEMPTY
+            assert "empty list" in cntl.error_text
+        finally:
+            ch.close()
+
+
+class TestSurfacedState:
+    def test_status_saturation_and_backends_rows(self):
+        def Ok(cntl, request):
+            return request
+
+        server, ep = _make_server({"Ok": Ok}, max_concurrency="auto:8:2:32")
+        rb = RetryBudget(max_tokens=10)
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(timeout_ms=2000, retry_budget=rb,
+                                    share_connections=False,
+                                    name="surfaced-e2e"))
+        try:
+            assert not ch.call_sync("Load", "Ok", b"x").failed()
+            from brpc_tpu.builtin.services import status_page
+            sat = status_page(server)["saturation"]
+            assert sat["concurrency_limit"] == \
+                server._limiter.max_concurrency
+            assert sat["inflight"] == server.concurrency
+            assert "limit_shed" in sat and "deadline_shed" in sat
+            assert sat["retry_tokens"] <= 10.0
+            from brpc_tpu.rpc.backend_stats import backends_page_payload
+            page = backends_page_payload()
+            entry = page["channels"]["surfaced-e2e"]
+            assert entry["retry_budget"]["max_tokens"] == 10.0
+            assert "rejects" in page["totals"]
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+    def test_merged_scalar_gauges_follow_limit_and_token_rules(self):
+        # merged /vars must agree with merged /status on the
+        # overload gauges: limits max, tokens min (with the -1
+        # no-budget sentinel excluded), counters still sum
+        from brpc_tpu.rpc.shard_group import merge_var_values
+        assert merge_var_values([128, 64],
+                                name="server_concurrency_limit") == 128
+        assert merge_var_values([-1.0, 30.0, 80.0],
+                                name="retry_tokens_min") == 30.0
+        assert merge_var_values([-1.0, -1.0],
+                                name="retry_tokens_min") == -1
+        assert merge_var_values([3, 4], name="server_limit_shed") == 7
+
+    def test_merged_saturation_math(self):
+        from brpc_tpu.rpc.shard_group import _merge_stat_dict
+        merged = _merge_stat_dict([
+            {"concurrency_limit": 8, "inflight": 3, "retry_tokens": 9.0,
+             "limit_shed": 2},
+            {"concurrency_limit": 16, "inflight": 1, "retry_tokens": 4.0,
+             "limit_shed": 5},
+        ])
+        assert merged["concurrency_limit"] == 16     # limits: max
+        assert merged["inflight"] == 4               # inflight: sum
+        assert merged["retry_tokens"] == 4.0         # tokens: min
+        assert merged["limit_shed"] == 7             # counters: sum

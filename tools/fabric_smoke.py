@@ -1,0 +1,379 @@
+"""Fabric storm driver: the overload-control loop proven under chaos
+(ISSUE 10). Three backend nodes behind locality-aware ClusterChannels
+with retry budgets + budget-aware hedging, driven by the pipelined
+done-callback client shape (tools/qps_client.py), through a SEEDED
+storm:
+
+  baseline  -> all three nodes healthy (fault-free goodput floor)
+  fault     -> one node SIGKILLed mid-burst, another STALLED (its
+               handler latency jumps via the node's SetDelay control
+               RPC) — retries move kills elsewhere, hedges rescue the
+               stall, survivor error rate must be ZERO and goodput
+               must hold >= 70% of baseline
+  outage    -> every node SIGKILLed: the retry token buckets drain and
+               throttle, so retry amplification (attempts per call)
+               stays <= 1.2x — the brown-out is never amplified
+  recover   -> nodes respawn on their old ports; health checks revive
+               them and the tail of the window must serve cleanly
+
+Hedge discipline is asserted from rpcz attempt spans: every armed
+hedge carries a ``hedge_armed remaining_ms=R p50_ms=P`` annotation
+stamped at the arming decision, and R >= P must hold for all of them
+(no hedge is ever armed past budget).
+
+  --node PORT   run one backend node (internal; the driver spawns 3)
+  --smoke       ~6s storm with hard asserts — preflight's
+                gate_fabric_smoke (BRPC_TPU_FABRIC_SMOKE=0 skips)
+  --bench       storm + one JSON line with fault_goodput_ratio /
+                fault_p99_ms for bench.py's fabric keys
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+BASE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, BASE)
+sys.path.insert(0, os.path.join(BASE, "tools"))
+
+NODES = 3
+
+
+# ------------------------------------------------------------- node
+def run_node(port: int) -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from brpc_tpu import fiber
+    from brpc_tpu.rpc import Server, ServerOptions, Service
+
+    state = {"delay_s": 0.0}
+    # adaptive limiter sized so ONE surviving node can admit the whole
+    # storm's shifted load (the client drives 32 pipelined lanes +
+    # hedges); the queue-delay gate stays armed via the auto spec
+    server = Server(ServerOptions(enable_builtin_services=False,
+                                  max_concurrency="auto:64:16:1024"))
+    svc = Service("Bench")
+
+    @svc.method()
+    async def PyEcho(cntl, request):
+        d = state["delay_s"]
+        if d > 0:
+            # the "stalled node" of the storm: a slow-but-alive
+            # backend, the tail-at-scale scenario hedges exist for
+            await fiber.sleep(d)
+        return bytes(request)
+
+    @svc.method()
+    def SetDelay(cntl, request):
+        state["delay_s"] = float(bytes(request) or b"0") / 1e3
+        return b"ok"
+
+    server.add_service(svc)
+    ep = server.start(f"tcp://127.0.0.1:{port}")
+    print(f"PORT {ep.port}", flush=True)
+    from spawn_util import parent_death_watchdog_loop
+    parent_death_watchdog_loop()
+
+
+# ----------------------------------------------------------- driver
+class PhaseStats:
+    def __init__(self, name: str):
+        self.name = name
+        self.lock = threading.Lock()
+        self.ok = 0
+        self.errors = 0
+        self.error_codes: dict = {}
+        self.samples: list = []
+        self.attempts = 0           # 1 + retries + hedge per call
+        self.lat_ms: list = []
+        self.t0 = time.perf_counter()
+        self.elapsed = 0.0
+
+    def record(self, failed, attempts: int, lat_ms: float) -> None:
+        with self.lock:
+            if failed:
+                self.errors += 1
+                self.error_codes[failed] = \
+                    self.error_codes.get(failed, 0) + 1
+            else:
+                self.ok += 1
+                self.lat_ms.append(lat_ms)
+            self.attempts += attempts
+
+    def close(self) -> None:
+        self.elapsed = time.perf_counter() - self.t0
+
+    def summary(self) -> dict:
+        calls = self.ok + self.errors
+        lat = sorted(self.lat_ms)
+        p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))] if lat else None
+        return {
+            "phase": self.name, "calls": calls, "ok": self.ok,
+            "errors": self.errors,
+            "qps": round(self.ok / self.elapsed, 1) if self.elapsed else 0.0,
+            "amplification": round(self.attempts / calls, 3) if calls
+            else None,
+            "p99_ms": round(p99, 2) if p99 is not None else None,
+            "error_codes": dict(self.error_codes),
+            "error_samples": list(self.samples),
+        }
+
+
+def _spawn_node(port: int = 0):
+    from spawn_util import spawn_port_server
+    proc, got = spawn_port_server(
+        [os.path.abspath(__file__), "--node", str(port)], wall_s=30.0)
+    if proc is None:
+        raise RuntimeError("fabric node spawn failed")
+    return proc, got
+
+
+def _set_delay(port: int, delay_ms: float) -> None:
+    from brpc_tpu.rpc import Channel, ChannelOptions
+    ch = Channel(f"tcp://127.0.0.1:{port}",
+                 ChannelOptions(timeout_ms=2000, share_connections=False,
+                                name="fabric-control"))
+    try:
+        cntl = ch.call_sync("Bench", "SetDelay", str(delay_ms).encode())
+        if cntl.failed():
+            raise RuntimeError(f"SetDelay failed: {cntl.error_text}")
+    finally:
+        ch.close()
+
+
+def run_storm(seed: int = 7, conns: int = 4, inflight: int = 8,
+              windows=(1.5, 2.0, 0.8, 1.0), verbose: bool = True) -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from brpc_tpu.butil.flags import set_flag
+    from brpc_tpu.rpc import ChannelOptions, ClusterChannel
+    from brpc_tpu.rpc.span import global_collector
+
+    set_flag("rpcz_enabled", True)      # hedge-arming evidence trail
+
+    procs = {}
+    ports = []
+    for _ in range(NODES):
+        proc, port = _spawn_node()
+        procs[port] = proc
+        ports.append(port)
+    naming = "list://" + ",".join(f"tcp://127.0.0.1:{p}" for p in ports)
+    chs = [ClusterChannel(naming, "la",
+                          ChannelOptions(timeout_ms=1500, max_retry=3,
+                                         backup_request_ms=50,
+                                         retry_budget=True,
+                                         share_connections=False,
+                                         name=f"fabric-{i}"))
+           for i in range(conns)]
+    # the storm script is a pure function of the seed: victim choice
+    # only (the phase schedule is fixed wall-clock windows)
+    kill_node = ports[seed % NODES]
+    stall_node = ports[(seed + 1) % NODES]
+
+    stats = {n: PhaseStats(n) for n in
+             ("warm", "baseline", "fault", "outage", "recover", "drain")}
+    current = ["warm"]
+    stop = [False]
+    live = [conns * inflight]
+    done_ev = threading.Event()
+
+    def issue(i: int) -> None:
+        ch = chs[i]
+        t0 = time.perf_counter()
+
+        def _done(cntl) -> None:
+            # attribute to the phase the call COMPLETED in: a call
+            # issued moments before a phase boundary fails/succeeds
+            # under the NEXT phase's conditions (an in-flight call at
+            # the outage kill is an outage casualty, not a "survivor
+            # error" of the fault window)
+            ph = stats[current[0]]
+            attempts = 1 + cntl.current_try + (1 if cntl.used_backup
+                                               else 0)
+            if cntl.failed() and len(ph.samples) < 8:
+                ph.samples.append(
+                    f"{cntl.error_code}:{cntl.error_text[:90]}:"
+                    f"tries={cntl.current_try}:bk={cntl.used_backup}")
+            ph.record(cntl.error_code if cntl.failed() else False,
+                      attempts, (time.perf_counter() - t0) * 1e3)
+            if not stop[0]:
+                issue(i)
+            else:
+                with stats["drain"].lock:
+                    live[0] -= 1
+                    if live[0] <= 0:
+                        done_ev.set()
+
+        try:
+            ch.call("Bench", "PyEcho", b"q", done=_done)
+        except Exception:
+            stats[current[0]].record("issue", 1, 0.0)
+            with stats["drain"].lock:
+                live[0] -= 1
+                if live[0] <= 0:
+                    done_ev.set()
+
+    def enter(phase: str) -> None:
+        stats[current[0]].close()
+        current[0] = phase
+        stats[phase].t0 = time.perf_counter()
+        if verbose:
+            print(f"# phase {phase}", file=sys.stderr, flush=True)
+
+    # warm every channel (first-call setup cost must not pollute the
+    # baseline window) and seed the backend p50 cells for hedging
+    for ch in chs:
+        for _ in range(6):
+            ch.call_sync("Bench", "PyEcho", b"w")
+    for i in range(conns):
+        for _ in range(inflight):
+            issue(i)
+
+    enter("baseline")
+    time.sleep(windows[0])
+
+    # ---- fault: kill one node mid-burst, stall another (the phase
+    # flips FIRST: the kill's in-flight casualties belong to the fault
+    # window, not to a baseline that was already over)
+    enter("fault")
+    _set_delay(stall_node, 150.0)
+    procs[kill_node].send_signal(signal.SIGKILL)
+    time.sleep(windows[1])
+    # hedge evidence BEFORE later phases can age it out of the ring
+    hedge_pairs = []
+    for sp in global_collector.recent(5000):
+        for _us, text in getattr(sp, "annotations", ()):
+            if text.startswith("hedge_armed"):
+                fields = dict(kv.split("=") for kv in text.split()[1:])
+                try:
+                    hedge_pairs.append((float(fields["remaining_ms"]),
+                                        float(fields["p50_ms"])))
+                except (KeyError, ValueError):
+                    pass    # inf/na: unknown budget or p50 — ungated arm
+
+    # ---- outage: every node down; the retry budget must throttle
+    enter("outage")
+    for port, proc in procs.items():
+        if port != kill_node:
+            proc.send_signal(signal.SIGKILL)
+    time.sleep(windows[2])
+
+    # ---- recover: respawn all three on their OLD ports
+    for port in ports:
+        procs[port].wait(5)
+        proc, got = _spawn_node(port)
+        if got != port:
+            raise RuntimeError(f"respawn moved port {port} -> {got}")
+        procs[port] = proc
+    enter("recover")
+    probe_deadline = time.monotonic() + 8.0
+    revived = False
+    while time.monotonic() < probe_deadline:
+        c = chs[0].call_sync("Bench", "PyEcho", b"p")
+        if not c.failed():
+            revived = True
+            break
+        time.sleep(0.1)
+    # measured tail: post-revival traffic must serve cleanly
+    stats["recover"].close()
+    stats["recover"] = PhaseStats("recover")
+    current[0] = "recover"
+    time.sleep(windows[3])
+    enter("drain")
+    stop[0] = True
+    done_ev.wait(10)
+    stats["drain"].close()
+
+    out = {n: stats[n].summary() for n in
+           ("baseline", "fault", "outage", "recover")}
+    base_qps = out["baseline"]["qps"] or 1.0
+    report = {
+        "seed": seed,
+        "ports": ports,
+        "killed": kill_node,
+        "stalled": stall_node,
+        "revived": revived,
+        "phases": out,
+        "fault_goodput_ratio": round(out["fault"]["qps"] / base_qps, 3),
+        "fault_p99_ms": out["fault"]["p99_ms"],
+        "outage_amplification": out["outage"]["amplification"],
+        "hedges_armed": len(hedge_pairs),
+        "hedges_past_budget": sum(1 for r, p in hedge_pairs if r < p),
+    }
+    for ch in chs:
+        ch.close()
+    for proc in procs.values():
+        try:
+            proc.kill()
+            proc.wait(5)
+        except Exception:
+            pass
+    return report
+
+
+def assert_storm(rep: dict) -> list:
+    """The gate's acceptance bars (ISSUE 10)."""
+    problems = []
+    ph = rep["phases"]
+    if ph["baseline"]["errors"]:
+        problems.append(f"baseline errors: {ph['baseline']['errors']}")
+    if not ph["baseline"]["calls"]:
+        problems.append("baseline served nothing")
+    if ph["fault"]["errors"]:
+        problems.append(
+            f"survivor error rate not 0: {ph['fault']['errors']} "
+            f"errors with 2 of 3 nodes degraded")
+    if rep["fault_goodput_ratio"] < 0.7:
+        problems.append(
+            f"fault goodput {rep['fault_goodput_ratio']} < 0.7x baseline")
+    amp = rep["outage_amplification"]
+    if amp is not None and amp > 1.2:
+        problems.append(f"outage retry amplification {amp} > 1.2x")
+    if rep["hedges_past_budget"]:
+        problems.append(
+            f"{rep['hedges_past_budget']} hedge(s) armed past budget")
+    if not rep["hedges_armed"]:
+        problems.append("no hedge was ever armed during the stall")
+    if not rep["revived"]:
+        problems.append("cluster never revived after respawn")
+    if ph["recover"]["errors"]:
+        problems.append(
+            f"recover-tail errors: {ph['recover']['errors']}")
+    return problems
+
+
+def main() -> int:
+    args = sys.argv[1:]
+    if args and args[0] == "--node":
+        run_node(int(args[1]) if len(args) > 1 else 0)
+        return 0
+    seed = int(os.environ.get("BRPC_TPU_FABRIC_SEED", "7"))
+    if "--seed" in args:
+        seed = int(args[args.index("--seed") + 1])
+    if "--smoke" in args:
+        rep = run_storm(seed=seed, verbose=False)
+        problems = assert_storm(rep)
+        rep["problems"] = problems
+        print(json.dumps(rep), flush=True)
+        return 1 if problems else 0
+    if "--bench" in args:
+        rep = run_storm(seed=seed, verbose=False)
+        rep["problems"] = assert_storm(rep)
+        print(json.dumps(rep), flush=True)
+        return 0
+    rep = run_storm(seed=seed)
+    print(json.dumps(rep, indent=2), flush=True)
+    problems = assert_storm(rep)
+    for p in problems:
+        print(f"PROBLEM: {p}", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)    # skip runtime-thread teardown, like bench.py

@@ -523,6 +523,42 @@ def gate_serving_smoke() -> dict:
     return out
 
 
+def gate_fabric_smoke() -> dict:
+    """Overload-control fabric storm (tools/fabric_smoke.py --smoke,
+    ~8s): three nodes behind budget-hedging ClusterChannels — one node
+    SIGKILLed mid-burst + one stalled must leave survivor error rate 0
+    with goodput >= 0.7x fault-free, a full-outage window must keep
+    retry amplification <= 1.2x (retry token bucket), no hedge may be
+    armed past budget (rpcz attempt-span evidence), and the cluster
+    must recover after the nodes respawn. A subprocess so a wedged
+    storm cannot hang the gate; ONE retry round absorbs the shared
+    sandbox's worst scheduling jitter (a real regression fails both).
+    BRPC_TPU_FABRIC_SMOKE=0 skips."""
+    if os.environ.get("BRPC_TPU_FABRIC_SMOKE", "1") == "0":
+        return {"ok": True, "skipped": "BRPC_TPU_FABRIC_SMOKE=0"}
+    out: dict = {}
+    for attempt in range(2):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "fabric_smoke.py"), "--smoke"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=180)
+        out = {"ok": proc.returncode == 0, "attempt": attempt + 1}
+        try:
+            report = json.loads(proc.stdout.strip().splitlines()[-1])
+            for k in ("fault_goodput_ratio", "fault_p99_ms",
+                      "outage_amplification", "hedges_armed",
+                      "hedges_past_budget", "revived"):
+                out[k] = report.get(k)
+            if proc.returncode != 0:
+                out["problems"] = report.get("problems")
+        except (ValueError, IndexError):
+            out["ok"] = False
+            out["error"] = (proc.stdout + proc.stderr)[-500:]
+        if out["ok"]:
+            break
+    return out
+
+
 def gate_perf_smoke() -> dict:
     """Fast hot-path perf gate: raw-socket-normalized small-RPC and
     1MB-echo ratios must stay within 30% of the BENCH_r05-era floors.
@@ -592,6 +628,7 @@ def run_gate() -> int:
                      ("flight_smoke", gate_flight_smoke),
                      ("cluster_top", gate_cluster_top),
                      ("serving_smoke", gate_serving_smoke),
+                     ("fabric_smoke", gate_fabric_smoke),
                      ("perf_smoke", gate_perf_smoke)):
         try:
             report[name] = fn()
