@@ -1161,6 +1161,46 @@ def main() -> None:
                 result["partial"] = True
                 _progress({"progress": "error", "phase": "fabric",
                            "error": result["fabric"]["error"]})
+        # ---- traffic lane (ISSUE 11): capture/replay engine. Headline
+        # keys: replay_fidelity_pct (a recorded mixed-priority corpus
+        # replayed at 1x reproduces the recorded qps profile) and
+        # capture_overhead_pct (capture-on at production defaults vs
+        # off on the pipelined multiproc driver — alternating best-of
+        # windows; capture_overhead_full_pct prices the unbudgeted
+        # corpus-recording mode). A subprocess so a wedged replay
+        # cannot take the bench down.
+        if deadline.remaining() < 35.0:
+            result["traffic"] = {"skipped": "wall budget"}
+            result["partial"] = True
+        else:
+            import subprocess as _sp
+            try:
+                p = _sp.run(
+                    [sys.executable,
+                     os.path.join(base, "tools", "traffic_smoke.py"),
+                     "--bench"],
+                    capture_output=True, text=True, timeout=240)
+                rep = json.loads(p.stdout.strip().splitlines()[-1])
+                lane = {k: rep.get(k) for k in (
+                    "replay_fidelity_pct", "capture_overhead_pct",
+                    "capture_overhead_full_pct", "qps_capture_on",
+                    "qps_capture_off", "qps_capture_full",
+                    "captured_under_load", "captured_full_rate",
+                    "behind_ms_max", "problems")}
+                result["traffic"] = lane
+                if rep.get("replay_fidelity_pct") is not None:
+                    result["replay_fidelity_pct"] = \
+                        rep["replay_fidelity_pct"]
+                if rep.get("capture_overhead_pct") is not None:
+                    result["capture_overhead_pct"] = \
+                        rep["capture_overhead_pct"]
+                _progress({"progress": "traffic_lane", **lane})
+            except Exception as e:  # noqa: BLE001 - diagnostics only
+                result["traffic"] = {
+                    "error": f"{type(e).__name__}: {e}"[:200]}
+                result["partial"] = True
+                _progress({"progress": "error", "phase": "traffic",
+                           "error": result["traffic"]["error"]})
         # ---- serving lane (ISSUE 8): continuous-batching inference
         # over streaming RPC — a 2-shard GenerateService under a
         # chaos-flapped pipelined client mix (seeded transport drops
@@ -1252,6 +1292,8 @@ def main() -> None:
         result.get("backend_stats_overhead_pct"),
         "fault_goodput_ratio": result.get("fault_goodput_ratio"),
         "fault_p99_ms": result.get("fault_p99_ms"),
+        "replay_fidelity_pct": result.get("replay_fidelity_pct"),
+        "capture_overhead_pct": result.get("capture_overhead_pct"),
         "device_lane": ("error" if ("error" in lane or
                                     "lane_error" in lane)
                         else ("ok" if lane else "absent")),

@@ -92,6 +92,11 @@ LOCK_ORDER: List[Tuple[str, str]] = [
     # leaf: drained inside Channel._retry_taken_call's _arb_lock hold
     # (the one sanctioned nesting); never wraps another acquisition
     ("RetryBudget._lock",           "rpc/retry_policy.py"),
+    # leaf: the traffic recorder's queue lock — taken bare on the
+    # dispatch completion path (on_complete) and by the writer's O(1)
+    # queue swap; disk writes NEVER run under it (blocking-under-lock
+    # mutation pin in tests/test_graftlint.py)
+    ("Recorder._lock",              "traffic/capture.py"),
 ]
 
 _RANK: Dict[str, int] = {name: i for i, (name, _) in enumerate(LOCK_ORDER)}

@@ -99,6 +99,69 @@ def census_page_payload(server=None) -> dict:
     return out
 
 
+def capture_page_payload(server=None) -> dict:
+    """The /capture payload: the traffic recorder's live state —
+    active/config, sampled/written/dropped counters, rotation + disk
+    budget effects, and the corpus files ready for download. ONE
+    builder shared by the RPC builtin service and the HTTP /capture
+    handler, so the two views cannot diverge. A shard-group
+    SUPERVISOR serves the merged per-shard view instead
+    (ShardAggregator.merged_capture)."""
+    from brpc_tpu.traffic.capture import global_recorder
+    return global_recorder().snapshot()
+
+
+def capture_control(action: str, params: dict) -> dict:
+    """start/stop the recorder from a page action (shared by the HTTP
+    handler and the builtin RPC method). Raises ValueError on a bad
+    action or missing dir — the callers turn that into 400/EREQUEST."""
+    from brpc_tpu.traffic.capture import start_capture, stop_capture
+    if action == "stop":
+        return stop_capture()
+    if action != "start":
+        raise ValueError(f"unknown capture action {action!r}")
+    kw = {}
+    if params.get("rate") not in (None, ""):
+        kw["default_rate"] = float(params["rate"])
+    if params.get("max_per_second") not in (None, ""):
+        kw["max_per_second"] = int(params["max_per_second"])
+    if params.get("rotate_mb") not in (None, ""):
+        kw["rotate_bytes"] = int(params["rotate_mb"]) << 20
+    if params.get("disk_budget_mb") not in (None, ""):
+        kw["disk_budget_bytes"] = int(params["disk_budget_mb"]) << 20
+    return start_capture(dir=params.get("dir") or None, **kw)
+
+
+def capture_download_bytes(paths=None) -> bytes:
+    """The merged, download-ready corpus: every corpus file (this
+    process's capture dir, or the shard files the supervisor collected)
+    merged in arrival order into one .brpccap byte string."""
+    import os as _os
+    import tempfile as _tempfile
+
+    from brpc_tpu.traffic.capture import global_recorder
+    from brpc_tpu.traffic.corpus import merge_corpora
+    if paths is None:
+        paths = global_recorder().corpus_paths()
+    if not paths:
+        return b""
+    if len(paths) == 1:
+        with open(paths[0], "rb") as f:
+            return f.read()
+    fd, tmp = _tempfile.mkstemp(suffix=".brpccap")
+    _os.close(fd)
+    try:
+        merge_corpora(paths, tmp)
+        with open(tmp, "rb") as f:
+            return f.read()
+    finally:
+        for p in (tmp, tmp + ".idx"):
+            try:
+                _os.remove(p)
+            except OSError:
+                pass
+
+
 def status_page(server) -> dict:
     """The /status payload: server state, per-method latency windows
     (qps + p50/p90/p99/max — "which method is slow" without scraping
@@ -205,6 +268,25 @@ def add_builtin_services(server) -> None:
         # of HTTP /serving, from the ONE shared builder
         from brpc_tpu.serving.service import serving_page_payload
         return json.dumps(serving_page_payload(server),
+                          default=str).encode()
+
+    @builtin.method()
+    def capture(cntl, request):
+        # traffic-recorder state + runtime control — the builtin-RPC
+        # twin of HTTP /capture. Request bytes: "" = snapshot, "stop",
+        # or "start <dir>" (dir optional when the capture_dir flag is
+        # set). Downloads stay on the HTTP side (binary body).
+        arg = bytes(request).decode().strip() if request else ""
+        if arg:
+            verb, _, dirpart = arg.partition(" ")
+            try:
+                return json.dumps(
+                    capture_control(verb, {"dir": dirpart.strip()}),
+                    default=str).encode()
+            except (ValueError, OSError) as e:
+                cntl.set_failed(berr.EREQUEST, str(e))
+                return b""
+        return json.dumps(capture_page_payload(server),
                           default=str).encode()
 
     @builtin.method()

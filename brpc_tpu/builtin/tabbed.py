@@ -19,6 +19,7 @@ TABS = [
     ("heap", "/hotspots?type=heap"),
     ("contentions", "/contentions"),
     ("census", "/census"),
+    ("capture", "/capture"),
     ("serving", "/serving"),
     ("backends", "/backends"),
     ("lb_trace", "/lb_trace"),

@@ -496,6 +496,8 @@ class HttpProtocol(Protocol):
                     agg.merged_census(), default=str).encode()
             return 200, "application/json", json.dumps(
                 census_page_payload(server), default=str).encode()
+        if path == "/capture":
+            return self._capture(server, req, agg=agg)
         if path == "/contentions":
             from brpc_tpu.fiber.contention import contention_report
             rows = contention_report(int(req.query.get("n", "30")))
@@ -512,6 +514,57 @@ class HttpProtocol(Protocol):
         return 404, "text/plain", f"no such page {req.path}".encode()
 
     # ------------------------------------------------- introspection pages
+    def _capture(self, server, req: HttpRequest, agg=None):
+        """/capture: traffic-recorder state, runtime control
+        (?action=start&dir=...&rate=..., ?action=stop) and the merged
+        corpus download (?action=download). On a shard-group
+        SUPERVISOR, start/stop write the control file the shards apply
+        on their next dump tick, the state view merges per-shard
+        recorder snapshots, and the download merges every shard's
+        per-pid corpus files into one arrival-ordered corpus."""
+        from brpc_tpu.builtin.services import (capture_control,
+                                               capture_download_bytes,
+                                               capture_page_payload)
+        action = req.query.get("action", "")
+        if agg is not None:
+            group = agg.group
+            if action in ("start", "stop"):
+                if group is None:
+                    return (404, "text/plain",
+                            b"no supervisor for capture control")
+                seq = group.write_capture_control(action, dict(req.query))
+                return 200, "application/json", json.dumps(
+                    {"control": action, "seq": seq,
+                     "applied_within_s": group.options.dump_interval_s},
+                    default=str).encode()
+            if action == "download":
+                data = capture_download_bytes(agg.capture_paths())
+                if not data:
+                    return 404, "text/plain", b"no captured corpus"
+                return 200, "application/octet-stream", data
+            if action:
+                return (400, "text/plain",
+                        f"unknown capture action {action!r}".encode())
+            return 200, "application/json", json.dumps(
+                agg.merged_capture(), default=str).encode()
+        if action in ("start", "stop"):
+            try:
+                snap = capture_control(action, dict(req.query))
+            except (ValueError, OSError) as e:
+                return 400, "text/plain", str(e).encode()
+            return 200, "application/json", json.dumps(
+                snap, default=str).encode()
+        if action == "download":
+            data = capture_download_bytes()
+            if not data:
+                return 404, "text/plain", b"no captured corpus"
+            return 200, "application/octet-stream", data
+        if action:
+            return (400, "text/plain",
+                    f"unknown capture action {action!r}".encode())
+        return 200, "application/json", json.dumps(
+            capture_page_payload(server), default=str).encode()
+
     def _protobufs(self, server) -> bytes:
         out = {}
         for sname, svc in server.services().items():

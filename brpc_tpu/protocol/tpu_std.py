@@ -475,13 +475,17 @@ class TpuStdProtocol(Protocol):
             serve = self._serve_fn = getattr(fcm, "serve_scan", None)
         if serve is None:
             return False     # extension missing or prebuilt-stale
-        global _turbo_ok, _flag
+        global _turbo_ok, _flag, _cap_active
         if _turbo_ok is None:
             from brpc_tpu.butil.flags import flag as _flag
-            from brpc_tpu.rpc.server_dispatch import \
-                _server_turbo_ok as _turbo_ok
+            from brpc_tpu.rpc.server_dispatch import (
+                _server_turbo_ok as _turbo_ok,
+                capture_active as _cap_active)
         if not _turbo_ok(server) or _flag("rpcz_enabled") \
-                or _flag("rpc_dump_dir"):
+                or _cap_active():
+            # capture stands the all-C loop down: serve_scan never
+            # crosses the interpreter, so it cannot record — requests
+            # fall to the turbo/classic lanes, which capture in-line
             return False
         win = portal.first_host_view()
         if win is None or len(win) < HEADER_SIZE:
@@ -526,13 +530,14 @@ class TpuStdProtocol(Protocol):
         if socket.pending_responses != 0 or \
                 socket.user_data.get("bound_streams"):
             return False
-        global _turbo_ok, _flag
+        global _turbo_ok, _flag, _cap_active
         if _turbo_ok is None:
             from brpc_tpu.butil.flags import flag as _flag
-            from brpc_tpu.rpc.server_dispatch import \
-                _server_turbo_ok as _turbo_ok
+            from brpc_tpu.rpc.server_dispatch import (
+                _server_turbo_ok as _turbo_ok,
+                capture_active as _cap_active)
         if not _turbo_ok(server) or _flag("rpcz_enabled") \
-                or _flag("rpc_dump_dir") \
+                or _cap_active() \
                 or not _flag("tpu_std_cut_through"):
             return False
         if portal.size < HEADER_SIZE:
@@ -671,6 +676,7 @@ class TpuStdProtocol(Protocol):
 
 _turbo_ok = None    # lazily bound server_dispatch._server_turbo_ok
 _flag = None        # lazily bound butil.flags.flag
+_cap_active = None  # lazily bound server_dispatch.capture_active
 
 _instance: Optional[TpuStdProtocol] = None
 

@@ -641,7 +641,7 @@ class Channel:
                 and not cntl.__dict__.get("request_device_arrays") \
                 and cntl.log_id == 0:
             key = (cntl._service_name, cntl._method_name, cntl.timeout_ms,
-                   cntl.auth_token)
+                   cntl.auth_token, cntl.request_priority)
             prefix = self._meta_prefix_cache.get(key)
             if prefix is None:
                 m = pb.RpcMeta()
@@ -651,6 +651,10 @@ class Channel:
                     m.request.timeout_ms = int(cntl.timeout_ms)
                 if cntl.auth_token:
                     m.request.auth_token = cntl.auth_token
+                if cntl.request_priority:
+                    # part of the CONSTANT request submessage, so it
+                    # rides the cached prefix (key carries it above)
+                    m.request.priority = cntl.request_priority
                 prefix = m.SerializeToString()
                 if len(self._meta_prefix_cache) < 4096:
                     self._meta_prefix_cache[key] = prefix
@@ -698,6 +702,8 @@ class Channel:
             meta.request.timeout_ms = int(cntl.timeout_ms)
         if cntl.auth_token:
             meta.request.auth_token = cntl.auth_token
+        if cntl.request_priority:
+            meta.request.priority = cntl.request_priority
         meta.correlation_id = cntl.correlation_id
         meta.compress_type = cntl.compress_type
         request_bytes = cntl._request_bytes  # already compressed in call()

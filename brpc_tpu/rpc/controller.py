@@ -111,6 +111,13 @@ class Controller:
     compress_type: int = 0
     trace_id: int = 0
     span_id: int = 0
+    # request priority / cost-class tag (RpcRequestMeta.priority):
+    # client side set it BEFORE the call, server handlers read the
+    # wire value here. 0 = unset — the tag is absent on the wire and
+    # existing traffic is unchanged. Higher = more important is the
+    # convention the traffic engine's per-class reports assume; the
+    # DAGOR admission work will shed on it.
+    request_priority: int = 0
     # ---- client side scalars
     timeout_ms: Optional[float] = None
     max_retry: Optional[int] = None   # None = inherit channel option
@@ -330,6 +337,9 @@ class Controller:
         d.pop("responded_server", None)
         d.pop("used_backup", None)
         d.pop("_hedge_decision", None)     # previous call's hedge arming
+        d.pop("request_priority", None)    # per-call tag: a reused
+        #                                    controller must not carry
+        #                                    the previous call's class
         d.pop("stream", None)     # a previous call's stream must not
         #                           resurface on the new call's response
         hooks = d.get("_complete_hooks")

@@ -1,87 +1,70 @@
-"""rpc_dump: sampled request recording for offline replay
-(brpc/rpc_dump.h:50-95 + tools/rpc_replay — SURVEY.md §5 checkpoint/
-resume analog). Enable by setting the ``rpc_dump_dir`` flag; a bounded
-per-second sample of inbound requests is appended as JSONL
-({service, method, payload(b64), log_id, ts}); tools/rpc_replay.py
-re-issues them at a target QPS."""
+"""rpc_dump: back-compat shim over the traffic capture engine.
+
+The seed-era sampler (bounded per-second JSONL dumps keyed by the
+``rpc_dump_dir`` flag) grew into ``brpc_tpu/traffic/`` — a production
+recorder with per-method sampling, rotation, a disk budget, and an
+indexed recordio corpus format (.brpccap). This module keeps the old
+surface alive:
+
+  * the ``rpc_dump_dir`` / ``rpc_dump_max_requests_per_second`` flags
+    still work — an active ``rpc_dump_dir`` auto-starts the capture
+    recorder with the legacy budget (traffic/capture.py reads them);
+  * ``global_dumper.maybe_dump(...)`` still records (now into the
+    corpus format, through the recorder's sampling gates);
+  * ``load_dump(path)`` still yields (service, method, payload,
+    log_id) — from legacy JSONL files AND .brpccap corpora alike.
+
+See docs/traffic.md and migrating_from_brpc.md for the new knobs.
+"""
 
 from __future__ import annotations
 
 import base64
 import json
-import os
-import threading
-import time
-from typing import Optional
 
-from brpc_tpu.butil.flags import define_flag, flag
+from brpc_tpu.butil.flags import define_flag
+from brpc_tpu.butil.recordio import MAGIC as _RIO_MAGIC
 
-define_flag("rpc_dump_dir", "", "directory for sampled request dumps "
-            "(empty = disabled)")
+define_flag("rpc_dump_dir", "", "LEGACY alias: directory for sampled "
+            "request capture (empty = disabled); prefer capture_dir / "
+            "the /capture page")
 define_flag("rpc_dump_max_requests_per_second", 100,
-            "sampling budget per second", validator=lambda v: v >= 1)
+            "LEGACY alias: sampling budget per second (applies when "
+            "capture starts via rpc_dump_dir)", validator=lambda v: v >= 1)
 
 
 class RpcDumper:
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._fh = None
-        self._dir = None
-        self._second = 0
-        self._taken = 0
+    """API-compatible wrapper: forwards into the traffic recorder.
+    Stateless — the recorder owns files, queueing and fork hygiene."""
 
     def maybe_dump(self, service: str, method: str, payload: bytes,
                    log_id: int = 0) -> bool:
-        d = flag("rpc_dump_dir")
-        if not d:
+        from brpc_tpu.traffic.capture import global_recorder
+        rec = global_recorder()
+        if not rec.capture_enabled():
             return False
-        now = int(time.time())
-        with self._lock:
-            if now != self._second:
-                self._second, self._taken = now, 0
-            if self._taken >= flag("rpc_dump_max_requests_per_second"):
-                return False
-            self._taken += 1
-            if self._fh is None or self._dir != d:
-                os.makedirs(d, exist_ok=True)
-                path = os.path.join(d, f"rpc_dump.{os.getpid()}.jsonl")
-                self._fh = open(path, "a")
-                self._dir = d
-            self._fh.write(json.dumps({
-                "service": service, "method": method,
-                "payload": base64.b64encode(payload).decode(),
-                "log_id": log_id, "ts": time.time(),
-            }) + "\n")
-            self._fh.flush()
+        r = rec.sample_request(f"{service}.{method}", service, method,
+                               bytes(payload), None, 0, 0.0, log_id, 0)
+        if r is None:
+            return False
+        rec.record_complete(r, 0, 0.0)
         return True
 
 
 global_dumper = RpcDumper()
 
 
-def _postfork_reset() -> None:
-    """Fork hygiene: the dump file is keyed by pid — a forked worker
-    inheriting the parent's fh would interleave into the parent-pid
-    file through the shared offset; its lock may be held by a dead
-    thread. Fresh lock, lazily reopened per-pid file."""
-    global_dumper._lock = threading.Lock()
-    fh, global_dumper._fh = global_dumper._fh, None
-    global_dumper._dir = None
-    if fh is not None:
-        try:
-            fh.close()
-        except Exception:
-            pass
-
-
-from brpc_tpu.butil import postfork as _postfork  # noqa: E402
-#   (registration ships with the dumper it resets)
-
-_postfork.register("rpc.rpc_dump", _postfork_reset)
-
-
 def load_dump(path: str):
-    """Yield (service, method, payload_bytes, log_id) records."""
+    """Yield (service, method, payload_bytes, log_id) records from a
+    legacy JSONL dump or a .brpccap corpus (sniffed by magic, so old
+    scripts keep working on new captures)."""
+    with open(path, "rb") as f:
+        head = f.read(4)
+    if head == _RIO_MAGIC:
+        from brpc_tpu.traffic.corpus import CorpusReader
+        for rec in CorpusReader(path):
+            yield (rec.service, rec.method, rec.payload, rec.log_id)
+        return
     with open(path) as f:
         for line in f:
             if not line.strip():

@@ -26,7 +26,20 @@ from brpc_tpu.rpc.controller import Controller
 
 
 _UNSET = object()
-_dumper = None   # lazily bound brpc_tpu.rpc.rpc_dump.global_dumper
+_cap = None   # lazily bound brpc_tpu.traffic.capture (one-time import)
+
+
+def capture_active() -> bool:
+    """Whether the traffic recorder wants requests — the gate the
+    all-C serving lanes (serve_drain / serve_scan / cut-through) check:
+    those never cross the interpreter per request, so they cannot
+    capture and must stand down while recording is on. The Python
+    lanes (classic AND turbo) capture in-line instead of standing
+    down. Covers the legacy rpc_dump_dir flag alias."""
+    global _cap
+    if _cap is None:
+        from brpc_tpu.traffic import capture as _cap
+    return _cap.global_recorder().capture_enabled()
 
 # requests shed with ERPCTIMEDOUT because their client budget was gone
 # before handler entry (the tail-at-scale lever: a pod under load must
@@ -220,6 +233,8 @@ async def _process_request_body(proto, msg: RpcMessage, socket, server,
         d["span_id"] = meta.span_id
     if req_meta.log_id:
         d["log_id"] = req_meta.log_id
+    if req_meta.priority:
+        d["request_priority"] = req_meta.priority
     d["remote_side"] = socket.remote_endpoint
     d["local_side"] = socket.local_endpoint
     if req_meta.auth_token:
@@ -282,24 +297,35 @@ async def _process_request_body(proto, msg: RpcMessage, socket, server,
 
     # decode request payload
     request = None
+    cap_rec = None
     try:
         payload_bytes = msg.payload.to_bytes()
         if meta.compress_type:
             from brpc_tpu.rpc.compress import decompress
             payload_bytes = decompress(payload_bytes, meta.compress_type)
             cntl.compress_type = meta.compress_type  # reply in kind
-        # dump AFTER decompression so rpc_replay re-issues plaintext.
-        # Observability must never fail serving: a broken rpc_dump_dir
-        # (perms, disk full) is swallowed here, not turned into EREQUEST.
+        # capture AFTER decompression so replay re-issues plaintext.
+        # Observability must never fail serving: a broken capture dir
+        # (perms, disk full) is swallowed here, not turned into
+        # EREQUEST. The record completes below with status + latency;
+        # a request shed BEFORE this point (deadline/queue gates) is
+        # dropped at the door and deliberately not recorded.
         try:
-            global _dumper
-            if _dumper is None:
-                from brpc_tpu.rpc.rpc_dump import global_dumper as _dumper
-            _dumper.maybe_dump(req_meta.service_name,
-                               req_meta.method_name,
-                               payload_bytes, req_meta.log_id)
+            global _cap
+            if _cap is None:
+                from brpc_tpu.traffic import capture as _cap
+            rec = _cap.global_recorder()
+            if rec.capture_enabled():
+                # service/method ride as "" — the corpus writer splits
+                # the key once per method, so this path never pays the
+                # per-request pb string reads
+                cap_rec = rec.sample_request(
+                    method_key, "", "", payload_bytes, msg.attachment,
+                    getattr(msg, "arrival_ns", 0) or t0,
+                    req_meta.timeout_ms, req_meta.log_id,
+                    req_meta.priority)
         except Exception:
-            pass
+            cap_rec = None
         if method.request_class is not None:
             request = method.request_class()
             request.ParseFromString(payload_bytes)
@@ -310,6 +336,10 @@ async def _process_request_body(proto, msg: RpcMessage, socket, server,
         cntl.set_failed(berr.EREQUEST, f"cannot parse request: {e}")
         _send_error(proto, socket, cid, berr.EREQUEST, f"cannot parse request: {e}")
         finish_span(span, cntl)  # malformed traffic must show in /rpcz
+        if cap_rec is not None:   # malformed is a capture verdict too
+            _cap.global_recorder().record_complete(
+                cap_rec, berr.EREQUEST,
+                (time.monotonic_ns() - t0) / 1e3)
         cntl.flush_session_kv()
         return
     if rz:
@@ -330,6 +360,9 @@ async def _process_request_body(proto, msg: RpcMessage, socket, server,
             code, reason = verdict
             latency_us = (time.monotonic_ns() - t0) / 1e3
             server.on_request_end(method_key, latency_us, failed=True)
+            if cap_rec is not None:   # rejected sessions are corpus too
+                _cap.global_recorder().record_complete(cap_rec, code,
+                                                   latency_us)
             cntl.set_failed(code, reason)
             _send_error(proto, socket, cid, code, reason)
             finish_span(span, cntl)
@@ -404,6 +437,11 @@ async def _process_request_body(proto, msg: RpcMessage, socket, server,
 
     latency_us = (time.monotonic_ns() - t0) / 1e3
     server.on_request_end(method_key, latency_us, failed=cntl.failed())
+    if cap_rec is not None:
+        # the record carries its verdict: status + latency ride to disk
+        # on the recorder's writer thread, never this dispatch fiber
+        _cap.global_recorder().record_complete(cap_rec, cntl.error_code,
+                                           latency_us)
     # drop cancel subscriptions BEFORE the response leaves: the peer may
     # read the response and close faster than this context runs its
     # post-write cleanup, and a finished request must not hear about
@@ -486,7 +524,7 @@ def make_fast_drain(server):
     def fast_drain(sock) -> bool:
         tgt = server._native_echo
         if tgt is None or not _server_turbo_ok(server) \
-                or flag("rpcz_enabled") or flag("rpc_dump_dir") \
+                or flag("rpcz_enabled") or capture_active() \
                 or sock.input_portal or sock.input_need \
                 or sock.user_data.get("_cut_forward") is not None:
             return False
@@ -628,6 +666,19 @@ async def _drive_fast_inner(proto, socket, server, method, method_key: str,
         ab = IOBuf()
         ab.append(att)
         d["request_attachment"] = ab
+    # traffic capture, turbo flavor: the scan lane only admits metas
+    # with no timeout/priority/auth (the C walker defers the rest to
+    # the classic path), so those fields are 0 by construction here.
+    # payload/att are already bytes — the sampled path costs one
+    # sampling decision + one slots-object allocation.
+    cap_rec = None
+    if capture_active():
+        try:
+            cap_rec = _cap.global_recorder().sample_request(
+                method_key, "", "", payload, att,
+                arrival_ns or t0, 0.0, log_id, 0)
+        except Exception:
+            cap_rec = None
     request: object = payload
     if method.request_class is not None:
         try:
@@ -635,6 +686,10 @@ async def _drive_fast_inner(proto, socket, server, method, method_key: str,
             request.ParseFromString(payload)
         except Exception as e:
             server.on_request_end(method_key, 0, failed=True)
+            if cap_rec is not None:
+                _cap.global_recorder().record_complete(
+                    cap_rec, berr.EREQUEST,
+                    (time.monotonic_ns() - t0) / 1e3)
             _send_error(proto, socket, cid, berr.EREQUEST,
                         f"cannot parse request: {e}")
             return
@@ -649,6 +704,10 @@ async def _drive_fast_inner(proto, socket, server, method, method_key: str,
             # classic path): this request aged behind busy workers
             # past the shed budget — reject before the handler runs
             server.on_request_end(method_key, 0, failed=True)
+            if cap_rec is not None:
+                _cap.global_recorder().record_complete(
+                    cap_rec, berr.ELIMIT,
+                    (time.monotonic_ns() - t0) / 1e3)
             cntl._drop_cancel_subs()
             _send_error(proto, socket, cid, berr.ELIMIT,
                         "queue delay over shed budget before handler "
@@ -660,8 +719,11 @@ async def _drive_fast_inner(proto, socket, server, method, method_key: str,
         response = r
     except Exception as e:
         cntl.set_failed(berr.EINTERNAL, f"{type(e).__name__}: {e}")
-    server.on_request_end(method_key, (time.monotonic_ns() - t0) / 1e3,
-                          failed=cntl.failed())
+    latency_us = (time.monotonic_ns() - t0) / 1e3
+    server.on_request_end(method_key, latency_us, failed=cntl.failed())
+    if cap_rec is not None:
+        _cap.global_recorder().record_complete(cap_rec, cntl.error_code,
+                                           latency_us)
     # before the send: see process_request's twin comment (the peer can
     # close faster than post-write cleanup runs)
     cntl._drop_cancel_subs()
@@ -687,7 +749,10 @@ def process_request_fast(proto, socket, server, cid: int, service: str,
     the reference runs the same span compiled
     (baidu_rpc_protocol.cpp:314 ProcessRpcRequest)."""
     if server is None or not _server_turbo_ok(server) or \
-            flag("rpcz_enabled") or flag("rpc_dump_dir"):
+            flag("rpcz_enabled"):
+        # NOTE: capture no longer bounces this lane to the classic
+        # path — the turbo body records in-line (_drive_fast_inner),
+        # so the hot lane keeps serving while the recorder runs
         return process_request(
             proto, _synth_request_msg(cid, service, method_name, log_id,
                                       payload, att, arrival_ns), socket)

@@ -117,6 +117,14 @@ def write_shard_dump(dirpath: str, index: int, server, seq: int) -> None:
     if getattr(server, "_serving", None) is not None:
         from brpc_tpu.serving.service import serving_page_payload
         doc["serving"] = serving_page_payload(server)
+    from brpc_tpu.traffic.capture import \
+        global_recorder as traffic_recorder
+    rec = traffic_recorder()
+    if rec.capturing() or rec.corpus_paths():
+        # traffic-capture state rides the dump: the supervisor's
+        # /capture merges these and its download collects the per-pid
+        # corpus files each shard names here
+        doc["capture"] = rec.snapshot()
     path = os.path.join(dirpath, f"shard-{index}.json")
     tmp = path + f".tmp.{os.getpid()}"
     with open(tmp, "w", encoding="utf-8") as f:
@@ -350,6 +358,51 @@ class ShardAggregator:
         out["kv_occupancy"] = round(sum(occ) / len(occ), 4) if occ else 0.0
         return out
 
+    def merged_capture(self) -> dict:
+        """The group-wide /capture view: per-shard recorder snapshots
+        (counters sum, files union) plus the control file's last
+        command so an operator can see what the shards were told."""
+        dumps = self.read_dumps()
+        caps = [(d.get("shard"), d["capture"]) for d in dumps
+                if d.get("capture")]
+        out: dict = {"mode": "shard_group",
+                     "shards_reporting": len(caps),
+                     "active": any(c.get("active") for _, c in caps)}
+        for key in ("sampled", "written", "written_bytes",
+                    "dropped_queue", "dropped_budget", "rotations",
+                    "deleted_files", "pending"):
+            out[key] = sum(c.get(key, 0) or 0 for _, c in caps)
+        files = {}
+        for _, c in caps:
+            for f in c.get("files", ()):
+                files[f["path"]] = f
+        out["files"] = [files[p] for p in sorted(files)]
+        out["shard_breakdown"] = {
+            str(i): {"active": c.get("active"),
+                     "written": c.get("written"),
+                     "pid": c.get("pid")} for i, c in caps}
+        ctl = self._read_capture_control()
+        if ctl is not None:
+            out["control"] = ctl
+        return out
+
+    def capture_paths(self) -> List[str]:
+        """Every corpus file the shards named in their dumps — the
+        supervisor download's merge set."""
+        paths = set()
+        for d in self.read_dumps():
+            for f in (d.get("capture") or {}).get("files", ()):
+                paths.add(f["path"])
+        return sorted(p for p in paths if os.path.exists(p))
+
+    def _read_capture_control(self) -> Optional[dict]:
+        try:
+            with open(os.path.join(self.dirpath, "capture-control.json"),
+                      encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
     def merged_census(self) -> dict:
         """The group-wide resource census: per-subsystem stat dicts
         merged with the shared counter/ratio/max rules, totals and the
@@ -374,6 +427,40 @@ class ShardAggregator:
 
 
 # ------------------------------------------------------------- the group
+
+def _apply_capture_control(shard_dir: str, seen_seq: int) -> int:
+    """Shard side of the supervisor's /capture control plane: apply
+    the control file's command once per sequence bump. Failures are
+    contained — serving must not die for a capture knob."""
+    try:
+        with open(os.path.join(shard_dir, "capture-control.json"),
+                  encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return seen_seq
+    seq = int(doc.get("seq", 0) or 0)
+    if seq <= seen_seq:
+        return seen_seq
+    try:
+        from brpc_tpu.traffic.capture import start_capture, stop_capture
+        if doc.get("action") == "start":
+            kw = {}
+            if doc.get("rate") not in (None, ""):
+                kw["default_rate"] = float(doc["rate"])
+            if doc.get("max_per_second") not in (None, ""):
+                kw["max_per_second"] = int(doc["max_per_second"])
+            if doc.get("rotate_mb") not in (None, ""):
+                kw["rotate_bytes"] = int(doc["rotate_mb"]) << 20
+            if doc.get("disk_budget_mb") not in (None, ""):
+                kw["disk_budget_bytes"] = \
+                    int(doc["disk_budget_mb"]) << 20
+            start_capture(dir=doc.get("dir"), **kw)
+        elif doc.get("action") == "stop":
+            stop_capture()
+    except (ValueError, OSError):
+        pass
+    return seq
+
 
 class _ShardState:
     __slots__ = ("index", "pid", "state", "restarts", "consecutive",
@@ -424,6 +511,7 @@ class ShardGroup:
         self._admin_server = None
         self._admin_endpoint: Optional[EndPoint] = None
         self._rng = random.Random(self.options.seed)
+        self._capture_ctl_seq = 0
         self.shard_dir = self.options.shard_dir
         self._own_shard_dir = self.options.shard_dir is None
         self.aggregator: Optional[ShardAggregator] = None
@@ -491,6 +579,33 @@ class ShardGroup:
     def shard_pids(self) -> List[int]:
         with self._lock:
             return [st.pid for st in self._shards if st.state == "running"]
+
+    def write_capture_control(self, action: str, params: dict) -> int:
+        """The supervisor's capture control plane: shards have no
+        admin port of their own, but they already visit the shard dir
+        every dump tick — a sequenced control file there reaches all
+        of them within one dump interval, atomically (tmp + rename,
+        the dump files' own discipline). Returns the new sequence."""
+        if action not in ("start", "stop"):
+            raise ValueError(f"unknown capture action {action!r}")
+        with self._lock:
+            self._capture_ctl_seq += 1
+            seq = self._capture_ctl_seq
+        doc = {"seq": seq, "action": action}
+        if action == "start":
+            # one shared dir: per-pid file names keep shards apart
+            doc["dir"] = params.get("dir") or \
+                os.path.join(self.shard_dir, "capture")
+            for k in ("rate", "max_per_second", "rotate_mb",
+                      "disk_budget_mb"):
+                if params.get(k) not in (None, ""):
+                    doc[k] = params[k]
+        path = os.path.join(self.shard_dir, "capture-control.json")
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return seq
 
     def group_status(self) -> dict:
         with self._lock:
@@ -700,6 +815,7 @@ class ShardGroup:
 
         parent = os.getppid()
         seq = 0
+        ctl_seen = 0
         interval = max(0.05, self.options.dump_interval_s)
         while not stop_ev.is_set():
             seq += 1
@@ -707,6 +823,7 @@ class ShardGroup:
                 write_shard_dump(self.shard_dir, index, server, seq)
             except OSError:
                 pass   # disk hiccup: serving must not die for a dump
+            ctl_seen = _apply_capture_control(self.shard_dir, ctl_seen)
             if os.getppid() != parent:
                 break  # supervisor died without SIGTERM: orphan exit
             stop_ev.wait(interval)

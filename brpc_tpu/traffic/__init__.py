@@ -1,0 +1,22 @@
+"""Traffic engine: production request capture, time-warped replay, and
+mixed-priority press (the reference's layer-7 rpc_dump + rpc_replay +
+rpc_press + rpc_view tool set, rebuilt as a first-class subsystem).
+
+  capture.py — sampled production recorder hooked into both server
+               dispatch lanes; bounded disk, rotation, postfork-safe
+               per-shard files, runtime control via the /capture page
+  corpus.py  — the indexed .brpccap recordio corpus format (reader
+               tolerates torn tails; writer keeps a sidecar index)
+  replay.py  — open-loop replay/press engine: recorded-interval x
+               time-warp / constant-qps / Poisson pacing, recorded
+               deadline + priority preservation, per-class reports
+
+The thin CLIs live in tools/: rpc_press.py (synthetic press),
+rpc_replay.py (corpus replay), rpc_view.py (corpus inspector).
+"""
+
+from brpc_tpu.traffic.corpus import (CapturedRequest, CorpusReader,
+                                     CorpusWriter, merge_corpora)
+
+__all__ = ["CapturedRequest", "CorpusReader", "CorpusWriter",
+           "merge_corpora"]

@@ -931,6 +931,96 @@ class TestEventWaitNotSleep:
         assert list(EventWaitNotSleepRule().finalize(ctx_ok)) == []
 
 
+class TestTrafficCaptureLint:
+    """ISSUE 11 pins on the traffic recorder: the capture subsystem's
+    fork hygiene, its never-block-the-dispatch-path lock discipline,
+    and its writer thread's no-lazy-import rule must all be enforced
+    by the analyzers — each pin mutates the REAL module and asserts
+    the rule fires (and that the shipped module stays clean)."""
+
+    PATH = os.path.join(REPO_ROOT, "brpc_tpu", "traffic", "capture.py")
+    REL = "brpc_tpu/traffic/capture.py"
+
+    def test_mutation_dropping_postfork_registration_fires(self):
+        """Strip the postfork.register line: a forked shard inheriting
+        the parent's recorder queue/writer-fd would interleave into
+        the parent-pid corpus through the shared file offset — the
+        postfork-reset rule must keep that registration unloseable."""
+        from brpc_tpu.analysis.core import Context, SourceFile
+        from brpc_tpu.analysis.rules.postfork_reset import PostforkResetRule
+        src = open(self.PATH).read()
+        target = [ln for ln in src.splitlines()
+                  if "postfork.register(" in ln]
+        assert len(target) == 1, target
+        mutated = src.replace(target[0] + "\n", "")
+        sf = SourceFile(self.PATH, self.REL, mutated)
+        found = list(PostforkResetRule().check(sf, Context([sf])))
+        assert any(f.rule == "postfork-reset"
+                   and "_recorder" in f.message for f in found), \
+            [f.format() for f in found]
+        sf_ok = SourceFile(self.PATH, self.REL, src)
+        assert list(PostforkResetRule().check(sf_ok,
+                                              Context([sf_ok]))) == []
+
+    def test_mutation_waiting_under_recorder_lock_fires(self):
+        """Pull the writer's parked wait under Recorder._lock: every
+        request completing on the dispatch side enqueues under that
+        lock, so a wait inside it stalls the dispatch path for the
+        whole tick — the blocking-under-lock rule must fire. (Disk
+        writes live outside the lock by the same discipline; the
+        queue-swap drain keeps the hold O(1).)"""
+        from brpc_tpu.analysis.rules.lock_graph import (
+            BlockingUnderLockRule,
+        )
+        src = open(self.PATH).read()
+        line = "            self._wake.wait(0.1)\n"
+        assert line in src
+        mutated = src.replace(
+            line, "            with self._lock:\n"
+                  "                self._wake.wait(0.1)\n", 1)
+        sf, ctx = _ctx_for(self.PATH, self.REL, mutated)
+        found = list(BlockingUnderLockRule().finalize(ctx))
+        assert any(f.rule == "blocking-under-lock"
+                   and "Recorder._lock" in f.message
+                   for f in found), [f.format() for f in found]
+        sf_ok, ctx_ok = _ctx_for(self.PATH, self.REL, src)
+        assert list(BlockingUnderLockRule().finalize(ctx_ok)) == []
+
+    def test_mutation_lazy_import_in_writer_loop_fires(self):
+        """Introduce a lazy import inside _record_writer_loop: the
+        capture writer is recorder-thread code (the rule's 'record'
+        marker matches it by construction), and a lazy import there
+        opens module files on that thread at drain time — the PR 8
+        fd-churn flake's shape. The rule must fire; the shipped module
+        binds everything at module load and stays clean."""
+        from brpc_tpu.analysis.rules.sampler_import import (
+            SamplerNoLazyImportRule,
+        )
+        src = open(self.PATH).read()
+        needle = ("            self._wake.wait(0.1)\n"
+                  "            self._wake.clear()\n")
+        assert needle in src
+        mutated = src.replace(
+            needle, needle + "            from brpc_tpu.rpc import "
+                             "server_dispatch as _sd\n", 1)
+        sf, ctx = _ctx_for(self.PATH, self.REL, mutated)
+        found = list(SamplerNoLazyImportRule().finalize(ctx))
+        assert any(f.rule == "sampler-no-lazy-import"
+                   and "_record_writer_loop" in f.message
+                   for f in found), [f.format() for f in found]
+        sf_ok, ctx_ok = _ctx_for(self.PATH, self.REL, src)
+        assert list(SamplerNoLazyImportRule().finalize(ctx_ok)) == []
+
+    def test_recorder_lock_ranked_in_lock_order(self):
+        """The recorder lock is a declared LEAF in the racelane's
+        LOCK_ORDER registry (and docs table row 34): dispatch-side
+        enqueues take it bare, and nothing may nest inside it."""
+        from brpc_tpu.analysis.racelane import LOCK_ORDER
+        names = [n for n, _ in LOCK_ORDER]
+        assert "Recorder._lock" in names
+        assert names.index("Recorder._lock") == len(names) - 1
+
+
 class TestMemoryviewRelease:
     def test_seeded_violations(self):
         active, _ = _lint("bad_memoryview_release.py")

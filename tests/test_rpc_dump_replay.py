@@ -36,18 +36,19 @@ def test_dump_view_replay_roundtrip(tmp_path):
             c = ch.call_sync("DumpSvc", "Echo", f"orig-{i}".encode())
             assert not c.failed(), c.error_text
         ch.close()
-        # the dumper buffers via append-per-request; find the dump file
+        # the legacy flag now routes into the traffic capture engine:
+        # a .brpccap corpus appears in the dir, written asynchronously
+        # by the recorder's writer thread — wait for all 5 records
+        from brpc_tpu.traffic.corpus import CorpusReader, corpus_files
         deadline = time.monotonic() + 5
         files = []
         while time.monotonic() < deadline:
-            files = [p for p in os.listdir(tmp_path)]
-            if files:
-                with open(tmp_path / files[0]) as f:
-                    if len(f.read().splitlines()) >= 5:
-                        break
+            files = corpus_files(str(tmp_path))
+            if files and len(CorpusReader(files[0]).records()) >= 5:
+                break
             time.sleep(0.1)
-        assert files, "no dump file written"
-        dump = str(tmp_path / files[0])
+        assert files, "no capture corpus written"
+        dump = files[0]
 
         # rpc_view lists the records
         r = subprocess.run(
@@ -77,5 +78,7 @@ def test_dump_view_replay_roundtrip(tmp_path):
             f"orig-{i}".encode() for i in range(5)), replayed
     finally:
         set_flag("rpc_dump_dir", old_dir)
+        from brpc_tpu.traffic.capture import stop_capture
+        stop_capture()          # the legacy alias auto-started it
         server.stop()
         server.join(2)

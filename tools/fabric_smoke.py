@@ -30,6 +30,7 @@ stamped at the arming decision, and R >= P must hold for all of them
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import signal
@@ -45,7 +46,7 @@ NODES = 3
 
 
 # ------------------------------------------------------------- node
-def run_node(port: int) -> None:
+def run_node(port: int, shards: int = 1) -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from brpc_tpu import fiber
     from brpc_tpu.rpc import Server, ServerOptions, Service
@@ -73,7 +74,14 @@ def run_node(port: int) -> None:
         return b"ok"
 
     server.add_service(svc)
-    ep = server.start(f"tcp://127.0.0.1:{port}")
+    # --shards N: the node is a REAL shard group (reuseport workers
+    # behind one port, supervised restarts) — the ROADMAP's ask that
+    # the storm run over the deployment shape production uses. The
+    # supervisor prints the port; SIGKILLing it orphans the shards,
+    # which notice within a dump tick and drain (the storm's kill is
+    # then a whole-NODE death, exactly the blast radius it models).
+    ep = server.start(f"tcp://127.0.0.1:{port}",
+                      num_shards=shards if shards > 1 else None)
     print(f"PORT {ep.port}", flush=True)
     from spawn_util import parent_death_watchdog_loop
     parent_death_watchdog_loop()
@@ -90,17 +98,24 @@ class PhaseStats:
         self.samples: list = []
         self.attempts = 0           # 1 + retries + hedge per call
         self.lat_ms: list = []
+        self.by_priority: dict = {}   # prio -> [ok, errors]
         self.t0 = time.perf_counter()
         self.elapsed = 0.0
 
-    def record(self, failed, attempts: int, lat_ms: float) -> None:
+    def record(self, failed, attempts: int, lat_ms: float,
+               priority: int = 0) -> None:
         with self.lock:
+            row = self.by_priority.get(priority)
+            if row is None:
+                row = self.by_priority[priority] = [0, 0]
             if failed:
                 self.errors += 1
+                row[1] += 1
                 self.error_codes[failed] = \
                     self.error_codes.get(failed, 0) + 1
             else:
                 self.ok += 1
+                row[0] += 1
                 self.lat_ms.append(lat_ms)
             self.attempts += attempts
 
@@ -120,33 +135,70 @@ class PhaseStats:
             "p99_ms": round(p99, 2) if p99 is not None else None,
             "error_codes": dict(self.error_codes),
             "error_samples": list(self.samples),
+            # per-priority goodput: the corpus-fed storm's evidence
+            # that no class silently starved (per-class qps needs the
+            # phase window, stitched in by the report builder)
+            "per_priority": {str(p): {"ok": row[0], "errors": row[1]}
+                             for p, row in sorted(
+                                 self.by_priority.items())},
         }
 
 
-def _spawn_node(port: int = 0):
+def _spawn_node(port: int = 0, shards: int = 1):
     from spawn_util import spawn_port_server
-    proc, got = spawn_port_server(
-        [os.path.abspath(__file__), "--node", str(port)], wall_s=30.0)
+    argv = [os.path.abspath(__file__), "--node", str(port)]
+    if shards > 1:
+        argv += ["--shards", str(shards)]
+    proc, got = spawn_port_server(argv, wall_s=30.0)
     if proc is None:
         raise RuntimeError("fabric node spawn failed")
     return proc, got
 
 
-def _set_delay(port: int, delay_ms: float) -> None:
+def _set_delay(port: int, delay_ms: float, fanout: int = 1) -> None:
+    """``fanout`` > 1 for shard-group nodes: the kernel balances each
+    fresh connection onto SOME reuseport shard, so repeating the
+    control RPC over fresh connections reaches every shard with high
+    probability (the delay state is per-process)."""
     from brpc_tpu.rpc import Channel, ChannelOptions
-    ch = Channel(f"tcp://127.0.0.1:{port}",
-                 ChannelOptions(timeout_ms=2000, share_connections=False,
-                                name="fabric-control"))
-    try:
-        cntl = ch.call_sync("Bench", "SetDelay", str(delay_ms).encode())
-        if cntl.failed():
-            raise RuntimeError(f"SetDelay failed: {cntl.error_text}")
-    finally:
-        ch.close()
+    for _ in range(fanout):
+        ch = Channel(f"tcp://127.0.0.1:{port}",
+                     ChannelOptions(timeout_ms=2000,
+                                    share_connections=False,
+                                    name="fabric-control"))
+        try:
+            cntl = ch.call_sync("Bench", "SetDelay",
+                                str(delay_ms).encode())
+            if cntl.failed():
+                raise RuntimeError(f"SetDelay failed: {cntl.error_text}")
+        finally:
+            ch.close()
+
+
+def load_storm_corpus(arg: str):
+    """--corpus records for the storm. 'auto' synthesizes a seeded
+    mixed-size mixed-priority corpus; anything else reads a .brpccap
+    file/dir (a /capture download). The storm nodes serve the echo
+    fabric, so records are RE-TARGETED onto Bench.PyEcho — what the
+    corpus contributes is the realistic payload-size/priority/
+    deadline MIX, which is exactly what synthetic uniform echo never
+    had."""
+    from brpc_tpu.traffic.replay import parse_mix, synthesize_records
+    if arg == "auto":
+        return synthesize_records(
+            2048, parse_mix("16:0.5,512:0.3,4096:0.2"),
+            parse_mix("1:0.6,5:0.3,9:0.1"), qps=1000.0, mode="poisson",
+            seed=23, service="Bench", method="PyEcho")
+    from brpc_tpu.traffic.corpus import read_corpus
+    recs = read_corpus(arg)
+    if not recs:
+        raise RuntimeError(f"empty corpus {arg!r}")
+    return recs
 
 
 def run_storm(seed: int = 7, conns: int = 4, inflight: int = 8,
-              windows=(1.5, 2.0, 0.8, 1.0), verbose: bool = True) -> dict:
+              windows=(1.5, 2.0, 0.8, 1.0), verbose: bool = True,
+              shards: int = 1, corpus_records=None) -> dict:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from brpc_tpu.butil.flags import set_flag
     from brpc_tpu.rpc import ChannelOptions, ClusterChannel
@@ -157,7 +209,7 @@ def run_storm(seed: int = 7, conns: int = 4, inflight: int = 8,
     procs = {}
     ports = []
     for _ in range(NODES):
-        proc, port = _spawn_node()
+        proc, port = _spawn_node(shards=shards)
         procs[port] = proc
         ports.append(port)
     naming = "list://" + ",".join(f"tcp://127.0.0.1:{p}" for p in ports)
@@ -180,9 +232,23 @@ def run_storm(seed: int = 7, conns: int = 4, inflight: int = 8,
     live = [conns * inflight]
     done_ev = threading.Event()
 
+    corpus_idx = itertools.count()
+
     def issue(i: int) -> None:
         ch = chs[i]
         t0 = time.perf_counter()
+        payload = b"q"
+        prio = 0
+        cntl = None
+        if corpus_records is not None:
+            rec = corpus_records[next(corpus_idx)
+                                 % len(corpus_records)]
+            payload = rec.payload
+            prio = rec.priority
+            if prio:
+                from brpc_tpu.rpc.controller import Controller
+                cntl = Controller()
+                cntl.request_priority = prio
 
         def _done(cntl) -> None:
             # attribute to the phase the call COMPLETED in: a call
@@ -198,7 +264,8 @@ def run_storm(seed: int = 7, conns: int = 4, inflight: int = 8,
                     f"{cntl.error_code}:{cntl.error_text[:90]}:"
                     f"tries={cntl.current_try}:bk={cntl.used_backup}")
             ph.record(cntl.error_code if cntl.failed() else False,
-                      attempts, (time.perf_counter() - t0) * 1e3)
+                      attempts, (time.perf_counter() - t0) * 1e3,
+                      priority=prio)
             if not stop[0]:
                 issue(i)
             else:
@@ -208,9 +275,9 @@ def run_storm(seed: int = 7, conns: int = 4, inflight: int = 8,
                         done_ev.set()
 
         try:
-            ch.call("Bench", "PyEcho", b"q", done=_done)
+            ch.call("Bench", "PyEcho", payload, cntl=cntl, done=_done)
         except Exception:
-            stats[current[0]].record("issue", 1, 0.0)
+            stats[current[0]].record("issue", 1, 0.0, priority=prio)
             with stats["drain"].lock:
                 live[0] -= 1
                 if live[0] <= 0:
@@ -239,7 +306,7 @@ def run_storm(seed: int = 7, conns: int = 4, inflight: int = 8,
     # flips FIRST: the kill's in-flight casualties belong to the fault
     # window, not to a baseline that was already over)
     enter("fault")
-    _set_delay(stall_node, 150.0)
+    _set_delay(stall_node, 150.0, fanout=shards * 4 if shards > 1 else 1)
     procs[kill_node].send_signal(signal.SIGKILL)
     time.sleep(windows[1])
     # hedge evidence BEFORE later phases can age it out of the ring
@@ -264,7 +331,9 @@ def run_storm(seed: int = 7, conns: int = 4, inflight: int = 8,
     # ---- recover: respawn all three on their OLD ports
     for port in ports:
         procs[port].wait(5)
-        proc, got = _spawn_node(port)
+        # same topology as the original nodes: a --shards storm must
+        # recover onto shard-group nodes, not single-process stand-ins
+        proc, got = _spawn_node(port, shards=shards)
         if got != port:
             raise RuntimeError(f"respawn moved port {port} -> {got}")
         procs[port] = proc
@@ -293,6 +362,9 @@ def run_storm(seed: int = 7, conns: int = 4, inflight: int = 8,
     report = {
         "seed": seed,
         "ports": ports,
+        "shards": shards,
+        "corpus_records": len(corpus_records)
+        if corpus_records is not None else 0,
         "killed": kill_node,
         "stalled": stall_node,
         "revived": revived,
@@ -303,6 +375,18 @@ def run_storm(seed: int = 7, conns: int = 4, inflight: int = 8,
         "hedges_armed": len(hedge_pairs),
         "hedges_past_budget": sum(1 for r, p in hedge_pairs if r < p),
     }
+    # per-priority goodput ratios, fault vs baseline (the corpus-fed
+    # storm's per-class evidence; uniform-priority storms show {"0"})
+    base_el = stats["baseline"].elapsed or 1.0
+    fault_el = stats["fault"].elapsed or 1.0
+    ratios = {}
+    for p, row in out["baseline"]["per_priority"].items():
+        bq = row["ok"] / base_el
+        fq = out["fault"]["per_priority"].get(
+            p, {"ok": 0})["ok"] / fault_el
+        if bq > 0:
+            ratios[p] = round(fq / bq, 3)
+    report["per_priority_goodput_ratio"] = ratios
     for ch in chs:
         ch.close()
     for proc in procs.values():
@@ -347,24 +431,31 @@ def assert_storm(rep: dict) -> list:
 
 def main() -> int:
     args = sys.argv[1:]
+    shards = int(args[args.index("--shards") + 1]) \
+        if "--shards" in args else 1
     if args and args[0] == "--node":
-        run_node(int(args[1]) if len(args) > 1 else 0)
+        run_node(int(args[1]) if len(args) > 1 else 0, shards=shards)
         return 0
     seed = int(os.environ.get("BRPC_TPU_FABRIC_SEED", "7"))
     if "--seed" in args:
         seed = int(args[args.index("--seed") + 1])
+    corpus_records = None
+    if "--corpus" in args:
+        corpus_records = load_storm_corpus(
+            args[args.index("--corpus") + 1])
+    kw = dict(seed=seed, shards=shards, corpus_records=corpus_records)
     if "--smoke" in args:
-        rep = run_storm(seed=seed, verbose=False)
+        rep = run_storm(verbose=False, **kw)
         problems = assert_storm(rep)
         rep["problems"] = problems
         print(json.dumps(rep), flush=True)
         return 1 if problems else 0
     if "--bench" in args:
-        rep = run_storm(seed=seed, verbose=False)
+        rep = run_storm(verbose=False, **kw)
         rep["problems"] = assert_storm(rep)
         print(json.dumps(rep), flush=True)
         return 0
-    rep = run_storm(seed=seed)
+    rep = run_storm(**kw)
     print(json.dumps(rep, indent=2), flush=True)
     problems = assert_storm(rep)
     for p in problems:

@@ -559,6 +559,36 @@ def gate_fabric_smoke() -> dict:
     return out
 
 
+def gate_traffic_smoke() -> dict:
+    """Traffic-engine smoke (tools/traffic_smoke.py, ~4s): record a
+    paced mixed-size/mixed-priority burst through the live capture
+    path, assert the corpus reproduces per-method counts EXACTLY (and
+    leaks nothing in the recorder), then replay it at 2x time-warp and
+    assert replayed counts match with the wall time landing near half
+    the recorded span (interarrival error in tolerance) and schedule
+    fidelity >= 85. A subprocess so a wedged replay cannot hang the
+    gate; BRPC_TPU_TRAFFIC_SMOKE=0 skips."""
+    if os.environ.get("BRPC_TPU_TRAFFIC_SMOKE", "1") == "0":
+        return {"ok": True, "skipped": "BRPC_TPU_TRAFFIC_SMOKE=0"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "traffic_smoke.py"), "--smoke"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    out: dict = {"ok": proc.returncode == 0}
+    try:
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+        for k in ("recorded", "replayed", "replay_fidelity_pct",
+                  "replay_elapsed_s", "recorded_span_s", "elapsed_s"):
+            if k in report:
+                out[k] = report[k]
+        if proc.returncode != 0:
+            out["problems"] = report.get("problems")
+    except (ValueError, IndexError):
+        out["ok"] = False
+        out["error"] = (proc.stdout + proc.stderr)[-500:]
+    return out
+
+
 def gate_perf_smoke() -> dict:
     """Fast hot-path perf gate: raw-socket-normalized small-RPC and
     1MB-echo ratios must stay within 30% of the BENCH_r05-era floors.
@@ -629,6 +659,7 @@ def run_gate() -> int:
                      ("cluster_top", gate_cluster_top),
                      ("serving_smoke", gate_serving_smoke),
                      ("fabric_smoke", gate_fabric_smoke),
+                     ("traffic_smoke", gate_traffic_smoke),
                      ("perf_smoke", gate_perf_smoke)):
         try:
             report[name] = fn()

@@ -1,115 +1,218 @@
-"""rpc_press: load generator (tools/rpc_press in the reference).
+"""rpc_press: synthetic load press (tools/rpc_press in the reference),
+rebuilt over the traffic engine's open-loop generator.
 
-    python tools/rpc_press.py tcp://127.0.0.1:8000 EchoService Echo \
-        --qps 5000 --duration 10 --payload-size 64 --fibers 16
+    python tools/rpc_press.py tcp://127.0.0.1:8000 Bench PyEcho \
+        --qps 2000 --duration 10 --size-mix 64:0.8,4096:0.2 \
+        --priority-mix 1:0.9,9:0.1 --procs 4
+
+Sizes and priority tags draw from weighted mixes (seeded), pacing is
+constant-qps or Poisson, and the press is OPEN loop: the schedule is
+fixed up front and a slowing server shows up as latency/errors, not as
+silently reduced load. --save writes the synthetic corpus to .brpccap
+first — the same format capture records and rpc_replay/rpc_view read,
+so a press scenario is a shareable artifact, not a command line.
+
+Legacy aliases kept from the seed tool: --payload-size (a one-entry
+size mix) and --fibers (connection count).
 """
 
+from __future__ import annotations
+
 import argparse
+import json
+import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/tools", 1)[0])
-
-from brpc_tpu import fiber
-from brpc_tpu.bvar import LatencyRecorder
-from brpc_tpu.rpc import Channel, ChannelOptions
+BASE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, BASE)
 
 
-def main(argv=None) -> None:
-    ap = argparse.ArgumentParser(description="brpc_tpu load generator")
-    ap.add_argument("address")
-    ap.add_argument("service")
-    ap.add_argument("method")
-    ap.add_argument("--qps", type=float, default=0, help="0 = unthrottled")
-    ap.add_argument("--duration", type=float, default=10.0)
-    ap.add_argument("--payload-size", type=int, default=64)
-    ap.add_argument("--fibers", type=int, default=16)
-    ap.add_argument("--timeout-ms", type=float, default=2000)
-    ap.add_argument("--protocol", choices=["tpu_std", "http"],
-                    default="tpu_std",
-                    help="http presses POST /<service>/<method> through "
-                         "the framework HttpClient (one keep-alive "
-                         "connection per fiber)")
-    args = ap.parse_args(argv)
+def build_records(args, worker: int = 0, nprocs: int = 1):
+    from brpc_tpu.traffic.replay import parse_mix, synthesize_records
+    n = args.count or max(1, int(args.qps * args.duration))
+    n_slice = len(range(worker, n, nprocs))
+    return synthesize_records(
+        n_slice, parse_mix(args.size_mix), parse_mix(args.priority_mix),
+        qps=args.qps / nprocs, mode=args.mode,
+        seed=args.seed + worker, service=args.service,
+        method=args.method, timeout_ms=args.timeout_ms)
 
-    payload = b"x" * args.payload_size
-    lat = LatencyRecorder()
-    stop_at = time.monotonic() + args.duration
+
+def run_worker(args) -> dict:
+    from brpc_tpu.traffic.replay import PaceSpec, run_open_loop
+    records = build_records(args, args.worker, args.nprocs)
+    pace = PaceSpec("recorded", warp=1.0)   # stamps carry the pacing
+    return run_open_loop(records, args.address, pace, conns=args.conns,
+                         default_timeout_ms=args.timeout_ms or 2000.0,
+                         bucket_width_s=args.bucket_width)
+
+
+def run_http_press(args) -> int:
+    """The seed tool's HTTP mode, kept verbatim in spirit: a closed
+    fiber loop of keep-alive POSTs per connection (one HttpClient per
+    fiber — HTTP/1.1 keep-alive is FIFO per connection, sharing one
+    would serialize the press). The open-loop engine is tpu_std-only;
+    this branch exists for `--protocol http` back-compat."""
+    import time as _time
+
+    from brpc_tpu import fiber
+    from brpc_tpu.protocol.http_client import HttpClient, HttpClientError
+    from brpc_tpu.traffic.replay import parse_mix
+
+    sizes = parse_mix(args.size_mix) or [(64, 1.0)]
+    payload = b"x" * sizes[0][0]
+    path = f"/{args.service}/{args.method}"
+    # HttpClient speaks the transport address space (tcp://, like the
+    # seed tool's invocations); accept an http:// spelling too
+    if args.address.startswith("http://"):
+        args.address = "tcp://" + args.address[len("http://"):]
+    stop_at = _time.monotonic() + args.duration
     stats = {"ok": 0, "fail": 0}
-    interval = (args.fibers / args.qps) if args.qps > 0 else 0.0
-
-    # per-protocol issue function; ONE shared loop owns timing, stats,
-    # and pacing so the variants cannot diverge
-    if args.protocol == "http":
-        from brpc_tpu.protocol.http_client import HttpClient, HttpClientError
-
-        path = f"/{args.service}/{args.method}"
-
-        def make_once():
-            # own client per fiber: HTTP/1.1 keep-alive is FIFO per
-            # connection, so sharing one would serialize the press.
-            # request_async keeps the worker THREAD free (a blocking
-            # request here would park every scheduler worker).
-            cl = HttpClient(args.address, timeout_s=args.timeout_ms / 1e3)
-
-            async def once() -> bool:
-                try:
-                    status, _, _ = await cl.request_async("POST", path,
-                                                          body=payload)
-                    return status == 200
-                except HttpClientError:
-                    return False
-
-            once.close = cl.close
-            return once
-    else:
-        ch = Channel(args.address,
-                     ChannelOptions(timeout_ms=args.timeout_ms))
-
-        def make_once():
-            async def once() -> bool:
-                cntl = await ch.call_async(args.service, args.method,
-                                           payload)
-                return not cntl.failed()
-
-            once.close = lambda: None
-            return once
+    interval = (args.conns / args.qps) if args.qps > 0 else 0.0
 
     async def worker():
-        once = make_once()
+        cl = HttpClient(args.address, timeout_s=args.timeout_ms / 1e3)
         try:
-            while time.monotonic() < stop_at:
-                t0 = time.perf_counter_ns()
-                if await once():
-                    stats["ok"] += 1
-                    lat.record((time.perf_counter_ns() - t0) / 1e3)
-                else:
+            while _time.monotonic() < stop_at:
+                t0 = _time.perf_counter()
+                try:
+                    status, _, _ = await cl.request_async(
+                        "POST", path, body=payload)
+                    stats["ok" if status == 200 else "fail"] += 1
+                except HttpClientError:
                     stats["fail"] += 1
                 if interval:
-                    spent = (time.perf_counter_ns() - t0) / 1e9
+                    spent = _time.perf_counter() - t0
                     if spent < interval:
                         await fiber.sleep(interval - spent)
         finally:
-            once.close()
+            cl.close()
 
-    fibers = [fiber.spawn(worker) for _ in range(args.fibers)]
-    last_ok = 0
-    while time.monotonic() < stop_at:
-        time.sleep(1.0)
-        ok = stats["ok"]
-        print(f"qps={ok - last_ok} ok={ok} fail={stats['fail']} "
-              f"avg={lat.latency():.0f}us p99={lat.latency_percentile(0.99):.0f}us")
-        last_ok = ok
+    fibers = [fiber.spawn(worker) for _ in range(args.conns)]
     for f in fibers:
-        f.join(args.timeout_ms / 1e3 + 5)
+        f.join(args.duration + args.timeout_ms / 1e3 + 5)
     total = stats["ok"] + stats["fail"]
-    print(f"\ntotal={total} ok={stats['ok']} fail={stats['fail']} "
-          f"qps={stats['ok']/args.duration:.0f} avg={lat.latency():.0f}us "
-          f"p50={lat.latency_percentile(0.5):.0f}us "
-          f"p99={lat.latency_percentile(0.99):.0f}us "
-          f"p999={lat.latency_percentile(0.999):.0f}us "
-          f"max={lat.max_latency():.0f}us")
+    print(f"total={total} ok={stats['ok']} fail={stats['fail']} "
+          f"qps={stats['ok'] / args.duration:.0f}", flush=True)
+    return 0 if stats["ok"] > 0 else 1
+
+
+def run_multiproc(args) -> dict:
+    from brpc_tpu.traffic.replay import merge_reports
+    width = max(args.duration / 200.0, min(0.1, args.duration / 10.0))
+    procs = []
+    for i in range(args.procs):
+        argv = [sys.executable, os.path.abspath(__file__),
+                args.address, args.service, args.method,
+                "--qps", str(args.qps), "--duration", str(args.duration),
+                "--count", str(args.count), "--mode", args.mode,
+                "--size-mix", args.size_mix,
+                "--priority-mix", args.priority_mix,
+                "--timeout-ms", str(args.timeout_ms),
+                "--seed", str(args.seed), "--conns", str(args.conns),
+                "--bucket-width", str(width),
+                "--worker", str(i), "--nprocs", str(args.procs)]
+        procs.append(subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                      stderr=subprocess.DEVNULL))
+    reports = []
+    deadline = time.monotonic() + args.duration + 60.0
+    dead = 0
+    for p in procs:
+        try:
+            out, _ = p.communicate(
+                timeout=max(5.0, deadline - time.monotonic()))
+            reports.append(json.loads(out.strip().splitlines()[-1]))
+        except Exception:
+            dead += 1
+            try:
+                p.kill()
+            except Exception:
+                pass
+    merged = merge_reports(reports)
+    merged["dead_workers"] = dead
+    return merged
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("address")
+    ap.add_argument("service")
+    ap.add_argument("method")
+    ap.add_argument("--qps", type=float, default=1000.0,
+                    help="offered rate (open loop)")
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--count", type=int, default=0,
+                    help="request count (overrides qps*duration)")
+    ap.add_argument("--mode", choices=["qps", "poisson"], default="qps")
+    ap.add_argument("--size-mix", default="64:1.0",
+                    help="payload sizes, 'bytes:weight,...'")
+    ap.add_argument("--priority-mix", default="0:1.0",
+                    help="priority tags, 'prio:weight,...'")
+    ap.add_argument("--timeout-ms", type=float, default=2000.0)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--procs", type=int, default=1)
+    ap.add_argument("--conns", type=int, default=4)
+    ap.add_argument("--save", default="",
+                    help="also write the synthetic corpus here (.brpccap)")
+    ap.add_argument("--json", action="store_true")
+    # legacy seed-tool aliases
+    ap.add_argument("--protocol", choices=["tpu_std", "http"],
+                    default="tpu_std",
+                    help="legacy: http presses POST /<service>/<method>"
+                         " through the framework HttpClient (closed-"
+                         "loop fiber press, the seed tool's shape)")
+    ap.add_argument("--payload-size", type=int, default=0,
+                    help="legacy: single payload size (= --size-mix N:1)")
+    ap.add_argument("--fibers", type=int, default=0,
+                    help="legacy: connection count (= --conns)")
+    ap.add_argument("--worker", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--nprocs", type=int, default=1,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--bucket-width", type=float, default=0.0,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.payload_size:
+        args.size_mix = f"{args.payload_size}:1.0"
+    if args.fibers:
+        args.conns = args.fibers
+    if args.protocol == "http":
+        return run_http_press(args)
+
+    if args.save:
+        from brpc_tpu.traffic.corpus import CorpusWriter
+        w = CorpusWriter(args.save)
+        for r in build_records(args):
+            w.write(r)
+        w.close()
+        print(f"# corpus saved: {args.save} ({w.records} records)",
+              file=sys.stderr, flush=True)
+
+    if args.procs > 1 and args.nprocs == 1:
+        rep = run_multiproc(args)
+    else:
+        rep = run_worker(args)
+    if args.json or args.nprocs > 1:
+        print(json.dumps(rep), flush=True)
+    else:
+        elapsed = rep.get("elapsed_s") or 1e-9
+        per_prio = rep.get("per_priority", {})
+        for p, d in sorted(per_prio.items()):
+            print(f"priority {p}: ok={d['ok']} fail={d['fail']}")
+        print(f"total={rep.get('ok', 0) + rep.get('fail', 0)} "
+              f"ok={rep.get('ok', 0)} fail={rep.get('fail', 0)} "
+              f"qps={rep.get('ok', 0) / elapsed:.0f} "
+              f"fidelity={rep.get('fidelity_pct')}% "
+              f"behind_ms_max={rep.get('behind_ms_max')}", flush=True)
+    return 0 if rep.get("ok", 0) > 0 else 1
 
 
 if __name__ == "__main__":
-    main()
+    rc = main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)    # skip runtime-thread teardown, like bench.py
