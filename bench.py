@@ -1297,6 +1297,13 @@ def main() -> None:
         "device_lane": ("error" if ("error" in lane or
                                     "lane_error" in lane)
                         else ("ok" if lane else "absent")),
+        # device observatory headline pair (measured inside the probe
+        # child next to the ici numbers they qualify): what the stage
+        # spans account for, and what the cells cost
+        "ici_stage_attribution_pct":
+        lane.get("ici_stage_attribution_pct"),
+        "device_stats_overhead_pct":
+        lane.get("device_stats_overhead_pct"),
         "native": bool(result.get("native", {}).get("fastcore")),
         "partial": result.get("partial"),
     }

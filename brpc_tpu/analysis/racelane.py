@@ -85,6 +85,10 @@ LOCK_ORDER: List[Tuple[str, str]] = [
     ("IciConn._pump_lock",          "transport/ici.py"),
     ("IciConn._flush_lock",         "transport/ici.py"),
     ("IciConn._lock",               "transport/ici.py"),
+    # leaf: device transfer cell — stamped by BatchTracker settle paths
+    # that run under IciConn flush/pump holds; never wraps another
+    # acquisition (transport/device_stats.py)
+    ("DeviceCell._lock",            "transport/device_stats.py"),
     ("BlockPool._lock",             "butil/iobuf.py"),
     ("variable:_registry_lock",     "bvar/variable.py"),
     ("postfork:_lock",              "butil/postfork.py"),

@@ -50,6 +50,11 @@ from brpc_tpu.butil.flags import define_flag, flag
 # fd-exhaustion scenarios (the EMFILE accept-backoff test lost its
 # "no free descriptors" precondition to exactly that open/close)
 from brpc_tpu.fiber import worker_module as _worker_module
+# same rule for the device-lane label registry: the sampler reads
+# device-thread labels (poller pump, PjRt waiter threads) through this
+# binding — transport/device_stats has no import cycle with builtin,
+# so it binds at load like worker_module
+from brpc_tpu.transport import device_stats as _device_stats
 
 # the remaining sampler-path collaborators are import-CYCLIC with this
 # module at load time (scheduler/server_dispatch/event_dispatcher all
@@ -96,6 +101,15 @@ _MAX_STACK = 48
 _SOCK_HINT_FRAMES = frozenset((
     "_drain_readable", "_process_input_entry", "_on_readable_event",
     "_drain_writes_inline", "_keep_write"))
+
+# frames whose ``self`` is the IciConn doing device-lane work (pump /
+# flush / descriptor staging / the pull itself): samples landing here
+# with no serving context attribute to ``device:<peer>`` instead of
+# vanishing into a thread-name leaf — /hotspots then shows the device
+# lane's true CPU cost
+_DEV_HINT_FRAMES = frozenset((
+    "_pump", "_pump_locked", "_flush", "_stage_lane_frame",
+    "take_device_payload", "write_device_payload"))
 
 # frame-id strings are hot (every busy sample builds one per frame):
 # cache keyed by the CODE OBJECT itself (hashable; holding it also
@@ -242,6 +256,7 @@ class FlightRecorder:
                 continue
             stack: List[str] = []
             hint_frame = None
+            dev_hint_frame = None
             f = frame
             while f is not None and len(stack) < _MAX_STACK:
                 stack.append(_frame_id(f))
@@ -249,10 +264,15 @@ class FlightRecorder:
                         f.f_code.co_name in _SOCK_HINT_FRAMES and \
                         f.f_code.co_filename.endswith("socket.py"):
                     hint_frame = f
+                if dev_hint_frame is None and \
+                        f.f_code.co_name in _DEV_HINT_FRAMES and \
+                        f.f_code.co_filename.endswith("ici.py"):
+                    dev_hint_frame = f
                 f = f.f_back
             if not stack:
                 continue
-            label = self._attribute(tid, names, hint_frame)
+            label = self._attribute(tid, names, hint_frame,
+                                    dev_hint_frame)
             folded_key = label + ";" + ";".join(reversed(stack))
             nbusy += 1
             loc_folded[folded_key] += 1
@@ -273,15 +293,16 @@ class FlightRecorder:
 
     @staticmethod
     def _attribute(tid: int, names: Dict[int, str],
-                   hint_frame=None) -> str:
+                   hint_frame=None, dev_hint_frame=None) -> str:
         """Sample attribution, most-specific first: the RPC method the
         thread's current fiber is serving (serving-controller fiber
         local, set by the classic dispatch path), the fiber's name (the
         turbo path names its fibers with the method key, so the native
-        scan lane attributes for free), the sampled connection's
-        last-served method (transport legs — the dispatcher draining a
-        conn's bytes is serving that conn's traffic), then the thread
-        name."""
+        scan lane attributes for free), the device-thread label / ici
+        pump-leg hint (device work outside any fiber attributes to
+        ``device:<peer>``), the sampled connection's last-served method
+        (transport legs — the dispatcher draining a conn's bytes is
+        serving that conn's traffic), then the thread name."""
         if _thread_current_fiber is None:
             return f"thread:{names.get(tid, tid)}"
         fiber = _thread_current_fiber(tid)
@@ -307,6 +328,19 @@ class FlightRecorder:
         lbl = _worker_module.active_label(tid)
         if lbl:
             return f"rpc:{lbl}" if "." in lbl else f"module:{lbl}"
+        dev_lbl = _device_stats.device_thread_label(tid)
+        if dev_lbl:
+            return dev_lbl
+        if dev_hint_frame is not None:
+            # f_locals on another thread's live frame builds a copy —
+            # fine at sampling rate, never mutates the frame
+            try:
+                conn = dev_hint_frame.f_locals.get("self")
+                rem = getattr(conn, "_remote", None)
+                if rem is not None:
+                    return f"device:{rem}"
+            except Exception:
+                pass
         if hint_frame is not None:
             # f_locals on another thread's live frame builds a copy —
             # fine at sampling rate, never mutates the frame

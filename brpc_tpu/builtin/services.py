@@ -262,6 +262,15 @@ def add_builtin_services(server) -> None:
         return json.dumps(backends_page_payload(), default=str).encode()
 
     @builtin.method()
+    def device(cntl, request):
+        # device-lane observatory (per-(peer, lane) transfer cells,
+        # credit/queue panes, leak counters, last probe result) — the
+        # builtin-RPC twin of HTTP /device, from the ONE shared builder
+        from brpc_tpu.transport.device_stats import device_page_payload
+        return json.dumps(device_page_payload(server),
+                          default=str).encode()
+
+    @builtin.method()
     def serving(cntl, request):
         # continuous-batching engine state (running/waiting/evicted,
         # batch-size histogram, KV occupancy) — the builtin-RPC twin

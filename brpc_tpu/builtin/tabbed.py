@@ -21,6 +21,7 @@ TABS = [
     ("census", "/census"),
     ("capture", "/capture"),
     ("serving", "/serving"),
+    ("device", "/device"),
     ("backends", "/backends"),
     ("lb_trace", "/lb_trace"),
     ("connections", "/connections"),

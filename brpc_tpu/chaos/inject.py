@@ -174,12 +174,20 @@ class ChaosConn(Conn):
             return
         self._inner.request_writable_event()
 
-    def write_device_payload(self, arrays):
+    def write_device_payload(self, arrays, tracker=None):
+        if tracker is not None and \
+                getattr(self._inner, "supports_device_tracker", False):
+            return self._inner.write_device_payload(arrays,
+                                                    tracker=tracker)
         return self._inner.write_device_payload(arrays)
 
     @property
     def supports_device_lane(self) -> bool:
         return self._inner.supports_device_lane
+
+    @property
+    def supports_device_tracker(self) -> bool:
+        return getattr(self._inner, "supports_device_tracker", False)
 
     @property
     def local_endpoint(self):

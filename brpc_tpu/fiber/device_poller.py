@@ -20,6 +20,13 @@ import threading
 from typing import Any, Callable, List, Optional, Tuple
 
 from brpc_tpu.fiber.scheduler import Fiber, SchedAwaitable
+# device-thread labels for the flight recorder: the pump thread and the
+# per-wait PjRt waiter threads run OUTSIDE any fiber, so without these
+# stamps their busy samples fall to thread-name leaves instead of the
+# device lane. Bound at module load (transport/__init__ is empty — no
+# cycle; and the sampler side reads only, per the PR 8 lazy-import rule)
+from brpc_tpu.transport.device_stats import (stamp_device_thread,
+                                             unstamp_device_thread)
 
 # cap on concurrently-parked waiter threads; beyond it new waits fall
 # back to the fair poll pump (a bounded executor QUEUE would let 32
@@ -68,6 +75,7 @@ class DeviceEventPoller:
                     self._active_waiters += 1
             if can_wait:
                 def wait_and_fire():
+                    stamp_device_thread("device:wait")
                     try:
                         block()       # parks in PjRt's future (GIL freed)
                     except Exception:
@@ -81,6 +89,10 @@ class DeviceEventPoller:
                         import logging
                         logging.getLogger("brpc_tpu.fiber").exception(
                             "device waiter callback failed")
+                    finally:
+                        # per-wait threads die here: an un-popped label
+                        # would pin dict entries for dead tids
+                        unstamp_device_thread()
                 # one daemon thread per in-flight wait: a stalled wait
                 # pins only its own thread (no executor queue to starve
                 # ready objects behind it) and cannot hang interpreter
@@ -104,6 +116,17 @@ class DeviceEventPoller:
 
     def _run(self):
         import time
+        # the pump's busy samples (is_ready sweeps over pending device
+        # objects) belong to the device lane on /hotspots; the unstamp
+        # rides a finally — a pump killed by a throwing is_ready must
+        # not leave a stale label for the OS to hand a reused tid
+        stamp_device_thread(f"device:{self._name}")
+        try:
+            self._run_inner(time)
+        finally:
+            unstamp_device_thread()
+
+    def _run_inner(self, time):
         idle_spins = 0
         while not self._stop:
             with self._cond:

@@ -400,6 +400,26 @@ class HttpProtocol(Protocol):
                     agg.merged_serving(), default=str).encode()
             return 200, "application/json", json.dumps(
                 serving_page_payload(server), default=str).encode()
+        if path == "/device":
+            from brpc_tpu.transport.device_stats import device_page_payload
+            if agg is not None:
+                # supervisor: merge the shard device views (counters
+                # sum, latency samples pool); ?shard=i narrows
+                shard, err = _shard_param(agg, req)
+                if err is not None:
+                    return err
+                if shard is not None:
+                    dump = agg.shard_dump(shard)
+                    if dump is None or not dump.get("device"):
+                        return (404, "text/plain",
+                                f"no device dump for shard {shard}"
+                                .encode())
+                    return 200, "application/json", json.dumps(
+                        dump["device"], default=str).encode()
+                return 200, "application/json", json.dumps(
+                    agg.merged_device(), default=str).encode()
+            return 200, "application/json", json.dumps(
+                device_page_payload(server), default=str).encode()
         if path == "/lb_trace":
             from brpc_tpu.rpc.backend_stats import lb_trace_payload
             try:

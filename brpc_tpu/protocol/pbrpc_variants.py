@@ -85,12 +85,15 @@ class SofaPbrpcProtocol(TpuStdProtocol):
         payload = portal.cut(data_size - att_size)
         attachment = portal.cut(att_size)
         device_arrays = []
+        device_recv = None
         if meta.device_payloads and any(not dp.inline_bytes
                                         for dp in meta.device_payloads):
-            lane = socket.take_device_payload()
+            lane, device_recv = socket.take_device_payload_with_recv()
             if lane is not None:
                 device_arrays = list(lane)
-        return PARSE_OK, RpcMessage(meta, payload, attachment, device_arrays)
+        msg = RpcMessage(meta, payload, attachment, device_arrays)
+        msg.device_recv = device_recv
+        return PARSE_OK, msg
 
 
 _hulu: Optional[HuluPbrpcProtocol] = None

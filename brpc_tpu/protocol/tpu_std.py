@@ -140,7 +140,7 @@ class RpcMessage:
     """One parsed tpu_std message."""
 
     __slots__ = ("meta", "payload", "attachment", "device_arrays",
-                 "arrival_ns")
+                 "arrival_ns", "device_recv")
 
     def __init__(self, meta: pb.RpcMeta, payload: IOBuf, attachment: IOBuf,
                  device_arrays: Optional[List] = None):
@@ -148,6 +148,10 @@ class RpcMessage:
         self.payload = payload
         self.attachment = attachment
         self.device_arrays = device_arrays or []
+        # device-lane recv info (peer/lane/recv_us) stamped by the
+        # socket's take_device_payload — dispatch hangs a device-recv
+        # child span off the server span from it
+        self.device_recv = None
         # cut-time stamp: the server-side deadline budget (request
         # timeout_ms) counts from HERE, so dispatch queueing — a burst
         # fanned out to fibers behind busy workers — spends the budget
@@ -317,12 +321,14 @@ class TpuStdProtocol(Protocol):
         payload = portal.cut(body_size - meta_size - att_size)
         attachment = portal.cut(att_size) if att_size else IOBuf()
         device_arrays: List = []
+        device_recv = None
         if meta.device_payloads and any(not dp.inline_bytes
                                         for dp in meta.device_payloads):
-            lane = socket.take_device_payload()
+            lane, device_recv = socket.take_device_payload_with_recv()
             if lane is not None:
                 device_arrays = list(lane)
         msg = RpcMessage(meta, payload, attachment, device_arrays)
+        msg.device_recv = device_recv
         return PARSE_OK, msg
 
     # ------------------------------------------------------- batch parse
@@ -392,13 +398,16 @@ class TpuStdProtocol(Protocol):
             if att_size:
                 attachment.append(bytes(win[p1:off + total]))
             device_arrays: List = []
+            device_recv = None
             if meta.device_payloads and any(not dp.inline_bytes
                                             for dp in meta.device_payloads):
-                lane = socket.take_device_payload()
+                lane, device_recv = \
+                    socket.take_device_payload_with_recv()
                 if lane is not None:
                     device_arrays = list(lane)
-            msgs.append(RpcMessage(meta, payload, attachment,
-                                   device_arrays))
+            m = RpcMessage(meta, payload, attachment, device_arrays)
+            m.device_recv = device_recv
+            msgs.append(m)
             processed = off + total
         if not msgs:
             return None

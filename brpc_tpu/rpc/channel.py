@@ -730,7 +730,10 @@ class Channel:
                 # another device-payload call slipping between them would
                 # cross-match lane batches on the receiver
                 with sock.lane_lock:
-                    sock.write_device_payload(lane)
+                    # the device batch's stage tracker hangs its child
+                    # span off this call's client span (trace inherit)
+                    sock.write_device_payload(lane,
+                                              span=d.get("_client_span"))
                     # graftlint: disable=callback-under-lock -- lane_lock
                     # exists to make exactly this pair atomic (device
                     # batch + envelope adjacent on the conn); Socket.write

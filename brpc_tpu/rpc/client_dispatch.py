@@ -229,4 +229,12 @@ def _fill_response(cntl, msg: RpcMessage, socket) -> None:
             for dp, inl in zip(msg.meta.device_payloads, inline):
                 arrays.append(inl if dp.inline_bytes else next(lane_iter, None))
             cntl.response_device_arrays = arrays
+            dr = getattr(msg, "device_recv", None)
+            span = cntl.__dict__.get("_client_span")
+            if dr is not None and span is not None:
+                # the response's device-recv leg as a child of the
+                # client span (shared helper; the server-side twin
+                # lives in server_dispatch._process_request_body)
+                from brpc_tpu.rpc.span import submit_device_recv_span
+                submit_device_recv_span(span, dr)
         cntl.response_attachment = msg.attachment

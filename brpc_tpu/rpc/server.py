@@ -313,6 +313,15 @@ class Server:
             # follow the same re-expose lifecycle
             from brpc_tpu.rpc.backend_stats import expose_backend_vars
             expose_backend_vars()
+            # device-lane stat cells + the ici_* counters (lane status,
+            # unpulled/leaked/reclaimed) — the unexpose_all survival
+            # rule again: a restart must not drop them from /vars
+            from brpc_tpu.transport.device_stats import expose_device_vars
+            expose_device_vars()
+            import sys as _sys
+            _ici_mod = _sys.modules.get("brpc_tpu.transport.ici")
+            if _ici_mod is not None:
+                _ici_mod.expose_ici_vars()
             # overload-control gauges (limiter limit + inflight) for
             # prometheus and the merged shard views
             _expose_limiter_vars(self)

@@ -294,6 +294,14 @@ async def _process_request_body(proto, msg: RpcMessage, socket, server,
         cntl.request_device_arrays = [
             inl if dp.inline_bytes else next(lane_iter, None)
             for dp, inl in zip(meta.device_payloads, inline)]
+        dr = getattr(msg, "device_recv", None)
+        if rz and dr is not None:
+            # the request's device-recv leg as a child of this server
+            # span — the receiving half of the sender's stage-resolved
+            # device span (shared helper; the client-side twin lives in
+            # client_dispatch._fill_response)
+            from brpc_tpu.rpc.span import submit_device_recv_span
+            submit_device_recv_span(span, dr)
 
     # decode request payload
     request = None
@@ -877,7 +885,9 @@ def _send_response(proto, socket, cid: int, cntl: Controller,
     if lane is not None:
         # adjacent pair under the lane lock (see Channel._issue_rpc)
         with socket.lane_lock:
-            socket.write_device_payload(lane)
+            # the response batch's stage tracker hangs its device span
+            # off this request's server span (trace inheritance)
+            socket.write_device_payload(lane, span=span)
             if span is not None:
                 # armed only once the write is certain to be issued (an
                 # armed latch with no callback would strand the span)

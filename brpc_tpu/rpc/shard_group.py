@@ -117,6 +117,13 @@ def write_shard_dump(dirpath: str, index: int, server, seq: int) -> None:
     if getattr(server, "_serving", None) is not None:
         from brpc_tpu.serving.service import serving_page_payload
         doc["serving"] = serving_page_payload(server)
+    from brpc_tpu.transport.device_stats import (device_page_payload,
+                                                 global_device_stats)
+    if global_device_stats().rows():
+        # device-lane state rides the dump only once a shard has moved
+        # a device batch (the common host-only shard pays nothing);
+        # the supervisor's /device merges these
+        doc["device"] = device_page_payload(server)
     from brpc_tpu.traffic.capture import \
         global_recorder as traffic_recorder
     rec = traffic_recorder()
@@ -357,6 +364,14 @@ class ShardAggregator:
                if d.get("kv_occupancy") is not None]
         out["kv_occupancy"] = round(sum(occ) / len(occ), 4) if occ else 0.0
         return out
+
+    def merged_device(self) -> dict:
+        """The group-wide /device view: per-shard device payloads
+        merged — counters sum, latency samples POOL, conn panes concat
+        (transport/device_stats.merge_device_payloads)."""
+        from brpc_tpu.transport.device_stats import merge_device_payloads
+        return merge_device_payloads(
+            [d["device"] for d in self.read_dumps() if d.get("device")])
 
     def merged_capture(self) -> dict:
         """The group-wide /capture view: per-shard recorder snapshots

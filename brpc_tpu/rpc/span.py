@@ -85,9 +85,13 @@ class Span:
         tail debugging needs. Server: arrival->handler (queueing +
         parse), handler, handler->flush (serialize + write). Client:
         issue->write-done, write-done->first-response-byte (network +
-        server residence), first-byte->completion. Sums to ~latency_us;
-        a span that never reached its handler puts everything in
-        queue_us."""
+        server residence), first-byte->completion. Device transfers
+        reuse the client shape with the lane waypoints mapped onto it
+        (write_done_us = descriptor encoded, first_byte_us = frame
+        flushed to transport, end_us = peer ack) so the triple reads
+        (stage_us, wire_us, ack_us) — see to_dict's aliases. Sums to
+        ~latency_us; a span that never reached its handler puts
+        everything in queue_us."""
         if self.side == "server":
             base = self.received_us or self.start_us
             mid0, mid1 = self.handler_start_us, self.handler_end_us
@@ -103,7 +107,7 @@ class Span:
 
     def to_dict(self) -> dict:
         queue_us, handle_us, write_us = self.stage_breakdown()
-        return {
+        d = {
             "trace_id": f"{self.trace_id:016x}",
             "span_id": f"{self.span_id:016x}",
             "parent_span_id": f"{self.parent_span_id:016x}",
@@ -138,6 +142,14 @@ class Span:
             "annotations": [
                 {"us": us, "text": t} for us, t in self.annotations],
         }
+        if self.side == "device":
+            # the device lane's waypoint names (transport/device_stats):
+            # host staging + descriptor encode, lane-enqueue/credit wait
+            # + pump flush, wire + peer recv + ack return
+            d["stage_us"] = queue_us
+            d["wire_us"] = handle_us
+            d["ack_us"] = write_us
+        return d
 
 
 class SpanCollector:
@@ -413,6 +425,48 @@ def start_attempt_span(parent: Span, service: str, method: str,
     span.annotate(f"attempt={attempt} backend={backend}"
                   + (" backup" if backup else ""))
     return span
+
+
+def start_device_span(parent: Span, peer: str, lane: str) -> Span:
+    """A device-transfer child of the owning RPC span: the lane's
+    stage-resolved waypoints (host-stage/encode, credit-wait + pump
+    flush, wire + peer ack) ride the client-shaped stamp slots —
+    write_done_us = encoded, first_byte_us = flushed, end_us = acked —
+    so stage_breakdown yields (stage_us, wire_us, ack_us) summing to
+    the transfer latency (see Span.to_dict's device aliases). The
+    tracker (transport/device_stats.BatchTracker) stamps and submits;
+    trace/parent inheritance keeps the transfer inside the call tree
+    the serving controller / client channel started."""
+    span = Span(
+        trace_id=parent.trace_id,
+        span_id=new_trace_id(),
+        parent_span_id=parent.span_id,
+        side="device",
+        service="device",
+        method=lane,
+        remote_side=peer,
+        start_us=time.monotonic_ns() // 1000,
+        log_id=parent.log_id,
+    )
+    span.annotate(f"device transfer peer={peer} lane={lane}")
+    return span
+
+
+def submit_device_recv_span(parent: Span, dr: dict) -> None:
+    """The receiving half of a device transfer (take_device_payload:
+    pull DMA / staged device_put + recv-pool admission) as a finished
+    child span of the owning RPC span. ``dr`` is the socket's
+    ``last_device_take`` record (peer/lane/recv_us/nbytes/t_us) —
+    one helper so the server- and client-side parse paths cannot
+    drift."""
+    span = start_device_span(parent, dr.get("peer", ""),
+                             dr.get("lane", ""))
+    span.start_us = dr.get("t_us") or span.start_us
+    span.end_us = span.start_us + int(dr.get("recv_us", 0))
+    span.request_size = dr.get("nbytes", 0)
+    span.annotate(f"device-recv recv_us={dr.get('recv_us')} "
+                  f"nbytes={dr.get('nbytes')}")
+    _submit_span(span)
 
 
 def submit_span(span: Span) -> None:

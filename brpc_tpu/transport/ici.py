@@ -60,6 +60,7 @@ from brpc_tpu.butil.device_pool import (BLOCK_CLASSES, DeviceRecvPool,
 
 logger = logging.getLogger("brpc_tpu.ici")
 from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.transport import device_stats as _dev_stats
 from brpc_tpu.transport.base import Conn, Listener, Transport
 from brpc_tpu.transport.tcp import TcpConn, TcpTransport
 from brpc_tpu.transport.tpud import (_decode_device_batch,
@@ -216,12 +217,20 @@ def _canonical_addr(addr: str, peer_host: str) -> str:
 _default_pool = DeviceRecvPool()
 
 
+_lazy_adders: List["_LazyAdder"] = []
+
+
 class _LazyAdder:
-    """Counter that only materializes its bvar on first use."""
+    """Counter that only materializes its bvar on first use. Instances
+    register themselves so ``expose_ici_vars`` (called at Server.start)
+    can RE-expose a materialized counter a test fixture's
+    unexpose_all() stripped — without the re-expose, a server restart
+    silently dropped every ici_* counter from /vars."""
 
     def __init__(self, name: str):
         self._name = name
         self._var = None
+        _lazy_adders.append(self)
 
     def add(self, n: int) -> None:
         try:
@@ -229,6 +238,20 @@ class _LazyAdder:
                 from brpc_tpu.bvar import Adder
                 self._var = Adder().expose(self._name)
             self._var.add(n)
+        except Exception:
+            pass
+
+    def get_value(self) -> int:
+        var = self._var
+        try:
+            return int(var.get_value()) if var is not None else 0
+        except Exception:
+            return 0
+
+    def reexpose_counter(self) -> None:
+        try:
+            if self._var is not None:
+                self._var.expose(self._name)
         except Exception:
             pass
 
@@ -251,6 +274,13 @@ _unpulled_registrations = _LazyAdder("ici_unpulled_registrations")
 # past it every peer degrades to the host-staged lane.
 # /vars ici_unpulled_bytes tracks the global estimate.
 _unpulled_bytes = _LazyAdder("ici_unpulled_bytes")
+# the real leaked/reclaimed counter PAIR the /device page surfaces:
+# leaked = bytes a closing conn abandoned (un-ACKed pull registrations
+# plus same-process exchange entries handed to the grace queue),
+# reclaimed = bytes the grace sweep actually dropped. leaked - reclaimed
+# is the live pinned estimate an operator watches.
+_leaked_bytes_counter = _LazyAdder("ici_leaked_bytes")
+_reclaimed_bytes_counter = _LazyAdder("ici_reclaimed_bytes")
 _leaked_pull_bytes = [0]                    # global, all epochs
 _leaked_by_epoch: Dict[str, int] = {}       # peer proc uuid -> bytes
 _LEAK_CAP_BYTES = int(os.environ.get(
@@ -329,17 +359,72 @@ def _reclaim_grace_s() -> float:
 
 
 _reclaim_queue: Deque[Tuple[float, int]] = deque()
+# uids on the grace queue, with the byte footprint their close charged
+# to ici_leaked_bytes: whichever way the entry leaves — swept after the
+# grace, or legitimately TAKEN by the peer mid-grace — the same bytes
+# credit ici_reclaimed_bytes exactly once, so the /device pinned
+# estimate (leaked - reclaimed) cannot drift upward on delivered
+# batches (guarded by _local_lock like the exchange itself)
+_grace_uid_bytes: Dict[int, int] = {}
 
 
 def _sweep_reclaim(now: Optional[float] = None) -> None:
     """Drop expired same-process exchange entries (called
-    opportunistically from lane activity and close)."""
+    opportunistically from lane activity and close). Reclaimed bytes
+    are counted (ici_reclaimed_bytes) so /device can show how much of
+    the leaked estimate actually came back."""
     import time as _time
     now = _time.monotonic() if now is None else now
+    freed = 0
     with _local_lock:
         while _reclaim_queue and _reclaim_queue[0][0] <= now:
             _, uid = _reclaim_queue.popleft()
             _local_exchange.pop(uid, None)
+            # credit what close charged — even when the peer already
+            # took the entry (its take credited it, pop above is a
+            # no-op and the uid is gone from the ledger)
+            freed += _grace_uid_bytes.pop(uid, 0)
+    if freed:
+        _reclaimed_bytes_counter.add(freed)
+
+
+def leak_snapshot() -> dict:
+    """The /device leak pane: what the lane has abandoned, what came
+    back, and where the circuit breaker stands."""
+    with _local_lock:
+        by_epoch = len(_leaked_by_epoch)
+        grace_queued = len(_reclaim_queue)
+    leaked = _leaked_bytes_counter.get_value()
+    reclaimed = _reclaimed_bytes_counter.get_value()
+    return {
+        "leaked_bytes": leaked,
+        "reclaimed_bytes": reclaimed,
+        "pinned_bytes_estimate": max(0, leaked - reclaimed),
+        "leaked_pull_bytes": _leaked_pull_bytes[0],
+        "unpulled_registrations": _unpulled_registrations.get_value(),
+        "epochs_tracked": by_epoch,
+        "grace_queue": grace_queued,
+        "leak_cap_bytes": _LEAK_CAP_BYTES,
+        "leak_global_cap_bytes": _LEAK_GLOBAL_CAP_BYTES,
+        "pull_lane_tripped":
+            _leaked_pull_bytes[0] >= _LEAK_GLOBAL_CAP_BYTES,
+    }
+
+
+def expose_ici_vars() -> None:
+    """(Re-)expose the lane's bvars — called from Server.start like the
+    socket counters (the PR 2 unexpose_all survival rule): a restarted
+    server must not silently drop ici_* from /vars."""
+    global _lane_status_var
+    if _lane_status_var is not None:
+        try:
+            _lane_status_var.expose("ici_transfer_lane")
+        except Exception:
+            pass
+    else:
+        _publish_lane_status()
+    for adder in _lazy_adders:
+        adder.reexpose_counter()
 
 
 def _encode_descriptor(uid: int, arrays) -> bytes:
@@ -386,6 +471,9 @@ class IciConn(Conn):
     (rdma_endpoint.h:235-241)."""
 
     supports_device_lane = True
+    # Socket.write_device_payload passes a stage tracker through to the
+    # flush/ack machinery (transport/device_stats.BatchTracker)
+    supports_device_tracker = True
 
     def __init__(self, inner: TcpConn, local: EndPoint, remote: EndPoint,
                  recv_device_ordinal: int = 0,
@@ -403,10 +491,19 @@ class IciConn(Conn):
         # from processing fibers (take_device_payload); the ingest state
         # (_inbuf/_appbuf/_lane/ack counters) needs one owner at a time
         self._pump_lock = threading.Lock()
-        # outbound: FIFO of ("bytes"|"ctrl", payload) | ("lane", arrays)
+        # outbound: FIFO of ("bytes", payload) | ("ctrl", ftype, payload)
+        # | ("lane", arrays, tracker) — the tracker (device_stats stage
+        # timeline, or None) rides the queue item so the flush/ack legs
+        # never look anything up
         self._outq: Deque[Tuple] = deque()
         self._out_bytes = 0                      # backpressure accounting
         self._wirebuf = bytearray()              # framed, partially written
+        # flush-stamp bookkeeping (all under _flush_lock): cumulative
+        # bytes pushed into TCP, and (target_offset, tracker) marks —
+        # a lane frame's tracker stamps lane_flushed when the wire
+        # counter passes its frame's end
+        self._wire_written = 0
+        self._wire_marks: Deque[Tuple[int, object]] = deque()
         self._inbuf = bytearray()
         self._appbuf = bytearray()
         self._lane: Deque[Tuple] = deque()       # inbound batch descriptors
@@ -426,8 +523,10 @@ class IciConn(Conn):
         self._peer_acked = 0                     # cumulative acks from peer
         # byte budget: footprints of un-ACKed batches, FIFO (the peer
         # consumes lane batches in order), so bytes-in-flight is
-        # derivable from the cumulative ack count
-        self._inflight_footprints: Deque[Tuple[int, bool]] = deque()
+        # derivable from the cumulative ack count; each entry is
+        # (footprint, is_pull, tracker-or-None)
+        self._inflight_footprints: Deque[Tuple[int, bool, object]] = \
+            deque()
         self._inflight_bytes = 0
         # uids this connection registered for peer pull; reclaimed (or at
         # least counted) on close/failure
@@ -462,12 +561,19 @@ class IciConn(Conn):
             "device": recv_device_ordinal,
             "can_pull": srv is not None,
         }
+        _dev_stats.global_device_stats().track_device_conn(self)
         self._enqueue(("ctrl", F_HELLO, json.dumps(hello).encode()))
         self._flush()
 
     # --------------------------------------------------------- outbound
     def _enqueue(self, item: Tuple) -> None:
         with self._lock:
+            if self._closed:
+                # close() flips this under the same lock BEFORE it
+                # sweeps queued-batch trackers: an enqueue losing that
+                # race must fail loudly, or its tracker would be in no
+                # sweep list and the cell would never balance
+                raise ConnectionError("ici conn closed")
             if self._out_bytes > _MAX_OUT:
                 raise BlockingIOError("ici out-buffer full")
             self._outq.append(item)
@@ -488,12 +594,21 @@ class IciConn(Conn):
 
     def _apply_peer_ack(self, ack: int) -> None:
         """Advance the cumulative-consumed count and retire the matching
-        FIFO footprints (bytes-in-flight accounting)."""
+        FIFO footprints (bytes-in-flight accounting). Retired batches'
+        stage trackers settle AFTER _fc_lock drops — the settle touches
+        the cell lock and submits the device span, neither of which
+        belongs under flow-control state."""
+        acked_trackers = []
         with self._fc_lock:
             while self._peer_acked < ack and self._inflight_footprints:
-                self._inflight_bytes -= self._inflight_footprints.popleft()[0]
+                fp, _, tracker = self._inflight_footprints.popleft()
+                self._inflight_bytes -= fp
                 self._peer_acked += 1
+                if tracker is not None:
+                    acked_trackers.append(tracker)
             self._peer_acked = max(self._peer_acked, ack)
+        for tracker in acked_trackers:
+            tracker.lane_acked()
 
     def _unsendable_reason(self, arrays) -> Optional[str]:
         """A batch no receiver state could ever admit (footprint over
@@ -530,9 +645,11 @@ class IciConn(Conn):
                 return False
         return True
 
-    def _stage_lane_frame(self, arrays) -> bytes:
+    def _stage_lane_frame(self, arrays, tracker=None) -> bytes:
         """Turn a lane batch into its wire frame, registering the arrays
-        for peer pull (or falling back to the staged lane)."""
+        for peer pull (or falling back to the staged lane). The tracker
+        stamps descriptor-encode done here (host-stage boundary) and
+        rides the in-flight footprint FIFO to its ack."""
         info = self.peer_info or {}
         footprint = self._batch_footprint(arrays)
         if info.get("proc") == _PROC_UUID:
@@ -543,6 +660,7 @@ class IciConn(Conn):
             self._issued_uids.append(uid)
             frame = self._frame(F_DESCRIPTOR, _encode_descriptor(uid, arrays))
             is_pull = False
+            staged = False
         else:
             srv = _get_transfer_server()
             if srv is not None and info.get("can_pull") \
@@ -555,12 +673,16 @@ class IciConn(Conn):
                 frame = self._frame(F_DESCRIPTOR,
                                     _encode_descriptor(uid, arrays))
                 is_pull = True
+                staged = False
             else:
                 # degraded lane: host-staged numpy over the control stream
                 frame = self._frame(F_STAGED, _encode_device_batch(arrays))
                 is_pull = False
+                staged = True
+        if tracker is not None:
+            tracker.lane_encoded(staged=staged)
         with self._fc_lock:
-            self._inflight_footprints.append((footprint, is_pull))
+            self._inflight_footprints.append((footprint, is_pull, tracker))
             self._inflight_bytes += footprint
             self._sent += 1
         _sweep_reclaim()
@@ -594,6 +716,12 @@ class IciConn(Conn):
                     finally:
                         mv.release()
                     del self._wirebuf[:n]
+                    self._wire_written += n
+                    while self._wire_marks and \
+                            self._wire_marks[0][0] <= self._wire_written:
+                        # this lane frame's bytes fully left for TCP:
+                        # pump-flush waypoint (wire_us starts here)
+                        self._wire_marks.popleft()[1].lane_flushed()
                 poison = None
                 with self._lock:
                     if not self._outq:
@@ -618,13 +746,23 @@ class IciConn(Conn):
                         if item[0] == "bytes":
                             self._out_bytes -= len(item[1])
                 if poison is not None:
+                    if len(item) > 2 and item[2] is not None:
+                        # the popped batch's tracker settles as failed
+                        # (the span carries the unsendable reason)
+                        item[2].lane_failed(poison)
                     raise ConnectionError(poison)
                 if item[0] == "bytes":
                     self._wirebuf += self._frame(F_BYTES, item[1])
                 elif item[0] == "ctrl":
                     self._wirebuf += self._frame(item[1], item[2])
                 else:                         # lane
-                    self._wirebuf += self._stage_lane_frame(item[1])
+                    tracker = item[2]
+                    self._wirebuf += self._stage_lane_frame(item[1],
+                                                            tracker)
+                    if tracker is not None:
+                        self._wire_marks.append(
+                            (self._wire_written + len(self._wirebuf),
+                             tracker))
 
     def write(self, mv: memoryview) -> int:
         if self._poisoned is not None:
@@ -634,10 +772,11 @@ class IciConn(Conn):
         self._flush()
         return len(data)
 
-    def write_device_payload(self, arrays) -> bool:
+    def write_device_payload(self, arrays, tracker=None) -> bool:
         """Stage jax arrays on our device and queue the batch. Host
         inputs are device_put once here (H2D staging); from then on the
-        payload moves device-to-device only."""
+        payload moves device-to-device only. ``tracker``: the
+        device_stats stage timeline riding this batch (or None)."""
         import jax
         staged = []
         for a in arrays:
@@ -645,13 +784,24 @@ class IciConn(Conn):
                 a = jax.device_put(a)
             staged.append(a)
         if self._poisoned is not None:
+            if tracker is not None:
+                tracker.lane_failed(self._poisoned)
             raise ConnectionError(self._poisoned)
         # fail-fast at the call site when the peer is already known
         # (otherwise flush-time detection poisons the connection)
         reason = self._unsendable_reason(staged)
         if reason is not None:
+            if tracker is not None:
+                tracker.lane_failed(reason)
             raise ConnectionError(reason)
-        self._enqueue(("lane", staged))
+        try:
+            self._enqueue(("lane", staged, tracker))
+        except (ConnectionError, BlockingIOError) as e:
+            # closed-conn / out-buffer refusal: settle here — the batch
+            # never entered a queue any sweep covers
+            if tracker is not None:
+                tracker.lane_failed(str(e))
+            raise
         self._flush()
         return True
 
@@ -741,6 +891,12 @@ class IciConn(Conn):
                 self._enqueue(("ctrl", F_ACK, b""))
             except BlockingIOError:
                 return      # out-buffer full: the ack piggybacks later
+            except ConnectionError:
+                # conn closed under us (a racing close flips _closed
+                # before tearing down): a courtesy ack on a dying conn
+                # is worthless — it must not error the batch the
+                # caller already took successfully
+                return
             self._flush()
 
     def take_device_payload(self):
@@ -778,6 +934,13 @@ class IciConn(Conn):
                     # copy (ICI hop on real multi-chip hardware)
                     with _local_lock:
                         arrays = _local_exchange.pop(uid, None)
+                        # a grace-queued entry (sender closed) that the
+                        # peer legitimately takes is DELIVERED, not
+                        # leaked: credit the bytes its close charged
+                        grace_credit = _grace_uid_bytes.pop(uid, 0) \
+                            if arrays is not None else 0
+                    if grace_credit:
+                        _reclaimed_bytes_counter.add(grace_credit)
                     if arrays is None:
                         raise ConnectionError(
                             "ici: same-process batch no longer available "
@@ -823,9 +986,13 @@ class IciConn(Conn):
 
     # --------------------------------------------------------- plumbing
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
+        with self._lock:
+            # under _lock: _enqueue checks the flag under the same
+            # hold, so no batch can slip into _outq after the queued-
+            # tracker sweep below has run
+            if self._closed:
+                return
+            self._closed = True
         # best-effort flush: Socket's keep_write reported success for
         # frames that may still sit in _outq/_wirebuf behind a window
         # gate or TCP backpressure — don't silently drop them on close
@@ -846,12 +1013,23 @@ class IciConn(Conn):
         grace = _reclaim_grace_s()
         deadline = _time.monotonic() + grace
         queued = False
+        grace_bytes = 0
         with _local_lock:
             for uid in self._issued_uids:
-                if uid in _local_exchange:
+                arrays = _local_exchange.get(uid)
+                if arrays is not None:
+                    nb = sum(getattr(a, "nbytes", 0) or 0
+                             for a in arrays)
                     _reclaim_queue.append((deadline, uid))
+                    _grace_uid_bytes[uid] = nb
+                    grace_bytes += nb
                     queued = True
         self._issued_uids.clear()
+        if grace_bytes:
+            # pinned until the grace sweep: counted leaked now, counted
+            # reclaimed when the sweep drops them — the /device pane's
+            # pinned estimate is the difference
+            _leaked_bytes_counter.add(grace_bytes)
         if queued:
             # a timer guarantees the sweep even if no further lane
             # activity ever happens in this process (otherwise the
@@ -862,17 +1040,42 @@ class IciConn(Conn):
                                               _sweep_reclaim)
             except Exception:
                 pass
+        # lane batches still QUEUED (window-gated, or stuck behind a
+        # poisoned head) never reached _stage_lane_frame: no footprint
+        # rides them, so the in-flight sweep below cannot see them —
+        # their trackers settle here or the cell never balances and the
+        # device span is stranded unsubmitted (collect under the lock,
+        # settle after)
+        with self._lock:
+            queued_trackers = [item[2] for item in self._outq
+                               if item[0] == "lane" and len(item) > 2
+                               and item[2] is not None]
+        for tracker in queued_trackers:
+            tracker.lane_failed("connection closed before the batch "
+                                "was flushed")
         with self._fc_lock:
             # every entry still in the deque is un-ACKed; only PULL-lane
             # batches pin peer-side registrations (staged/local bytes
             # attributed here would falsely trip the breaker)
-            outstanding = sum(1 for _, p in self._inflight_footprints if p)
-            leaked_bytes = sum(fp for fp, p in self._inflight_footprints
-                               if p)
+            unacked = list(self._inflight_footprints)
+            outstanding = sum(1 for _, p, _t in unacked if p)
+            leaked_bytes = sum(fp for fp, p, _t in unacked if p)
+        # un-ACKed batches' stage trackers settle as failures — a pull
+        # registration the peer never drained is a LEAK and its device
+        # span says so (leak-reclaim annotation + failed cell counter)
         peer_epoch = (self.peer_info or {}).get("proc")
-        if outstanding > 0 and peer_epoch != _PROC_UUID:
+        cross_proc = peer_epoch != _PROC_UUID
+        for fp, is_pull, tracker in unacked:
+            if tracker is not None:
+                tracker.lane_failed(
+                    "connection closed with batch un-ACKed"
+                    + (" (pull registration pinned — no cancel API)"
+                       if is_pull and cross_proc else ""),
+                    leaked=is_pull and cross_proc)
+        if outstanding > 0 and cross_proc:
             _unpulled_registrations.add(outstanding)
             _unpulled_bytes.add(leaked_bytes)
+            _leaked_bytes_counter.add(leaked_bytes)
             with _local_lock:   # closes race from two threads' +=
                 _note_leaked(peer_epoch, leaked_bytes)
         _sweep_reclaim()
@@ -924,6 +1127,37 @@ class IciConn(Conn):
     def outstanding_batches(self) -> int:
         with self._fc_lock:
             return self._sent - self._peer_acked
+
+    def lane_introspection(self) -> dict:
+        """One /device conn row: credit-window occupancy, queue depths,
+        buffered bytes — the live lane state next to the cells."""
+        info = self.peer_info or {}
+        window = int(info.get("window") or self._window)
+        with self._fc_lock:
+            outstanding = self._sent - self._peer_acked
+            inflight_bytes = self._inflight_bytes
+            sent = self._sent
+        with self._lock:
+            outq_depth = len(self._outq)
+            out_bytes = self._out_bytes
+        buffered = len(self._wirebuf) + len(self._inbuf) \
+            + len(self._appbuf) + out_bytes
+        return {
+            "remote": str(self._remote),
+            "lane_kind": self.lane_kind,
+            "window": window,
+            "outstanding_batches": outstanding,
+            "window_occupancy": round(outstanding / window, 3)
+            if window else 0.0,
+            "inflight_bytes": inflight_bytes,
+            "budget": int(info.get("budget") or 0),
+            "batches_sent": sent,
+            "enqueue_depth": outq_depth,
+            "buffered_bytes": buffered,
+            "want_writable": self._want_writable,
+            "poisoned": self._poisoned,
+            "closed": self._closed,
+        }
 
 
 class _IciListener(Listener):

@@ -36,6 +36,7 @@ from brpc_tpu.bvar.reducer import Adder, Maxer, PassiveStatus
 from brpc_tpu.fiber import TaskControl, global_control
 from brpc_tpu.fiber.butex import Butex
 from brpc_tpu.transport.base import Conn, get_transport
+from brpc_tpu.transport import device_stats as _device_stats
 
 define_flag("socket_inline_process", True,
             "process socket input inline on the event-raising thread "
@@ -865,10 +866,58 @@ class Socket:
                     except Exception:
                         pass
 
-    def write_device_payload(self, arrays) -> bool:
+    def write_device_payload(self, arrays, span=None) -> bool:
         """Out-of-band device lane (mem/tpu transports); host transports
-        must serialize instead."""
-        r = self.conn.write_device_payload(arrays)
+        must serialize instead. ``span``: the owning RPC span — when
+        device telemetry is on, the transfer gets a stage tracker (and,
+        with rpcz, a child device span) stamped through the conn's
+        flush/ack machinery; conns without tracker support settle the
+        whole timeline synchronously around the call."""
+        _ds = _device_stats
+        tracker = None
+        if _ds.enabled():
+            conn = self.conn
+            lane = getattr(conn, "lane_kind", None) or \
+                getattr(conn.remote_endpoint, "scheme", "device")
+            # (lane, peer, cell) cached on the socket — the PR 7
+            # cells-cached-per-channel discipline; lane_kind can change
+            # once the hello lands, so the cache keys on it
+            cached = self.__dict__.get("_dev_send")
+            if cached is None or cached[0] != lane:
+                peer = _ds.peer_key(conn.remote_endpoint)
+                cached = (lane, peer,
+                          _ds.global_device_stats().device_cell(peer,
+                                                                lane))
+                self._dev_send = cached
+            nbytes = sum(getattr(a, "nbytes", 0) or 0 for a in arrays)
+            tracker = _ds.open_transfer(cached[1], lane, nbytes,
+                                        parent_span=span,
+                                        cell=cached[2])
+        if tracker is not None and \
+                getattr(self.conn, "supports_device_tracker", False):
+            try:
+                return bool(self.conn.write_device_payload(
+                    arrays, tracker=tracker))
+            except BaseException as e:
+                # the conn's own failure paths settle the tracker for
+                # the cases they detect (poison, unsendable) — but a
+                # raise BEFORE those checks (device_put OOM, bad
+                # dtype) must not strand an opened cell record; the
+                # settle latch makes a double report harmless
+                tracker.lane_failed(f"{type(e).__name__}: {e}")
+                raise
+        try:
+            r = self.conn.write_device_payload(arrays)
+        except BaseException as e:
+            if tracker is not None:
+                tracker.lane_failed(f"{type(e).__name__}: {e}")
+            raise
+        if tracker is not None:
+            # loopback/staged conns deliver synchronously: the whole
+            # timeline collapses into one settle (stage≈call, ack≈0)
+            tracker.lane_encoded()
+            tracker.lane_flushed()
+            tracker.lane_acked()
         return bool(r)
 
     def _cut_buf(self, buf: IOBuf) -> None:
@@ -1433,7 +1482,49 @@ class Socket:
 
     def take_device_payload(self):
         take = getattr(self.conn, "take_device_payload", None)
-        return take() if take is not None else None
+        if take is None:
+            return None
+        _ds = _device_stats
+        if not _ds.enabled():
+            return take()
+        t0 = time.monotonic_ns()
+        lane = take()
+        if lane is None:
+            return None
+        dur_us = (time.monotonic_ns() - t0) / 1e3
+        conn = self.conn
+        kind = getattr(conn, "lane_kind", None) or \
+            getattr(conn.remote_endpoint, "scheme", "device")
+        cached = self.__dict__.get("_dev_recv")
+        if cached is None or cached[0] != kind:
+            peer = _ds.peer_key(conn.remote_endpoint)
+            cached = (kind, peer,
+                      _ds.global_device_stats().device_cell(peer, kind))
+            self._dev_recv = cached
+        nbytes = sum(getattr(a, "nbytes", 0) or 0 for a in lane)
+        cached[2].note_recv(dur_us, nbytes)
+        if flag("rpcz_enabled"):
+            # parse-path handoff: the protocol attaches this to the
+            # message so dispatch can hang a device-recv child span off
+            # the server span it is about to create (parse per conn is
+            # sequential — the slot cannot be clobbered before the
+            # attach); only rpcz consumers read it, so only they pay
+            # the dict
+            self.last_device_take = {
+                "peer": cached[1], "lane": kind,
+                "recv_us": round(dur_us, 1),
+                "nbytes": nbytes, "t_us": t0 // 1000}
+        return lane
+
+    def take_device_payload_with_recv(self):
+        """(lane_arrays_or_None, recv_record_or_None) — the ONE parse-
+        side consumer API: every protocol parse site uses this so the
+        take + recv-record handoff cannot drift per protocol (the
+        device-recv span's producing half)."""
+        lane = self.take_device_payload()
+        if lane is None:
+            return None, None
+        return lane, self.__dict__.pop("last_device_take", None)
 
     # ------------------------------------------------------------ failure
     def set_failed(self, reason: Optional[BaseException] = None) -> None:

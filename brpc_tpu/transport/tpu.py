@@ -43,6 +43,7 @@ class TpuConn(MemConn):
     device (the PjRt Send/Recv slot)."""
 
     supports_device_lane = True
+    lane_kind = "loopback-d2d"   # /device cell label (device_stats)
 
     def __init__(self, rx, tx, local, remote, peer_device_ordinal: Optional[int]):
         super().__init__(rx, tx, local, remote)

@@ -98,6 +98,7 @@ def _decode_device_batch(data: bytes) -> List[np.ndarray]:
 
 class TpudConn(Conn):
     supports_device_lane = True
+    lane_kind = "staged-dcn"     # /device cell label (device_stats)
 
     def __init__(self, inner: TcpConn, local: EndPoint, remote: EndPoint,
                  device_ordinal: Optional[int]):

@@ -423,6 +423,34 @@ class TestPostforkReset:
         assert list(PostforkResetRule().check(sf_ok, Context([sf_ok]))) \
             == []
 
+    def test_mutation_dropping_registration_fires_on_device_stats(self):
+        """Mutation pin: strip the postfork.register line from the real
+        transport/device_stats.py — the rule must fire on
+        global_device_stats(), so the device-cell registry can never
+        silently lose its fork reset (a forked shard would report the
+        parent's transfer cells and a conn weak-set pointing into the
+        parent's transport)."""
+        from brpc_tpu.analysis.core import Context, SourceFile
+        from brpc_tpu.analysis.rules.postfork_reset import PostforkResetRule
+        path = os.path.join(REPO_ROOT, "brpc_tpu", "transport",
+                            "device_stats.py")
+        src = open(path).read()
+        target = [ln for ln in src.splitlines()
+                  if "postfork.register(" in ln]
+        assert len(target) == 1, target
+        mutated = src.replace(target[0] + "\n", "")
+        sf = SourceFile(path, "brpc_tpu/transport/device_stats.py",
+                        mutated)
+        found = list(PostforkResetRule().check(sf, Context([sf])))
+        assert any(f.rule == "postfork-reset"
+                   and "global_device_stats" in f.message
+                   for f in found), [f.format() for f in found]
+        # and the unmutated module stays clean
+        sf_ok = SourceFile(path, "brpc_tpu/transport/device_stats.py",
+                           src)
+        assert list(PostforkResetRule().check(sf_ok, Context([sf_ok]))) \
+            == []
+
     def test_registry_fixture_violation(self):
         """The object-registry registrar shape (fiber/worker_module.py
         idiom): a register* function appending its bare parameter into
@@ -645,7 +673,15 @@ class TestLockModelSnapshot:
     # update deliberately, together with docs/invariants.md
     # (36: +Controller._arb_lock -> RetryBudget._lock — the retry
     # token bucket drains inside _retry_taken_call's arb hold)
-    PINNED_EDGE_COUNT = 36
+    # (44: +IciConn._flush_lock/_pump_lock -> DeviceCell._lock — the
+    # device-transfer stage trackers stamp AND settle their leaf cells
+    # from the ici flush/ack legs (stamps hold the cell lock so the
+    # settle latch fully serializes span access). The model also mints
+    # receiver-inferred twin nodes (device_stats:cell._lock and
+    # device_stats:?._lock) for the same physical lock, x2 each, plus
+    # -> _ReducerBase._lock x2. DeviceCell._lock is a LOCK_ORDER leaf,
+    # see racelane.py)
+    PINNED_EDGE_COUNT = 44
 
     def _model(self):
         from brpc_tpu.analysis.core import Context, iter_source_files
@@ -1019,6 +1055,51 @@ class TestTrafficCaptureLint:
         names = [n for n, _ in LOCK_ORDER]
         assert "Recorder._lock" in names
         assert names.index("Recorder._lock") == len(names) - 1
+
+
+class TestDeviceObsLint:
+    """ISSUE 12 pins on the device observatory: the device cell lock's
+    place in the runtime lock order, and the uniqueness of the
+    recorder-hook verbs (the lock model's unique-method fallback minted
+    a FALSE edge from a shared `on_complete` name in PR 11 — the
+    device hooks must never collide the same way)."""
+
+    def test_device_cell_lock_ranked_after_ici_locks(self):
+        """DeviceCell._lock is a declared LEAF acquired under the ici
+        flush/pump holds (BatchTracker settle paths): it must rank
+        AFTER every IciConn lock in LOCK_ORDER + docs table row 29."""
+        from brpc_tpu.analysis.racelane import LOCK_ORDER
+        names = [n for n, _ in LOCK_ORDER]
+        assert "DeviceCell._lock" in names
+        for ici_lock in ("IciConn._pump_lock", "IciConn._flush_lock",
+                         "IciConn._lock"):
+            assert names.index(ici_lock) < \
+                names.index("DeviceCell._lock"), ici_lock
+
+    def test_device_hook_verbs_are_unique(self):
+        """Every device-stats hook/stamp verb is defined exactly once
+        across the package — a second definer would re-open the
+        unique-method-fallback false-edge hazard."""
+        import re
+        verbs = ("stamp_device_thread", "unstamp_device_thread",
+                 "device_thread_label", "lane_encoded", "lane_flushed",
+                 "lane_acked", "lane_failed", "note_open", "note_done",
+                 "note_recv", "open_transfer",
+                 "lane_introspection", "take_device_payload_with_recv",
+                 "device_page_payload", "merge_device_payloads")
+        counts = {v: 0 for v in verbs}
+        pkg = os.path.join(REPO_ROOT, "brpc_tpu")
+        for dirpath, _dirs, files in os.walk(pkg):
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                src = open(os.path.join(dirpath, fn),
+                           encoding="utf-8").read()
+                for v in verbs:
+                    counts[v] += len(
+                        re.findall(rf"\bdef {v}\b", src))
+        dupes = {v: n for v, n in counts.items() if n != 1}
+        assert not dupes, dupes
 
 
 class TestMemoryviewRelease:

@@ -105,9 +105,32 @@ def merge_backends(pages: List[dict]) -> Dict[str, dict]:
     return merged
 
 
+def _device_summary(page: Optional[dict]) -> Optional[dict]:
+    """One node's /device page collapsed to the top row: lane state,
+    transfer counters, decayed GB/s (the cells' bytes_per_second)."""
+    if not page:
+        return None
+    totals = page.get("totals") or {}
+    bps = 0.0
+    for row in (page.get("cells") or {}).values():
+        v = row.get("bytes_per_second")
+        if isinstance(v, (int, float)):
+            bps += v
+    return {
+        "lane": page.get("transfer_lane"),
+        "transfers": totals.get("transfers", 0),
+        "recv_transfers": totals.get("recv_transfers", 0),
+        "failed": totals.get("failed", 0),
+        "staged_fallbacks": totals.get("staged_fallbacks", 0),
+        "GBps": round(bps / 1e9, 4),
+        "leaked_bytes": (page.get("leaks") or {}).get("leaked_bytes", 0),
+    }
+
+
 def scrape(nodes: List[str]) -> dict:
     pages = []
     statuses = {}
+    devices = {}
     down = []
     for node in nodes:
         page = fetch_json(node, "/backends")
@@ -120,8 +143,14 @@ def scrape(nodes: List[str]) -> dict:
             statuses[node] = {"processed": st.get("processed"),
                               "errors": st.get("errors"),
                               "concurrency": st.get("concurrency")}
+        dev = _device_summary(fetch_json(node, "/device"))
+        # either direction counts: a node that only RECEIVES device
+        # payloads (device-array requests, host responses) is active
+        if dev is not None and (dev["transfers"] or
+                                dev["recv_transfers"]):
+            devices[node] = dev
     return {"backends": merge_backends(pages), "nodes": statuses,
-            "nodes_down": down, "nodes_up": len(pages)}
+            "device": devices, "nodes_down": down, "nodes_up": len(pages)}
 
 
 def render(view: dict) -> str:
@@ -144,11 +173,26 @@ def render(view: dict) -> str:
     out += ["  ".join(cell.ljust(widths[i]) for i, cell in enumerate(r))
             for r in rows]
     srv = view.get("nodes", {})
+    dev = view.get("device", {})
     out.append("")
     for node, st in sorted(srv.items()):
-        out.append(f"node {node}: processed={st.get('processed')} "
-                   f"errors={st.get('errors')} "
-                   f"concurrency={st.get('concurrency')}")
+        line = (f"node {node}: processed={st.get('processed')} "
+                f"errors={st.get('errors')} "
+                f"concurrency={st.get('concurrency')}")
+        d = dev.get(node)
+        if d is not None:
+            # the device column: per-node lane state + decayed GB/s
+            # from /device (absent when the node moved no payloads)
+            line += (f"  device[{d.get('lane')}]: "
+                     f"{d.get('GBps')} GB/s "
+                     f"transfers={d.get('transfers')}"
+                     + (f" failed={d['failed']}" if d.get("failed")
+                        else "")
+                     + (f" staged={d['staged_fallbacks']}"
+                        if d.get("staged_fallbacks") else "")
+                     + (f" leaked={d['leaked_bytes']}B"
+                        if d.get("leaked_bytes") else ""))
+        out.append(line)
     for node in view.get("nodes_down", []):
         out.append(f"node {node}: DOWN")
     return "\n".join(out)

@@ -1,0 +1,259 @@
+"""Device-observatory smoke: the cpu-dryrun proof that the device lane
+is MEASURED before anyone optimizes it (gate_device_obs in
+tools/preflight.py --gate).
+
+One process, ici:// loopback (lane_kind local-d2d on this fabric):
+
+  1. a device transfer burst under rpcz must produce stage-resolved
+     device spans whose stage/wire/ack stamps account for >= 90% of
+     each transfer's wall time (``ici_stage_attribution_pct``) — a span
+     set that can't explain its own latency is decoration, not
+     measurement;
+  2. after the conns close, every (peer, lane) cell must BALANCE:
+     transfers == completed + failed, and bytes_out must equal the
+     exact byte corpus the burst moved;
+  3. the /device builders must agree: the in-process payload, the HTTP
+     page served by a tcp:// admin server in the same process, and the
+     supervisor merge over single-shard dumps all report the same
+     totals;
+  4. the cells must cost <= 5% — the MEDIAN over order-balanced
+     (off, on) window pairs of per-call median latency (wall-clock
+     windows, cross-run minima and single pairs all drift more than
+     the cells cost on shared sandboxes), cumulative retry rounds,
+     BRPC_TPU_PERF_SMOKE=0 skips just this criterion.
+
+Prints one JSON line; exit 0 iff every criterion held.
+BRPC_TPU_DEVICE_OBS_SMOKE=0 skips the lane (handled by preflight).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import List
+
+BASE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, BASE)
+sys.path.insert(0, os.path.join(BASE, "tools"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ATTRIBUTION_MIN_PCT = 90.0
+OVERHEAD_PCT_MAX = 5.0
+
+
+def _make_server(addr: str, builtin: bool = False):
+    from brpc_tpu.rpc import Server, ServerOptions, Service
+    server = Server(ServerOptions(enable_builtin_services=builtin))
+    svc = Service("DevObs")
+
+    @svc.method()
+    def EchoDevice(cntl, request):
+        cntl.response_device_arrays = [a
+                                       for a in cntl.request_device_arrays]
+        return b"dev"
+
+    server.add_service(svc)
+    ep = server.start(addr)
+    return server, ep
+
+
+def _burst(ch, arr, calls: int) -> float:
+    t0 = time.perf_counter()
+    for i in range(calls):
+        cntl = ch.call_sync("DevObs", "EchoDevice", b"",
+                            request_device_arrays=[arr])
+        if cntl.failed():
+            raise RuntimeError(f"call {i} failed: {cntl.error_text}")
+    return time.perf_counter() - t0
+
+
+def _pipelined_window(ch, arr, iters: int) -> float:
+    """Pipelined device-echo window -> MEDIAN per-call latency (s).
+    Two measurement rules learned the hard way: a sync 1-conn loop
+    drifts far more than the cells cost (PR 7), and on a device lane
+    even pipelined WALL time is heavy-tailed (jax dispatch, allocator,
+    gc pauses land on a few calls) — the per-call median shrugs those
+    outliers off where a wall-clock window swallows them whole."""
+    from pipeline_runner import run_pipelined
+
+    lat: List[float] = []
+
+    def issue(on_done):
+        t0 = time.perf_counter_ns()
+
+        def _done(cntl):
+            lat.append(time.perf_counter_ns() - t0)
+            on_done(RuntimeError(cntl.error_text) if cntl.failed()
+                    else None)
+        ch.call("DevObs", "EchoDevice", b"", done=_done,
+                request_device_arrays=[arr])
+
+    run_pipelined(iters, 8, issue, 60.0)
+    lat.sort()
+    return lat[len(lat) // 2] / 1e9
+
+
+def run_smoke(out: dict) -> None:
+    import numpy as np
+
+    from brpc_tpu.butil.flags import set_flag
+    from brpc_tpu.rpc import Channel
+    from brpc_tpu.rpc.span import global_collector
+    from brpc_tpu.transport import device_stats as ds
+    from spawn_util import http_get_local
+
+    problems: List[str] = []
+    set_flag("device_stats_enabled", True)
+    from brpc_tpu.rpc import ChannelOptions
+    server, ep = _make_server("ici://127.0.0.1:0#device=0")
+    admin, admin_ep = _make_server("tcp://127.0.0.1:0", builtin=True)
+    # generous deadline: the deep pipelined overhead windows queue
+    # calls well past the 1s default on a loaded box
+    ch = Channel(f"ici://127.0.0.1:{ep.port}",
+                 ChannelOptions(timeout_ms=30000))
+    # a HOST buffer, staged fresh per call (the probe's shape): the
+    # recv pool's budget releases when the pulled arrays die, so a
+    # long-lived RESIDENT array re-sent N times pins N footprints by
+    # design (both lanes reserve — admission control) and a deep burst
+    # would exhaust the 256MB pool and wedge on pool.reserve. Fresh
+    # staging keeps reservations bounded by what's actually in flight.
+    arr = np.ones(((64 << 10) // 4,), np.float32)      # 64KB per leg
+    calls = 16
+
+    # ---- 1. stage-resolved spans under rpcz
+    _burst(ch, arr, 2)                                  # warm the lane
+    set_flag("rpcz_enabled", True)
+    global_collector.clear()
+    _burst(ch, arr, calls)
+    set_flag("rpcz_enabled", False)
+    sends = [s.to_dict() for s in global_collector.recent(600)
+             if s.side == "device" and (s.write_done_us
+                                        or s.first_byte_us)]
+    recvs = [s for s in global_collector.recent(600)
+             if s.side == "device" and not (s.write_done_us
+                                            or s.first_byte_us)]
+    out["device_spans"] = len(sends)
+    out["device_recv_spans"] = len(recvs)
+    # request + response legs both stamp: 2 sends per call
+    if len(sends) < calls:
+        problems.append(f"only {len(sends)} device send spans for "
+                        f"{calls} calls")
+    if not recvs:
+        problems.append("no device-recv child spans")
+    ratios = [(d["stage_us"] + d["wire_us"] + d["ack_us"])
+              / d["latency_us"] for d in sends if d["latency_us"] > 0]
+    att = round(100.0 * sum(ratios) / len(ratios), 1) if ratios else 0.0
+    out["ici_stage_attribution_pct"] = att
+    if att < ATTRIBUTION_MIN_PCT:
+        problems.append(f"stage attribution {att}% < "
+                        f"{ATTRIBUTION_MIN_PCT}%")
+    orphans = [d for d in sends if d["parent_span_id"] ==
+               f"{0:016x}"]
+    if orphans:
+        problems.append(f"{len(orphans)} device spans with no parent "
+                        "RPC span (trace inheritance broken)")
+
+    # ---- 4. overhead windows (BEFORE close: warm lane, rpcz off).
+    # Alternating BEST-OF pairs of seconds-scale windows (the flight /
+    # cluster_top gate discipline): sub-100ms windows drift 3-8% of
+    # pure scheduling noise on this box (observed 8.5% with all
+    # accounting no-oped), which swamps the ~2% real cost — window
+    # length, not pair count, is the lever. One retry round absorbs a
+    # gate-neighbour's teardown burst; a settle pause starts clean.
+    if os.environ.get("BRPC_TPU_PERF_SMOKE", "1") != "0":
+        overhead = None
+        time.sleep(0.3)
+        _pipelined_window(ch, arr, 64)                  # pipeline warm
+        # PAIR-WISE estimator: each adjacent (off, on) pair shares its
+        # load conditions, so the per-pair ratio cancels drift that a
+        # cross-run min cannot (observed: 14% "overhead" from a
+        # neighbour ramping between arms, on a box whose floor reading
+        # is 0%). Pairs alternate arm ORDER (off-first / on-first) so
+        # even an in-pair trend cancels across pairs; the MEDIAN over
+        # pairs shrugs off the loaded ones. Rounds are cumulative —
+        # every clean pair is evidence.
+        pair_pcts: List[float] = []
+        for round_no in range(3):
+            for _ in range(2):
+                off_first = (len(pair_pcts) % 2 == 0)
+                t = {}
+                for arm in ((False, True) if off_first
+                            else (True, False)):
+                    set_flag("device_stats_enabled", arm)
+                    t[arm] = _pipelined_window(ch, arr, 256)
+                pair_pcts.append(
+                    (t[True] - t[False]) / t[False] * 100.0)
+            s = sorted(pair_pcts)
+            overhead = round(max(0.0, s[len(s) // 2]), 2)
+            if overhead <= OVERHEAD_PCT_MAX:
+                break
+        out["device_stats_overhead_pct"] = overhead
+        if overhead is None or overhead > OVERHEAD_PCT_MAX:
+            problems.append(f"device_stats overhead {overhead}% > "
+                            f"{OVERHEAD_PCT_MAX}%")
+    else:
+        out["overhead_skipped"] = "BRPC_TPU_PERF_SMOKE=0"
+
+    # ---- 2. cells balance after close (close settles un-ACKed tails)
+    ch.close()
+    time.sleep(0.1)
+    page = ds.device_page_payload()
+    totals = page["totals"]
+    out["cells"] = {k: {kk: v[kk] for kk in
+                        ("transfers", "completed", "failed", "bytes_out")}
+                    for k, v in page["cells"].items()}
+    bad = [k for k, row in page["cells"].items()
+           if row["transfers"] != row["completed"] + row["failed"]]
+    if bad:
+        problems.append(f"cells out of balance after close: {bad}")
+    # byte corpus: the burst is uniform (arr.nbytes per transfer), so
+    # every cell's bytes_out must equal its transfer count times the
+    # payload size — an accounting drift shows as a mismatch here
+    for k, row in page["cells"].items():
+        if row["bytes_out"] != row["transfers"] * arr.nbytes:
+            problems.append(
+                f"cell {k}: bytes_out {row['bytes_out']} != "
+                f"{row['transfers']} transfers x {arr.nbytes}B")
+
+    # ---- 3. the three /device views agree
+    status, body = http_get_local(admin_ep.port, "/device")
+    if status != 200:
+        problems.append(f"/device HTTP {status}")
+        http_page = {}
+    else:
+        http_page = json.loads(body)
+        if http_page.get("totals") != totals:
+            problems.append("/device HTTP totals != in-process totals")
+    merged = ds.merge_device_payloads([page])
+    if merged["totals"] != totals:
+        problems.append("supervisor merge totals != in-process totals")
+    out["transfer_lane"] = page.get("transfer_lane")
+
+    server.stop()
+    server.join(2)
+    admin.stop()
+    admin.join(2)
+    out["problems"] = problems
+    out["ok"] = not problems
+
+
+def main() -> int:
+    import faulthandler
+    # a wedged lane must leave stacks, not a silent gate timeout
+    faulthandler.dump_traceback_later(150, exit=True)
+    out: dict = {"ok": False}
+    t0 = time.monotonic()
+    try:
+        run_smoke(out)
+    except BaseException as e:  # noqa: BLE001 - one JSON line always
+        out["error"] = f"{type(e).__name__}: {e}"[:500]
+    out["elapsed_s"] = round(time.monotonic() - t0, 1)
+    print(json.dumps(out, default=str), flush=True)
+    return 0 if out.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

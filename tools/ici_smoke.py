@@ -124,7 +124,17 @@ def main() -> None:
         evidence["first_rtt_ms"] = round(rtt_ms, 1)
 
         evidence["stage"] = "steady_state"
-        # a few more calls for a steady-state number
+        # a few more calls for a steady-state number — with rpcz on, so
+        # the new device spans stamp the stage-resolved breakdown the
+        # evidence asserts below
+        from brpc_tpu.butil.flags import set_flag
+        from brpc_tpu.rpc.span import global_collector
+        set_flag("rpcz_enabled", True)
+        # device spans ride the stage trackers: force the layer on for
+        # the breakdown even when the caller priced it out via
+        # BRPC_TPU_DEVICE_STATS=0 (this tool MEASURES the lane)
+        set_flag("device_stats_enabled", True)
+        global_collector.clear()
         lat = []
         for _ in range(5):
             t0 = time.perf_counter()
@@ -134,8 +144,38 @@ def main() -> None:
                 raise RuntimeError(f"rpc failed: {cntl.error_text}")
             np.asarray(cntl.response_device_arrays[0])
             lat.append((time.perf_counter() - t0) * 1e3)
+        set_flag("rpcz_enabled", False)
         evidence["steady_rtt_ms"] = round(sorted(lat)[len(lat) // 2], 1)
         evidence["payload_bytes"] = arr.nbytes
+
+        evidence["stage"] = "stage_breakdown"
+        # the request's device send spans (this process is the client;
+        # recv-child spans carry no write_done/first_byte stamps)
+        sends = [s.to_dict() for s in global_collector.recent(200)
+                 if s.side == "device" and
+                 (s.write_done_us or s.first_byte_us)]
+        if not sends:
+            raise RuntimeError("no device spans captured — the lane "
+                               "moved payloads without stage stamps")
+        n = len(sends)
+        bd = {
+            "n": n,
+            "stage_us": round(sum(d["stage_us"] for d in sends) / n, 1),
+            "wire_us": round(sum(d["wire_us"] for d in sends) / n, 1),
+            "ack_us": round(sum(d["ack_us"] for d in sends) / n, 1),
+        }
+        bd["sum_ms"] = round(
+            (bd["stage_us"] + bd["wire_us"] + bd["ack_us"]) / 1e3, 2)
+        evidence["stage_breakdown"] = bd
+        # the send span runs issue -> peer ack (the ack piggybacks on
+        # the response frame), so its stage sum must land near the
+        # measured RTT — wildly off means the stamps are lying
+        rtt_ms = evidence["steady_rtt_ms"]
+        if rtt_ms > 0 and not (0.1 * rtt_ms <= bd["sum_ms"]
+                               <= 1.7 * rtt_ms):
+            raise RuntimeError(
+                f"stage breakdown sum {bd['sum_ms']}ms inconsistent "
+                f"with measured RTT {rtt_ms}ms")
         evidence["ok"] = True
         evidence.pop("stage", None)
         ch.close()

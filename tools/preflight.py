@@ -559,6 +559,46 @@ def gate_fabric_smoke() -> dict:
     return out
 
 
+def gate_device_obs() -> dict:
+    """Device-observatory smoke (tools/device_obs_smoke.py, cpu-dryrun
+    lane, ~3s): an ici:// loopback transfer burst must produce
+    stage-resolved device spans accounting for >= 90% of transfer wall
+    time (child spans of the owning RPC spans), cells must balance
+    after close (transfers == completed + failed, bytes == corpus),
+    the /device HTTP page + supervisor merge must agree with the
+    in-process builder, and the cells must cost <= 5% on-vs-off on
+    pipelined pair-median windows (BRPC_TPU_PERF_SMOKE=0 skips just
+    that criterion). A subprocess so a wedged lane cannot hang the
+    gate; ONE retry round absorbs the shared sandbox's sustained load
+    bursts (the fabric-gate precedent — a real overhead regression
+    fails both); BRPC_TPU_DEVICE_OBS_SMOKE=0 skips."""
+    if os.environ.get("BRPC_TPU_DEVICE_OBS_SMOKE", "1") == "0":
+        return {"ok": True, "skipped": "BRPC_TPU_DEVICE_OBS_SMOKE=0"}
+    out: dict = {}
+    for attempt in range(2):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "device_obs_smoke.py")],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        out = {"ok": proc.returncode == 0, "attempt": attempt + 1}
+        try:
+            report = json.loads(proc.stdout.strip().splitlines()[-1])
+            for k in ("device_spans", "ici_stage_attribution_pct",
+                      "device_stats_overhead_pct", "transfer_lane",
+                      "elapsed_s"):
+                if k in report:
+                    out[k] = report[k]
+            if proc.returncode != 0:
+                out["problems"] = report.get("problems",
+                                             report.get("error"))
+        except (ValueError, IndexError):
+            out["ok"] = False
+            out["error"] = (proc.stdout + proc.stderr)[-500:]
+        if out["ok"]:
+            break
+    return out
+
+
 def gate_traffic_smoke() -> dict:
     """Traffic-engine smoke (tools/traffic_smoke.py, ~4s): record a
     paced mixed-size/mixed-priority burst through the live capture
@@ -660,6 +700,7 @@ def run_gate() -> int:
                      ("serving_smoke", gate_serving_smoke),
                      ("fabric_smoke", gate_fabric_smoke),
                      ("traffic_smoke", gate_traffic_smoke),
+                     ("device_obs", gate_device_obs),
                      ("perf_smoke", gate_perf_smoke)):
         try:
             report[name] = fn()
