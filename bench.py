@@ -1201,6 +1201,72 @@ def main() -> None:
                 result["partial"] = True
                 _progress({"progress": "error", "phase": "traffic",
                            "error": result["traffic"]["error"]})
+        # ---- timeline lane (ISSUE 13): the trend-ring engine's price.
+        # series_overhead_pct = series-on vs BRPC_TPU_BVAR_SERIES=0 on
+        # the pipelined multiproc qps driver (never a sync 1-conn
+        # loop), TWO echo servers alive at once (the cost sits on the
+        # SERVER's sampler tick, so the toggle rides the server env),
+        # alternating best-of windows like every overhead headline.
+        if deadline.remaining() < 15.0:
+            result["timeline"] = {"skipped": "wall budget"}
+            result["partial"] = True
+        else:
+            try:
+                from qps_client import drive_multiproc
+                from spawn_util import spawn_port_server
+                tservers = []
+                tports = {}
+                try:
+                    for tag, flagval in (("on", "1"), ("off", "0")):
+                        env = dict(os.environ,
+                                   BRPC_TPU_BVAR_SERIES=flagval,
+                                   JAX_PLATFORMS="cpu")
+                        tproc, tport = spawn_port_server(
+                            [os.path.join(base, "tools",
+                                          "bench_echo_server.py")],
+                            wall_s=20.0, env=env)
+                        if tport is None:
+                            raise RuntimeError(
+                                f"series-{tag} server spawn failed")
+                        tservers.append(tproc)
+                        tports[tag] = tport
+                    ncl = max(2, min(4, (os.cpu_count() or 2) // 4))
+                    win = min(1.2, max(0.8, deadline.remaining() * 0.02))
+                    qps_on: list = []
+                    qps_off: list = []
+                    for _ in range(2):     # alternating best-of
+                        qps_on.append(drive_multiproc(
+                            str(tports["on"]), nprocs=ncl, seconds=win,
+                            conns=2, inflight=8,
+                            method="PyEcho")["qps"])
+                        qps_off.append(drive_multiproc(
+                            str(tports["off"]), nprocs=ncl, seconds=win,
+                            conns=2, inflight=8,
+                            method="PyEcho")["qps"])
+                    lane = {"window_s": win, "client_procs": ncl,
+                            "qps_series_on": max(qps_on),
+                            "qps_series_off": max(qps_off)}
+                    if max(qps_off):
+                        result["series_overhead_pct"] = round(
+                            max(0.0, (1.0 - max(qps_on) / max(qps_off))
+                                * 100), 2)
+                    result["timeline"] = lane
+                    _progress({"progress": "timeline_lane", **lane,
+                               "series_overhead_pct":
+                               result.get("series_overhead_pct")})
+                finally:
+                    for tproc in tservers:
+                        try:
+                            tproc.terminate()
+                            tproc.wait(5)
+                        except Exception:
+                            pass
+            except Exception as e:  # noqa: BLE001 - diagnostics only
+                result["timeline"] = {
+                    "error": f"{type(e).__name__}: {e}"[:200]}
+                result["partial"] = True
+                _progress({"progress": "error", "phase": "timeline",
+                           "error": result["timeline"]["error"]})
         # ---- serving lane (ISSUE 8): continuous-batching inference
         # over streaming RPC — a 2-shard GenerateService under a
         # chaos-flapped pipelined client mix (seeded transport drops
@@ -1294,6 +1360,7 @@ def main() -> None:
         "fault_p99_ms": result.get("fault_p99_ms"),
         "replay_fidelity_pct": result.get("replay_fidelity_pct"),
         "capture_overhead_pct": result.get("capture_overhead_pct"),
+        "series_overhead_pct": result.get("series_overhead_pct"),
         "device_lane": ("error" if ("error" in lane or
                                     "lane_error" in lane)
                         else ("ok" if lane else "absent")),

@@ -101,6 +101,15 @@ LOCK_ORDER: List[Tuple[str, str]] = [
     # queue swap; disk writes NEVER run under it (blocking-under-lock
     # mutation pin in tests/test_graftlint.py)
     ("Recorder._lock",              "traffic/capture.py"),
+    # leaf: the trend-ring registry — settled on the bvar sampler's
+    # tick thread AFTER every variable read (get_value / passive
+    # callbacks run before the lock is taken); guards ring mutation
+    # only, never wraps another acquisition (bvar/series.py)
+    ("SeriesCollector._lock",       "bvar/series.py"),
+    # leaf: the anomaly watchdog's key-state + incident ring — same
+    # tick thread; span/flight-recorder annotation fires OUTSIDE it
+    # (bvar/anomaly.py)
+    ("AnomalyWatchdog._lock",       "bvar/anomaly.py"),
 ]
 
 _RANK: Dict[str, int] = {name: i for i, (name, _) in enumerate(LOCK_ORDER)}

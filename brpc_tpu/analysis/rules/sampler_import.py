@@ -14,6 +14,16 @@ thread name or target name mentions sampling (``sampl``/``record``/
 ``flight``/``profil``); the rule walks the target's call closure
 through the lock model's resolved edges and flags any ``import``
 statement executed inside it.
+
+The walk crosses module boundaries by MARKER NAME: a resolved call
+leaving the root's module becomes a new root when the callee's own
+name matches the markers (the bvar sampler's tick calling
+``series_sample_tick`` in bvar/series.py, which calls
+``watchdog_sample_pass`` in bvar/anomaly.py — the trend-ring engine
+and the anomaly watchdog are sampler-thread code even though the
+thread object lives in bvar/window.py). Naming the entrypoint with a
+marker is the opt-in; an unmarked cross-module callee stays out of
+scope, so helper calls into unrelated modules cannot flood the rule.
 """
 
 from __future__ import annotations
@@ -42,8 +52,26 @@ class SamplerNoLazyImportRule(Rule):
                 roots.add(target_fkey)
         findings: List[Finding] = []
         reported: Set[tuple] = set()
-        for root in sorted(roots):
+        seen_roots: Set[str] = set()
+        pending = sorted(roots)
+        while pending:
+            root = pending.pop(0)
+            if root in seen_roots:
+                continue
+            seen_roots.add(root)
             for info, chain in model.same_module_closure(root):
+                # marker-named callees in OTHER modules are sampler
+                # code too (the tick crossing a module boundary):
+                # recurse into their own same-module closures
+                for callee, _, _ in info.resolved_calls:
+                    target = model.funcs.get(callee)
+                    if target is None or \
+                            target.relpath == info.relpath or \
+                            callee in seen_roots:
+                        continue
+                    leaf = callee.split("::")[-1].split(".")[-1].lower()
+                    if any(m in leaf for m in _MARKERS):
+                        pending.append(callee)
                 for line, names in info.imports:
                     if (info.relpath, line) in reported:
                         continue

@@ -541,6 +541,19 @@ class FlightRecorder:
             "stall_ms_max_10s": stall_ms_max_10s(),
         }
 
+    def note_incident(self, text: str) -> None:
+        """Anomaly-watchdog stamp (bvar/anomaly.py): mark the LIVE
+        continuous-profile window's label counts so the window
+        covering a statistical break reads as such on
+        /hotspots?mode=continuous and in merged shard profiles (labels
+        already ride dump_state). No live window (profiler parked, hz
+        0) means nothing to mark — the incident ring on /timeline is
+        the durable record either way."""
+        with self._lock:
+            cur = self._cur
+            if cur is not None:
+                cur.labels[f"incident:{text}"] += 1
+
     def clear(self) -> None:
         with self._lock:
             self._done.clear()

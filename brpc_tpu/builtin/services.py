@@ -162,6 +162,34 @@ def capture_download_bytes(paths=None) -> bytes:
                 pass
 
 
+def timeline_page_payload(server=None, names=None, prefix: str = "",
+                          max_vars=None) -> dict:
+    """The /timeline payload: every tracked variable's multi-resolution
+    trend rings (60x1s -> 60x1m -> 24x1h, bvar/series.py), the anomaly
+    watchdog's incident ring and its tracked keys. ONE builder shared
+    by the RPC builtin service, the HTTP /timeline handler and the
+    shard dump (write_shard_dump bounds max_vars), so the views cannot
+    diverge. A shard-group SUPERVISOR serves the merged view instead
+    (ShardAggregator.merged_timeline)."""
+    import time as _time
+
+    from brpc_tpu.bvar.anomaly import global_watchdog
+    from brpc_tpu.bvar.series import (HOUR_BUCKETS, MIN_BUCKETS,
+                                      SEC_BUCKETS, global_series,
+                                      series_enabled)
+    wd = global_watchdog()
+    return {
+        "enabled": series_enabled(),
+        "now": _time.time(),
+        "resolution": {"sec": SEC_BUCKETS, "min": MIN_BUCKETS,
+                       "hr": HOUR_BUCKETS},
+        "series": global_series().dump_series(names=names, prefix=prefix,
+                                              max_vars=max_vars),
+        "incidents": wd.incident_snapshot(),
+        "watch_keys": wd.tracked_keys(),
+    }
+
+
 def status_page(server) -> dict:
     """The /status payload: server state, per-method latency windows
     (qps + p50/p90/p99/max — "which method is slow" without scraping
@@ -198,6 +226,23 @@ def status_page(server) -> dict:
     tokens = min_retry_tokens()
     if tokens is not None:
         saturation["retry_tokens"] = tokens
+    # saturation -> /timeline links: a live spike on this pane is one
+    # click from its history (only entries whose backing bvar has a
+    # trend ring right now — a link to an empty series helps nobody)
+    from brpc_tpu.bvar.series import global_series, series_enabled
+    timeline_links = {}
+    if series_enabled():
+        col = global_series()
+        for pane_key, var_name in (
+                ("socket_wqueue_bytes", "socket_wqueue_bytes"),
+                ("limit_shed", "server_limit_shed"),
+                ("deadline_shed", "server_deadline_shed"),
+                ("inflight", "server_concurrency_inflight"),
+                ("concurrency_limit", "server_concurrency_limit"),
+                ("iobuf_pool_hit_ratio", "iobuf_pool_hit_ratio"),
+                ("retry_tokens", "retry_tokens_min")):
+            if pane_key in saturation and col.has_series(var_name):
+                timeline_links[pane_key] = f"/timeline?name={var_name}"
     return {
         "running": server.is_running,
         "endpoint": str(server.endpoint) if server.endpoint else None,
@@ -209,6 +254,7 @@ def status_page(server) -> dict:
         "method_status": {k: lr.get_value()
                           for k, lr in server.method_status.items()},
         "saturation": saturation,
+        "saturation_timeline": timeline_links,
     }
 
 
@@ -277,6 +323,20 @@ def add_builtin_services(server) -> None:
         # of HTTP /serving, from the ONE shared builder
         from brpc_tpu.serving.service import serving_page_payload
         return json.dumps(serving_page_payload(server),
+                          default=str).encode()
+
+    @builtin.method()
+    def timeline(cntl, request):
+        # multi-resolution trend rings + incident ring — the builtin-
+        # RPC twin of HTTP /timeline, from the ONE shared builder.
+        # Request bytes: optional name prefix filter. A shard-group
+        # SUPERVISOR serves the merged per-shard view instead.
+        prefix = bytes(request).decode().strip() if request else ""
+        agg = getattr(server, "shard_aggregator", None)
+        if agg is not None:
+            return json.dumps(agg.merged_timeline(prefix=prefix),
+                              default=str).encode()
+        return json.dumps(timeline_page_payload(server, prefix=prefix),
                           default=str).encode()
 
     @builtin.method()

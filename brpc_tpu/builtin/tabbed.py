@@ -14,6 +14,7 @@ TABS = [
     ("vars", "/vars"),
     ("flags", "/flags"),
     ("rpcz", "/rpcz"),
+    ("timeline", "/timeline"),
     ("hotspots", "/hotspots?seconds=1"),
     ("continuous", "/hotspots?mode=continuous"),
     ("heap", "/hotspots?type=heap"),

@@ -8,6 +8,10 @@ from brpc_tpu.bvar.variable import Variable, expose, dump_exposed, describe_expo
 from brpc_tpu.bvar.reducer import Adder, Maxer, Miner, IntRecorder, PassiveStatus, Status
 from brpc_tpu.bvar.percentile import Percentile
 from brpc_tpu.bvar.window import Window, PerSecond, Sampler, global_sampler
+from brpc_tpu.bvar.series import (SeriesCollector, declare_series_kind,
+                                  ensure_series, global_series,
+                                  series_enabled, sparkline)
+from brpc_tpu.bvar.anomaly import AnomalyWatchdog, global_watchdog
 from brpc_tpu.bvar.latency_recorder import LatencyRecorder
 from brpc_tpu.bvar.prometheus import dump_prometheus
 from brpc_tpu.bvar.multi_dimension import MultiDimension
@@ -18,6 +22,9 @@ __all__ = [
     "Variable", "expose", "dump_exposed", "describe_exposed", "unexpose_all",
     "Adder", "Maxer", "Miner", "IntRecorder", "PassiveStatus", "Status",
     "Percentile", "Window", "PerSecond", "Sampler", "global_sampler",
+    "SeriesCollector", "declare_series_kind", "ensure_series",
+    "global_series", "series_enabled", "sparkline",
+    "AnomalyWatchdog", "global_watchdog",
     "LatencyRecorder", "dump_prometheus", "MultiDimension",
     "expose_default_variables", "FlagVar", "expose_flag", "expose_all_flags",
 ]

@@ -1,0 +1,303 @@
+"""Anomaly watchdog: EWMA+MAD z-scores over the curated watch-key set,
+riding the bvar sampler tick (bvar/series.py hands it every stored
+bucket that matches the watch predicate).
+
+Post-mortems start from "when did it go wrong", and /timeline's rings
+only answer that if someone knows where to look. The watchdog watches
+the keys an operator would: error counters, shed counters, latency p99
+tracks, queue-delay, device-lane failed/leaked bytes, capture drops —
+and turns a statistical break in any of them into an INCIDENT record:
+
+  * per key, an exponentially-weighted mean and an EWMA of absolute
+    deviation (the MAD estimator's online form); a bucket whose
+    z-score ``(x - mean) / (1.4826 * mad)`` clears ``anomaly_z_open``
+    after ``anomaly_warmup_ticks`` observations raises an alert —
+    upward breaks only (an error counter going quiet is recovery, not
+    an incident);
+  * alerts in one tick coalesce into ONE incident (a fault storm bumps
+    sheds + errors + p99 together — three records for one cause is
+    noise); later alerting keys attach to the open incident; the
+    incident closes after ``anomaly_close_ticks`` consecutive calm
+    ticks and the record (bounded ring) keeps open/close stamps, the
+    implicated vars and their peak z/values;
+  * an opening incident ANNOTATES the in-window rpcz spans (the
+    requests that lived through the break carry ``incident #N`` in
+    /rpcz) and stamps the flight recorder's live continuous-profile
+    window label, so the profile window covering the break is marked
+    in /hotspots?mode=continuous.
+
+Everything here is sampler-thread code: the span/flight-recorder
+collaborators are bound by ``bind_watchdog_imports()`` on the CALLER
+thread (Server.start via series.ensure_series) — never imported at
+sample time (the PR 8 fd-hazard rule; graftlint's
+sampler-no-lazy-import rule walks this module through the tick
+entrypoints' marker names). Determinism: incident open/close is a pure
+function of the value sequence — same synthetic series, same incident
+records, every run.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from brpc_tpu.butil.flags import define_flag, flag
+
+define_flag("anomaly_watchdog_enabled", True,
+            "run the EWMA+MAD anomaly watchdog over the curated "
+            "watch keys on every series tick")
+define_flag("anomaly_z_open", 6.0,
+            "z-score a watched bucket must clear to raise an alert")
+define_flag("anomaly_z_close", 3.0,
+            "z-score below which a tick counts as calm for an open "
+            "incident")
+define_flag("anomaly_warmup_ticks", 5,
+            "observations a key needs before it may alert (a fresh "
+            "key's first reading is not an anomaly)")
+define_flag("anomaly_close_ticks", 5,
+            "consecutive calm ticks that close an open incident")
+define_flag("anomaly_max_incidents", 64,
+            "incident records kept in the bounded ring")
+define_flag("anomaly_watch_filter", "",
+            "comma-separated allowlist narrowing the watch-key set "
+            "(empty = the full curated predicate); smokes pin this "
+            "for determinism")
+
+_EWMA_ALPHA = 0.25
+_MAD_SCALE = 1.4826            # MAD -> sigma under normality
+_SPAN_WINDOW_US = 5_000_000    # annotate spans that ended in the last 5s
+_SPAN_ANNOTATE_MAX = 16
+
+# annotation collaborators, bound on the CALLER thread by
+# bind_watchdog_imports (never at sample time): rpc.span's collector
+# ring and the flight recorder's live window label
+_span_mod = None
+_fr_mod = None
+
+
+def bind_watchdog_imports() -> None:
+    """One-time import binding for the watchdog's annotation targets;
+    runs on the thread that starts the serving stack (Server.start),
+    mirroring flight_recorder._bind_sampler_imports."""
+    global _span_mod, _fr_mod
+    if _fr_mod is not None:
+        return
+    from brpc_tpu.builtin import flight_recorder as fr
+    from brpc_tpu.rpc import span as sm
+    _span_mod, _fr_mod = sm, fr
+
+
+def is_watch_key(name: str) -> bool:
+    """The curated predicate: error counters, sheds, queue delay,
+    device-lane failed/leaked, capture drops, and latency p99 tracks —
+    both *_p99_us gauges and the ``<name>.p99`` track every quantile
+    series derives. A set ``anomaly_watch_filter`` replaces the
+    predicate wholesale (exact names only), so a pinned filter also
+    silences the quantile tracks — the smokes' determinism contract."""
+    filt = flag("anomaly_watch_filter")
+    if filt:
+        return name in {k.strip() for k in str(filt).split(",")
+                        if k.strip()}
+    return (name.endswith("_shed") or "error" in name
+            or "queue_delay" in name or name.endswith("_p99_us")
+            or name.endswith(".p99")
+            or name.endswith("dropped_queue")
+            or name.endswith("dropped_budget")
+            or "leaked" in name or "unpulled" in name
+            or name.startswith("chaos_injected"))
+
+
+class _KeyState:
+    __slots__ = ("mean", "mad", "n")
+
+    def __init__(self):
+        self.mean = 0.0
+        self.mad = 0.0
+        self.n = 0
+
+
+class Incident:
+    __slots__ = ("id", "opened_t", "closed_t", "keys", "peak_z",
+                 "peak_value", "peak_key", "baseline", "calm",
+                 "spans_annotated")
+
+    def __init__(self, iid: int, t: int):
+        self.id = iid
+        self.opened_t = t
+        self.closed_t: Optional[int] = None
+        self.keys: List[str] = []
+        self.peak_z = 0.0
+        self.peak_value = 0.0
+        self.peak_key = ""
+        self.baseline = 0.0
+        self.calm = 0
+        self.spans_annotated = 0
+
+    def to_dict(self) -> dict:
+        return {"id": self.id, "opened_t": self.opened_t,
+                "closed_t": self.closed_t,
+                "state": "closed" if self.closed_t is not None
+                else "open",
+                "keys": list(self.keys),
+                "peak_key": self.peak_key,
+                "peak_z": round(self.peak_z, 2),
+                "peak_value": round(self.peak_value, 3),
+                "baseline": round(self.baseline, 3),
+                "spans_annotated": self.spans_annotated}
+
+
+class AnomalyWatchdog:
+    """``_lock`` is a LEAF (LOCK_ORDER row: bvar/anomaly.py): it
+    guards key-state and the incident ring only; span/flight-recorder
+    annotation fires AFTER the lock is released (annotating under it
+    would nest foreign locks beneath a sampler-tick leaf)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._keys: Dict[str, _KeyState] = {}
+        self._incidents: deque = deque(
+            maxlen=int(flag("anomaly_max_incidents")))
+        self._open: Optional[Incident] = None
+        self._next_id = 1
+
+    # ----------------------------------------------------------- tick
+    def watchdog_pass(self, points: Dict[str, float], t: int) -> None:
+        """One tick's pass (unique verb — generic names mint false
+        lock-graph edges through the unique-method fallback, the PR 11
+        lesson). ``points`` is {watch key: the bucket value the series
+        engine just stored}."""
+        if not flag("anomaly_watchdog_enabled"):
+            return
+        warmup = int(flag("anomaly_warmup_ticks"))
+        z_open = float(flag("anomaly_z_open"))
+        z_close = float(flag("anomaly_z_close"))
+        close_ticks = int(flag("anomaly_close_ticks"))
+        opened: Optional[Incident] = None
+        with self._lock:
+            alerts = []
+            any_hot = False
+            for key in sorted(points):
+                x = float(points[key])
+                st = self._keys.get(key)
+                if st is None:
+                    st = self._keys[key] = _KeyState()
+                dev = abs(x - st.mean)
+                denom = max(_MAD_SCALE * st.mad, 1.0,
+                            0.02 * abs(st.mean))
+                z = (x - st.mean) / denom          # upward breaks only
+                if st.n >= warmup:
+                    if z >= z_open:
+                        alerts.append((key, x, z, st.mean))
+                    if z >= z_close:
+                        any_hot = True
+                # update AFTER scoring: the spike must not vote on the
+                # baseline it is judged against
+                st.mean += _EWMA_ALPHA * (x - st.mean)
+                st.mad += _EWMA_ALPHA * (dev - st.mad)
+                st.n += 1
+            if alerts:
+                inc = self._open
+                if inc is None:
+                    inc = Incident(self._next_id, t)
+                    self._next_id += 1
+                    self._incidents.append(inc)
+                    self._open = inc
+                    opened = inc
+                inc.calm = 0
+                for key, x, z, mean in alerts:
+                    if key not in inc.keys:
+                        inc.keys.append(key)
+                    if z > inc.peak_z:
+                        inc.peak_z, inc.peak_value = z, x
+                        inc.peak_key, inc.baseline = key, mean
+            elif self._open is not None and not any_hot:
+                self._open.calm += 1
+                if self._open.calm >= close_ticks:
+                    self._open.closed_t = t
+                    self._open = None
+        if opened is not None:
+            self._stamp_incident(opened)
+
+    # ----------------------------------------------------- annotation
+    def _stamp_incident(self, inc: Incident) -> None:
+        """Outside every lock: mark the rpcz spans that ended inside
+        the break window and the flight recorder's live profile
+        window. Best-effort — an annotation failure must never cost
+        the sampler thread."""
+        label = f"incident #{inc.id}: " + ",".join(inc.keys)
+        sm, fr = _span_mod, _fr_mod
+        if sm is not None:
+            try:
+                cutoff = time.monotonic_ns() // 1000 - _SPAN_WINDOW_US
+                n = 0
+                for span in reversed(sm.global_collector.recent(64)):
+                    if span.end_us and span.end_us >= cutoff:
+                        span.annotate(
+                            f"{label} z={inc.peak_z:.1f} "
+                            f"peak={inc.peak_value:g}")
+                        n += 1
+                        if n >= _SPAN_ANNOTATE_MAX:
+                            break
+                inc.spans_annotated = n
+            except Exception:
+                pass
+        if fr is not None:
+            try:
+                fr.global_recorder().note_incident(
+                    f"#{inc.id} {inc.peak_key or inc.keys[0]}")
+            except Exception:
+                pass
+
+    # ---------------------------------------------------------- reads
+    def incident_snapshot(self) -> List[dict]:
+        with self._lock:
+            return [inc.to_dict() for inc in self._incidents]
+
+    def tracked_keys(self) -> List[str]:
+        with self._lock:
+            return sorted(self._keys)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._keys.clear()
+            self._incidents.clear()
+            self._open = None
+            self._next_id = 1
+
+
+# ------------------------------------------------------------ singleton
+
+_watchdog: Optional[AnomalyWatchdog] = None
+_watchdog_lock = threading.Lock()
+
+
+def global_watchdog() -> AnomalyWatchdog:
+    global _watchdog
+    if _watchdog is None:
+        with _watchdog_lock:
+            if _watchdog is None:
+                _watchdog = AnomalyWatchdog()
+    return _watchdog
+
+
+def watchdog_sample_pass(points: Dict[str, float], t: int) -> None:
+    """The series tick's entry (bvar/series.py) — marker-named so the
+    sampler-no-lazy-import rule roots its closure here."""
+    global_watchdog().watchdog_pass(points, t)
+
+
+def _postfork_reset() -> None:
+    """Fork hygiene: the key baselines and incidents describe the
+    PARENT's traffic and the leaf lock may be mid-hold at fork time.
+    A shard child starts with a fresh watchdog."""
+    global _watchdog, _watchdog_lock
+    _watchdog = None
+    _watchdog_lock = threading.Lock()
+
+
+from brpc_tpu.butil import postfork as _postfork  # noqa: E402
+#   (registration ships with the singleton it resets)
+
+_postfork.register("bvar.anomaly", _postfork_reset)

@@ -1,0 +1,526 @@
+"""Multi-resolution trend rings over every exposed variable — the
+time axis under /vars (the reference's bvar Series<T> + -save_series:
+bvar/detail/series.h keeps 60s/60m/24h/30d rings per exposed var and
+/vars plots them; our /timeline serves the same rings as JSON).
+
+One ring set per exposed variable, stamped on the EXISTING global
+sampler tick thread (bvar/window.py — the thread that already
+snapshots every windowed reducer 1/s): 60 one-second buckets cascading
+into 60 one-minute buckets cascading into 24 one-hour buckets, O(1)
+per var per tick (the cascade combines 60 buckets once per minute —
+amortized O(1)). Value semantics per kind:
+
+  delta    — Adder-shaped cumulative counters: bucket = per-interval
+             delta of get_value snapshots (never reset(): the Window
+             sampler owns reset-mode sampling); cascade + shard merge
+             SUM.
+  last     — gauges (PassiveStatus/Status/Window readings): bucket =
+             last reading; cascade keeps the last; shard merge applies
+             the name-aware scalar rules merged /vars uses
+             (shard_group.merge_var_values), so the two views cannot
+             disagree on any gauge.
+  max/min  — Maxer readings and instant-quantile gauges keep the max
+             observed; Miner readings keep the MIN (told apart by the
+             reducer's combine op); cascade + merge with the same
+             extreme (a p99 spike — or a Miner's floor reading — must
+             survive into the minute ring; averaging would erase it).
+  quantile — LatencyRecorder composites: bucket = {count: per-interval
+             delta, p50/p99/max: instant readings}; cascade and shard
+             merge sum the counts and take per-field MAXIMA — pooled
+             worst-case, never averaged (averaged percentiles are
+             wrong; the merged /status percentiles pool raw reservoirs
+             instead, this ring keeps the bounded conservative form).
+
+Escape hatch: ``BRPC_TPU_BVAR_SERIES=0`` in the environment or the
+``bvar_series_enabled`` flag parks the whole engine (ticks become a
+single boolean check). The registry survives ``unexpose_all`` + a
+re-expose at ``Server.start`` (the PR 2 lifecycle rule): a name that
+re-appears under a NEW variable object keeps its ring history and
+re-baselines its delta snapshot, so a restart never fabricates a
+spike. Fork hygiene: the postfork registry clears the rings — a shard
+child starts fresh while the parent's rings stay untouched.
+
+The anomaly watchdog (bvar/anomaly.py) rides the same tick: every
+stored bucket that matches the curated watch-key set feeds its
+EWMA+MAD z-score pass. Sampler-thread discipline applies to this whole
+module: everything reachable from ``series_sample_tick`` binds its
+imports at module load (the PR 8 fd-hazard rule, enforced by
+graftlint's sampler-no-lazy-import rule through the cross-module
+marker recursion).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from brpc_tpu.butil.flags import define_flag, flag
+from brpc_tpu.bvar.variable import dump_exposed_variables
+# the watchdog is sampler-tick code: bound at module load (anomaly
+# imports only flags/stdlib at load — no cycle back into bvar), as
+# DIRECT function imports so the lock model resolves the tick's call
+# chain into anomaly.py (the sampler-no-lazy-import rule roots there)
+from brpc_tpu.bvar.anomaly import (bind_watchdog_imports,
+                                   is_watch_key as _is_watch_key,
+                                   watchdog_sample_pass)
+
+define_flag("bvar_series_enabled", True,
+            "attach multi-resolution trend rings (60x1s -> 60x1m -> "
+            "24x1h) to every exposed bvar on the sampler tick; serves "
+            "/timeline and the /vars sparklines. BRPC_TPU_BVAR_SERIES=0 "
+            "in the environment overrides to off")
+define_flag("bvar_series_max_vars", 256,
+            "most exposed variables tracked by the series engine "
+            "(sorted by name; the rest are skipped, never sampled)")
+
+SEC_BUCKETS = 60
+MIN_BUCKETS = 60
+HOUR_BUCKETS = 24
+
+KIND_DELTA = "delta"
+KIND_LAST = "last"
+KIND_MAX = "max"
+KIND_MIN = "min"
+KIND_QUANTILE = "quantile"
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def series_enabled() -> bool:
+    """One boolean gate for the whole engine: env escape hatch first
+    (an operator's BRPC_TPU_BVAR_SERIES=0 must win even over a /flags
+    mutation), then the runtime flag."""
+    if os.environ.get("BRPC_TPU_BVAR_SERIES", "1") == "0":
+        return False
+    return bool(flag("bvar_series_enabled"))
+
+
+def sparkline(values, width: int = 30) -> str:
+    """Unicode sparkline of the last ``width`` numeric values.
+    Bounds: empty/non-numeric input -> "", a constant series renders
+    at the floor glyph, min..max always span the full glyph ramp."""
+    vals = [float(v) for v in values
+            if isinstance(v, (int, float)) and not isinstance(v, bool)]
+    vals = vals[-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK[0] * len(vals)
+    span = hi - lo
+    return "".join(_SPARK[min(len(_SPARK) - 1,
+                              int((v - lo) / span * len(_SPARK)))]
+                   for v in vals)
+
+
+def _combine(kind: str, a, b):
+    """Fold bucket ``b`` into accumulator ``a`` (the cascade op and
+    the cross-shard per-bucket op share these semantics)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if kind == KIND_DELTA:
+        return a + b
+    if kind == KIND_LAST:
+        return b
+    if kind == KIND_MAX:
+        return max(a, b)
+    if kind == KIND_MIN:
+        return min(a, b)
+    # quantile dict: counts sum, percentile fields keep the worst
+    out = dict(a)
+    out["count"] = (a.get("count", 0) or 0) + (b.get("count", 0) or 0)
+    for k in ("p50", "p99", "max"):
+        out[k] = max(a.get(k, 0) or 0, b.get(k, 0) or 0)
+    return out
+
+
+class _Ring:
+    """One variable's three-level ring: seconds cascade into minutes
+    cascade into hours on rollover."""
+
+    __slots__ = ("kind", "sec", "min", "hr",
+                 "_min_acc", "_min_n", "_hr_acc", "_hr_n")
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self.sec: deque = deque(maxlen=SEC_BUCKETS)
+        self.min: deque = deque(maxlen=MIN_BUCKETS)
+        self.hr: deque = deque(maxlen=HOUR_BUCKETS)
+        self._min_acc = None
+        self._min_n = 0
+        self._hr_acc = None
+        self._hr_n = 0
+
+    def push(self, t: int, value) -> None:
+        self.sec.append((t, value))
+        self._min_acc = _combine(self.kind, self._min_acc, value)
+        self._min_n += 1
+        if self._min_n >= SEC_BUCKETS:
+            self.min.append((t, self._min_acc))
+            self._hr_acc = _combine(self.kind, self._hr_acc,
+                                    self._min_acc)
+            self._hr_n += 1
+            self._min_acc, self._min_n = None, 0
+            if self._hr_n >= MIN_BUCKETS:
+                self.hr.append((t, self._hr_acc))
+                self._hr_acc, self._hr_n = None, 0
+
+    def to_dict(self) -> dict:
+        # live_sec/live_min: buckets not yet cascaded into the level
+        # above — the seconds ring is a sliding WINDOW (it keeps
+        # showing buckets a rolled minute already absorbed), so exact
+        # accounting reads "minutes + the last live_sec seconds"
+        return {"kind": self.kind,
+                "sec": [[t, v] for t, v in self.sec],
+                "min": [[t, v] for t, v in self.min],
+                "hr": [[t, v] for t, v in self.hr],
+                "live_sec": self._min_n, "live_min": self._hr_n}
+
+
+class _Entry:
+    __slots__ = ("ring", "vid", "prev", "touched")
+
+    def __init__(self, kind: str, vid: int):
+        self.ring = _Ring(kind)
+        self.vid = vid          # id() of the backing Variable: a
+        #                         re-exposed name re-baselines, never
+        #                         fabricates a delta across objects
+        self.prev = None        # previous cumulative snapshot (delta)
+        self.touched = 0.0
+
+
+def detect_kind(var) -> Optional[str]:
+    """Duck-typed (no bvar-submodule imports — this runs on the
+    sampler path and the latency/window modules import back into this
+    package): LatencyRecorder shape first, then the reducer's declared
+    SERIES_MODE, then 'numeric gauge'."""
+    if hasattr(var, "_percentile") and hasattr(var, "latency_percentile"):
+        return KIND_QUANTILE
+    mode = getattr(var, "SERIES_MODE", None)
+    if mode == "cumulative":
+        return KIND_DELTA
+    if mode == "delta":
+        # Maxer vs Miner share the reducer shape; the combine op tells
+        # them apart (a Miner's minima cascaded with max() would erase
+        # exactly the floor readings a Miner exists to catch)
+        op = getattr(var, "_op", None)
+        if op is not None:
+            try:
+                if op(0, 1) == 0:
+                    return KIND_MIN
+            except Exception:
+                pass
+        return KIND_MAX
+    return KIND_LAST
+
+
+class SeriesCollector:
+    """The process-wide ring registry. ``_lock`` is a LEAF (LOCK_ORDER
+    row: bvar/series.py): it guards ring/entry mutation only — every
+    variable read (get_value may call arbitrary PassiveStatus
+    callbacks and take reducer locks) happens BEFORE the lock is
+    taken, and nothing is called out under it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _Entry] = {}
+        self._declared: Dict[str, str] = {}
+
+    # -------------------------------------------------------- declare
+    def declare_kind(self, name: str, kind: str) -> None:
+        """Name-declared semantics override detection: a monotone
+        PassiveStatus (server_processed) graphs as qps only when its
+        series knows it is a counter."""
+        with self._lock:
+            self._declared[name] = kind
+
+    # ---------------------------------------------------------- ticks
+    def collect_readings(self) -> List[Tuple[str, int, str, object]]:
+        """Phase 1, NO lock held: read every tracked variable.
+        Non-numeric readings are skipped (Status strings, dict-valued
+        passives)."""
+        cap = max(1, int(flag("bvar_series_max_vars")))
+        with self._lock:
+            declared = dict(self._declared)
+        pairs = dump_exposed_variables("")
+        if len(pairs) > cap:
+            # over the cap: the watchdog's keys and every declared
+            # series keep their slots FIRST — a labeled-cell explosion
+            # must not silently evict server_errors because 's' sorts
+            # late — the remainder fills alphabetically
+            priority = [(n, v) for n, v in pairs
+                        if n in declared or _is_watch_key(n)]
+            rest = [(n, v) for n, v in pairs
+                    if n not in declared and not _is_watch_key(n)]
+            pairs = (priority + rest)[:cap]
+        out: List[Tuple[str, int, str, object]] = []
+        for name, var in pairs:
+            kind = declared.get(name) or detect_kind(var)
+            try:
+                if kind == KIND_QUANTILE:
+                    raw = {"count": int(var.count()),
+                           "p50": float(var.latency_percentile(0.5)),
+                           "p99": float(var.latency_percentile(0.99)),
+                           "max": float(var.max_latency() or 0)}
+                else:
+                    v = var.get_value()
+                    if not isinstance(v, (int, float)) or \
+                            isinstance(v, bool):
+                        continue
+                    raw = v
+            except Exception:
+                continue    # a raising passive must not kill the tick
+            out.append((name, id(var), kind, raw))
+        return out
+
+    def store_readings(self, readings, t: int) -> Dict[str, float]:
+        """Phase 2, under the leaf lock: turn readings into buckets.
+        Returns the watch points for the anomaly pass (key -> the
+        bucket value just stored, numeric only)."""
+        points: Dict[str, float] = {}
+        now = time.monotonic()
+        with self._lock:
+            for name, vid, kind, raw in readings:
+                e = self._entries.get(name)
+                if e is None or e.ring.kind != kind:
+                    e = self._entries[name] = _Entry(kind, vid)
+                if e.vid != vid:
+                    # re-exposed under a new object (unexpose_all +
+                    # Server.start): keep the ring, re-baseline
+                    e.vid = vid
+                    e.prev = None
+                e.touched = now
+                if kind == KIND_DELTA:
+                    prev, e.prev = e.prev, raw
+                    bucket = raw - prev if prev is not None else 0
+                    if bucket < 0:      # counter reset: re-baseline
+                        bucket = 0
+                elif kind == KIND_QUANTILE:
+                    prev, e.prev = e.prev, raw["count"]
+                    dc = raw["count"] - prev if prev is not None else 0
+                    bucket = {"count": max(0, dc), "p50": raw["p50"],
+                              "p99": raw["p99"], "max": raw["max"]}
+                else:
+                    bucket = raw
+                e.ring.push(t, bucket)
+                if kind == KIND_QUANTILE:
+                    # the .p99 track goes through the same predicate
+                    # as every other key: a pinned anomaly_watch_filter
+                    # must silence it too (the smoke's exactly-one-
+                    # incident determinism depends on that)
+                    key = name + ".p99"
+                    if _is_watch_key(key):
+                        points[key] = bucket["p99"]
+                elif _is_watch_key(name):
+                    points[name] = float(bucket)
+            self._prune_locked(now)
+        return points
+
+    def _prune_locked(self, now: float) -> None:
+        cap = max(1, int(flag("bvar_series_max_vars")))
+        if len(self._entries) <= cap:
+            return
+        # over the cap (mass re-expose churn): drop least-recently
+        # touched names first — frozen history loses to live series
+        for name in sorted(self._entries,
+                           key=lambda n: self._entries[n].touched):
+            if len(self._entries) <= cap:
+                break
+            del self._entries[name]
+
+    # ---------------------------------------------------------- reads
+    def has_series(self, name: str) -> bool:
+        with self._lock:
+            return name in self._entries
+
+    def tracked_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def spark(self, name: str, width: int = 30) -> str:
+        """Seconds-level sparkline for the /vars inline column
+        (quantile series render their p99 track)."""
+        with self._lock:
+            e = self._entries.get(name)
+            if e is None or len(e.ring.sec) < 2:
+                return ""
+            vals = [v for _, v in e.ring.sec]
+            kind = e.ring.kind
+        if kind == KIND_QUANTILE:
+            vals = [v.get("p99", 0) for v in vals]
+        return sparkline(vals, width)
+
+    def dump_series(self, names: Optional[List[str]] = None,
+                    prefix: str = "",
+                    max_vars: Optional[int] = None) -> Dict[str, dict]:
+        with self._lock:
+            picked = []
+            for name in sorted(self._entries):
+                if names is not None and name not in names:
+                    continue
+                if prefix and not name.startswith(prefix):
+                    continue
+                picked.append(name)
+                if max_vars is not None and len(picked) >= max_vars:
+                    break
+            return {n: self._entries[n].ring.to_dict() for n in picked}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+# ------------------------------------------------------------ singleton
+
+_collector: Optional[SeriesCollector] = None
+_collector_lock = threading.Lock()
+
+
+def global_series() -> SeriesCollector:
+    global _collector
+    if _collector is None:
+        with _collector_lock:
+            if _collector is None:
+                _collector = SeriesCollector()
+    return _collector
+
+
+def declare_series_kind(name: str, kind: str) -> None:
+    global_series().declare_kind(name, kind)
+
+
+# tick serialization gate: collect+store must not interleave between
+# two tickers (the background sampler vs a smoke's manual wall_t
+# drive) — an out-of-order store would hit the delta clamp and
+# re-baseline DOWNWARD, over-counting the next interval. Non-blocking:
+# the loser skips its stamp (the winner's pass covers the interval —
+# sums stay an exact partition either way). acquire/release, not
+# `with`: nothing may nest inside, it is a mutual-exclusion gate.
+_tick_serial = threading.Lock()
+
+
+def series_sample_tick(wall_t: Optional[int] = None) -> None:
+    """The per-second stamp, called by the global sampler's tick
+    (bvar/window.py) — and by tests driving time by hand (wall_t pins
+    the bucket stamp; buckets are wall-epoch so shard merges align).
+    Never raises: the sampler thread must not die for a ring."""
+    if not series_enabled():
+        return
+    if not _tick_serial.acquire(blocking=False):
+        return
+    try:
+        col = global_series()
+        t = int(time.time()) if wall_t is None else int(wall_t)
+        points = col.store_readings(col.collect_readings(), t)
+        watchdog_sample_pass(points, t)
+    except Exception:
+        pass
+    finally:
+        _tick_serial.release()
+
+
+def ensure_series() -> None:
+    """Server.start's hook (caller thread, NOT the sampler thread):
+    bind the watchdog's annotation imports before the sampler can need
+    them (the PR 8 rule), and make sure the global sampler's tick
+    thread is running even in a process with no windowed reducers."""
+    bind_watchdog_imports()
+    if not series_enabled():
+        return
+    from brpc_tpu.bvar import window as _window
+    _window.global_sampler._ensure_thread()
+
+
+# --------------------------------------------------------------- merges
+
+def merge_timeline_states(states: List[Tuple[Optional[int], dict]],
+                          names: Optional[List[str]] = None,
+                          prefix: str = "") -> dict:
+    """Supervisor-side merge of per-shard /timeline payloads (each a
+    (shard_index, timeline_page_payload dict) pair from the dumps):
+    per-bucket counters SUM, maxima MAX, quantile series pool their
+    per-field worst case with counts summed — never averaged — and
+    gauges apply the same name-aware scalar rules merged /vars uses
+    (shard_group.merge_var_values), so the two merged views agree on
+    every gauge by construction. Incidents concatenate, tagged with
+    their shard."""
+    from brpc_tpu.rpc.shard_group import merge_var_values
+    out: dict = {"mode": "shard_group", "shards_reporting": len(states),
+                 "enabled": any(s.get("enabled") for _, s in states),
+                 "resolution": {"sec": SEC_BUCKETS, "min": MIN_BUCKETS,
+                                "hr": HOUR_BUCKETS}}
+    merged: Dict[str, dict] = {}
+    # shards roll their minute/hour buckets at their OWN 60th push
+    # (ring-relative, and sampler periods drift past 1s), so coarse
+    # buckets align on the epoch grid here — without this, two shards'
+    # minutes almost never share a t key and "counters sum" would be
+    # an interleave, not a sum
+    grid = {"sec": 1, "min": 60, "hr": 3600}
+    for _, st in states:
+        for name, ser in (st.get("series") or {}).items():
+            if names is not None and name not in names:
+                continue
+            if prefix and not name.startswith(prefix):
+                continue
+            m = merged.setdefault(name, {"kind": ser.get("kind"),
+                                         "sec": {}, "min": {}, "hr": {}})
+            kind = m["kind"]
+            for level in ("sec", "min", "hr"):
+                buckets = m[level]
+                step = grid[level]
+                for t, v in ser.get(level) or ():
+                    t -= t % step
+                    if kind == KIND_LAST:
+                        buckets.setdefault(t, []).append(v)
+                    else:
+                        buckets[t] = _combine(kind, buckets.get(t), v)
+    series: Dict[str, dict] = {}
+    for name, m in merged.items():
+        d = {"kind": m["kind"]}
+        for level in ("sec", "min", "hr"):
+            if m["kind"] == KIND_LAST:
+                d[level] = [[t, merge_var_values(vals, name=name)]
+                            for t, vals in sorted(m[level].items())]
+            else:
+                d[level] = [[t, v] for t, v in sorted(m[level].items())]
+        series[name] = d
+    out["series"] = series
+    incidents = []
+    for shard, st in states:
+        for inc in st.get("incidents") or ():
+            row = dict(inc)
+            row["shard"] = shard
+            incidents.append(row)
+    incidents.sort(key=lambda r: (r.get("opened_t") or 0,
+                                  r.get("shard") or 0))
+    out["incidents"] = incidents
+    keys = set()
+    for _, st in states:
+        keys.update(st.get("watch_keys") or ())
+    out["watch_keys"] = sorted(keys)
+    return out
+
+
+# ------------------------------------------------------------- postfork
+
+def _postfork_reset() -> None:
+    """Fork hygiene: the rings describe the PARENT's counters (a
+    shard's private bvar store diverges from the first request on) and
+    the leaf lock — or the tick gate — may be mid-hold at fork time.
+    The child starts with an empty registry; the parent's rings are
+    untouched."""
+    global _collector, _collector_lock, _tick_serial
+    _collector = None
+    _collector_lock = threading.Lock()
+    _tick_serial = threading.Lock()
+
+
+from brpc_tpu.butil import postfork as _postfork  # noqa: E402
+#   (registration ships with the registry it resets)
+
+_postfork.register("bvar.series", _postfork_reset)

@@ -24,6 +24,11 @@ from collections import deque
 from typing import Deque, Optional, Tuple
 
 from brpc_tpu.bvar.variable import Variable
+# the trend-ring engine rides THIS module's tick thread: bound at
+# module load, never inside take_sample (sampler-thread code must not
+# lazily import — the PR 8 fd-hazard rule). No cycle: series imports
+# only variable/flags/anomaly.
+from brpc_tpu.bvar.series import series_sample_tick
 
 _MAX_WINDOW = 120
 
@@ -64,6 +69,13 @@ class Sampler:
             series = list(self._series)
         for s in series:
             s.take_sample(now)
+        if self is global_sampler:
+            # multi-resolution trend rings + the anomaly watchdog ride
+            # the same 1/s stamp (bvar/series.py; buckets stamp on the
+            # wall clock, not this monotonic now) — only the GLOBAL
+            # sampler: private test samplers drive synthetic clocks
+            # that must not pollute the process rings
+            series_sample_tick()
 
     def _ensure_thread(self):
         if self._thread is None or not self._thread.is_alive():

@@ -320,6 +320,23 @@ class HttpProtocol(Protocol):
         if path == "/vars" or path.startswith("/vars/"):
             from brpc_tpu.bvar.variable import dump_exposed
             prefix = req.query.get("prefix", path[6:] if len(path) > 6 else "")
+            sname = req.query.get("series")
+            if sname is not None:
+                # ?series=<name>: that one variable's trend rings as
+                # JSON (the /timeline data, scoped to one var — what
+                # the inline sparkline links to). Unknown name = 400.
+                if agg is not None:
+                    merged = agg.merged_timeline(names=[sname])
+                    ser = merged.get("series", {}).get(sname)
+                else:
+                    from brpc_tpu.bvar.series import global_series
+                    ser = global_series().dump_series(
+                        names=[sname]).get(sname)
+                if ser is None:
+                    return (400, "text/plain",
+                            f"no series for {sname!r}".encode())
+                return 200, "application/json", json.dumps(
+                    {sname: ser}, default=str).encode()
             if agg is not None:
                 shard, err = _shard_param(agg, req)
                 if err is not None:
@@ -334,10 +351,58 @@ class HttpProtocol(Protocol):
                                    if n.startswith(prefix))
                 else:
                     items = sorted(agg.merged_vars(prefix).items())
+                lines = [f"{n} : {v}" for n, v in items]
             else:
                 items = dump_exposed(prefix)
-            lines = [f"{n} : {v}" for n, v in items]
+                # inline sparklines: the last minute's trend next to
+                # each instant value (only names with a warm ring —
+                # merged/shard views stay sparkline-free, their values
+                # come from dumps, not the local rings)
+                from brpc_tpu.bvar.series import (global_series,
+                                                  series_enabled)
+                col = global_series() if series_enabled() else None
+                lines = []
+                for n, v in items:
+                    spark = col.spark(n) if col is not None else ""
+                    lines.append(f"{n} : {v}  {spark}" if spark
+                                 else f"{n} : {v}")
             return 200, "text/plain", ("\n".join(lines) + "\n").encode()
+        if path == "/timeline":
+            from brpc_tpu.builtin.services import timeline_page_payload
+            names = req.query.get("name") or req.query.get("names")
+            names = [n for n in names.split(",") if n] if names else None
+            tprefix = req.query.get("prefix", "")
+            if agg is not None:
+                shard, err = _shard_param(agg, req)
+                if err is not None:
+                    return err
+                if shard is not None:
+                    dump = agg.shard_dump(shard)
+                    if dump is None or not dump.get("timeline"):
+                        return (404, "text/plain",
+                                f"no timeline for shard {shard}"
+                                .encode())
+                    payload = dict(dump["timeline"])
+                    if names or tprefix:
+                        payload["series"] = {
+                            k: v for k, v in
+                            (payload.get("series") or {}).items()
+                            if (names is None or k in names)
+                            and k.startswith(tprefix)}
+                else:
+                    payload = agg.merged_timeline(names=names,
+                                                  prefix=tprefix)
+            else:
+                payload = timeline_page_payload(server, names=names,
+                                                prefix=tprefix)
+            if names:
+                missing = [n for n in names
+                           if n not in payload.get("series", {})]
+                if missing:
+                    return (400, "text/plain",
+                            f"no series for {missing[0]!r}".encode())
+            return 200, "application/json", json.dumps(
+                payload, default=str).encode()
         if path == "/brpc_metrics" or path == "/metrics":
             from brpc_tpu.bvar.prometheus import dump_prometheus
             if agg is not None:

@@ -288,6 +288,7 @@ class Server:
             # by an unexpose_all() (test fixtures) — re-register here
             # like the process_* vars, so /vars keeps them for any
             # server started afterward in the process
+            from brpc_tpu.rpc.server_dispatch import nlimit_shed, nshed
             from brpc_tpu.transport.socket import (_wqueue_peak_window,
                                                    npluck_defer,
                                                    npluck_fast, nreads,
@@ -296,7 +297,13 @@ class Server:
                               (nreads, "socket_read_bytes"),
                               (npluck_fast, "pluck_fast_responses"),
                               (npluck_defer, "pluck_defers"),
-                              (nwqueue_bytes, "socket_wqueue_bytes")):
+                              (nwqueue_bytes, "socket_wqueue_bytes"),
+                              # the shed counters are anomaly-watchdog
+                              # keys: their trend rings (and the
+                              # /status saturation links) must survive
+                              # an unexpose_all like every counter here
+                              (nshed, "server_deadline_shed"),
+                              (nlimit_shed, "server_limit_shed")):
                 var.expose(name)
             from brpc_tpu.bvar.reducer import PassiveStatus
             wq_peak = _wqueue_peak_window()
@@ -325,6 +332,39 @@ class Server:
             # overload-control gauges (limiter limit + inflight) for
             # prometheus and the merged shard views
             _expose_limiter_vars(self)
+            # server-wide trend triple for /timeline + cluster_top's
+            # spark columns: processed/errors as DECLARED delta series
+            # (a monotone passive graphs as qps only when its ring
+            # knows it is a counter), worst instant method p99 as a
+            # max series — all following the unexpose_all re-expose
+            # lifecycle like every counter above. Weakly bound like
+            # _expose_limiter_vars: the registry outlives any one
+            # Server, and a strong closure would pin a stopped server
+            # (and its reservoirs) for the process lifetime
+            from brpc_tpu.bvar.series import declare_series_kind
+            wref = weakref.ref(self)
+
+            def _trend(attr_fn, default=0):
+                s = wref()
+                return attr_fn(s) if s is not None else default
+
+            def _worst_p99(srv):
+                best = 0.0
+                for lr in list(srv.method_status.values()):
+                    try:
+                        best = max(best, lr.latency_percentile(0.99))
+                    except Exception:
+                        pass
+                return round(best, 1)
+            PassiveStatus(lambda: _trend(lambda s: s.nprocessed)).expose(
+                "server_processed")
+            PassiveStatus(lambda: _trend(lambda s: s.nerror)).expose(
+                "server_errors")
+            PassiveStatus(lambda: _trend(_worst_p99, 0.0)).expose(
+                "server_latency_p99_us")
+            declare_series_kind("server_processed", "delta")
+            declare_series_kind("server_errors", "delta")
+            declare_series_kind("server_latency_p99_us", "max")
             # scheduler saturation trio (runqueue depth/peak, worker
             # busy fraction) + fiber counters: /vars + prometheus
             self._control.expose_vars()
@@ -352,6 +392,12 @@ class Server:
         # registry dropped the parent's recorder)
         from brpc_tpu.builtin.flight_recorder import global_recorder
         global_recorder().ensure_running()
+        # trend rings + anomaly watchdog: make sure the bvar sampler's
+        # tick thread runs even with no windowed reducers yet, and bind
+        # the watchdog's annotation imports on THIS thread before the
+        # sampler can need them (the PR 8 sampler-import rule)
+        from brpc_tpu.bvar.series import ensure_series
+        ensure_series()
         return self._endpoint
 
     def _maybe_install_sigterm(self) -> None:

@@ -124,6 +124,12 @@ def write_shard_dump(dirpath: str, index: int, server, seq: int) -> None:
         # a device batch (the common host-only shard pays nothing);
         # the supervisor's /device merges these
         doc["device"] = device_page_payload(server)
+    from brpc_tpu.bvar.series import series_enabled
+    if series_enabled():
+        # trend rings + incident ring ride the dump (bounded var
+        # count: the supervisor's /timeline merges these per bucket)
+        from brpc_tpu.builtin.services import timeline_page_payload
+        doc["timeline"] = timeline_page_payload(server, max_vars=64)
     from brpc_tpu.traffic.capture import \
         global_recorder as traffic_recorder
     rec = traffic_recorder()
@@ -186,8 +192,12 @@ def merge_var_values(values: list, name: str = ""):
     (counters), dicts merge stat-wise, anything else keeps the first
     shard's reading (strings, None). ``name`` applies the scalar-gauge
     rules the saturation pane's dict merge uses — capacity limits take
-    the max, retry-token gauges the min — so merged /vars agrees with
-    merged /status on the overload-control gauges."""
+    the max, retry-token gauges the min, fractions/ratios/usages the
+    mean (summing two shards' 0.9 hit ratios to 1.8 is nonsense) — so
+    merged /vars agrees with merged /status on the overload-control
+    gauges AND with merged_timeline on every gauge series (the
+    timeline's last-kind per-bucket merge calls THIS function with the
+    same name, bvar/series.merge_timeline_states)."""
     nums = [v for v in values
             if isinstance(v, (int, float)) and not isinstance(v, bool)]
     if nums and len(nums) == len(values):
@@ -199,6 +209,12 @@ def merge_var_values(values: list, name: str = ""):
             # drag the group's most-drained reading to -1
             real = [v for v in nums if v >= 0]
             return min(real) if real else -1
+        if ("ratio" in name or "usage" in name or "fraction" in name
+                or name.endswith("_pct")):
+            return round(sum(nums) / len(nums), 4)
+        if "peak" in name or name.endswith("_max") or "_max_" in name:
+            # windowed peaks are maxima, not additive flow
+            return max(nums)
         s = sum(nums)
         return round(s, 3) if isinstance(s, float) else s
     dicts = [v for v in values if isinstance(v, dict)]
@@ -372,6 +388,19 @@ class ShardAggregator:
         from brpc_tpu.transport.device_stats import merge_device_payloads
         return merge_device_payloads(
             [d["device"] for d in self.read_dumps() if d.get("device")])
+
+    def merged_timeline(self, names=None, prefix: str = "") -> dict:
+        """The group-wide /timeline: per-shard trend-ring dumps merged
+        per epoch-second bucket — counters sum, maxima max, quantile
+        series pool their per-field worst case (never averaged),
+        gauges through merge_var_values — plus every shard's incidents
+        tagged with their shard index
+        (bvar/series.merge_timeline_states)."""
+        from brpc_tpu.bvar.series import merge_timeline_states
+        return merge_timeline_states(
+            [(d.get("shard"), d["timeline"]) for d in self.read_dumps()
+             if d.get("timeline")],
+            names=names, prefix=prefix)
 
     def merged_capture(self) -> dict:
         """The group-wide /capture view: per-shard recorder snapshots

@@ -1054,7 +1054,13 @@ class TestTrafficCaptureLint:
         from brpc_tpu.analysis.racelane import LOCK_ORDER
         names = [n for n, _ in LOCK_ORDER]
         assert "Recorder._lock" in names
-        assert names.index("Recorder._lock") == len(names) - 1
+        # trailing leaf block: nothing this codebase ranks may nest
+        # inside the recorder lock — only the ISSUE-13 sampler-tick
+        # leaves (series rings, anomaly watchdog) rank below it, and
+        # those are leaves themselves
+        below = names[names.index("Recorder._lock") + 1:]
+        assert below == ["SeriesCollector._lock",
+                         "AnomalyWatchdog._lock"], below
 
 
 class TestDeviceObsLint:
@@ -1141,3 +1147,140 @@ class TestMemoryviewRelease:
             [f.format() for f in found]
         sf_ok, ctx_ok = _ctx_for(path, "brpc_tpu/transport/ici.py", src)
         assert list(MemoryviewReleaseRule().check(sf_ok, ctx_ok)) == []
+
+
+class TestTimelineLint:
+    """ISSUE 13 pins on the telemetry time machine: the series
+    registry's fork hygiene, the anomaly watchdog's sampler-thread
+    import discipline (it runs on the bvar sampler tick — the PR 8
+    fd-hazard rule reaches it through the marker-named cross-module
+    recursion), the uniqueness of the watchdog verbs, and the new
+    leaf rows in the runtime lock order."""
+
+    SERIES = os.path.join(REPO_ROOT, "brpc_tpu", "bvar", "series.py")
+    ANOMALY = os.path.join(REPO_ROOT, "brpc_tpu", "bvar", "anomaly.py")
+
+    def _files_with(self, relpath, content):
+        from brpc_tpu.analysis.core import SourceFile, iter_source_files
+        out = []
+        for f in iter_source_files([os.path.join(REPO_ROOT, "brpc_tpu")]):
+            if f.relpath == relpath:
+                out.append(SourceFile(f.path, relpath, content))
+            else:
+                out.append(f)
+        return out
+
+    def test_mutation_dropping_series_postfork_registration_fires(self):
+        """Strip the postfork.register line from the REAL series
+        module: a forked shard inheriting the parent's rings would
+        serve the PARENT's history as its own /timeline (and the leaf
+        lock may be mid-hold at fork) — the postfork-reset rule must
+        keep that registration unloseable."""
+        from brpc_tpu.analysis.core import Context, SourceFile
+        from brpc_tpu.analysis.rules.postfork_reset import PostforkResetRule
+        src = open(self.SERIES).read()
+        target = [ln for ln in src.splitlines()
+                  if "postfork.register(" in ln]
+        assert len(target) == 1, target
+        mutated = src.replace(target[0] + "\n", "")
+        sf = SourceFile(self.SERIES, "brpc_tpu/bvar/series.py", mutated)
+        found = list(PostforkResetRule().check(sf, Context([sf])))
+        assert any(f.rule == "postfork-reset"
+                   and "global_series" in f.message for f in found), \
+            [f.format() for f in found]
+        sf_ok = SourceFile(self.SERIES, "brpc_tpu/bvar/series.py", src)
+        assert list(PostforkResetRule().check(sf_ok,
+                                              Context([sf_ok]))) == []
+
+    def test_mutation_lazy_import_in_watchdog_pass_fires(self):
+        """Introduce a lazy import inside AnomalyWatchdog.watchdog_pass:
+        the watchdog runs on the bvar sampler's tick thread (window
+        Sampler._run -> series_sample_tick -> watchdog_sample_pass,
+        each hop marker-named), and a lazy import there opens module
+        files on that thread at sample time — the PR 8 fd-churn flake's
+        shape. The cross-module recursion must root the rule into
+        anomaly.py; the shipped module binds at module load and stays
+        clean."""
+        from brpc_tpu.analysis.core import Context
+        from brpc_tpu.analysis.rules.sampler_import import (
+            SamplerNoLazyImportRule,
+        )
+        src = open(self.ANOMALY).read()
+        needle = "        opened: Optional[Incident] = None\n"
+        assert needle in src
+        mutated = src.replace(
+            needle, needle + "        from brpc_tpu.butil import "
+                             "timekeeping as _tk\n", 1)
+        found = list(SamplerNoLazyImportRule().finalize(Context(
+            self._files_with("brpc_tpu/bvar/anomaly.py", mutated))))
+        assert any(f.rule == "sampler-no-lazy-import"
+                   and "watchdog_pass" in f.message
+                   and f.path == "brpc_tpu/bvar/anomaly.py"
+                   for f in found), [f.format() for f in found]
+        clean = list(SamplerNoLazyImportRule().finalize(Context(
+            self._files_with("brpc_tpu/bvar/anomaly.py", src))))
+        assert [f for f in clean
+                if f.path.startswith("brpc_tpu/bvar/")] == [], \
+            [f.format() for f in clean]
+
+    def test_mutation_lazy_import_in_series_store_fires(self):
+        """Same pin one hop earlier: a lazy import inside the series
+        engine's store path (reached from the tick) must fire."""
+        from brpc_tpu.analysis.core import Context
+        from brpc_tpu.analysis.rules.sampler_import import (
+            SamplerNoLazyImportRule,
+        )
+        src = open(self.SERIES).read()
+        needle = "        points: Dict[str, float] = {}\n"
+        assert needle in src
+        mutated = src.replace(
+            needle, needle + "        import json as _json\n", 1)
+        found = list(SamplerNoLazyImportRule().finalize(Context(
+            self._files_with("brpc_tpu/bvar/series.py", mutated))))
+        assert any(f.rule == "sampler-no-lazy-import"
+                   and f.path == "brpc_tpu/bvar/series.py"
+                   for f in found), [f.format() for f in found]
+
+    def test_watchdog_verbs_are_unique(self):
+        """Every watchdog/series hook verb is defined exactly once
+        across the package — a second definer would re-open the
+        unique-method-fallback false-edge hazard (the PR 11 lesson;
+        never on_*/enabled names on sampler-reachable objects)."""
+        import re
+        verbs = ("watchdog_pass", "watchdog_sample_pass",
+                 "series_sample_tick", "incident_snapshot",
+                 "note_incident", "store_readings", "collect_readings",
+                 "declare_series_kind", "bind_watchdog_imports",
+                 "merge_timeline_states")
+        counts = {v: 0 for v in verbs}
+        pkg = os.path.join(REPO_ROOT, "brpc_tpu")
+        for dirpath, _dirs, files in os.walk(pkg):
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                src = open(os.path.join(dirpath, fn)).read()
+                for v in verbs:
+                    counts[v] += len(re.findall(
+                        rf"def {v}\(", src))
+        assert all(c == 1 for c in counts.values()), counts
+
+    def test_series_locks_ranked_as_trailing_leaves(self):
+        """SeriesCollector._lock and AnomalyWatchdog._lock are the
+        declared trailing leaves of LOCK_ORDER (docs table rows
+        36-37): settled on the sampler tick thread, never wrapping
+        another acquisition — and the lock model must DISCOVER both
+        (a silent rename would un-rank them without failing)."""
+        from brpc_tpu.analysis.core import Context, iter_source_files
+        from brpc_tpu.analysis.lockmodel import get_lock_model
+        from brpc_tpu.analysis.racelane import LOCK_ORDER
+        names = [n for n, _ in LOCK_ORDER]
+        assert names[-2:] == ["SeriesCollector._lock",
+                              "AnomalyWatchdog._lock"]
+        m = get_lock_model(Context(iter_source_files(
+            [os.path.join(REPO_ROOT, "brpc_tpu")])))
+        assert "SeriesCollector._lock" in m.locks
+        assert "AnomalyWatchdog._lock" in m.locks
+        # leaves: neither may be the HELD side of any lock-graph edge
+        for a, _b in m.edges:
+            assert a not in ("SeriesCollector._lock",
+                             "AnomalyWatchdog._lock"), m.edges

@@ -127,10 +127,36 @@ def _device_summary(page: Optional[dict]) -> Optional[dict]:
     }
 
 
+def _timeline_trends(page: Optional[dict]) -> Optional[dict]:
+    """One node's /timeline collapsed to the three trend tracks the
+    top renders: qps (per-second processed deltas), p99 and errors —
+    the last minute's seconds-level buckets, numbers only."""
+    if not page or not page.get("series"):
+        return None
+    out = {}
+    ser = page["series"]
+    for var, track in (("server_processed", "qps"),
+                       ("server_errors", "errors"),
+                       ("server_latency_p99_us", "p99_us")):
+        buckets = (ser.get(var) or {}).get("sec") or []
+        vals = [v for _, v in buckets
+                if isinstance(v, (int, float))]
+        if vals:
+            out[track] = vals
+    if not out:
+        return None
+    incidents = [i for i in (page.get("incidents") or ())
+                 if i.get("state") == "open"]
+    if incidents:
+        out["open_incidents"] = len(incidents)
+    return out
+
+
 def scrape(nodes: List[str]) -> dict:
     pages = []
     statuses = {}
     devices = {}
+    timelines = {}
     down = []
     for node in nodes:
         page = fetch_json(node, "/backends")
@@ -149,8 +175,17 @@ def scrape(nodes: List[str]) -> dict:
         if dev is not None and (dev["transfers"] or
                                 dev["recv_transfers"]):
             devices[node] = dev
+        # trend columns: the node's own qps/p99/errors rings (absent
+        # when the node predates the series engine or runs it off).
+        # Prefix filter, not ?names=: a node missing one var answers
+        # the prefix query with what it has instead of a 400.
+        tl = _timeline_trends(fetch_json(node,
+                                         "/timeline?prefix=server_"))
+        if tl is not None:
+            timelines[node] = tl
     return {"backends": merge_backends(pages), "nodes": statuses,
-            "device": devices, "nodes_down": down, "nodes_up": len(pages)}
+            "device": devices, "timeline": timelines,
+            "nodes_down": down, "nodes_up": len(pages)}
 
 
 def render(view: dict) -> str:
@@ -174,11 +209,24 @@ def render(view: dict) -> str:
             for r in rows]
     srv = view.get("nodes", {})
     dev = view.get("device", {})
+    trends = view.get("timeline", {})
     out.append("")
     for node, st in sorted(srv.items()):
         line = (f"node {node}: processed={st.get('processed')} "
                 f"errors={st.get('errors')} "
                 f"concurrency={st.get('concurrency')}")
+        tl = trends.get(node)
+        if tl is not None:
+            # the time axis: last-minute qps/p99/error sparklines from
+            # the node's /timeline rings, open incidents flagged
+            from brpc_tpu.bvar.series import sparkline
+            for track, tag in (("qps", "qps"), ("p99_us", "p99"),
+                               ("errors", "err")):
+                vals = tl.get(track)
+                if vals:
+                    line += f"  {tag} {sparkline(vals, 20)}"
+            if tl.get("open_incidents"):
+                line += f"  INCIDENTS={tl['open_incidents']}"
         d = dev.get(node)
         if d is not None:
             # the device column: per-node lane state + decayed GB/s

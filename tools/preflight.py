@@ -629,6 +629,41 @@ def gate_traffic_smoke() -> dict:
     return out
 
 
+def gate_timeline_smoke() -> dict:
+    """Telemetry-time-machine smoke (tools/timeline_smoke.py, ~3s
+    plus overhead windows): a paced burst's 1s series buckets must
+    equal the counter deltas EXACTLY, an injected fault must open
+    exactly one incident that names the implicated vars and annotates
+    an in-window rpcz span, HTTP /timeline must equal the builtin twin
+    structurally, the supervisor merge must reproduce the per-bucket
+    shard-dump sum (p99 per-bucket MAX, never the average), and the
+    series engine must cost <= 5% on order-balanced pair-median
+    windows (the PR 12 estimator; BRPC_TPU_PERF_SMOKE=0 skips just
+    that criterion). A subprocess so a wedged burst cannot hang the
+    gate; BRPC_TPU_TIMELINE_SMOKE=0 skips the lane."""
+    if os.environ.get("BRPC_TPU_TIMELINE_SMOKE", "1") == "0":
+        return {"ok": True, "skipped": "BRPC_TPU_TIMELINE_SMOKE=0"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "timeline_smoke.py")],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    out: dict = {"ok": proc.returncode == 0}
+    try:
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+        for k in ("bucket_exact", "incidents_opened", "incident_ok",
+                  "twin_parity", "merged_ok", "series_overhead_pct",
+                  "elapsed_s"):
+            if k in report:
+                out[k] = report[k]
+        if proc.returncode != 0:
+            out["invariant"] = report.get("invariant",
+                                          report.get("error"))
+    except (ValueError, IndexError):
+        out["ok"] = False
+        out["error"] = (proc.stdout + proc.stderr)[-500:]
+    return out
+
+
 def gate_perf_smoke() -> dict:
     """Fast hot-path perf gate: raw-socket-normalized small-RPC and
     1MB-echo ratios must stay within 30% of the BENCH_r05-era floors.
@@ -701,6 +736,7 @@ def run_gate() -> int:
                      ("fabric_smoke", gate_fabric_smoke),
                      ("traffic_smoke", gate_traffic_smoke),
                      ("device_obs", gate_device_obs),
+                     ("timeline_smoke", gate_timeline_smoke),
                      ("perf_smoke", gate_perf_smoke)):
         try:
             report[name] = fn()

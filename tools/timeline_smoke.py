@@ -1,0 +1,275 @@
+"""Telemetry-time-machine smoke: the gate behind /timeline
+(gate_timeline_smoke in tools/preflight.py --gate, ~3s budget).
+
+Five invariants, one JSON line:
+
+  1. EXACT BUCKET MATH — a paced loopback burst's 1s-resolution series
+     buckets for ``server_processed`` sum to the counter's delta
+     EXACTLY (snapshot-delta bucketing partitions the counter growth
+     whatever the tick phase);
+  2. DETERMINISTIC INCIDENT — an injected fault burst (a method that
+     fails every call) must open EXACTLY ONE incident that names the
+     implicated var (``server_errors``) and annotates at least one
+     in-window rpcz span (the watch filter is pinned to the fault key
+     so a noisy sandbox's p99 jitter cannot race the assertion);
+  3. TWIN PARITY — HTTP /timeline and the builtin-RPC ``timeline``
+     method return the same structure (same top-level keys, same
+     series names) from the ONE shared builder;
+  4. MERGED == SUM — ShardAggregator.merged_timeline over two shard
+     dumps carrying bounded series reproduces the per-bucket sum for
+     counters and the per-bucket max (never the average) for p99;
+  5. OVERHEAD <= 5% — series-on vs BRPC_TPU_BVAR_SERIES=0, two echo
+     SERVER processes alive at once (the engine costs on the server's
+     sampler tick), pipelined multi-process client windows in
+     order-balanced (on,off)/(off,on) pairs, median over per-pair
+     overheads (the PR 12 estimator). BRPC_TPU_PERF_SMOKE=0 skips this
+     criterion only; BRPC_TPU_TIMELINE_SMOKE=0 skips the lane.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+BASE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, BASE)
+sys.path.insert(0, os.path.join(BASE, "tools"))
+
+OVERHEAD_PCT_MAX = 5.0
+
+
+def _tick(n: int = 1, wall_t=None):
+    from brpc_tpu.bvar.series import series_sample_tick
+    for i in range(n):
+        series_sample_tick(wall_t=None if wall_t is None else wall_t + i)
+
+
+def run_checks(out: dict) -> None:
+    from spawn_util import http_get_local
+
+    from brpc_tpu.butil.flags import set_flag
+    from brpc_tpu.bvar.anomaly import global_watchdog
+    from brpc_tpu.bvar.series import global_series
+    from brpc_tpu.rpc import (Channel, ChannelOptions, Server,
+                              ServerOptions, Service)
+    from brpc_tpu.rpc import errno_codes as berr
+    from brpc_tpu.rpc.span import global_collector
+
+    set_flag("rpcz_enabled", "true")
+    # determinism: only the fault key feeds the watchdog — sandbox p99
+    # jitter must not open a second incident under the exactly-one
+    # assertion
+    set_flag("anomaly_watch_filter", "server_errors")
+    set_flag("anomaly_warmup_ticks", "3")
+    set_flag("anomaly_close_ticks", "3")
+    global_watchdog().reset()
+
+    server = Server(ServerOptions(enable_builtin_services=True))
+    svc = Service("Smoke")
+
+    @svc.method()
+    def PyEcho(cntl, request):
+        return bytes(request)
+
+    @svc.method()
+    def Boom(cntl, request):
+        cntl.set_failed(berr.EINTERNAL, "injected fault")
+        return b""
+
+    server.add_service(svc)
+    ep = server.start("tcp://127.0.0.1:0")
+    ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                 ChannelOptions(timeout_ms=4000))
+    try:
+        # ---- 1. exact bucket math under a paced burst
+        assert not ch.call_sync("Smoke", "PyEcho", b"w").failed()
+        _tick(4)                       # settle: baseline + warmup
+        col = global_series()
+        ser0 = col.dump_series(names=["server_processed"])
+        sum0 = sum(v for _, v in ser0["server_processed"]["sec"])
+        c0 = server.nprocessed
+        calls = 0
+        for burst in (7, 19, 3, 31):
+            for _ in range(burst):
+                if not ch.call_sync("Smoke", "PyEcho", b"x").failed():
+                    calls += 1
+            _tick()
+        _tick()                        # flush the last partial bucket
+        c1 = server.nprocessed
+        ser1 = col.dump_series(names=["server_processed"])
+        sum1 = sum(v for _, v in ser1["server_processed"]["sec"])
+        out["burst_calls"] = calls
+        out["bucket_sum_delta"] = sum1 - sum0
+        out["counter_delta"] = c1 - c0
+        out["bucket_exact"] = (sum1 - sum0) == (c1 - c0) and calls > 0
+        # the background 1/s sampler may interleave ticks freely: the
+        # partition property makes the equality EXACT regardless
+
+        # ---- 2. one deterministic incident, span-annotated
+        before = len(global_watchdog().incident_snapshot())
+        for _ in range(25):
+            ch.call_sync("Smoke", "Boom", b"f")
+        _tick()                        # the error spike's bucket
+        incidents = global_watchdog().incident_snapshot()[before:]
+        out["incidents_opened"] = len(incidents)
+        inc = incidents[0] if incidents else {}
+        out["incident_keys"] = inc.get("keys")
+        out["incident_spans_annotated"] = inc.get("spans_annotated")
+        annotated = any(
+            any("incident #" in a for _, a in s.annotations)
+            for s in global_collector.recent(64))
+        out["incident_ok"] = (
+            len(incidents) == 1
+            and "server_errors" in (inc.get("keys") or ())
+            and (inc.get("spans_annotated") or 0) >= 1 and annotated)
+
+        # ---- 3. HTTP page == builtin twin structure
+        st, body = http_get_local(ep.port, "/timeline")
+        http_page = json.loads(body)
+        r = ch.call_sync("builtin", "timeline", b"")
+        twin = json.loads(r.response_payload.to_bytes())
+        out["twin_parity"] = bool(
+            st == 200 and not r.failed()
+            and set(http_page) == set(twin)
+            and set(http_page["series"]) == set(twin["series"]))
+        st, body = http_get_local(ep.port, "/timeline?name=nope")
+        out["bad_name_400"] = st == 400
+    finally:
+        try:
+            ch.close()
+        except Exception:
+            pass
+        try:
+            server.stop()
+            server.join(2)
+        except Exception:
+            pass
+        set_flag("anomaly_watch_filter", "")
+
+    # ---- 4. supervisor merged series == sum of shard dumps
+    import tempfile
+
+    from brpc_tpu.rpc.shard_group import ShardAggregator
+    tmp = tempfile.mkdtemp(prefix="brpc-tpu-tl-smoke-")
+    shard_series = [
+        {"server_processed": {"kind": "delta",
+                              "sec": [[100, 5], [101, 7]],
+                              "min": [], "hr": []},
+         "server_latency_p99_us": {"kind": "max",
+                                   "sec": [[100, 900.0], [101, 120.0]],
+                                   "min": [], "hr": []}},
+        {"server_processed": {"kind": "delta",
+                              "sec": [[100, 11], [102, 2]],
+                              "min": [], "hr": []},
+         "server_latency_p99_us": {"kind": "max",
+                                   "sec": [[100, 150.0], [101, 130.0]],
+                                   "min": [], "hr": []}},
+    ]
+    for i, ser in enumerate(shard_series):
+        with open(os.path.join(tmp, f"shard-{i}.json"), "w") as f:
+            json.dump({"shard": i, "pid": 1000 + i, "seq": 1,
+                       "time": time.time(), "vars": {}, "status": {},
+                       "latency_samples": {},
+                       "timeline": {"enabled": True, "series": ser,
+                                    "incidents": [], "watch_keys": []}},
+                      f)
+    merged = ShardAggregator(tmp, 2).merged_timeline()
+    mp = dict((t, v) for t, v in
+              merged["series"]["server_processed"]["sec"])
+    mq = dict((t, v) for t, v in
+              merged["series"]["server_latency_p99_us"]["sec"])
+    out["merged_ok"] = (
+        mp == {100: 16, 101: 7, 102: 2}           # per-bucket SUM
+        and mq == {100: 900.0, 101: 130.0}        # per-bucket MAX,
+        and merged["shards_reporting"] == 2)      # never the average
+
+    # ---- 5. overhead: series-on vs series-off servers, pair medians
+    skip_perf = os.environ.get("BRPC_TPU_PERF_SMOKE", "1") == "0"
+    if not skip_perf:
+        _overhead(out)
+    ok = bool(out.get("bucket_exact") and out.get("incident_ok")
+              and out.get("twin_parity") and out.get("bad_name_400")
+              and out.get("merged_ok")
+              and (skip_perf or out.get("series_overhead_pct", 100.0)
+                   <= OVERHEAD_PCT_MAX))
+    out["ok"] = ok
+    if not ok:
+        out["invariant"] = ("bucket/incident/twin/merged/overhead "
+                            "check failed")
+
+
+def _overhead(out: dict, window_s: float = 0.7) -> None:
+    """series-on vs series-off qps through TWO live echo servers (the
+    cost sits on the server's sampler tick, so the toggle must ride
+    the SERVER env) — order-balanced pairs, median per-pair overhead
+    (the PR 12 estimator), one cumulative retry round on a >5% read."""
+    from qps_client import drive_multiproc
+    from spawn_util import spawn_port_server
+
+    servers = []
+    try:
+        ports = {}
+        for tag, flagval in (("on", "1"), ("off", "0")):
+            env = dict(os.environ, BRPC_TPU_BVAR_SERIES=flagval,
+                       JAX_PLATFORMS="cpu")
+            proc, port = spawn_port_server(
+                [os.path.join(BASE, "tools", "bench_echo_server.py")],
+                wall_s=20.0, env=env)
+            if port is None:
+                out["overhead_error"] = f"{tag} server spawn failed"
+                return
+            servers.append(proc)
+            ports[tag] = port
+        nprocs = min(4, max(2, (os.cpu_count() or 2) // 4))
+
+        def window(tag: str) -> float:
+            return drive_multiproc(str(ports[tag]), nprocs=nprocs,
+                                   seconds=window_s, conns=2,
+                                   inflight=8, method="PyEcho")["qps"]
+
+        pair_pcts = []
+        rounds = [("on", "off"), ("off", "on")]
+        for attempt in range(2):
+            for order in rounds:
+                qps = {}
+                for tag in order:
+                    qps[tag] = window(tag)
+                if qps["off"] > 0:
+                    pair_pcts.append(
+                        max(0.0, (1.0 - qps["on"] / qps["off"]) * 100))
+            out["series_overhead_pct"] = round(
+                statistics.median(pair_pcts), 2) if pair_pcts else 100.0
+            out["overhead_pairs"] = [round(p, 2) for p in pair_pcts]
+            if out["series_overhead_pct"] <= OVERHEAD_PCT_MAX:
+                break
+            # one cumulative retry round: more pairs, fresh median
+            # (box drift vs real cost — a real regression fails both)
+    finally:
+        for p in servers:
+            try:
+                p.terminate()
+            except Exception:
+                pass
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.monotonic()
+    out: dict = {}
+    try:
+        run_checks(out)
+    except Exception as e:  # noqa: BLE001 - one JSON line either way
+        out["ok"] = False
+        out["error"] = f"{type(e).__name__}: {e}"[:300]
+    out["elapsed_s"] = round(time.monotonic() - t0, 2)
+    print(json.dumps(out))
+    sys.stdout.flush()
+    return 0 if out.get("ok") else 1
+
+
+if __name__ == "__main__":
+    rc = main()
+    os._exit(rc)   # skip runtime-thread teardown, like cluster_top.py
