@@ -1121,11 +1121,16 @@ def main() -> None:
                 result["partial"] = True
                 _progress({"progress": "error", "phase": "cluster",
                            "error": result["cluster"]["error"]})
-        # ---- fabric storm lane (ISSUE 10): the overload-control loop
-        # under fault. Seeded kill/stall/outage/recover storm over 3
-        # nodes behind budget-hedging ClusterChannels — headline keys
-        # fault_goodput_ratio (fault-window goodput vs fault-free) and
-        # fault_p99_ms ride next to cluster_qps. A subprocess so a
+        # ---- fabric storm lane (ISSUE 10 + 14): the overload-control
+        # loop under fault. Seeded kill/stall/outage/recover storm over
+        # 3 nodes behind budget-hedging ClusterChannels, with the
+        # corpus-fed PRESS tail driving >= 2x capacity so the DAGOR
+        # priority-admission loop engages — headline keys
+        # fault_goodput_ratio (fault-window goodput vs fault-free),
+        # fault_p99_ms, priority_goodput_hi_ratio (converged top-class
+        # goodput under press) and admission_overhead_pct (calm-path
+        # layer cost with no priorities configured, pair-median
+        # alternating windows, acceptance <= 5%). Subprocesses so a
         # wedged storm cannot take the bench down.
         if deadline.remaining() < 25.0:
             result["fabric"] = {"skipped": "wall budget"}
@@ -1136,7 +1141,7 @@ def main() -> None:
                 p = _sp.run(
                     [sys.executable,
                      os.path.join(base, "tools", "fabric_smoke.py"),
-                     "--bench"],
+                     "--bench", "--corpus", "auto"],
                     capture_output=True, text=True, timeout=180)
                 rep = json.loads(p.stdout.strip().splitlines()[-1])
                 lane = {"fault_goodput_ratio": rep.get(
@@ -1147,6 +1152,12 @@ def main() -> None:
                         "hedges_armed": rep.get("hedges_armed"),
                         "hedges_past_budget": rep.get(
                             "hedges_past_budget"),
+                        "priority_goodput_hi_ratio": rep.get(
+                            "priority_goodput_hi_ratio"),
+                        "press_client_shed_frac": rep.get(
+                            "press_client_shed_frac"),
+                        "press_priority_sheds": rep.get(
+                            "press_priority_sheds"),
                         "problems": rep.get("problems")}
                 result["fabric"] = lane
                 if rep.get("fault_goodput_ratio") is not None:
@@ -1154,6 +1165,9 @@ def main() -> None:
                         rep["fault_goodput_ratio"]
                 if rep.get("fault_p99_ms") is not None:
                     result["fault_p99_ms"] = rep["fault_p99_ms"]
+                if rep.get("priority_goodput_hi_ratio") is not None:
+                    result["priority_goodput_hi_ratio"] = \
+                        rep["priority_goodput_hi_ratio"]
                 _progress({"progress": "fabric_lane", **lane})
             except Exception as e:  # noqa: BLE001 - diagnostics only
                 result["fabric"] = {
@@ -1161,6 +1175,31 @@ def main() -> None:
                 result["partial"] = True
                 _progress({"progress": "error", "phase": "fabric",
                            "error": result["fabric"]["error"]})
+            # admission-layer calm-path cost (prices what every PR 10
+            # server pays for the ISSUE 14 layer it isn't using)
+            if deadline.remaining() >= 20.0:
+                try:
+                    p = _sp.run(
+                        [sys.executable,
+                         os.path.join(base, "tools",
+                                      "fabric_smoke.py"), "--overhead"],
+                        capture_output=True, text=True, timeout=180)
+                    rep = json.loads(p.stdout.strip().splitlines()[-1])
+                    if rep.get("admission_overhead_pct") is not None:
+                        result["admission_overhead_pct"] = \
+                            rep["admission_overhead_pct"]
+                        result["fabric"]["admission_overhead_pct"] = \
+                            rep["admission_overhead_pct"]
+                    _progress({"progress": "fabric_admission_overhead",
+                               "admission_overhead_pct":
+                               result.get("admission_overhead_pct")})
+                except Exception as e:  # noqa: BLE001 - diagnostics
+                    result["fabric"]["overhead_error"] = \
+                        f"{type(e).__name__}: {e}"[:200]
+                    result["partial"] = True
+            else:
+                result["fabric"]["overhead_skipped"] = "wall budget"
+                result["partial"] = True
         # ---- traffic lane (ISSUE 11): capture/replay engine. Headline
         # keys: replay_fidelity_pct (a recorded mixed-priority corpus
         # replayed at 1x reproduces the recorded qps profile) and
@@ -1358,6 +1397,9 @@ def main() -> None:
         result.get("backend_stats_overhead_pct"),
         "fault_goodput_ratio": result.get("fault_goodput_ratio"),
         "fault_p99_ms": result.get("fault_p99_ms"),
+        "priority_goodput_hi_ratio":
+        result.get("priority_goodput_hi_ratio"),
+        "admission_overhead_pct": result.get("admission_overhead_pct"),
         "replay_fidelity_pct": result.get("replay_fidelity_pct"),
         "capture_overhead_pct": result.get("capture_overhead_pct"),
         "series_overhead_pct": result.get("series_overhead_pct"),

@@ -110,6 +110,17 @@ LOCK_ORDER: List[Tuple[str, str]] = [
     # tick thread; span/flight-recorder annotation fires OUTSIDE it
     # (bvar/anomaly.py)
     ("AnomalyWatchdog._lock",       "bvar/anomaly.py"),
+    # leaf: the DAGOR admission controller's window histogram — taken
+    # bare on the dispatch admission path (admit_level) and by the
+    # overload organs AFTER their own leaf locks released
+    # (signal_overload runs once on_requested has returned False);
+    # never wraps another acquisition (rpc/admission.py)
+    ("AdmissionController._lock",   "rpc/admission.py"),
+    # leaf: the channel-group budget registry — the shared bucket is
+    # BUILT outside it (RetryBudget's constructor exposes a bvar, and
+    # bvar registration must never nest under a registry lock); the
+    # lock guards the dict insert/snapshot only (rpc/retry_policy.py)
+    ("retry_policy:_group_lock",    "rpc/retry_policy.py"),
 ]
 
 _RANK: Dict[str, int] = {name: i for i, (name, _) in enumerate(LOCK_ORDER)}

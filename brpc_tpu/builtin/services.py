@@ -30,6 +30,7 @@ def connections_page(server) -> dict:
     from brpc_tpu.rpc.circuit_breaker import all_breaker_snapshots
     robustness = dict(dump_exposed("chaos_injected_"))
     for name in ("server_deadline_shed", "server_limit_shed",
+                 "server_priority_shed", "client_priority_shed",
                  "retry_suppressed_budget", "retry_throttled",
                  "hedge_suppressed_budget", "naming_empty"):
         robustness.update(dump_exposed(name))
@@ -218,11 +219,18 @@ def status_page(server) -> dict:
     # retry token bucket. Merged shard views: *limit takes the max,
     # inflight sums, *tokens takes the min (shard_group merge rules).
     from brpc_tpu.rpc.retry_policy import min_retry_tokens
-    from brpc_tpu.rpc.server_dispatch import nlimit_shed, nshed
+    from brpc_tpu.rpc.server_dispatch import (nlimit_shed, npriority_shed,
+                                              nshed)
     saturation["concurrency_limit"] = server.concurrency_limit()
     saturation["inflight"] = server.concurrency
     saturation["limit_shed"] = nlimit_shed.get_value()
     saturation["deadline_shed"] = nshed.get_value()
+    saturation["priority_shed"] = npriority_shed.get_value()
+    adm = server._admission
+    if adm is not None:
+        # the DAGOR admission threshold (0 = calm); merged shard views
+        # take the max — the group's tightest gate is its headline
+        saturation["admission_threshold"] = adm.wire_threshold()
     tokens = min_retry_tokens()
     if tokens is not None:
         saturation["retry_tokens"] = tokens
@@ -237,6 +245,8 @@ def status_page(server) -> dict:
                 ("socket_wqueue_bytes", "socket_wqueue_bytes"),
                 ("limit_shed", "server_limit_shed"),
                 ("deadline_shed", "server_deadline_shed"),
+                ("priority_shed", "server_priority_shed"),
+                ("admission_threshold", "server_admission_threshold"),
                 ("inflight", "server_concurrency_inflight"),
                 ("concurrency_limit", "server_concurrency_limit"),
                 ("iobuf_pool_hit_ratio", "iobuf_pool_hit_ratio"),

@@ -80,7 +80,8 @@ class EspProtocol(Protocol):
                    if server is not None else None)
         if handler is None:
             return       # esp has no error channel: drop, like the reference
-        if not server.on_request_start("esp.process"):
+        cost = server.on_request_start("esp.process")
+        if not cost:
             return
         t0 = time.monotonic_ns()
         error = False
@@ -93,7 +94,7 @@ class EspProtocol(Protocol):
         except Exception:
             error = True
         server.on_request_end("esp.process",
-                              (time.monotonic_ns() - t0) / 1e3, error)
+                              (time.monotonic_ns() - t0) / 1e3, error, cost)
         if reply is None:
             return
         if isinstance(reply, (bytes, bytearray, memoryview)):

@@ -629,7 +629,10 @@ class H2ServerProtocol(Protocol):
             status, message = GRPC_INTERNAL, f"bad request: {e}"
             request = None
         if status == GRPC_OK:
-            if not server.on_request_start(f"{service}.{method_name}"):
+            # cost rides to on_request_end: weighted limiter slots
+            # (rpc/admission.CostModel) must release what they charged
+            cost = server.on_request_start(f"{service}.{method_name}")
+            if not cost:
                 status, message = GRPC_UNAVAILABLE, "max_concurrency reached"
             else:
                 t0 = time.monotonic_ns()
@@ -653,7 +656,7 @@ class H2ServerProtocol(Protocol):
                     server.on_request_end(
                         f"{service}.{method_name}",
                         (time.monotonic_ns() - t0) / 1e3,
-                        status != GRPC_OK or cntl.failed())
+                        status != GRPC_OK or cntl.failed(), cost)
                 if status == GRPC_OK and cntl.failed():
                     status = errno_to_grpc_status(cntl.error_code)
                     message = cntl.error_text

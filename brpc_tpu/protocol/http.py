@@ -871,7 +871,11 @@ class HttpProtocol(Protocol):
                     return 400, "text/plain", f"bad json: {e}".encode()
         else:
             request = req.body
-        if not server.on_request_start(f"{service}.{method_name}"):
+        # cost rides to on_request_end: weighted limiter slots must
+        # release what they charged (rpc/admission.CostModel)
+        cost = server.on_request_start(f"{service}.{method_name}",
+                                       len(req.body or b""))
+        if not cost:
             return 500, "text/plain", b"max_concurrency reached"
         interceptor = getattr(server.options, "interceptor", None)
         if interceptor is not None:
@@ -883,7 +887,8 @@ class HttpProtocol(Protocol):
             except Exception as e:
                 verdict = (500, f"interceptor error: {e}")
             if verdict is not None:
-                server.on_request_end(f"{service}.{method_name}", 0, True)
+                server.on_request_end(f"{service}.{method_name}", 0,
+                                      True, cost)
                 return 403, "text/plain", str(verdict[1]).encode()
         t0 = time.monotonic_ns()
         try:
@@ -894,10 +899,12 @@ class HttpProtocol(Protocol):
             response = r
         except Exception as e:
             server.on_request_end(f"{service}.{method_name}",
-                                  (time.monotonic_ns() - t0) / 1e3, True)
+                                  (time.monotonic_ns() - t0) / 1e3, True,
+                                  cost)
             return 500, "text/plain", f"handler error: {e}".encode()
         server.on_request_end(f"{service}.{method_name}",
-                              (time.monotonic_ns() - t0) / 1e3, cntl.failed())
+                              (time.monotonic_ns() - t0) / 1e3,
+                              cntl.failed(), cost)
         if cntl.failed():
             # honor the cntl.set_failed error pattern over HTTP too
             from brpc_tpu.rpc import errno_codes as berr

@@ -168,7 +168,8 @@ class MongoProtocol(Protocol):
             send({"ok": 0.0, "errmsg": f"no such command: '{cmd_name}'",
                   "code": 59})
             return
-        if not server.on_request_start(f"mongo.{cmd_name}"):
+        cost = server.on_request_start(f"mongo.{cmd_name}")
+        if not cost:
             send({"ok": 0.0, "errmsg": "max_concurrency reached", "code": 202})
             return
         t0 = time.monotonic_ns()
@@ -184,7 +185,7 @@ class MongoProtocol(Protocol):
             error = True
             reply = {"ok": 0.0, "errmsg": f"handler error: {e}", "code": 8}
         server.on_request_end(f"mongo.{cmd_name}",
-                              (time.monotonic_ns() - t0) / 1e3, error)
+                              (time.monotonic_ns() - t0) / 1e3, error, cost)
         send(reply)
 
     def process(self, msg, socket):

@@ -109,7 +109,8 @@ class NsheadProtocol(Protocol):
                                      msg.log_id).pack())
             socket.write(out)
             return
-        if not server.on_request_start("nshead.process"):
+        cost = server.on_request_start("nshead.process")
+        if not cost:
             return
         t0 = time.monotonic_ns()
         error = False
@@ -122,7 +123,7 @@ class NsheadProtocol(Protocol):
         except Exception:
             error = True
         server.on_request_end("nshead.process",
-                              (time.monotonic_ns() - t0) / 1e3, error)
+                              (time.monotonic_ns() - t0) / 1e3, error, cost)
         if reply is None:
             return
         if isinstance(reply, (bytes, bytearray, memoryview)):

@@ -272,7 +272,8 @@ class RedisProtocol(Protocol):
             socket.write(_reply_buf(RedisError(
                 f"ERR unknown command '{name}'")))
             return
-        if not server.on_request_start(f"redis.{name}"):
+        cost = server.on_request_start(f"redis.{name}")
+        if not cost:
             socket.write(_reply_buf(RedisError("ERR max_concurrency reached")))
             return
         t0 = time.monotonic_ns()
@@ -286,7 +287,7 @@ class RedisProtocol(Protocol):
             error = True
             out = _reply_buf(RedisError(f"ERR handler error: {e}"))
         server.on_request_end(f"redis.{name}",
-                              (time.monotonic_ns() - t0) / 1e3, error)
+                              (time.monotonic_ns() - t0) / 1e3, error, cost)
         socket.write(out)
 
     def process(self, msg, socket):

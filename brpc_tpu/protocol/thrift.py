@@ -336,7 +336,8 @@ class ThriftProtocol(Protocol):
             reply(MSG_EXCEPTION, app_exception_fields(
                 f"unknown method {msg.method!r}", 1))   # UNKNOWN_METHOD
             return
-        if not server.on_request_start(f"thrift.{msg.method}"):
+        cost = server.on_request_start(f"thrift.{msg.method}")
+        if not cost:
             reply(MSG_EXCEPTION, app_exception_fields(
                 "max_concurrency reached", 5))           # INTERNAL_ERROR
             return
@@ -361,7 +362,7 @@ class ThriftProtocol(Protocol):
             reply(MSG_EXCEPTION, app_exception_fields(
                 f"handler error: {e}", 6))               # INTERNAL_ERROR
         server.on_request_end(f"thrift.{msg.method}",
-                              (time.monotonic_ns() - t0) / 1e3, error)
+                              (time.monotonic_ns() - t0) / 1e3, error, cost)
 
     def process(self, msg, socket):
         raise AssertionError("thrift messages are processed inline")

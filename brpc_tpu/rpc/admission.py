@@ -1,0 +1,306 @@
+"""DAGOR-grade priority admission (ISSUE 14): cost-weighted limiter
+slots and two-level business+user priority shedding with the threshold
+fed back to senders.
+
+The design follows WeChat's DAGOR overload control (Zhou et al.,
+SoCC'18) grafted onto the server's existing overload organs (PR 10's
+concurrency limiter + queue-delay gate):
+
+* **Admission level** — every request maps to one integer:
+  ``level = business_priority << 7 | user_slot`` where the business
+  priority is the wire's ``RpcRequestMeta.priority`` tag and the user
+  sub-priority is a stable hash of the caller's identity (auth cookie
+  when present, else the connection's client address). The user slice
+  exists so a threshold can cut PART of a business class — and because
+  it is a stable hash, one caller's requests are consistently kept or
+  consistently dropped instead of randomly flapping.
+
+* **Threshold adaptation** — while the limiter or the queue-delay gate
+  reports overload, the controller raises an admission threshold each
+  window so the below-threshold fraction of the CURRENT traffic
+  histogram is shed at the door (µs-cheap, before parse/handler); calm
+  windows relax it back toward zero. The threshold never climbs into
+  the highest business class seen in the window — with uniform
+  priorities (every request untagged) the floor of that class is level
+  0 and admission never sheds anything, so servers without priority-
+  tagged traffic keep their exact PR 10 behavior.
+
+* **Cost weights** — limiter slots become weighted: a request's cost
+  derives from its size and its method's expected-latency bucket (fed
+  from the server's per-method latency reservoirs), so a 4MB streaming
+  call no longer draws the same admission slot as a 4B echo
+  (``ServerOptions(request_costs=True)``).
+
+The current threshold piggybacks on ``RpcResponseMeta.
+admission_threshold`` (default-absent); clients cache it per
+(backend, service) and fail doomed sends fast locally with periodic
+probe-through — overload stops burning sockets and retry tokens at
+the source (rpc/channel.py holds the client half).
+
+``BRPC_TPU_ADMISSION=0`` (env, read at import) or the runtime flag
+``admission_enabled`` turns the layer off for overhead A/B runs.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import zlib
+from typing import Optional
+
+from brpc_tpu.butil.flags import define_flag, flag as _flag
+
+define_flag("admission_enabled",
+            os.environ.get("BRPC_TPU_ADMISSION", "1") != "0",
+            "DAGOR priority-admission layer (two-level threshold "
+            "shedding + response piggyback); BRPC_TPU_ADMISSION=0 sets "
+            "the default off for overhead A/B runs")
+
+USER_SLOTS = 128            # user sub-priority space per business class
+LEVEL_SHIFT = 7             # level = priority << 7 | user_slot
+MAX_PRIORITY = 127
+
+
+def admission_enabled() -> bool:
+    return _flag("admission_enabled")
+
+
+def user_slot(identity) -> int:
+    """Stable user sub-priority in [0, 127] from a caller identity
+    (auth cookie / client address string). crc32, not hash():
+    PYTHONHASHSEED salts str hashing per process, and the client must
+    compute the SAME slot the server does (the racelane lesson)."""
+    if not identity:
+        return 0
+    if isinstance(identity, str):
+        identity = identity.encode("utf-8", "surrogatepass")
+    return zlib.crc32(identity) & (USER_SLOTS - 1)
+
+
+def cached_socket_slot(socket, ep) -> int:
+    """The user sub-priority of a connection identity, cached on the
+    socket: ``ep`` is whichever endpoint of the pair names the CLIENT
+    (the server hashes its ``remote_endpoint``, the client its
+    socket's ``local_endpoint`` — the same address, so both sides
+    compute the same slot; the piggyback fail-fast depends on the
+    match). ONE implementation on purpose: a drift between the two
+    sides would silently turn every doomed-send decision wrong."""
+    slot = socket.__dict__.get("_adm_user_slot")
+    if slot is None:
+        from brpc_tpu.rpc.backend_stats import ep_key
+        slot = user_slot(ep_key(ep)) if ep is not None else 0
+        socket._adm_user_slot = slot
+    return slot
+
+
+def compose_level(priority: int, slot: int) -> int:
+    """One admission integer from (business priority, user slot):
+    higher = more important; the business class dominates."""
+    if priority < 0:
+        priority = 0
+    elif priority > MAX_PRIORITY:
+        priority = MAX_PRIORITY
+    return (priority << LEVEL_SHIFT) | (slot & (USER_SLOTS - 1))
+
+
+class CostModel:
+    """Weighted request cost for the concurrency limiter: cost =
+    latency-bucket weight of the method (its reservoir p50, refreshed
+    at most once a second) + a bytes term, capped. A weight-1 request
+    is the PR 10 slot; heavier classes draw proportionally more of the
+    limit, so weighted inflight tracks real pressure instead of a
+    request count."""
+
+    UNIT_BYTES = 64 * 1024          # one extra slot per 64KB
+    MAX_COST = 64.0
+    REFRESH_S = 1.0
+    # method p50 (us) -> extra latency weight (expected-service cost)
+    LAT_BUCKETS = ((1_000.0, 0.0), (10_000.0, 1.0),
+                   (100_000.0, 3.0), (float("inf"), 7.0))
+
+    def __init__(self, server):
+        import weakref
+        # weak: the server owns this model — a strong back-ref would
+        # make the pair uncollectable as a cycle through options
+        self._server_ref = weakref.ref(server)
+        self._method_weights: dict = {}
+        self._next_refresh = 0.0
+
+    def request_cost(self, method_key: Optional[str], nbytes: int) -> float:
+        now = time.monotonic()
+        if now >= self._next_refresh:
+            self._refresh_weights(now)
+        cost = 1.0 + self._method_weights.get(method_key, 0.0)
+        if nbytes > self.UNIT_BYTES:
+            cost += nbytes / self.UNIT_BYTES
+        return cost if cost <= self.MAX_COST else self.MAX_COST
+
+    def _refresh_weights(self, now: float) -> None:
+        """Re-bucket every method from its latency reservoir. Racy by
+        design: concurrent refreshers compute the same table and the
+        dict swap is atomic — a lock here would sit on the admission
+        hot path for a once-a-second event."""
+        self._next_refresh = now + self.REFRESH_S
+        server = self._server_ref()
+        if server is None:
+            return
+        weights = {}
+        for key, lr in list(server.method_status.items()):
+            try:
+                p50 = lr.latency_percentile(0.5)
+            except Exception:
+                continue
+            if not p50:
+                continue
+            for bound, w in self.LAT_BUCKETS:
+                if p50 <= bound:
+                    if w:
+                        weights[key] = w
+                    break
+        self._method_weights = weights
+
+
+class AdmissionController:
+    """Two-level priority admission (DAGOR): windowed threshold over
+    composed (business, user) levels.
+
+    Fast path discipline: while no overload has been signalled and no
+    threshold is set, ``threshold_engaged`` is two attribute reads —
+    the calm server pays nothing else. Overload signals (limiter
+    rejects, queue-delay sheds) arm the controller; from then on every
+    request's level feeds the window histogram and windows adapt
+    ``shed_frac`` toward the overload evidence: up while signals keep
+    arriving, down while calm, threshold recomputed each window as the
+    histogram quantile at ``shed_frac`` — clamped BELOW the floor of
+    the highest business class seen, so the top class (and therefore
+    uniform-priority traffic, whose only class IS the top) is never
+    shed by priority.
+
+    ``_lock`` is a LEAF (LOCK_ORDER row): taken bare on the dispatch
+    admission path, never wraps another acquisition."""
+
+    WINDOW_S = 0.5
+    MAX_SHED_FRAC = 0.95
+    STEP_UP_MIN = 0.05          # overloaded window: raise at least this
+    STEP_DOWN = 0.10            # calm window: relax this much
+    HIST_CAP = 2048             # distinct levels tracked per window
+
+    def __init__(self, window_s: Optional[float] = None):
+        self._lock = threading.Lock()
+        self._armed = False          # racy-read fast-path gate
+        self._threshold = 0          # racy-read piggyback value
+        self._shed_frac = 0.0
+        self._hist: dict = {}
+        self._win_total = 0
+        self._win_over = 0
+        self._win_start = time.monotonic()
+        self._shed_count = 0         # lifetime priority sheds (snapshot)
+        if window_s:
+            self.WINDOW_S = float(window_s)
+
+    # ------------------------------------------------------- hot path
+    def threshold_engaged(self) -> bool:
+        """True while admission has anything to say (armed by overload
+        signals; disarmed after the threshold decays to zero through a
+        calm window). Racy read by design — the dispatch path must not
+        pay a lock to learn the server is calm."""
+        return self._armed
+
+    def admit_level(self, level: int) -> bool:
+        """Count this request's level into the window and judge it
+        against the current threshold. Only called while engaged (the
+        caller checks ``threshold_engaged`` first); False = shed with
+        EPRIORITYSHED before parse/handler."""
+        with self._lock:
+            self._tally_locked(level)
+            self._maybe_adapt_locked()
+            shed = level < self._threshold
+            if shed:
+                self._shed_count += 1
+        return not shed
+
+    # ------------------------------------------------- overload signals
+    def signal_overload(self, level: int = 0,
+                        counted: bool = False) -> None:
+        """An overload organ rejected work this instant (concurrency
+        limiter full, queue-delay gate tripped): arm the controller and
+        feed the evidence the next window adapts on. Cold path — it
+        only runs when the server is already shedding. ``counted`` =
+        this request's level already entered the window histogram
+        through ``admit_level`` (the engaged dispatch path) — tallying
+        it again would double-weight rejected levels AND halve the
+        over/total adaptation ratio exactly in deep overload."""
+        with self._lock:
+            self._armed = True
+            self._win_over += 1
+            if not counted:
+                self._tally_locked(level)
+            self._maybe_adapt_locked()
+
+    # ------------------------------------------------------- internals
+    def _tally_locked(self, level: int) -> None:
+        self._win_total += 1
+        h = self._hist
+        n = h.get(level)
+        if n is None and len(h) >= self.HIST_CAP:
+            return                      # bounded: drop novel levels
+        h[level] = (n or 0) + 1
+
+    def _maybe_adapt_locked(self) -> None:
+        now = time.monotonic()
+        if now - self._win_start < self.WINDOW_S:
+            return
+        total = self._win_total
+        over = self._win_over
+        if over > 0 and total > 0:
+            # raise the shed target by at least STEP_UP_MIN, more when
+            # a large fraction of the window hit the overload organs
+            # (half the observed overflow — full-step chasing
+            # oscillates against the load the shed itself removes)
+            step = max(self.STEP_UP_MIN, 0.5 * over / total)
+            self._shed_frac = min(self.MAX_SHED_FRAC,
+                                  self._shed_frac + step)
+        else:
+            self._shed_frac = max(0.0, self._shed_frac - self.STEP_DOWN)
+        self._threshold = self._quantile_threshold_locked(total)
+        if self._threshold == 0 and self._shed_frac == 0.0 and over == 0:
+            self._armed = False
+        self._hist = {}
+        self._win_total = 0
+        self._win_over = 0
+        self._win_start = now
+
+    def _quantile_threshold_locked(self, total: int) -> int:
+        """Smallest T with count(levels < T) >= shed_frac * total,
+        clamped below the floor of the highest business class seen —
+        DAGOR never sheds its top class, and with uniform priorities
+        that floor is level 0, so the threshold stays 0."""
+        if self._shed_frac <= 0.0 or not total or not self._hist:
+            return 0
+        levels = sorted(self._hist)
+        top_band_floor = (levels[-1] >> LEVEL_SHIFT) << LEVEL_SHIFT
+        if top_band_floor <= 0:
+            return 0
+        target = self._shed_frac * total
+        cum = 0
+        threshold = 0
+        for lvl in levels:
+            if cum >= target:
+                break
+            threshold = lvl + 1
+            cum += self._hist[lvl]
+        return min(threshold, top_band_floor)
+
+    # --------------------------------------------------------- reads
+    def wire_threshold(self) -> int:
+        """The piggyback value for RpcResponseMeta.admission_threshold
+        (racy read; 0 = calm, field stays absent on the wire)."""
+        return self._threshold
+
+    def admission_snapshot(self) -> dict:
+        with self._lock:
+            return {"threshold": self._threshold,
+                    "armed": self._armed,
+                    "shed_frac": round(self._shed_frac, 3),
+                    "priority_sheds": self._shed_count}

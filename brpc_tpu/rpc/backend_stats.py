@@ -77,7 +77,13 @@ EWMA_ALPHA = 0.2
 # breakage (LALB error penalty, circuit breaker). ERPCTIMEDOUT joins
 # the class only when a server RESPONDED with it (the deadline shed
 # gate) — a client-local timeout has no responder and stays a failure.
-REJECT_CODES = frozenset({berr.ELIMIT, berr.EOVERCROWDED})
+# EPRIORITYSHED (the priority-admission shed, ISSUE 14) is a member
+# whether the SERVER shed it or the CLIENT failed it fast against the
+# piggybacked threshold: neither flavor burned anything anywhere, so
+# it must not drain retry tokens, darken the channel, or penalize the
+# balancer — the PR 10 ELIMIT rule.
+REJECT_CODES = frozenset({berr.ELIMIT, berr.EOVERCROWDED,
+                          berr.EPRIORITYSHED})
 
 
 def is_reject(code: int, responded_server=None) -> bool:
@@ -564,9 +570,15 @@ def backends_page_payload(samples: int = 256) -> dict:
         entry["backends"][backend] = row
         for k in totals:
             totals[k] += row.get(k, 0)
+    # channel-group retry budgets (ISSUE 14): one bucket per
+    # budget_group, shared by every member channel — surfaced beside
+    # the per-channel buckets so an operator sees the cluster-wide
+    # retry fuel, not N identical-looking private snapshots
+    from brpc_tpu.rpc.retry_policy import budget_group_snapshot
     return {
         "enabled": enabled(),
         "channels": channels,
         "totals": totals,
+        "budget_groups": budget_group_snapshot(),
         "unattributed_errors": reg.unattributed,
     }

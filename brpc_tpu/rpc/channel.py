@@ -90,6 +90,20 @@ nretry_throttled = Adder().expose("retry_throttled")
 # Dean & Barroso, The Tail at Scale) — /vars
 nhedge_suppressed = Adder().expose("hedge_suppressed_budget")
 
+# sends failed fast CLIENT-side against a piggybacked admission
+# threshold (DAGOR: doomed traffic stops at the source instead of
+# burning a socket round trip to be shed at the server's door) — /vars
+nclient_priority_shed = Adder().expose("client_priority_shed")
+
+# admission-threshold cache discipline (Channel._adm_cache): entries
+# expire after TTL, a broken CONNECTION drops its backend's entries at
+# once (a restarted backend must not inherit a stale threshold — see
+# _on_attempt_failed), and every PROBE interval one doomed send per
+# (backend, service) goes through anyway so a relaxing threshold is
+# observed
+ADM_THRESHOLD_TTL_S = 5.0
+ADM_PROBE_INTERVAL_S = 0.25
+
 # failure codes that never drain the retry token bucket: overload
 # REJECTS cost the server microseconds at the door (see _maybe_retry),
 # and a naming-empty fail-fast burns nothing anywhere — draining on it
@@ -160,6 +174,14 @@ class ChannelOptions:
     # (EndPoint)->bool; servers it rejects never reach the load
     # balancer. Cluster channels only.
     ns_filter: Optional[Any] = None
+    # channel-group retry budget (ISSUE 14): every channel in a process
+    # naming the same group shares ONE RetryBudget — a process holding
+    # N channels to one cluster otherwise gives a brown-out N buckets
+    # of retry fuel (the PR 10 amplification hole). The group's sizing
+    # comes from the FIRST member's retry_budget spec; later members
+    # join the existing bucket. Empty = per-channel budget semantics
+    # unchanged.
+    budget_group: str = ""
 
 
 
@@ -202,12 +224,25 @@ class Channel:
         # registration happens exactly once
         self._stats_name = self.options.name or self._default_stats_name()
         _bs.global_stats().register_channel(self._stats_name, self)
-        if self.options.retry_budget is not None:
+        if self.options.budget_group:
+            # cluster-scoped token bucket: all channels in the group
+            # drain/refill ONE budget (retry_policy.shared_retry_budget)
+            from brpc_tpu.rpc.retry_policy import shared_retry_budget
+            self._retry_budget = shared_retry_budget(
+                self.options.budget_group, self.options.retry_budget)
+        elif self.options.retry_budget is not None:
             from brpc_tpu.rpc.retry_policy import RetryBudget
             self._retry_budget = RetryBudget.resolve(
                 self.options.retry_budget)
         else:
             self._retry_budget = None
+        # piggybacked admission thresholds, keyed (backend, service):
+        # plain dict, atomic get/set/pop only — fed by the response
+        # paths, consulted by the issue path's doomed-send fail-fast.
+        # Empty (the overwhelming common case) costs one truthiness
+        # check per issue/response.
+        self._adm_cache: dict = {}
+        self._adm_sweep = 0.0          # last stale-entry sweep stamp
         self._control = control or global_control()
         self._messenger = InputMessenger(control=self._control)
         self._socket: Optional[Socket] = None
@@ -364,6 +399,14 @@ class Channel:
             if parent.trace_id and not cntl.trace_id:
                 cntl.trace_id = parent.trace_id
                 cntl.span_id = parent.span_id
+            # priority inheritance (ISSUE 14): a nested call carries
+            # the serving request's business priority unless the
+            # caller explicitly set one — a chain's class survives
+            # hops exactly like its deadline budget does below (same
+            # fiber-local path; 0 = unset inherits, a reused
+            # controller was reset by _reset_for_call)
+            if parent.request_priority and not cntl.request_priority:
+                cntl.request_priority = parent.request_priority
             rem = parent.remaining_ms()
             if rem is not None:
                 if rem <= 0.0:
@@ -615,6 +658,32 @@ class Channel:
             self._maybe_retry(cntl, getattr(e, "berrno",
                                             berr.EFAILEDSOCKET), str(e))
             return
+        if self._adm_cache and self._doomed_by_threshold(cntl, sock):
+            # the chosen backend's piggybacked admission threshold
+            # sits above this call's level: the send is DOOMED at THIS
+            # backend — fail the attempt here, before the attempt
+            # record, the span and the socket write (DAGOR: overload
+            # stops burning sockets at the source), and hand it to the
+            # retry machinery like the server's own shed would arrive:
+            # a cluster pick already sits on tried_servers, so the
+            # retry goes ELSEWHERE (one stalled node must not doom a
+            # call the healthy survivor would serve), while a cluster
+            # whose every backend is doomed fails in microseconds once
+            # the pick exclusions exhaust. EPRIORITYSHED is a reject —
+            # no token drain, no LALB penalty, no breaker darkening —
+            # and probe-through keeps one send per interval flowing so
+            # a relaxing threshold is observed.
+            nclient_priority_shed.add(1)
+            # a local shed never left the building: it is a re-pick,
+            # not load on the cluster — wire-attempt accounting
+            # (outage amplification) subtracts these
+            d["_adm_local_sheds"] = d.get("_adm_local_sheds", 0) + 1
+            self._maybe_retry(cntl, berr.EPRIORITYSHED,
+                              "below piggybacked admission threshold "
+                              f"at {sock.remote_endpoint} (shed "
+                              "client-side)",
+                              failed_ep=sock.remote_endpoint)
+            return
         cntl.remote_side = sock.remote_endpoint
         cntl.local_side = sock.local_endpoint
         cntl._set_issue_socket(sock)  # sync-pluck lane (Controller.join)
@@ -801,6 +870,21 @@ class Channel:
         correlation id, so only the issue sequence can tell a verdict
         for a dead attempt from one against its live successor."""
         cid = cntl.correlation_id if expect_cid is None else expect_cid
+        if self._adm_cache and code in (berr.EFAILEDSOCKET, berr.ECLOSE):
+            # the CONNECTION to this backend died: whatever admission
+            # threshold it piggybacked describes a process that may no
+            # longer exist — a respawned backend must be approached
+            # fresh, not doomed-shed against its predecessor's number
+            # for up to a TTL (the fabric storm's recover tail pins
+            # this). Before the latch on purpose: even a stale verdict
+            # for an already re-issued attempt reports a real
+            # connection death, and the drop is idempotent.
+            ep = failed_ep or self._endpoint
+            if ep is not None:
+                epk = _bs.ep_key(ep)
+                for key in [k for k in list(self._adm_cache)
+                            if k[0] == epk]:
+                    self._adm_cache.pop(key, None)
         if address_call(cid) is not cntl:
             return  # already completed (response/timeout won) or recycled
         # policy consult BEFORE the lock: user policy code must not run
@@ -964,6 +1048,75 @@ class Channel:
         cntl.current_try += 1
         self._on_attempt_failed(cntl, code, text, failed_ep)
         cntl._register_call()
+        return True
+
+    # --------------------------------------- admission-threshold cache
+    def _track_admission_threshold(self, ep, service: str,
+                                   threshold: int) -> None:
+        """Response-path hook: a server piggybacked its current DAGOR
+        admission threshold (or, threshold 0, stopped — absent field /
+        fast-lane response), cache it per (backend, service). Called
+        only while a threshold rides the wire or the cache is non-empty
+        — the calm hot path never lands here."""
+        key = (_bs.ep_key(ep), service)
+        now = time.monotonic()
+        if threshold:
+            ent = self._adm_cache.get(key)
+            if ent is None:
+                self._adm_cache[key] = [threshold, now, now]
+            else:
+                ent[0] = threshold
+                ent[1] = now
+        else:
+            self._adm_cache.pop(key, None)
+        if now - self._adm_sweep > ADM_THRESHOLD_TTL_S:
+            # lazy sweep (at most once per TTL): an entry for a
+            # (backend, service) the app stopped calling would
+            # otherwise keep the cache truthy forever — every
+            # issue/response of the whole channel paying the admission
+            # lookups for a pair nobody uses
+            self._adm_sweep = now
+            for k in [k for k, e in list(self._adm_cache.items())
+                      if now - e[1] > ADM_THRESHOLD_TTL_S]:
+                self._adm_cache.pop(k, None)
+
+    def _client_user_slot(self, cntl: Controller, sock) -> int:
+        """This call's user sub-priority as the SERVER will compute it:
+        the auth cookie when one rides the request, else the hash of
+        the connection's client address — our socket's local endpoint
+        IS the server's remote_endpoint, and the shared
+        admission.cached_socket_slot keeps both sides' hash in
+        lockstep."""
+        from brpc_tpu.rpc.admission import cached_socket_slot, user_slot
+        if cntl.auth_token:
+            return user_slot(cntl.auth_token)
+        return cached_socket_slot(sock, sock.local_endpoint)
+
+    def _doomed_by_threshold(self, cntl: Controller, sock) -> bool:
+        """True = this send's admission level sits below the backend's
+        cached threshold and the probe window hasn't come around: fail
+        it locally. Stale entries (TTL) expire here so a restarted or
+        recovered backend is re-probed by the first send."""
+        key = (_bs.ep_key(sock.remote_endpoint), cntl._service_name)
+        ent = self._adm_cache.get(key)
+        if ent is None:
+            return False
+        now = time.monotonic()
+        if now - ent[1] > ADM_THRESHOLD_TTL_S:
+            self._adm_cache.pop(key, None)
+            return False
+        from brpc_tpu.rpc.admission import compose_level
+        level = compose_level(cntl.request_priority,
+                              self._client_user_slot(cntl, sock))
+        if level >= ent[0]:
+            return False
+        if now - ent[2] >= ADM_PROBE_INTERVAL_S:
+            # probe-through: one doomed send per interval goes to the
+            # wire anyway, so a relaxing threshold reaches this cache
+            # (its response either carries a lower threshold or, calm,
+            # clears the entry)
+            ent[2] = now
+            return False
         return True
 
     # ------------------------------------------- per-backend telemetry

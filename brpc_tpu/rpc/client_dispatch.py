@@ -103,6 +103,14 @@ def process_response_fast(cid: int, err_code: int, err_text, payload: bytes,
     cntl = address_call(cid)
     if cntl is None:
         return  # stale: the call already completed (timeout/backup winner)
+    ch = cntl._owner_channel
+    if ch is not None and ch._adm_cache:
+        # a response that rode the FAST lane cannot carry an admission
+        # threshold (the C scanner defers unknown response-meta fields
+        # to the classic parser) — its absence here is therefore
+        # definitive: the backend relaxed, clear the cached entry
+        ch._track_admission_threshold(socket.remote_endpoint,
+                                      cntl._service_name, 0)
     if err_code:
         from brpc_tpu.protocol.proto import tpu_rpc_meta_pb2 as pb
         meta = pb.RpcMeta()
@@ -145,8 +153,20 @@ def process_response(proto, msg: RpcMessage, socket) -> None:
     cntl = address_call(cid)
     if cntl is None:
         return  # stale: the call already completed (timeout/backup winner)
-    is_error = (msg.meta.HasField("response")
-                and msg.meta.response.error_code != 0)
+    has_resp = msg.meta.HasField("response")
+    ch = cntl._owner_channel
+    if ch is not None:
+        # DAGOR threshold piggyback: an overloaded backend stamps its
+        # admission threshold on every response — cache it so doomed
+        # sends fail fast locally (Channel._doomed_by_threshold); a
+        # response WITHOUT the stamp means that backend relaxed, so a
+        # non-empty cache clears its entry. The calm common case pays
+        # one int read (0) + one empty-dict truthiness check.
+        thr = msg.meta.response.admission_threshold if has_resp else 0
+        if thr or ch._adm_cache:
+            ch._track_admission_threshold(socket.remote_endpoint,
+                                          cntl._service_name, thr)
+    is_error = has_resp and msg.meta.response.error_code != 0
     if is_error:
         code = msg.meta.response.error_code
         text = msg.meta.response.error_text

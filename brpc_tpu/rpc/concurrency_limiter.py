@@ -21,11 +21,19 @@ from typing import Optional
 
 
 class ConcurrencyLimiter:
-    def on_requested(self) -> bool:
+    """``cost`` (ISSUE 14): weighted slots — the admission cost model
+    charges heavy requests more than one slot (request bytes +
+    expected-latency bucket, rpc/admission.CostModel), so weighted
+    inflight tracks real pressure. The default cost of 1.0 is exactly
+    the PR 10 slot; a release must pass the SAME cost its admission
+    charged (the Server threads it through the request lifecycle)."""
+
+    def on_requested(self, cost: float = 1.0) -> bool:
         """False = reject with ELIMIT."""
         raise NotImplementedError
 
-    def on_responded(self, latency_us: float, failed: bool) -> None:
+    def on_responded(self, latency_us: float, failed: bool,
+                     cost: float = 1.0) -> None:
         raise NotImplementedError
 
     @property
@@ -36,20 +44,28 @@ class ConcurrencyLimiter:
 class ConstantLimiter(ConcurrencyLimiter):
     def __init__(self, limit: int):
         self._limit = limit
-        self._inflight = 0
+        self._inflight = 0.0
         self._lock = threading.Lock()
 
-    def on_requested(self) -> bool:
+    def on_requested(self, cost: float = 1.0) -> bool:
+        # admit while weighted inflight sits below the limit: a heavy
+        # request admitted at the boundary may overshoot by its own
+        # cost (weighted-semaphore semantics — it can never be starved
+        # by lighter traffic), but everything behind it then waits for
+        # the weighted release
         with self._lock:
             if self._inflight >= self._limit:
                 return False
-            self._inflight += 1
+            self._inflight += cost
             return True
 
-    def on_responded(self, latency_us, failed):
+    def on_responded(self, latency_us, failed, cost: float = 1.0):
         with self._lock:
-            if self._inflight > 0:
-                self._inflight -= 1
+            self._inflight = max(0.0, self._inflight - cost)
+
+    @property
+    def inflight(self) -> float:
+        return self._inflight
 
     @property
     def max_concurrency(self):
@@ -86,24 +102,23 @@ class AutoLimiter(ConcurrencyLimiter):
                                                  self.min_concurrency))
         self._limit = float(min(max(initial, self.min_concurrency),
                                 self.max_limit))
-        self._inflight = 0
+        self._inflight = 0.0
         self._lock = threading.Lock()
         self._best_latency = float("inf")
         self._lat_sum = 0.0
         self._lat_n = 0
         self._win_start = time.monotonic()
 
-    def on_requested(self) -> bool:
+    def on_requested(self, cost: float = 1.0) -> bool:
         with self._lock:
             if self._inflight >= int(self._limit):
                 return False
-            self._inflight += 1
+            self._inflight += cost
             return True
 
-    def on_responded(self, latency_us, failed):
+    def on_responded(self, latency_us, failed, cost: float = 1.0):
         with self._lock:
-            if self._inflight > 0:
-                self._inflight -= 1
+            self._inflight = max(0.0, self._inflight - cost)
             if failed:
                 return
             self._lat_sum += latency_us
@@ -129,7 +144,7 @@ class AutoLimiter(ConcurrencyLimiter):
             self._limit = min(self.max_limit, self._limit * self.GROW)
 
     @property
-    def inflight(self) -> int:
+    def inflight(self) -> float:
         return self._inflight
 
     @property
@@ -183,28 +198,28 @@ class TimeoutLimiter(ConcurrencyLimiter):
     def __init__(self, timeout_ms: float):
         self._timeout_us = float(timeout_ms) * 1e3
         self._ema_us = 0.0
-        self._inflight = 0
+        self._inflight = 0.0
         self._lock = threading.Lock()
 
-    def on_requested(self) -> bool:
+    def on_requested(self, cost: float = 1.0) -> bool:
         with self._lock:
             if self._inflight >= self.MIN_LIMIT and self._ema_us > 0:
-                # queueing behind `inflight` others plus its own service
-                expected_done = (self._inflight + 1) * self._ema_us
+                # queueing behind `inflight` weighted others plus its
+                # own weighted service
+                expected_done = (self._inflight + cost) * self._ema_us
                 if expected_done > self._timeout_us:
                     return False
-            self._inflight += 1
+            self._inflight += cost
             return True
 
-    def on_responded(self, latency_us, failed):
+    def on_responded(self, latency_us, failed, cost: float = 1.0):
         # failures count too: during sustained overload every request
         # dies at the timeout, and skipping them would freeze the EMA at
         # the last healthy value — exactly when shedding matters most.
         # A timeout corpse's latency (~the timeout) pushes the estimate
         # up; recovery pulls it back down through later successes.
         with self._lock:
-            if self._inflight > 0:
-                self._inflight -= 1
+            self._inflight = max(0.0, self._inflight - cost)
             if latency_us > 0:
                 if self._ema_us == 0:
                     self._ema_us = latency_us

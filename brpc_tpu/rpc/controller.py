@@ -340,6 +340,7 @@ class Controller:
         d.pop("request_priority", None)    # per-call tag: a reused
         #                                    controller must not carry
         #                                    the previous call's class
+        d.pop("_adm_local_sheds", None)    # per-call doomed-send count
         d.pop("stream", None)     # a previous call's stream must not
         #                           resurface on the new call's response
         hooks = d.get("_complete_hooks")

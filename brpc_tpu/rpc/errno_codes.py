@@ -21,6 +21,11 @@ ECANCELED = 2006        # call canceled
 ENAMINGEMPTY = 2007     # naming service resolved no servers (cluster
 #                         channel fails fast instead of a generic pick
 #                         failure — see /vars naming_empty)
+EPRIORITYSHED = 2008    # DAGOR priority admission shed: the request's
+#                         (business, user) level sat below the server's
+#                         current admission threshold — a µs-cheap
+#                         reject distinct from ELIMIT so operators see
+#                         WHICH overload organ fired (rpc/admission.py)
 
 _NAMES = {v: k for k, v in list(globals().items()) if isinstance(v, int)}
 

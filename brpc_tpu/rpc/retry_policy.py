@@ -44,6 +44,14 @@ class RpcRetryPolicy(RetryPolicy):
         berr.ELOGOFF,         # server stopping: another replica may serve
         berr.ELIMIT,          # concurrency limiter rejected: retry elsewhere
         berr.EOVERCROWDED,    # write buffers full
+        berr.EPRIORITYSHED,   # below one node's admission threshold:
+        #                       thresholds are per-node — another
+        #                       replica may still admit this class.
+        #                       Client-LOCAL doomed-send sheds take
+        #                       the same path (µs per excluded pick,
+        #                       Channel._issue_rpc), so one stalled
+        #                       node never dooms a call its healthy
+        #                       siblings would serve
     })
 
     def do_retry(self, cntl) -> bool:
@@ -163,6 +171,41 @@ class RetryBudget:
 _budgets: "weakref.WeakSet[RetryBudget]" = weakref.WeakSet()
 _tokens_var_exposed = False
 
+# channel-group budgets (ISSUE 14): every channel a process holds to
+# the same cluster shares ONE token bucket, closing the PR 10 "one
+# process, many channels, one cluster" amplification hole — N channels
+# with private buckets give a brown-out N x max_tokens of retry fuel.
+# Keyed by ChannelOptions(budget_group=...); strongly held (the group
+# is a process-lifetime identity, like the bvar it feeds).
+_group_budgets: dict = {}
+_group_lock = threading.Lock()
+
+
+def shared_retry_budget(group: str, spec=True) -> RetryBudget:
+    """The group's shared RetryBudget, created from ``spec`` on first
+    use (True = defaults, an instance = its sizing). First channel
+    wins the sizing; later channels join the EXISTING bucket whatever
+    spec they carry — two sizings for one cluster would mean two
+    different ideas of how much retry fuel that cluster can absorb.
+    Built outside the lock (the bucket's constructor exposes a bvar,
+    and bvar registration must never nest under this registry lock)."""
+    cur = _group_budgets.get(group)
+    if cur is not None:
+        return cur
+    made = RetryBudget.resolve(True if spec is None else spec)
+    with _group_lock:
+        cur = _group_budgets.get(group)
+        if cur is None:
+            cur = _group_budgets[group] = made
+    return cur
+
+
+def budget_group_snapshot() -> dict:
+    """Group name -> bucket snapshot (the /backends robustness pane)."""
+    with _group_lock:
+        groups = dict(_group_budgets)
+    return {g: b.snapshot() for g, b in groups.items()}
+
 
 def min_retry_tokens():
     """Lowest token count across live budgets; None when no channel
@@ -200,9 +243,12 @@ def _postfork_reset() -> None:
     registry drops too: the parent's channel buckets describe traffic
     on sockets the child does not own."""
     global _default, _budgets, _tokens_var_exposed
+    global _group_budgets, _group_lock
     _default = None
     _budgets = weakref.WeakSet()
     _tokens_var_exposed = False
+    _group_budgets = {}
+    _group_lock = threading.Lock()
 
 
 from brpc_tpu.butil import postfork as _postfork  # noqa: E402

@@ -66,6 +66,12 @@ def _expose_limiter_vars(server) -> None:
         .expose("server_concurrency_limit")
     PassiveStatus(lambda: _read(lambda s: s.concurrency)) \
         .expose("server_concurrency_inflight")
+    # the DAGOR admission threshold: 0 while calm; merged shard views
+    # take the max (the shard_group "threshold" scalar rule)
+    PassiveStatus(lambda: _read(
+        lambda s: s._admission.wire_threshold()
+        if s._admission is not None else 0)) \
+        .expose("server_admission_threshold")
 
 
 # process-wide graceful-SIGTERM state: weak so stopped/forgotten servers
@@ -107,6 +113,8 @@ class ServerOptions:
                  max_concurrency=None,
                  method_max_concurrency: Optional[Dict[str, object]] = None,
                  queue_delay_shed_ms: Optional[float] = None,
+                 request_costs=None,
+                 priority_admission: Optional[bool] = None,
                  auth_token: Optional[str] = None,
                  auth=None, interceptor=None,
                  enable_builtin_services: bool = True,
@@ -132,6 +140,21 @@ class ServerOptions:
         # the server_queue_shed_ms flag) when max_concurrency is an
         # adaptive spec, OFF otherwise; a number forces it on.
         self.queue_delay_shed_ms = queue_delay_shed_ms
+        # cost-weighted limiter slots (rpc/admission.CostModel): True
+        # charges each request a weight from its size + its method's
+        # expected-latency bucket, so a 4MB streaming call draws more
+        # of the concurrency limit than a 4B echo. None/False = every
+        # request costs exactly one slot (the PR 10 behavior).
+        self.request_costs = request_costs
+        # DAGOR two-level priority admission (rpc/admission.py): when
+        # the limiter or queue-delay gate reports overload, requests
+        # below the adaptive (business, user) threshold are shed with
+        # EPRIORITYSHED before parse/handler, and the threshold rides
+        # every response back to senders. None = default ON whenever
+        # any overload organ is configured (a limiter or the queue
+        # gate) — inert until overload AND inert on uniform-priority
+        # traffic (the top-class clamp); False forces it off.
+        self.priority_admission = priority_admission
         self.auth_token = auth_token
         # pluggable Authenticator (rpc/auth.py; brpc/authenticator.h) —
         # wins over auth_token, which is sugar for TokenAuthenticator
@@ -214,6 +237,23 @@ class Server:
             # work time out in seconds (The Tail at Scale / DAGOR)
             qd = flag("server_queue_shed_ms")
         self._queue_shed_ns = int(qd * 1e6) if qd else 0
+        # DAGOR priority admission + weighted request costs (ISSUE 14).
+        # Rebuilt here so a forked shard gets fresh window/threshold
+        # state, like the limiters above. Admission defaults ON where
+        # an overload organ exists to signal it (any limiter, or the
+        # queue gate) — it stays inert until overload AND never sheds
+        # uniform-priority traffic (the top-class clamp), so servers
+        # without priority-tagged callers keep exact PR 10 behavior.
+        from brpc_tpu.rpc.admission import (AdmissionController,
+                                            CostModel, admission_enabled)
+        want_adm = o.priority_admission
+        if want_adm is None:
+            want_adm = (self._limiter is not None
+                        or bool(self._method_limiters)
+                        or self._queue_shed_ns > 0)
+        self._admission = AdmissionController() \
+            if (want_adm and admission_enabled()) else None
+        self._cost_model = CostModel(self) if o.request_costs else None
 
     def concurrency_limit(self) -> Optional[int]:
         """The server-wide limiter's current limit (None = unlimited) —
@@ -288,7 +328,9 @@ class Server:
             # by an unexpose_all() (test fixtures) — re-register here
             # like the process_* vars, so /vars keeps them for any
             # server started afterward in the process
-            from brpc_tpu.rpc.server_dispatch import nlimit_shed, nshed
+            from brpc_tpu.rpc.server_dispatch import (nlimit_shed,
+                                                      npriority_shed,
+                                                      nshed)
             from brpc_tpu.transport.socket import (_wqueue_peak_window,
                                                    npluck_defer,
                                                    npluck_fast, nreads,
@@ -303,7 +345,8 @@ class Server:
                               # /status saturation links) must survive
                               # an unexpose_all like every counter here
                               (nshed, "server_deadline_shed"),
-                              (nlimit_shed, "server_limit_shed")):
+                              (nlimit_shed, "server_limit_shed"),
+                              (npriority_shed, "server_priority_shed")):
                 var.expose(name)
             from brpc_tpu.bvar.reducer import PassiveStatus
             wq_peak = _wqueue_peak_window()
@@ -535,27 +578,45 @@ class Server:
                 reset=self.options.session_local_data_reset)
 
     # ----------------------------------------------------------- accounting
-    def on_request_start(self, method_key: Optional[str] = None) -> bool:
+    def on_request_start(self, method_key: Optional[str] = None,
+                         nbytes: int = 0, level: int = 0,
+                         level_counted: bool = False) -> float:
         """Admission gate, both dispatch paths (classic AND the turbo
         lane) plus every protocol front-end: consult the server-wide
-        limiter, then the method's (when configured). False = the
-        caller rejects with ELIMIT. Limiter locks are leaves — never
-        taken under _concurrency_lock."""
+        limiter, then the method's (when configured). Returns the
+        request's admitted COST (>= 1.0, truthy — weighted slots when
+        ``ServerOptions(request_costs=True)``, else exactly 1.0) or
+        0.0 (falsy) when the caller must reject with ELIMIT; the SAME
+        cost must ride to on_request_end so the weighted release
+        balances. ``level`` is the request's admission level — limiter
+        rejects feed it to the priority-admission controller as
+        overload evidence (``level_counted`` = the engaged dispatch
+        path already tallied it through admit_level). Limiter locks
+        are leaves — never taken under _concurrency_lock."""
+        cm = self._cost_model
+        cost = cm.request_cost(method_key, nbytes) if cm is not None \
+            else 1.0
         lim = self._limiter
-        if lim is not None and not lim.on_requested():
+        if lim is not None and not lim.on_requested(cost):
             _count_limit_shed()
-            return False
+            adm = self._admission
+            if adm is not None:
+                adm.signal_overload(level, level_counted)
+            return 0.0
         if self._method_limiters and method_key is not None:
             ml = self._method_limiters.get(method_key)
-            if ml is not None and not ml.on_requested():
+            if ml is not None and not ml.on_requested(cost):
                 if lim is not None:
                     # release the server-wide slot the gate above took
-                    lim.on_responded(0.0, True)
+                    lim.on_responded(0.0, True, cost)
                 _count_limit_shed()
-                return False
+                adm = self._admission
+                if adm is not None:
+                    adm.signal_overload(level, level_counted)
+                return 0.0
         with self._concurrency_lock:
             self.concurrency += 1
-        return True
+        return cost
 
     def account_native_batch(self, method_key: str, n: int,
                              total_us: float) -> None:
@@ -569,7 +630,8 @@ class Server:
             lr = self.method_status.setdefault(method_key, LatencyRecorder())
         lr.record_batch(total_us / n, n)
 
-    def on_request_end(self, method_key: str, latency_us: float, failed: bool):
+    def on_request_end(self, method_key: str, latency_us: float,
+                       failed: bool, cost: float = 1.0):
         with self._concurrency_lock:
             self.concurrency -= 1
             self.nprocessed += 1
@@ -577,11 +639,11 @@ class Server:
                 self.nerror += 1
         lim = self._limiter
         if lim is not None:
-            lim.on_responded(latency_us, failed)
+            lim.on_responded(latency_us, failed, cost)
         if self._method_limiters:
             ml = self._method_limiters.get(method_key)
             if ml is not None:
-                ml.on_responded(latency_us, failed)
+                ml.on_responded(latency_us, failed, cost)
         lr = self.method_status.get(method_key)
         if lr is None:
             lr = self.method_status.setdefault(method_key, LatencyRecorder())

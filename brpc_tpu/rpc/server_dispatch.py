@@ -51,20 +51,46 @@ nshed = Adder().expose("server_deadline_shed")
 # below (DAGOR-style: shed early, shed cheaply) — /vars
 nlimit_shed = Adder().expose("server_limit_shed")
 
+# requests shed with EPRIORITYSHED by the two-level priority-admission
+# threshold (rpc/admission.py): below-threshold work rejected at the
+# door while the server is in overload — /vars
+npriority_shed = Adder().expose("server_priority_shed")
 
-def _queue_delay_shed(server, arrival_ns: int) -> bool:
+
+def _queue_delay_shed(server, arrival_ns: int, level: int = 0,
+                      level_counted: bool = False) -> bool:
     """True = this request sat in the dispatch queue past the server's
     queue-delay budget and must be shed NOW (before parse/handler):
     a saturated node rejecting in microseconds beats every caller
     timing out in seconds. Counts from the frame's cut-time stamp —
-    the same arrival authority as the deadline gates."""
+    the same arrival authority as the deadline gates. A trip is an
+    overload signal for the priority-admission controller: the NEXT
+    below-threshold request sheds by class instead of by age
+    (``level_counted`` = admit_level already tallied this request)."""
     qns = server._queue_shed_ns
     if not qns or not arrival_ns:
         return False
     if time.monotonic_ns() - arrival_ns <= qns:
         return False
     nlimit_shed.add(1)
+    adm = server._admission
+    if adm is not None:
+        adm.signal_overload(level, level_counted)
     return True
+
+
+def _request_level(priority: int, auth_token: str, socket) -> int:
+    """Compose the request's admission level: business priority from
+    the wire tag, user sub-priority from the caller's cookie (auth
+    token) when present, else the connection identity (the server's
+    remote_endpoint IS the client socket's local_endpoint — the
+    shared admission.cached_socket_slot keeps both sides' hash in
+    lockstep)."""
+    from brpc_tpu.rpc.admission import (cached_socket_slot, compose_level,
+                                        user_slot)
+    slot = user_slot(auth_token) if auth_token \
+        else cached_socket_slot(socket, socket.remote_endpoint)
+    return compose_level(priority, slot)
 
 # the controller of the request THIS fiber is currently serving —
 # nested Channel.call inside a handler reads it to inherit the parent's
@@ -179,14 +205,36 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
         return
     method_key = method.full_name or \
         f"{req_meta.service_name}.{req_meta.method_name}"
-    if _queue_delay_shed(server, getattr(msg, "arrival_ns", 0)):
+    # DAGOR priority admission (before parse/interceptor/handler and
+    # before any slot): while the server is in overload, requests whose
+    # (business, user) level sits below the adaptive threshold shed
+    # with a distinct errno — a µs-cheap reject the client's reject
+    # discipline treats as neither breakage nor a retry-token spend
+    level = 0
+    counted = False
+    adm = server._admission
+    if adm is not None and adm.threshold_engaged():
+        level = _request_level(req_meta.priority, req_meta.auth_token,
+                               socket)
+        counted = True          # admit_level tallies, pass or shed
+        if not adm.admit_level(level):
+            npriority_shed.add(1)
+            _send_error(proto, socket, cid, berr.EPRIORITYSHED,
+                        "priority below admission threshold "
+                        "(server overloaded)")
+            return
+    if _queue_delay_shed(server, getattr(msg, "arrival_ns", 0), level,
+                         counted):
         # overload: this request aged past the queue-delay budget before
         # dispatch even saw it — reject before parse, interceptor,
         # handler and before taking a concurrency slot
         _send_error(proto, socket, cid, berr.ELIMIT,
                     "queue delay over shed budget (server overloaded)")
         return
-    if not server.on_request_start(method_key):
+    cost = server.on_request_start(
+        method_key, msg.payload.size + msg.attachment.size, level,
+        counted)
+    if not cost:
         _send_error(proto, socket, cid, berr.ELIMIT, "max_concurrency reached")
         return
 
@@ -203,14 +251,15 @@ async def process_request(proto, msg: RpcMessage, socket) -> None:
     _serving_cntl.set(cntl)
     try:
         await _process_request_body(proto, msg, socket, server, method,
-                                    method_key, cntl, d, t0)
+                                    method_key, cntl, d, t0, cost)
     finally:
         _serving_cntl.set(None)
 
 
 async def _process_request_body(proto, msg: RpcMessage, socket, server,
                                 method, method_key: str, cntl: Controller,
-                                d: dict, t0: int) -> None:
+                                d: dict, t0: int,
+                                cost: float = 1.0) -> None:
     meta = msg.meta
     cid = meta.correlation_id
     req_meta = meta.request
@@ -276,7 +325,7 @@ async def _process_request_body(proto, msg: RpcMessage, socket, server,
         # of computing a response nobody is waiting for (Dean & Barroso,
         # The Tail at Scale: expired work amplifies the tail)
         nshed.add(1)
-        server.on_request_end(method_key, 0, failed=True)
+        server.on_request_end(method_key, 0, failed=True, cost=cost)
         cntl.set_failed(berr.ERPCTIMEDOUT,
                         f"deadline {budget_ms}ms expired before dispatch")
         _send_error(proto, socket, cid, berr.ERPCTIMEDOUT,
@@ -340,7 +389,7 @@ async def _process_request_body(proto, msg: RpcMessage, socket, server,
         else:
             request = payload_bytes
     except Exception as e:
-        server.on_request_end(method_key, 0, failed=True)
+        server.on_request_end(method_key, 0, failed=True, cost=cost)
         cntl.set_failed(berr.EREQUEST, f"cannot parse request: {e}")
         _send_error(proto, socket, cid, berr.EREQUEST, f"cannot parse request: {e}")
         finish_span(span, cntl)  # malformed traffic must show in /rpcz
@@ -367,7 +416,8 @@ async def _process_request_body(proto, msg: RpcMessage, socket, server,
         if verdict is not None:
             code, reason = verdict
             latency_us = (time.monotonic_ns() - t0) / 1e3
-            server.on_request_end(method_key, latency_us, failed=True)
+            server.on_request_end(method_key, latency_us, failed=True,
+                                  cost=cost)
             if cap_rec is not None:   # rejected sessions are corpus too
                 _cap.global_recorder().record_complete(cap_rec, code,
                                                    latency_us)
@@ -444,7 +494,8 @@ async def _process_request_body(proto, msg: RpcMessage, socket, server,
             cntl._session_local = None
 
     latency_us = (time.monotonic_ns() - t0) / 1e3
-    server.on_request_end(method_key, latency_us, failed=cntl.failed())
+    server.on_request_end(method_key, latency_us, failed=cntl.failed(),
+                          cost=cost)
     if cap_rec is not None:
         # the record carries its verdict: status + latency ride to disk
         # on the recorder's writer thread, never this dispatch fiber
@@ -531,10 +582,16 @@ def make_fast_drain(server):
 
     def fast_drain(sock) -> bool:
         tgt = server._native_echo
+        adm = server._admission
         if tgt is None or not _server_turbo_ok(server) \
                 or flag("rpcz_enabled") or capture_active() \
+                or (adm is not None and adm.threshold_engaged()) \
                 or sock.input_portal or sock.input_need \
                 or sock.user_data.get("_cut_forward") is not None:
+            # (the admission clause: the all-C echo loop serves without
+            # crossing the interpreter, so it can neither judge levels
+            # nor piggyback the threshold — while the server is
+            # shedding by priority it stands down, like capture)
             return False
         pfd = getattr(sock.conn, "pluck_fd", None)
         if pfd is not None:
@@ -634,7 +691,7 @@ def _server_turbo_ok(server) -> bool:
 async def _drive_fast(proto, socket, server, method, method_key: str,
                       cid: int, service: str, method_name: str,
                       log_id: int, payload: bytes, att: bytes,
-                      arrival_ns: int = 0) -> None:
+                      arrival_ns: int = 0, cost: float = 1.0) -> None:
     """The turbo request body: Controller setup, handler, response —
     the classic process_request minus every branch the scan_frames
     eligibility rules already guarantee can't apply (no auth, no
@@ -644,12 +701,12 @@ async def _drive_fast(proto, socket, server, method, method_key: str,
     if not _track_pending(socket):
         await _drive_fast_inner(proto, socket, server, method, method_key,
                                 cid, service, method_name, log_id, payload,
-                                att, arrival_ns)
+                                att, arrival_ns, cost)
         return
     try:
         await _drive_fast_inner(proto, socket, server, method, method_key,
                                 cid, service, method_name, log_id, payload,
-                                att, arrival_ns)
+                                att, arrival_ns, cost)
     finally:
         # THE single settle of process_request_fast's claim — exactly
         # once, on success and on every escape path alike
@@ -659,7 +716,8 @@ async def _drive_fast(proto, socket, server, method, method_key: str,
 async def _drive_fast_inner(proto, socket, server, method, method_key: str,
                             cid: int, service: str, method_name: str,
                             log_id: int, payload: bytes, att: bytes,
-                            arrival_ns: int = 0) -> None:
+                            arrival_ns: int = 0,
+                            cost: float = 1.0) -> None:
     t0 = time.monotonic_ns()
     cntl = Controller()
     d = cntl.__dict__
@@ -693,7 +751,7 @@ async def _drive_fast_inner(proto, socket, server, method, method_key: str,
             request = method.request_class()
             request.ParseFromString(payload)
         except Exception as e:
-            server.on_request_end(method_key, 0, failed=True)
+            server.on_request_end(method_key, 0, failed=True, cost=cost)
             if cap_rec is not None:
                 _cap.global_recorder().record_complete(
                     cap_rec, berr.EREQUEST,
@@ -711,7 +769,7 @@ async def _drive_fast_inner(proto, socket, server, method, method_key: str,
             # the turbo lane's post-hop queue-delay gate (mirrors the
             # classic path): this request aged behind busy workers
             # past the shed budget — reject before the handler runs
-            server.on_request_end(method_key, 0, failed=True)
+            server.on_request_end(method_key, 0, failed=True, cost=cost)
             if cap_rec is not None:
                 _cap.global_recorder().record_complete(
                     cap_rec, berr.ELIMIT,
@@ -728,7 +786,8 @@ async def _drive_fast_inner(proto, socket, server, method, method_key: str,
     except Exception as e:
         cntl.set_failed(berr.EINTERNAL, f"{type(e).__name__}: {e}")
     latency_us = (time.monotonic_ns() - t0) / 1e3
-    server.on_request_end(method_key, latency_us, failed=cntl.failed())
+    server.on_request_end(method_key, latency_us, failed=cntl.failed(),
+                          cost=cost)
     if cap_rec is not None:
         _cap.global_recorder().record_complete(cap_rec, cntl.error_code,
                                            latency_us)
@@ -774,14 +833,33 @@ def process_request_fast(proto, socket, server, cid: int, service: str,
                     f"unknown {service}.{method_name}")
         return None
     method_key = method.full_name or f"{service}.{method_name}"
-    if _queue_delay_shed(server, arrival_ns):
+    # priority admission, turbo flavor: scan-lane requests carry no
+    # priority/auth BY CONSTRUCTION (the C walker defers those metas
+    # to the classic lane), so the level is business class 0 + the
+    # connection's user slot — below-threshold conns shed here exactly
+    # like the classic path (the gate discipline must not depend on
+    # which dispatch lane a burst landed in)
+    level = 0
+    counted = False
+    adm = server._admission
+    if adm is not None and adm.threshold_engaged():
+        level = _request_level(0, "", socket)
+        counted = True          # admit_level tallies, pass or shed
+        if not adm.admit_level(level):
+            npriority_shed.add(1)
+            _send_error(proto, socket, cid, berr.EPRIORITYSHED,
+                        "priority below admission threshold "
+                        "(server overloaded)")
+            return None
+    if _queue_delay_shed(server, arrival_ns, level, counted):
         # the turbo lane sheds through the same queue-delay gate as the
-        # classic path: the limiter/gate discipline must not depend on
-        # which dispatch lane a burst landed in
+        # classic path
         _send_error(proto, socket, cid, berr.ELIMIT,
                     "queue delay over shed budget (server overloaded)")
         return None
-    if not server.on_request_start(method_key):
+    cost = server.on_request_start(method_key, len(payload) + len(att),
+                                   level, counted)
+    if not cost:
         _send_error(proto, socket, cid, berr.ELIMIT,
                     "max_concurrency reached")
         return None
@@ -796,7 +874,7 @@ def process_request_fast(proto, socket, server, cid: int, service: str,
     # the fiber name alone — the slim path never pays a fiber-local set
     coro = _drive_fast(proto, socket, server, method, method_key, cid,
                        service, method_name, log_id, payload, att,
-                       arrival_ns)
+                       arrival_ns, cost)
     if not method.is_coroutine and not is_last:
         # the classic loop's fan-out discipline (QueueMessage,
         # input_messenger.cpp:183): a blocking handler for a non-last
@@ -823,12 +901,26 @@ def _send_response(proto, socket, cid: int, cntl: Controller,
     if span is not None:
         from brpc_tpu.rpc.span import expect_flush, mark_flushed
         on_done = lambda err, s=span: mark_flushed(s, err)  # noqa: E731
+    # DAGOR threshold piggyback: while this server is shedding by
+    # priority, the current admission threshold rides EVERY response
+    # (success and shed alike) so senders can fail doomed traffic fast
+    # at the source. Calm servers (threshold 0) pay two lookups and
+    # keep the wire byte-identical — the field stays absent, and
+    # responses stay eligible for the client's native scan lane
+    # (which defers unknown response-meta fields to the classic parse,
+    # exactly when the threshold needs full semantics).
+    adm_thr = 0
+    srv = socket.user_data.get("server")
+    if srv is not None:
+        adm = srv._admission
+        if adm is not None:
+            adm_thr = adm.wire_threshold()
     # small-call fast path: a successful tpu_std-framed response with no
     # stream/device/progressive sections needs only correlation_id (+
     # attachment_size) in its meta — hand-encoded varints over a single
     # bytes frame, no pb object, no IOBuf
     att = cntl.__dict__.get("response_attachment")
-    if (not cntl.failed() and cntl.compress_type == 0
+    if (not adm_thr and not cntl.failed() and cntl.compress_type == 0
             and getattr(cntl, "_accepted_stream", None) is None
             and not cntl.__dict__.get("response_device_arrays")
             and type(proto).frame is TpuStdProtocol.frame):
@@ -852,6 +944,8 @@ def _send_response(proto, socket, cid: int, cntl: Controller,
     meta.correlation_id = cid
     meta.response.error_code = cntl.error_code
     meta.response.error_text = cntl.error_text
+    if adm_thr:
+        meta.response.admission_threshold = adm_thr
     accepted = getattr(cntl, "_accepted_stream", None)
     if accepted is not None:
         meta.stream_settings.stream_id = accepted.id

@@ -167,10 +167,12 @@ def _merge_stat_dict(dicts: List[dict]) -> dict:
                 out[k] = out.get(k, 0) + v
             elif "max" in k or "peak" in k:
                 out[k] = max(out.get(k, v), v)
-            elif k.endswith("limit"):
+            elif k.endswith("limit") or k.endswith("threshold"):
                 # a shard group's capacity headroom is its biggest
                 # shard limit, not the sum (concurrency_limit et al;
-                # limit_shed stays a summed counter below)
+                # limit_shed stays a summed counter below); the DAGOR
+                # admission_threshold follows the same tightest-gate
+                # rule
                 out[k] = max(out.get(k, v), v)
             elif "tokens" in k:
                 # retry budgets drain independently: the group's
@@ -201,7 +203,9 @@ def merge_var_values(values: list, name: str = ""):
     nums = [v for v in values
             if isinstance(v, (int, float)) and not isinstance(v, bool)]
     if nums and len(nums) == len(values):
-        if name.endswith("limit"):
+        if name.endswith("limit") or name.endswith("threshold"):
+            # capacity limits AND the DAGOR admission threshold: the
+            # group's headline is its tightest gate, not a sum
             return max(nums)
         if "tokens" in name:
             # -1 is the "no budget configured" sentinel

@@ -133,16 +133,18 @@ class TestSeededViolations:
         # ConstantLimiter's whole on_responded (anchored by the
         # @property that follows it, so the AutoLimiter method with the
         # same first lines cannot match)
-        needle = ("    def on_responded(self, latency_us, failed):\n"
+        needle = ("    def on_responded(self, latency_us, failed,"
+                  " cost: float = 1.0):\n"
                   "        with self._lock:\n"
-                  "            if self._inflight > 0:\n"
-                  "                self._inflight -= 1\n"
+                  "            self._inflight = max(0.0,"
+                  " self._inflight - cost)\n"
                   "\n"
                   "    @property\n")
         assert needle in src, "ConstantLimiter.on_responded shape moved"
         mutated = src.replace(
             needle,
-            "    def on_responded(self, latency_us, failed):\n"
+            "    def on_responded(self, latency_us, failed,"
+            " cost: float = 1.0):\n"
             "        raise NotImplementedError\n"
             "\n"
             "    @property\n", 1)
@@ -1056,11 +1058,14 @@ class TestTrafficCaptureLint:
         assert "Recorder._lock" in names
         # trailing leaf block: nothing this codebase ranks may nest
         # inside the recorder lock — only the ISSUE-13 sampler-tick
-        # leaves (series rings, anomaly watchdog) rank below it, and
-        # those are leaves themselves
+        # leaves (series rings, anomaly watchdog) and the ISSUE-14
+        # admission leaves rank below it, and those are leaves
+        # themselves
         below = names[names.index("Recorder._lock") + 1:]
         assert below == ["SeriesCollector._lock",
-                         "AnomalyWatchdog._lock"], below
+                         "AnomalyWatchdog._lock",
+                         "AdmissionController._lock",
+                         "retry_policy:_group_lock"], below
 
 
 class TestDeviceObsLint:
@@ -1265,22 +1270,27 @@ class TestTimelineLint:
         assert all(c == 1 for c in counts.values()), counts
 
     def test_series_locks_ranked_as_trailing_leaves(self):
-        """SeriesCollector._lock and AnomalyWatchdog._lock are the
-        declared trailing leaves of LOCK_ORDER (docs table rows
-        36-37): settled on the sampler tick thread, never wrapping
-        another acquisition — and the lock model must DISCOVER both
-        (a silent rename would un-rank them without failing)."""
+        """SeriesCollector._lock and AnomalyWatchdog._lock lead the
+        trailing leaf block of LOCK_ORDER (docs table rows 36-39,
+        closed by the ISSUE-14 admission leaves): settled on the
+        sampler tick thread, never wrapping another acquisition — and
+        the lock model must DISCOVER both (a silent rename would
+        un-rank them without failing)."""
         from brpc_tpu.analysis.core import Context, iter_source_files
         from brpc_tpu.analysis.lockmodel import get_lock_model
         from brpc_tpu.analysis.racelane import LOCK_ORDER
         names = [n for n, _ in LOCK_ORDER]
-        assert names[-2:] == ["SeriesCollector._lock",
-                              "AnomalyWatchdog._lock"]
+        assert names[-4:] == ["SeriesCollector._lock",
+                              "AnomalyWatchdog._lock",
+                              "AdmissionController._lock",
+                              "retry_policy:_group_lock"]
         m = get_lock_model(Context(iter_source_files(
             [os.path.join(REPO_ROOT, "brpc_tpu")])))
         assert "SeriesCollector._lock" in m.locks
         assert "AnomalyWatchdog._lock" in m.locks
-        # leaves: neither may be the HELD side of any lock-graph edge
+        assert "AdmissionController._lock" in m.locks
+        # leaves: none may be the HELD side of any lock-graph edge
         for a, _b in m.edges:
             assert a not in ("SeriesCollector._lock",
-                             "AnomalyWatchdog._lock"), m.edges
+                             "AnomalyWatchdog._lock",
+                             "AdmissionController._lock"), m.edges

@@ -589,3 +589,638 @@ class TestSurfacedState:
         assert merged["inflight"] == 4               # inflight: sum
         assert merged["retry_tokens"] == 4.0         # tokens: min
         assert merged["limit_shed"] == 7             # counters: sum
+
+
+# ------------------------------------------- ISSUE 14: DAGOR admission
+
+
+class TestWeightedLimiterSlots:
+    def test_weighted_inflight_sums(self):
+        lim = ConstantLimiter(4)
+        assert lim.on_requested(3.0)
+        assert lim.inflight == 3.0
+        # boundary overshoot is allowed (weighted-semaphore semantics:
+        # a heavy request can never be starved by lighter traffic) but
+        # everything behind it then waits for the weighted release
+        assert lim.on_requested(3.0)
+        assert not lim.on_requested(1.0)
+        lim.on_responded(100.0, False, 3.0)
+        lim.on_responded(100.0, False, 3.0)
+        assert lim.inflight == 0.0
+
+    def test_heavy_request_shrinks_effective_slots(self):
+        lim = ConstantLimiter(4)
+        # one cost-4 request consumes the whole 4-limit that four
+        # cost-1 requests used to share
+        assert lim.on_requested(4.0)
+        assert not lim.on_requested(1.0)
+        lim.on_responded(0.0, True, 4.0)
+        for _ in range(4):
+            assert lim.on_requested(1.0)
+        assert not lim.on_requested(1.0)
+
+    def test_release_never_goes_negative(self):
+        lim = ConstantLimiter(4)
+        lim.on_responded(0.0, True, 5.0)
+        assert lim.inflight == 0.0
+        assert lim.on_requested(1.0)
+
+    def test_auto_and_timeout_limiters_weighted(self):
+        lim = AutoLimiter(initial=8)
+        assert lim.on_requested(6.0)
+        assert lim.on_requested(2.0)
+        assert not lim.on_requested(1.0)       # weighted inflight 8 >= 8
+        lim.on_responded(100.0, False, 6.0)
+        lim.on_responded(100.0, False, 2.0)
+        assert lim.inflight == 0.0
+        tl = TimeoutLimiter(timeout_ms=100)
+        tl._ema_us = 10_000.0                  # 10ms per unit of work
+        tl._inflight = float(tl.MIN_LIMIT)
+        # cost 9 behind MIN_LIMIT weighted others: (inflight+9)*10ms
+        # overshoots the 100ms budget, cost 1 fits exactly
+        assert not tl.on_requested(9.0)
+        assert tl.on_requested(1.0)
+
+
+class TestCostModel:
+    def _server(self):
+        from brpc_tpu.rpc.admission import CostModel
+        s = Server(ServerOptions(enable_builtin_services=False,
+                                 max_concurrency=64,
+                                 request_costs=True))
+        assert isinstance(s._cost_model, CostModel)
+        return s
+
+    def test_bytes_term_and_cap(self):
+        s = self._server()
+        cm = s._cost_model
+        assert cm.request_cost("Svc.M", 16) == 1.0       # the PR 10 slot
+        assert cm.request_cost("Svc.M", 128 * 1024) == \
+            pytest.approx(3.0)                           # +1 per 64KB
+        assert cm.request_cost("Svc.M", 1 << 30) == cm.MAX_COST
+
+    def test_latency_bucket_from_method_reservoir(self):
+        from brpc_tpu.bvar.latency_recorder import LatencyRecorder
+        s = self._server()
+        cm = s._cost_model
+        lr = s.method_status.setdefault("Svc.Slow", LatencyRecorder())
+        for _ in range(64):
+            lr.record(50_000.0)                # p50 50ms -> weight 3
+        cm._next_refresh = 0.0                 # force the 1s refresh
+        assert cm.request_cost("Svc.Slow", 0) == pytest.approx(4.0)
+        assert cm.request_cost("Svc.Fast", 0) == 1.0
+
+    def test_server_threads_cost_through_accounting(self):
+        from brpc_tpu.bvar.latency_recorder import LatencyRecorder
+        s = self._server()
+        lr = s.method_status.setdefault("Svc.Slow", LatencyRecorder())
+        for _ in range(64):
+            lr.record(50_000.0)
+        s._cost_model._next_refresh = 0.0
+        cost = s.on_request_start("Svc.Slow", 128 * 1024)
+        assert cost == pytest.approx(6.0)      # 1 + 3 latency + 2 bytes
+        assert s._limiter.inflight == pytest.approx(6.0)
+        s.on_request_end("Svc.Slow", 100.0, False, cost)
+        assert s._limiter.inflight == 0.0
+
+
+class TestAdmissionControllerUnit:
+    def test_levels_and_user_slots(self):
+        from brpc_tpu.rpc.admission import (USER_SLOTS, compose_level,
+                                            user_slot)
+        assert user_slot("") == 0 and user_slot(None) == 0
+        s = user_slot("cookie-a")
+        assert 0 <= s < USER_SLOTS
+        assert user_slot("cookie-a") == s          # stable across calls
+        assert user_slot(b"cookie-a") == s         # bytes == str form
+        assert compose_level(2, 3) == (2 << 7) | 3
+        assert compose_level(-5, 3) == 3           # clamped at class 0
+        assert compose_level(1000, 0) == 127 << 7  # clamped at class max
+
+    def test_signal_overload_counted_skips_double_tally(self):
+        # a request the engaged dispatch path already tallied through
+        # admit_level must not enter the window histogram a second
+        # time when the limiter then rejects it — double-weighting
+        # rejected levels halves the over/total adaptation ratio
+        # exactly in deep overload
+        from brpc_tpu.rpc.admission import AdmissionController
+        adm = AdmissionController(window_s=3600.0)
+        adm.signal_overload(5)                 # fresh evidence tallies
+        assert adm._win_total == 1 and adm._win_over == 1
+        assert adm.admit_level(5)              # engaged path tallies
+        assert adm._win_total == 2
+        adm.signal_overload(5, counted=True)   # limiter reject, same req
+        assert adm._win_total == 2 and adm._win_over == 2
+
+    def test_threshold_rises_under_overload_then_relaxes(self):
+        from brpc_tpu.rpc.admission import AdmissionController
+        adm = AdmissionController(window_s=0.01)
+        assert not adm.threshold_engaged()     # calm fast path: nothing
+        hi = 5 << 7
+        for _ in range(4):                     # windows of evidence
+            for i in range(60):
+                adm.signal_overload(hi if i % 2 else 0)
+            time.sleep(0.015)
+            adm.signal_overload(hi)
+        assert adm.threshold_engaged()
+        thr = adm.wire_threshold()
+        assert 0 < thr <= hi
+        assert adm.admit_level(hi)             # the top class always in
+        assert not adm.admit_level(0)          # below threshold: shed
+        snap = adm.admission_snapshot()
+        assert snap["priority_sheds"] >= 1 and snap["armed"]
+        # calm windows (admits only, no overload signals) relax to 0
+        deadline = time.monotonic() + 5.0
+        while adm.wire_threshold() and time.monotonic() < deadline:
+            adm.admit_level(hi)
+            time.sleep(0.012)
+        assert adm.wire_threshold() == 0
+        assert not adm.threshold_engaged()     # disarmed: fast path back
+
+    def test_uniform_priority_traffic_is_never_shed(self):
+        # the top-class clamp: with ONE business class in the window
+        # (whatever its user sub-priorities), the threshold stays at
+        # that class's floor or below — untagged PR 10 traffic keeps
+        # its exact behavior, tagged-but-uniform traffic too
+        from brpc_tpu.rpc.admission import AdmissionController
+        for base in (0, 5 << 7):
+            adm = AdmissionController(window_s=0.01)
+            for _ in range(4):
+                for i in range(60):
+                    adm.signal_overload(base + (i % 128))
+                time.sleep(0.015)
+                adm.signal_overload(base)
+            assert adm.wire_threshold() <= base
+            assert adm.admit_level(base)
+
+    def test_histogram_is_bounded(self):
+        from brpc_tpu.rpc.admission import AdmissionController
+        adm = AdmissionController(window_s=3600.0)
+        adm.signal_overload(0)
+        for lvl in range(3 * adm.HIST_CAP):
+            adm.admit_level(lvl)
+        assert len(adm._hist) <= adm.HIST_CAP
+
+
+class TestPrioritySheddRejectDiscipline:
+    def test_errno_classification(self):
+        import brpc_tpu.rpc.backend_stats as _bs
+        from brpc_tpu.rpc.channel import _NO_DRAIN_CODES
+        from brpc_tpu.rpc.retry_policy import RpcRetryPolicy
+        # a priority shed cost the server microseconds at the door:
+        # reject (no LALB penalty, no breaker), no retry-token drain,
+        # retry-elsewhere allowed (thresholds are per-node)
+        assert berr.EPRIORITYSHED in _bs.REJECT_CODES
+        assert _bs.is_reject(berr.EPRIORITYSHED)
+        assert berr.EPRIORITYSHED in _NO_DRAIN_CODES
+        assert berr.EPRIORITYSHED in RpcRetryPolicy.RETRYABLE
+
+    def test_backend_cell_classes_shed_as_reject(self):
+        import brpc_tpu.rpc.backend_stats as _bs
+        cell = _bs.BackendCell()
+        cell.on_start(0)
+        cell.on_reject(berr.EPRIORITYSHED)
+        assert cell.rejects == 1
+        assert cell.errors.get("EPRIORITYSHED") == 1
+        assert cell.attempts == cell.completed == 1    # balance kept
+        assert cell.ewma_us == 0.0      # a µs shed must not look FAST
+
+
+class TestPriorityAdmissionE2E:
+    def _mixed_flood(self, ch, n, spacing_s=0.004):
+        from brpc_tpu.rpc.controller import Controller
+        done = threading.Event()
+        out = []
+        lock = threading.Lock()
+
+        def _done(c):
+            with lock:
+                out.append(c)
+                if len(out) >= n:
+                    done.set()
+
+        for i in range(n):
+            c = Controller()
+            c.timeout_ms = 10_000
+            c.max_retry = 0
+            c.request_priority = 5 if i % 2 == 0 else 1
+            ch.call("Load", "Slow", b"x", cntl=c, done=_done)
+            time.sleep(spacing_s)
+        assert done.wait(60), f"stalled: {len(out)}/{n}"
+        return out
+
+    def test_overload_sheds_low_class_and_piggybacks_threshold(self):
+        from brpc_tpu.rpc.channel import nclient_priority_shed
+        from brpc_tpu.rpc.server_dispatch import npriority_shed
+
+        async def Slow(cntl, request):
+            await fiber.sleep(0.05)
+            return request
+
+        server, ep = _make_server({"Slow": Slow},
+                                  max_concurrency="constant:2")
+        assert server._admission is not None    # defaults ON with organ
+        server._admission.WINDOW_S = 0.1        # fast windows for test
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(timeout_ms=10_000, max_retry=0,
+                                    share_connections=False))
+        srv_before = npriority_shed.get_value()
+        cli_before = nclient_priority_shed.get_value()
+        try:
+            out = self._mixed_flood(ch, 120)
+            by = {}
+            for c in out:
+                by.setdefault((c.request_priority, c.error_code), 0)
+                by[(c.request_priority, c.error_code)] += 1
+            # the top class is NEVER priority-shed (threshold clamp);
+            # the low class sheds with the distinct errno
+            assert by.get((5, berr.EPRIORITYSHED), 0) == 0, by
+            lo_shed = by.get((1, berr.EPRIORITYSHED), 0)
+            assert lo_shed > 0, by
+            assert npriority_shed.get_value() > srv_before
+            # the threshold rode responses back: the client cached it
+            # and failed part of the doomed flow locally
+            assert ch._adm_cache, "no threshold was piggybacked"
+            assert nclient_priority_shed.get_value() > cli_before
+            client_sheds = [c for c in out
+                            if c.error_code == berr.EPRIORITYSHED
+                            and "client-side" in c.error_text]
+            assert client_sheds, "no doomed send failed fast locally"
+            # calm traffic relaxes the threshold and clears the cache
+            # (probe-through lets the relaxing threshold be observed)
+            deadline = time.monotonic() + 15.0
+            while (server._admission.wire_threshold()
+                   or ch._adm_cache) and time.monotonic() < deadline:
+                ch.call_sync("Load", "Slow", b"probe")
+                time.sleep(0.05)
+            assert server._admission.wire_threshold() == 0
+            assert not ch._adm_cache
+            c = ch.call_sync("Load", "Slow", b"after")
+            assert not c.failed(), c.error_text
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+    def test_client_fail_fast_and_probe_through(self):
+        import brpc_tpu.rpc.backend_stats as _bs
+        from brpc_tpu.rpc.channel import ADM_THRESHOLD_TTL_S
+
+        def Echo(cntl, request):
+            return bytes(request)
+
+        server, ep = _make_server({"Echo": Echo})
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(timeout_ms=4000, max_retry=0,
+                                    share_connections=False))
+        try:
+            c = ch.call_sync("Load", "Echo", b"warm")
+            assert not c.failed(), c.error_text
+            key = (_bs.ep_key(ch._socket.remote_endpoint), "Load")
+            now = time.monotonic()
+            # stuff the cache as if a huge threshold rode a response;
+            # probe stamp = now, so the window hasn't come around
+            ch._adm_cache[key] = [1 << 20, now, now]
+            before = server.nprocessed
+            c = ch.call_sync("Load", "Echo", b"doomed")
+            assert c.error_code == berr.EPRIORITYSHED
+            assert "client-side" in c.error_text
+            assert server.nprocessed == before     # never hit the wire
+            # probe-through: age the probe stamp — one send flows, and
+            # the calm server's response CLEARS the cached entry
+            ch._adm_cache[key][2] = now - 10.0
+            c = ch.call_sync("Load", "Echo", b"probe")
+            assert not c.failed(), c.error_text
+            assert key not in ch._adm_cache
+            # TTL: a stale entry expires instead of dooming forever
+            ch._adm_cache[key] = [1 << 20,
+                                  now - ADM_THRESHOLD_TTL_S - 1.0, now]
+            c = ch.call_sync("Load", "Echo", b"expired")
+            assert not c.failed(), c.error_text
+            assert key not in ch._adm_cache
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+    def test_connection_death_drops_cached_threshold(self):
+        import brpc_tpu.rpc.backend_stats as _bs
+
+        def Echo(cntl, request):
+            return bytes(request)
+
+        server, ep = _make_server({"Echo": Echo})
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(timeout_ms=1000, max_retry=0,
+                                    share_connections=False))
+        try:
+            c = ch.call_sync("Load", "Echo", b"warm")
+            assert not c.failed(), c.error_text
+            key = (_bs.ep_key(ch._socket.remote_endpoint), "Load")
+            now = time.monotonic()
+            # aged probe stamp: the next doomed send probes through —
+            # onto a backend that is GONE
+            ch._adm_cache[key] = [1 << 20, now, now - 10.0]
+            server.stop()
+            server.join(2)
+            c = ch.call_sync("Load", "Echo", b"dead")
+            assert c.failed()
+            assert c.error_code != berr.EPRIORITYSHED, c.error_text
+            # the broken connection dropped the backend's entries: a
+            # respawned process must not be doomed-shed against its
+            # predecessor's threshold for up to a TTL (the fabric
+            # storm's recover tail)
+            assert key not in ch._adm_cache, ch._adm_cache
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+    def test_doomed_retry_loop_is_bounded_and_drains_no_tokens(self):
+        import brpc_tpu.rpc.backend_stats as _bs
+
+        def Echo(cntl, request):
+            return bytes(request)
+
+        server, ep = _make_server({"Echo": Echo})
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(timeout_ms=4000, max_retry=2,
+                                    retry_budget=True,
+                                    share_connections=False))
+        try:
+            c = ch.call_sync("Load", "Echo", b"warm")
+            assert not c.failed()
+            tokens_before = ch._retry_budget.tokens()
+            key = (_bs.ep_key(ch._socket.remote_endpoint), "Load")
+            now = time.monotonic()
+            ch._adm_cache[key] = [1 << 20, now, now + 3600.0]
+            c = ch.call_sync("Load", "Echo", b"doomed")
+            # every retry re-picked the same doomed backend and failed
+            # fast locally: bounded by max_retry, microseconds apiece
+            assert c.error_code == berr.EPRIORITYSHED
+            assert c.current_try == 2
+            assert c.__dict__.get("_adm_local_sheds") == 3
+            # reject discipline: none of it drained the token bucket
+            assert ch._retry_budget.tokens() == tokens_before
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+
+class TestPriorityInheritance:
+    def test_nested_call_inherits_and_override_wins(self):
+        observed = {}
+
+        def Echo(cntl, request):
+            observed.setdefault("prio", []).append(cntl.request_priority)
+            return bytes(request)
+
+        backend, bep = _make_server({"Echo": Echo})
+        baddr = f"tcp://{bep.host}:{bep.port}"
+
+        async def Fan(cntl, request):
+            from brpc_tpu.rpc.controller import Controller
+            ch = Channel(baddr, ChannelOptions(timeout_ms=5000))
+            nc = ch.call("Load", "Echo", b"inherit")
+            await nc.join_async(5)
+            observed["inherit_ok"] = not nc.failed()
+            # explicit override: the caller's own class wins
+            c2 = Controller()
+            c2.request_priority = 3
+            nc2 = ch.call("Load", "Echo", b"override", cntl=c2)
+            await nc2.join_async(5)
+            observed["override_ok"] = not nc2.failed()
+            ch.close()
+            return b"done"
+
+        front, fep = _make_server({"Fan": Fan})
+        try:
+            from brpc_tpu.rpc.controller import Controller
+            ch = Channel(f"tcp://{fep.host}:{fep.port}",
+                         ChannelOptions(timeout_ms=5000))
+            c = Controller()
+            c.request_priority = 7
+            c.timeout_ms = 5000
+            nc = ch.call("Load", "Fan", b"", cntl=c)
+            nc.join(5)
+            assert not nc.failed(), nc.error_text
+            assert observed["inherit_ok"] and observed["override_ok"]
+            # the chain's class survived the hop; the override didn't
+            assert observed["prio"] == [7, 3], observed
+            ch.close()
+        finally:
+            front.stop()
+            backend.stop()
+
+    def test_reused_controller_resets_priority_and_shed_count(self):
+        from brpc_tpu.rpc.controller import Controller
+        c = Controller()
+        c.request_priority = 9
+        c.__dict__["_adm_local_sheds"] = 3
+        c._reset_for_call()
+        assert c.request_priority == 0
+        assert "_adm_local_sheds" not in c.__dict__
+
+
+class TestBudgetGroups:
+    def test_channels_in_a_group_share_one_bucket(self):
+        from brpc_tpu.rpc.retry_policy import (RetryBudget,
+                                               budget_group_snapshot,
+                                               shared_retry_budget)
+        g = f"cluster-a-{time.monotonic_ns()}"
+        ch1 = Channel("tcp://127.0.0.1:1",
+                      ChannelOptions(budget_group=g,
+                                     retry_budget=RetryBudget(
+                                         max_tokens=4, token_ratio=0.5),
+                                     share_connections=False))
+        # the second member carries a DIFFERENT sizing — first wins,
+        # later channels join the existing bucket (one cluster, one
+        # idea of how much retry fuel it can absorb)
+        ch2 = Channel("tcp://127.0.0.1:1",
+                      ChannelOptions(budget_group=g,
+                                     retry_budget=RetryBudget(
+                                         max_tokens=100),
+                                     share_connections=False))
+        try:
+            assert ch1._retry_budget is ch2._retry_budget
+            assert ch1._retry_budget.snapshot()["max_tokens"] == 4
+            assert shared_retry_budget(g) is ch1._retry_budget
+            # a drain through ONE member throttles the whole group —
+            # the PR 10 "N channels, N buckets of fuel" hole is closed
+            ch1._retry_budget.drain()
+            ch1._retry_budget.drain()
+            assert ch2._retry_budget.throttled()
+            snap = budget_group_snapshot()
+            assert snap[g]["throttled"] is True
+        finally:
+            ch1.close()
+            ch2.close()
+
+    def test_groupless_channels_keep_private_buckets(self):
+        ch1 = Channel("tcp://127.0.0.1:1",
+                      ChannelOptions(retry_budget=True,
+                                     share_connections=False))
+        ch2 = Channel("tcp://127.0.0.1:1",
+                      ChannelOptions(retry_budget=True,
+                                     share_connections=False))
+        try:
+            assert ch1._retry_budget is not ch2._retry_budget
+        finally:
+            ch1.close()
+            ch2.close()
+
+    def test_throttled_group_suppresses_other_members_retries(self):
+        from brpc_tpu.rpc.channel import nretry_throttled
+        from brpc_tpu.rpc.retry_policy import RetryBudget
+        g = f"cluster-b-{time.monotonic_ns()}"
+        opts = dict(timeout_ms=1500, max_retry=4,
+                    share_connections=False, budget_group=g)
+        ch1 = Channel("tcp://127.0.0.1:1",      # nothing listens here
+                      ChannelOptions(retry_budget=RetryBudget(
+                          max_tokens=2, token_ratio=0.5), **opts))
+        ch2 = Channel("tcp://127.0.0.1:1",
+                      ChannelOptions(**opts))
+        before = nretry_throttled.get_value()
+        try:
+            # ch1's failures drain the SHARED bucket to the floor
+            for _ in range(4):
+                ch1.call_sync("Load", "Echo", b"x")
+            assert ch1._retry_budget.throttled()
+            # ch2's retries are now suppressed by the group bucket
+            c = ch2.call_sync("Load", "Echo", b"x")
+            assert c.failed()
+            assert c.current_try < 4
+            assert nretry_throttled.get_value() > before
+        finally:
+            ch1.close()
+            ch2.close()
+
+
+class TestMixedPriorityStormGoodput:
+    def test_corpus_fed_storm_orders_goodput_by_class(self):
+        # scaled-down in-process cousin of the fabric press gate: a
+        # synthetic mixed-priority corpus floods one throttled server
+        # at well over capacity; per-class goodput must order by class
+        # and the top class must never be priority-shed
+        from brpc_tpu.rpc.controller import Controller
+        from brpc_tpu.traffic.replay import parse_mix, synthesize_records
+
+        recs = synthesize_records(
+            240, parse_mix("16:0.7,512:0.3"),
+            parse_mix("1:0.5,5:0.3,9:0.2"), qps=800.0, mode="poisson",
+            seed=11, service="Load", method="Slow")
+
+        async def Slow(cntl, request):
+            await fiber.sleep(0.04)
+            return b"ok"
+
+        server, ep = _make_server({"Slow": Slow},
+                                  max_concurrency="constant:2")
+        server._admission.WINDOW_S = 0.1
+        ch = Channel(f"tcp://{ep.host}:{ep.port}",
+                     ChannelOptions(timeout_ms=20_000, max_retry=0,
+                                    share_connections=False))
+        done = threading.Event()
+        out = []
+        lock = threading.Lock()
+
+        def _done(c, prio):
+            with lock:
+                out.append((prio, c.error_code))
+                if len(out) >= len(recs):
+                    done.set()
+
+        try:
+            for rec in recs:
+                c = Controller()
+                c.timeout_ms = 20_000
+                c.max_retry = 0
+                c.request_priority = rec.priority
+                ch.call("Load", "Slow", rec.payload, cntl=c,
+                        done=lambda cc, p=rec.priority: _done(cc, p))
+                time.sleep(0.003)
+            assert done.wait(90), f"stalled: {len(out)}/{len(recs)}"
+            by: dict = {}
+            sheds: dict = {}
+            for prio, code in out:
+                row = by.setdefault(prio, [0, 0])
+                row[0 if code == 0 else 1] += 1
+                if code == berr.EPRIORITYSHED:
+                    sheds[prio] = sheds.get(prio, 0) + 1
+            rates = {p: row[0] / (row[0] + row[1])
+                     for p, row in by.items()}
+            # the admission loop engaged and the top class kept its
+            # goodput lead; lower classes shed increasingly below it
+            assert sum(sheds.values()) > 0, by
+            assert sheds.get(9, 0) == 0, sheds     # clamp: top never
+            assert rates[9] >= rates[5] - 0.05, rates
+            assert rates[5] >= rates[1] - 0.05, rates
+            assert rates[9] > rates[1], rates
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+
+# --------------------------------------------- ISSUE 14 discipline pins
+
+
+class TestAdmissionPins:
+    """The admission hook verbs stay unique across the package (the
+    lock model's unique-method fallback minted a FALSE edge from a
+    shared name in PR 11 — new cross-layer hooks must never collide),
+    and a forked child must not inherit the parent's channel-group
+    budget registry: its buckets describe retry traffic on sockets the
+    child does not own."""
+
+    def test_admission_verbs_are_unique(self):
+        import os
+        import re
+        verbs = ("admit_level", "signal_overload", "threshold_engaged",
+                 "wire_threshold", "admission_snapshot", "request_cost",
+                 "compose_level", "user_slot", "cached_socket_slot",
+                 "shared_retry_budget", "budget_group_snapshot")
+        counts = {v: 0 for v in verbs}
+        pkg = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "brpc_tpu")
+        for dirpath, _dirs, files in os.walk(pkg):
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                src = open(os.path.join(dirpath, fn)).read()
+                for v in verbs:
+                    counts[v] += len(re.findall(rf"def {v}\(", src))
+        assert all(c == 1 for c in counts.values()), counts
+
+    def test_group_budget_registry_resets_in_child(self):
+        import os
+        from brpc_tpu.rpc import retry_policy as rp
+        b = rp.shared_retry_budget("pins-cluster", True)
+        assert rp._group_budgets.get("pins-cluster") is b
+        r, w = os.pipe()
+        pid = os.fork()
+        if pid == 0:
+            try:
+                empty = not rp._group_budgets
+                fresh = rp.shared_retry_budget("pins-cluster", True)
+                msg = "OK" if (empty and fresh is not b) else \
+                    f"BAD:empty={empty}"
+            except BaseException as e:  # noqa: BLE001 - report only
+                msg = f"EXC:{type(e).__name__}:{e}"
+            try:
+                os.write(w, msg.encode()[:4096])
+            finally:
+                os._exit(0)
+        os.close(w)
+        out = b""
+        while True:
+            chunk = os.read(r, 4096)
+            if not chunk:
+                break
+            out += chunk
+        os.close(r)
+        os.waitpid(pid, 0)
+        # parent untouched: the registry still holds the same bucket
+        assert rp._group_budgets.get("pins-cluster") is b
+        assert out.decode() == "OK", out.decode()

@@ -21,11 +21,25 @@ hedge carries a ``hedge_armed remaining_ms=R p50_ms=P`` annotation
 stamped at the arming decision, and R >= P must hold for all of them
 (no hedge is ever armed past budget).
 
+With ``--corpus`` (ISSUE 14) the storm grows a PRESS tail: after the
+cluster recovers, every node is stalled while the lane count doubles —
+offered load >= 2x what the shrunken limiters will admit — and the
+DAGOR priority-admission loop must hold the line: highest-priority
+goodput >= 0.9 once thresholds converge (the second press half),
+per-priority goodput ordered by class, and >= 50% of the doomed
+low-priority sends shed CLIENT-side via the piggybacked threshold
+(rpc/admission.py) instead of burning a socket round trip.
+
   --node PORT   run one backend node (internal; the driver spawns 3)
   --smoke       ~6s storm with hard asserts — preflight's
                 gate_fabric_smoke (BRPC_TPU_FABRIC_SMOKE=0 skips)
   --bench       storm + one JSON line with fault_goodput_ratio /
                 fault_p99_ms for bench.py's fabric keys
+  --overhead    no storm: admission-layer cost probe — two calm nodes
+                (BRPC_TPU_ADMISSION on vs off, no priorities, no
+                weights), order-balanced alternating windows, median
+                per-pair overhead (the PR 12 estimator) — emits
+                admission_overhead_pct (acceptance <= 5%)
 """
 
 from __future__ import annotations
@@ -99,11 +113,12 @@ class PhaseStats:
         self.attempts = 0           # 1 + retries + hedge per call
         self.lat_ms: list = []
         self.by_priority: dict = {}   # prio -> [ok, errors]
+        self.shed_by_priority: dict = {}   # prio -> [server, client]
         self.t0 = time.perf_counter()
         self.elapsed = 0.0
 
     def record(self, failed, attempts: int, lat_ms: float,
-               priority: int = 0) -> None:
+               priority: int = 0, shed=None) -> None:
         with self.lock:
             row = self.by_priority.get(priority)
             if row is None:
@@ -113,6 +128,14 @@ class PhaseStats:
                 row[1] += 1
                 self.error_codes[failed] = \
                     self.error_codes.get(failed, 0) + 1
+                if shed is not None:
+                    # EPRIORITYSHED split: at the server's door vs
+                    # failed fast locally against the piggybacked
+                    # threshold — the press gate's convergence evidence
+                    srow = self.shed_by_priority.get(priority)
+                    if srow is None:
+                        srow = self.shed_by_priority[priority] = [0, 0]
+                    srow[1 if shed == "client" else 0] += 1
             else:
                 self.ok += 1
                 row[0] += 1
@@ -141,15 +164,21 @@ class PhaseStats:
             "per_priority": {str(p): {"ok": row[0], "errors": row[1]}
                              for p, row in sorted(
                                  self.by_priority.items())},
+            "priority_sheds": {str(p): {"server": row[0],
+                                        "client": row[1]}
+                               for p, row in sorted(
+                                   self.shed_by_priority.items())},
         }
 
 
-def _spawn_node(port: int = 0, shards: int = 1):
+def _spawn_node(port: int = 0, shards: int = 1, env: dict = None):
     from spawn_util import spawn_port_server
     argv = [os.path.abspath(__file__), "--node", str(port)]
     if shards > 1:
         argv += ["--shards", str(shards)]
-    proc, got = spawn_port_server(argv, wall_s=30.0)
+    proc, got = spawn_port_server(
+        argv, wall_s=30.0,
+        env=dict(os.environ, **env) if env else None)
     if proc is None:
         raise RuntimeError("fabric node spawn failed")
     return proc, got
@@ -198,7 +227,8 @@ def load_storm_corpus(arg: str):
 
 def run_storm(seed: int = 7, conns: int = 4, inflight: int = 8,
               windows=(1.5, 2.0, 0.8, 1.0), verbose: bool = True,
-              shards: int = 1, corpus_records=None) -> dict:
+              shards: int = 1, corpus_records=None,
+              press_s: float = 2.2) -> dict:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from brpc_tpu.butil.flags import set_flag
     from brpc_tpu.rpc import ChannelOptions, ClusterChannel
@@ -226,7 +256,8 @@ def run_storm(seed: int = 7, conns: int = 4, inflight: int = 8,
     stall_node = ports[(seed + 1) % NODES]
 
     stats = {n: PhaseStats(n) for n in
-             ("warm", "baseline", "fault", "outage", "recover", "drain")}
+             ("warm", "baseline", "fault", "outage", "recover",
+              "press1", "press2", "drain")}
     current = ["warm"]
     stop = [False]
     live = [conns * inflight]
@@ -257,15 +288,27 @@ def run_storm(seed: int = 7, conns: int = 4, inflight: int = 8,
             # the outage kill is an outage casualty, not a "survivor
             # error" of the fault window)
             ph = stats[current[0]]
-            attempts = 1 + cntl.current_try + (1 if cntl.used_backup
-                                               else 0)
+            # WIRE attempts: a client-local doomed-send shed
+            # (_adm_local_sheds) consumed a retry slot in microseconds
+            # without touching the cluster — amplification gauges load
+            # on the brown-out, so local sheds subtract
+            attempts = max(1, 1 + cntl.current_try
+                           + (1 if cntl.used_backup else 0)
+                           - cntl.__dict__.get("_adm_local_sheds", 0))
             if cntl.failed() and len(ph.samples) < 8:
                 ph.samples.append(
                     f"{cntl.error_code}:{cntl.error_text[:90]}:"
                     f"tries={cntl.current_try}:bk={cntl.used_backup}")
+            shed = None
+            if cntl.error_code == 2008:     # berr.EPRIORITYSHED
+                # the client-local fail-fast stamps "client-side" in
+                # its error text (Channel._issue_rpc); a server-door
+                # shed carries the dispatch lanes' message instead
+                shed = "client" if "client-side" in cntl.error_text \
+                    else "server"
             ph.record(cntl.error_code if cntl.failed() else False,
                       attempts, (time.perf_counter() - t0) * 1e3,
-                      priority=prio)
+                      priority=prio, shed=shed)
             if not stop[0]:
                 issue(i)
             else:
@@ -351,13 +394,49 @@ def run_storm(seed: int = 7, conns: int = 4, inflight: int = 8,
     stats["recover"] = PhaseStats("recover")
     current[0] = "recover"
     time.sleep(windows[3])
+
+    # ---- press (corpus storms only, ISSUE 14): the healthy cluster
+    # stalled node-wide while the lane count doubles — offered load
+    # >= 2x what the latency-inflated limiters will admit, so every
+    # node's overload organs fire and the DAGOR admission loop takes
+    # over: thresholds rise, low-priority work sheds at the door, the
+    # piggybacked threshold moves the shedding to the CLIENT, and the
+    # highest class keeps serving. Two equal halves so convergence is
+    # observable: press1 is the ramp, press2 the converged regime.
+    if corpus_records is not None:
+        fan = shards * 4 if shards > 1 else 1
+        for port in ports:
+            _set_delay(port, 80.0, fanout=fan)
+        # lane budget scales with the cluster's shard fan-out: every
+        # reuseport shard runs its OWN limiter (floor 16), so offered
+        # per-shard inflight must beat the shrunken per-shard limit by
+        # ~2x for the overload organs to fire at all
+        extra = max(conns * inflight, NODES * shards * 48
+                    - conns * inflight)
+        with stats["drain"].lock:
+            live[0] += extra
+        enter("press1")
+        for j in range(extra):
+            issue(j % conns)
+        time.sleep(press_s)
+        enter("press2")
+        time.sleep(press_s)
+        for port in ports:
+            # un-stall so the drain tail completes promptly; a node
+            # wedged by the storm must not hang the teardown
+            try:
+                _set_delay(port, 0.0, fanout=fan)
+            except Exception:
+                pass
     enter("drain")
     stop[0] = True
     done_ev.wait(10)
     stats["drain"].close()
 
-    out = {n: stats[n].summary() for n in
-           ("baseline", "fault", "outage", "recover")}
+    phase_names = ["baseline", "fault", "outage", "recover"]
+    if corpus_records is not None:
+        phase_names += ["press1", "press2"]
+    out = {n: stats[n].summary() for n in phase_names}
     base_qps = out["baseline"]["qps"] or 1.0
     report = {
         "seed": seed,
@@ -387,6 +466,8 @@ def run_storm(seed: int = 7, conns: int = 4, inflight: int = 8,
         if bq > 0:
             ratios[p] = round(fq / bq, 3)
     report["per_priority_goodput_ratio"] = ratios
+    if corpus_records is not None:
+        report.update(_press_report(out))
     for ch in chs:
         ch.close()
     for proc in procs.values():
@@ -398,6 +479,84 @@ def run_storm(seed: int = 7, conns: int = 4, inflight: int = 8,
     return report
 
 
+def _press_report(out: dict) -> dict:
+    """The press tail's priority-admission evidence (ISSUE 14):
+    per-class goodput rate in the converged half, the headline
+    highest-class ratio, and the low-class client-side shed fraction
+    per half (the 'increasingly client-side' trajectory)."""
+
+    def _rates(ph: dict) -> dict:
+        rates = {}
+        for p, row in ph["per_priority"].items():
+            n = row["ok"] + row["errors"]
+            if n:
+                rates[int(p)] = round(row["ok"] / n, 3)
+        return rates
+
+    def _client_frac(ph: dict, prio: int):
+        row = ph["priority_sheds"].get(str(prio))
+        if not row:
+            return None
+        n = row["server"] + row["client"]
+        return round(row["client"] / n, 3) if n else None
+
+    p1, p2 = out["press1"], out["press2"]
+    rates2 = _rates(p2)
+    prios = sorted(rates2)
+    shed_total = sum(r["server"] + r["client"]
+                     for ph in (p1, p2)
+                     for r in ph["priority_sheds"].values())
+    rep = {
+        "press_goodput_rates": {str(p): rates2[p] for p in prios},
+        "press_priority_sheds": shed_total,
+    }
+    if prios:
+        hi, lo = prios[-1], prios[0]
+        rep["priority_goodput_hi_ratio"] = rates2[hi]
+        rep["press_client_shed_frac"] = [_client_frac(p1, lo),
+                                         _client_frac(p2, lo)]
+    return rep
+
+
+def assert_press(rep: dict) -> list:
+    """The press tail's acceptance bars (ISSUE 14): admission engaged,
+    the top class held >= 0.9 goodput once converged, per-priority
+    goodput ordered by class, and the doomed low-priority flow moved
+    client-side (>= 50% of its sheds in the converged half, and not
+    receding from the ramp half)."""
+    problems = []
+    if not rep.get("press_priority_sheds"):
+        problems.append("press never engaged priority admission "
+                        "(zero EPRIORITYSHED)")
+        return problems
+    hi_ratio = rep.get("priority_goodput_hi_ratio")
+    if hi_ratio is None or hi_ratio < 0.9:
+        problems.append(
+            f"converged high-priority goodput {hi_ratio} < 0.9")
+    rates = {int(p): r for p, r in
+             rep.get("press_goodput_rates", {}).items()}
+    prios = sorted(rates)
+    for a, b in zip(prios, prios[1:]):
+        # small epsilon: two classes both near-fully served may jitter
+        if rates[b] < rates[a] - 0.05:
+            problems.append(
+                f"press goodput not ordered by class: "
+                f"prio {b} {rates[b]} < prio {a} {rates[a]}")
+    fracs = rep.get("press_client_shed_frac") or [None, None]
+    f1, f2 = fracs[0], fracs[1]
+    if f2 is None:
+        problems.append("converged press half shed nothing low-priority")
+    else:
+        if f2 < 0.5:
+            problems.append(
+                f"only {f2:.0%} of converged low-priority sheds were "
+                "client-side (piggyback threshold not propagating)")
+        if f1 is not None and f2 < f1 and f2 < 0.75:
+            problems.append(
+                f"client-side shed fraction receded: {f1} -> {f2}")
+    return problems
+
+
 def assert_storm(rep: dict) -> list:
     """The gate's acceptance bars (ISSUE 10)."""
     problems = []
@@ -406,10 +565,24 @@ def assert_storm(rep: dict) -> list:
         problems.append(f"baseline errors: {ph['baseline']['errors']}")
     if not ph["baseline"]["calls"]:
         problems.append("baseline served nothing")
-    if ph["fault"]["errors"]:
+    # survivor errors: in a corpus-fed priority storm the degraded
+    # window MAY shed below-top-class work with EPRIORITYSHED — the
+    # saturated survivor protecting its top class is the designed
+    # DAGOR outcome, not a casualty. Everything else (and ANY shed of
+    # the top class, which the threshold clamp must never allow) still
+    # counts; uniform storms have no priority sheds, so the original
+    # zero-error bar is unchanged for them.
+    fault = ph["fault"]
+    classes = [int(p) for p in fault["per_priority"]]
+    top = max(classes) if classes else 0
+    low_sheds = sum(r["server"] + r["client"]
+                    for p, r in fault["priority_sheds"].items()
+                    if int(p) < top)
+    if fault["errors"] - low_sheds:
         problems.append(
-            f"survivor error rate not 0: {ph['fault']['errors']} "
-            f"errors with 2 of 3 nodes degraded")
+            f"survivor error rate not 0: "
+            f"{fault['errors'] - low_sheds} non-shed errors "
+            f"({fault['errors']} total) with 2 of 3 nodes degraded")
     if rep["fault_goodput_ratio"] < 0.7:
         problems.append(
             f"fault goodput {rep['fault_goodput_ratio']} < 0.7x baseline")
@@ -423,10 +596,74 @@ def assert_storm(rep: dict) -> list:
         problems.append("no hedge was ever armed during the stall")
     if not rep["revived"]:
         problems.append("cluster never revived after respawn")
-    if ph["recover"]["errors"]:
-        problems.append(
-            f"recover-tail errors: {ph['recover']['errors']}")
+    # recover tail: post-revival traffic must serve cleanly — but an
+    # EPRIORITYSHED here is the admission layer doing its job, not a
+    # failed recovery: the freshly respawned node warms up with small
+    # limits, briefly arms admission under the resuming full-blast
+    # lanes, and low-priority work sheds (increasingly client-side)
+    # until the limiter grows back. The per-priority press criteria
+    # gate shed BEHAVIOR; this check gates hard failures only.
+    rec_hard = ph["recover"]["errors"] \
+        - ph["recover"]["error_codes"].get(2008, 0)
+    if rec_hard:
+        problems.append(f"recover-tail errors: {rec_hard}")
+    if "press2" in ph:
+        problems.extend(assert_press(rep))
     return problems
+
+
+# --------------------------------------------------- admission cost
+def run_overhead(window_s: float = 0.8, pairs: int = 2) -> dict:
+    """admission_overhead_pct: qps through an admission-ON node vs an
+    admission-OFF node (BRPC_TPU_ADMISSION env), NO priorities and NO
+    request costs configured — the price every PR 10 server pays for
+    the ISSUE 14 layer it isn't using. Order-balanced alternating
+    windows, median per-pair overhead (the PR 12 estimator), one
+    cumulative retry round on a > 5% read (box drift vs real cost — a
+    real regression fails both)."""
+    import statistics
+
+    from qps_client import drive_multiproc
+
+    nodes = []
+    out: dict = {}
+    try:
+        ports = {}
+        for tag, flagval in (("on", "1"), ("off", "0")):
+            proc, port = _spawn_node(
+                env={"BRPC_TPU_ADMISSION": flagval})
+            nodes.append(proc)
+            ports[tag] = port
+        nprocs = min(4, max(2, (os.cpu_count() or 2) // 4))
+
+        def window(tag: str) -> float:
+            return drive_multiproc(str(ports[tag]), nprocs=nprocs,
+                                   seconds=window_s, conns=2,
+                                   inflight=8, method="PyEcho")["qps"]
+
+        pair_pcts: list = []
+        for _attempt in range(2):
+            for _ in range(pairs):
+                for order in (("on", "off"), ("off", "on")):
+                    qps = {}
+                    for tag in order:
+                        qps[tag] = window(tag)
+                    if qps["off"] > 0:
+                        pair_pcts.append(max(
+                            0.0, (1.0 - qps["on"] / qps["off"]) * 100))
+            out["admission_overhead_pct"] = round(
+                statistics.median(pair_pcts), 2) if pair_pcts else 100.0
+            out["overhead_pairs"] = [round(p, 2) for p in pair_pcts]
+            if out["admission_overhead_pct"] <= 5.0:
+                break
+    finally:
+        for p in nodes:
+            try:
+                p.kill()
+            except Exception:
+                pass
+    out["ok"] = out.get("admission_overhead_pct", 100.0) <= 5.0
+    return out
 
 
 def main() -> int:
@@ -439,6 +676,10 @@ def main() -> int:
     seed = int(os.environ.get("BRPC_TPU_FABRIC_SEED", "7"))
     if "--seed" in args:
         seed = int(args[args.index("--seed") + 1])
+    if "--overhead" in args:
+        rep = run_overhead()
+        print(json.dumps(rep), flush=True)
+        return 0 if rep["ok"] else 1
     corpus_records = None
     if "--corpus" in args:
         corpus_records = load_storm_corpus(

@@ -524,30 +524,41 @@ def gate_serving_smoke() -> dict:
 
 
 def gate_fabric_smoke() -> dict:
-    """Overload-control fabric storm (tools/fabric_smoke.py --smoke,
-    ~8s): three nodes behind budget-hedging ClusterChannels — one node
-    SIGKILLed mid-burst + one stalled must leave survivor error rate 0
-    with goodput >= 0.7x fault-free, a full-outage window must keep
+    """Overload-control fabric storm (tools/fabric_smoke.py --smoke
+    --shards 2 --corpus auto, ~15s): three 2-shard nodes behind
+    budget-hedging ClusterChannels — one node SIGKILLed mid-burst +
+    one stalled must leave the non-shed survivor error rate 0 with
+    goodput >= 0.7x fault-free, a full-outage window must keep WIRE
     retry amplification <= 1.2x (retry token bucket), no hedge may be
     armed past budget (rpcz attempt-span evidence), and the cluster
-    must recover after the nodes respawn. A subprocess so a wedged
-    storm cannot hang the gate; ONE retry round absorbs the shared
-    sandbox's worst scheduling jitter (a real regression fails both).
-    BRPC_TPU_FABRIC_SMOKE=0 skips."""
+    must recover after the nodes respawn. The corpus-fed press tail
+    (ISSUE 14) then drives >= 2x capacity: highest-priority goodput
+    >= 0.9 once thresholds converge, per-priority goodput ordered by
+    class, and >= 50% of doomed low-priority sends shed CLIENT-side
+    via the piggybacked admission threshold. BRPC_TPU_PERF_SMOKE=1
+    (default) also prices the calm-path admission layer:
+    admission_overhead_pct <= 5% with no priorities/weights
+    configured (pair-median alternating windows). A subprocess so a
+    wedged storm cannot hang the gate; ONE retry round absorbs the
+    shared sandbox's worst scheduling jitter (a real regression fails
+    both). BRPC_TPU_FABRIC_SMOKE=0 skips."""
     if os.environ.get("BRPC_TPU_FABRIC_SMOKE", "1") == "0":
         return {"ok": True, "skipped": "BRPC_TPU_FABRIC_SMOKE=0"}
     out: dict = {}
     for attempt in range(2):
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO_ROOT, "tools",
-                                          "fabric_smoke.py"), "--smoke"],
+                                          "fabric_smoke.py"), "--smoke",
+             "--shards", "2", "--corpus", "auto"],
             cwd=REPO_ROOT, capture_output=True, text=True, timeout=180)
         out = {"ok": proc.returncode == 0, "attempt": attempt + 1}
         try:
             report = json.loads(proc.stdout.strip().splitlines()[-1])
             for k in ("fault_goodput_ratio", "fault_p99_ms",
                       "outage_amplification", "hedges_armed",
-                      "hedges_past_budget", "revived"):
+                      "hedges_past_budget", "revived",
+                      "priority_goodput_hi_ratio",
+                      "press_client_shed_frac", "press_priority_sheds"):
                 out[k] = report.get(k)
             if proc.returncode != 0:
                 out["problems"] = report.get("problems")
@@ -556,6 +567,25 @@ def gate_fabric_smoke() -> dict:
             out["error"] = (proc.stdout + proc.stderr)[-500:]
         if out["ok"]:
             break
+    if out.get("ok") and os.environ.get("BRPC_TPU_PERF_SMOKE",
+                                        "1") != "0":
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "fabric_smoke.py"),
+             "--overhead"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=180)
+        try:
+            rep = json.loads(proc.stdout.strip().splitlines()[-1])
+            out["admission_overhead_pct"] = rep.get(
+                "admission_overhead_pct")
+            if proc.returncode != 0:
+                out["ok"] = False
+                out["problems"] = (out.get("problems") or []) + [
+                    f"admission overhead "
+                    f"{rep.get('admission_overhead_pct')}% > 5%"]
+        except (ValueError, IndexError):
+            out["ok"] = False
+            out["error"] = (proc.stdout + proc.stderr)[-500:]
     return out
 
 
