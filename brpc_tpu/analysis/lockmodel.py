@@ -78,6 +78,9 @@ _COMMON_METHODS = frozenset((
     "max", "min", "any", "all", "seek", "tell", "getvalue", "readline",
     "readlines", "fileno", "most_common", "elements", "total",
     "isoformat", "timestamp", "serialize", "parse",
+    # threading.Condition verbs: a unique same-named fiber method must
+    # not claim a stdlib condvar's notify (ring_lane's _barrier_cv)
+    "notify", "notify_all",
 ))
 
 _SUBPROCESS_BLOCKING = ("run", "call", "check_call", "check_output",
@@ -218,6 +221,11 @@ class LockModel:
         self._methods: Dict[str, List[str]] = {}   # meth name -> [fkey]
         self._class_methods: Dict[str, Dict[str, str]] = {}
         self._maps: Dict[str, _ModuleMaps] = {}
+        # fkey -> ClassName from the def's return annotation: resolves
+        # factory-call receivers (global_dispatcher().pause_read(...))
+        # that the unique-method fallback loses once two lane classes
+        # define the method
+        self._ret_types: Dict[str, str] = {}
         # (class, attr) -> ClassName   |   (modname, var) -> ClassName
         self._attr_types: Dict[Tuple[str, str], str] = {}
         self._var_types: Dict[Tuple[str, str], str] = {}
@@ -351,6 +359,38 @@ class LockModel:
             self.funcs[fkey] = FuncInfo(fkey, sf.relpath, qual, cls,
                                         node.lineno)
             self._def_index[(maps.modname, qual)] = fkey
+            ann = getattr(node, "returns", None)
+            if isinstance(ann, ast.Subscript):
+                # Optional[X]: the class inside
+                v = ann.value
+                vn = v.id if isinstance(v, ast.Name) else (
+                    v.attr if isinstance(v, ast.Attribute) else None)
+                if vn == "Optional":
+                    ann = ann.slice
+            if isinstance(ann, ast.BinOp):
+                # PEP-604 "X | None" / "None | X": the non-None side
+                if isinstance(ann.right, ast.Constant) and \
+                        ann.right.value is None:
+                    ann = ann.left
+                elif isinstance(ann.left, ast.Constant) and \
+                        ann.left.value is None:
+                    ann = ann.right
+            nm = None
+            if isinstance(ann, ast.Name):
+                nm = ann.id
+            elif isinstance(ann, ast.Attribute):
+                nm = ann.attr
+            elif isinstance(ann, ast.Constant) and \
+                    isinstance(ann.value, str):
+                # string annotation, possibly "mod.X | None": first
+                # Capitalized non-None union member
+                for part in ann.value.split("|"):
+                    part = part.split(".")[-1].strip().strip("'\"")
+                    if part and part != "None" and part[0].isupper():
+                        nm = part
+                        break
+            if nm and nm[:1].isupper() and nm != "None":
+                self._ret_types[fkey] = nm
             if cls:
                 self._methods.setdefault(node.name, []).append(fkey)
                 self._class_methods.setdefault(cls, {})[node.name] = fkey
@@ -521,6 +561,23 @@ class LockModel:
                     fkey = self._class_lookup(t, meth)
                     if fkey:
                         return fkey
+            elif recv_desc[0] == "callret":
+                # the receiver is a factory call: type it from the
+                # factory's return annotation (global_dispatcher() ->
+                # EventDispatcher), so lane-duck-typed methods resolve
+                # even when several classes define them
+                fname = recv_desc[1]
+                ffkey = self._def_index.get((maps.modname, fname))
+                if not ffkey:
+                    fi = maps.from_imports.get(fname)
+                    if fi:
+                        ffkey = self._def_index.get((fi[0], fi[1]))
+                if ffkey:
+                    rt = self._ret_types.get(ffkey)
+                    if rt:
+                        fkey = self._class_lookup(rt, meth)
+                        if fkey:
+                            return fkey
             # unique-method fallback
             if meth not in _COMMON_METHODS and not meth.startswith("__"):
                 hits = self._methods.get(meth, ())
@@ -951,9 +1008,11 @@ class _FuncWalk(ast.NodeVisitor):
                     base.value.id == "self":
                 return ("attr", ("selfattr", base.attr), fn.attr)
             if isinstance(base, ast.Call) and \
-                    isinstance(base.func, ast.Name) and \
-                    base.func.id == "super":
-                return ("super", fn.attr)
+                    isinstance(base.func, ast.Name):
+                if base.func.id == "super":
+                    return ("super", fn.attr)
+                # factory-call receiver: global_dispatcher().pause_read()
+                return ("attr", ("callret", base.func.id), fn.attr)
             return ("attr", ("expr",), fn.attr)
         return None
 

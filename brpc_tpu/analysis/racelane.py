@@ -81,6 +81,11 @@ LOCK_ORDER: List[Tuple[str, str]] = [
     ("Socket._failed_cb_lock",      "transport/socket.py"),
     ("Socket._lock",                "transport/socket.py"),
     ("EventDispatcher._lock",       "transport/event_dispatcher.py"),
+    # ring-lane twin of the dispatcher lock: fd registry + tick-barrier
+    # condvar (transport/ring_lane.py). Completion callbacks and the
+    # write flush fire OUTSIDE it; inside it only native ring calls run,
+    # so it never wraps another Python acquisition
+    ("RingDispatcher._lock",        "transport/ring_lane.py"),
     ("socket_map:_glock",           "transport/socket_map.py"),
     ("IciConn._pump_lock",          "transport/ici.py"),
     ("IciConn._flush_lock",         "transport/ici.py"),

@@ -26,10 +26,14 @@ function named ``register_postfork_reset``):
      i.e. a module-level function with a ``global NAME`` statement, an
      ``is None``/truthiness guard on NAME, and an assignment whose
      value constructs an object (a Call whose callee is CapitalizedName
-     or x.CapitalizedAttr). Accessors that hand the instance to
-     ``register_protocol`` are exempt: the protocol table is a
-     fork-safe codec registry (pure data, no threads/fds), owned by
-     protocol/registry.py.
+     or x.CapitalizedAttr) — or calls a SAME-MODULE factory helper
+     whose body constructs one (``_global = _new_dispatcher()`` where
+     ``def _new_dispatcher(): return RingDispatcher() or
+     EventDispatcher()``); the lane-selection indirection must not
+     launder the singleton past the rule. Accessors that hand the
+     instance to ``register_protocol`` are exempt: the protocol table
+     is a fork-safe codec registry (pure data, no threads/fds), owned
+     by protocol/registry.py.
 
   2. module-level instantiation of a resource-bearing class::
 
@@ -121,6 +125,20 @@ class PostforkResetRule(Rule):
                 return True
         return False
 
+    def _factory_constructs(self, sf: SourceFile, value: ast.AST) -> bool:
+        """True when ``value`` calls a same-module factory helper whose
+        body contains a constructor-looking call — the
+        ``_global = _new_dispatcher()`` lane-selection idiom."""
+        factories = {node.name: node for node in sf.tree.body
+                     if isinstance(node, ast.FunctionDef)}
+        for node in ast.walk(value):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name):
+                fac = factories.get(node.func.id)
+                if fac is not None and _constructor_calls(fac):
+                    return True
+        return False
+
     def _lazy_singletons(self, sf: SourceFile) -> Iterable[ast.FunctionDef]:
         """Module-level functions matching the lazy-global accessor
         idiom (see module doc), excluding protocol registrars."""
@@ -147,7 +165,8 @@ class PostforkResetRule(Rule):
                     tgt_hit = any(isinstance(t, ast.Name)
                                   and t.id in globals_
                                   for t in sub.targets)
-                    if tgt_hit and _constructor_calls(sub.value):
+                    if tgt_hit and (_constructor_calls(sub.value) or
+                                    self._factory_constructs(sf, sub.value)):
                         constructs = True
                 if isinstance(sub, ast.Call) and \
                         isinstance(sub.func, ast.Name) and \

@@ -49,6 +49,14 @@ class ChaosConn(Conn):
     # every outbound byte crosses the fault script
     writev = None
 
+    # never ring-native (shadow the inner TcpConn's True before
+    # __getattr__ can forward it): the ring tick's native recv/writev
+    # would move bytes without crossing this fault script. Poll-only
+    # registration keeps the chaos lane observing every byte while the
+    # ring dispatcher still drives readiness.
+    supports_ring_sink = False
+    ring_attached = False
+
     def __init__(self, inner: Conn, faults: Optional[List[Fault]],
                  plan: FaultPlan, key: str, idx: int):
         self._inner = inner

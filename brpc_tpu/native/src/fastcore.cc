@@ -29,6 +29,7 @@
 #include <sys/socket.h>
 #include <time.h>
 
+#include <atomic>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -56,6 +57,15 @@ uint64_t bt_mpsc_drained(bt_mpsc*);
 // httpparse.cc — native HTTP/1.x head parsing (request + response)
 PyObject* fc_http_parse_request(PyObject*, PyObject*);
 PyObject* fc_http_parse_resp_head(PyObject*, PyObject*);
+
+// ring.cc — the batched-syscall event lane (Ring type + the
+// process-wide native-boundary syscall counters the fd loops below
+// stamp; syscall_stats.py derives syscalls_per_rpc from them)
+extern "C" int fc_ring_add_to_module(PyObject* m);
+extern std::atomic<unsigned long long> fc_sys_recv;
+extern std::atomic<unsigned long long> fc_sys_send;
+extern std::atomic<unsigned long long> fc_sys_accept;
+extern std::atomic<unsigned long long> fc_sys_poll;
 
 namespace {
 
@@ -864,8 +874,10 @@ PyObject* fc_pluck_scan(PyObject*, PyObject* args) {
     pfd.fd = fd;
     pfd.events = POLLIN;
     pfd.revents = 0;
+    fc_sys_poll.fetch_add(1, std::memory_order_relaxed);
     pr = poll(&pfd, 1, int(remaining > 0x7FFFFFFF ? 0x7FFFFFFF : remaining));
     if (pr > 0) {
+      fc_sys_recv.fetch_add(1, std::memory_order_relaxed);
       r = recv(fd, buf + n, cap - n, 0);
       if (r < 0) err = errno;
     } else if (pr < 0) {
@@ -937,6 +949,7 @@ PyObject* fc_serve_drain(PyObject*, PyObject* args) {
   int err = 0;
   Py_BEGIN_ALLOW_THREADS
   for (;;) {
+    fc_sys_recv.fetch_add(1, std::memory_order_relaxed);
     ssize_t r = recv(fd, buf + n, cap - n, 0);
     if (r < 0) {
       if (errno == EINTR) continue;
@@ -1203,7 +1216,8 @@ PyMODINIT_FUNC PyInit__brpc_fastcore() {
   if (PyModule_AddObjectRef(m, "Pool",
                             reinterpret_cast<PyObject*>(&PoolType)) < 0 ||
       PyModule_AddObjectRef(m, "Mpsc",
-                            reinterpret_cast<PyObject*>(&MpscType)) < 0) {
+                            reinterpret_cast<PyObject*>(&MpscType)) < 0 ||
+      fc_ring_add_to_module(m) < 0) {
     Py_DECREF(m);
     return nullptr;
   }

@@ -9,6 +9,8 @@ from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.protocol.tpu_std import RpcMessage, unpack_inline_device_arrays
 from brpc_tpu.rpc import errno_codes as berr
 from brpc_tpu.rpc.controller import address_call, take_call
+from brpc_tpu.transport.syscall_stats import (note_rpc_messages as
+                                              _note_rpc_messages)
 
 
 class PayloadBytes(bytes):
@@ -65,6 +67,10 @@ def make_client_fast_drain():
             # find its cut point)
             sock.input_portal.append_user_data(data)
             return False
+        if frames:
+            # these completions bypass record_dispatch_batch: stamp the
+            # syscalls_per_rpc denominator here (transport/syscall_stats)
+            _note_rpc_messages(len(frames))
         for f in frames:
             if f[0] == 2:
                 # live stream frame: dispatched in parse order, like

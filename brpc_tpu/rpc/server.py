@@ -18,6 +18,7 @@ from brpc_tpu.butil.flags import define_flag, flag
 from brpc_tpu.bvar.latency_recorder import LatencyRecorder
 from brpc_tpu.fiber import TaskControl, global_control
 from brpc_tpu.rpc.service import Method, Service
+from brpc_tpu.transport import syscall_stats as _syscall_stats
 from brpc_tpu.transport.base import get_transport
 from brpc_tpu.transport.input_messenger import InputMessenger
 from brpc_tpu.transport.socket import Socket
@@ -359,6 +360,11 @@ class Server:
             from brpc_tpu.transport.socket import expose_conn_census_vars
             expose_conn_census_vars()
             expose_stall_vars()
+            # syscall-accounting floor (syscalls_recv/writev/accept +
+            # the syscalls_per_rpc derived key) — same survival rule
+            from brpc_tpu.transport.syscall_stats import (
+                expose_syscall_vars)
+            expose_syscall_vars()
             # per-backend client stat cells (labeled prometheus family)
             # follow the same re-expose lifecycle
             from brpc_tpu.rpc.backend_stats import expose_backend_vars
@@ -470,7 +476,13 @@ class Server:
             if fdr is None:    # resolve once; False = unavailable
                 from brpc_tpu.rpc.server_dispatch import make_fast_drain
                 fdr = self._fast_drain_hook = make_fast_drain(self) or False
-            if fdr is not False:
+            if fdr is not False and not sock._ring_attached:
+                # ring lane: the dispatcher tick is this fd's only recv
+                # authority — the fd-draining serve_drain hook would
+                # read bytes that arrived AFTER chunks the ring already
+                # queued, serving them out of order. The portal-based
+                # native echo (input_messenger's nserve) still engages
+                # on ring-delivered bytes.
                 sock.fast_drain = fdr
         with self._conns_lock:
             self._conns.append(sock)
@@ -623,6 +635,7 @@ class Server:
         """Stats for a batch the C serving loop handled (serve_scan):
         native methods never block, so they bypass the concurrency
         gate; processed counts and /status latency still land."""
+        _syscall_stats.note_rpc_messages(n)
         with self._concurrency_lock:
             self.nprocessed += n
         lr = self.method_status.get(method_key)

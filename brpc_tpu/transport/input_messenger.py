@@ -20,6 +20,7 @@ from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.bvar.reducer import Adder, Maxer, PassiveStatus
 from brpc_tpu.fiber import TaskControl, global_control
 from brpc_tpu.protocol.registry import PARSE_OK, PARSE_NOT_ENOUGH_DATA, PARSE_TRY_OTHERS, get_protocols
+from brpc_tpu.transport import syscall_stats as _syscall_stats
 from brpc_tpu.transport.socket import Socket
 
 # Run-to-completion budget for a pipelined burst: up to this many
@@ -90,6 +91,10 @@ def record_dispatch_batch(n: int) -> None:
     _batch_msgs.add(n)
     _batch_cycles.add(1)
     _batch_peak.update(n)
+    # syscalls_per_rpc denominator (transport/syscall_stats.py): every
+    # message this authority dispatches — requests AND responses, so a
+    # loopback process counts both sides of each call
+    _syscall_stats.note_rpc_messages(n)
 
 
 async def _counted_dispatch(socket, work):

@@ -37,6 +37,8 @@ from brpc_tpu.fiber import TaskControl, global_control
 from brpc_tpu.fiber.butex import Butex
 from brpc_tpu.transport.base import Conn, get_transport
 from brpc_tpu.transport import device_stats as _device_stats
+from brpc_tpu.transport import syscall_stats as _syscall_stats
+from brpc_tpu.transport.ring_lane import try_defer_write as _try_defer_write
 
 define_flag("socket_inline_process", True,
             "process socket input inline on the event-raising thread "
@@ -64,6 +66,22 @@ define_flag("socket_async_write_min",
 # pins while the queue drains)
 _COALESCE_MAX_FRAMES = 32
 _COALESCE_MAX_BYTES = 1 << 20
+
+
+def _composite_cb(pending_cbs):
+    """One done-callback firing a batch's unfired per-frame callbacks —
+    the parked-remainder composite every write lane hands to
+    _park_handoff. None when there is nothing to fire."""
+    if not pending_cbs:
+        return None
+
+    def comp(err, _cbs=pending_cbs):
+        for c in _cbs:
+            try:
+                c(err)
+            except Exception:
+                pass
+    return comp
 
 
 def _close_pinned(cell) -> None:
@@ -434,6 +452,18 @@ class Socket:
         # sync input path; True = the pass was handled natively.
         # Installed by Server for eligible sockets, self-disabling.
         self.fast_drain: Optional[Callable] = None
+        # ring lane (transport/ring_lane.py): bytes the dispatcher tick
+        # recv'd natively queue here under _nevent_lock; the OWNING
+        # processing context moves them into the portal
+        # (_drain_readable's ring branch), so appender and parser never
+        # touch the portal concurrently — the classic lane's
+        # single-consumer invariant, kept structurally. _ring_fed marks
+        # a pass whose bytes arrived this way (initialized BEFORE
+        # start_events: a ring completion can fire mid-__init__).
+        self._ring_chunks: list = []
+        self._ring_fed = False
+        self._ring_attached = False
+        self._ring_pluck_ok = True
         self.user_data: dict = {}                 # per-conn session state
         # last read-event/write stamp (monotonic ns): the idle-class
         # signal for /census, /connections and idle_conn_count — one
@@ -488,7 +518,14 @@ class Socket:
             raise ConnectionError("socket pool exhausted") from None
         with _live_sockets_lock:         # resource-census registry
             _live_sockets.add(self)
+        # ring lane: offer the completion sink BEFORE start_events —
+        # the conn decides there (ring-mode dispatcher + plain fd)
+        # whether to register ring-native or classic
+        if getattr(conn, "supports_ring_sink", False):
+            conn.ring_sink = self.ring_input
         conn.start_events(self._on_readable_event, self._on_writable_event)
+        self._ring_attached = getattr(conn, "ring_attached", False)
+        self._ring_pluck_ok = getattr(conn, "ring_pluck_ok", True)
 
     # ---------------------------------------------------------- pinned fd
     def pin_fd_acquire(self) -> int:
@@ -590,6 +627,13 @@ class Socket:
         _wqueue_peak.update(self.wq_bytes)
         if not self._wq.push((data, on_done)):
             return True          # the active writer drains it in order
+        if self._ring_attached and type(data) is bytes and \
+                _try_defer_write(self):
+            # mid-tick on the ring thread: writership just claimed by
+            # the push is handed to the tick's end-of-batch flush — the
+            # whole burst's responses leave as one gather writev per
+            # connection instead of one send per frame
+            return True
         m = self._async_write_min
         if self._inline_write and not (m and sz >= m):
             return self._drain_writes_inline()
@@ -674,6 +718,9 @@ class Socket:
                         continue      # batch fully sent: keep draining
                     if status == 1:
                         return ok     # parked on the writable event
+                    if status == 3:
+                        return False  # queue claimed by a concurrent
+                                      # set_failed: stop draining
                     ok = False        # batch failed (socket now failed)
                     continue
             if self.failed:
@@ -681,36 +728,15 @@ class Socket:
             else:
                 err, leftover = self._write_data_once(data)
                 if err is None and leftover is not None:
-                    # blocked mid-frame: park writership on the writable
-                    # event (continuation takes it via _take_handoff).
-                    # The parked bytes re-enter the queue gauge — a
-                    # stalled peer holding megabytes mid-frame is
-                    # exactly what socket_wqueue_bytes exists to show
-                    # (_take_handoff settles it when the park resolves)
-                    lsz = leftover.size
-                    with self._handoff_lock:
-                        self._handoff = (leftover, cb)
-                        self.wq_bytes += lsz
-                        nwqueue_bytes.add(lsz)
-                    try:
-                        self.conn.request_writable_event()
-                    except Exception as e:
-                        took = self._take_handoff()
-                        self.set_failed(e if isinstance(e, Exception)
-                                        else ConnectionError(str(e)))
-                        if took is None:
-                            # a concurrent set_failed already claimed the
-                            # handoff AND writership: draining here too
-                            # would put two consumers on the queue
-                            return False
-                        if took[1] is not None:
-                            try:
-                                took[1](self.fail_reason)
-                            except Exception:
-                                pass
-                        ok = False
-                        continue
-                    return ok
+                    # blocked mid-frame: park writership on the
+                    # writable event
+                    st = self._park_handoff(leftover, cb)
+                    if st == 1:
+                        return ok
+                    if st == -1:
+                        return False
+                    ok = False
+                    continue
             if err is not None:
                 ok = False
                 self.set_failed(err)
@@ -731,6 +757,42 @@ class Socket:
                 nwqueue_bytes.add(-sz)
         return item
 
+    def _park_handoff(self, leftover, comp) -> int:
+        """Park a blocked write remainder on the writable event (the
+        continuation takes it via _take_handoff) — the ONE copy of the
+        park protocol the single-frame, coalesced and ring write paths
+        all share. The parked bytes re-enter the queue gauge: a
+        stalled peer holding megabytes mid-frame is exactly what
+        socket_wqueue_bytes exists to show (_take_handoff settles it
+        when the park resolves).
+
+        Returns 1 = parked; 0 = requesting the event failed (socket
+        now failed, ``comp`` fired with the reason, writership still
+        this context's — keep fail-draining); -1 = it failed AND a
+        concurrent set_failed already claimed the handoff and
+        writership (this context must NOT touch the queue again:
+        draining here too would put two consumers on it)."""
+        lsz = leftover.size
+        with self._handoff_lock:
+            self._handoff = (leftover, comp)
+            self.wq_bytes += lsz
+            nwqueue_bytes.add(lsz)
+        try:
+            self.conn.request_writable_event()
+            return 1
+        except Exception as e:
+            took = self._take_handoff()
+            self.set_failed(e if isinstance(e, Exception)
+                            else ConnectionError(str(e)))
+            if took is None:
+                return -1
+            if took[1] is not None:
+                try:
+                    took[1](self.fail_reason)
+                except Exception:
+                    pass
+            return 0
+
     def _write_coalesced(self, data, cb, nxt) -> int:
         """Send a run of queued frames as ONE gather-write batch:
         ``data``/``cb`` plus ``nxt`` plus whatever else sits in the
@@ -744,7 +806,9 @@ class Socket:
 
         Returns 0 = batch fully sent (keep draining), 1 = parked on
         the writable event (writership parked), 2 = failed (socket is
-        now failed; every callback fired with the reason)."""
+        now failed; every callback fired with the reason), 3 = failed
+        AND a concurrent set_failed claimed the queue (the caller must
+        stop draining — two consumers otherwise)."""
         agg = IOBuf()
         marks = []                    # (end_offset, cb) per frame
         total = 0
@@ -795,33 +859,103 @@ class Socket:
         # blocked mid-batch: park the remainder with the unfired
         # callbacks composited into one done (same protocol as the
         # single-frame park in _drain_writes_inline)
-        if pending_cbs:
-            def comp(err, _cbs=pending_cbs):
-                for c in _cbs:
+        comp = _composite_cb(pending_cbs)
+        st = self._park_handoff(agg, comp)
+        if st == 1:
+            return 1
+        return 3 if st == -1 else 2
+
+    def ring_collect_writes(self):
+        """Ring-flush collect half (ring thread; writership was claimed
+        by the deferring push): drain queued frames into a flat list of
+        buffer views plus per-frame callback marks for ONE native
+        gather write. The coalescing caps bound what one writev pins,
+        exactly like _write_coalesced. Returns (views, marks, total)."""
+        views = []
+        marks = []              # (end_offset, cb) per frame
+        total = 0
+        while total < _COALESCE_MAX_BYTES and \
+                len(marks) < _COALESCE_MAX_FRAMES:
+            item = self._wq.drain_one()
+            if item is None:
+                break
+            self._wq_acct_pop(item)
+            data, cb = item
+            if isinstance(data, IOBuf):
+                # rare on this lane (deferral only claims bytes frames,
+                # but racing producers may queue IOBufs behind one):
+                # flatten — fd conns carry no device refs, and the ring
+                # batch is a small-frame lane
+                data = data.to_bytes()
+            if len(data):
+                views.append(data)
+                total += len(data)
+            marks.append((total, cb))
+        return views, marks, total
+
+    def ring_settle_write(self, res: int, errcode: int, views, marks,
+                          total: int) -> bool:
+        """Ring-flush settle half: fire done callbacks for fully-sent
+        frames, park a blocked remainder through the standard handoff
+        protocol (writable-event continuation), fail the socket on real
+        errors — the exact _write_coalesced contract, split so the
+        syscall itself could run in the tick's native batch. Returns
+        False when the socket failed."""
+        if errcode:
+            e = ConnectionError(
+                f"ring writev: {os.strerror(errcode)}")
+            self.set_failed(e)
+            for _, cb in marks:
+                if cb is not None:
                     try:
-                        c(err)
+                        cb(e)
                     except Exception:
                         pass
-        else:
-            comp = None
-        lsz = agg.size
-        with self._handoff_lock:
-            self._handoff = (agg, comp)
-            self.wq_bytes += lsz
-            nwqueue_bytes.add(lsz)
-        try:
-            self.conn.request_writable_event()
-        except Exception as e:
-            took = self._take_handoff()
-            self.set_failed(e if isinstance(e, Exception)
-                            else ConnectionError(str(e)))
-            if took is not None and took[1] is not None:
-                try:
-                    took[1](self.fail_reason)
-                except Exception:
-                    pass
-            return 2
-        return 1
+            # stragglers queued behind the batch fail-drain through the
+            # classic writer (we still hold writership), which retires
+            self._drain_writes_inline()
+            return False
+        sent = res
+        pending_cbs = []
+        for end, cb in marks:
+            if end <= sent:
+                if cb is not None:
+                    try:
+                        cb(None)
+                    except Exception:
+                        pass
+            elif cb is not None:
+                pending_cbs.append(cb)
+        if sent >= total:
+            # batch fully sent: anything that queued meanwhile drains
+            # classically, and try_retire releases writership
+            self._drain_writes_inline()
+            return True
+        # blocked mid-batch: rebuild the unsent tail as zero-copy
+        # user-data refs (only the straddled frame pays a slice) and
+        # park it with the unfired callbacks composited — the same
+        # protocol as _write_coalesced's status-1 exit
+        leftover = IOBuf()
+        off = 0
+        for v in views:
+            lv = len(v)
+            if off + lv <= sent:
+                off += lv
+                continue
+            start = sent - off if sent > off else 0
+            leftover.append_user_data(v[start:] if start else v)
+            off += lv
+        st = self._park_handoff(leftover, _composite_cb(pending_cbs))
+        if st == 1:
+            return True
+        if st == 0:
+            # park failed but writership is still this context's (the
+            # socket is now failed): fail-drain the stragglers queued
+            # behind the batch so their callbacks fire with the reason
+            # and try_retire releases writership — matching the errcode
+            # branch above and _drain_writes_inline's st==0 handling
+            self._drain_writes_inline()
+        return False
 
     def probe_unobserved(self) -> bool:
         """True when this socket is (now) failed. A sticky pluck pause
@@ -998,6 +1132,57 @@ class Socket:
                 self._drain_writes_inline(first_item=item)
 
     # -------------------------------------------------------------- input
+    def ring_input(self, data, eof: bool = False, err: int = 0) -> None:
+        """Ring-lane completion sink (ring dispatcher thread): the tick
+        already recv'd ``data`` natively — queue it and run the
+        standard input cycle with the fd drain suppressed. Mirrors
+        _on_readable_event + _drain_readable with the recv replaced by
+        a chunk handoff; the busy/_nevent protocol, EOF verdicts and
+        escalation rules are shared, so the lanes cannot diverge on
+        semantics (completion drain only schedules work — the
+        graftlint ring-entrypoint contract)."""
+        self.last_active_ns = time.monotonic_ns()
+        if data:
+            nreads.add(len(data))
+        with self._nevent_lock:
+            if data:
+                self._ring_chunks.append(data)
+            self._nevent += 1
+            busy = self._nevent > 1 or self._plucking
+            if not busy:
+                self._ring_fed = True
+            elif data and self._level_triggered and not self._busy_paused:
+                # busy period with data still arriving: pause ring read
+                # interest for the rest of it, exactly like the classic
+                # level-trigger path (same lock, same flag — the resume
+                # in _finish_input_cycle cannot disagree)
+                self._busy_paused = True
+                try:
+                    self.conn.pause_read_events()
+                except Exception:
+                    self._busy_paused = False
+        if eof or err:
+            e = (ConnectionResetError("peer closed") if eof
+                 else ConnectionError(f"ring recv: {os.strerror(err)}"))
+            if busy:
+                # the owning pass may be SUSPENDED awaiting a handler;
+                # the failure must not wait for it, and set_failed runs
+                # user callbacks — keep them off the event thread (the
+                # classic peek path's discipline)
+                self._control.spawn(lambda: self.set_failed(e))
+                return
+            self.set_failed(e)   # inline: the drain's own verdict path
+        if busy:
+            return
+        if self._inline_process:
+            if self._on_input_sync is not None:
+                self._process_input_entry()
+            else:
+                self._control.run_inline(self._process_input(),
+                                         name="socket_input")
+        else:
+            self._control.spawn(self._process_input, name="socket_input")
+
     def _on_readable_event(self):
         """May fire from the dispatcher thread or a peer's fiber; only the
         0->1 transition starts a processing fiber."""
@@ -1151,6 +1336,10 @@ class Socket:
         if getattr(self.conn, "pluck_fd", None) is None \
                 or self._on_input_sync is None or self.failed:
             return False
+        if self._ring_attached and not self._ring_pluck_ok:
+            # uring backend: an in-flight kernel RECV cannot be fenced
+            # synchronously — sync joins keep the event-driven path
+            return False
         with self._nevent_lock:
             if self._nevent > 0 or self._plucking:
                 return False
@@ -1159,12 +1348,31 @@ class Socket:
             # read interest is already off, so the claim pays NO
             # epoll_ctl (the steady sync-RPC state)
             self._pluck_sticky = False
+            reads_were_live = not self._busy_paused
             if self._level_triggered and not self._busy_paused:
                 self._busy_paused = True
                 try:
                     self.conn.pause_read_events()
                 except Exception:
                     self._busy_paused = False
+        if self._ring_attached and reads_were_live:
+            # reads were armed on the ring: fence the in-flight tick so
+            # its native pass cannot consume the response this claim is
+            # about to solicit (steady-state sticky claims skip — reads
+            # were already off, the ring never had the fd armed). The
+            # barrier runs OUTSIDE _nevent_lock: the tick may be
+            # delivering to this very socket's ring_input right now.
+            rb = getattr(self.conn, "ring_read_barrier", None)
+            if rb is not None:
+                rb()
+            if self._ring_chunks:
+                # bytes the ring stole before the fence (pre-request
+                # pipelined tails): we own processing now — move them
+                # into the portal so the pluck lanes judge them
+                with self._nevent_lock:
+                    chunks, self._ring_chunks = self._ring_chunks, []
+                for c in chunks:
+                    self.input_portal.append_user_data(c)
         return True
 
     def pluck_release(self) -> None:
@@ -1247,7 +1455,8 @@ class Socket:
             return pred()
         scan = None
         dup_fd = -1
-        if fast is not None and not self.input_portal and not self.input_need:
+        if fast is not None and not self.input_portal and \
+                not self.input_need and not self._ring_chunks:
             fc = _fastcore()
             scan = getattr(fc, "pluck_scan", None) if fc is not None else None
             if scan is not None:
@@ -1266,6 +1475,19 @@ class Socket:
                 remaining = deadline_s - time.monotonic()
                 if remaining <= 0:
                     break
+                if self._ring_chunks and not carry:
+                    # belt and braces: a ring completion slipped past
+                    # the preclaim fence (uring cross-tick tail) —
+                    # those bytes precede anything still in the kernel,
+                    # so the classic machinery must judge them first,
+                    # and the native scan stands down (a partial frame
+                    # left in the portal must not have its tail read
+                    # into the scan's carry out of order)
+                    scan = None
+                    escalated = self._pluck_process()
+                    if escalated:
+                        break
+                    continue
                 # short slices: pred() can flip without fd traffic
                 # (timeout timer, another thread completing the call)
                 if scan is not None:
@@ -1283,6 +1505,11 @@ class Socket:
                     carry = b""
                     if tag == 0:          # the response for cid
                         npluck_fast.add(1)
+                        # this completion bypasses record_dispatch_batch
+                        # (the other denominator authority): count it
+                        # here so syscalls_per_rpc stays honest on the
+                        # sync-pluck lane
+                        _syscall_stats.note_rpc_messages(1)
                         _, ec, et, payload, att, leftover, _nr = r
                         if leftover:
                             self.input_portal.append_user_data(leftover)
@@ -1410,6 +1637,39 @@ class Socket:
         small reads shrink it back so idle connections don't hold large
         buffers — the readv-into-many-blocks effect of
         iobuf.h:469 without the iovec."""
+        if self._ring_fed or self._ring_attached or self._ring_chunks:
+            # ring lane: the dispatcher tick is the ONLY recv authority
+            # for this fd — this pass consumes what it queued (ordered:
+            # one appender, moved here by the one owning processing
+            # context). _ring_fed guards the birth race where a
+            # completion lands before __init__ stamps _ring_attached.
+            self._ring_fed = False
+            with self._nevent_lock:
+                chunks, self._ring_chunks = self._ring_chunks, []
+            total = 0
+            portal = self.input_portal
+            for c in chunks:
+                portal.append_user_data(c)
+                total += len(c)
+            if not (self._plucking and self._busy_paused):
+                return total
+            # pluck claim: preclaim paused ring reads AND fenced the
+            # in-flight tick (read_barrier), so the ring can no longer
+            # touch this fd — the PLUCKING context is the recv
+            # authority now. Everything the pluck lane routes through
+            # the classic machinery (a response past the scan's
+            # max_body, a large-request call that never armed the
+            # scan) reaches here, and without the fd drain below those
+            # bytes would sit in the kernel forever while pluck_until
+            # busy-polls readiness. Queued chunks went first (they
+            # were recv'd before anything the kernel still holds), so
+            # order is preserved; outside the claim the suppression
+            # above stands — an unfenced in-flight tick may hold an
+            # undelivered chunk, and an fd read here would land behind
+            # it out of order.
+            ring_total = total
+        else:
+            ring_total = 0
         rc = self._read_chunks
         if rc is not None:
             # zero-copy handoff (mem://): the writer's bytes objects
@@ -1427,7 +1687,7 @@ class Socket:
             if total:
                 nreads.add(total)
             return total
-        total = 0
+        total = ring_total
         while not self.failed:
             hint = self._read_hint
             try:

@@ -1,0 +1,106 @@
+"""Syscall accounting floor: recv/writev/accept counted at the native
+boundary, merged into the /vars ``syscalls_per_rpc`` derived key.
+
+Two stamp sites, one per boundary kind (ISSUE 15 satellite — "not
+strace"):
+
+* **Native loops** (ring.cc ticks, fastcore's pluck_scan/serve_drain
+  fd loops) bump process-wide C atomics at the actual recv/writev/
+  accept/poll call sites; ``_brpc_fastcore.syscall_counts()`` reads
+  them.
+* **Python conns** (transport/tcp.py) bump the Adders below at the
+  conn-method boundary — the Python→libc crossing the ring lane
+  exists to batch away.
+
+Both lanes stamp at the same altitude, so the bench's ring-vs-selector
+``syscalls_per_rpc`` ratio is honest: the selector lane's native echo
+loops count exactly like the ring lane's ticks.
+
+The denominator (``rpc_messages``) is stamped by the two dispatch
+authorities: ``input_messenger.record_dispatch_batch`` (classic +
+turbo lanes, requests AND responses — a loopback process counts both
+sides of each call) and ``Server.account_native_batch`` (frames the
+all-C echo loops served without ever crossing the interpreter).
+"""
+
+from __future__ import annotations
+
+from brpc_tpu.bvar.reducer import Adder, PassiveStatus
+
+# Python-side conn-boundary counters (tcp.py stamps these)
+py_recv = Adder()
+py_writev = Adder()
+py_accept = Adder()
+
+# messages dispatched / natively served — syscalls_per_rpc's denominator
+rpc_msgs = Adder()
+
+
+def note_rpc_messages(n: int) -> None:
+    rpc_msgs.add(n)
+
+
+_native_fn = False      # unresolved; None = extension absent
+
+
+def _native_counts():
+    """(recv, send, accept, poll) from the native boundary, (0,0,0,0)
+    when the extension is absent. Resolved once — a /vars scrape must
+    never trigger a compile (the loader caches after first use, and
+    any process doing socket I/O resolved it long before a scrape)."""
+    global _native_fn
+    fn = _native_fn
+    if fn is False:
+        from brpc_tpu.native import fastcore
+        try:
+            fc = fastcore.get()
+        except RuntimeError:    # sanitize-mode mismatch guard raced
+            return (0, 0, 0, 0)
+        fn = _native_fn = (getattr(fc, "syscall_counts", None)
+                           if fc is not None else None)
+    if fn is None:
+        return (0, 0, 0, 0)
+    return fn()
+
+
+def snapshot() -> dict:
+    """Merged totals since process start — the bench lanes window-delta
+    this around their measurement to derive per-RPC costs."""
+    nrecv, nsend, naccept, npoll = _native_counts()
+    return {
+        "recv": nrecv + (py_recv.get_value() or 0),
+        "writev": nsend + (py_writev.get_value() or 0),
+        "accept": naccept + (py_accept.get_value() or 0),
+        "poll": npoll,
+        "rpc_msgs": rpc_msgs.get_value() or 0,
+    }
+
+
+def syscalls_per_rpc() -> float:
+    """Cumulative (recv + writev + accept) per dispatched RPC message —
+    the ring-lane gate's cost metric. Poll/epoll wakeups are excluded:
+    they amortize over whole ticks and would reward busy-waiting."""
+    s = snapshot()
+    denom = s["rpc_msgs"]
+    if not denom:
+        return 0.0
+    return round((s["recv"] + s["writev"] + s["accept"]) / denom, 3)
+
+
+_recv_var = PassiveStatus(lambda: snapshot()["recv"])
+_writev_var = PassiveStatus(lambda: snapshot()["writev"])
+_accept_var = PassiveStatus(lambda: snapshot()["accept"])
+_ratio_var = PassiveStatus(syscalls_per_rpc)
+
+
+def expose_syscall_vars() -> None:
+    """(Re-)expose the syscall-floor bvars — called at import and again
+    from Server.start, surviving a test fixture's unexpose_all like the
+    other transport counters."""
+    _recv_var.expose("syscalls_recv")
+    _writev_var.expose("syscalls_writev")
+    _accept_var.expose("syscalls_accept")
+    _ratio_var.expose("syscalls_per_rpc")
+
+
+expose_syscall_vars()

@@ -1,0 +1,56 @@
+"""Seeded ring-lane completion violations (ISSUE 15): the batched
+tick drains its completion ring straight into the Socket-side
+entrypoints (ring_input / ring_settle_write / ring_collect_writes),
+so they are event-thread code — a blocking call there stalls EVERY fd
+in the batch. The drain itself must only pop state under the
+dispatcher lock and fire callbacks AFTER releasing it, mirroring the
+scan lane's deferred-timeout discipline."""
+
+import threading
+import time
+
+
+class RingSocketish:
+    """Completion sinks that break the event-thread contract."""
+
+    def __init__(self):
+        self._chunks = []
+        self._wlock = threading.Lock()
+
+    def ring_input(self, data, eof=False, err=0):
+        time.sleep(0.001)        # VIOLATION: direct block in the drain
+        self._chunks.append(data)
+
+    def ring_settle_write(self, res, errcode, views, marks, total):
+        _settle_slowly()         # VIOLATION: block via same-module helper
+
+    def ring_collect_writes(self):
+        self._wlock.acquire()    # VIOLATION: parks the tick thread
+        try:
+            return list(self._chunks)
+        finally:
+            self._wlock.release()
+
+
+def _settle_slowly():
+    time.sleep(0.005)            # blocking, reached FROM the drain
+
+
+class RingDrain:
+    """A completion drain that fires the consumer callback while still
+    holding the dispatcher registry lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handlers = {}
+
+    def dispatch_completion(self, comp):
+        fd, op, res, payload = comp
+        with self._lock:
+            h = self._handlers.get(fd)
+            if h is None:
+                return
+            cb = h[0]
+            # VIOLATION: callback-under-lock — the consumer re-enters
+            # the dispatcher (pause/resume/remove) and deadlocks
+            cb(payload)

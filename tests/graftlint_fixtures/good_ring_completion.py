@@ -1,0 +1,49 @@
+"""The clean twin of bad_ring_completion.py: completion sinks that
+only queue bytes / retire writes, and a drain that resolves the
+handler under the registry lock but fires it AFTER release — the
+sanctioned ring-lane shape (transport/ring_lane.py)."""
+
+import threading
+
+
+class RingSocketish:
+    def __init__(self):
+        self._chunks = []
+        self._wlock = threading.Lock()
+        self._spawn = None
+
+    def ring_input(self, data, eof=False, err=0):
+        # queue-and-schedule only: the processing fiber does the work
+        with self._wlock:
+            self._chunks.append((data, eof, err))
+        if self._spawn is not None:
+            self._spawn()
+
+    def ring_settle_write(self, res, errcode, views, marks, total):
+        with self._wlock:
+            self._chunks.append((res, errcode, total))
+
+    def ring_collect_writes(self):
+        if not self._wlock.acquire(blocking=False):
+            return None          # never parks the tick thread
+        try:
+            return list(self._chunks)
+        finally:
+            self._wlock.release()
+
+
+class RingDrain:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handlers = {}
+
+    def dispatch_completion(self, comp):
+        fd, op, res, payload = comp
+        with self._lock:
+            h = self._handlers.get(fd)
+            if h is None:
+                return
+            cb = h[0]
+        # fired OUTSIDE the registry lock: the consumer may re-enter
+        # the dispatcher (pause/resume/remove on failure)
+        cb(payload)

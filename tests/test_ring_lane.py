@@ -1,0 +1,432 @@
+"""Ring lane (ISSUE 15): the batched-syscall submission/completion
+event lane.
+
+Native-level fault coverage against the Ring ABI itself — partial-batch
+completion, mid-batch peer close, EAGAIN storms, short gather-writes —
+then the RingDispatcher's delivery/pause/barrier contract in-process,
+and tier-1 end-to-end proofs in lane subprocesses (the
+``event_ring_lane`` flag is process-global): byte-for-byte framed-echo
+parity ring vs selector, and chaos faults (drop mid-stream, delay =
+writer EAGAIN parks) recovering over the ring dispatcher.
+"""
+
+import errno
+import hashlib
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.native import fastcore
+from brpc_tpu.transport import ring_lane
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_fc = fastcore.get()
+pytestmark = pytest.mark.skipif(
+    _fc is None or not hasattr(_fc, "Ring"),
+    reason="fastcore extension (with Ring) unavailable")
+
+OP_RECV = ring_lane.OP_RECV
+OP_ACCEPT = ring_lane.OP_ACCEPT
+
+
+@pytest.fixture
+def ring():
+    r = _fc.Ring()
+    yield r
+    r.close()
+
+
+def _pair():
+    a, b = socket.socketpair()
+    a.setblocking(False)
+    b.setblocking(False)
+    return a, b
+
+
+def _wait_all(ring, want_fds, timeout=5.0, op=None):
+    """Collect completions until every fd in want_fds appeared (a batch
+    may split across ticks on a loaded box) — returns {fd: completion}.
+    Extra fds (wakeup pipes etc.) are ignored."""
+    got = {}
+    deadline = time.monotonic() + timeout
+    while set(want_fds) - set(got) and time.monotonic() < deadline:
+        for comp in ring.wait(100):
+            if comp[0] in want_fds and (op is None or comp[1] == op):
+                got.setdefault(comp[0], comp)
+    return got
+
+
+class TestNativeRing:
+    def test_backend_probe_and_enosys_fallback(self, ring):
+        """The auto backend is always constructible; forcing uring on a
+        kernel without io_uring must surface ENOSYS/EPERM (the smoke's
+        fallback proof, pinned here so tier-1 carries it)."""
+        assert ring.backend_name() in ("batch", "uring")
+        try:
+            forced = _fc.Ring(2)
+        except OSError as e:
+            assert e.errno in (errno.ENOSYS, errno.EPERM, errno.ENOMEM)
+            # ENOSYS host: auto MUST have picked the portable backend
+            assert ring.backend_name() == "batch"
+        else:
+            assert forced.backend_name() == "uring"
+            forced.close()
+
+    def test_partial_batch_completion(self, ring):
+        """Three registered fds, two ready: the completion batch names
+        exactly the ready ones — an idle fd must not fabricate a
+        completion nor block the batch."""
+        pairs = [_pair() for _ in range(3)]
+        fds = [a.fileno() for a, _ in pairs]
+        try:
+            for fd in fds:
+                ring.register_fd(fd, 0)
+            pairs[0][1].send(b"alpha")
+            pairs[2][1].send(b"gamma")
+            got = _wait_all(ring, {fds[0], fds[2]}, op=OP_RECV)
+            assert set(got) == {fds[0], fds[2]}
+            assert bytes(got[fds[0]][3]) == b"alpha"
+            assert bytes(got[fds[2]][3]) == b"gamma"
+            assert got[fds[0]][2] == 5 and got[fds[2]][2] == 5
+            # the idle fd stays silent on a follow-up poll
+            extra = ring.wait(50)
+            assert all(c[0] != fds[1] for c in extra)
+        finally:
+            for a, b in pairs:
+                a.close()
+                b.close()
+
+    def test_mid_batch_peer_close(self, ring):
+        """One peer hangs up while another delivers: the EOF completion
+        (res == 0) and the data completion ride the same lane without
+        disturbing each other."""
+        (a1, b1), (a2, b2) = _pair(), _pair()
+        try:
+            ring.register_fd(a1.fileno(), 0)
+            ring.register_fd(a2.fileno(), 0)
+            b1.send(b"live-bytes")
+            b2.close()                      # FIN before any payload
+            got = _wait_all(ring, {a1.fileno(), a2.fileno()}, op=OP_RECV)
+            assert bytes(got[a1.fileno()][3]) == b"live-bytes"
+            assert got[a2.fileno()][2] == 0        # EOF verdict
+        finally:
+            a1.close()
+            b1.close()
+            a2.close()
+
+    def test_reset_surfaces_negative_errno(self, ring):
+        """A hard RST arrives as res = -errno, not an exception and not
+        a silent drop — Socket.ring_input turns it into set_failed."""
+        a, b = _pair()
+        try:
+            ring.register_fd(a.fileno(), 0)
+            b.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                         b"\x01\x00\x00\x00\x00\x00\x00\x00")
+            b.close()                        # linger 0: RST, not FIN
+            got = _wait_all(ring, {a.fileno()}, op=OP_RECV)
+            comp = got[a.fileno()]
+            # AF_UNIX pairs read EOF on some kernels; either verdict is
+            # a verdict — what must not happen is no completion at all
+            assert comp[2] <= 0
+        finally:
+            a.close()
+
+    def test_eagain_storm_dribble(self, ring):
+        """A peer dribbling one byte per tick: every wait returns real
+        data completions only — the lane never leaks -EAGAIN upward nor
+        spins on an empty fd (the quiet polls return nothing for it)."""
+        a, b = _pair()
+        try:
+            ring.register_fd(a.fileno(), 0)
+            seen = bytearray()
+            for i in range(20):
+                b.send(bytes([i]))
+                got = _wait_all(ring, {a.fileno()}, op=OP_RECV)
+                comp = got[a.fileno()]
+                assert comp[2] > 0, comp
+                seen += bytes(comp[3])
+            assert bytes(seen) == bytes(range(20))
+            # storm over: the armed fd must go quiet, not busy-complete
+            assert all(c[0] != a.fileno() for c in ring.wait(50))
+        finally:
+            a.close()
+            b.close()
+
+    def test_short_write_flush_and_remainder(self, ring):
+        """flush_writes against a full socket buffer: the gather write
+        is SHORT (res < total); re-flushing the remainder while the
+        peer drains delivers every byte exactly once, in order."""
+        a, b = _pair()
+        try:
+            payload = bytes(range(256)) * 4096        # 1 MiB
+            total = len(payload)
+            sent = 0
+            received = bytearray()
+            saw_short = False
+            deadline = time.monotonic() + 30
+            while sent < total and time.monotonic() < deadline:
+                chunk = payload[sent:]
+                (fd, res, err), = ring.flush_writes(
+                    [(a.fileno(), (chunk,))])
+                assert fd == a.fileno()
+                if res >= 0:
+                    if 0 < res < len(chunk):
+                        saw_short = True
+                    sent += res
+                else:
+                    assert err in (errno.EAGAIN, errno.EWOULDBLOCK), \
+                        (res, err)
+                # drain the peer so the writer can make progress
+                try:
+                    while True:
+                        data = b.recv(65536)
+                        if not data:
+                            break
+                        received += data
+                except BlockingIOError:
+                    pass
+            assert sent == total
+            try:
+                while True:
+                    data = b.recv(65536)
+                    if not data:
+                        break
+                    received += data
+            except BlockingIOError:
+                pass
+            assert saw_short, "buffer never filled — shrink payload?"
+            assert bytes(received) == payload
+        finally:
+            a.close()
+            b.close()
+
+    def test_accept_batch(self, ring):
+        """A listener's completion carries pre-accepted fds (res = new
+        fd): N backlogged clients arrive as OP_ACCEPT completions and
+        the new fds actually speak."""
+        lst = socket.socket()
+        lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(16)
+        lst.setblocking(False)
+        port = lst.getsockname()[1]
+        clients = []
+        accepted = []
+        try:
+            ring.register_fd(lst.fileno(), 1)
+            for _ in range(5):
+                c = socket.create_connection(("127.0.0.1", port))
+                clients.append(c)
+            deadline = time.monotonic() + 5
+            while len(accepted) < 5 and time.monotonic() < deadline:
+                for comp in ring.wait(100):
+                    if comp[0] == lst.fileno() and comp[1] == OP_ACCEPT:
+                        assert comp[2] >= 0, comp
+                        accepted.append(comp[2])
+            assert len(accepted) == 5
+            clients[0].send(b"hi")
+            got = b""
+            for afd in accepted:
+                s = socket.socket(fileno=afd)
+                s.setblocking(False)
+                try:
+                    got += s.recv(16)
+                except BlockingIOError:
+                    pass
+                finally:
+                    s.close()
+            accepted = []
+            assert got == b"hi"
+        finally:
+            for c in clients:
+                c.close()
+            for afd in accepted:
+                os.close(afd)
+            lst.close()
+
+
+class TestRingDispatcher:
+    """The Python lane above the native ring, driven directly (no
+    global flag): sink delivery, EOF, pause/resume + barrier."""
+
+    def _disp(self):
+        return ring_lane.RingDispatcher(name="test_ring_disp")
+
+    def test_sink_delivery_then_eof(self):
+        d = self._disp()
+        a, b = _pair()
+        got = []
+        evt = threading.Event()
+
+        def sink(data, eof, err):
+            got.append((bytes(data) if data is not None else None,
+                        eof, err))
+            evt.set()
+
+        try:
+            d.add_consumer(a.fileno(), lambda: None, ring_recv=sink)
+            b.send(b"payload")
+            assert evt.wait(5)
+            assert got[0] == (b"payload", False, 0)
+            evt.clear()
+            b.close()
+            assert evt.wait(5)
+            assert got[-1][1] is True          # EOF verdict
+            d.remove_consumer(a.fileno())
+        finally:
+            d.stop()
+            a.close()
+
+    def test_pause_read_barrier_then_resume(self):
+        """pause_read + read_barrier is a hard cutoff: bytes sent after
+        it stay in the kernel until resume_read (the pluck lane's
+        fencing contract)."""
+        d = self._disp()
+        a, b = _pair()
+        got = []
+        evt = threading.Event()
+
+        def sink(data, eof, err):
+            if data is not None:
+                got.append(bytes(data))
+                evt.set()
+
+        try:
+            d.add_consumer(a.fileno(), lambda: None, ring_recv=sink)
+            d.pause_read(a.fileno())
+            d.read_barrier()
+            b.send(b"fenced")
+            assert not evt.wait(0.3), got
+            d.resume_read(a.fileno())
+            assert evt.wait(5)
+            assert got == [b"fenced"]
+            d.remove_consumer(a.fileno())
+        finally:
+            d.stop()
+            a.close()
+            b.close()
+
+
+def _run_child(code: str, env_extra: dict, timeout: int = 180) -> dict:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(env_extra)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO_ROOT, env=env,
+        capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+_PARITY_CHILD = r"""
+import hashlib, json, os, sys
+sys.path.insert(0, os.getcwd())
+from brpc_tpu.rpc import Channel, ChannelOptions, Server, ServerOptions, Service
+from brpc_tpu.transport.event_dispatcher import global_dispatcher
+
+svc = Service("P")
+
+@svc.method()
+def Frame(cntl, request):
+    b = bytes(request)
+    return len(b).to_bytes(4, "big") + b[::-1]
+
+server = Server(ServerOptions(enable_builtin_services=False))
+server.add_service(svc)
+server.start("tcp://127.0.0.1:0")
+ch = Channel(f"tcp://127.0.0.1:{server.endpoint.port}",
+             ChannelOptions(timeout_ms=10000, share_connections=False))
+h = hashlib.sha256()
+sizes = [0, 1, 7, 64, 255, 1024, 8192, 65536]
+for i in range(64):
+    sz = sizes[i % len(sizes)]
+    req = bytes((i + j) % 256 for j in range(min(sz, 256))) * (1 if sz <= 256 else sz // 256)
+    req = req[:sz]
+    c = ch.call_sync("P", "Frame", req)
+    assert not c.failed(), c.error_text
+    resp = c.response_payload.to_bytes() if c.response_payload is not None else b""
+    assert resp == len(req).to_bytes(4, "big") + req[::-1], (i, sz)
+    h.update(resp)
+out = {"dispatcher": type(global_dispatcher()).__name__,
+       "digest": h.hexdigest()}
+ch.close()
+server.stop()
+print(json.dumps(out))
+"""
+
+_CHAOS_CHILD = r"""
+import json, os, sys
+sys.path.insert(0, os.getcwd())
+from brpc_tpu import chaos
+from brpc_tpu.chaos import Fault, FaultPlan
+from brpc_tpu.rpc import Channel, ChannelOptions, Server, ServerOptions, Service
+from brpc_tpu.transport.event_dispatcher import global_dispatcher
+
+svc = Service("C")
+
+@svc.method()
+def Echo(cntl, request):
+    return bytes(request)
+
+server = Server(ServerOptions(enable_builtin_services=False))
+server.add_service(svc)
+server.start("tcp://127.0.0.1:0")
+addr = f"tcp://127.0.0.1:{server.endpoint.port}"
+plan = FaultPlan(seed=3)
+plan.at(addr, 0, Fault("drop", at_byte=48))        # mid-stream conn kill
+plan.at(addr, 1, Fault("delay", at_byte=16, delay_ms=60))  # writer parks (EAGAIN)
+chaos.install(plan)
+ok = errors = retried = 0
+try:
+    ch = Channel(addr, ChannelOptions(timeout_ms=4000, max_retry=3,
+                                      share_connections=False))
+    for i in range(32):
+        c = ch.call_sync("C", "Echo", bytes([i % 256]) * 96)
+        if c.failed():
+            errors += 1
+        else:
+            ok += 1
+            if c.current_try > 0:
+                retried += 1
+    ch.close()
+finally:
+    chaos.uninstall()
+    server.stop()
+print(json.dumps({"dispatcher": type(global_dispatcher()).__name__,
+                  "ok": ok, "errors": errors, "retried": retried}))
+"""
+
+
+class TestRingLaneEndToEnd:
+    def test_framed_echo_parity_ring_vs_selector(self):
+        """Byte-for-byte parity: the same framed-echo corpus through
+        each lane subprocess digests identically."""
+        ring = _run_child(_PARITY_CHILD,
+                          {"BRPC_TPU_FLAG_EVENT_RING_LANE": "1"})
+        sel = _run_child(_PARITY_CHILD,
+                         {"BRPC_TPU_FLAG_EVENT_RING_LANE": "0"})
+        assert ring["dispatcher"] == "RingDispatcher"
+        assert sel["dispatcher"] == "EventDispatcher"
+        assert ring["digest"] == sel["digest"]
+
+    def test_chaos_faults_recover_on_ring_lane(self):
+        """Chaos over the ring dispatcher: a mid-stream drop and a
+        delay fault (writer parks on EAGAIN, resumes via writable
+        rearm) — retries recover every call, zero surviving errors.
+        This also pins the poll-only demotion: ChaosConn sets
+        supports_ring_sink=False, so the injected conns ride the ring
+        as readiness-only fds while every byte still crosses the fault
+        script."""
+        rep = _run_child(_CHAOS_CHILD,
+                         {"BRPC_TPU_FLAG_EVENT_RING_LANE": "1"})
+        assert rep["dispatcher"] == "RingDispatcher"
+        assert rep["errors"] == 0, rep
+        assert rep["ok"] == 32
+        assert rep["retried"] >= 1, rep    # the drop really bit a conn

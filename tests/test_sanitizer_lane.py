@@ -33,7 +33,11 @@ SAN = ("address", "undefined")
 # instrumented)
 FUZZ_TARGETS = ["tests/test_decoder_fuzz.py", "tests/test_protocol_fuzz.py",
                 "tests/test_native.py",
-                "tests/test_hotpath_batching.py::TestBatchedScanDifferential"]
+                "tests/test_hotpath_batching.py::TestBatchedScanDifferential",
+                # ring.cc instrumented (ISSUE 15): the native batch
+                # loop's recv bursts, short gather-writes, accept
+                # loops and EOF/RST verdicts under ASan/UBSan
+                "tests/test_ring_lane.py::TestNativeRing"]
 # engagement/wiring assertions that are timing-sensitive under the
 # sanitizers' ~2-10x slowdown (burst accumulation); they are perf-path
 # wiring checks, not memory-safety differentials — tier-1 covers them

@@ -1,0 +1,395 @@
+"""Ring-lane smoke + burst driver (ISSUE 15).
+
+The event_ring_lane flag is process-global (the dispatcher lane is
+chosen when the global dispatcher is built), so every comparison here
+runs each lane in its OWN subprocess and the parent compares the JSON
+reports — the same-process counters (syscall floor, ring ticks) are
+then trivially attributable to one lane.
+
+Modes (each prints ONE JSON line on stdout):
+
+  --lane ring|selector --burst
+      In-process pipelined multi-connection small-RPC burst: one
+      loopback PyEcho server, NCH channels with private connections,
+      INFLIGHT calls deep each, issued from completion callbacks (the
+      PR 7 lesson: a sync 1-conn loop is latency-bound and cannot
+      express batching). Reports best-of-N windows qps with THAT
+      window's syscalls_per_rpc + latency percentiles.
+
+  --lane ring|selector --parity
+      Seeded framed-echo corpus (sequential sync + pipelined phases)
+      over the lane; prints a sha256 digest of every response byte.
+      The parent compares digests across lanes — byte-for-byte parity.
+
+  --burst-pair
+      Runs --burst in both lane subprocesses (ring first, then
+      selector — same box state order every run), computes the ratio
+      keys bench.py publishes: ring_syscall_drop (selector spr / ring
+      spr), ring_qps_ratio, ring_p99_ratio.
+
+  --smoke
+      The preflight gate (gate_ring_lane): native probe (auto backend
+      + forced-uring verdict), ENOSYS/EPERM fallback proof on kernels
+      without io_uring, ring-lane bring-up, and cross-lane parity.
+      Exit 0/1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+BASE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, BASE)
+
+# burst shape: wide and deep enough that whole response runs retire in
+# one dispatcher tick — the shape the submission/completion ring exists
+# for (narrow shapes measure latency, not batching)
+NCH = 8
+INFLIGHT = 32
+WINDOW_CALLS = 4000
+WINDOWS = 3
+PAYLOAD = b"ring"
+
+PARITY_CALLS = 96
+PARITY_PIPELINED = 128
+
+
+def _set_lane_env(lane: str) -> None:
+    os.environ["BRPC_TPU_FLAG_EVENT_RING_LANE"] = \
+        "1" if lane == "ring" else "0"
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _make_server():
+    from brpc_tpu.rpc import Server, ServerOptions, Service
+    svc = Service("Bench")
+
+    @svc.method()
+    def PyEcho(cntl, request):
+        return bytes(request)
+
+    @svc.method()
+    def Scramble(cntl, request):
+        # parity corpus: a response the wire cannot produce by luck —
+        # length-stamped reversed payload
+        b = bytes(request)
+        return len(b).to_bytes(4, "big") + b[::-1]
+
+    server = Server(ServerOptions(enable_builtin_services=False))
+    server.add_service(svc)
+    server.start("tcp://127.0.0.1:0")
+    return server
+
+
+def _lane_report_base():
+    from brpc_tpu.transport.event_dispatcher import global_dispatcher
+    d = global_dispatcher()
+    return {
+        "dispatcher": type(d).__name__,
+        "backend": getattr(d, "backend", "selector"),
+    }
+
+
+def run_burst(lane: str) -> dict:
+    _set_lane_env(lane)
+    from brpc_tpu.bvar.latency_recorder import LatencyRecorder
+    from brpc_tpu.rpc import Channel, ChannelOptions
+    from brpc_tpu.transport import ring_lane, syscall_stats
+
+    # the flag only REQUESTS the lane — a silent bring-up failure
+    # (stale extension, ring constructor error) falls back to the
+    # selector, and a selector-vs-selector "ratio" of ~1.0 would read
+    # as a perf regression instead of the bring-up failure it is
+    want = "RingDispatcher" if lane == "ring" else "EventDispatcher"
+    got = _lane_report_base()["dispatcher"]
+    if got != want:
+        raise RuntimeError(
+            f"--lane {lane} child runs {got}, wanted {want}: "
+            "lane bring-up failed — the ratio would be meaningless")
+
+    server = _make_server()
+    port = server.endpoint.port
+    chs = [Channel(f"tcp://127.0.0.1:{port}",
+                   ChannelOptions(timeout_ms=10000,
+                                  share_connections=False))
+           for _ in range(NCH)]
+    for c in chs:
+        r = c.call_sync("Bench", "PyEcho", b"warm")
+        if r.failed():
+            raise RuntimeError(f"warm-up failed: {r.error_text}")
+
+    def window(rec) -> tuple:
+        done_evt = threading.Event()
+        state = {"left": WINDOW_CALLS, "issued": 0, "errors": 0}
+        lock = threading.Lock()
+
+        def issue(ch):
+            t0 = time.perf_counter_ns()
+
+            def _done(c):
+                if not c.failed() and rec is not None:
+                    rec.record((time.perf_counter_ns() - t0) / 1e3)
+                go = False
+                with lock:
+                    if c.failed():
+                        state["errors"] += 1
+                    state["left"] -= 1
+                    if state["left"] == 0:
+                        done_evt.set()
+                    elif state["issued"] < WINDOW_CALLS:
+                        state["issued"] += 1
+                        go = True
+                if go:
+                    issue(ch)
+
+            ch.call("Bench", "PyEcho", PAYLOAD, done=_done)
+
+        s0 = syscall_stats.snapshot()
+        t0 = time.perf_counter()
+        seed = min(NCH * INFLIGHT, WINDOW_CALLS)
+        with lock:
+            state["issued"] = seed
+        for i in range(seed):
+            issue(chs[i % NCH])
+        if not done_evt.wait(120):
+            raise RuntimeError("burst window hung")
+        dt = time.perf_counter() - t0
+        s1 = syscall_stats.snapshot()
+        msgs = s1["rpc_msgs"] - s0["rpc_msgs"]
+        sys_io = (s1["recv"] - s0["recv"]) + \
+            (s1["writev"] - s0["writev"]) + \
+            (s1["accept"] - s0["accept"])
+        spr = round(sys_io / msgs, 3) if msgs else 0.0
+        return (round(WINDOW_CALLS / dt, 1), spr, state["errors"])
+
+    window(None)                       # warm window (JIT-ish settling)
+    best = None
+    win_reports = []
+    errors = 0
+    for _ in range(WINDOWS):
+        rec = LatencyRecorder()
+        qps, spr, errs = window(rec)
+        errors += errs
+        w = {"qps": qps, "syscalls_per_rpc": spr,
+             "p50_us": round(rec.latency_percentile(0.5), 1),
+             "p99_us": round(rec.latency_percentile(0.99), 1)}
+        win_reports.append(w)
+        if best is None or qps > best["qps"]:
+            best = w
+    out = {
+        "lane": lane, **_lane_report_base(),
+        "conns": NCH, "inflight": INFLIGHT,
+        "window_calls": WINDOW_CALLS,
+        "errors": errors,
+        **best,
+        "windows": win_reports,
+    }
+    if lane == "ring":
+        out["ring_ticks"] = ring_lane.nticks.get_value() or 0
+        out["ring_completions"] = ring_lane.ncompletions.get_value() or 0
+        out["ring_flush_batches"] = \
+            ring_lane.nflush_batches.get_value() or 0
+        out["ring_flushed_frames"] = \
+            ring_lane.nflush_frames.get_value() or 0
+    for c in chs:
+        c.close()
+    server.stop()
+    return out
+
+
+def run_parity(lane: str) -> dict:
+    """Deterministic corpus -> digest of every response byte. Sizes
+    cross the small-frame/turbo thresholds and the ring's short-read
+    heuristic; the pipelined phase exercises completion-batch ordering
+    (digest folds responses in ISSUE ORDER, which both lanes must
+    preserve per call id)."""
+    _set_lane_env(lane)
+    from brpc_tpu.rpc import Channel, ChannelOptions
+
+    server = _make_server()
+    port = server.endpoint.port
+    h = hashlib.sha256()
+    ch = Channel(f"tcp://127.0.0.1:{port}",
+                 ChannelOptions(timeout_ms=10000,
+                                share_connections=False))
+    # sequential phase: exact request/response pairing, growing and
+    # boundary-straddling sizes
+    sizes = [0, 1, 3, 16, 255, 1024, 4096, 65536, 262144]
+    for i in range(PARITY_CALLS):
+        sz = sizes[i % len(sizes)]
+        req = bytes((i + j) % 256 for j in range(min(sz, 512))) * \
+            (1 if sz <= 512 else sz // 512)
+        req = req[:sz]
+        cntl = ch.call_sync("Bench", "Scramble", req)
+        if cntl.failed():
+            raise RuntimeError(f"parity call {i} failed: "
+                               f"{cntl.error_text}")
+        resp = cntl.response_payload.to_bytes() \
+            if cntl.response_payload is not None else b""
+        expect = len(req).to_bytes(4, "big") + req[::-1]
+        if resp != expect:
+            raise RuntimeError(f"parity mismatch at call {i} "
+                               f"(size {sz})")
+        h.update(resp)
+    # pipelined phase: responses may COMPLETE out of order; fold in
+    # issue order from a slot table
+    slots = [None] * PARITY_PIPELINED
+    done_evt = threading.Event()
+    left = [PARITY_PIPELINED]
+    lock = threading.Lock()
+
+    def issue(i):
+        req = (b"%06d" % i) * (1 + i % 17)
+
+        def _done(c, idx=i, expect=req):
+            if c.failed():
+                slots[idx] = b"FAILED:" + c.error_text.encode()
+            else:
+                slots[idx] = c.response_payload.to_bytes() \
+                    if c.response_payload is not None else b""
+            with lock:
+                left[0] -= 1
+                if left[0] == 0:
+                    done_evt.set()
+
+        ch.call("Bench", "PyEcho", req, done=_done)
+
+    for i in range(PARITY_PIPELINED):
+        issue(i)
+    if not done_evt.wait(60):
+        raise RuntimeError("parity pipelined phase hung")
+    for i, resp in enumerate(slots):
+        expect = (b"%06d" % i) * (1 + i % 17)
+        if resp != expect:
+            raise RuntimeError(f"pipelined parity mismatch at {i}")
+        h.update(resp)
+    out = {"lane": lane, **_lane_report_base(),
+           "calls": PARITY_CALLS + PARITY_PIPELINED,
+           "digest": h.hexdigest()}
+    ch.close()
+    server.stop()
+    return out
+
+
+def _child(lane: str, mode: str, timeout: int = 300) -> dict:
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--lane", lane, mode],
+        cwd=BASE, capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{lane} {mode} child failed: "
+            f"{(proc.stdout + proc.stderr)[-500:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def run_burst_pair() -> dict:
+    ring = _child("ring", "--burst")
+    selector = _child("selector", "--burst")
+    out = {"ring": ring, "selector": selector}
+    if ring["syscalls_per_rpc"]:
+        out["ring_syscall_drop"] = round(
+            selector["syscalls_per_rpc"] / ring["syscalls_per_rpc"], 2)
+    if selector["qps"]:
+        out["ring_qps_ratio"] = round(ring["qps"] / selector["qps"], 2)
+    if selector["p99_us"]:
+        out["ring_p99_ratio"] = round(
+            ring["p99_us"] / selector["p99_us"], 2)
+    out["errors"] = ring["errors"] + selector["errors"]
+    return out
+
+
+def run_smoke() -> dict:
+    """gate_ring_lane: probe + fallback proof + bring-up + parity."""
+    _set_lane_env("selector")          # this process stays off-ring
+    report: dict = {"ok": True}
+    from brpc_tpu.native import fastcore
+    fc = fastcore.get()
+    if fc is None or not hasattr(fc, "Ring"):
+        report["ok"] = False
+        report["error"] = "fastcore extension lacks Ring"
+        return report
+    r = fc.Ring()
+    report["auto_backend"] = r.backend_name()
+    r.close()
+    # forced-uring verdict: on kernels without usable io_uring the
+    # constructor must surface ENOSYS/EPERM (never silently serve the
+    # batch loop as "uring"); where io_uring exists, auto already
+    # picked it
+    try:
+        r2 = fc.Ring(2)
+        report["forced_uring"] = r2.backend_name()
+        r2.close()
+        report["uring_native"] = True
+    except OSError as e:
+        import errno as _errno
+        report["forced_uring_errno"] = e.errno
+        report["uring_native"] = False
+        if e.errno not in (_errno.ENOSYS, _errno.EPERM, _errno.ENOMEM):
+            report["ok"] = False
+            report["error"] = f"unexpected probe errno {e.errno}"
+            return report
+        if report["auto_backend"] != "batch":
+            report["ok"] = False
+            report["error"] = ("auto backend must fall back to batch "
+                               "when the uring probe fails")
+            return report
+        report["enosys_fallback_proven"] = True
+    # lane bring-up + byte-for-byte parity across lanes
+    try:
+        ring = _child("ring", "--parity", timeout=180)
+        selector = _child("selector", "--parity", timeout=180)
+    except (RuntimeError, subprocess.TimeoutExpired, ValueError) as e:
+        report["ok"] = False
+        report["error"] = f"parity child: {e}"[:500]
+        return report
+    report["ring_dispatcher"] = ring["dispatcher"]
+    report["ring_backend"] = ring["backend"]
+    report["parity_calls"] = ring["calls"]
+    if ring["dispatcher"] != "RingDispatcher":
+        report["ok"] = False
+        report["error"] = "event_ring_lane flag did not select the " \
+                          "ring dispatcher"
+    elif ring["digest"] != selector["digest"]:
+        report["ok"] = False
+        report["error"] = "response digests diverge between lanes"
+    else:
+        report["parity"] = "byte-for-byte"
+    return report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--lane", choices=("ring", "selector"))
+    p.add_argument("--burst", action="store_true")
+    p.add_argument("--parity", action="store_true")
+    p.add_argument("--burst-pair", action="store_true")
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    if args.burst or args.parity:
+        if not args.lane:
+            p.error("--burst/--parity need --lane")
+        out = run_burst(args.lane) if args.burst \
+            else run_parity(args.lane)
+        print(json.dumps(out))
+        return 0
+    if args.burst_pair:
+        print(json.dumps(run_burst_pair()))
+        return 0
+    if args.smoke:
+        out = run_smoke()
+        print(json.dumps(out, indent=2))
+        return 0 if out["ok"] else 1
+    p.error("pick a mode")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
