@@ -8,6 +8,11 @@ errors exit 120. Machine consumers pick ``--format=json`` or
 (default HEAD) while still analyzing the whole tree for cross-module
 context; ``--show-waivers`` audits every waiver in force (file:line,
 rules, reason, and whether it suppressed anything this run).
+``--baseline FILE`` suppresses the findings recorded by a previous
+``--write-baseline FILE`` (keyed path+rule+message, line-drift-proof)
+so CI fails only on NEW findings; ``--field-guards`` prints the
+guarded-by rule's inferred field->guard registry — the table
+docs/invariants.md publishes.
 """
 
 from __future__ import annotations
@@ -51,7 +56,38 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--show-waivers", action="store_true",
                    help="list every waiver in force (file:line, rules, "
                         "reason, used/unused this run) and exit 0")
+    p.add_argument("--field-guards", action="store_true",
+                   help="print the inferred field->guard registry "
+                        "(the docs/invariants.md table) and exit 0")
+    p.add_argument("--baseline", metavar="FILE", default=None,
+                   help="suppress findings recorded in FILE (written "
+                        "by --write-baseline): CI diffs against the "
+                        "committed baseline instead of failing on "
+                        "known rows")
+    p.add_argument("--write-baseline", metavar="FILE", default=None,
+                   help="write the current active findings to FILE "
+                        "and exit 0")
     return p
+
+
+def _baseline_key(f) -> tuple:
+    """Baseline identity deliberately drops the line number: unrelated
+    edits shift lines, and a baseline that churns on every edit gets
+    regenerated blindly instead of reviewed."""
+    return (f.path, f.rule, f.message)
+
+
+def load_baseline(path: str) -> Optional[Set[tuple]]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    keys: Set[tuple] = set()
+    for row in data.get("findings", ()):
+        keys.add((row.get("path", ""), row.get("rule", ""),
+                  row.get("message", "")))
+    return keys
 
 
 def changed_files(base: str, repo_root: str) -> Optional[Set[str]]:
@@ -201,8 +237,37 @@ def main(argv: Optional[List[str]] = None) -> int:
             return EXIT_USAGE
         rules = [r for r in rules if r.name in wanted]
     paths = args.paths or ["brpc_tpu"]
+
+    if args.field_guards:
+        from brpc_tpu.analysis.core import Context
+        from brpc_tpu.analysis.rules.guarded_by import (
+            field_guard_table, render_field_guards,
+        )
+        ctx = Context(iter_source_files(paths))
+        if fmt == "json":
+            print(json.dumps({"field_guards": field_guard_table(ctx)}))
+        else:
+            print(render_field_guards(ctx))
+        return 0
+
     analyzer = Analyzer(rules=rules)
     active, waived = analyzer.run(paths)
+
+    if args.write_baseline:
+        with open(args.write_baseline, "w", encoding="utf-8") as fh:
+            json.dump({"findings": [f.to_dict() for f in active]},
+                      fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"graftlint: baseline with {len(active)} finding(s) "
+              f"written to {args.write_baseline}", file=sys.stderr)
+        return 0
+    if args.baseline:
+        known = load_baseline(args.baseline)
+        if known is None:
+            print(f"graftlint: cannot read baseline {args.baseline}",
+                  file=sys.stderr)
+            return EXIT_USAGE
+        active = [f for f in active if _baseline_key(f) not in known]
 
     if args.show_waivers:
         waivers = collect_waivers(paths, waived)

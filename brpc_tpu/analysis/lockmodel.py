@@ -88,6 +88,14 @@ _SUBPROCESS_BLOCKING = ("run", "call", "check_call", "check_output",
 
 _SOCKETISH = ("sock", "stream", "conn")
 
+# container-method calls that MUTATE their receiver: `self._q.append(x)`
+# is a write to `_q` for guard purposes, same as `self._q = ...`
+_MUTATORS = frozenset((
+    "append", "appendleft", "add", "pop", "popleft", "popitem",
+    "update", "extend", "extendleft", "remove", "discard", "clear",
+    "insert", "setdefault", "rotate", "sort", "reverse",
+))
+
 
 class LockDef:
     """One discovered lock object."""
@@ -119,7 +127,7 @@ class FuncInfo:
     __slots__ = ("key", "relpath", "qual", "cls", "line",
                  "acquires", "with_edges", "calls", "blocking",
                  "callbacks", "resolved_calls", "imports",
-                 "thread_targets", "sleeps_in_loop")
+                 "thread_targets", "sleeps_in_loop", "attr_uses")
 
     def __init__(self, key: str, relpath: str, qual: str,
                  cls: Optional[str], line: int):
@@ -142,6 +150,13 @@ class FuncInfo:
         self.thread_targets: List[Tuple[tuple, str, int]] = []
         # time.sleep call lines sitting inside a while-loop body
         self.sleeps_in_loop: List[int] = []
+        # attribute/global access sites with the held-lock set at each:
+        # (kind 'w'|'r', field key 'Class.attr'|'module:name', line,
+        # held) — the guarded-by rule's raw material. Only resolvable
+        # receivers are recorded (self.X, typed receivers, declared
+        # globals); an access the model cannot attribute to a class is
+        # skipped, never guessed
+        self.attr_uses: List[Tuple[str, str, int, Tuple[str, ...]]] = []
 
 
 class _ModuleMaps:
@@ -158,6 +173,17 @@ class _ModuleMaps:
         self.socket_aliases: Set[str] = set()
         self.direct_sleep: Set[str] = set()
         self.direct_subprocess: Set[str] = set()
+        # names assigned at module top level (mutable module state the
+        # guarded-by rule tracks writes/reads of)
+        self.module_globals: Set[str] = set()
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.module_globals.add(tgt.id)
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                self.module_globals.add(node.target.id)
         for node in ast.walk(sf.tree):
             if isinstance(node, ast.Import):
                 for a in node.names:
@@ -230,6 +256,13 @@ class LockModel:
         self._attr_types: Dict[Tuple[str, str], str] = {}
         self._var_types: Dict[Tuple[str, str], str] = {}
         self._event_attrs: Set[Tuple[str, str]] = set()  # (cls, attr)
+        # threading.Condition attributes/globals: a Condition IS a
+        # mutex for guarded-by purposes, but it never joins the lock
+        # graph (its with-regions are tracked on a separate stack so
+        # the pinned edge set and the blocking/callback rules are
+        # unaffected)
+        self._cond_attrs: Set[Tuple[str, str]] = set()   # (cls, attr)
+        self._cond_vars: Set[Tuple[str, str]] = set()    # (mod, name)
         # edges: (a, b) -> (relpath, line, chain) first witness
         self.edges: Dict[Tuple[str, str],
                          Tuple[str, int, Tuple[str, ...]]] = {}
@@ -323,6 +356,12 @@ class LockModel:
             val = node.value
             if not isinstance(val, ast.Call):
                 return
+            # fluent chains (`Adder().expose("name")` returns the
+            # Adder): unwrap to the constructor call so the bound
+            # name still gets its receiver type
+            while isinstance(val.func, ast.Attribute) and \
+                    isinstance(val.func.value, ast.Call):
+                val = val.func.value
             fn = val.func
             cls_name = None
             if isinstance(fn, ast.Name):
@@ -331,20 +370,25 @@ class LockModel:
                 cls_name = fn.attr
             if cls_name is None:
                 return
-            is_event = (cls_name == "Event"
-                        and isinstance(fn, ast.Attribute)
-                        and isinstance(fn.value, ast.Name)
-                        and fn.value.id == "threading")
+            is_threading = (isinstance(fn, ast.Attribute)
+                            and isinstance(fn.value, ast.Name)
+                            and fn.value.id == "threading")
+            is_event = cls_name == "Event" and is_threading
+            is_cond = cls_name == "Condition" and is_threading
             for tgt in node.targets:
                 if isinstance(tgt, ast.Attribute) and \
                         isinstance(tgt.value, ast.Name) and \
                         tgt.value.id == "self" and cls:
                     if is_event:
                         self._event_attrs.add((cls[-1], tgt.attr))
+                    elif is_cond:
+                        self._cond_attrs.add((cls[-1], tgt.attr))
                     elif cls_name in self.ctx.classes:
                         self._attr_types[(cls[-1], tgt.attr)] = cls_name
                 elif isinstance(tgt, ast.Name) and not cls:
-                    if cls_name in self.ctx.classes and not is_event:
+                    if is_cond:
+                        self._cond_vars.add((maps.modname, tgt.id))
+                    elif cls_name in self.ctx.classes and not is_event:
                         self._var_types[(maps.modname, tgt.id)] = cls_name
 
         V().visit(sf.tree)
@@ -772,11 +816,22 @@ class _FuncWalk(ast.NodeVisitor):
         self.info = info
         self.cls = cls
         self.held: List[str] = []
+        # Condition-guarded regions: a parallel stack feeding ONLY the
+        # attr_uses held tuples (conditions are mutexes for guard
+        # inference but stay out of the lock graph / blocking rules)
+        self.cond_held: List[str] = []
         self.loops = 0                    # while-loop nesting depth
         self.awaited: Set[int] = set()
         self.local_events: Set[str] = set()
         self.local_sockets: Set[str] = set()
         self.with_ctxs: Set[str] = set()   # receivers used as `with X:`
+        self.globals_decl: Set[str] = set()   # `global x` names
+        self.local_stores: Set[str] = set()   # names assigned locally
+        # Attribute/Name nodes that are WRITES despite Load ctx (the
+        # receiver of a subscript store / del / mutating method call)
+        self._sub_writes: Set[int] = set()
+        # Attribute nodes that are a call's method slot, not field reads
+        self._method_attrs: Set[int] = set()
 
     def walk(self, func) -> None:
         for node in ast.walk(func):
@@ -788,6 +843,12 @@ class _FuncWalk(ast.NodeVisitor):
                     r = _recv_name(item.context_expr)
                     if r:
                         self.with_ctxs.add(r)
+            if isinstance(node, ast.Global):
+                self.globals_decl.update(node.names)
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Store):
+                self.local_stores.add(node.id)
+        self.local_stores -= self.globals_decl
         for child in func.body:
             self.visit(child)
 
@@ -814,8 +875,20 @@ class _FuncWalk(ast.NodeVisitor):
         self.generic_visit(node)
         self.loops -= 1
 
+    def _cond_name(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and self.cls and \
+                (self.cls, expr.attr) in self.model._cond_attrs:
+            return f"{self.cls}.{expr.attr}"
+        if isinstance(expr, ast.Name) and \
+                (self.maps.modname, expr.id) in self.model._cond_vars:
+            return f"{self.maps.short}:{expr.id}"
+        return None
+
     def visit_With(self, node: ast.With) -> None:
         entered = 0
+        cond_entered = 0
         for item in node.items:
             name = self.model.lock_at(item.context_expr, self.maps,
                                       self.cls)
@@ -825,14 +898,92 @@ class _FuncWalk(ast.NodeVisitor):
                 self.info.acquires.append((name, node.lineno))
                 self.held.append(name)
                 entered += 1
+            else:
+                cname = self._cond_name(item.context_expr)
+                if cname:
+                    self.cond_held.append(cname)
+                    cond_entered += 1
         for child in node.body:
             self.visit(child)
         for _ in range(entered):
             self.held.pop()
+        for _ in range(cond_entered):
+            self.cond_held.pop()
 
     visit_AsyncWith = visit_With
 
+    # -------------------------------------------- attribute use sites
+    def _field_key(self, node: ast.Attribute) -> Optional[str]:
+        """'Class.attr' / 'module:name' for a resolvable receiver, else
+        None (never guessed)."""
+        attr = node.attr
+        if attr.startswith("__"):
+            return None
+        base = node.value
+        if isinstance(base, ast.Name):
+            if base.id == "self":
+                return f"{self.cls}.{attr}" if self.cls else None
+            # ClassName.attr class-var access (known class)
+            if base.id in self.model._class_methods:
+                return f"{base.id}.{attr}"
+        rtype = self.model._receiver_type(base, self.maps, self.cls)
+        if rtype:
+            return f"{rtype}.{attr}"
+        return None
+
+    def _mark_sub_write(self, tgt: ast.AST) -> None:
+        """`x[k] = v` / `del x[k]` / `x[k] += v` mutate the container
+        `x` even though the receiver node carries Load ctx."""
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._mark_sub_write(el)
+        elif isinstance(tgt, ast.Starred):
+            self._mark_sub_write(tgt.value)
+        elif isinstance(tgt, ast.Subscript):
+            v = tgt.value
+            if isinstance(v, (ast.Attribute, ast.Name)):
+                self._sub_writes.add(id(v))
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if id(node) not in self._method_attrs:
+            key = self._field_key(node)
+            if key is not None:
+                if isinstance(node.ctx, (ast.Store, ast.Del)) or \
+                        id(node) in self._sub_writes:
+                    kind = "w"
+                else:
+                    kind = "r"
+                self.info.attr_uses.append(
+                    (kind, key, node.lineno,
+                     tuple(self.held) + tuple(self.cond_held)))
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        name = node.id
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            if name in self.globals_decl:
+                self.info.attr_uses.append(
+                    ("w", f"{self.maps.short}:{name}", node.lineno,
+                     tuple(self.held) + tuple(self.cond_held)))
+        elif name in self.maps.module_globals and \
+                name not in self.local_stores:
+            kind = "w" if id(node) in self._sub_writes else "r"
+            self.info.attr_uses.append(
+                (kind, f"{self.maps.short}:{name}", node.lineno,
+                 tuple(self.held) + tuple(self.cond_held)))
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._mark_sub_write(node.target)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            self._mark_sub_write(t)
+        self.generic_visit(node)
+
     def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._mark_sub_write(t)
         val = node.value
         if isinstance(val, ast.Call):
             fn = val.func
@@ -852,6 +1003,18 @@ class _FuncWalk(ast.NodeVisitor):
     def visit_Call(self, node: ast.Call) -> None:
         held = tuple(self.held)
         fn = node.func
+        if isinstance(fn, ast.Attribute):
+            # the method slot itself is not a field read; a mutating
+            # container method IS a write to its receiver
+            self._method_attrs.add(id(fn))
+            if fn.attr in _MUTATORS and \
+                    isinstance(fn.value, (ast.Attribute, ast.Name)) and \
+                    self.model._receiver_type(
+                        fn.value, self.maps, self.cls) is None:
+                # typed receivers (Adder.add, Maxer.update...) are
+                # domain calls, not raw container mutations — the
+                # callee class's own fields get their own analysis
+                self._sub_writes.add(id(fn.value))
         self._note_thread_target(node)
         handled = False
         # manual acquire of a discovered lock = acquisition event

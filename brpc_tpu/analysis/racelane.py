@@ -472,6 +472,254 @@ def replay_pair(setup, thread_a, thread_b, seed: int,
             "stats": stats()}
 
 
+# ------------------------------------------------- field-race replay
+#
+# The guarded-by rule's dynamic complement: a flagged site is a source
+# LINE that mutates a field with the inferred guard not held. Replaying
+# it means running the two implicated code paths on two threads while a
+# per-thread trace hook injects a seeded GIL yield every time a racer
+# is ABOUT to execute a flagged line — exactly the window a racing peer
+# needs between the site's check and its act. A finding whose replay
+# breaks the caller-supplied invariant ships as CONFIRMED with this
+# reproducer (seed + sites); the rest stay ranked PLAUSIBLE.
+
+def _parse_site(site) -> Tuple[str, int]:
+    """'pkg/mod.py:123' or ('mod.py', 123) -> ('mod.py', 123)."""
+    if isinstance(site, str):
+        path, _, ln = site.rpartition(":")
+        return os.path.basename(path), int(ln)
+    path, ln = site
+    return os.path.basename(str(path)), int(ln)
+
+
+def _make_tracer(files, lines, quals, seed, tix):
+    """One tracer per racer thread. The call-event filter keeps the
+    line hook out of every frame not under watch, so the replay's
+    overhead stays on the implicated functions only. Yield decisions
+    are a pure function of (seed, thread index, site, hit #) — the
+    schedule replays exactly, run after run."""
+    k = [0]
+
+    leaves = {q.split(".")[-1] for q in quals}
+
+    def match_qual(code) -> bool:
+        # suffix match on a dot boundary: functions built inside a
+        # factory carry '<locals>.' prefixes in co_qualname. Before
+        # 3.11 code objects have no co_qualname — fall back to the
+        # bare name (a looser match that only ever ADDS yield points)
+        qual = getattr(code, "co_qualname", None)
+        if qual is None:
+            return code.co_name in leaves
+        return any(qual == q or qual.endswith("." + q) for q in quals)
+
+    def line_hook(frame, event, arg):
+        if event != "line":
+            return line_hook
+        code = frame.f_code
+        bn = os.path.basename(code.co_filename)
+        if (bn, frame.f_lineno) in lines or match_qual(code):
+            k[0] += 1
+            h = zlib.crc32(f"{seed}|{tix}|{bn}:{frame.f_lineno}|"
+                           f"{k[0]}".encode())
+            if h % 2 == 0:
+                _state.yields += 1
+                # a POSITIVE sleep, unlike the lock twins' sleep(0):
+                # a zero sleep often re-acquires the GIL before the
+                # peer's condvar wakes, silently serializing the
+                # replay — 20us forces a real handoff into the window
+                time.sleep(0.00002)
+        return line_hook
+
+    def call_hook(frame, event, arg):
+        code = frame.f_code
+        if os.path.basename(code.co_filename) in files or \
+                match_qual(code):
+            return line_hook
+        return None
+
+    return call_hook
+
+
+def replay_field_race(setup, racer_a, racer_b, sites, seed: int = 0,
+                      check=None, timeout_s: float = 10.0) -> dict:
+    """Replay a guarded-by finding as a concrete interleaving.
+
+    ``setup()`` builds the victim object; ``racer_a``/``racer_b`` are
+    the two implicated code paths (callables taking the object);
+    ``sites`` mixes flagged source lines (``'path.py:123'`` strings or
+    ``(file, line)`` pairs) with function qualnames (every line of the
+    function is a yield point — drift-proof against edits). After both
+    racers finish, ``check(obj)`` validates the field's invariant; its
+    message is the reproducer's evidence. Returns ``{seed, completed,
+    site_yields, ok, evidence}``."""
+    lines = set()
+    quals = set()
+    for s in sites:
+        if isinstance(s, str) and ":" not in s:
+            quals.add(s)
+        else:
+            lines.add(_parse_site(s))
+    files = {f for f, _ in lines}
+    y0 = _state.yields
+    obj = setup()
+    done = [False, False]
+    errs: List[str] = []
+    # both racers align here before racing: without it the first
+    # thread routinely finishes before the second's OS thread even
+    # starts, and a serialized run can confirm nothing
+    barrier = threading.Barrier(2)
+
+    def run(fn, i):
+        barrier.wait(timeout_s)
+        sys.settrace(_make_tracer(files, lines, quals, seed, i))
+        try:
+            fn(obj)
+        except Exception as e:   # noqa: BLE001 - the report carries it
+            errs.append(f"racer_{'ab'[i]}: {e!r}")
+        finally:
+            sys.settrace(None)
+            done[i] = True
+
+    ta = threading.Thread(target=run, args=(racer_a, 0), daemon=True)
+    tb = threading.Thread(target=run, args=(racer_b, 1), daemon=True)
+    ta.start()
+    tb.start()
+    ta.join(timeout_s)
+    tb.join(timeout_s)
+    completed = all(done)
+    evidence = list(errs)
+    ok = completed and not errs
+    if ok and check is not None:
+        try:
+            verdict = check(obj)
+            if verdict not in (None, True):
+                ok = False
+                evidence.append(str(verdict))
+        except AssertionError as e:
+            ok = False
+            evidence.append(str(e) or "invariant check failed")
+    if not completed:
+        evidence.append(f"racers hung past {timeout_s}s "
+                        "(potential deadlock; daemons abandoned)")
+    return {"seed": seed, "completed": completed,
+            "site_yields": _state.yields - y0,
+            "ok": ok, "evidence": evidence}
+
+
+# The suspicious-pair list the preflight smoke replays: each entry is a
+# named builder returning (setup, racer_a, racer_b, sites, check,
+# expect_race). `expect_race=True` rows are positive controls — the
+# replay MUST break their invariant (the harness detects real races);
+# `False` rows are fixed findings — the replay must leave the
+# invariant intact (the regression stays dead at this seed).
+
+def _pair_unguarded_counter():
+    """Positive control: the textbook lost update. The read-modify-
+    write is split across two lines so the line hook can yield inside
+    the window; 2x200 increments with no lock must lose some."""
+    class _Cell:
+        def __init__(self):
+            self.x = 0
+
+        def bump(self):
+            t = self.x
+            self.x = t + 1
+
+    def racer(o):
+        for _ in range(200):
+            o.bump()
+
+    def check(o):
+        assert o.x == 400, f"lost update: {o.x}/400 after 2x200 bumps"
+
+    return _Cell, racer, racer, ["_Cell.bump"], check, True
+
+
+def _pair_guarded_counter():
+    """The same counter with its guard held: zero lost updates under
+    the identical yield schedule — the twin that proves detection is
+    the race, not the harness."""
+    class _Cell:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.x = 0
+
+        def bump(self):
+            with self._lock:
+                t = self.x
+                self.x = t + 1
+
+    def racer(o):
+        for _ in range(200):
+            o.bump()
+
+    def check(o):
+        assert o.x == 400, f"guarded counter lost updates: {o.x}"
+
+    return _Cell, racer, racer, ["_Cell.bump"], check, False
+
+
+def _pair_taskcontrol_stop_vs_start():
+    """The fixed ISSUE-16 finding: TaskControl.stop_and_join used to
+    clear _threads and drop _started/_stop with no lock while start()
+    published the pool under _start_lock — a start() landing in the
+    teardown window left a pool that CLAIMS started with every worker
+    dead (spawned fibers never run). Yields at every line of both
+    verbs drive the interleaving; the invariant is 'started implies a
+    live worker'."""
+    from brpc_tpu.fiber.scheduler import TaskControl
+
+    def setup():
+        return TaskControl(concurrency=2, name="racelane_tc")
+
+    def starter(tc):
+        for _ in range(6):
+            tc.start()
+            time.sleep(0)
+
+    def stopper(tc):
+        for _ in range(6):
+            tc.stop_and_join(timeout=2.0)
+
+    def check(tc):
+        try:
+            with tc._start_lock:
+                started = tc._started
+                alive = [t for t in tc._threads if t.is_alive()]
+            assert not started or alive, (
+                "pool claims started with no live worker: start() "
+                "landed inside stop_and_join's teardown window")
+        finally:
+            tc.stop_and_join(timeout=2.0)
+
+    return (setup, starter, stopper,
+            ["TaskControl.start", "TaskControl.stop_and_join"],
+            check, False)
+
+
+SUSPICIOUS_PAIRS = [
+    ("unguarded-counter", _pair_unguarded_counter),
+    ("guarded-counter", _pair_guarded_counter),
+    ("taskcontrol-stop-vs-start", _pair_taskcontrol_stop_vs_start),
+]
+
+
+def replay_suspicious_pairs(seed: int = 0) -> dict:
+    """Run every registered pair; ok = every positive control raced
+    and every fixed finding held its invariant."""
+    out: dict = {"pairs": {}, "ok": True}
+    for name, build in SUSPICIOUS_PAIRS:
+        setup, ra, rb, sites, check, expect_race = build()
+        r = replay_field_race(setup, ra, rb, sites, seed=seed,
+                              check=check)
+        raced = not r["ok"]
+        good = r["completed"] and (raced == expect_race)
+        out["pairs"][name] = {"expect_race": expect_race,
+                              "raced": raced, **r}
+        out["ok"] = out["ok"] and good
+    return out
+
+
 # ------------------------------------------------------------- smoke
 
 def _smoke() -> dict:
@@ -570,8 +818,13 @@ def _smoke() -> dict:
                            "violations": real_viol[:5],
                            "stats": stats()}
     report["real_code_clean"] = not errs and not real_viol
+
+    # -- (3) the guarded-by suspicious-pair list: positive controls
+    # must race, fixed findings must hold their invariant
+    report["field_races"] = replay_suspicious_pairs(_state.seed)
     report["ok"] = bool(detected and deterministic
-                        and report["real_code_clean"])
+                        and report["real_code_clean"]
+                        and report["field_races"]["ok"])
     return report
 
 

@@ -11,6 +11,7 @@ def default_rules() -> List[Rule]:
     from brpc_tpu.analysis.rules.block_recycle import BlockRecycleRule
     from brpc_tpu.analysis.rules.event_wait import EventWaitNotSleepRule
     from brpc_tpu.analysis.rules.fiber_blocking import FiberBlockingRule
+    from brpc_tpu.analysis.rules.guarded_by import GuardedByRule
     from brpc_tpu.analysis.rules.iobuf_aliasing import IOBufAliasingRule
     from brpc_tpu.analysis.rules.judge_defer import JudgeDeferRule
     from brpc_tpu.analysis.rules.lock_graph import (
@@ -29,7 +30,8 @@ def default_rules() -> List[Rule]:
     from brpc_tpu.analysis.rules.span_finish import SpanFinishRule
     return [BlockRecycleRule(), BlockingUnderLockRule(),
             CallbackUnderLockRule(), EventWaitNotSleepRule(),
-            FiberBlockingRule(), IOBufAliasingRule(), JudgeDeferRule(),
+            FiberBlockingRule(), GuardedByRule(),
+            IOBufAliasingRule(), JudgeDeferRule(),
             LockCycleRule(), MemoryviewReleaseRule(),
             PostforkResetRule(), RegistryCompleteRule(),
             SamplerNoLazyImportRule(), SpanFinishRule()]

@@ -393,6 +393,10 @@ class IOBuf:
             self.append_buf(data)
             return
         if isinstance(data, bytes) and len(data) >= _APPEND_ZEROCOPY_MIN:
+            # graftlint: disable=guarded-by -- IOBuf is single-owner
+            # (bRPC's buffer contract): concurrent mutation is a caller
+            # bug; ownership moves whole through locked queues, so the
+            # next owner reads behind the publishing lock's barrier.
             self._refs.append(
                 BlockRef(Block.from_user_data(data), 0, len(data)))
             return

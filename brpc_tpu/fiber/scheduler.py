@@ -353,13 +353,24 @@ class TaskControl:
 
     def stop_and_join(self, timeout: float = 5.0) -> None:
         self._stop = True
-        for _ in self._threads:
-            self.parking_lot.signal(len(self._threads))
-        for t in self._threads:
+        with self._start_lock:
+            # claim the pool under the same lock start() publishes it
+            # with; _started stays True through the join so a racing
+            # start() keeps no-opping instead of spawning a doomed
+            # pool that would only see _stop and exit
+            threads = list(self._threads)
+            self._threads.clear()
+        for _ in threads:
+            self.parking_lot.signal(len(threads))
+        for t in threads:
             t.join(timeout)
-        self._threads.clear()
-        self._started = False
-        self._stop = False
+        with self._start_lock:
+            # both flags flip in one critical section: dropping
+            # _started with _stop still True would let a racing
+            # start() spawn workers that instantly see _stop and
+            # exit — a pool that claims started with nothing alive
+            self._started = False
+            self._stop = False
 
     # -------------------------------------------------------------- spawn
     def spawn(self, fn: Callable | Any, *args, name: str = "", urgent: bool = False,

@@ -381,7 +381,8 @@ class Recorder:
                     # a full/broken disk (or any writer bug) must
                     # never take serving down; the records are lost,
                     # the counter says so
-                    self.dropped_queue += len(batch)
+                    with self._lock:
+                        self.dropped_queue += len(batch)
                     ndropped_queue.add(len(batch))
             if stopping:
                 w, self._writer = self._writer, None
@@ -394,7 +395,12 @@ class Recorder:
                 return
 
     def _write_batch(self, batch) -> None:
-        cfg = self._cfg
+        with self._lock:
+            # start() swaps cfg and the clock anchor under _lock on a
+            # runtime reconfigure while this thread is still draining:
+            # snapshot both together or the wall stamps mix anchors
+            cfg = self._cfg
+            wall0, mono0 = self._clock_anchor
         w = self._writer
         if w is None or os.path.dirname(w.path) != cfg.dir:
             if w is not None:
@@ -406,7 +412,6 @@ class Recorder:
                 except OSError:
                     pass
             w = self._open_writer(cfg)
-        wall0, mono0 = self._clock_anchor
         batch_bytes = 0
         for i, (rec, code, lat_us) in enumerate(batch):
             # wall stamp derived here, off the hot path, from the
@@ -421,7 +426,8 @@ class Recorder:
                 # swap must not blow a single file far past the bound
                 w.close()
                 self._closed_files.append(w.path)
-                self.rotations += 1
+                with self._lock:
+                    self.rotations += 1
                 self._enforce_disk_budget(cfg)
                 w = self._open_writer(cfg)
             if not (i + 1) % 64:
@@ -430,10 +436,14 @@ class Recorder:
                 # thread behind the GIL switch interval
                 time.sleep(0)
         w.flush()
-        self.written += len(batch)
-        # session total, not the active file's size — rotation must
-        # not make the page's byte counter fall back to zero
-        self.written_bytes += batch_bytes
+        with self._lock:
+            # these increments race start()'s counter reset when a
+            # restart lands while the old writer is still draining:
+            # unguarded they can resurrect a zeroed counter
+            self.written += len(batch)
+            # session total, not the active file's size — rotation
+            # must not make the page's byte counter fall back to zero
+            self.written_bytes += batch_bytes
         nwritten.add(len(batch))
 
     def _open_writer(self, cfg: CaptureConfig) -> CorpusWriter:
@@ -468,7 +478,8 @@ class Recorder:
                 except OSError:
                     pass
                 total -= sz
-                self.deleted_files += 1
+                with self._lock:
+                    self.deleted_files += 1
         except OSError:
             pass
 

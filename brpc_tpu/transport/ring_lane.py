@@ -233,6 +233,10 @@ class RingDispatcher:
             self._handlers.pop(fd, None)
             self._ring.unregister_fd(fd)
             self._tick_dead.add(fd)
+            # graftlint: disable=guarded-by -- _pending_writes is
+            # ring-thread owned (defer/settle on the tick); this one
+            # teardown pop from another thread holds _lock while the
+            # native generation guard stales any in-flight CQE for fd.
             pend = self._pending_writes.pop(fd, None)
         if pend is not None:
             # a deferred uring gather was still in flight: its CQE is
@@ -269,6 +273,9 @@ class RingDispatcher:
         # ring-thread only (the thread-local gate in try_defer_write);
         # the socket's push already claimed writership, which the tick
         # flush now owns until settle
+        # graftlint: disable=guarded-by -- _flush is ring-thread
+        # confined: the thread-local gate admits only the tick thread,
+        # a single writer that needs no lock.
         self._flush.append(sock)
         return True
 

@@ -622,6 +622,10 @@ class Socket:
         nwrites.add(1)
         self.last_active_ns = time.monotonic_ns()
         sz = data.size if isinstance(data, IOBuf) else len(data)
+        # graftlint: disable=guarded-by -- wq_bytes is approximate
+        # accounting beside the wait-free write queue: a lock here
+        # would sit on every submit of every thread, and drift only
+        # skews an observability gauge, never the queue itself.
         self.wq_bytes += sz
         nwqueue_bytes.add(sz)
         _wqueue_peak.update(self.wq_bytes)

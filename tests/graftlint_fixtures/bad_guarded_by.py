@@ -1,0 +1,72 @@
+"""Seeded guarded-by violations, one per rule branch:
+
+* ``SlopPyDepot.total``: ten write sites hold ``_lock`` (>= 90% — the
+  guard infers) and one, on the flush thread, does not -> [CONFIRMED]
+  write without the inferred guard, witness chain attached;
+* ``SlopPyDepot.total`` read in ``audit``: the reader is an external
+  caller, the writers all run on the flush thread — disjoint roles ->
+  [PLAUSIBLE] read without the guard;
+* ``CrossRoleBox.state``: written by its worker thread AND by the
+  external ``poke`` with no common lock -> [CONFIRMED] cross-role
+  unguarded writes (the highest-ranked class of finding);
+* ``CrossRoleBox.waived_state``: the same cross-role pattern under a
+  reasoned waiver -> suppressed, lands in the waived list.
+"""
+
+import threading
+
+
+class SlopPyDepot:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self._stop = False
+        self._thread = threading.Thread(target=self._flush_loop,
+                                        daemon=True)
+
+    def _flush_loop(self):
+        while not self._stop:
+            self._settle()
+            self._unguarded_bump()
+
+    def _settle(self):
+        # ten guarded writes: the inference sees _lock at 10/11 sites
+        with self._lock:
+            self.total += 1
+            self.total += 2
+            self.total += 3
+            self.total += 4
+            self.total += 5
+            self.total += 6
+            self.total += 7
+            self.total += 8
+            self.total += 9
+            self.total += 10
+
+    def _unguarded_bump(self):
+        self.total += 1          # the guarded-elsewhere write
+
+    def audit(self):
+        return self.total        # external read, flush-thread writers
+
+
+class CrossRoleBox:
+    def __init__(self):
+        self.state = 0
+        self.waived_state = 0
+        self._stop = False
+        self._thread = threading.Thread(target=self._worker,
+                                        daemon=True)
+
+    def _worker(self):
+        while not self._stop:
+            self.state += 1
+            # graftlint: disable=guarded-by -- fixture: a deliberate
+            # lock-free increment, approximate by design
+            self.waived_state += 1
+
+    def poke(self):
+        self.state = 0           # external writer, no common lock
+        # graftlint: disable=guarded-by -- fixture: a deliberate
+        # lock-free increment, approximate by design
+        self.waived_state = 0

@@ -1,0 +1,49 @@
+"""Clean guarded-by fixtures: a class whose every non-constructor
+write holds its one lock (the guard infers and all sites comply), and
+a single-writer field confined to its spawning thread (one role, no
+lock needed, no finding). Zero findings expected."""
+
+import threading
+
+
+class GuardedLedger:
+    """Every write site holds _lock: the guard infers at 100% and the
+    rule stays quiet, including on the lock-free read (reads need the
+    guard only when the reader's roles are disjoint from the writers';
+    here both paths are external callers)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.balance = 0
+        self.entries = []
+
+    def deposit(self, amount):
+        with self._lock:
+            self.balance += amount
+            self.entries.append(amount)
+
+    def reset(self):
+        with self._lock:
+            self.balance = 0
+            self.entries = []
+
+    def peek(self):
+        return self.balance
+
+
+class ConfinedCounter:
+    """The tick thread is the only writer of .ticks: a single ad-hoc
+    thread role, so there is no cross-role pair to race and the rule
+    grants single-writer silence without any lock."""
+
+    def __init__(self):
+        self.ticks = 0
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop:
+            self._bump()
+
+    def _bump(self):
+        self.ticks += 1

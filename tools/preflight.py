@@ -294,6 +294,75 @@ def _fresh_ctx(files):
     return Context(files)
 
 
+def gate_guard_lint() -> dict:
+    """The guarded-by lane: zero unwaivered CONFIRMED findings on the
+    full tree (PLAUSIBLE rows are ranked advice, not gate failures),
+    plus a mutation smoke proving the rule still bites — re-stripping
+    the two lock holds ISSUE 16 added (Recorder._write_batch's counter
+    block, TaskControl.stop_and_join's pool teardown) must re-surface
+    their cross-role CONFIRMED findings. BRPC_TPU_GUARD_LINT=0
+    skips."""
+    if os.environ.get("BRPC_TPU_GUARD_LINT", "1") == "0":
+        return {"ok": True, "skipped": "BRPC_TPU_GUARD_LINT=0"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "brpc_tpu.analysis", "brpc_tpu",
+         "--rules", "guarded-by", "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    out: dict = {}
+    try:
+        report = json.loads(proc.stdout)
+        confirmed = [f for f in report["active"]
+                     if "[CONFIRMED]" in f["message"]]
+        out["ok"] = not confirmed
+        out["confirmed"] = len(confirmed)
+        out["plausible"] = len(report["active"]) - len(confirmed)
+        out["waived"] = len(report["waived"])
+        if confirmed:
+            out["findings"] = [
+                f"{f['path']}:{f['line']}: {f['message']}"
+                for f in confirmed[:10]]
+    except (ValueError, KeyError):
+        out["ok"] = False
+        out["error"] = (proc.stdout + proc.stderr)[-500:]
+        return out
+    # mutation smoke: the real tree with this PR's own fixes reverted
+    # must re-flag the races they closed
+    try:
+        from brpc_tpu.analysis.core import SourceFile, iter_source_files
+        from brpc_tpu.analysis.rules.guarded_by import GuardedByRule
+        muts = []
+        for relpath, field, old, new in (
+            ("brpc_tpu/traffic/capture.py", "Recorder.written",
+             "        w.flush()\n        with self._lock:\n",
+             "        w.flush()\n        if True:\n"),
+            ("brpc_tpu/fiber/scheduler.py", "TaskControl._threads",
+             "        with self._start_lock:\n"
+             "            # claim the pool under the same lock",
+             "        if True:\n"
+             "            # claim the pool under the same lock"),
+        ):
+            files = iter_source_files(
+                [os.path.join(REPO_ROOT, "brpc_tpu")])
+            path = os.path.join(REPO_ROOT, relpath)
+            src = open(path).read()
+            mutated = src.replace(old, new)
+            assert mutated != src, relpath
+            files = [SourceFile(path, relpath, mutated)
+                     if sf.relpath == relpath else sf for sf in files]
+            found = list(GuardedByRule().finalize(_fresh_ctx(files)))
+            muts.append((field, any(
+                f.path == relpath and field in f.message
+                and "[CONFIRMED]" in f.message for f in found)))
+        out["mutations"] = {name: fired for name, fired in muts}
+        if not all(fired for _, fired in muts):
+            out["ok"] = False
+            out["error"] = "mutation smoke: a stripped guard went unseen"
+    except Exception as e:  # noqa: BLE001 - gate must report, not die
+        out["ok"] = False
+        out["error"] = f"mutation smoke failed: {type(e).__name__}: {e}"
+    return out
+
+
 def gate_racelane() -> dict:
     """The racelane seeded-interleaving smoke (python -m
     brpc_tpu.analysis.racelane --smoke under BRPC_TPU_LOCK_DEBUG=1): a
@@ -318,6 +387,13 @@ def gate_racelane() -> dict:
                   "real_code_clean"):
             out[k] = report.get(k)
         out["stats"] = report.get("real_code", {}).get("stats")
+        fr = report.get("field_races", {})
+        out["field_races"] = {
+            name: {"expect_race": p.get("expect_race"),
+                   "raced": p.get("raced"),
+                   "evidence": p.get("evidence", [])[:2]}
+            for name, p in fr.get("pairs", {}).items()}
+        out["field_races_ok"] = fr.get("ok")
     except ValueError:
         out["ok"] = False
         out["error"] = (proc.stdout + proc.stderr)[-500:]
@@ -784,6 +860,7 @@ def run_gate() -> int:
     report = {}
     for name, fn in (("graftlint", gate_graftlint),
                      ("locklint", gate_locklint),
+                     ("guard_lint", gate_guard_lint),
                      ("racelane", gate_racelane),
                      ("sanitizer_smoke", gate_sanitizer_smoke),
                      ("ring_lane", gate_ring_lane),
