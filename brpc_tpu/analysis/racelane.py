@@ -126,6 +126,11 @@ LOCK_ORDER: List[Tuple[str, str]] = [
     # bvar registration must never nest under a registry lock); the
     # lock guards the dict insert/snapshot only (rpc/retry_policy.py)
     ("retry_policy:_group_lock",    "rpc/retry_policy.py"),
+    # leaf: the incident manager's window state — arm/seal decisions
+    # settle under it on the sampler tick, but recorder control, the
+    # bundler thread spawn, and every disk write fire OUTSIDE it;
+    # never wraps another acquisition (incident/manager.py)
+    ("IncidentManager._lock",       "incident/manager.py"),
 ]
 
 _RANK: Dict[str, int] = {name: i for i, (name, _) in enumerate(LOCK_ORDER)}

@@ -191,6 +191,17 @@ def timeline_page_payload(server=None, names=None, prefix: str = "",
     }
 
 
+def incidents_page_payload(server=None) -> dict:
+    """The /incidents payload: incident-capture state, the artifact
+    ledger (id, trigger keys, size, snapshot inventory per artifact)
+    and the disk-budget accounting. ONE builder shared by the RPC
+    builtin service, the HTTP /incidents handler and the shard dump;
+    a shard-group SUPERVISOR serves the merged view instead
+    (ShardAggregator.merged_incidents)."""
+    from brpc_tpu.incident.manager import incidents_snapshot_payload
+    return incidents_snapshot_payload(server)
+
+
 def status_page(server) -> dict:
     """The /status payload: server state, per-method latency windows
     (qps + p50/p90/p99/max — "which method is slow" without scraping
@@ -253,9 +264,13 @@ def status_page(server) -> dict:
                 ("retry_tokens", "retry_tokens_min")):
             if pane_key in saturation and col.has_series(var_name):
                 timeline_links[pane_key] = f"/timeline?name={var_name}"
+    # capture-on-anomaly headline: open window / bundled artifacts /
+    # bytes on disk, linking to /incidents (incident/manager.py)
+    from brpc_tpu.incident.manager import incident_status_line
     return {
         "running": server.is_running,
         "endpoint": str(server.endpoint) if server.endpoint else None,
+        "incidents": incident_status_line(),
         "concurrency": server.concurrency,
         "processed": server.nprocessed,
         "errors": server.nerror,
@@ -366,6 +381,19 @@ def add_builtin_services(server) -> None:
                 cntl.set_failed(berr.EREQUEST, str(e))
                 return b""
         return json.dumps(capture_page_payload(server),
+                          default=str).encode()
+
+    @builtin.method()
+    def incidents(cntl, request):
+        # capture-on-anomaly state + artifact ledger — the builtin-RPC
+        # twin of HTTP /incidents, from the ONE shared builder. A
+        # shard-group SUPERVISOR serves the merged per-shard view
+        # instead (downloads stay on the HTTP side: binary body).
+        agg = getattr(server, "shard_aggregator", None)
+        if agg is not None:
+            return json.dumps(agg.merged_incidents(),
+                              default=str).encode()
+        return json.dumps(incidents_page_payload(server),
                           default=str).encode()
 
     @builtin.method()

@@ -21,6 +21,7 @@ TABS = [
     ("contentions", "/contentions"),
     ("census", "/census"),
     ("capture", "/capture"),
+    ("incidents", "/incidents"),
     ("serving", "/serving"),
     ("device", "/device"),
     ("backends", "/backends"),

@@ -72,21 +72,25 @@ _SPAN_ANNOTATE_MAX = 16
 
 # annotation collaborators, bound on the CALLER thread by
 # bind_watchdog_imports (never at sample time): rpc.span's collector
-# ring and the flight recorder's live window label
+# ring, the flight recorder's live window label, and the incident
+# manager (capture-on-anomaly, incident/manager.py)
 _span_mod = None
 _fr_mod = None
+_inc_mod = None
 
 
 def bind_watchdog_imports() -> None:
     """One-time import binding for the watchdog's annotation targets;
     runs on the thread that starts the serving stack (Server.start),
     mirroring flight_recorder._bind_sampler_imports."""
-    global _span_mod, _fr_mod
+    global _span_mod, _fr_mod, _inc_mod
     if _fr_mod is not None:
         return
     from brpc_tpu.builtin import flight_recorder as fr
+    from brpc_tpu.incident import manager as im
     from brpc_tpu.rpc import span as sm
-    _span_mod, _fr_mod = sm, fr
+    im.bind_incident_imports()
+    _span_mod, _fr_mod, _inc_mod = sm, fr, im
 
 
 def is_watch_key(name: str) -> bool:
@@ -175,6 +179,7 @@ class AnomalyWatchdog:
         z_close = float(flag("anomaly_z_close"))
         close_ticks = int(flag("anomaly_close_ticks"))
         opened: Optional[Incident] = None
+        closed: Optional[Incident] = None
         with self._lock:
             alerts = []
             any_hot = False
@@ -216,9 +221,16 @@ class AnomalyWatchdog:
                 self._open.calm += 1
                 if self._open.calm >= close_ticks:
                     self._open.closed_t = t
+                    closed = self._open
                     self._open = None
         if opened is not None:
             self._stamp_incident(opened)
+        # capture-on-anomaly hand-off, outside the leaf lock; called
+        # every tick (the manager's idle early-out is one attribute
+        # check) so an armed window keeps counting down on calm ticks
+        im = _inc_mod
+        if im is not None:
+            im.incident_sample_tick(opened, closed, t)
 
     # ----------------------------------------------------- annotation
     def _stamp_incident(self, inc: Incident) -> None:

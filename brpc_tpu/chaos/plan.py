@@ -34,6 +34,7 @@ wraps: ``"connect"`` (the dialing side's writes — requests) or
 
 from __future__ import annotations
 
+import json
 import random
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -168,6 +169,53 @@ class FaultPlan:
         p._refuse = {k: set(v) for k, v in self._refuse.items()}
         p._flaps = {k: dict(v) for k, v in self._flaps.items()}
         return p
+
+    # -------------------------------------------------- serialization
+    def to_json(self) -> str:
+        """The plan's full script (seed + every fault with its
+        endpoint/conn-index/byte-offset address) as one deterministic
+        JSON document — sorted keys, compact separators, so two plans
+        with the same script serialize byte-identically. Per-run state
+        (connection counters, fired log) is deliberately NOT part of
+        the document: a deserialized plan is always fresh."""
+        scripts = {
+            key: {str(idx): [{"kind": f.kind, "at_byte": f.at_byte,
+                              "delay_ms": f.delay_ms,
+                              "xor_mask": f.xor_mask, "side": f.side}
+                             for f in faults]
+                  for idx, faults in by_idx.items()}
+            for key, by_idx in self._scripts.items()}
+        doc = {"v": 1, "seed": self.seed, "scripts": scripts,
+               "refuse": {k: sorted(v) for k, v in self._refuse.items()},
+               "flaps": {k: {str(at): n for at, n in v.items()}
+                         for k, v in self._flaps.items()}}
+        return json.dumps(doc, sort_keys=True, separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        """Rebuild a fresh (unfired) plan from ``to_json()`` output.
+        Rebuilds through the scripting API so the same invariants hold
+        (kind validation, at_byte ordering, endpoint-key
+        canonicalization)."""
+        doc = json.loads(text)
+        v = doc.get("v")
+        if v != 1:
+            raise ValueError(f"unsupported FaultPlan document v={v!r}")
+        plan = cls(seed=int(doc.get("seed", 0)))
+        for key, by_idx in (doc.get("scripts") or {}).items():
+            for idx, faults in by_idx.items():
+                plan.at(key, int(idx), *(
+                    Fault(f["kind"], at_byte=int(f.get("at_byte", 0)),
+                          delay_ms=float(f.get("delay_ms", 0.0)),
+                          xor_mask=int(f.get("xor_mask", 0x01)),
+                          side=f.get("side", "connect"))
+                    for f in faults))
+        for key, idxs in (doc.get("refuse") or {}).items():
+            plan.refuse(key, *idxs)
+        for key, flaps in (doc.get("flaps") or {}).items():
+            for at, n in flaps.items():
+                plan.flap(key, int(at), refuse_next=int(n))
+        return plan
 
     def schemes(self) -> set:
         """Transport schemes this plan touches (what install() wraps)."""

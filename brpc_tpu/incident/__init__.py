@@ -1,0 +1,36 @@
+"""Incident time machine: capture-on-anomaly, bounded artifacts, and
+deterministic local reproduction.
+
+The watchdog (bvar/anomaly.py) detects the break; the traffic recorder
+(traffic/capture.py) knows how to record and warp-replay a corpus; this
+package connects them. When an incident opens, the manager flips the
+recorder into corpus-recording mode for a bounded tick window, then
+bundles the in-window corpus plus the observability snapshots that
+explain it (/timeline slice for the triggering keys, folded profile,
+/status, /device, /backends, the annotated rpcz spans) into one
+size-capped ``.brpcinc`` artifact under a disk budget. The other half
+(incident/replay.py, tools/incident_replay.py) turns an artifact back
+into a failing local run: derive a seeded chaos FaultPlan from the
+incident's error classes, replay the corpus against a fresh server
+under that plan, and assert the watchdog re-fires on the same key.
+"""
+
+from brpc_tpu.incident.artifact import (ArtifactWriter, SUFFIX,
+                                        artifact_files, artifact_summary,
+                                        read_artifact)
+from brpc_tpu.incident.manager import (IncidentManager,
+                                       attach_incident_server,
+                                       bind_incident_imports,
+                                       expose_incident_vars,
+                                       global_manager,
+                                       incident_sample_tick,
+                                       incident_status_line,
+                                       incidents_snapshot_payload)
+
+__all__ = [
+    "ArtifactWriter", "SUFFIX", "artifact_files", "artifact_summary",
+    "read_artifact", "IncidentManager", "attach_incident_server",
+    "bind_incident_imports", "expose_incident_vars", "global_manager",
+    "incident_sample_tick", "incident_status_line",
+    "incidents_snapshot_payload",
+]

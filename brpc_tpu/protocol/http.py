@@ -583,6 +583,8 @@ class HttpProtocol(Protocol):
                 census_page_payload(server), default=str).encode()
         if path == "/capture":
             return self._capture(server, req, agg=agg)
+        if path == "/incidents":
+            return self._incidents(server, req, agg=agg)
         if path == "/contentions":
             from brpc_tpu.fiber.contention import contention_report
             rows = contention_report(int(req.query.get("n", "30")))
@@ -599,6 +601,54 @@ class HttpProtocol(Protocol):
         return 404, "text/plain", f"no such page {req.path}".encode()
 
     # ------------------------------------------------- introspection pages
+    def _incidents(self, server, req: HttpRequest, agg=None):
+        """/incidents: capture-on-anomaly state + artifact ledger
+        (incident/manager.py), and the artifact download
+        (?action=download&path=...). On a shard-group SUPERVISOR the
+        state view merges per-shard incident sections (?shard=i
+        narrows to one shard's dump) and downloads resolve against
+        any shard's ledger."""
+        from brpc_tpu.builtin.services import incidents_page_payload
+        action = req.query.get("action", "")
+        if action == "download":
+            from brpc_tpu.incident.artifact import SUFFIX as _INC_SUFFIX
+            path = req.query.get("path", "")
+            if agg is not None:
+                rows = agg.merged_incidents().get("artifacts") or []
+            else:
+                rows = incidents_page_payload(server).get(
+                    "artifacts") or []
+            known = {r.get("path") for r in rows}
+            # ledger membership IS the authorization: an arbitrary
+            # ?path= must not read arbitrary files
+            if not path or path not in known \
+                    or not path.endswith(_INC_SUFFIX):
+                return 404, "text/plain", b"no such incident artifact"
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                return 404, "text/plain", b"artifact unreadable"
+            return 200, "application/octet-stream", data
+        if action:
+            return (400, "text/plain",
+                    f"unknown incidents action {action!r}".encode())
+        if agg is not None:
+            shard, err = _shard_param(agg, req)
+            if err is not None:
+                return err
+            if shard is not None:
+                dump = agg.shard_dump(shard)
+                if dump is None or not dump.get("incidents"):
+                    return (404, "text/plain",
+                            f"no incidents for shard {shard}".encode())
+                return 200, "application/json", json.dumps(
+                    dump["incidents"], default=str).encode()
+            return 200, "application/json", json.dumps(
+                agg.merged_incidents(), default=str).encode()
+        return 200, "application/json", json.dumps(
+            incidents_page_payload(server), default=str).encode()
+
     def _capture(self, server, req: HttpRequest, agg=None):
         """/capture: traffic-recorder state, runtime control
         (?action=start&dir=...&rate=..., ?action=stop) and the merged

@@ -441,6 +441,14 @@ class Server:
         # registry dropped the parent's recorder)
         from brpc_tpu.builtin.flight_recorder import global_recorder
         global_recorder().ensure_running()
+        # incident time machine: re-expose the incident bvars (the PR 2
+        # unexpose_all survival rule), hand the manager this server for
+        # its bundler snapshots, and prime the artifact ledger so
+        # artifacts surviving a restart show up immediately
+        from brpc_tpu.incident.manager import (attach_incident_server,
+                                               expose_incident_vars)
+        expose_incident_vars()
+        attach_incident_server(self)
         # trend rings + anomaly watchdog: make sure the bvar sampler's
         # tick thread runs even with no windowed reducers yet, and bind
         # the watchdog's annotation imports on THIS thread before the

@@ -138,6 +138,15 @@ def write_shard_dump(dirpath: str, index: int, server, seq: int) -> None:
         # /capture merges these and its download collects the per-pid
         # corpus files each shard names here
         doc["capture"] = rec.snapshot()
+    from brpc_tpu.incident.manager import global_manager
+    mgr = global_manager()
+    if mgr.window_engaged or mgr.bundled or mgr.artifact_rows():
+        # capture-on-anomaly state rides the dump once a shard has
+        # armed or bundled anything: the supervisor's /incidents
+        # merges these and its download resolves the per-shard
+        # artifact paths named here
+        from brpc_tpu.builtin.services import incidents_page_payload
+        doc["incidents"] = incidents_page_payload(server)
     path = os.path.join(dirpath, f"shard-{index}.json")
     tmp = path + f".tmp.{os.getpid()}"
     with open(tmp, "w", encoding="utf-8") as f:
@@ -432,6 +441,38 @@ class ShardAggregator:
         ctl = self._read_capture_control()
         if ctl is not None:
             out["control"] = ctl
+        return out
+
+    def merged_incidents(self) -> dict:
+        """The group-wide /incidents view: per-shard incident sections
+        concatenated (each artifact row tagged with its shard index,
+        sorted by open stamp then shard — the PR 13 incident-merge
+        discipline), counters and byte totals summed, the open-window
+        count across shards."""
+        dumps = self.read_dumps()
+        secs = [(d.get("shard"), d["incidents"]) for d in dumps
+                if d.get("incidents")]
+        out: dict = {"mode": "shard_group",
+                     "shards_reporting": len(secs),
+                     "enabled": any(s.get("enabled") for _, s in secs),
+                     "open": sum(int(s.get("open") or 0)
+                                 for _, s in secs)}
+        for key in ("total", "evicted", "skipped", "artifact_bytes"):
+            out[key] = sum(s.get(key, 0) or 0 for _, s in secs)
+        rows = []
+        for shard, s in secs:
+            for row in s.get("artifacts") or ():
+                r = dict(row)
+                r["shard"] = shard
+                rows.append(r)
+        rows.sort(key=lambda r: (r.get("opened_t") or 0,
+                                 r.get("shard") or 0))
+        out["artifacts"] = rows
+        out["shard_breakdown"] = {
+            str(i): {"open": s.get("open"), "total": s.get("total"),
+                     "artifact_bytes": s.get("artifact_bytes"),
+                     "last_error": s.get("last_error") or ""}
+            for i, s in secs}
         return out
 
     def capture_paths(self) -> List[str]:

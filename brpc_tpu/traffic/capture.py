@@ -181,6 +181,13 @@ class Recorder:
         # paying a time.time_ns() per sample
         self._clock_anchor = (time.time_ns(), time.monotonic_ns())
         self._legacy = False       # started via the rpc_dump_dir alias
+        # incident-window state (incident/manager.py): while True the
+        # recorder runs a corpus-recording session the anomaly watchdog
+        # opened, and _pre_incident holds (cfg, active, legacy) from
+        # before the window so end_incident_capture restores the
+        # operator's session — not flag defaults
+        self._incident_mode = False
+        self._pre_incident: Optional[tuple] = None
         # writer-thread-only state (no lock needed: one owner)
         self._writer: Optional[CorpusWriter] = None
         self._file_seq = 0
@@ -195,7 +202,8 @@ class Recorder:
         self.deleted_files = 0
 
     # ----------------------------------------------------------- control
-    def start(self, cfg: CaptureConfig, legacy: bool = False) -> None:
+    def start(self, cfg: CaptureConfig, legacy: bool = False,
+              _incident: bool = False) -> None:
         """Begin a capture SESSION: counters restart at zero (the
         /capture page reports this session, the corpus files report
         history), the clock anchor re-pins, sampling state resets."""
@@ -214,6 +222,13 @@ class Recorder:
                     and not self._thread.is_alive():
                 self._thread = None
                 self._stopping = False
+            if not _incident and self._incident_mode:
+                # an operator reconfigure that lands MID-incident-window
+                # wins: this config becomes the session truth and the
+                # window's eventual end_incident_capture restore
+                # dissolves into a no-op
+                self._incident_mode = False
+                self._pre_incident = None
             self._cfg = cfg
             self._legacy = legacy
             if cfg.seed is not None:
@@ -221,7 +236,13 @@ class Recorder:
             self._clock_anchor = (time.time_ns(), time.monotonic_ns())
             if not self._active:
                 self.written = self.written_bytes = 0
-                self.dropped_queue = self.dropped_budget = 0
+                self.dropped_queue = 0
+                # graftlint: disable=guarded-by -- dropped_budget is
+                # approximate accounting: its dispatch-path bump in
+                # sample_request is deliberately lock-free (a racy int,
+                # observability-only), so no guard is inferrable; this
+                # locked session reset only restarts the gauge.
+                self.dropped_budget = 0
                 self.rotations = self.deleted_files = 0
             self._active = True
             self._stopping = False
@@ -252,6 +273,59 @@ class Recorder:
             self._thread = None
             self._stopping = False
 
+    def begin_incident_capture(self, cfg: CaptureConfig) -> bool:
+        """Enter corpus-recording mode for an anomaly's bounded window
+        (incident/manager.py). Saves the live session state — config,
+        active, legacy — so the window's close RESTORES it: an
+        operator capturing at sampled rates before the incident is
+        capturing at the same rates, budget and dir after it, not at
+        flag defaults. Returns False when a window is already in
+        progress (one incident records at a time) or the spool dir is
+        unusable."""
+        with self._lock:
+            if self._incident_mode:
+                return False
+            self._pre_incident = (self._cfg, self._active, self._legacy)
+            self._incident_mode = True
+        try:
+            self.start(cfg, _incident=True)
+        except OSError:
+            with self._lock:
+                self._incident_mode = False
+                self._pre_incident = None
+            return False
+        return True
+
+    def end_incident_capture(self, flush_s: float = 3.0) -> bool:
+        """Close the incident window: flush/stop the corpus-recording
+        session, then restore whatever the operator had running before
+        the window. Returns False when no window is active (including
+        the operator-reconfigured-mid-window case, where the operator's
+        session keeps running untouched)."""
+        with self._lock:
+            if not self._incident_mode:
+                return False
+            prior, self._pre_incident = self._pre_incident, None
+            self._incident_mode = False
+        self.stop(flush_s=flush_s)
+        prior_cfg, was_active, was_legacy = prior or (None, False, False)
+        if was_active and prior_cfg is not None:
+            try:
+                self.start(prior_cfg, legacy=was_legacy)
+            except OSError:
+                pass
+        else:
+            with self._lock:
+                # idle before the window: leave idle, but point the
+                # config surfaces (corpus_paths, /capture page) back at
+                # the pre-window session instead of the deleted spool
+                self._cfg = prior_cfg
+                self._legacy = was_legacy
+        return True
+
+    def incident_capturing(self) -> bool:
+        return self._incident_mode
+
     def capture_enabled(self) -> bool:
         """The dispatch-path gate: one attribute read when capture was
         never configured; the legacy rpc_dump_dir flag keeps working as
@@ -275,7 +349,8 @@ class Recorder:
         try:
             self.start(cfg, legacy=True)
         except OSError:
-            self._active = False      # bad legacy dir: stay off
+            with self._lock:
+                self._active = False  # bad legacy dir: stay off
 
     # ---------------------------------------------------------- sampling
     def sample_request(self, method_key: str, service: str, method: str,
@@ -500,6 +575,7 @@ class Recorder:
         cfg = self._cfg
         out = {
             "active": self._active, "legacy": self._legacy,
+            "incident_mode": self._incident_mode,
             "config": cfg.to_dict() if cfg is not None else None,
             "pending": pending,
             "sampled": self.written + self.dropped_queue + pending,
@@ -574,6 +650,16 @@ def _postfork_reset() -> None:
     child keeps capturing — into its own per-pid file (_open_writer
     names files by os.getpid()), and counters restart at zero."""
     r = _recorder
+    if r._incident_mode:
+        # the incident window belongs to the PARENT (its watchdog, its
+        # spool): the child resumes the pre-window session state
+        cfg, was_active, was_legacy = r._pre_incident or (None, False,
+                                                          False)
+        r._cfg = cfg
+        r._active = bool(was_active and cfg is not None)
+        r._legacy = was_legacy
+    r._incident_mode = False
+    r._pre_incident = None
     r._lock = threading.Lock()
     r._q = deque()
     r._q_bytes = 0

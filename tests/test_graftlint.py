@@ -1165,7 +1165,8 @@ class TestTrafficCaptureLint:
         assert below == ["SeriesCollector._lock",
                          "AnomalyWatchdog._lock",
                          "AdmissionController._lock",
-                         "retry_policy:_group_lock"], below
+                         "retry_policy:_group_lock",
+                         "IncidentManager._lock"], below
 
 
 class TestDeviceObsLint:
@@ -1380,17 +1381,20 @@ class TestTimelineLint:
         from brpc_tpu.analysis.lockmodel import get_lock_model
         from brpc_tpu.analysis.racelane import LOCK_ORDER
         names = [n for n, _ in LOCK_ORDER]
-        assert names[-4:] == ["SeriesCollector._lock",
+        assert names[-5:] == ["SeriesCollector._lock",
                               "AnomalyWatchdog._lock",
                               "AdmissionController._lock",
-                              "retry_policy:_group_lock"]
+                              "retry_policy:_group_lock",
+                              "IncidentManager._lock"]
         m = get_lock_model(Context(iter_source_files(
             [os.path.join(REPO_ROOT, "brpc_tpu")])))
         assert "SeriesCollector._lock" in m.locks
         assert "AnomalyWatchdog._lock" in m.locks
         assert "AdmissionController._lock" in m.locks
+        assert "IncidentManager._lock" in m.locks
         # leaves: none may be the HELD side of any lock-graph edge
         for a, _b in m.edges:
             assert a not in ("SeriesCollector._lock",
                              "AnomalyWatchdog._lock",
-                             "AdmissionController._lock"), m.edges
+                             "AdmissionController._lock",
+                             "IncidentManager._lock"), m.edges

@@ -1,0 +1,377 @@
+"""Incident time machine (ISSUE 17): capture-on-anomaly freezing the
+evidence into bounded .brpcinc artifacts, the recorder's mid-window
+session pinning, FaultPlan JSON round-trips, the /incidents twin
+pages, the supervisor merge, and the seeded end-to-end loop —
+fault -> incident -> artifact -> replay re-fires on the same key ->
+fix-forward stays green."""
+
+import json
+import os
+import time
+
+import pytest
+
+from brpc_tpu.butil.flags import flag, set_flag
+from brpc_tpu.chaos import Fault, FaultPlan
+from brpc_tpu.incident.artifact import (ArtifactWriter, artifact_files,
+                                        artifact_summary, read_artifact)
+from brpc_tpu.traffic import capture
+from brpc_tpu.traffic.capture import CaptureConfig
+from brpc_tpu.traffic.corpus import CorpusReader
+from brpc_tpu.traffic.replay import synthesize_records, parse_mix
+
+
+@pytest.fixture
+def flags_restored():
+    names = ("anomaly_watch_filter", "anomaly_warmup_ticks",
+             "anomaly_close_ticks", "incident_dir",
+             "incident_window_ticks", "incident_capture_enabled",
+             "incident_max_artifact_mb", "incident_disk_budget_mb",
+             "incident_max_corpus_records")
+    saved = {n: flag(n) for n in names}
+    yield
+    for n, v in saved.items():
+        set_flag(n, str(v))
+    from brpc_tpu.bvar.anomaly import global_watchdog
+    global_watchdog().reset()
+
+
+def _records(n=8, seed=3):
+    return synthesize_records(
+        n, parse_mix("32:1.0"), parse_mix("1:1.0"), qps=200.0,
+        seed=seed, service="T", method="Echo", timeout_ms=500)
+
+
+# ---------------------------------------------------- faultplan json
+class TestFaultPlanJson:
+    def test_round_trip_every_kind_and_addressing(self):
+        plan = (FaultPlan(seed=42)
+                .at("tcp://10.0.0.1:80", 0,
+                    Fault("delay", at_byte=7, delay_ms=25.0),
+                    Fault("corrupt", at_byte=90, xor_mask=0x40,
+                          side="accept"))
+                .at("tcp://10.0.0.1:80", 3,
+                    Fault("drop", at_byte=128))
+                .at("mem://b", 1,
+                    Fault("partial_stall", at_byte=16, side="accept"))
+                .refuse("mem://b", 0, 5)
+                .flap("ici://dev0", at_conn=2, refuse_next=3))
+        text = plan.to_json()
+        clone = FaultPlan.from_json(text)
+        # deterministic document: byte-identical re-serialization
+        assert clone.to_json() == text
+        assert clone.seed == 42
+        doc = json.loads(text)
+        assert doc["v"] == 1
+        kinds = {f["kind"]
+                 for by_idx in doc["scripts"].values()
+                 for faults in by_idx.values() for f in faults}
+        assert kinds == {"delay", "corrupt", "drop", "partial_stall"}
+        assert doc["refuse"]["mem://b"] == [0, 5]
+        assert doc["flaps"]["ici://dev0"] == {"2": 3}
+        # per-run state never rides the document: a rebuilt plan is
+        # fresh even when serialized from a fired one
+        assert clone.fired() == []
+        assert clone.connect_verdict("mem://b", 0) == "refuse"
+
+    def test_rejects_foreign_versions_and_bad_kinds(self):
+        with pytest.raises(ValueError):
+            FaultPlan.from_json(json.dumps({"v": 2}))
+        bad = json.loads(FaultPlan(seed=1).at(
+            "mem://a", 0, Fault("delay")).to_json())
+        bad["scripts"]["mem://a"]["0"][0]["kind"] = "meteor"
+        with pytest.raises(ValueError):
+            FaultPlan.from_json(json.dumps(bad))
+
+
+# ------------------------------------------------------- artifact io
+class TestArtifact:
+    def test_write_read_round_trip_and_sidecar(self, tmp_path):
+        p = str(tmp_path / "i.brpcinc")
+        recs = _records(6)
+        w = ArtifactWriter(p)
+        w.put_incident_meta({"id": 3, "keys": ["server_limit_shed"],
+                             "peak_key": "server_limit_shed",
+                             "opened_t": 1234})
+        w.put_snapshot("status", {"server": {"state": "running"}})
+        w.put_snapshot("spans", [{"span_id": 1}])
+        for r in recs:
+            w.put_request(r)
+        w.close()
+
+        art = read_artifact(p)
+        assert art["meta"]["id"] == 3
+        assert art["meta"]["keys"] == ["server_limit_shed"]
+        assert set(art["snapshots"]) == {"status", "spans"}
+        assert art["corpus"] == recs
+        assert art["bad_records"] == 0
+
+        s = artifact_summary(p)
+        assert s["source"] == "sidecar"
+        assert s["corpus_records"] == 6
+        assert s["incident_id"] == 3
+        assert s["file_size"] == os.stat(p).st_size
+        # stale sidecar (size mismatch) falls back to a scan
+        with open(p, "ab") as f:
+            f.write(b"")
+        os.replace(p + ".idx", p + ".idx.bak")
+        s2 = artifact_summary(p)
+        assert s2["source"] == "scan"
+        assert s2["corpus_records"] == 6
+
+    def test_corpus_tools_read_brpcinc_unchanged(self, tmp_path):
+        """The artifact is a recordio superset of .brpccap: the corpus
+        reader yields exactly the embedded requests, skipping the
+        foreign meta/snapshot records."""
+        p = str(tmp_path / "i.brpcinc")
+        recs = _records(5)
+        w = ArtifactWriter(p)
+        w.put_incident_meta({"id": 1, "keys": ["k"]})
+        w.put_snapshot("status", {"x": 1})
+        for r in recs:
+            w.put_request(r)
+        w.close()
+        assert CorpusReader(p).records() == recs
+
+    def test_artifact_files_oldest_first(self, tmp_path):
+        a = str(tmp_path / "a.brpcinc")
+        b = str(tmp_path / "b.brpcinc")
+        for p in (b, a):
+            w = ArtifactWriter(p)
+            w.put_incident_meta({"id": 1})
+            w.close()
+        past = time.time() - 100
+        os.utime(b, (past, past))
+        assert artifact_files(str(tmp_path)) == [b, a]
+
+
+# ------------------------------------- recorder mid-window pinning
+class TestRecorderIncidentWindow:
+    """The satellite bugfix: corpus-recording entered while an
+    operator capture is live must restore the operator's exact
+    session on window close — and an operator reconfigure mid-window
+    wins over the window's restore."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_recorder(self):
+        yield
+        r = capture.global_recorder()
+        if r.incident_capturing():
+            r.end_incident_capture(flush_s=1.0)
+        capture.stop_capture()
+
+    def test_restores_prior_sampled_session(self, tmp_path):
+        r = capture.global_recorder()
+        op_dir = str(tmp_path / "op")
+        cfg_a = CaptureConfig(dir=op_dir, default_rate=0.25,
+                              max_per_second=100)
+        r.start(cfg_a)
+        spool = str(tmp_path / "spool")
+        assert r.begin_incident_capture(CaptureConfig(
+            dir=spool, default_rate=1.0, max_per_second=0))
+        snap = r.snapshot()
+        assert snap["incident_mode"] and snap["active"]
+        assert snap["config"]["dir"] == spool
+        assert snap["config"]["max_per_second"] == 0
+        # one window at a time
+        assert not r.begin_incident_capture(CaptureConfig(
+            dir=str(tmp_path / "s2")))
+        assert r.end_incident_capture(flush_s=1.0)
+        snap = r.snapshot()
+        assert not snap["incident_mode"]
+        assert snap["active"]                      # operator still on
+        assert snap["config"]["dir"] == os.path.normpath(op_dir)
+        assert snap["config"]["default_rate"] == 0.25
+        assert snap["config"]["max_per_second"] == 100
+
+    def test_operator_reconfigure_mid_window_wins(self, tmp_path):
+        r = capture.global_recorder()
+        r.start(CaptureConfig(dir=str(tmp_path / "a"),
+                              default_rate=0.5))
+        assert r.begin_incident_capture(CaptureConfig(
+            dir=str(tmp_path / "spool")))
+        b_dir = str(tmp_path / "b")
+        r.start(CaptureConfig(dir=b_dir, default_rate=0.75))
+        assert not r.incident_capturing()
+        # the window's close is a no-op: the operator session stays
+        assert not r.end_incident_capture(flush_s=1.0)
+        snap = r.snapshot()
+        assert snap["active"]
+        assert snap["config"]["dir"] == os.path.normpath(b_dir)
+        assert snap["config"]["default_rate"] == 0.75
+
+    def test_idle_before_window_idle_after(self, tmp_path):
+        r = capture.global_recorder()
+        capture.stop_capture()
+        assert r.begin_incident_capture(CaptureConfig(
+            dir=str(tmp_path / "spool")))
+        assert r.snapshot()["active"]
+        assert r.end_incident_capture(flush_s=1.0)
+        assert not r.snapshot()["active"]
+        assert not r.snapshot()["incident_mode"]
+
+
+# -------------------------------------------------- supervisor merge
+class TestMergedIncidents:
+    def test_merged_sums_tags_and_sorts(self, tmp_path):
+        from brpc_tpu.rpc.shard_group import ShardAggregator
+        sections = [
+            {"enabled": True, "open": 1, "total": 2, "evicted": 1,
+             "skipped": 0, "artifact_bytes": 1000,
+             "artifacts": [
+                 {"path": "/a/i2.brpcinc", "opened_t": 200},
+                 {"path": "/a/i1.brpcinc", "opened_t": 100}]},
+            {"enabled": False, "open": 0, "total": 1, "evicted": 0,
+             "skipped": 2, "artifact_bytes": 500,
+             "artifacts": [{"path": "/b/j1.brpcinc",
+                            "opened_t": 150}]},
+        ]
+        for i, sec in enumerate(sections):
+            with open(tmp_path / f"shard-{i}.json", "w") as f:
+                json.dump({"shard": i, "pid": 1000 + i, "seq": 1,
+                           "time": time.time(), "vars": {},
+                           "status": {}, "latency_samples": {},
+                           "incidents": sec}, f)
+        m = ShardAggregator(str(tmp_path), 2).merged_incidents()
+        assert m["shards_reporting"] == 2
+        assert m["enabled"] is True
+        assert m["open"] == 1
+        assert m["total"] == 3
+        assert m["evicted"] == 1
+        assert m["skipped"] == 2
+        assert m["artifact_bytes"] == 1500
+        assert [r["opened_t"] for r in m["artifacts"]] == [100, 150, 200]
+        assert [r["shard"] for r in m["artifacts"]] == [0, 1, 0]
+
+
+# ------------------------------------------------------ bvars / vars
+class TestIncidentVars:
+    def test_reexpose_survives_unexpose_all(self):
+        from brpc_tpu.bvar.variable import dump_exposed, unexpose_all
+        from brpc_tpu.incident.manager import expose_incident_vars
+        unexpose_all()
+        expose_incident_vars()
+        names = {n for n, _ in dump_exposed(prefix="incident_")}
+        assert {"incident_open", "incident_total",
+                "incident_artifact_bytes"} <= names
+
+
+# --------------------------------------------------------- e2e loop
+class TestIncidentEndToEnd:
+    """The seeded tier-1 loop: concurrency press -> watchdog opens on
+    server_limit_shed -> bounded window captures the in-window wave ->
+    the bundler writes one capped artifact -> the twin pages serve it
+    -> replay re-fires the watchdog on the same key -> the fix-forward
+    run stays green."""
+
+    def test_fault_to_artifact_to_replay(self, tmp_path,
+                                         flags_restored):
+        import threading
+
+        from brpc_tpu.bvar.anomaly import global_watchdog
+        from brpc_tpu.bvar.series import series_sample_tick
+        from brpc_tpu.fiber.timer import sleep as fiber_sleep
+        from brpc_tpu.incident.manager import global_manager
+        from brpc_tpu.incident.replay import replay_incident
+        from brpc_tpu.rpc import (Channel, ChannelOptions, Server,
+                                  ServerOptions, Service)
+
+        art_dir = str(tmp_path / "artifacts")
+        set_flag("anomaly_watch_filter", "server_limit_shed")
+        set_flag("anomaly_warmup_ticks", "3")
+        set_flag("anomaly_close_ticks", "3")
+        set_flag("incident_dir", art_dir)
+        set_flag("incident_window_ticks", "3")
+        set_flag("incident_capture_enabled", "true")
+        set_flag("incident_max_artifact_mb", "4")
+        global_watchdog().reset()
+
+        server = Server(ServerOptions(enable_builtin_services=True,
+                                      max_concurrency=1))
+        svc = Service("IncE2E")
+
+        @svc.method()
+        async def Slow(cntl, request):
+            await fiber_sleep(0.02)
+            return bytes(request)
+
+        server.add_service(svc)
+        ep = server.start("tcp://127.0.0.1:0")
+        ch = Channel(f"tcp://127.0.0.1:{ep.port}",
+                     ChannelOptions(timeout_ms=8000))
+        mgr = global_manager()
+        try:
+            assert not ch.call_sync("IncE2E", "Slow", b"w").failed()
+            for _ in range(4):
+                series_sample_tick()
+
+            # the press wave: concurrent calls against limit=1
+            done_ev = threading.Event()
+            left = [24]
+
+            def _done(c):
+                if left[0] == 1:
+                    done_ev.set()
+                left[0] -= 1
+
+            for _ in range(24):
+                ch.call("IncE2E", "Slow", b"press", done=_done)
+            assert done_ev.wait(15.0)
+            series_sample_tick()            # the spike's bucket
+            deadline = time.monotonic() + 3.0
+            while not mgr.window_engaged \
+                    and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert mgr.window_engaged, mgr.incidents_state_payload()
+
+            # in-window evidence rides into the spool corpus
+            for _ in range(6):
+                ch.call_sync("IncE2E", "Slow", b"evidence")
+
+            # run the window down; the bundler writes on its own
+            # thread — poll, never count ticks exactly (the background
+            # 1/s sampler interleaves freely)
+            arts = []
+            deadline = time.monotonic() + 12.0
+            while time.monotonic() < deadline:
+                series_sample_tick()
+                arts = mgr.artifact_rows()
+                if arts and not mgr.window_engaged:
+                    break
+                time.sleep(0.2)
+            assert arts, mgr.incidents_state_payload()
+            path = arts[0]["path"]
+            art = read_artifact(path)
+            assert "server_limit_shed" in art["meta"]["keys"]
+            assert len(art["corpus"]) >= 1
+            assert os.stat(path).st_size <= 4 << 20
+            assert "status" in art["snapshots"]
+
+            # twin parity from the ONE builder + the /status line
+            from tests.test_http import http_get
+            st, body = http_get(ep, "/incidents")
+            assert st == 200
+            page = json.loads(body)
+            r = ch.call_sync("builtin", "incidents", b"")
+            assert not r.failed()
+            twin = json.loads(r.response_payload.to_bytes())
+            assert set(page) == set(twin)
+            assert len(page["artifacts"]) == len(arts)
+            st, body = http_get(ep, "/status")
+            assert st == 200
+            line = json.loads(body)["incidents"]
+            assert line["url"] == "/incidents"
+            assert line["total"] >= 1
+        finally:
+            ch.close()
+            server.stop()
+            server.join(2)
+
+        # replay re-fires on the same key; fix-forward stays green
+        rep = replay_incident(path, use_plan=True, seed=11)
+        assert rep["ok"], rep
+        assert rep["refired"], rep
+        assert "server_limit_shed" in str(rep.get("matched_key"))
+        fix = replay_incident(path, use_plan=False, seed=11)
+        assert fix["ok"], fix
+        assert not fix["refired"], fix

@@ -799,6 +799,42 @@ def gate_timeline_smoke() -> dict:
     return out
 
 
+def gate_incident_smoke() -> dict:
+    """Incident-time-machine smoke (tools/incident_smoke.py): a
+    concurrency-press wave must open an incident, arm a bounded
+    capture window and bundle ONE size-capped .brpcinc artifact naming
+    the trigger key; HTTP /incidents must equal the builtin twin and
+    serve only ledgered downloads; replay_incident must re-fire the
+    watchdog on the same key while the fix-forward run stays green;
+    the supervisor merge must sum/tag the shard sections; and arming
+    must cost <= 5% on order-balanced pair-median windows
+    (BRPC_TPU_PERF_SMOKE=0 skips just that criterion). A subprocess so
+    a wedged replay cannot hang the gate; BRPC_TPU_INCIDENT_SMOKE=0
+    skips the lane."""
+    if os.environ.get("BRPC_TPU_INCIDENT_SMOKE", "1") == "0":
+        return {"ok": True, "skipped": "BRPC_TPU_INCIDENT_SMOKE=0"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "incident_smoke.py")],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    out: dict = {"ok": proc.returncode == 0}
+    try:
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+        for k in ("press_sheds", "e2e_ok", "artifacts",
+                  "corpus_records", "twin_parity", "status_line_ok",
+                  "download_ok", "replay_refired", "fix_forward_quiet",
+                  "merged_ok", "arm_overhead_pct", "elapsed_s"):
+            if k in report:
+                out[k] = report[k]
+        if proc.returncode != 0:
+            out["invariant"] = report.get("invariant",
+                                          report.get("error"))
+    except (ValueError, IndexError):
+        out["ok"] = False
+        out["error"] = (proc.stdout + proc.stderr)[-500:]
+    return out
+
+
 def gate_perf_smoke() -> dict:
     """Fast hot-path perf gate: raw-socket-normalized small-RPC and
     1MB-echo ratios must stay within 30% of the BENCH_r05-era floors.
@@ -874,6 +910,7 @@ def run_gate() -> int:
                      ("traffic_smoke", gate_traffic_smoke),
                      ("device_obs", gate_device_obs),
                      ("timeline_smoke", gate_timeline_smoke),
+                     ("incident_smoke", gate_incident_smoke),
                      ("perf_smoke", gate_perf_smoke)):
         try:
             report[name] = fn()

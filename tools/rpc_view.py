@@ -4,12 +4,21 @@ for the traffic engine's .brpccap format).
     python tools/rpc_view.py capture_dir/            # summary + records
     python tools/rpc_view.py corpus.brpccap --summary
     python tools/rpc_view.py dump.jsonl --service EchoService --limit 20
+    python tools/rpc_view.py --incident incident-3-11-170.brpcinc
 
 Reads .brpccap corpora (file or capture directory) and legacy rpc_dump
 JSONL files. The summary block shows per-method and per-priority
 histograms, a payload-size histogram, the interarrival profile, and
 status/latency spread — the "what is in this corpus" view an operator
 wants before replaying it.
+
+--incident (implied by a ``.brpcinc`` suffix) opens an incident
+artifact instead: the incident document (trigger keys, window stamps,
+per-class error counts), the snapshot inventory, and the embedded
+corpus's summary — the "what broke and what evidence rode along" view
+before handing the artifact to tools/incident_replay.py. The plain
+corpus flags (--service/--limit/...) still apply to the embedded
+corpus because .brpcinc is a recordio superset of .brpccap.
 """
 
 from __future__ import annotations
@@ -119,10 +128,58 @@ def summarize(records) -> dict:
     return out
 
 
+def incident_view(path: str, args) -> None:
+    """The --incident mode: artifact document + snapshot inventory +
+    embedded-corpus summary (one JSON doc with --json)."""
+    from brpc_tpu.incident.artifact import read_artifact
+    art = read_artifact(path)
+    meta = art["meta"]
+    corpus = [r for r in art["corpus"]
+              if (not args.service or r.service == args.service)
+              and (not args.method or r.method == args.method)
+              and (args.priority is None or r.priority == args.priority)]
+    snaps = {name: sorted(doc) if isinstance(doc, dict)
+             else f"{len(doc)} rows" if isinstance(doc, list)
+             else type(doc).__name__
+             for name, doc in art["snapshots"].items()}
+    if args.json:
+        print(json.dumps({"incident": meta, "snapshots": snaps,
+                          "corpus": summarize(corpus),
+                          "bad_records": art.get("bad_records", 0)},
+                         default=str))
+        return
+    print(f"# incident #{meta.get('id')}  state={meta.get('state')}  "
+          f"pid={meta.get('pid')}")
+    print(f"# keys: {json.dumps(meta.get('keys'))}  "
+          f"peak={meta.get('peak_key')} z={meta.get('peak_z')} "
+          f"value={meta.get('peak_value')} "
+          f"baseline={meta.get('baseline')}")
+    print(f"# window: opened_t={meta.get('opened_t')} "
+          f"closed_t={meta.get('closed_t')} "
+          f"window_ticks={meta.get('window_ticks')}")
+    print(f"# error_classes: {json.dumps(meta.get('error_classes'))}")
+    print(f"# snapshots: {json.dumps(snaps)}")
+    if not args.summary:
+        for r in corpus[:args.limit or 20]:
+            extra = f"  status={r.status}" if r.status else ""
+            print(f"  {r.service}.{r.method}  log_id={r.log_id}  "
+                  f"{len(r.payload)}B{extra}  {_preview(r.payload)}")
+    s = summarize(corpus)
+    print(f"# corpus: {s['records']} records, {s['bytes']} bytes")
+    print(f"# methods: {json.dumps(s['methods'])}")
+    print(f"# statuses: {json.dumps(s['statuses'])}")
+    if "latency_us" in s:
+        print(f"# latency_us: {json.dumps(s['latency_us'])}")
+    print(f"# replay: python tools/incident_replay.py {path}")
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="inspect captured corpora")
-    ap.add_argument("path", help="corpus file, capture dir, or legacy "
-                                 "jsonl dump")
+    ap.add_argument("path", help="corpus file, capture dir, legacy "
+                                 "jsonl dump, or .brpcinc artifact")
+    ap.add_argument("--incident", action="store_true",
+                    help="treat path as a .brpcinc incident artifact "
+                         "(implied by the suffix)")
     ap.add_argument("--service", default=None, help="filter by service")
     ap.add_argument("--method", default=None, help="filter by method")
     ap.add_argument("--priority", type=int, default=None,
@@ -135,6 +192,10 @@ def main(argv=None) -> None:
     ap.add_argument("--raw", action="store_true",
                     help="write payload bytes of the first match to stdout")
     args = ap.parse_args(argv)
+
+    if args.incident or args.path.endswith(".brpcinc"):
+        incident_view(args.path, args)
+        return
 
     def matches(r) -> bool:
         if args.service and r.service != args.service:
