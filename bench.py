@@ -1331,6 +1331,28 @@ def main() -> None:
                     result["tokens_per_s"] = rep["tokens_per_s"]
                 if rep.get("ttft_p99_ms") is not None:
                     result["ttft_p99_ms"] = rep["ttft_p99_ms"]
+                # pre-wired at 0.0 until a prefix cache exists to hit:
+                # the key is in the headline set NOW so the first PR
+                # that adds prefill caching shows up as a delta, not a
+                # new column
+                result["prefill_cache_hit_ratio"] = 0.0
+                # the flight deck's cost joins the headline set,
+                # re-measured on THIS box by the observatory smoke
+                # (same pair-median estimator gate_serving_obs runs)
+                op = _sp.run(
+                    [sys.executable,
+                     os.path.join(base, "tools",
+                                  "serving_obs_smoke.py")],
+                    capture_output=True, text=True, timeout=240)
+                try:
+                    orep = json.loads(
+                        op.stdout.strip().splitlines()[-1])
+                    if orep.get("serving_stats_overhead_pct") \
+                            is not None:
+                        result["serving_stats_overhead_pct"] = \
+                            orep["serving_stats_overhead_pct"]
+                except (ValueError, IndexError):
+                    pass
                 _progress({"progress": "serving_lane",
                            "tokens_per_s": rep.get("tokens_per_s"),
                            "ttft_p99_ms": rep.get("ttft_p99_ms"),
@@ -1455,6 +1477,15 @@ def main() -> None:
         "ring_syscall_drop": result.get("ring_syscall_drop"),
         "ring_qps_ratio": result.get("ring_qps_ratio"),
         "ring_p99_ratio": result.get("ring_p99_ratio"),
+        # serving flight-deck headline set: throughput + TTFT from the
+        # flapped bench lane, the deck's measured cost, and the
+        # pre-wired prefix-cache ratio (0.0 until one exists)
+        "tokens_per_s": result.get("tokens_per_s"),
+        "ttft_p99_ms": result.get("ttft_p99_ms"),
+        "serving_stats_overhead_pct":
+        result.get("serving_stats_overhead_pct"),
+        "prefill_cache_hit_ratio":
+        result.get("prefill_cache_hit_ratio"),
         "device_lane": ("error" if ("error" in lane or
                                     "lane_error" in lane)
                         else ("ok" if lane else "absent")),

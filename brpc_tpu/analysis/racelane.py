@@ -131,6 +131,18 @@ LOCK_ORDER: List[Tuple[str, str]] = [
     # bundler thread spawn, and every disk write fire OUTSIDE it;
     # never wraps another acquisition (incident/manager.py)
     ("IncidentManager._lock",       "incident/manager.py"),
+    # leaf: one per-method serving stat cell — the generation
+    # tracker's waypoint stamps are plain attribute writes, so the
+    # lock is taken ONCE per request lifetime (the settle latch +
+    # counter/reservoir writes share the acquisition), always bare:
+    # settles fire from _fire / the service shed path, outside every
+    # batcher lock (serving/serving_stats.py)
+    ("ServingCell._cell_lock",      "serving/serving_stats.py"),
+    # leaf: the flight deck's bounded step ring — the batcher appends
+    # its per-iteration record AFTER releasing its own lock and firing
+    # callbacks; guards ring mutation only, never wraps another
+    # acquisition (serving/serving_stats.py)
+    ("ServingStats._ring_lock",     "serving/serving_stats.py"),
 ]
 
 _RANK: Dict[str, int] = {name: i for i, (name, _) in enumerate(LOCK_ORDER)}

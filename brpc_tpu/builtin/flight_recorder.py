@@ -328,6 +328,16 @@ class FlightRecorder:
         lbl = _worker_module.active_label(tid)
         if lbl:
             return f"rpc:{lbl}" if "." in lbl else f"module:{lbl}"
+        # serving-lane threads (engine warm-up / decode slices with no
+        # live module label) stamp ``serving:<what>`` in serving_stats;
+        # resolved through sys.modules — NEVER an import on the sampler
+        # thread, and the serving package (model -> jax) must not load
+        # just because the recorder sampled a thread
+        ss = sys.modules.get("brpc_tpu.serving.serving_stats")
+        if ss is not None:
+            srv_lbl = ss.serving_thread_label(tid)
+            if srv_lbl:
+                return srv_lbl
         dev_lbl = _device_stats.device_thread_label(tid)
         if dev_lbl:
             return dev_lbl

@@ -110,7 +110,14 @@ def is_watch_key(name: str) -> bool:
             or name.endswith("dropped_queue")
             or name.endswith("dropped_budget")
             or "leaked" in name or "unpulled" in name
-            or name.startswith("chaos_injected"))
+            or name.startswith("chaos_injected")
+            # serving-lane default arms (the TTFT watchdog): the
+            # instant-max p99 gauge and the pooled recorder's quantile
+            # track already match the *_p99_us/.p99 suffixes above;
+            # the explicit prefixes keep the tok/s trend and any
+            # future serving_ttft_* key in the set by name
+            or name.startswith("serving_ttft")
+            or name.startswith("serving_tokens_per_second"))
 
 
 class _KeyState:

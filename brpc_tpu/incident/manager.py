@@ -46,6 +46,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import sys
 import threading
 import time
 import weakref
@@ -325,6 +326,17 @@ class IncidentManager:
                 yield "backends", bs.backends_page_payload()
             except Exception:
                 yield "backends", None
+        # serving flight deck: the /serving payload (batcher + engine +
+        # per-method stage panes) joins the bundle whenever the serving
+        # lane is loaded — resolved through sys.modules (a read, never
+        # an import on the bundler thread), so a TTFT break's artifact
+        # carries the step ring that explains it
+        srv = sys.modules.get("brpc_tpu.serving.service")
+        if srv is not None and server is not None:
+            try:
+                yield "serving", srv.serving_page_payload(server)
+            except Exception:
+                yield "serving", None
         if sm is not None:
             try:
                 label = f"incident #{inc.id}"

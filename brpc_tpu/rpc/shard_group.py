@@ -392,6 +392,20 @@ class ShardAggregator:
         occ = [d.get("kv_occupancy") for d in dumps
                if d.get("kv_occupancy") is not None]
         out["kv_occupancy"] = round(sum(occ) / len(occ), 4) if occ else 0.0
+        out["waiting_detail"] = [w for d in dumps
+                                 for w in (d.get("waiting_detail")
+                                           or ())][:32]
+        # the flight-deck panes (per-method cells, TTFT/TPOT
+        # reservoirs, step rings): counters sum, samples POOL with
+        # percentiles recomputed — never averaged
+        # (serving_stats.merge_serving_panes). Shards serve, so the
+        # serving package is loaded in the supervisor that forked them;
+        # sys.modules keeps this core module from importing the model
+        # stack on a host-only group.
+        panes = [d["stats"] for d in dumps if d.get("stats")]
+        ss = sys.modules.get("brpc_tpu.serving.serving_stats")
+        if panes and ss is not None:
+            out["stats"] = ss.merge_serving_panes(panes)
         return out
 
     def merged_device(self) -> dict:

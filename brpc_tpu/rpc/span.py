@@ -149,6 +149,20 @@ class Span:
             d["stage_us"] = queue_us
             d["wire_us"] = handle_us
             d["ack_us"] = write_us
+        elif self.side == "serving":
+            # the serving lane's waypoint names (serving/serving_stats):
+            # submit->admit (write_done_us), admit->prefill-done
+            # (first_byte_us), prefill-done->decode-done (serialized_us),
+            # decode-done->emitted (end_us). Telescoping fallbacks: a
+            # stage never reached contributes 0 and its time lands in
+            # the previous stage, so the four ALWAYS sum to latency_us.
+            a = self.write_done_us or self.end_us
+            p = self.first_byte_us or a
+            f = self.serialized_us or p
+            d["queue_us"] = max(0, a - self.start_us)
+            d["prefill_us"] = max(0, p - a)
+            d["decode_us"] = max(0, f - p)
+            d["emit_us"] = max(0, self.end_us - f)
         return d
 
 
@@ -449,6 +463,33 @@ def start_device_span(parent: Span, peer: str, lane: str) -> Span:
         log_id=parent.log_id,
     )
     span.annotate(f"device transfer peer={peer} lane={lane}")
+    return span
+
+
+def start_serving_span(cntl, service: str, method: str) -> Span:
+    """A token-generation child of the owning RPC span: the serving
+    lane's stage-resolved waypoints (queue, prefill, decode, emit) ride
+    the client-shaped stamp slots — write_done_us = admitted,
+    first_byte_us = prefill done, serialized_us = decode done, end_us =
+    emitted — so to_dict yields (queue_us, prefill_us, decode_us,
+    emit_us) summing to the stream latency (see the serving aliases).
+    The tracker (serving/serving_stats.GenTracker) stamps and submits;
+    trace/parent inheritance through the serving controller (whose
+    trace_id/span_id start_server_span set) keeps the generation inside
+    the call tree — the start_device_span idiom for the token lane."""
+    span = Span(
+        trace_id=getattr(cntl, "trace_id", 0) or new_trace_id(),
+        span_id=new_trace_id(),
+        parent_span_id=getattr(cntl, "span_id", 0) or 0,
+        side="serving",
+        service=service,
+        method=method,
+        remote_side=str(cntl.remote_side)
+        if getattr(cntl, "remote_side", None) else "",
+        start_us=time.monotonic_ns() // 1000,
+        log_id=getattr(cntl, "log_id", 0) or 0,
+    )
+    span.annotate(f"generation {service}.{method}")
     return span
 
 

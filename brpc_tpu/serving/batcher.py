@@ -46,6 +46,7 @@ from brpc_tpu.bvar.reducer import Adder, PassiveStatus
 from brpc_tpu.bvar.window import PerSecond
 from brpc_tpu.rpc import errno_codes as berr
 
+from . import serving_stats as _sstats
 from .model import TinyDecoder
 
 # request states
@@ -92,6 +93,9 @@ def expose_serving_vars() -> None:
         "serving_waiting")
     PassiveStatus(lambda: round(_sum_live("kv_occupancy"), 4)).expose(
         "serving_kv_occupancy")
+    # the flight-deck family (per-method cells, TTFT/TPOT recorders,
+    # serving_ttft_p99_us) shares the serving lane's expose lifecycle
+    _sstats.expose_serving_stats_vars()
 
 
 def _postfork_reset() -> None:
@@ -145,6 +149,10 @@ class GenRequest:
         self.finished_ns = 0
         self.error_code = 0          # berr.* for evicted/shed
         self._cancel = False         # set by cancel(); swept by step()
+        # flight-deck stage tracker (serving_stats.GenTracker), attached
+        # by the service when serving_stats is enabled; None costs the
+        # batcher one attribute check per waypoint
+        self.tracker = None
 
     @property
     def ntokens(self) -> int:
@@ -283,6 +291,15 @@ class ContinuousBatcher:
         emits: List[Tuple[GenRequest, int]] = []
         done: List[Tuple[GenRequest, str]] = []
         admitted: List[GenRequest] = []
+        # flight-deck iteration telemetry: one flag check per step; the
+        # waypoint stamps below are attribute writes gated on the
+        # request's tracker, and the step record lands in the bounded
+        # ring AFTER the callbacks (never under this lock)
+        stats_on = _sstats.enabled()
+        t0 = time.monotonic_ns() if stats_on else 0
+        t_sweep = t_admit = 0
+        n_evicted = n_canceled = 0
+        waiting_after = free_after = kv_used = 0
         with self._lock:
             # 1. sweep the running batch: client-gone and deadline-dead
             # sequences leave BEFORE we spend a step on them
@@ -306,6 +323,10 @@ class ContinuousBatcher:
                     else:
                         survivors.append(req)
                 self._waiting = survivors
+            if stats_on:
+                t_sweep = time.monotonic_ns()
+                n_evicted = sum(1 for _, s in done if s == EVICTED)
+                n_canceled = len(done) - n_evicted
             # 2. iteration-level admission: free slots pull from the
             # bounded queue between steps — never waiting for drain.
             # Slot assignment here; the prefill compute below, outside
@@ -317,6 +338,8 @@ class ContinuousBatcher:
                 req.slot = i
                 req.state = RUNNING
                 req.admitted_ns = time.monotonic_ns()
+                if req.tracker is not None:
+                    req.tracker.gen_admitted(req.admitted_ns)
                 self._nrunning += 1
                 admitted.append(req)
             active = [(i, r) for i, r in enumerate(self._slots)
@@ -328,6 +351,11 @@ class ContinuousBatcher:
                     self.steps_by_group[group_index] += 1
         if not active:
             self._fire(emits, done)
+            if stats_on and done:
+                self._record_step(t0, t_sweep, t_sweep, t_sweep,
+                                  group_index, 0, len(admitted),
+                                  n_evicted, n_canceled, 0,
+                                  len(self._waiting), len(self._free), 0)
             return bool(done)
         # prefill the admissions outside the lock: the caches and lens
         # are only written by step(), and steps are serialized by the
@@ -339,10 +367,14 @@ class ContinuousBatcher:
             self._k[i, :n], self._v[i, :n] = kp, vp
             self._h[i] = hl
             self._lens[i] = n
+            if req.tracker is not None:
+                req.tracker.gen_prefilled(time.monotonic_ns())
+        t_admit = time.monotonic_ns() if stats_on else 0
         # 3. the decode step proper — outside the lock (jax releases
         # the GIL; submit/cancel must not wait a full step)
         nxt, k_new, v_new, h_new = self.model.decode_step(
             self._k, self._v, self._h, self._lens.copy())
+        t_decode = time.monotonic_ns() if stats_on else 0
         with self._lock:
             for i, req in active:
                 if self._slots[i] is not req:
@@ -357,13 +389,60 @@ class ContinuousBatcher:
                 ntokens.add(1)
                 if not req.first_token_ns:
                     req.first_token_ns = time.monotonic_ns()
+                if req.tracker is not None:
+                    req.tracker.gen_token(time.monotonic_ns())
                 emits.append((req, tok))
                 if (req.stop_token is not None and tok == req.stop_token) \
                         or req.ntokens >= req.max_new_tokens \
                         or int(self._lens[i]) >= self.cache_len:
                     self._retire_locked(req, COMPLETED, done)
+            if stats_on:
+                waiting_after = len(self._waiting)
+                free_after = len(self._free)
+                kv_used = sum(int(self._lens[i])
+                              for i, r in enumerate(self._slots)
+                              if r is not None)
         self._fire(emits, done)
+        if stats_on:
+            self._record_step(t0, t_sweep, t_admit, t_decode,
+                              group_index, len(active), len(admitted),
+                              n_evicted, n_canceled, len(emits),
+                              waiting_after, free_after, kv_used)
         return True
+
+    def _record_step(self, t0: int, t_sweep: int, t_admit: int,
+                     t_decode: int, group_index, batch: int,
+                     admitted: int, evicted: int, canceled: int,
+                     tokens: int, waiting: int, free_slots: int,
+                     kv_used: int) -> None:
+        """One bounded iteration record into the flight deck's step
+        ring (leaf lock, outside every batcher lock): the Orca view —
+        what THIS step did and where its microseconds went."""
+        t_end = time.monotonic_ns()
+        # a positional tuple in STEP_FIELDS order, integer microseconds:
+        # this runs once per engine iteration from cold caches, where a
+        # keyed dict build + float round()s measured ~3x the cost of
+        # the whole record (step_records() re-keys at read time)
+        reg = _sstats._registry
+        if reg is None:
+            reg = _sstats.global_serving_stats()
+        reg.note_step_record((
+            time.time_ns() // 1_000_000,
+            group_index,
+            batch,
+            admitted,
+            evicted,
+            canceled,
+            tokens,
+            waiting,
+            free_slots,
+            round(kv_used / float(self.max_batch * self.cache_len), 4),
+            max(0, t_sweep - t0) // 1000,
+            max(0, t_admit - t_sweep) // 1000,
+            max(0, t_decode - t_admit) // 1000,
+            max(0, t_end - t_decode) // 1000,
+            max(0, t_end - t0) // 1000,
+        ))
 
     @staticmethod
     def _fire(emits, done) -> None:
@@ -386,6 +465,19 @@ class ContinuousBatcher:
                     import logging
                     logging.getLogger("brpc_tpu.serving").exception(
                         "on_finish failed")
+            # settle the flight-deck tracker AFTER the finish callback:
+            # emit_us then covers the delivery path (the sender pushing
+            # the verdict frame), and the span's end stamp is the
+            # moment the client could know its outcome
+            if req.tracker is not None:
+                cause = None
+                if state == EVICTED:
+                    cause = "deadline_expired"
+                elif state == CANCELED:
+                    cause = "client_gone"
+                req.tracker.gen_settled(
+                    state, cause=cause, finished_ns=req.finished_ns,
+                    error_code=req.error_code)
 
     # ----------------------------------------------------------- shutdown
     def stop(self) -> List[GenRequest]:
@@ -413,6 +505,13 @@ class ContinuousBatcher:
                 "remaining_ms": (None if r.cntl is None
                                  else r.cntl.remaining_ms()),
             } for r in self._slots if r is not None]
+            now = time.monotonic_ns()
+            waiting_detail = [{
+                "req_id": r.req_id,
+                "age_ms": round((now - r.created_ns) / 1e6, 1),
+                "remaining_ms": (None if r.cntl is None
+                                 else r.cntl.remaining_ms()),
+            } for r in list(self._waiting)[:32]]
             waiting = len(self._waiting)
             hist = dict(sorted(self.batch_hist.items()))
             groups = dict(sorted(self.steps_by_group.items()))
@@ -424,6 +523,7 @@ class ContinuousBatcher:
             "max_waiting": self.max_waiting,
             "running": running,
             "waiting": waiting,
+            "waiting_detail": waiting_detail,
             "completed": self.completed,
             "evicted": self.evicted,
             "shed": self.shed,

@@ -26,6 +26,7 @@ from collections import Counter
 
 from brpc_tpu.fiber.worker_module import WorkerModule
 
+from . import serving_stats as _sstats
 from .batcher import ContinuousBatcher
 
 
@@ -54,10 +55,21 @@ class ServingEngine(WorkerModule):
         if not self._decode_lock.acquire(False):
             self.contended += 1
             return False
+        # flight-recorder thread label: while the module's
+        # attribution_label claims busy samples first (rpc:<method>),
+        # the serving:decode stamp keeps the decode slice attributable
+        # when no module label is live (e.g. sampler races the
+        # process-exit edge) — and documents WHICH serving work the
+        # thread was doing
+        stats_on = _sstats.enabled()
+        if stats_on:
+            _sstats.stamp_serving_thread("serving:decode")
         try:
             did = self.batcher.step(group_index)
         finally:
             self._decode_lock.release()
+            if stats_on:
+                _sstats.unstamp_serving_thread()
         if did:
             self.steps += 1
             self.threads_seen[threading.get_ident()] += 1
@@ -81,5 +93,15 @@ class ServingEngine(WorkerModule):
                      np.float32)
         v = np.zeros_like(k)
         h = np.zeros((self.batcher.max_batch, cfg.dim), np.float32)
-        m.decode_step(k, v, h,
-                      np.ones((self.batcher.max_batch,), np.int64))
+        stats_on = _sstats.enabled()
+        if stats_on:
+            # XLA compile runs on the start thread, outside any fiber
+            # or module slice: without the stamp those busy samples
+            # land on a bare thread-name leaf
+            _sstats.stamp_serving_thread("serving:warmup")
+        try:
+            m.decode_step(k, v, h,
+                          np.ones((self.batcher.max_batch,), np.int64))
+        finally:
+            if stats_on:
+                _sstats.unstamp_serving_thread()

@@ -47,6 +47,7 @@ from brpc_tpu.rpc import errno_codes as berr
 from brpc_tpu.rpc.service import Service
 from brpc_tpu.rpc.stream import StreamOptions, stream_accept
 
+from . import serving_stats as _sstats
 from .batcher import (CANCELED, COMPLETED, EVICTED, ContinuousBatcher,
                       GenRequest, RequestTooLong, expose_serving_vars)
 from .engine import ServingEngine
@@ -265,6 +266,11 @@ class GenerateService:
         out = {"enabled": True, "service": self.name}
         out.update(self.batcher.stats_snapshot())
         out["engine"] = self.engine.snapshot() if self.engine else {}
+        # the flight-deck panes (per-method token table, TTFT/TPOT
+        # percentiles + pooled reservoirs, step ring) ride the SAME
+        # builder — HTTP route, builtin twin and shard dump all read
+        # serving_page_payload, so the views cannot diverge
+        out["stats"] = _sstats.serving_obs_pane()
         return out
 
     async def _generate(self, cntl, request):
@@ -287,14 +293,28 @@ class GenerateService:
                                           max_tokens, stop_token)
 
     def _submit(self, cntl, batcher, req) -> bool:
-        """Shared shed/too-long handling; True when admitted."""
+        """Shared shed/too-long handling; True when admitted. The
+        flight-deck tracker attaches HERE (one flag check per request);
+        a request refused at the door settles immediately with its
+        cause — everything it spent lands in queue_us."""
+        req.tracker = _sstats.open_generation(
+            self.name, "Generate", cntl, created_ns=req.created_ns)
         try:
             ok = batcher.submit(req)
         except RequestTooLong as e:
             cntl.set_failed(berr.EREQUEST, str(e))
+            if req.tracker is not None:
+                req.tracker.gen_settled("rejected",
+                                        cause="prompt_too_long",
+                                        error_code=berr.EREQUEST)
             return False
         if not ok:
             cntl.set_failed(berr.ELIMIT, "serving queue full (shed)")
+            if req.tracker is not None:
+                req.tracker.gen_settled(
+                    "shed", cause="queue_full",
+                    finished_ns=req.finished_ns,
+                    error_code=req.error_code or berr.ELIMIT)
             return False
         return True
 

@@ -853,11 +853,11 @@ class TestCallbackUnderLock:
         path = os.path.join(REPO_ROOT, "brpc_tpu", "serving",
                             "batcher.py")
         src = open(path).read()
-        tail = "        self._fire(emits, done)\n        return True"
+        tail = "        self._fire(emits, done)\n        if stats_on:"
         assert tail in src
         mutated = src.replace(
             tail, "            self._fire(emits, done)\n"
-                  "        return True")
+                  "        if stats_on:")
         sf, ctx = _ctx_for(path, "brpc_tpu/serving/batcher.py", mutated)
         found = list(CallbackUnderLockRule().finalize(ctx))
         assert any(f.rule == "callback-under-lock"
@@ -1166,7 +1166,9 @@ class TestTrafficCaptureLint:
                          "AnomalyWatchdog._lock",
                          "AdmissionController._lock",
                          "retry_policy:_group_lock",
-                         "IncidentManager._lock"], below
+                         "IncidentManager._lock",
+                         "ServingCell._cell_lock",
+                         "ServingStats._ring_lock"], below
 
 
 class TestDeviceObsLint:
@@ -1381,11 +1383,13 @@ class TestTimelineLint:
         from brpc_tpu.analysis.lockmodel import get_lock_model
         from brpc_tpu.analysis.racelane import LOCK_ORDER
         names = [n for n, _ in LOCK_ORDER]
-        assert names[-5:] == ["SeriesCollector._lock",
+        assert names[-7:] == ["SeriesCollector._lock",
                               "AnomalyWatchdog._lock",
                               "AdmissionController._lock",
                               "retry_policy:_group_lock",
-                              "IncidentManager._lock"]
+                              "IncidentManager._lock",
+                              "ServingCell._cell_lock",
+                              "ServingStats._ring_lock"]
         m = get_lock_model(Context(iter_source_files(
             [os.path.join(REPO_ROOT, "brpc_tpu")])))
         assert "SeriesCollector._lock" in m.locks

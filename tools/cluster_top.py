@@ -1,5 +1,7 @@
 """Live multi-node cluster "top": scrape N admin endpoints' /backends
-+ /status and render ONE merged per-backend table.
++ /status and render ONE merged per-backend table; /device, /serving
+and /timeline decorate the node lines (device GB/s, serving tok/s +
+TTFT p99 + KV occupancy + queue depth, qps/p99/err sparklines).
 
 Each node's /backends page reports its own channels' view of the
 cluster (per-backend qps, percentiles, errors, inflight, breaker
@@ -127,6 +129,29 @@ def _device_summary(page: Optional[dict]) -> Optional[dict]:
     }
 
 
+def _serving_summary(page: Optional[dict]) -> Optional[dict]:
+    """One node's /serving page collapsed to the top row: tok/s from
+    the flight-deck pane's 10s window, pooled TTFT p99, KV occupancy
+    and queue depth. Supervisors answer with the shard-merged payload,
+    so one scrape covers the whole group."""
+    if not page or not page.get("enabled"):
+        return None
+    stats = page.get("stats") or {}
+    ttft = stats.get("ttft") or {}
+    return {
+        "tokens_per_s": round(
+            float(stats.get("tokens_per_second_10s", 0) or 0), 2),
+        "ttft_p99_ms": round((ttft.get("p99_us", 0) or 0) / 1000.0, 2),
+        "kv_occupancy": page.get("kv_occupancy", 0),
+        "waiting": page.get("waiting", 0),
+        "running": len(page.get("running") or ()),
+        "tokens_out": page.get("tokens_out", 0),
+        "completed": page.get("completed", 0),
+        "shed": page.get("shed", 0),
+        "evicted": page.get("evicted", 0),
+    }
+
+
 def _timeline_trends(page: Optional[dict]) -> Optional[dict]:
     """One node's /timeline collapsed to the three trend tracks the
     top renders: qps (per-second processed deltas), p99 and errors —
@@ -156,6 +181,7 @@ def scrape(nodes: List[str]) -> dict:
     pages = []
     statuses = {}
     devices = {}
+    servings = {}
     timelines = {}
     down = []
     for node in nodes:
@@ -175,6 +201,15 @@ def scrape(nodes: List[str]) -> dict:
         if dev is not None and (dev["transfers"] or
                                 dev["recv_transfers"]):
             devices[node] = dev
+        srv = _serving_summary(fetch_json(node, "/serving"))
+        # ANY serving activity includes the node — finished work,
+        # queued work, or refusals alike (the device lane's recv-only
+        # lesson: the node that only queues or sheds is exactly the
+        # one an operator needs to see)
+        if srv is not None and (srv["tokens_out"] or srv["waiting"]
+                                or srv["running"] or srv["completed"]
+                                or srv["shed"] or srv["evicted"]):
+            servings[node] = srv
         # trend columns: the node's own qps/p99/errors rings (absent
         # when the node predates the series engine or runs it off).
         # Prefix filter, not ?names=: a node missing one var answers
@@ -184,7 +219,8 @@ def scrape(nodes: List[str]) -> dict:
         if tl is not None:
             timelines[node] = tl
     return {"backends": merge_backends(pages), "nodes": statuses,
-            "device": devices, "timeline": timelines,
+            "device": devices, "serving": servings,
+            "timeline": timelines,
             "nodes_down": down, "nodes_up": len(pages)}
 
 
@@ -240,6 +276,18 @@ def render(view: dict) -> str:
                         if d.get("staged_fallbacks") else "")
                      + (f" leaked={d['leaked_bytes']}B"
                         if d.get("leaked_bytes") else ""))
+        s = view.get("serving", {}).get(node)
+        if s is not None:
+            # the inference column: tok/s, pooled TTFT p99, KV cache
+            # occupancy and queue depth from /serving (absent when the
+            # node runs no serving lane or saw no generations)
+            line += (f"  serving: {s.get('tokens_per_s')} tok/s "
+                     f"ttft_p99={s.get('ttft_p99_ms')}ms "
+                     f"kv={s.get('kv_occupancy')} "
+                     f"waiting={s.get('waiting')}"
+                     + (f" shed={s['shed']}" if s.get("shed") else "")
+                     + (f" evicted={s['evicted']}"
+                        if s.get("evicted") else ""))
         out.append(line)
     for node in view.get("nodes_down", []):
         out.append(f"node {node}: DOWN")

@@ -257,8 +257,8 @@ def gate_locklint() -> dict:
                              "batcher.py")
         bsrc = open(bpath).read()
         mutated = bsrc.replace(
-            "        self._fire(emits, done)\n        return True",
-            "            self._fire(emits, done)\n        return True")
+            "        self._fire(emits, done)\n        if stats_on:",
+            "            self._fire(emits, done)\n        if stats_on:")
         assert mutated != bsrc
         sf = SourceFile(bpath, "brpc_tpu/serving/batcher.py", mutated)
         found = list(CallbackUnderLockRule().finalize(
@@ -734,6 +734,45 @@ def gate_device_obs() -> dict:
     return out
 
 
+def gate_serving_obs() -> dict:
+    """Serving-observatory smoke (tools/serving_obs_smoke.py, cpu-dryrun
+    lane, ~3s): a mixed-length generate burst must produce serving
+    spans whose queue/prefill/decode/emit stages account for >= 90% of
+    each generation's stream latency (children of the owning RPC
+    spans), the /serving HTTP page + supervisor merge must agree with
+    the in-process pane on the per-method counters, the step ring must
+    carry the burst's iterations, and the flight deck must cost <= 5%
+    on-vs-off on per-step pair-median windows (BRPC_TPU_PERF_SMOKE=0
+    skips just that criterion). A subprocess so a wedged engine cannot
+    hang the gate; ONE retry round absorbs the shared sandbox's
+    sustained load bursts (a real overhead regression fails both);
+    BRPC_TPU_SERVING_OBS_SMOKE=0 skips."""
+    if os.environ.get("BRPC_TPU_SERVING_OBS_SMOKE", "1") == "0":
+        return {"ok": True, "skipped": "BRPC_TPU_SERVING_OBS_SMOKE=0"}
+    out: dict = {}
+    for attempt in range(2):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "serving_obs_smoke.py")],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        out = {"ok": proc.returncode == 0, "attempt": attempt + 1}
+        try:
+            report = json.loads(proc.stdout.strip().splitlines()[-1])
+            for k in ("serving_spans", "serving_stage_attribution_pct",
+                      "serving_stats_overhead_pct", "elapsed_s"):
+                if k in report:
+                    out[k] = report[k]
+            if proc.returncode != 0:
+                out["problems"] = report.get("problems",
+                                             report.get("error"))
+        except (ValueError, IndexError):
+            out["ok"] = False
+            out["error"] = (proc.stdout + proc.stderr)[-500:]
+        if out["ok"]:
+            break
+    return out
+
+
 def gate_traffic_smoke() -> dict:
     """Traffic-engine smoke (tools/traffic_smoke.py, ~4s): record a
     paced mixed-size/mixed-priority burst through the live capture
@@ -909,6 +948,7 @@ def run_gate() -> int:
                      ("fabric_smoke", gate_fabric_smoke),
                      ("traffic_smoke", gate_traffic_smoke),
                      ("device_obs", gate_device_obs),
+                     ("serving_obs", gate_serving_obs),
                      ("timeline_smoke", gate_timeline_smoke),
                      ("incident_smoke", gate_incident_smoke),
                      ("perf_smoke", gate_perf_smoke)):
