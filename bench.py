@@ -1444,6 +1444,15 @@ def main() -> None:
     # lane — must survive in the final line even when the full result
     # object above is cut off
     lane = result.get("device_lane") or {}
+    # small-batch latency headline: mean of the lane sweep's avg_us
+    # over the coalescable sizes (4B-16KB) — the number the descriptor
+    # coalescing + adaptive window work moves
+    _small = [pt.get("avg_us") for sz, pt in (lane.get("sweep")
+                                              or {}).items()
+              if sz.isdigit() and int(sz) <= 16384
+              and isinstance(pt, dict) and pt.get("avg_us")]
+    ici_small_batch_us = (round(sum(_small) / len(_small), 1)
+                          if _small else None)
     summary = {
         "SUMMARY": 1,
         "GBps": result.get("value"),
@@ -1489,6 +1498,10 @@ def main() -> None:
         "device_lane": ("error" if ("error" in lane or
                                     "lane_error" in lane)
                         else ("ok" if lane else "absent")),
+        # device lane headline pair: bulk GB/s and the coalescable
+        # small-batch latency (4B-16KB sweep mean)
+        "ici_headline_GBps": lane.get("headline_GBps"),
+        "ici_small_batch_us": ici_small_batch_us,
         # device observatory headline pair (measured inside the probe
         # child next to the ici numbers they qualify): what the stage
         # spans account for, and what the cells cost

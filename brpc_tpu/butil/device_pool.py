@@ -81,13 +81,25 @@ class DeviceRecvPool:
         the pulled arrays, and arrays caught in reference cycles (a
         Controller holding its arrays and callbacks is one) would
         otherwise hold budget until an arbitrary future collection."""
+        return self._reserve_footprint(round_to_class(nbytes), timeout_s)
+
+    def reserve_group(self, footprint: int,
+                      timeout_s: Optional[float] = 10.0) -> int:
+        """ONE admission for a coalesced batch group: ``footprint`` is
+        the pre-rounded sum of the group's per-array size classes (the
+        sender and receiver compute it identically), so N tiny arrays
+        pay one blocking reserve instead of N. Release with release()
+        — or let GroupReservation do it when the last array dies."""
+        return self._reserve_footprint(footprint, timeout_s)
+
+    def _reserve_footprint(self, footprint: int,
+                           timeout_s: Optional[float]) -> int:
         import time as _time
 
-        footprint = round_to_class(nbytes)
         if footprint > self.capacity:
             raise MemoryError(
-                f"device payload of {nbytes}B exceeds pool capacity "
-                f"{self.capacity}B")
+                f"device payload footprint of {footprint}B exceeds "
+                f"pool capacity {self.capacity}B")
         deadline = (None if timeout_s is None
                     else _time.monotonic() + timeout_s)
         gc_at = 0.0
@@ -140,3 +152,131 @@ class DeviceRecvPool:
             # object doesn't support weakrefs: release immediately rather
             # than leak budget forever
             self.release(footprint)
+
+    def attach_group_finalizer(self, obj, group: "GroupReservation") -> None:
+        """Coalesced-batch variant: every array of the group carries a
+        finalizer into the SAME GroupReservation; the single group
+        footprint releases when the last one dies."""
+        import weakref
+        try:
+            weakref.finalize(obj, group.release_one)
+        except TypeError:
+            group.release_one()
+
+
+class DevicePinnedStager:
+    """Stage recv-side H2D copies through the native pinned (mlock'd)
+    arena: the host bytes are copied into a pinned block, device_put
+    reads from locked pages (no kernel bounce on a real DMA engine),
+    and the block recycles when the device array is ready — a fiber
+    parks on the PjRt future via DeviceEventPoller.watch instead of
+    anyone blocking.
+
+    Active only when BOTH the native pinned arena can serve blocks AND
+    the jax build has ``jax.experimental.transfer`` (the DMA-capable
+    transfer runtime this staging exists for). Otherwise ``land()`` is
+    exactly ``jax.device_put`` — same signature, clean fallback, which
+    is what this env without the transfer extension exercises. Tests
+    force-enable with ``DevicePinnedStager(force=True)``.
+    """
+
+    def __init__(self, force: bool = False):
+        self._force = force
+        self._active: Optional[bool] = None
+        self.staged_count = 0
+        self.fallback_count = 0
+
+    def _probe(self) -> bool:
+        from brpc_tpu import native
+        if native.alloc_pinned_block(1) is None:
+            return False
+        if self._force:
+            return True
+        try:
+            import jax.experimental.transfer  # noqa: F401
+        except Exception:
+            return False
+        return True
+
+    @property
+    def active(self) -> bool:
+        if self._active is None:
+            self._active = self._probe()
+        return self._active
+
+    def land(self, host_arr, device=None, sharding=None):
+        """device_put ``host_arr`` (a numpy array), staging through a
+        pinned block when active. Returns the jax array; the pinned
+        block is released when the device buffer signals ready."""
+        import jax
+
+        dst = sharding if sharding is not None else device
+        if not self.active:
+            self.fallback_count += 1
+            return (jax.device_put(host_arr, dst) if dst is not None
+                    else jax.device_put(host_arr))
+        import numpy as np
+        from brpc_tpu.butil.iobuf import pinned_staging_block
+        staging = pinned_staging_block(host_arr.nbytes)
+        if not staging.pinned:
+            self.fallback_count += 1
+            return (jax.device_put(host_arr, dst) if dst is not None
+                    else jax.device_put(host_arr))
+        flat = np.frombuffer(staging.view, dtype=np.uint8,
+                             count=host_arr.nbytes)
+        flat[:] = host_arr.reshape(-1).view(np.uint8)
+        pinned_arr = flat.view(host_arr.dtype).reshape(host_arr.shape)
+        arr = (jax.device_put(pinned_arr, dst) if dst is not None
+               else jax.device_put(pinned_arr))
+        self.staged_count += 1
+        # park on the PjRt future: the block goes back to the pinned
+        # freelist only once the H2D copy has consumed it
+        from brpc_tpu.fiber.device_poller import global_poller
+        global_poller().watch(arr, staging.release)
+        return arr
+
+
+_stager: Optional[DevicePinnedStager] = None
+_stager_lock = threading.Lock()
+
+
+def global_pinned_stager() -> DevicePinnedStager:
+    global _stager
+    with _stager_lock:
+        if _stager is None:
+            _stager = DevicePinnedStager()
+        return _stager
+
+
+class GroupReservation:
+    """Release-once holder shared by every array of a coalesced batch
+    group: the pool footprint was reserved ONCE (reserve_group) and
+    goes back when the last array is dropped."""
+
+    __slots__ = ("_pool", "_footprint", "_count", "_lock")
+
+    def __init__(self, pool: DeviceRecvPool, footprint: int, count: int):
+        self._pool = pool
+        self._footprint = footprint
+        self._count = max(1, count)
+        self._lock = threading.Lock()
+
+    def release_one(self) -> None:
+        with self._lock:
+            self._count -= 1
+            if self._count > 0:
+                return
+        self._pool.release(self._footprint)
+
+
+def _postfork_reset_stager() -> None:
+    # child gets a fresh stager (parent's watched futures/poller thread
+    # are gone) and a fresh lock in case fork landed mid-acquire
+    global _stager, _stager_lock
+    _stager_lock = threading.Lock()
+    _stager = None
+
+
+from brpc_tpu.butil import postfork as _postfork  # noqa: E402
+
+_postfork.register("butil.device_pool.stager", _postfork_reset_stager)

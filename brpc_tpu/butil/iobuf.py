@@ -238,6 +238,40 @@ def _recycle_buffer(buf: bytearray) -> None:
     pool.recycle(buf)
 
 
+class PinnedStaging:
+    """A host staging buffer for H2D transfers, backed by an mlock'd
+    block from the native pinned arena when one is available and by a
+    plain bytearray otherwise. ``view`` is writable; ``release()``
+    returns the pinned block to its freelist (no-op for the fallback)
+    and is safe to call from a poller callback after the device copy
+    lands."""
+
+    __slots__ = ("view", "pinned", "_block", "__weakref__")
+
+    def __init__(self, view: memoryview, block=None):
+        self.view = view
+        self.pinned = block is not None
+        self._block = block
+
+    def release(self) -> None:
+        blk, self._block = self._block, None
+        if blk is not None:
+            blk.release()
+
+
+def pinned_staging_block(nbytes: int) -> PinnedStaging:
+    """Acquire staging memory for an H2D copy of ``nbytes``: an
+    mlock'd pinned block when the native arena can serve it (the DMA
+    engine reads straight from locked pages, the RDMA-registered-rbuf
+    analog), else pageable memory — same interface either way, so
+    callers never branch on availability."""
+    from brpc_tpu import native
+    blk = native.alloc_pinned_block(nbytes)
+    if blk is not None:
+        return PinnedStaging(blk.view[:nbytes], blk)
+    return PinnedStaging(memoryview(bytearray(nbytes)))
+
+
 class Block:
     """A contiguous host buffer; append-only region shared by BlockRefs.
 

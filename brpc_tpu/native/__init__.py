@@ -57,6 +57,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     # block pool
     L.bt_block_alloc.restype = ctypes.c_void_p
     L.bt_block_alloc.argtypes = [ctypes.c_int]
+    L.bt_block_alloc_pinned.restype = ctypes.c_void_p
+    L.bt_block_alloc_pinned.argtypes = [ctypes.c_int]
+    L.bt_block_is_pinned.restype = ctypes.c_int
+    L.bt_block_is_pinned.argtypes = [ctypes.c_void_p]
     L.bt_block_ref.argtypes = [ctypes.c_void_p]
     L.bt_block_unref.argtypes = [ctypes.c_void_p]
     L.bt_block_refcount.restype = c_u32
@@ -248,6 +252,68 @@ def snappy_compress(data: bytes) -> Optional[bytes]:
     if n == 0 and data:
         return None
     return dst.raw[:n]
+
+
+def _unref_block(ptr: int) -> None:
+    L = lib()
+    if L is not None:
+        L.bt_block_unref(ctypes.c_void_p(ptr))
+
+
+class PinnedBlock:
+    """One mlock'd block from the native pinned arena, exposed as a
+    writable memoryview (``view``). The block returns to the pinned
+    freelist on release() — or, safety net, when this wrapper dies
+    (weakref.finalize fires its callback at most once, so the pair
+    cannot double-unref)."""
+
+    __slots__ = ("ptr", "size", "view", "_buf", "_fin", "__weakref__")
+
+    def __init__(self, ptr: int, size: int):
+        self.ptr = ptr
+        self.size = size
+        self._buf = (ctypes.c_char * size).from_address(ptr)
+        self.view = memoryview(self._buf).cast("B")
+        import weakref
+        self._fin = weakref.finalize(self, _unref_block, ptr)
+
+    def release(self) -> None:
+        """Return the block to the pinned freelist. The view must not
+        be written after this — the block may already be re-owned."""
+        self._fin()
+
+
+def alloc_pinned_block(nbytes: int) -> Optional[PinnedBlock]:
+    """A pinned (mlock'd, DMA-capable) staging block of at least
+    ``nbytes``; None when the native lib is absent, the size exceeds
+    the largest class, the pinned cap is reached, or mlock is refused
+    (RLIMIT_MEMLOCK) — callers fall back to pageable memory."""
+    L = lib()
+    if L is None:
+        return None
+    cls = int(L.bt_block_class_for(nbytes))
+    if cls < 0:
+        return None
+    ptr = L.bt_block_alloc_pinned(cls)
+    if not ptr:
+        return None
+    return PinnedBlock(int(ptr), int(L.bt_block_size(cls)))
+
+
+def pinned_pool_stats() -> Optional[dict]:
+    """Pinned-arena counters for /vars and the /device page."""
+    L = lib()
+    if L is None:
+        return None
+    per_class = []
+    for cls in range(3):
+        per_class.append({
+            "total": int(L.bt_block_pool_stats(cls, 3)),
+            "live": int(L.bt_block_pool_stats(cls, 4)),
+            "free": int(L.bt_block_pool_stats(cls, 5)),
+        })
+    return {"classes": per_class,
+            "pinned_bytes": int(L.bt_block_pool_stats(0, 6))}
 
 
 def snappy_decompress(data: bytes) -> Optional[bytes]:

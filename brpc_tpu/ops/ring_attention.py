@@ -33,7 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from brpc_tpu.ops.flash_attention import (
     NEG_INF, _finalize, _online_softmax_step,
 )
-from brpc_tpu.parallel.mesh import SHARD_AXIS
+from brpc_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
 
 def _ring_perm(n: int):
@@ -106,7 +106,7 @@ def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = False,
     # check_vma off: the (m, l, o) accumulators start axis-invariant and
     # become ring-varying after the first ppermute step, which the static
     # varying-axes checker can't type (same situation as ring_allreduce)
-    fn = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return jax.jit(fn)(q, k, v)
 
@@ -146,6 +146,6 @@ def ulysses_attention(mesh: Mesh, q, k, v, *, causal: bool = False,
         return reshard_bwd(out)
 
     spec = P(None, axis_name, None)
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec)
     return jax.jit(fn)(q, k, v)

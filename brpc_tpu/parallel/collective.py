@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from brpc_tpu.parallel.mesh import REPLICA_AXIS, SHARD_AXIS
+from brpc_tpu.parallel.mesh import REPLICA_AXIS, SHARD_AXIS, shard_map
 
 
 _MERGES = ("sum", "mean", "max", "min", "concat", "stack", "none")
@@ -67,7 +67,7 @@ class CollectiveChannel:
             out_spec = P(SHARD_AXIS)    # leave sharded (response stays put)
         else:                           # concat / stack
             out_spec = P(SHARD_AXIS)
-        fn = jax.shard_map(per_shard, mesh=mesh, in_specs=P(SHARD_AXIS),
+        fn = shard_map(per_shard, mesh=mesh, in_specs=P(SHARD_AXIS),
                            out_specs=out_spec)
         return jax.jit(fn)
 
@@ -88,14 +88,14 @@ class CollectiveChannel:
 
     def all_gather(self, x):
         """Every shard sees the full request (fan-out broadcast side)."""
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             lambda s: jax.lax.all_gather(s, SHARD_AXIS, tiled=True),
             mesh=self.mesh, in_specs=P(SHARD_AXIS), out_specs=P(),
             check_vma=False))  # replication holds post-all_gather; not inferable
         return fn(x)
 
     def reduce_scatter(self, x):
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             lambda s: jax.lax.psum_scatter(s, SHARD_AXIS, tiled=True),
             mesh=self.mesh, in_specs=P(None), out_specs=P(SHARD_AXIS)))
         return fn(x)
@@ -120,7 +120,7 @@ def all_to_all_reshard(mesh: Mesh, x, concat_axis: int, split_axis: int):
     in_spec[concat_axis] = SHARD_AXIS
     out_spec = [None] * x.ndim
     out_spec[split_axis] = SHARD_AXIS
-    fn = jax.shard_map(per_shard, mesh=mesh, in_specs=P(*in_spec),
+    fn = shard_map(per_shard, mesh=mesh, in_specs=P(*in_spec),
                        out_specs=P(*out_spec))
     return jax.jit(fn)(x)
 
@@ -130,5 +130,5 @@ def replicated_call(mesh: Mesh, service_fn: Callable, request):
     full request; the caller reads any replica's response (they're
     identical — replica choice becomes a scheduling detail, not a data
     movement)."""
-    fn = jax.shard_map(service_fn, mesh=mesh, in_specs=P(), out_specs=P())
+    fn = shard_map(service_fn, mesh=mesh, in_specs=P(), out_specs=P())
     return jax.jit(fn)(request)

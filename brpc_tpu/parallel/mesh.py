@@ -24,6 +24,20 @@ REPLICA_AXIS = "replica"
 SHARD_AXIS = "shard"
 
 
+def shard_map(f, mesh: Mesh, in_specs, out_specs, check_vma=None):
+    """jax.shard_map across jax versions: newer jax exports it at top
+    level (``check_vma``); older builds keep it in jax.experimental
+    under the ``check_rep`` spelling of the same knob."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kw)
+    from jax.experimental.shard_map import shard_map as xsm
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return xsm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
 def make_rpc_mesh(n_replicas: Optional[int] = None,
                   n_shards: Optional[int] = None,
                   devices: Optional[Sequence] = None) -> Mesh:

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from brpc_tpu.parallel.mesh import SHARD_AXIS
+from brpc_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
 
 def _ring_perm(n: int, step: int = 1):
@@ -35,7 +35,7 @@ def ring_shift(mesh: Mesh, x, step: int = 1):
     def per_shard(s):
         return jax.lax.ppermute(s, SHARD_AXIS, perm=_ring_perm(n, step))
 
-    fn = jax.shard_map(per_shard, mesh=mesh, in_specs=P(SHARD_AXIS),
+    fn = shard_map(per_shard, mesh=mesh, in_specs=P(SHARD_AXIS),
                        out_specs=P(SHARD_AXIS))
     return jax.jit(fn)(x)
 
@@ -77,7 +77,7 @@ def ring_allreduce(mesh: Mesh, x):
 
     # check_vma off: the carry flips between replicated and ring-varying
     # across loop steps, which the static varying-axes checker can't type
-    fn = jax.shard_map(per_shard, mesh=mesh, in_specs=P(None),
+    fn = shard_map(per_shard, mesh=mesh, in_specs=P(None),
                        out_specs=P(None), check_vma=False)
     # x: [n, chunk] replicated; result: allreduced [n, chunk] replicated
     return jax.jit(fn)(x)
@@ -103,6 +103,6 @@ def ring_scan(mesh: Mesh, x, combine: Callable, init=None):
         carry, _ = jax.lax.fori_loop(0, n - 1, step, (carry0, block))
         return carry
 
-    fn = jax.shard_map(per_shard, mesh=mesh, in_specs=P(SHARD_AXIS),
+    fn = shard_map(per_shard, mesh=mesh, in_specs=P(SHARD_AXIS),
                        out_specs=P(SHARD_AXIS))
     return jax.jit(fn)(x)

@@ -337,6 +337,35 @@ class Channel:
         return connect_dedup(self._socket_lock, lambda: self._socket,
                              _write, _make)
 
+    def device_lane_kind(self,
+                         timeout_s: float = 2.0) -> Optional[str]:
+        """The device-lane flavor of this channel's connection
+        ('local-d2d' / 'pjrt-pull' / 'staged'), or None when the
+        transport has no device lane at all. Dials lazily and waits
+        (bounded) for the lane hello, since the flavor is negotiated —
+        combo channels probe this once per generation before lowering
+        a device fan-out to one XLA collective."""
+        try:
+            sock = self._get_socket()
+        except Exception:
+            return None
+        conn = getattr(sock, "conn", None)
+        if conn is None or not getattr(conn, "supports_device_lane", False):
+            return None
+        kind = getattr(conn, "lane_kind", None)
+        if kind is None:
+            return None
+        if getattr(conn, "peer_info", True) is None:
+            # hello still in flight: the kind would read as the staged
+            # floor; wait for the negotiated answer
+            deadline = time.monotonic() + timeout_s
+            while conn.peer_info is None:
+                if sock.failed or time.monotonic() >= deadline:
+                    break
+                time.sleep(0.001)
+            kind = conn.lane_kind
+        return kind
+
     def close(self) -> None:
         """Release the connection(s); the channel may be re-used (it will
         reconnect lazily)."""
@@ -797,21 +826,32 @@ class Channel:
             if lane is not None:
                 # lane + wire must hit the conn as an adjacent pair:
                 # another device-payload call slipping between them would
-                # cross-match lane batches on the receiver
-                with sock.lane_lock:
-                    # the device batch's stage tracker hangs its child
-                    # span off this call's client span (trace inherit)
-                    sock.write_device_payload(lane,
-                                              span=d.get("_client_span"))
-                    # graftlint: disable=callback-under-lock -- lane_lock
-                    # exists to make exactly this pair atomic (device
-                    # batch + envelope adjacent on the conn); Socket.write
-                    # only queues — it never parks and the on_done fires
-                    # from the drain, not here
-                    sock.write(wire, on_done=lambda err, s=sock,
-                               q=d["_issue_seq"],
-                               sp=d.get("_client_span"):
-                               self._on_write_done(cntl, err, s, q, sp))
+                # cross-match lane batches on the receiver. The defer-
+                # flush hold moves the TCP syscalls for both frames out
+                # from under lane_lock (one gather-write at release), so
+                # concurrent callers serialize only on the queue pushes.
+                conn = getattr(sock, "conn", None)
+                hold = getattr(conn, "hold_flush", None)
+                if hold is not None:
+                    hold()
+                try:
+                    with sock.lane_lock:
+                        # the device batch's stage tracker hangs its child
+                        # span off this call's client span (trace inherit)
+                        sock.write_device_payload(lane,
+                                                  span=d.get("_client_span"))
+                        # graftlint: disable=callback-under-lock -- lane_lock
+                        # exists to make exactly this pair atomic (device
+                        # batch + envelope adjacent on the conn); Socket.write
+                        # only queues — it never parks and the on_done fires
+                        # from the drain, not here
+                        sock.write(wire, on_done=lambda err, s=sock,
+                                   q=d["_issue_seq"],
+                                   sp=d.get("_client_span"):
+                                   self._on_write_done(cntl, err, s, q, sp))
+                finally:
+                    if hold is not None:
+                        conn.release_flush()
             else:
                 sock.write(wire, on_done=lambda err, s=sock,
                            q=d["_issue_seq"], sp=d.get("_client_span"):

@@ -75,9 +75,80 @@ class ParallelChannel:
         self.fail_limit = fail_limit
         self.call_mapper = call_mapper or CallMapper()
         self.response_merger = response_merger or ResponseMerger()
+        # collective lowering (parallel/collective.py): when every
+        # sub-channel rides the device lane of one mesh, the N-sub-call
+        # fan-out is the wrong program — attach_collective swaps it for
+        # ONE jit'd shard_map op per (service, method)
+        self._collective = None
+        self._collective_fns: Dict[Any, Callable] = {}
+        self._lane_verdict: Optional[bool] = None
+        self.collective_fused = 0
+        self.collective_fallbacks = 0
+
+    def attach_collective(self, collective,
+                          service_fns: Dict[Any, Callable]) -> None:
+        """Arm collective lowering: ``collective`` is a
+        parallel.collective.CollectiveChannel over the mesh whose
+        devices back the sub-channels; ``service_fns`` maps
+        ``(service, method)`` to the jax-traceable per-shard function
+        equivalent to what that RPC method computes. A device-array
+        call to a mapped method then lowers to ONE XLA collective
+        (scatter over the shard axis + on-device merge) instead of N
+        point-to-point lane RPCs — the fan-out and merge become ICI
+        traffic inside one compiled program. Calls that don't qualify
+        (host payloads, unmapped methods, a non-device-lane sub) fan
+        out exactly as before."""
+        self._collective = collective
+        self._collective_fns = dict(service_fns)
+        self._lane_verdict = None
+
+    def _all_device_lane(self) -> bool:
+        """One probe per sub-channel generation: every sub must expose
+        a device lane for the fused program to be equivalent (a plain
+        TCP sub would silently drop out of a collective)."""
+        if self._lane_verdict is None:
+            try:
+                self._lane_verdict = bool(self._subs) and all(
+                    sub.device_lane_kind() is not None
+                    for sub in self._subs)
+            except Exception:
+                self._lane_verdict = False
+        return self._lane_verdict
+
+    def _maybe_collective(self, service: str, method: str,
+                          cntl: Controller) -> bool:
+        """Try the fused path; True means the call completed there.
+        Any lowering failure falls back to the per-sub fan-out — the
+        optimization must never change call semantics."""
+        coll = self._collective
+        if coll is None:
+            return False
+        fn = self._collective_fns.get((service, method))
+        if fn is None:
+            return False
+        arrs = cntl.request_device_arrays
+        if not arrs or len(arrs) != 1:
+            return False
+        if type(self.call_mapper) is not CallMapper:
+            # a custom mapper rewrites per-sub requests; the collective
+            # can only express the stock scatter shape
+            return False
+        if len(self._subs) != coll.n_shards or not self._all_device_lane():
+            return False
+        try:
+            out = coll.call(fn, arrs[0])
+        except Exception:
+            self.collective_fallbacks += 1
+            return False
+        self.collective_fused += 1
+        cntl.collective_lowered = True
+        cntl.response_device_arrays = [out]
+        cntl._complete()
+        return True
 
     def add_sub_channel(self, ch: Channel) -> None:
         self._subs.append(ch)
+        self._lane_verdict = None
 
     @property
     def sub_channel_count(self) -> int:
@@ -98,6 +169,8 @@ class ParallelChannel:
         if nsub == 0:
             cntl.set_failed(berr.EINTERNAL, "no sub channels")
             cntl._complete()
+            return cntl
+        if self._maybe_collective(service, method, cntl):
             return cntl
         fail_limit = (self.fail_limit if self.fail_limit is not None else nsub)
         state = {"pending": 0, "failed": 0, "done": False}

@@ -256,6 +256,14 @@ class BatchTracker:
     # stamp can touch the already-submitted span, and a stamp that wins
     # finishes its span writes before the settle can submit.
     def lane_encoded(self, staged: bool = False) -> None:
+        if self.span is None and not staged:
+            # rpcz off and nothing to count: the stamp is a plain int
+            # store the latch exists to protect SPAN writes from — a
+            # settle racing it at worst reads the old value and books
+            # those microseconds to the neighboring stage bucket. The
+            # lock here was the hot path's single biggest tax.
+            self.t_encoded = time.monotonic_ns()
+            return
         with self.cell._lock:
             if self._finished:
                 return
@@ -270,6 +278,10 @@ class BatchTracker:
                 self.span.write_done_us = self.t_encoded // 1000
 
     def lane_flushed(self) -> None:
+        if self.span is None:
+            # same span-less fast path as lane_encoded
+            self.t_flushed = time.monotonic_ns()
+            return
         with self.cell._lock:
             if self._finished:
                 return

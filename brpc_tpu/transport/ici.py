@@ -38,9 +38,17 @@ Frame format (all big-endian):
     type 1 pull descriptor: uuid:u64, count:u16, then per array
            {dtype_len:u8, dtype, rank:u8, dims:i64*rank, nbytes:u64}
     type 2 hello (json)
-    type 3 bare ack (empty payload; header ack is the message)
+    type 3 bare ack (payload empty, or a u32 adaptive window grant —
+           header ack is the message, the grant is the receiver
+           resizing the sender's pipeline from its admission headroom)
     type 4 staged batch (numpy fallback when either side lacks a
            transfer server — the old tpud lane, clearly second-class)
+    type 5 coalesced group: mode:u8 (0 descriptor / 1 staged),
+           count:u16, then mode 0: uid:u64 + per sub-batch
+           {count:u16, array specs as type 1}; mode 1: per sub-batch
+           {len:u32, staged blob}. One registration / one receiver
+           reservation for N small batches; window + ack accounting
+           stays per sub-batch.
 """
 
 from __future__ import annotations
@@ -71,10 +79,34 @@ F_DESCRIPTOR = 1
 F_HELLO = 2
 F_ACK = 3
 F_STAGED = 4
+F_COALESCED = 5
 _HDR = struct.Struct(">BQI")
 _MAX_FRAME = 256 << 20
 _MAX_OUT = 64 << 20
 DEFAULT_WINDOW = 32
+# cap on framed-but-unwritten bytes per flush pass: one gather pass
+# frames every sendable queue item up to this, then pays ONE TCP write
+_FLUSH_CHUNK = 1 << 20
+
+_jax_mod = None
+
+
+def _jax():
+    """Module-cached jax import: the take path runs per batch and the
+    `import jax` statement is a sys.modules dict hit + attr dance we
+    don't need to repeat there."""
+    global _jax_mod
+    if _jax_mod is None:
+        import jax
+        _jax_mod = jax
+    return _jax_mod
+
+
+def _stager():
+    """The process-wide pinned H2D stager (plain device_put when the
+    native pinned arena or jax transfer runtime is absent)."""
+    from brpc_tpu.butil.device_pool import global_pinned_stager
+    return global_pinned_stager()
 
 _PROC_UUID = uuidlib.uuid4().hex
 
@@ -353,6 +385,29 @@ _define_flag("ici_reclaim_grace_s", 30.0,
              "seconds a closed connection's same-process exchange "
              "entries linger before reclaim (peer may still take them)")
 
+# --- device-lane speed-run knobs (docs/performance.md "Device lane
+# tuning"): the idle-ACK timer closes the "cells only balance after
+# close" gap, coalescing collapses bursts of tiny batches into one
+# frame/registration/reservation, and the adaptive grant lets a
+# receiver with headroom deepen the sender's pipeline.
+_define_flag("ici_idle_ack_ms", 2.0,
+             "idle-ACK timer: a conn that consumed batches but has no "
+             "reverse traffic sends a bare ACK after this many ms so "
+             "the sender's window reopens (and its /device cells "
+             "balance) without waiting for close; <=0 disables")
+_define_flag("ici_coalesce_bytes", 16 << 10,
+             "lane batches whose arrays total at most this many bytes "
+             "are eligible to coalesce into one descriptor frame / one "
+             "pull registration / one receiver reservation; <=0 "
+             "disables coalescing")
+_define_flag("ici_coalesce_max", 16,
+             "max lane batches per coalesced frame (the flush-on-"
+             "window-or-bytes cap)")
+_define_flag("ici_adaptive_window", True,
+             "receivers ride a window grant on bare ACKs sized from "
+             "pool headroom: free pool -> grant 2x the hello window "
+             "(deeper pipelining), pool under pressure -> window/4")
+
 
 def _reclaim_grace_s() -> float:
     return float(_flag("ici_reclaim_grace_s"))
@@ -427,16 +482,33 @@ def expose_ici_vars() -> None:
         adder.reexpose_counter()
 
 
+def _encode_spec(a) -> bytes:
+    dt = str(a.dtype).encode()
+    parts = [struct.pack(">B", len(dt)), dt, struct.pack(">B", a.ndim)]
+    if a.ndim:
+        parts.append(struct.pack(f">{a.ndim}q", *a.shape))
+    parts.append(struct.pack(">Q", a.nbytes))
+    return b"".join(parts)
+
+
+def _decode_spec(data: bytes, pos: int) -> Tuple[dict, int]:
+    (dtlen,) = struct.unpack_from(">B", data, pos)
+    pos += 1
+    dtype = data[pos:pos + dtlen].decode()
+    pos += dtlen
+    (rank,) = struct.unpack_from(">B", data, pos)
+    pos += 1
+    shape = struct.unpack_from(f">{rank}q", data, pos) if rank else ()
+    pos += 8 * rank
+    (nbytes,) = struct.unpack_from(">Q", data, pos)
+    pos += 8
+    return {"dtype": dtype, "shape": tuple(shape), "nbytes": nbytes}, pos
+
+
 def _encode_descriptor(uid: int, arrays) -> bytes:
     parts = [struct.pack(">QH", uid, len(arrays))]
     for a in arrays:
-        dt = str(a.dtype).encode()
-        parts.append(struct.pack(">B", len(dt)))
-        parts.append(dt)
-        parts.append(struct.pack(">B", a.ndim))
-        if a.ndim:
-            parts.append(struct.pack(f">{a.ndim}q", *a.shape))
-        parts.append(struct.pack(">Q", a.nbytes))
+        parts.append(_encode_spec(a))
     return b"".join(parts)
 
 
@@ -445,19 +517,56 @@ def _decode_descriptor(data: bytes) -> Tuple[int, List[dict]]:
     pos = 10
     specs = []
     for _ in range(count):
-        (dtlen,) = struct.unpack_from(">B", data, pos)
-        pos += 1
-        dtype = data[pos:pos + dtlen].decode()
-        pos += dtlen
-        (rank,) = struct.unpack_from(">B", data, pos)
-        pos += 1
-        shape = struct.unpack_from(f">{rank}q", data, pos) if rank else ()
-        pos += 8 * rank
-        (nbytes,) = struct.unpack_from(">Q", data, pos)
-        pos += 8
-        specs.append({"dtype": dtype, "shape": tuple(shape),
-                      "nbytes": nbytes})
+        spec, pos = _decode_spec(data, pos)
+        specs.append(spec)
     return uid, specs
+
+
+def _encode_coalesced(uid: Optional[int], batches) -> bytes:
+    """F_COALESCED payload: N sub-batches in one frame. ``uid`` is the
+    group's single registration (descriptor mode); None means staged
+    mode (each sub-batch's numpy blob rides inline)."""
+    if uid is None:
+        parts = [struct.pack(">BH", 1, len(batches))]
+        for arrays in batches:
+            blob = _encode_device_batch(arrays)
+            parts.append(struct.pack(">I", len(blob)))
+            parts.append(blob)
+    else:
+        parts = [struct.pack(">BH", 0, len(batches)),
+                 struct.pack(">Q", uid)]
+        for arrays in batches:
+            parts.append(struct.pack(">H", len(arrays)))
+            for a in arrays:
+                parts.append(_encode_spec(a))
+    return b"".join(parts)
+
+
+def _decode_coalesced(data: bytes):
+    """-> ("staged", None, [blob, ...]) |
+          ("pull", uid, [[spec, ...] per sub-batch])"""
+    mode, count = struct.unpack_from(">BH", data, 0)
+    pos = 3
+    if mode == 1:
+        blobs = []
+        for _ in range(count):
+            (ln,) = struct.unpack_from(">I", data, pos)
+            pos += 4
+            blobs.append(data[pos:pos + ln])
+            pos += ln
+        return "staged", None, blobs
+    (uid,) = struct.unpack_from(">Q", data, pos)
+    pos += 8
+    groups = []
+    for _ in range(count):
+        (narr,) = struct.unpack_from(">H", data, pos)
+        pos += 2
+        specs = []
+        for _ in range(narr):
+            spec, pos = _decode_spec(data, pos)
+            specs.append(spec)
+        groups.append(specs)
+    return "pull", uid, groups
 
 
 class IciConn(Conn):
@@ -535,6 +644,24 @@ class IciConn(Conn):
         # flow-control state (receiver side)
         self._consumed = 0                       # batches we pulled
         self._acked_sent = 0                     # last consumed count sent
+        # defer-flush window (hold_flush/release_flush): >0 means a
+        # caller is batching enqueues (device batch + its envelope) and
+        # will drain them in one gather-write at release
+        self._hold_depth = 0
+        self._flush_pending = False
+        # adaptive window: last grant the peer rode on a bare ACK
+        # (0 = none yet; effective window stays the hello window)
+        self._peer_grant = 0
+        # idle-ACK timer state (under _fc_lock) + lane counters
+        self._idle_ack_armed = False
+        self._idle_acks = 0
+        self._coalesced_frames = 0
+        self._coalesced_batches = 0
+        # take-path caches: the recv device is fixed per conn (the
+        # ordinal came from the endpoint), so jax.devices() and the
+        # SingleDeviceSharding need resolving once, not per batch
+        self._recv_dev = None
+        self._recv_sharding = None
         # handshake
         self.peer_info: Optional[dict] = None
         self._hello_evt = threading.Event()
@@ -625,20 +752,33 @@ class IciConn(Conn):
                         f"peer's DeviceRecvPool capacity)")
         return None
 
+    def _effective_window(self, info: dict) -> int:
+        """The batch window actually gating sends: the peer's hello
+        window, overridden by the adaptive grant it rode on a bare ACK
+        (bounded to 4x the hello window so a corrupt grant can't blow
+        the pipeline open)."""
+        base = int(info.get("window", 1))
+        grant = self._peer_grant
+        if grant > 0:
+            return max(1, min(grant, base * 4))
+        return base
+
     def _lane_ready(self) -> bool:
         """May the queue-head device batch go out? Gates: hello received
-        (QP up), batch window, and the peer's advertised byte budget —
-        bytes in flight plus this batch must fit, so per-connection
-        in-flight bytes can never exceed what the receiver advertised.
-        A batch larger than the budget (but within the peer's pool
-        capacity) goes out ALONE once the lane drains."""
+        (QP up), batch window (adaptive — see _effective_window), and
+        the peer's advertised byte budget — bytes in flight plus this
+        batch must fit, so per-connection in-flight bytes can never
+        exceed what the receiver advertised. A batch larger than the
+        budget (but within the peer's pool capacity) goes out ALONE
+        once the lane drains."""
         info = self.peer_info
         if info is None:
             return False                     # QP not up yet
         budget = int(info.get("budget") or 0)
         need = self._batch_footprint(self._outq[0][1])
+        window = self._effective_window(info)
         with self._fc_lock:
-            if (self._sent - self._peer_acked) >= int(info.get("window", 1)):
+            if (self._sent - self._peer_acked) >= window:
                 return False
             if (budget and self._inflight_bytes + need > budget
                     and self._inflight_bytes > 0):
@@ -685,14 +825,143 @@ class IciConn(Conn):
             self._inflight_footprints.append((footprint, is_pull, tracker))
             self._inflight_bytes += footprint
             self._sent += 1
-        _sweep_reclaim()
+        # NOTE: no _sweep_reclaim() here — the grace sweep runs on the
+        # timer close() schedules, not on every staged frame (it was a
+        # lock + clock read on the hottest path in the lane)
         return frame
+
+    def _collect_coalesce(self, head: Tuple) -> Optional[List[Tuple]]:
+        """Called under _lock with ``head`` (a lane item) just popped:
+        pull additional SMALL lane batches out of _outq so the group
+        rides ONE coalesced frame — one descriptor, one registration,
+        one receiver-side reservation. Hoisting a later lane batch over
+        interleaved byte frames is safe (a descriptor only has to
+        precede its OWN envelope; the receiver matches batches to
+        envelopes FIFO in descriptor order) — which is also why an
+        INELIGIBLE lane batch stops the scan: lane batches must keep
+        their relative order. Returns the extra items (already removed
+        from _outq), or None."""
+        limit = int(_flag("ici_coalesce_bytes"))
+        nmax = int(_flag("ici_coalesce_max"))
+        if limit <= 0 or nmax <= 1 or not self._outq:
+            return None
+        if sum(a.nbytes for a in head[1]) > limit:
+            return None
+        info = self.peer_info or {}
+        budget = int(info.get("budget") or 0)
+        window = self._effective_window(info)
+        with self._fc_lock:
+            slots = window - (self._sent - self._peer_acked) - 1
+            room = (budget - self._inflight_bytes
+                    - self._batch_footprint(head[1])) if budget else None
+        if slots <= 0:
+            return None
+        extras: List[Tuple] = []
+        keep: Deque[Tuple] = deque()
+        while self._outq and len(extras) < nmax - 1 and slots > 0:
+            it = self._outq.popleft()
+            if it[0] != "lane":
+                keep.append(it)
+                continue
+            fp = self._batch_footprint(it[1])
+            if sum(a.nbytes for a in it[1]) > limit \
+                    or (room is not None and fp > room) \
+                    or self._unsendable_reason(it[1]) is not None:
+                keep.append(it)
+                break
+            extras.append(it)
+            slots -= 1
+            if room is not None:
+                room -= fp
+        while self._outq:
+            keep.append(self._outq.popleft())
+        self._outq = keep
+        return extras or None
+
+    def _stage_coalesced_frame(self, items: List[Tuple]) -> bytes:
+        """One F_COALESCED frame for N small lane batches: one uid /
+        one pull registration / one receiver reservation for the whole
+        group, while window, budget, and stage-tracker accounting stay
+        per sub-batch (each still consumes one window slot, one ack)."""
+        info = self.peer_info or {}
+        batches = [it[1] for it in items]
+        flat = [a for b in batches for a in b]
+        staged = False
+        is_pull = False
+        if info.get("proc") == _PROC_UUID:
+            uid = _next_uuid()
+            with _local_lock:
+                _local_exchange[uid] = flat
+            self._issued_uids.append(uid)
+            payload = _encode_coalesced(uid, batches)
+        else:
+            srv = _get_transfer_server()
+            if srv is not None and info.get("can_pull") \
+                    and _pull_lane_allowed(info.get("proc")):
+                uid = _next_uuid()
+                srv.await_pull(uid, flat)
+                self._issued_uids.append(uid)
+                with self._fc_lock:
+                    self._pull_registered += 1
+                payload = _encode_coalesced(uid, batches)
+                is_pull = True
+            else:
+                staged = True
+                payload = _encode_coalesced(None, batches)
+        frame = self._frame(F_COALESCED, payload)
+        for it in items:
+            if it[2] is not None:
+                it[2].lane_encoded(staged=staged)
+        with self._fc_lock:
+            for it in items:
+                fp = self._batch_footprint(it[1])
+                self._inflight_footprints.append((fp, is_pull, it[2]))
+                self._inflight_bytes += fp
+                self._sent += 1
+            self._coalesced_frames += 1
+            self._coalesced_batches += len(items)
+        return frame
+
+    def hold_flush(self) -> None:
+        """Open a defer-flush window: while at least one hold is open,
+        _flush() only notes that work is pending — the matching
+        release_flush() drains everything in ONE gather-write. Channel
+        and server dispatch hold across their lane_lock pairing (device
+        batch + its envelope) so the TCP syscalls run OUTSIDE the lock
+        instead of serializing every worker fiber on it."""
+        with self._lock:
+            self._hold_depth += 1
+
+    def release_flush(self) -> None:
+        with self._lock:
+            self._hold_depth -= 1
+            fire = self._hold_depth == 0 and self._flush_pending
+            if fire:
+                self._flush_pending = False
+        if fire:
+            drained = self._flush()
+            # mirror _pump_locked's tail: a deferred flush that drains
+            # a previously-stalled queue must still fire the writable
+            # edge, or a parked keep_write fiber stays parked
+            if drained and self._want_writable:
+                self._want_writable = False
+                cb = self._on_writable_cb
+                if cb is not None:
+                    cb()
 
     def _flush(self) -> bool:
         """Drain wirebuf + eligible queue items into TCP. Single-flight
-        (two flushers would interleave framed bytes). True = all drained."""
+        (two flushers would interleave framed bytes). True = all
+        drained. Framing is a GATHER pass: every currently-sendable
+        queue item is framed before each TCP write, so a burst pays one
+        syscall, not one per item."""
         if self._poisoned is not None:
             raise ConnectionError(self._poisoned)
+        if self._hold_depth > 0:
+            with self._lock:
+                if self._hold_depth > 0:
+                    self._flush_pending = True
+                    return False
         with self._flush_lock:
             while True:
                 # re-check INSIDE the lock: a writer that passed the
@@ -700,6 +969,9 @@ class IciConn(Conn):
                 # not drain its frame past the popped batch
                 if self._poisoned is not None:
                     raise ConnectionError(self._poisoned)
+                stalled = self._frame_ready_items()
+                if not self._wirebuf:
+                    return not stalled
                 while self._wirebuf:
                     # the memoryview is released EXPLICITLY before the
                     # resize below: callee frames keep the view object
@@ -722,47 +994,70 @@ class IciConn(Conn):
                         # this lane frame's bytes fully left for TCP:
                         # pump-flush waypoint (wire_us starts here)
                         self._wire_marks.popleft()[1].lane_flushed()
-                poison = None
-                with self._lock:
-                    if not self._outq:
-                        return True
-                    item = self._outq[0]
-                    if item[0] == "lane":
-                        poison = self._unsendable_reason(item[1])
-                        if poison is not None:
-                            # poison the whole connection, not just the
-                            # item: later writes must not slip past the
-                            # popped batch or the receiver would FIFO-
-                            # match some other RPC's arrays to this
-                            # RPC's envelope
-                            self._outq.popleft()
-                            self._poisoned = poison
-                        elif not self._lane_ready():
-                            # out of credit: park until an ACK arrives
-                            self._want_writable = True
-                            return False
-                    if poison is None:
+                if stalled:
+                    return False
+
+    def _frame_ready_items(self) -> bool:
+        """Pop every currently-sendable _outq item and frame it into
+        _wirebuf (the caller pays one TCP write for the lot — PR 4's
+        gather-write idea applied to the lane). Adjacent small lane
+        batches coalesce into one F_COALESCED frame. Returns True when
+        the queue head is a credit-gated lane batch (caller parks for
+        the ACK edge). Runs under _flush_lock."""
+        while len(self._wirebuf) < _FLUSH_CHUNK:
+            poison = None
+            extras = None
+            with self._lock:
+                if not self._outq:
+                    return False
+                item = self._outq[0]
+                if item[0] == "lane":
+                    poison = self._unsendable_reason(item[1])
+                    if poison is not None:
+                        # poison the whole connection, not just the
+                        # item: later writes must not slip past the
+                        # popped batch or the receiver would FIFO-
+                        # match some other RPC's arrays to this
+                        # RPC's envelope
                         self._outq.popleft()
-                        if item[0] == "bytes":
-                            self._out_bytes -= len(item[1])
-                if poison is not None:
-                    if len(item) > 2 and item[2] is not None:
-                        # the popped batch's tracker settles as failed
-                        # (the span carries the unsendable reason)
-                        item[2].lane_failed(poison)
-                    raise ConnectionError(poison)
-                if item[0] == "bytes":
-                    self._wirebuf += self._frame(F_BYTES, item[1])
-                elif item[0] == "ctrl":
-                    self._wirebuf += self._frame(item[1], item[2])
-                else:                         # lane
-                    tracker = item[2]
-                    self._wirebuf += self._stage_lane_frame(item[1],
-                                                            tracker)
-                    if tracker is not None:
-                        self._wire_marks.append(
-                            (self._wire_written + len(self._wirebuf),
-                             tracker))
+                        self._poisoned = poison
+                    elif not self._lane_ready():
+                        # out of credit: park until an ACK arrives
+                        self._want_writable = True
+                        return True
+                    else:
+                        self._outq.popleft()
+                        extras = self._collect_coalesce(item)
+                else:
+                    self._outq.popleft()
+                    if item[0] == "bytes":
+                        self._out_bytes -= len(item[1])
+            if poison is not None:
+                if len(item) > 2 and item[2] is not None:
+                    # the popped batch's tracker settles as failed
+                    # (the span carries the unsendable reason)
+                    item[2].lane_failed(poison)
+                raise ConnectionError(poison)
+            if item[0] == "bytes":
+                self._wirebuf += self._frame(F_BYTES, item[1])
+            elif item[0] == "ctrl":
+                self._wirebuf += self._frame(item[1], item[2])
+            elif extras:
+                group = [item] + extras
+                self._wirebuf += self._stage_coalesced_frame(group)
+                end = self._wire_written + len(self._wirebuf)
+                for it in group:
+                    if it[2] is not None:
+                        self._wire_marks.append((end, it[2]))
+            else:                             # lone lane batch
+                tracker = item[2]
+                self._wirebuf += self._stage_lane_frame(item[1],
+                                                        tracker)
+                if tracker is not None:
+                    self._wire_marks.append(
+                        (self._wire_written + len(self._wirebuf),
+                         tracker))
+        return False
 
     def write(self, mv: memoryview) -> int:
         if self._poisoned is not None:
@@ -777,7 +1072,7 @@ class IciConn(Conn):
         inputs are device_put once here (H2D staging); from then on the
         payload moves device-to-device only. ``tracker``: the
         device_stats stage timeline riding this batch (or None)."""
-        import jax
+        jax = _jax()
         staged = []
         for a in arrays:
             if not isinstance(a, jax.Array):
@@ -847,6 +1142,15 @@ class IciConn(Conn):
                 self._lane.append(("pull", uid, specs))
             elif ftype == F_STAGED:
                 self._lane.append(("staged", payload, None))
+            elif ftype == F_COALESCED:
+                mode, uid, subs = _decode_coalesced(payload)
+                # one group dict shared by all sub-entries: the FIRST
+                # take materializes the whole group (one pull / one
+                # reservation), later takes just index into it
+                group = {"mode": mode, "uid": uid, "subs": subs,
+                         "out": None, "error": None}
+                for i in range(len(subs)):
+                    self._lane.append(("coal", group, i))
             elif ftype == F_HELLO:
                 try:
                     self.peer_info = json.loads(payload.decode())
@@ -855,7 +1159,12 @@ class IciConn(Conn):
                 self._hello_evt.set()
                 window_opened = True          # lane may be gated on hello
             elif ftype == F_ACK:
-                pass                          # header ack already applied
+                # header ack already applied; payload may carry the
+                # receiver's adaptive window grant
+                if len(payload) >= 4:
+                    (grant,) = struct.unpack_from(">I", payload, 0)
+                    self._peer_grant = grant
+                    window_opened = True      # a wider grant may unpark
             else:
                 raise ConnectionError(f"ici: unknown frame type {ftype}")
         if window_opened:
@@ -877,10 +1186,40 @@ class IciConn(Conn):
         raise BlockingIOError
 
     def _recv_device(self):
-        import jax
-        devs = jax.devices()
-        k = self._recv_device_ordinal
-        return devs[k] if 0 <= k < len(devs) else devs[0]
+        """Resolved ONCE per conn: jax.devices() re-enumerates the
+        client's device list per call, which the take path used to pay
+        per batch."""
+        dev = self._recv_dev
+        if dev is None:
+            devs = _jax().devices()
+            k = self._recv_device_ordinal
+            dev = devs[k] if 0 <= k < len(devs) else devs[0]
+            self._recv_dev = dev
+        return dev
+
+    def _ack_grant_payload(self) -> bytes:
+        """Adaptive window grant riding the bare-ACK payload: the
+        receiver sizes the sender's pipeline from its own admission
+        headroom (the input the sender's ack-stage reservoir reflects —
+        ack latency is set by how deep the pipeline runs vs how fast
+        takes drain it). Plenty of pool headroom -> grant 2x the hello
+        window (deeper pipelining); pool under pressure -> shrink
+        toward window/4 so the blocking admission gate, not the wire,
+        is what backs off."""
+        if not _flag("ici_adaptive_window"):
+            return b""
+        cap = self._pool.capacity or 1
+        try:
+            frac = self._pool.available / cap
+        except Exception:
+            frac = 1.0
+        if frac >= 0.5:
+            grant = self._window * 2
+        elif frac >= 0.25:
+            grant = self._window
+        else:
+            grant = max(1, self._window // 4)
+        return struct.pack(">I", grant)
 
     def _maybe_send_ack(self) -> None:
         """Bare ACK once half the window is unacknowledged and no
@@ -888,7 +1227,7 @@ class IciConn(Conn):
         rdma_endpoint.h:138)."""
         if self._consumed - self._acked_sent >= max(1, self._window // 2):
             try:
-                self._enqueue(("ctrl", F_ACK, b""))
+                self._enqueue(("ctrl", F_ACK, self._ack_grant_payload()))
             except BlockingIOError:
                 return      # out-buffer full: the ack piggybacks later
             except ConnectionError:
@@ -898,6 +1237,158 @@ class IciConn(Conn):
                 # caller already took successfully
                 return
             self._flush()
+
+    def _arm_idle_ack(self) -> None:
+        """Eager-ACK timer: a quiescent conn must not leave its last
+        consumed batches un-ACKed until close (acks normally piggyback
+        on reverse traffic or fire at half-window). Armed from the take
+        path; fires once, the next take re-arms. This is what lets the
+        sender's /device cells balance WITHOUT a close(), and what
+        reopens a ping-pong sender's window inside the same RTT."""
+        if self._closed or self._consumed <= self._acked_sent:
+            return
+        delay = float(_flag("ici_idle_ack_ms")) / 1000.0
+        if delay <= 0:
+            return
+        with self._fc_lock:
+            if self._idle_ack_armed:
+                return
+            self._idle_ack_armed = True
+        try:
+            from brpc_tpu.fiber.timer import global_timer
+            global_timer().schedule_after(delay, self._idle_ack_fire)
+        except Exception:
+            with self._fc_lock:
+                self._idle_ack_armed = False
+
+    def _idle_ack_fire(self) -> None:
+        with self._fc_lock:
+            self._idle_ack_armed = False
+        if self._closed or self._consumed <= self._acked_sent:
+            return          # a frame already carried the ack
+        try:
+            self._enqueue(("ctrl", F_ACK, self._ack_grant_payload()))
+        except (BlockingIOError, ConnectionError):
+            return
+        self._idle_acks += 1
+        try:
+            self._flush()
+        except Exception:
+            pass            # conn poisoned/torn down under the timer
+
+    def _sharding_for(self, target):
+        if self._recv_sharding is None:
+            self._recv_sharding = \
+                _jax().sharding.SingleDeviceSharding(target)
+        return self._recv_sharding
+
+    def _take_local(self, uid: int, target) -> list:
+        """Same-process take: pop the exchange entry, credit a grace-
+        queued uid as DELIVERED, and device_put (the D2D/ICI hop)."""
+        jax = _jax()
+        with _local_lock:
+            arrays = _local_exchange.pop(uid, None)
+            # a grace-queued entry (sender closed) that the peer
+            # legitimately takes is DELIVERED, not leaked: credit the
+            # bytes its close charged
+            grace_credit = _grace_uid_bytes.pop(uid, 0) \
+                if arrays is not None else 0
+        if grace_credit:
+            _reclaimed_bytes_counter.add(grace_credit)
+        if arrays is None:
+            raise ConnectionError(
+                "ici: same-process batch no longer available "
+                "(sender closed and its registration was "
+                "reclaimed)")
+        return [a if (hasattr(a, "devices") and target in a.devices())
+                else jax.device_put(a, target) for a in arrays]
+
+    def _pull_arrays(self, uid: int, specs: List[dict], target) -> list:
+        """Cross-process take: PjRt pull straight onto our device."""
+        jax = _jax()
+        info = self.peer_info or {}
+        addr = _canonical_addr(info["transfer_addr"],
+                               self._remote.host or "127.0.0.1")
+        pconn = _get_pull_conn(addr)
+        sharding = self._sharding_for(target)
+        sds = [jax.ShapeDtypeStruct(
+            s["shape"], _np_dtype(s["dtype"]),
+            sharding=sharding) for s in specs]
+        try:
+            return pconn.pull(uid, sds)
+        except BaseException:
+            # a failed pull poisons the cached connection
+            # (peer restart leaves a half-dead channel):
+            # drop it so the next pull redials
+            with _server_lock:
+                if _conn_cache.get(addr) is pconn:
+                    del _conn_cache[addr]
+            raise
+
+    def _materialize_coalesced(self, group: dict, target) -> List[list]:
+        """First take of a coalesced group: ONE pool reservation for
+        the whole group's footprint, one pull (or one exchange pop /
+        one staged decode), then split back into per-sub-batch lists.
+        The reservation is released when the LAST array of the group
+        dies (GroupReservation refcount)."""
+        jax = _jax()
+        info = self.peer_info or {}
+        if group["mode"] == "staged":
+            subs = [_decode_device_batch(blob) for blob in group["subs"]]
+            footprint = sum(round_to_class(x.nbytes)
+                            for b in subs for x in b)
+            res = self._pool.reserve_group(footprint)
+            stager = _stager()
+            try:
+                outs = [[stager.land(x, device=target) for x in b]
+                        for b in subs]
+            except BaseException:
+                self._pool.release(res)
+                raise
+        else:
+            spec_groups = group["subs"]
+            flat_specs = [s for g in spec_groups for s in g]
+            footprint = sum(round_to_class(s["nbytes"])
+                            for s in flat_specs)
+            res = self._pool.reserve_group(footprint)
+            try:
+                if info.get("proc") == _PROC_UUID:
+                    flat = self._take_local(group["uid"], target)
+                else:
+                    flat = self._pull_arrays(group["uid"], flat_specs,
+                                             target)
+                outs = []
+                pos = 0
+                for g in spec_groups:
+                    outs.append(list(flat[pos:pos + len(g)]))
+                    pos += len(g)
+            except BaseException:
+                self._pool.release(res)
+                raise
+        from brpc_tpu.butil.device_pool import GroupReservation
+        holder = GroupReservation(self._pool, res,
+                                  sum(len(o) for o in outs))
+        for sub in outs:
+            for arr in sub:
+                self._pool.attach_group_finalizer(arr, holder)
+        return outs
+
+    def _take_coalesced(self, group: dict, idx: int, target) -> list:
+        err = group.get("error")
+        if err is not None:
+            # a sibling's materialization failed: every sub-batch of
+            # the group fails the same way (one registration, one fate)
+            raise ConnectionError(err)
+        outs = group.get("out")
+        if outs is None:
+            try:
+                outs = self._materialize_coalesced(group, target)
+            except BaseException as e:
+                group["error"] = \
+                    f"ici: coalesced group materialization failed: {e}"
+                raise
+            group["out"] = outs
+        return outs[idx]
 
     def take_device_payload(self):
         # NO TCP pump here: a descriptor frame always precedes its
@@ -910,8 +1401,15 @@ class IciConn(Conn):
             if not self._lane:
                 return None
             kind, a, b = self._lane.popleft()
-        import jax
+        jax = _jax()
         target = self._recv_device()
+        if kind == "coal":
+            out = self._take_coalesced(a, b, target)
+            with self._pump_lock:
+                self._consumed += 1
+            self._maybe_send_ack()
+            self._arm_idle_ack()
+            return out
         footprints: List[int] = []
         try:
             # reserve inside the try: a partial multi-array reservation
@@ -921,9 +1419,10 @@ class IciConn(Conn):
             # transfer server must not escape the budget).
             if kind == "staged":
                 batch = _decode_device_batch(a)
+                stager = _stager()
                 for x in batch:
                     footprints.append(self._pool.reserve(x.nbytes))
-                out = [jax.device_put(x, target) for x in batch]
+                out = [stager.land(x, device=target) for x in batch]
             else:
                 uid, specs = a, b
                 info = self.peer_info or {}
@@ -932,41 +1431,9 @@ class IciConn(Conn):
                 if info.get("proc") == _PROC_UUID:
                     # same-process: receiver-driven device_put = the D2D
                     # copy (ICI hop on real multi-chip hardware)
-                    with _local_lock:
-                        arrays = _local_exchange.pop(uid, None)
-                        # a grace-queued entry (sender closed) that the
-                        # peer legitimately takes is DELIVERED, not
-                        # leaked: credit the bytes its close charged
-                        grace_credit = _grace_uid_bytes.pop(uid, 0) \
-                            if arrays is not None else 0
-                    if grace_credit:
-                        _reclaimed_bytes_counter.add(grace_credit)
-                    if arrays is None:
-                        raise ConnectionError(
-                            "ici: same-process batch no longer available "
-                            "(sender closed and its registration was "
-                            "reclaimed)")
-                    out = [a if (hasattr(a, "devices")
-                                 and target in a.devices())
-                           else jax.device_put(a, target) for a in arrays]
+                    out = self._take_local(uid, target)
                 else:
-                    addr = _canonical_addr(info["transfer_addr"],
-                                           self._remote.host or "127.0.0.1")
-                    pconn = _get_pull_conn(addr)
-                    sharding = jax.sharding.SingleDeviceSharding(target)
-                    sds = [jax.ShapeDtypeStruct(
-                        s["shape"], _np_dtype(s["dtype"]),
-                        sharding=sharding) for s in specs]
-                    try:
-                        out = pconn.pull(uid, sds)
-                    except BaseException:
-                        # a failed pull poisons the cached connection
-                        # (peer restart leaves a half-dead channel):
-                        # drop it so the next pull redials
-                        with _server_lock:
-                            if _conn_cache.get(addr) is pconn:
-                                del _conn_cache[addr]
-                        raise
+                    out = self._pull_arrays(uid, specs, target)
         except BaseException:
             # admission timeout (MemoryError after reserve's 10s wait)
             # or pull failure: the error escapes into the input path,
@@ -982,6 +1449,7 @@ class IciConn(Conn):
         with self._pump_lock:
             self._consumed += 1
         self._maybe_send_ack()
+        self._arm_idle_ack()
         return out
 
     # --------------------------------------------------------- plumbing
@@ -1137,21 +1605,29 @@ class IciConn(Conn):
             outstanding = self._sent - self._peer_acked
             inflight_bytes = self._inflight_bytes
             sent = self._sent
+            coalesced_frames = self._coalesced_frames
+            coalesced_batches = self._coalesced_batches
         with self._lock:
             outq_depth = len(self._outq)
             out_bytes = self._out_bytes
+        effective = self._effective_window(info) if info else window
         buffered = len(self._wirebuf) + len(self._inbuf) \
             + len(self._appbuf) + out_bytes
         return {
             "remote": str(self._remote),
             "lane_kind": self.lane_kind,
             "window": window,
+            "effective_window": effective,
+            "peer_grant": self._peer_grant,
             "outstanding_batches": outstanding,
-            "window_occupancy": round(outstanding / window, 3)
-            if window else 0.0,
+            "window_occupancy": round(outstanding / effective, 3)
+            if effective else 0.0,
             "inflight_bytes": inflight_bytes,
             "budget": int(info.get("budget") or 0),
             "batches_sent": sent,
+            "coalesced_frames": coalesced_frames,
+            "coalesced_batches": coalesced_batches,
+            "idle_acks": self._idle_acks,
             "enqueue_depth": outq_depth,
             "buffered_bytes": buffered,
             "want_writable": self._want_writable,

@@ -165,18 +165,49 @@ class TestNativeRing:
         peer drains delivers every byte exactly once, in order."""
         a, b = _pair()
         try:
+            # the uring backend only surfaces OP_WRITEV settles for
+            # REGISTERED fds (generation-checked against the slot);
+            # harmless on the batch backend (no peer data to recv)
+            ring.register_fd(a.fileno(), 0)
             payload = bytes(range(256)) * 4096        # 1 MiB
             total = len(payload)
             sent = 0
             received = bytearray()
             saw_short = False
             deadline = time.monotonic() + 30
+            def drain_peer():
+                try:
+                    while True:
+                        data = b.recv(65536)
+                        if not data:
+                            break
+                        received.extend(data)
+                except BlockingIOError:
+                    pass
+
             while sent < total and time.monotonic() < deadline:
                 chunk = payload[sent:]
                 (fd, res, err), = ring.flush_writes(
                     [(a.fileno(), (chunk,))])
                 assert fd == a.fileno()
-                if res >= 0:
+                if res < 0 and err == 0:
+                    # uring backend: the gather is PENDING and settles
+                    # via its OP_WRITEV completion; keep the peer
+                    # draining so the kernel can finish the write
+                    res = None
+                    while res is None and time.monotonic() < deadline:
+                        drain_peer()
+                        for comp in ring.wait(20):
+                            if (comp[0] == a.fileno()
+                                    and comp[1] == ring_lane.OP_WRITEV):
+                                res = comp[2]
+                                break
+                    assert res is not None, "OP_WRITEV never settled"
+                    assert res >= 0, res
+                    if 0 < res < len(chunk):
+                        saw_short = True
+                    sent += res
+                elif res >= 0:
                     if 0 < res < len(chunk):
                         saw_short = True
                     sent += res
@@ -184,14 +215,7 @@ class TestNativeRing:
                     assert err in (errno.EAGAIN, errno.EWOULDBLOCK), \
                         (res, err)
                 # drain the peer so the writer can make progress
-                try:
-                    while True:
-                        data = b.recv(65536)
-                        if not data:
-                            break
-                        received += data
-                except BlockingIOError:
-                    pass
+                drain_peer()
             assert sent == total
             try:
                 while True:

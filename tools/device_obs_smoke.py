@@ -197,18 +197,27 @@ def run_smoke(out: dict) -> None:
     else:
         out["overhead_skipped"] = "BRPC_TPU_PERF_SMOKE=0"
 
-    # ---- 2. cells balance after close (close settles un-ACKed tails)
-    ch.close()
-    time.sleep(0.1)
-    page = ds.device_page_payload()
+    # ---- 2. cells balance on a LIVE conn (no close): the idle-ack
+    # timer flushes the consumed-but-unsignaled tail, so a quiescent
+    # lane must settle to transfers == completed + failed on its own —
+    # closing first would hide a broken eager-ack path entirely.
+    deadline = time.monotonic() + 5.0
+    bad: List[str] = []
+    while True:
+        page = ds.device_page_payload()
+        bad = [k for k, row in page["cells"].items()
+               if row["transfers"] != row["completed"] + row["failed"]]
+        if not bad or time.monotonic() >= deadline:
+            break
+        time.sleep(0.05)
     totals = page["totals"]
     out["cells"] = {k: {kk: v[kk] for kk in
                         ("transfers", "completed", "failed", "bytes_out")}
                     for k, v in page["cells"].items()}
-    bad = [k for k, row in page["cells"].items()
-           if row["transfers"] != row["completed"] + row["failed"]]
     if bad:
-        problems.append(f"cells out of balance after close: {bad}")
+        problems.append(f"cells out of balance without close: {bad}")
+    ch.close()
+    time.sleep(0.1)
     # byte corpus: the burst is uniform (arr.nbytes per transfer), so
     # every cell's bytes_out must equal its transfer count times the
     # payload size — an accounting drift shows as a mismatch here

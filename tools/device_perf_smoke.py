@@ -1,0 +1,160 @@
+"""Device-lane perf smoke: the fast, machine-relative floor check for
+ISSUE 19's speed run (the device sibling of tools/perf_smoke.py).
+
+Measures, over an in-process ici:// loopback:
+
+  ici_small_batch_us   pipelined 4B-16KB device echo, mean latency
+  ici_headline_GBps    pipelined 1MB device echo, 2-leg GB/s
+  small_latency_ratio  ici_small_batch_us / host-payload small echo µs
+  headline_ratio       ici_headline_GBps / host-payload 1MB GB/s
+
+Absolute numbers do NOT transfer across harnesses; the ratios against
+a plain host-payload RPC on the SAME box in the SAME process do — a
+device-lane regression moves the ratio while machine speed cancels.
+Prints one JSON line; exit 1 only on measurement failure (floors are
+the gate's business, tools/preflight.py gate_device_perf).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _pipelined(n: int, inflight: int, issue) -> float:
+    """Issue ``n`` calls keeping ``inflight`` outstanding; returns
+    wall seconds. ``issue(i, on_done)`` must fire on_done(err) once."""
+    sem = threading.Semaphore(inflight)
+    done = threading.Event()
+    state = {"left": n, "err": None}
+    lock = threading.Lock()
+
+    def on_done(err):
+        sem.release()
+        with lock:
+            if err is not None and state["err"] is None:
+                state["err"] = err
+            state["left"] -= 1
+            if state["left"] == 0:
+                done.set()
+
+    t0 = time.perf_counter()
+    for i in range(n):
+        sem.acquire()
+        issue(i, on_done)
+    if not done.wait(120.0):
+        raise TimeoutError("pipelined burst never drained")
+    if state["err"] is not None:
+        raise RuntimeError(f"burst call failed: {state['err']}")
+    return time.perf_counter() - t0
+
+
+def main() -> int:
+    import numpy as np
+
+    from brpc_tpu.rpc import Channel, ChannelOptions, Server, ServerOptions
+    from brpc_tpu.rpc.service import Service
+
+    out = {}
+    server = Server(ServerOptions(enable_builtin_services=False))
+    svc = Service("Bench")
+
+    @svc.method()
+    def Echo(cntl, request):
+        if cntl.request_device_arrays:
+            cntl.response_device_arrays = list(cntl.request_device_arrays)
+        return bytes(request)
+
+    server.add_service(svc)
+    ep = server.start("ici://127.0.0.1:0#device=0")
+    ch = Channel(f"ici://127.0.0.1:{ep.port}",
+                 ChannelOptions(timeout_ms=30000))
+
+    def device_burst(nbytes: int, n: int, inflight: int):
+        host = np.ones((max(1, nbytes // 4),), np.float32)
+        lats = []
+
+        def issue(i, on_done):
+            t = time.perf_counter_ns()
+
+            def cb(cntl):
+                lats.append((time.perf_counter_ns() - t) / 1e3)
+                on_done(None if not cntl.failed() else cntl.error_text)
+
+            import jax
+            ch.call("Bench", "Echo", b"", done=cb,
+                    request_device_arrays=[jax.device_put(host)])
+
+        dt = _pipelined(n, inflight, issue)
+        return dt, sum(lats) / len(lats)
+
+    def host_burst(nbytes: int, n: int, inflight: int):
+        payload = b"x" * nbytes
+        lats = []
+
+        def issue(i, on_done):
+            t = time.perf_counter_ns()
+
+            def cb(cntl):
+                lats.append((time.perf_counter_ns() - t) / 1e3)
+                on_done(None if not cntl.failed() else cntl.error_text)
+
+            ch.call("Bench", "Echo", payload, done=cb)
+
+        dt = _pipelined(n, inflight, issue)
+        return dt, sum(lats) / len(lats)
+
+    try:
+        # warm both paths (compile device_put, dial, hello)
+        device_burst(4, 4, 4)
+        host_burst(4, 8, 4)
+
+        # small-batch lane: the coalescable sizes
+        small_lats = []
+        for sz in (4, 256, 4096, 16384):
+            _, avg = device_burst(sz, 32, 16)
+            small_lats.append(avg)
+        out["ici_small_batch_us"] = round(sum(small_lats)
+                                          / len(small_lats), 1)
+        _, host_small = host_burst(4096, 64, 16)
+        out["host_small_us"] = round(host_small, 1)
+        out["small_latency_ratio"] = round(
+            out["ici_small_batch_us"] / host_small, 2)
+
+        # headline: 1MB both legs
+        n = 24
+        dt, _ = device_burst(1 << 20, n, 8)
+        out["ici_headline_GBps"] = round(n * (1 << 20) * 2 / dt / 1e9, 4)
+        dt, _ = host_burst(1 << 20, n, 8)
+        host_gbps = n * (1 << 20) * 2 / dt / 1e9
+        out["host_1mb_GBps"] = round(host_gbps, 4)
+        out["headline_ratio"] = round(
+            out["ici_headline_GBps"] / host_gbps, 3)
+
+        conn = ch._get_socket().conn
+        intro = conn.lane_introspection()
+        out["lane_kind"] = intro["lane_kind"]
+        out["coalesced_frames"] = intro["coalesced_frames"]
+        out["idle_acks"] = intro["idle_acks"]
+        out["ok"] = True
+    except BaseException as e:  # noqa: BLE001 - report, don't traceback
+        out["ok"] = False
+        out["error"] = f"{type(e).__name__}: {e}"[:300]
+    finally:
+        try:
+            ch.close()
+            server.stop()
+            server.join(2)
+        except Exception:
+            pass
+    print(json.dumps(out), flush=True)
+    return 0 if out.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -536,6 +536,17 @@ def gate_chaos_smoke() -> dict:
 # gate entirely; BRPC_TPU_PERF_FLOOR_SCALE scales both floors.
 PERF_FLOORS = {"mb_eff": 0.458, "qps_ratio": 0.25}
 
+# Device-lane floors (tools/device_perf_smoke.py), machine-relative by
+# the same discipline: ratios against a host-payload RPC burst in the
+# same process. ISSUE-19-close calibration on cpu-dryrun loopback:
+#   headline_ratio        2.86-3.42 measured -> floor 2.9 * 0.7
+#   small_latency_ratio   1.6-2.33 measured (lower is better) ->
+#                         ceiling 2.33 * 1.5 (30% + sandbox noise)
+# BRPC_TPU_PERF_SMOKE=0 skips; BRPC_TPU_PERF_FLOOR_SCALE scales the
+# floor down / the ceiling up for slow machines.
+DEVICE_PERF_FLOOR_HEADLINE_RATIO = 2.0
+DEVICE_PERF_CEIL_SMALL_RATIO = 3.5
+
 
 def gate_flight_smoke() -> dict:
     """Flight-recorder smoke (tools/flight_smoke.py): a loopback PyEcho
@@ -931,6 +942,55 @@ def gate_perf_smoke() -> dict:
     return out
 
 
+def gate_device_perf() -> dict:
+    """Device-lane perf gate (tools/device_perf_smoke.py): the ici://
+    loopback's 1MB headline must stay >= 2x a host-payload burst on
+    the same box (floor = calibration * 0.7) and the 4B-16KB
+    small-batch latency must stay within 3.5x of the host small-RPC
+    burst — the pair the pipelined-window + coalescing work moves. A
+    subprocess so a wedged lane cannot hang the gate;
+    BRPC_TPU_PERF_SMOKE=0 skips."""
+    if os.environ.get("BRPC_TPU_PERF_SMOKE", "1") == "0":
+        return {"ok": True, "skipped": "BRPC_TPU_PERF_SMOKE=0"}
+    try:
+        scale = float(os.environ.get("BRPC_TPU_PERF_FLOOR_SCALE", "1.0"))
+    except ValueError:
+        scale = 1.0
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "device_perf_smoke.py")],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=420)
+    out: dict = {"ok": proc.returncode == 0}
+    try:
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        out["ok"] = False
+        out["error"] = (proc.stdout + proc.stderr)[-500:]
+        return out
+    out.update(report)
+    if not out["ok"]:
+        return out
+    floor = DEVICE_PERF_FLOOR_HEADLINE_RATIO * scale
+    ceil = DEVICE_PERF_CEIL_SMALL_RATIO / max(scale, 1e-9)
+    out["headline_ratio_floor"] = round(floor, 2)
+    out["small_latency_ratio_ceil"] = round(ceil, 2)
+    got = report.get("headline_ratio")
+    if got is None:
+        out["headline_ratio_missing"] = True
+    elif got < floor:
+        out["ok"] = False
+        out["regression"] = (f"headline_ratio {got} < floor "
+                             f"{round(floor, 2)}")
+    got = report.get("small_latency_ratio")
+    if got is None:
+        out["small_latency_ratio_missing"] = True
+    elif got > ceil:
+        out["ok"] = False
+        out["regression"] = (f"small_latency_ratio {got} > ceiling "
+                             f"{round(ceil, 2)}")
+    return out
+
+
 def run_gate() -> int:
     report = {}
     for name, fn in (("graftlint", gate_graftlint),
@@ -951,6 +1011,7 @@ def run_gate() -> int:
                      ("serving_obs", gate_serving_obs),
                      ("timeline_smoke", gate_timeline_smoke),
                      ("incident_smoke", gate_incident_smoke),
+                     ("device_perf", gate_device_perf),
                      ("perf_smoke", gate_perf_smoke)):
         try:
             report[name] = fn()
